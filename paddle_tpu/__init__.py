@@ -1,0 +1,13 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A from-scratch rebuild of the 2017 PaddlePaddle feature set (see SURVEY.md)
+designed TPU-first: JAX/XLA compilation, pjit/shard_map over device meshes in
+place of the parameter server and multi-GPU thread ring, Pallas kernels for
+fused hot spots, and sharded checkpointing.
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu import core, nn, ops
+
+__all__ = ["core", "nn", "ops", "__version__"]

@@ -1,0 +1,11 @@
+from paddle_tpu.core.errors import EnforceError, ConfigError, enforce, enforce_eq, enforce_in, enforce_rank
+from paddle_tpu.core.dtypes import Policy, get_policy, set_policy, mixed_precision, FLOAT32, MIXED_BF16
+from paddle_tpu.core.rng import KeySeq, as_key
+from paddle_tpu.core.config import OptimizationConfig, TrainerConfig
+
+__all__ = [
+    "EnforceError", "ConfigError", "enforce", "enforce_eq", "enforce_in",
+    "enforce_rank", "Policy", "get_policy", "set_policy", "mixed_precision",
+    "FLOAT32", "MIXED_BF16", "KeySeq", "as_key", "OptimizationConfig",
+    "TrainerConfig",
+]

@@ -1,0 +1,73 @@
+"""Typed configuration objects.
+
+TPU-native twin of the reference's protobuf config tier
+(``proto/TrainerConfig.proto:21-160``, ``proto/ModelConfig.proto``,
+``OptimizerConfig.proto``): plain dataclasses with dict round-tripping so they
+serialize into checkpoints (msgpack/json) the way the protos serialized into
+model files.  The Python layer DSL builds models directly (no proto
+indirection — XLA is the IR), so these configs carry *run* settings rather
+than the layer graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+
+def _asdict(obj) -> Dict[str, Any]:
+    return dataclasses.asdict(obj)
+
+
+@dataclasses.dataclass
+class OptimizationConfig:
+    """Twin of OptimizationConfig in TrainerConfig.proto + settings() kwargs
+    (python/paddle/trainer_config_helpers/optimizers.py:358)."""
+
+    batch_size: int = 32
+    learning_rate: float = 0.01
+    learning_method: str = "sgd"  # sgd|momentum|adagrad|adadelta|rmsprop|decayed_adagrad|adam|adamax
+    momentum: float = 0.0
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_schedule: str = "constant"
+    l1_rate: float = 0.0
+    l2_rate: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+    average_window: int = 0
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    to_dict = _asdict
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OptimizationConfig":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Run-level settings (twin of TrainerConfig.proto + utils/Flags.cpp)."""
+
+    num_passes: int = 1
+    log_period: int = 100
+    test_period: int = 0
+    saving_period: int = 1
+    save_dir: Optional[str] = None
+    start_pass: int = 0
+    seed: int = 0
+    use_bf16: bool = False
+    mesh_shape: Tuple[int, ...] = ()
+    mesh_axes: Tuple[str, ...] = ()
+
+    to_dict = _asdict
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainerConfig":
+        d = dict(d)
+        d["mesh_shape"] = tuple(d.get("mesh_shape", ()))
+        d["mesh_axes"] = tuple(d.get("mesh_axes", ()))
+        return cls(**d)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())

@@ -1,0 +1,66 @@
+"""Dtype policy for TPU execution.
+
+The reference framework is float32-only (optionally float64 via
+``WITH_DOUBLE``, ``paddle/math/Matrix.h``).  On TPU the MXU natively consumes
+bfloat16, so the idiomatic policy is: *parameters and optimizer state in
+float32, matmul/conv compute in bfloat16, reductions and losses in float32*.
+
+A :class:`Policy` bundles the three dtypes.  ``get_policy()`` returns the
+process-wide default, switchable with :func:`set_policy` or the
+``mixed_precision`` context manager.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator
+
+import jax.numpy as jnp
+
+Dtype = type(jnp.float32)  # loose alias; jnp dtypes are numpy dtype-likes
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.float32
+    output_dtype: object = jnp.float32
+
+    def cast_to_compute(self, x):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return x.astype(self.compute_dtype)
+        return x
+
+    def cast_to_output(self, x):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return x.astype(self.output_dtype)
+        return x
+
+
+FLOAT32 = Policy()
+MIXED_BF16 = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                    output_dtype=jnp.float32)
+
+_policy: Policy = FLOAT32
+
+
+def get_policy() -> Policy:
+    return _policy
+
+
+def set_policy(policy: Policy) -> None:
+    global _policy
+    _policy = policy
+
+
+@contextlib.contextmanager
+def mixed_precision(enabled: bool = True) -> Iterator[None]:
+    """Run the enclosed model construction under the bf16 compute policy."""
+    global _policy
+    prev = _policy
+    _policy = MIXED_BF16 if enabled else FLOAT32
+    try:
+        yield
+    finally:
+        _policy = prev

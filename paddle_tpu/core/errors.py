@@ -1,0 +1,54 @@
+"""Error checking utilities.
+
+TPU-native twin of the reference's ``PADDLE_ENFORCE`` macro family
+(``paddle/platform/enforce.h:62-230`` and ``paddle/utils/Error.h``): a single
+``enforce`` callable that raises a rich, framework-branded exception carrying
+the failing condition and a formatted message.  Unlike the C++ original there
+is no demangled stack trace machinery — Python tracebacks already provide it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+
+class EnforceError(RuntimeError):
+    """Raised when an ``enforce`` condition fails (PADDLE_ENFORCE twin)."""
+
+
+class ConfigError(ValueError):
+    """Raised for invalid model/optimizer/trainer configuration."""
+
+
+def enforce(condition: Any, msg: str = "", *fmt_args: Any) -> None:
+    """Raise :class:`EnforceError` unless ``condition`` is truthy.
+
+    ``fmt_args`` are lazily ``%``-formatted into ``msg`` only on failure, so
+    hot paths pay nothing for message construction.
+    """
+    if not condition:
+        _fail(msg, *fmt_args)
+
+
+def _fail(msg: str, *fmt_args: Any) -> NoReturn:
+    if fmt_args:
+        try:
+            msg = msg % fmt_args
+        except Exception:  # pragma: no cover - formatting is best effort
+            msg = f"{msg} {fmt_args}"
+    raise EnforceError(msg or "enforce failed")
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    if a != b:
+        _fail(f"enforce_eq failed: {a!r} != {b!r}. {msg}")
+
+
+def enforce_in(value: Any, options: Any, msg: str = "") -> None:
+    if value not in options:
+        _fail(f"enforce_in failed: {value!r} not in {options!r}. {msg}")
+
+
+def enforce_rank(x: Any, rank: int, name: str = "tensor") -> None:
+    if x.ndim != rank:
+        _fail(f"{name} must have rank {rank}, got shape {tuple(x.shape)}")

@@ -1,0 +1,33 @@
+"""RNG key plumbing.
+
+The reference seeds a global generator per thread (``paddle/math/MathUtils``,
+``utils/Util.cpp``).  JAX RNG is explicit and splittable; this module provides
+a tiny ``KeySeq`` so imperative-looking code (module init, dropout) can draw
+fresh keys deterministically from one root seed.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class KeySeq:
+    """A mutable stream of PRNG keys derived from one root key."""
+
+    def __init__(self, key_or_seed):
+        if isinstance(key_or_seed, int):
+            key_or_seed = jax.random.key(key_or_seed)
+        self._key = key_or_seed
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def __next__(self) -> jax.Array:
+        return self.next()
+
+
+def as_key(key_or_seed) -> jax.Array:
+    if isinstance(key_or_seed, int):
+        return jax.random.key(key_or_seed)
+    return key_or_seed

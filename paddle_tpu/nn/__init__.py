@@ -1,0 +1,15 @@
+from paddle_tpu.nn.module import (Module, Transformed, transform, param, state,
+                                  set_state, is_training, next_rng_key,
+                                  flatten_names, unflatten_names)
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.layers import (Linear, Embedding, Conv2D, Pool2D,
+                                  GlobalPool2D, BatchNorm, LayerNorm, Dropout,
+                                  Maxout, CrossChannelNorm, Sequential)
+
+__all__ = [
+    "Module", "Transformed", "transform", "param", "state", "set_state",
+    "is_training", "next_rng_key", "flatten_names", "unflatten_names",
+    "initializers", "Linear", "Embedding", "Conv2D", "Pool2D", "GlobalPool2D",
+    "BatchNorm", "LayerNorm", "Dropout", "Maxout", "CrossChannelNorm",
+    "Sequential",
+]

@@ -1,0 +1,78 @@
+"""Parameter initializers.
+
+Twin of the reference's ``Parameter::randomize`` modes
+(``paddle/parameter/Parameter.cpp``): the default v1 scheme is
+uniform(-sqrt(3/dim), +sqrt(3/dim)) on the input dim ("initial_strategy=0"),
+with explicit normal/uniform overrides — plus the modern Xavier/He variants
+the layer zoo effectively assumed for convs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def zeros(key, shape, dtype):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def constant(value: float):
+    def init(key, shape, dtype):
+        del key
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+def normal(std: float = 0.01, mean: float = 0.0):
+    def init(key, shape, dtype):
+        return mean + std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def uniform(scale: float):
+    def init(key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def paddle_default(fan_in_axis: int = 0):
+    """v1 default: uniform with scale sqrt(3/fan_in) (Parameter.cpp randomize)."""
+    def init(key, shape, dtype):
+        fan_in = shape[fan_in_axis] if shape else 1
+        scale = np.sqrt(3.0 / max(1, fan_in))
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def xavier_uniform(fan_in: int = None, fan_out: int = None):
+    def init(key, shape, dtype):
+        fin = fan_in if fan_in is not None else _fan(shape)[0]
+        fout = fan_out if fan_out is not None else _fan(shape)[1]
+        scale = np.sqrt(6.0 / (fin + fout))
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def he_normal():
+    def init(key, shape, dtype):
+        fin = _fan(shape)[0]
+        return jax.random.normal(key, shape, dtype) * np.sqrt(2.0 / fin)
+    return init
+
+
+def _fan(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels HWIO
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive

@@ -1,0 +1,299 @@
+"""Core layers.
+
+TPU-native twins of the reference layer zoo (``paddle/gserver/layers/*``,
+82 REGISTER_LAYER registrations — see SURVEY.md §2.2).  Layers here are thin
+:class:`~paddle_tpu.nn.module.Module` wrappers over jnp/lax ops; XLA does the
+kernel fusion the reference hand-wrote in ``paddle/cuda``.
+
+Conventions (TPU-first, not reference-translated):
+
+* images are NHWC (XLA's preferred TPU conv layout), conv kernels HWIO —
+  the reference's NCHW/``im2col`` path (``paddle/function/GemmConvOp.cpp``)
+  is irrelevant on TPU where XLA lowers convs straight onto the MXU;
+* matmuls run in the active dtype-policy compute dtype (bf16 on TPU);
+* every layer takes ``act=`` by name, mirroring the v1 helper API
+  (``trainer_config_helpers/layers.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.core.errors import enforce, enforce_in
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.module import Module, param, state, is_training, next_rng_key
+from paddle_tpu.ops import activations
+
+IntOrPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOrPair) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class Linear(Module):
+    """Fully-connected layer (twin of FullyConnectedLayer.cpp / fc_layer)."""
+
+    def __init__(self, size: int, act="linear", bias: bool = True,
+                 w_init=None, b_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.act = activations.get(act)
+        self.bias = bias
+        self.w_init = w_init
+        self.b_init = b_init or init.zeros
+
+    def forward(self, x):
+        policy = get_policy()
+        in_dim = x.shape[-1]
+        w_init = self.w_init or init.paddle_default(fan_in_axis=0)
+        w = param("w", (in_dim, self.size), policy.param_dtype, w_init)
+        y = jnp.matmul(policy.cast_to_compute(x), policy.cast_to_compute(w))
+        y = policy.cast_to_output(y)
+        if self.bias:
+            b = param("b", (self.size,), policy.param_dtype, self.b_init)
+            y = y + b
+        return self.act(y)
+
+
+class Embedding(Module):
+    """Embedding lookup (twin of TableProjection / lookup_table op).
+
+    Row-sparse gradients (the reference's ``SparseRowCpuMatrix``) arrive for
+    free: ``jnp.take`` differentiates to a scatter-add, which XLA keeps sparse.
+    """
+
+    def __init__(self, vocab_size: int, dim: int, w_init=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.w_init = w_init or init.normal(0.01)
+
+    def forward(self, ids):
+        policy = get_policy()
+        table = param("w", (self.vocab_size, self.dim), policy.param_dtype,
+                      self.w_init)
+        return jnp.take(table, ids, axis=0)
+
+
+class Conv2D(Module):
+    """2-D convolution, NHWC/HWIO (twin of ExpandConvLayer / conv2d op).
+
+    XLA lowers this directly to MXU systolic matmuls; no im2col
+    (``paddle/function/Im2Col.h``) is needed on TPU.
+    """
+
+    def __init__(self, channels: int, kernel: IntOrPair, stride: IntOrPair = 1,
+                 padding: Union[str, IntOrPair] = "SAME", act="linear",
+                 bias: bool = True, groups: int = 1, dilation: IntOrPair = 1,
+                 w_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.channels = channels
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        self.dilation = _pair(dilation)
+        self.groups = groups
+        if isinstance(padding, str):
+            self.padding = padding.upper()
+        else:
+            p = _pair(padding)
+            self.padding = [(p[0], p[0]), (p[1], p[1])]
+        self.act = activations.get(act)
+        self.bias = bias
+        self.w_init = w_init or init.he_normal()
+
+    def forward(self, x):
+        policy = get_policy()
+        in_ch = x.shape[-1]
+        enforce(in_ch % self.groups == 0, "channels %d not divisible by groups",
+                in_ch)
+        kshape = (*self.kernel, in_ch // self.groups, self.channels)
+        w = param("w", kshape, policy.param_dtype, self.w_init)
+        y = lax.conv_general_dilated(
+            policy.cast_to_compute(x), policy.cast_to_compute(w),
+            window_strides=self.stride, padding=self.padding,
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = policy.cast_to_output(y)
+        if self.bias:
+            b = param("b", (self.channels,), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)
+
+
+class Pool2D(Module):
+    """Max/avg pooling (twin of PoolLayer / pool2d op)."""
+
+    def __init__(self, kernel: IntOrPair, stride: Optional[IntOrPair] = None,
+                 padding: Union[str, IntOrPair] = "VALID",
+                 pool_type: str = "max", name: Optional[str] = None):
+        super().__init__(name)
+        enforce_in(pool_type, ("max", "avg"))
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride) if stride is not None else self.kernel
+        self.pool_type = pool_type
+        if isinstance(padding, str):
+            self.padding = padding.upper()
+        else:
+            p = _pair(padding)
+            self.padding = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+
+    def forward(self, x):
+        window = (1, *self.kernel, 1)
+        strides = (1, *self.stride, 1)
+        if self.pool_type == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                     self.padding)
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                   self.padding)
+        if isinstance(self.padding, str) and self.padding == "VALID":
+            count = self.kernel[0] * self.kernel[1]
+            return summed / count
+        ones = jnp.ones_like(x)
+        count = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                  self.padding)
+        return summed / count
+
+
+class GlobalPool2D(Module):
+    """Global spatial pooling over NHWC."""
+
+    def __init__(self, pool_type: str = "avg", name=None):
+        super().__init__(name)
+        enforce_in(pool_type, ("max", "avg"))
+        self.pool_type = pool_type
+
+    def forward(self, x):
+        if self.pool_type == "avg":
+            return jnp.mean(x, axis=(1, 2))
+        return jnp.max(x, axis=(1, 2))
+
+
+class BatchNorm(Module):
+    """Batch normalization (twin of BatchNormalizationLayer /
+    CudnnBatchNormLayer — ``gserver/layers/BatchNormBaseLayer.h``).
+
+    Running stats live in the mutable ``state`` collection; training updates
+    them with ``moving_average_fraction`` semantics from the reference.
+    """
+
+    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
+                 act="linear", axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.act = activations.get(act)
+        self.axis = axis
+
+    def forward(self, x):
+        policy = get_policy()
+        dim = x.shape[self.axis]
+        reduce_axes = tuple(i for i in range(x.ndim)
+                            if i != (self.axis % x.ndim))
+        gamma = param("scale", (dim,), policy.param_dtype, init.ones)
+        beta = param("bias", (dim,), policy.param_dtype, init.zeros)
+        mean_s = state("moving_mean", (dim,), jnp.float32,
+                       lambda s, d: jnp.zeros(s, d))
+        var_s = state("moving_var", (dim,), jnp.float32,
+                      lambda s, d: jnp.ones(s, d))
+        if is_training():
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
+            from paddle_tpu.nn.module import set_state
+            m = self.momentum
+            set_state("moving_mean", m * mean_s + (1 - m) * mean)
+            set_state("moving_var", m * var_s + (1 - m) * var)
+        else:
+            mean, var = mean_s, var_s
+        shape = [1] * x.ndim
+        shape[self.axis % x.ndim] = dim
+        inv = lax.rsqrt(var + self.epsilon) * gamma
+        y = (x - mean.reshape(shape)) * inv.reshape(shape) + beta.reshape(shape)
+        return self.act(y.astype(x.dtype))
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last axis."""
+
+    def __init__(self, epsilon: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        policy = get_policy()
+        dim = x.shape[-1]
+        gamma = param("scale", (dim,), policy.param_dtype, init.ones)
+        beta = param("bias", (dim,), policy.param_dtype, init.zeros)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.epsilon)
+        return (y * gamma + beta).astype(x.dtype)
+
+
+class Dropout(Module):
+    """Inverted dropout (twin of Layer::forwardDropOut, ``Layer.cpp:334``)."""
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = rate
+
+    def forward(self, x):
+        if self.rate <= 0.0 or not is_training():
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(next_rng_key(), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class Maxout(Module):
+    """Maxout over channel groups (twin of MaxOutLayer.cpp)."""
+
+    def __init__(self, groups: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.groups = groups
+
+    def forward(self, x):
+        ch = x.shape[-1]
+        enforce(ch % self.groups == 0, "maxout channels %% groups != 0")
+        new_shape = x.shape[:-1] + (ch // self.groups, self.groups)
+        return jnp.max(x.reshape(new_shape), axis=-1)
+
+
+class CrossChannelNorm(Module):
+    """L2 normalization across channels with learned per-channel scale
+    (twin of CrossChannelNormLayer / NormLayer in SSD)."""
+
+    def __init__(self, epsilon: float = 1e-10, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        policy = get_policy()
+        dim = x.shape[-1]
+        scale = param("scale", (dim,), policy.param_dtype, init.ones)
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True)
+                        + self.epsilon)
+        return x / norm * scale
+
+
+class Sequential(Module):
+    """Chain of callables/modules."""
+
+    def __init__(self, *layers, name: Optional[str] = None):
+        super().__init__(name)
+        self.layers = layers
+
+    def forward(self, x, *args, **kwargs):
+        for layer in self.layers:
+            x = layer(x)
+        return x

@@ -1,0 +1,222 @@
+"""Minimal functional module system.
+
+This is the TPU-native replacement for the reference's ``Layer`` base class
+and registry (``paddle/gserver/layers/Layer.h:62``, ``REGISTER_LAYER``
+``Layer.h:31``): instead of config-constructed C++ nodes mutating ``Argument``
+buffers, a model is a pure Python function that calls :class:`Module` objects;
+:func:`transform` turns it into an ``(init, apply)`` pair of pure functions
+over an explicit parameter pytree, which is what ``jax.jit``/``pjit``/
+``jax.grad`` consume.
+
+Design points:
+
+* **Named parameters.** Every parameter lives at a path
+  ``("scope", ..., "name")`` in a nested dict — the twin of the reference's
+  ``parameterMap_`` (``NeuralNetwork.cpp:74``) — so checkpoints, sharding
+  rules, and per-parameter optimizer attributes can address parameters by
+  name, as the reference's ``ParameterConfig`` does.
+* **Deterministic auto-naming.** Modules are named ``<class>_<k>`` in call
+  order within their parent scope (explicit ``name=`` overrides), so ``init``
+  and ``apply`` agree without a registry.  Calling the *same instance* twice
+  reuses its scope → weight sharing, the twin of the reference's shared
+  ``Weight`` objects.
+* **Separate state collection.** Non-trained buffers (batch-norm running
+  stats — ``Parameter``'s extra ``ParameterType`` buffers in the reference)
+  live in a parallel ``state`` tree; ``apply`` returns ``(out, new_state)``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.core.rng import KeySeq
+
+Params = Dict[str, Any]  # nested dict of str -> (dict | jax.Array)
+State = Dict[str, Any]
+
+_local = threading.local()
+
+
+def _frames():
+    if not hasattr(_local, "frames"):
+        _local.frames = []
+    return _local.frames
+
+
+class _Frame:
+    def __init__(self, mode: str, params: Params, state: State,
+                 rng: Optional[KeySeq], train: bool):
+        self.mode = mode  # "init" | "apply"
+        self.params = params
+        self.state = state
+        self.new_state: State = {}
+        self.rng = rng
+        self.train = train
+        self.scope: list[str] = []
+        self.counters: Dict[Tuple[str, ...], Dict[str, int]] = {}
+        # Keyed by module *object* (identity hash) rather than id(): holding a
+        # strong reference prevents CPython id reuse from aliasing the scopes
+        # of two short-lived module instances.
+        self.module_names: Dict["Module", str] = {}
+
+
+def current_frame() -> _Frame:
+    frames = _frames()
+    enforce(frames, "Module/param used outside of transform().init/apply")
+    return frames[-1]
+
+
+def in_transform() -> bool:
+    return bool(_frames())
+
+
+def is_training() -> bool:
+    return current_frame().train
+
+
+def next_rng_key() -> jax.Array:
+    frame = current_frame()
+    enforce(frame.rng is not None,
+            "An RNG key is required (dropout/init) but none was passed")
+    return frame.rng.next()
+
+
+def _tree_get(tree: Dict[str, Any], path: Sequence[str]):
+    node: Any = tree
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            return None
+        node = node[p]
+    return node
+
+
+def _tree_set(tree: Dict[str, Any], path: Sequence[str], value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def param(name: str, shape: Sequence[int], dtype,
+          init: Callable[[jax.Array, Sequence[int], Any], jax.Array]) -> jax.Array:
+    """Fetch (apply) or create (init) a trainable parameter at current scope."""
+    frame = current_frame()
+    path = tuple(frame.scope) + (name,)
+    value = _tree_get(frame.params, path)
+    if value is None:
+        enforce(frame.mode == "init",
+                "Unknown parameter %s during apply", "/".join(path))
+        value = init(next_rng_key(), tuple(shape), dtype)
+        _tree_set(frame.params, path, value)
+    return value
+
+
+def state(name: str, shape: Sequence[int], dtype,
+          init: Callable[..., jax.Array]) -> jax.Array:
+    """Fetch or create a non-trainable buffer (e.g. BN running stats)."""
+    frame = current_frame()
+    path = tuple(frame.scope) + (name,)
+    value = _tree_get(frame.new_state, path)
+    if value is None:
+        value = _tree_get(frame.state, path)
+    if value is None:
+        enforce(frame.mode == "init",
+                "Unknown state %s during apply", "/".join(path))
+        value = init(tuple(shape), dtype)
+    _tree_set(frame.new_state, path, value)
+    return value
+
+
+def set_state(name: str, value: jax.Array) -> None:
+    frame = current_frame()
+    path = tuple(frame.scope) + (name,)
+    _tree_set(frame.new_state, path, value)
+
+
+class Module:
+    """Base class for layers.  Subclasses implement ``forward``."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._requested_name = name
+
+    def _scope_name(self, frame: _Frame) -> str:
+        if self in frame.module_names:
+            return frame.module_names[self]
+        if self._requested_name is not None:
+            name = self._requested_name
+        else:
+            base = type(self).__name__.lower()
+            scope_key = tuple(frame.scope)
+            counters = frame.counters.setdefault(scope_key, {})
+            idx = counters.get(base, 0)
+            counters[base] = idx + 1
+            name = f"{base}_{idx}"
+        frame.module_names[self] = name
+        return name
+
+    def __call__(self, *args, **kwargs):
+        frame = current_frame()
+        name = self._scope_name(frame)
+        frame.scope.append(name)
+        try:
+            return self.forward(*args, **kwargs)
+        finally:
+            frame.scope.pop()
+
+    def forward(self, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Transformed:
+    """``(init, apply)`` pair produced by :func:`transform`."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def init(self, rng, *args, **kwargs) -> Tuple[Params, State]:
+        frame = _Frame("init", {}, {}, KeySeq(rng), train=False)
+        _frames().append(frame)
+        try:
+            self._fn(*args, **kwargs)
+        finally:
+            _frames().pop()
+        return frame.params, frame.new_state
+
+    def apply(self, params: Params, state: State, rng, *args,
+              train: bool = False, **kwargs):
+        frame = _Frame("apply", params or {}, state or {},
+                       KeySeq(rng) if rng is not None else None, train=train)
+        _frames().append(frame)
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _frames().pop()
+        return out, frame.new_state
+
+
+def transform(fn: Callable) -> Transformed:
+    return Transformed(fn)
+
+
+def flatten_names(params: Params, prefix: str = "") -> Dict[str, jax.Array]:
+    """Flatten a nested param tree to {'a/b/c': array} (for printing/saving)."""
+    out: Dict[str, jax.Array] = {}
+    for k, v in params.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(flatten_names(v, path))
+        else:
+            out[path] = v
+    return out
+
+
+def unflatten_names(flat: Dict[str, jax.Array]) -> Params:
+    tree: Params = {}
+    for k, v in flat.items():
+        _tree_set(tree, k.split("/"), v)
+    return tree

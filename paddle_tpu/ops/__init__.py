@@ -1,0 +1,3 @@
+from paddle_tpu.ops import activations
+
+__all__ = ["activations"]

@@ -1,0 +1,118 @@
+"""Activation functions.
+
+Twin of the reference activation zoo
+(``paddle/gserver/activations/ActivationFunction.cpp:97-441``): sigmoid,
+softmax, sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs, square,
+exponential, reciprocal, sqrt, log, linear.  All are pure jnp functions that
+XLA fuses into adjacent matmuls — no custom backward needed (``jax.grad``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import ConfigError
+
+
+def linear(x):
+    return x
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    return jnp.clip(x, t_min, t_max)
+
+
+def stanh(x, scale_a: float = 2.0 / 3.0, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def softrelu(x, threshold: float = 40.0):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def abs_(x):
+    return jnp.abs(x)
+
+
+def square(x):
+    return x * x
+
+
+def exponential(x):
+    return jnp.exp(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def sqrt_(x):
+    return jnp.sqrt(x)
+
+
+def log_(x):
+    return jnp.log(x)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def sequence_softmax(x, segment_ids, num_segments=None):
+    """Softmax within each variable-length sequence of a packed batch.
+
+    ``segment_ids``: int array, same leading shape as ``x`` (1-D values),
+    mapping each position to its sequence — the packed twin of the
+    reference's per-sequence softmax over ``sequenceStartPositions``.
+    """
+    if num_segments is None:
+        num_segments = int(segment_ids.max()) + 1
+    seg_max = jax.ops.segment_max(x, segment_ids, num_segments=num_segments)
+    x = x - seg_max[segment_ids]
+    ex = jnp.exp(x)
+    seg_sum = jax.ops.segment_sum(ex, segment_ids, num_segments=num_segments)
+    return ex / seg_sum[segment_ids]
+
+
+ACTIVATIONS = {
+    "linear": linear,
+    "": linear,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "brelu": brelu,
+    "stanh": stanh,
+    "softrelu": softrelu,
+    "abs": abs_,
+    "square": square,
+    "exponential": exponential,
+    "reciprocal": reciprocal,
+    "sqrt": sqrt_,
+    "log": log_,
+    "softmax": softmax,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    if name_or_fn is None:
+        return linear
+    try:
+        return ACTIVATIONS[name_or_fn]
+    except KeyError:
+        raise ConfigError(f"Unknown activation {name_or_fn!r}; "
+                          f"available: {sorted(ACTIVATIONS)}")

@@ -1,0 +1,131 @@
+"""Module-system and core-layer tests.
+
+Covers the transform init/apply contract, deterministic naming, weight
+sharing, state collections (BatchNorm), dropout train/eval, and shape/value
+sanity of the core layers — the twin of the reference's per-layer unit tests
+(``gserver/tests/test_LayerGrad.cpp`` shape plumbing; gradients are covered
+in test_gradcheck.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+
+
+def test_linear_init_apply():
+    model = nn.transform(lambda x: nn.Linear(7, act="relu", name="fc")(x))
+    params, state = model.init(jax.random.key(0), jnp.ones((4, 3)))
+    assert params["fc"]["w"].shape == (3, 7)
+    assert params["fc"]["b"].shape == (7,)
+    out, _ = model.apply(params, state, None, jnp.ones((4, 3)))
+    assert out.shape == (4, 7)
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_auto_naming_deterministic():
+    def fn(x):
+        x = nn.Linear(5)(x)
+        x = nn.Linear(3)(x)
+        return x
+    model = nn.transform(fn)
+    params, _ = model.init(jax.random.key(0), jnp.ones((2, 4)))
+    assert set(params) == {"linear_0", "linear_1"}
+    out, _ = model.apply(params, {}, None, jnp.ones((2, 4)))
+    assert out.shape == (2, 3)
+
+
+def test_weight_sharing_same_instance():
+    def fn(x):
+        shared = nn.Linear(4, bias=False)
+        return shared(shared(x))
+    model = nn.transform(fn)
+    params, _ = model.init(jax.random.key(0), jnp.ones((2, 4)))
+    flat = nn.flatten_names(params)
+    assert len(flat) == 1  # one shared weight
+
+
+def test_unknown_param_in_apply_raises():
+    model = nn.transform(lambda x: nn.Linear(3, name="fc")(x))
+    with pytest.raises(Exception, match="Unknown parameter"):
+        model.apply({}, {}, None, jnp.ones((1, 2)))
+
+
+def test_batchnorm_state_updates():
+    model = nn.transform(lambda x: nn.BatchNorm(name="bn")(x))
+    x = jnp.array(np.random.RandomState(0).randn(16, 8), jnp.float32) * 3 + 1
+    params, state = model.init(jax.random.key(0), x)
+    out, new_state = model.apply(params, state, None, x, train=True)
+    # normalized output
+    assert abs(float(out.mean())) < 1e-4
+    assert abs(float(out.std()) - 1.0) < 1e-2
+    # moving stats moved toward batch stats
+    assert not np.allclose(np.asarray(new_state["bn"]["moving_mean"]), 0.0)
+    # eval mode uses moving stats, returns state unchanged
+    out2, s2 = model.apply(params, new_state, None, x, train=False)
+    np.testing.assert_allclose(np.asarray(s2["bn"]["moving_mean"]),
+                               np.asarray(new_state["bn"]["moving_mean"]))
+
+
+def test_dropout_train_vs_eval():
+    model = nn.transform(lambda x: nn.Dropout(0.5)(x))
+    x = jnp.ones((100, 100))
+    params, state = model.init(jax.random.key(0), x)
+    out_eval, _ = model.apply(params, state, None, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_eval), np.asarray(x))
+    out_train, _ = model.apply(params, state, jax.random.key(1), x, train=True)
+    zeros = float((np.asarray(out_train) == 0).mean())
+    assert 0.4 < zeros < 0.6
+    # kept entries are scaled by 1/keep
+    kept = np.asarray(out_train)[np.asarray(out_train) != 0]
+    np.testing.assert_allclose(kept, 2.0)
+
+
+def test_conv_pool_shapes():
+    def fn(x):
+        x = nn.Conv2D(8, 3, padding="SAME", act="relu")(x)
+        x = nn.Pool2D(2, pool_type="max")(x)
+        return x
+    model = nn.transform(fn)
+    x = jnp.ones((2, 8, 8, 3))
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x)
+    assert out.shape == (2, 4, 4, 8)
+
+
+def test_avg_pool_value():
+    model = nn.transform(lambda x: nn.Pool2D(2, pool_type="avg")(x))
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0], (0 + 1 + 4 + 5) / 4)
+
+
+def test_embedding_lookup():
+    model = nn.transform(lambda ids: nn.Embedding(10, 4, name="emb")(ids))
+    ids = jnp.array([[1, 2], [3, 4]])
+    params, state = model.init(jax.random.key(0), ids)
+    out, _ = model.apply(params, state, None, ids)
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(out[0, 0]),
+                               np.asarray(params["emb"]["w"][1]))
+
+
+def test_maxout():
+    model = nn.transform(lambda x: nn.Maxout(2)(x))
+    x = jnp.array([[1.0, 5.0, 2.0, -1.0]])
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x)
+    np.testing.assert_allclose(np.asarray(out), [[5.0, 2.0]])
+
+
+def test_jit_apply():
+    model = nn.transform(lambda x: nn.Linear(4, name="fc")(x))
+    x = jnp.ones((2, 3))
+    params, state = model.init(jax.random.key(0), x)
+    fast = jax.jit(lambda p, x: model.apply(p, {}, None, x)[0])
+    out = fast(params, x)
+    ref, _ = model.apply(params, {}, None, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
