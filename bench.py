@@ -1,0 +1,68 @@
+"""Throughput benchmark — prints ONE JSON line.
+
+Twin of the reference's ``paddle train --job=time`` harness
+(``trainer/TrainerBenchmark.cpp:27-66``: 10 burn-in batches, then timed
+batches) on its RNN benchmark config (``benchmark/paddle/rnn/rnn.py``:
+IMDB-style stacked 2×LSTM classifier, seq_len=100, dict 30k).
+
+Baseline: LSTM h=256 bs=64 = 83 ms/batch on a K40m (BASELINE.md RNN table).
+``vs_baseline`` is the speedup factor (baseline_ms / our_ms, >1 = faster).
+Full train step (forward+backward+update) like the reference's --job=time.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import optim
+    from paddle_tpu.core.dtypes import mixed_precision
+    from paddle_tpu.models.lstm_classifier import model_fn_builder
+    from paddle_tpu.training import Trainer
+
+    vocab, b, t = 30000, 64, 100
+    hidden = 256
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "ids": rs.randint(0, vocab, (b, t)).astype(np.int32),
+        "ids_mask": np.ones((b, t), bool),
+        "label": rs.randint(0, 2, b).astype(np.int32),
+    }
+
+    with mixed_precision():
+        trainer = Trainer(
+            model_fn_builder(vocab, embed_dim=128, hidden=hidden,
+                             num_layers=2),
+            optim.adam(1e-3))
+        trainer.init(batch)
+
+        # burn-in (compile + warm caches), TrainerBenchmark.cpp style
+        for _ in range(10):
+            loss, _ = trainer.train_batch(batch)
+        jax.block_until_ready(trainer.params)
+
+        n_timed = 50
+        t0 = time.perf_counter()
+        for _ in range(n_timed):
+            loss, _ = trainer.train_batch(batch)
+        jax.block_until_ready(trainer.params)
+        elapsed = time.perf_counter() - t0
+
+    ms_per_batch = elapsed / n_timed * 1000.0
+    baseline_ms = 83.0  # K40m, benchmark/README.md:117-120
+    print(json.dumps({
+        "metric": "stacked-LSTM cls train step, h=256 bs=64 seq=100 dict=30k",
+        "value": round(ms_per_batch, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(baseline_ms / ms_per_batch, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,275 @@
+// recordio: length-prefixed record file format with CRC32 integrity and a
+// threaded prefetching reader.
+//
+// TPU-native twin of two reference components (SURVEY.md §2.2/§2.4):
+//   * the recordio chunk files streamed by the Go master/dataset dispatcher
+//     (go/master/service.go partition over recordio chunks), and
+//   * the async double-buffered DataProvider loader
+//     (paddle/gserver/dataproviders/DataProvider.h:249 DoubleBuffer).
+//
+// Design is new (not a port): a single flat file of records
+//   [u32 magic][u32 len][u32 crc32][len bytes]
+// with a trailing index block enabling O(1) seek to any record — which is
+// what a data-cursor checkpoint needs for exact resume — plus a C API with
+// a background prefetch thread and a bounded ring buffer, consumed from
+// Python via ctypes (no pybind11 in this image).
+//
+// Build: see csrc/Makefile (g++ -O2 -fPIC -shared -pthread).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50544652;  // "PTFR"
+constexpr uint32_t kIndexMagic = 0x50544958;  // "PTIX"
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint64_t> offsets;
+  std::string error;
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<uint64_t> offsets;  // record start offsets
+  size_t next_record = 0;         // cursor for sequential interface
+  std::string error;
+
+  // prefetch machinery
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::deque<std::vector<uint8_t>> queue;
+  size_t queue_cap = 0;
+  std::atomic<bool> stop{false};
+  bool producer_done = false;
+};
+
+bool write_u32(FILE* f, uint32_t v) { return fwrite(&v, 4, 1, f) == 1; }
+bool write_u64(FILE* f, uint64_t v) { return fwrite(&v, 8, 1, f) == 1; }
+bool read_u32(FILE* f, uint32_t* v) { return fread(v, 4, 1, f) == 1; }
+bool read_u64(FILE* f, uint64_t* v) { return fread(v, 8, 1, f) == 1; }
+
+bool read_record_at(Reader* r, uint64_t offset, std::vector<uint8_t>* out) {
+  if (fseek(r->f, (long)offset, SEEK_SET) != 0) {
+    r->error = "seek failed";
+    return false;
+  }
+  uint32_t magic, len, crc;
+  if (!read_u32(r->f, &magic) || magic != kMagic) {
+    r->error = "bad record magic";
+    return false;
+  }
+  if (!read_u32(r->f, &len) || !read_u32(r->f, &crc)) {
+    r->error = "truncated header";
+    return false;
+  }
+  out->resize(len);
+  if (len && fread(out->data(), 1, len, r->f) != len) {
+    r->error = "truncated record";
+    return false;
+  }
+  if (crc32(out->data(), len) != crc) {
+    r->error = "crc mismatch";
+    return false;
+  }
+  return true;
+}
+
+void prefetch_loop(Reader* r) {
+  // Sequential scan from the cursor at start time; each produced record
+  // advances an internal position independent of the pull cursor.
+  size_t pos = r->next_record;
+  while (!r->stop.load()) {
+    if (pos >= r->offsets.size()) break;
+    std::vector<uint8_t> rec;
+    {
+      // file handle shared with random-access API; serialize via mu
+      std::unique_lock<std::mutex> lock(r->mu);
+      if (!read_record_at(r, r->offsets[pos], &rec)) break;
+    }
+    pos++;
+    {
+      std::unique_lock<std::mutex> lock(r->mu);
+      r->cv_produce.wait(lock, [r] {
+        return r->queue.size() < r->queue_cap || r->stop.load();
+      });
+      if (r->stop.load()) break;
+      r->queue.push_back(std::move(rec));
+    }
+    r->cv_consume.notify_one();
+  }
+  {
+    std::unique_lock<std::mutex> lock(r->mu);
+    r->producer_done = true;
+  }
+  r->cv_consume.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------- writer ----------
+
+Writer* recordio_writer_open(const char* path) {
+  Writer* w = new Writer();
+  w->f = fopen(path, "wb");
+  if (!w->f) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int recordio_writer_put(Writer* w, const uint8_t* data, uint32_t len) {
+  long off = ftell(w->f);
+  if (off < 0) return -1;
+  if (!write_u32(w->f, kMagic) || !write_u32(w->f, len) ||
+      !write_u32(w->f, crc32(data, len)))
+    return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  // index the record only once fully written: a failed put leaves garbage
+  // bytes before the index but no dangling index entry, so readers (which
+  // are index-driven) never see the truncated record
+  w->offsets.push_back((uint64_t)off);
+  return 0;
+}
+
+int recordio_writer_close(Writer* w) {
+  int rc = 0;
+  long index_off = ftell(w->f);
+  uint64_t n = w->offsets.size();
+  if (!write_u32(w->f, kIndexMagic) || !write_u64(w->f, n)) rc = -1;
+  for (uint64_t off : w->offsets)
+    if (!write_u64(w->f, off)) rc = -1;
+  if (!write_u64(w->f, (uint64_t)index_off)) rc = -1;
+  if (fclose(w->f) != 0) rc = -1;
+  delete w;
+  return rc;
+}
+
+// ---------- reader ----------
+
+Reader* recordio_reader_open(const char* path, uint32_t prefetch) {
+  Reader* r = new Reader();
+  r->f = fopen(path, "rb");
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  // locate index: last 8 bytes hold its offset
+  if (fseek(r->f, -8, SEEK_END) != 0) goto fail;
+  uint64_t index_off;
+  if (!read_u64(r->f, &index_off)) goto fail;
+  if (fseek(r->f, (long)index_off, SEEK_SET) != 0) goto fail;
+  {
+    uint32_t magic;
+    uint64_t n;
+    if (!read_u32(r->f, &magic) || magic != kIndexMagic) goto fail;
+    if (!read_u64(r->f, &n)) goto fail;
+    r->offsets.resize(n);
+    for (uint64_t i = 0; i < n; i++)
+      if (!read_u64(r->f, &r->offsets[i])) goto fail;
+  }
+  if (prefetch > 0) {
+    r->queue_cap = prefetch;
+    r->worker = std::thread(prefetch_loop, r);
+  }
+  return r;
+fail:
+  fclose(r->f);
+  delete r;
+  return nullptr;
+}
+
+int64_t recordio_reader_count(Reader* r) { return (int64_t)r->offsets.size(); }
+
+// Status codes for next/get: 0=ok, 1=end-of-stream, -1=error,
+// -2=buffer too small (len_out holds the needed size).  Length goes in
+// *len_out so a zero-length record is distinguishable from end-of-stream.
+int recordio_reader_next(Reader* r, uint8_t* buf, uint64_t cap,
+                         uint64_t* len_out) {
+  std::vector<uint8_t> rec;
+  if (r->queue_cap > 0) {
+    std::unique_lock<std::mutex> lock(r->mu);
+    r->cv_consume.wait(lock, [r] {
+      return !r->queue.empty() || r->producer_done || r->stop.load();
+    });
+    if (r->queue.empty())
+      return r->error.empty() ? 1 : -1;  // producer done: end or error
+    std::vector<uint8_t>& front = r->queue.front();
+    *len_out = front.size();
+    if (front.size() > cap) return -2;  // record stays queued for retry
+    memcpy(buf, front.data(), front.size());
+    r->queue.pop_front();
+    r->next_record++;
+    r->cv_produce.notify_one();
+    return 0;
+  } else {
+    if (r->next_record >= r->offsets.size()) return 1;
+    std::unique_lock<std::mutex> lock(r->mu);
+    if (!read_record_at(r, r->offsets[r->next_record], &rec)) return -1;
+  }
+  *len_out = rec.size();
+  if (rec.size() > cap) return -2;  // cursor NOT advanced: retry re-reads
+  r->next_record++;
+  memcpy(buf, rec.data(), rec.size());
+  return 0;
+}
+
+// Random access by index (no prefetch interaction); for shard/seek/resume.
+int recordio_reader_get(Reader* r, uint64_t idx, uint8_t* buf, uint64_t cap,
+                        uint64_t* len_out) {
+  if (idx >= r->offsets.size()) {
+    r->error = "index out of range";
+    return -1;
+  }
+  std::vector<uint8_t> rec;
+  {
+    std::unique_lock<std::mutex> lock(r->mu);
+    if (!read_record_at(r, r->offsets[idx], &rec)) return -1;
+  }
+  *len_out = rec.size();
+  if (rec.size() > cap) return -2;
+  memcpy(buf, rec.data(), rec.size());
+  return 0;
+}
+
+const char* recordio_reader_error(Reader* r) { return r->error.c_str(); }
+
+void recordio_reader_close(Reader* r) {
+  r->stop.store(true);
+  r->cv_produce.notify_all();
+  r->cv_consume.notify_all();
+  if (r->worker.joinable()) r->worker.join();
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

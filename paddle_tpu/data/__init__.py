@@ -1,0 +1,6 @@
+from paddle_tpu.data import reader, datasets
+from paddle_tpu.data.feeder import (DataFeeder, Dense, Integer, IntSequence,
+                                    DenseSequence)
+
+__all__ = ["reader", "datasets", "DataFeeder", "Dense", "Integer",
+           "IntSequence", "DenseSequence"]

@@ -1,0 +1,3 @@
+from paddle_tpu.data.datasets import mnist, cifar, imdb, uci_housing, imikolov
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "imikolov"]

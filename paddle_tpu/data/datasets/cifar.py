@@ -1,0 +1,53 @@
+"""CIFAR-10/100 dataset (twin of ``python/paddle/v2/dataset/cifar.py``).
+
+Samples are ``(image, label)`` with image float32[3072] in [0, 1] laid out
+CHW-flattened like the reference.  Loads the python-pickle tarball when
+cached; synthetic fallback otherwise.
+"""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+CIFAR10 = "cifar-10-python.tar.gz"
+
+
+def _synthetic(n, num_classes, seed):
+    rng = common.synthetic_rng("cifar", seed)
+    protos = rng.rand(num_classes, 3072).astype(np.float32)
+    labels = rng.randint(0, num_classes, n)
+    imgs = np.clip(protos[labels]
+                   + 0.25 * rng.randn(n, 3072).astype(np.float32), 0, 1)
+    return imgs, labels
+
+
+def _reader(sub_names, num_classes, n_synth, seed):
+    path = common.fetch(CIFAR10)
+
+    def reader():
+        if path:
+            with tarfile.open(path, mode="r") as tf:
+                for member in tf.getmembers():
+                    if any(s in member.name for s in sub_names):
+                        batch = pickle.load(tf.extractfile(member),
+                                            encoding="latin1")
+                        for img, lbl in zip(batch["data"], batch["labels"]):
+                            yield (img.astype(np.float32) / 255.0, int(lbl))
+        else:
+            imgs, labels = _synthetic(n_synth, num_classes, seed)
+            for img, lbl in zip(imgs, labels):
+                yield img, int(lbl)
+    return reader
+
+
+def train10(n_synthetic: int = 2048):
+    return _reader(["data_batch"], 10, n_synthetic, seed=0)
+
+
+def test10(n_synthetic: int = 512):
+    return _reader(["test_batch"], 10, n_synthetic, seed=1)

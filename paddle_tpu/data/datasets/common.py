@@ -1,0 +1,39 @@
+"""Dataset common utilities.
+
+Twin of ``python/paddle/v2/dataset/common.py`` (download cache + split).
+This build environment has no network egress, so ``fetch`` only resolves
+files already present in the cache directory (``~/.cache/paddle_tpu`` or
+``$PADDLE_TPU_DATA``); every dataset module falls back to a deterministic
+synthetic generator when real files are absent — the test-fixture strategy
+of the reference (``paddle/testing/TestUtil.*`` random fake inputs).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def data_home() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_DATA",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def fetch(filename: str) -> Optional[str]:
+    """Return the cached path for filename if it exists, else None."""
+    path = os.path.join(data_home(), filename)
+    return path if os.path.exists(path) else None
+
+
+def synthetic_rng(name: str, seed: int = 0) -> np.random.RandomState:
+    """Deterministic per-dataset RNG for synthetic fallbacks.
+
+    Uses crc32, not hash(): Python's str hash is salted per process, which
+    would silently give every process a different 'deterministic' dataset.
+    """
+    import zlib
+    return np.random.RandomState(
+        zlib.crc32(f"{name}:{seed}".encode()) % (2 ** 31))

@@ -1,0 +1,49 @@
+"""Synthetic CTR dataset with sparse multi-hot fields.
+
+Stand-in for the reference ``quick_start``/Avazu-style CTR data (the sparse
+pserver workload, BASELINE.json config 5): each sample has several sparse
+id-list fields and a click label generated from a hidden per-id weight
+vector, so AUC genuinely improves during training.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+
+def make_fields(num_fields: int = 3,
+                vocab_sizes: Sequence[int] = (1000, 500, 100),
+                max_ids: int = 5):
+    return list(vocab_sizes[:num_fields]), max_ids
+
+
+def train(vocab_sizes: Sequence[int] = (1000, 500, 100), max_ids: int = 5,
+          n: int = 4096, seed: int = 0):
+    # hidden model fixed across train/test splits; only samples vary by seed
+    rng = common.synthetic_rng("ctr_weights", 0)
+    hidden_w = [rng.randn(v) * 1.5 for v in vocab_sizes]
+
+    def reader():
+        r = common.synthetic_rng("ctr_samples", seed)
+        for _ in range(n):
+            sample = []
+            score = 0.0
+            for fi, v in enumerate(vocab_sizes):
+                k = int(r.randint(1, max_ids + 1))
+                ids = r.randint(0, v, k).astype(np.int32)
+                score += hidden_w[fi][ids].sum()
+                sample.append(ids)
+            p = 1.0 / (1.0 + np.exp(-score / np.sqrt(len(vocab_sizes)
+                                                     * max_ids)))
+            label = int(r.rand() < p)
+            yield (*sample, label)
+    return reader
+
+
+def test(vocab_sizes: Sequence[int] = (1000, 500, 100), max_ids: int = 5,
+         n: int = 1024):
+    return train(vocab_sizes, max_ids, n, seed=1)

@@ -1,0 +1,46 @@
+"""PTB-style n-gram language-model dataset (twin of
+``python/paddle/v2/dataset/imikolov.py``): samples are n-gram tuples of word
+ids.  Synthetic Markov-chain fallback so LM perplexity actually improves
+during tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+
+def build_dict(vocab_size: int = 2074):
+    return {f"w{i}": i for i in range(vocab_size)}
+
+
+def _chain(n_tokens, vocab_size, seed):
+    rng = common.synthetic_rng("imikolov", seed)
+    # sparse row-stochastic transition matrix -> learnable bigram structure
+    trans = rng.rand(vocab_size, vocab_size) ** 8 + 1e-4
+    trans /= trans.sum(1, keepdims=True)
+    tok = int(rng.randint(vocab_size))
+    for _ in range(n_tokens):
+        tok = int(rng.choice(vocab_size, p=trans[tok]))
+        yield tok
+
+
+def ngram(n: int = 5, vocab_size: int = 2074, n_tokens: int = 20000,
+          seed: int = 0):
+    def reader():
+        window = []
+        for tok in _chain(n_tokens, vocab_size, seed):
+            window.append(tok)
+            if len(window) == n:
+                yield tuple(window)
+                window.pop(0)
+    return reader
+
+
+def train(n: int = 5, vocab_size: int = 2074, n_tokens: int = 20000):
+    return ngram(n, vocab_size, n_tokens, seed=0)
+
+
+def test(n: int = 5, vocab_size: int = 2074, n_tokens: int = 4000):
+    return ngram(n, vocab_size, n_tokens, seed=1)

@@ -1,0 +1,72 @@
+"""MNIST dataset (twin of ``python/paddle/v2/dataset/mnist.py``).
+
+Yields ``(image, label)`` with image a flat float32[784] in [-1, 1] and
+label int — the exact sample contract of the reference.  Reads the standard
+idx-format files from the cache dir when present; otherwise generates a
+deterministic synthetic set with class-dependent structure (each digit class
+has a distinct mean pattern) so models can actually learn from it in tests.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic}"
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows * cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic}"
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+def _synthetic(n: int, seed: int):
+    rng = common.synthetic_rng("mnist", seed)
+    protos = rng.rand(10, 784).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    imgs = protos[labels] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    imgs = np.clip(imgs, 0.0, 1.0)
+    return imgs, labels
+
+
+def _reader(images_file, labels_file, n_synth, seed):
+    img_path = common.fetch(images_file)
+    lbl_path = common.fetch(labels_file)
+
+    def reader():
+        if img_path and lbl_path:
+            imgs = _read_idx_images(img_path).astype(np.float32) / 255.0
+            labels = _read_idx_labels(lbl_path)
+        else:
+            imgs, labels = _synthetic(n_synth, seed)
+        # reference normalizes to [-1, 1] (mnist.py reader_creator)
+        for img, lbl in zip(imgs, labels):
+            yield img * 2.0 - 1.0, int(lbl)
+    return reader
+
+
+def train(n_synthetic: int = 2048):
+    return _reader(TRAIN_IMAGES, TRAIN_LABELS, n_synthetic, seed=0)
+
+
+def test(n_synthetic: int = 512):
+    return _reader(TEST_IMAGES, TEST_LABELS, n_synthetic, seed=1)

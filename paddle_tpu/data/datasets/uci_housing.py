@@ -1,0 +1,36 @@
+"""UCI housing regression dataset (twin of
+``python/paddle/v2/dataset/uci_housing.py``): samples ``(features[13], price)``
+with feature normalization.  Synthetic linear-model fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+FEATURE_DIM = 13
+
+
+def _synthetic(n, seed):
+    rng = common.synthetic_rng("uci_housing", seed)
+    w = rng.randn(FEATURE_DIM)
+    x = rng.randn(n, FEATURE_DIM).astype(np.float32)
+    y = (x @ w + 0.1 * rng.randn(n)).astype(np.float32)
+    return x, y
+
+
+def train(n_synthetic: int = 404):
+    def reader():
+        x, y = _synthetic(n_synthetic, 0)
+        for xi, yi in zip(x, y):
+            yield xi, float(yi)
+    return reader
+
+
+def test(n_synthetic: int = 102):
+    def reader():
+        x, y = _synthetic(n_synthetic, 1)
+        for xi, yi in zip(x, y):
+            yield xi, float(yi)
+    return reader

@@ -1,0 +1,107 @@
+"""Batch feeding: python samples -> device-ready arrays.
+
+Twin of the reference's DataFeeder/DataProviderConverter
+(``paddle/py_paddle/dataprovider_converter.py``, ``v2/data_feeder.py``) and
+of ``Argument.sequenceStartPositions``: declared input types map each sample
+slot to a dense array; variable-length sequence slots are padded to the
+batch max (or a bucket boundary) and paired with a boolean mask, which is
+the TPU-native replacement for the reference's packed offset vectors (static
+shapes for XLA; bucketing bounds recompilation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Dense:
+    """Fixed-shape float slot (twin of dense_vector input type)."""
+    shape: Tuple[int, ...]
+    dtype: Any = np.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class Integer:
+    """Scalar int slot (twin of integer_value)."""
+    dtype: Any = np.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class IntSequence:
+    """Variable-length int sequence slot (twin of integer_value_sequence).
+
+    Produces (padded_ids [b, t], mask [b, t]).
+    """
+    pad_value: int = 0
+    buckets: Optional[Sequence[int]] = None
+    dtype: Any = np.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseSequence:
+    """Variable-length sequence of fixed-dim vectors
+    (twin of dense_vector_sequence).  Produces (padded [b, t, d], mask)."""
+    dim: int
+    pad_value: float = 0.0
+    buckets: Optional[Sequence[int]] = None
+    dtype: Any = np.float32
+
+
+def _bucket_len(n: int, buckets: Optional[Sequence[int]]) -> int:
+    if not buckets:
+        return n
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class DataFeeder:
+    """Convert a list of samples (tuples aligned with feed_types) into a
+    dict of numpy arrays keyed by the given names."""
+
+    def __init__(self, feed_types: Sequence[Any], names: Sequence[str]):
+        assert len(feed_types) == len(names)
+        self.feed_types = list(feed_types)
+        self.names = list(names)
+
+    def __call__(self, samples: List[Tuple]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        cols = list(zip(*samples))
+        for ftype, name, col in zip(self.feed_types, self.names, cols):
+            if isinstance(ftype, Dense):
+                out[name] = np.stack(
+                    [np.asarray(x, ftype.dtype).reshape(ftype.shape)
+                     for x in col])
+            elif isinstance(ftype, Integer):
+                out[name] = np.asarray(col, ftype.dtype)
+            elif isinstance(ftype, IntSequence):
+                max_len = _bucket_len(max(len(x) for x in col), ftype.buckets)
+                b = len(col)
+                ids = np.full((b, max_len), ftype.pad_value, ftype.dtype)
+                mask = np.zeros((b, max_len), bool)
+                for i, x in enumerate(col):
+                    n = min(len(x), max_len)
+                    ids[i, :n] = np.asarray(x[:n], ftype.dtype)
+                    mask[i, :n] = True
+                out[name] = ids
+                out[name + "_mask"] = mask
+            elif isinstance(ftype, DenseSequence):
+                max_len = _bucket_len(max(len(x) for x in col), ftype.buckets)
+                b = len(col)
+                arr = np.full((b, max_len, ftype.dim), ftype.pad_value,
+                              ftype.dtype)
+                mask = np.zeros((b, max_len), bool)
+                for i, x in enumerate(col):
+                    n = min(len(x), max_len)
+                    arr[i, :n] = np.asarray(x[:n], ftype.dtype)
+                    mask[i, :n] = True
+                out[name] = arr
+                out[name + "_mask"] = mask
+            else:
+                raise TypeError(f"Unknown feed type {ftype!r}")
+        return out

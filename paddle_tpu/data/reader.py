@@ -1,0 +1,215 @@
+"""Functional reader combinators.
+
+Behavioral twin of ``python/paddle/v2/reader/decorator.py:26-233`` (and
+``creator.py``): a *reader creator* is a zero-arg callable returning an
+iterator over samples.  Combinators wrap reader creators.  Semantics follow
+the reference (buffered shuffling over a window, chain, compose with zipped
+readers, firstn, buffered prefetch via a daemon thread, multi-thread xmap).
+
+Docstring cites are to the reference implementation being mirrored.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Sequence
+
+Reader = Callable[[], Iterator[Any]]
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    """Apply func over zipped samples of readers (decorator.py:26)."""
+    def reader():
+        rs = [r() for r in readers]
+        for sample in zip(*rs):
+            yield func(*sample)
+    return reader
+
+
+def shuffle(reader_creator: Reader, buf_size: int,
+            seed: int = 0) -> Reader:
+    """Window-shuffle with buffer buf_size (decorator.py shuffle:60)."""
+    def reader():
+        rng = _random.Random(seed)
+        buf: List[Any] = []
+        for sample in reader_creator():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+    return reader
+
+
+def chain(*readers: Reader) -> Reader:
+    """Concatenate readers (decorator.py chain:90)."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip readers into combined tuples (decorator.py compose:120).
+
+    Single-item samples are flattened into the output tuple as in the
+    reference.
+    """
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*rs, fillvalue=_SENTINEL):
+                if any(i is _SENTINEL for i in items):
+                    raise RuntimeError(
+                        "composed readers have different lengths")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+    return reader
+
+
+_SENTINEL = object()
+
+
+def buffered(reader_creator: Reader, size: int) -> Reader:
+    """Prefetch up to `size` samples in a daemon thread — the twin of the
+    DoubleBuffer async loader (``DataProvider.h:249``) and
+    decorator.py buffered:169."""
+    def reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        end = object()
+        err: List[BaseException] = []
+
+        def produce():
+            try:
+                for sample in reader_creator():
+                    q.put(sample)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                if err:
+                    raise err[0]
+                return
+            yield sample
+    return reader
+
+
+def firstn(reader_creator: Reader, n: int) -> Reader:
+    """First n samples (decorator.py firstn:233)."""
+    def reader():
+        return itertools.islice(reader_creator(), n)
+    return reader
+
+
+def xmap_readers(mapper: Callable, reader_creator: Reader,
+                 process_num: int, buffer_size: int,
+                 order: bool = False) -> Reader:
+    """Parallel map over samples with worker threads
+    (decorator.py xmap_readers:201).  With order=True, output order matches
+    input order.
+    """
+    def reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        end = object()
+        err: List[BaseException] = []
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader_creator()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:
+                    err.append(e)
+                    out_q.put(end)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if err:
+            raise err[0]
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return reader
+
+
+# ---- creators (twin of v2/reader/creator.py) ----
+
+def np_array(arr) -> Reader:
+    """Reader over the first axis of a numpy array (creator.py:22)."""
+    def reader():
+        yield from arr
+    return reader
+
+
+def text_file(path: str, strip: bool = True) -> Reader:
+    """Reader over lines of a text file (creator.py:39)."""
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n") if strip else line
+    return reader
+
+
+def batch(reader_creator: Reader, batch_size: int,
+          drop_last: bool = True) -> Reader:
+    """Group samples into lists of batch_size (twin of v2/minibatch.py)."""
+    def reader():
+        buf = []
+        for sample in reader_creator():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return reader

@@ -1,0 +1,72 @@
+"""Inference entry points.
+
+Twin of the reference's serving surfaces: the C inference API
+(``paddle/capi/gradient_machine.h:36-112`` — create-from-merged-model,
+forward, shared-param clones for multithread serving) and ``paddle.v2.infer``
+(``python/paddle/v2/inference.py:111``).
+
+An :class:`InferenceMachine` binds (model_fn, params, state) into a jitted
+forward; ``export_model``/``load_model`` is the ``paddle_merge_model`` twin
+(one self-contained directory with weights + config metadata).  Thread-safe
+shared-parameter serving falls out of JAX purity: one machine can serve from
+many threads (the reference needed explicit shared-param clones).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn import transform
+from paddle_tpu.training import checkpoint as ckpt_lib
+
+
+class InferenceMachine:
+    def __init__(self, model_fn: Callable, params, net_state=None):
+        """model_fn(batch) -> outputs (any pytree; no loss needed)."""
+        self.model = transform(model_fn)
+        self.params = params
+        self.net_state = net_state or {}
+        self._fwd = jax.jit(
+            lambda p, s, batch: self.model.apply(p, s, None, batch,
+                                                 train=False)[0])
+
+    def infer(self, batch: Dict[str, Any]):
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        return self._fwd(self.params, self.net_state, batch)
+
+    def infer_batches(self, reader: Callable[[], Iterable[Dict[str, Any]]],
+                      field: Optional[str] = None):
+        """Stream inference over a batched reader (v2 infer semantics:
+        concatenated outputs)."""
+        outs = []
+        for batch in reader():
+            out = self.infer(batch)
+            if field is not None:
+                out = out[field]
+            outs.append(np.asarray(out))
+        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+
+
+def export_model(directory: str, params, net_state=None,
+                 config: Optional[Dict[str, Any]] = None) -> str:
+    """Merge weights + config into one deployable dir
+    (paddle_merge_model twin, ``trainer/MergeModel.cpp``)."""
+    path = ckpt_lib.save(directory, 0, {"params": params,
+                                        "net_state": net_state or {}},
+                         metadata={"exported": True})
+    with open(os.path.join(directory, "model_config.json"), "w") as f:
+        json.dump(config or {}, f, indent=2)
+    return path
+
+
+def load_model(directory: str, model_fn: Callable) -> InferenceMachine:
+    trees, _ = ckpt_lib.load(directory)
+    as_jnp = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+    return InferenceMachine(model_fn, as_jnp(trees["params"]),
+                            as_jnp(trees.get("net_state", {})))

@@ -1,0 +1,3 @@
+from paddle_tpu.io import recordio
+
+__all__ = ["recordio"]

@@ -1,0 +1,157 @@
+"""Python binding for the native recordio library (ctypes).
+
+Twin of the reference's record streaming path: the Go master partitions
+recordio chunks into tasks (``go/master/service.go:106``) and the v2 master
+client streams records (``go/master/client.go:119-239`` NextRecord); here a
+C++ reader with a prefetch thread feeds Python, and the index block gives
+O(1) seek for data-cursor resume (the master's checkpointed cursor).
+
+The .so is built on demand from ``csrc/recordio.cc`` with g++ (no pybind11
+in this environment — plain C ABI via ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "librecordio.so")
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    src = os.path.join(_CSRC, "recordio.cc")
+    subprocess.run(
+        ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",
+         "-o", _LIB_PATH, src],
+        check=True, capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = os.path.join(_CSRC, "recordio.cc")
+    if (not os.path.exists(_LIB_PATH)
+            or (os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.recordio_writer_open.restype = ctypes.c_void_p
+    lib.recordio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.recordio_writer_put.restype = ctypes.c_int
+    lib.recordio_writer_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint32]
+    lib.recordio_writer_close.restype = ctypes.c_int
+    lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.recordio_reader_open.restype = ctypes.c_void_p
+    lib.recordio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.recordio_reader_count.restype = ctypes.c_int64
+    lib.recordio_reader_count.argtypes = [ctypes.c_void_p]
+    lib.recordio_reader_next.restype = ctypes.c_int
+    lib.recordio_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.recordio_reader_get.restype = ctypes.c_int
+    lib.recordio_reader_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.recordio_reader_error.restype = ctypes.c_char_p
+    lib.recordio_reader_error.argtypes = [ctypes.c_void_p]
+    lib.recordio_reader_close.restype = None
+    lib.recordio_reader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class Writer:
+    def __init__(self, path: str):
+        self._lib = _load()
+        self._h = self._lib.recordio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, data: bytes) -> None:
+        rc = self._lib.recordio_writer_put(self._h, data, len(data))
+        if rc != 0:
+            raise IOError("recordio write failed")
+
+    def close(self) -> None:
+        if self._h:
+            rc = self._lib.recordio_writer_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError("recordio close failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Reader:
+    """Sequential (prefetching) + random-access record reader."""
+
+    def __init__(self, path: str, prefetch: int = 64,
+                 buf_size: int = 1 << 20):
+        self._lib = _load()
+        self._h = self._lib.recordio_reader_open(path.encode(), prefetch)
+        if not self._h:
+            raise IOError(f"cannot open recordio file {path}")
+        self._buf = ctypes.create_string_buffer(buf_size)
+
+    def __len__(self) -> int:
+        return self._lib.recordio_reader_count(self._h)
+
+    def _grow(self, needed: int) -> None:
+        self._buf = ctypes.create_string_buffer(needed)
+
+    def __iter__(self) -> Iterator[bytes]:
+        length = ctypes.c_uint64()
+        while True:
+            status = self._lib.recordio_reader_next(
+                self._h, self._buf, len(self._buf), ctypes.byref(length))
+            if status == 1:
+                return
+            if status == -1:
+                err = self._lib.recordio_reader_error(self._h).decode()
+                raise IOError(f"recordio read failed: {err}")
+            if status == -2:
+                self._grow(length.value)
+                continue
+            yield self._buf.raw[:length.value]
+
+    def get(self, idx: int) -> bytes:
+        length = ctypes.c_uint64()
+        status = self._lib.recordio_reader_get(
+            self._h, idx, self._buf, len(self._buf), ctypes.byref(length))
+        if status == -2:
+            self._grow(length.value)
+            return self.get(idx)
+        if status != 0:
+            err = self._lib.recordio_reader_error(self._h).decode()
+            raise IOError(f"recordio get failed: {err}")
+        return self._buf.raw[:length.value]
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.recordio_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def reader_creator(path: str, prefetch: int = 64):
+    """Reader-combinator-compatible creator over raw record bytes."""
+    def reader():
+        with Reader(path, prefetch) as r:
+            yield from r
+    return reader

@@ -1,0 +1,5 @@
+from paddle_tpu.models import (lenet, resnet, alexnet, googlenet,
+                               lstm_classifier, seq2seq)
+
+__all__ = ["lenet", "resnet", "alexnet", "googlenet", "lstm_classifier",
+           "seq2seq"]

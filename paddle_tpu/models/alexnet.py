@@ -1,0 +1,62 @@
+"""AlexNet (twin of ``benchmark/paddle/image/alexnet.py``).
+
+One of the reference's three published image benchmarks (BASELINE.md).
+NHWC; LRN is replaced by its modern no-op equivalent unless requested —
+the reference config uses cross-map normalization (img_cmrnorm_layer),
+kept here as an option via jax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import losses
+
+
+def _lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    """Cross-channel local response normalization (img_cmrnorm twin)."""
+    sq = jnp.square(x)
+    # sum over a window of channels
+    pad = size // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    windows = sum(sq_pad[..., i:i + x.shape[-1]] for i in range(size))
+    return x / jnp.power(k + alpha * windows, beta)
+
+
+class AlexNet(nn.Module):
+    def __init__(self, num_classes: int = 1000, use_lrn: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.use_lrn = use_lrn
+
+    def forward(self, images, train_dropout: bool = True):
+        x = nn.Conv2D(64, 11, stride=4, padding=(2, 2), act="relu",
+                      name="conv1")(images)
+        if self.use_lrn:
+            x = _lrn(x)
+        x = nn.Pool2D(3, 2, name="pool1")(x)
+        x = nn.Conv2D(192, 5, padding=(2, 2), act="relu", name="conv2")(x)
+        if self.use_lrn:
+            x = _lrn(x)
+        x = nn.Pool2D(3, 2, name="pool2")(x)
+        x = nn.Conv2D(384, 3, act="relu", name="conv3")(x)
+        x = nn.Conv2D(256, 3, act="relu", name="conv4")(x)
+        x = nn.Conv2D(256, 3, act="relu", name="conv5")(x)
+        x = nn.Pool2D(3, 2, name="pool5")(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dropout(0.5, name="drop6")(x)
+        x = nn.Linear(4096, act="relu", name="fc6")(x)
+        x = nn.Dropout(0.5, name="drop7")(x)
+        x = nn.Linear(4096, act="relu", name="fc7")(x)
+        return nn.Linear(self.num_classes, name="fc8")(x)
+
+
+def model_fn_builder(num_classes: int = 1000, use_lrn: bool = True):
+    def model_fn(batch):
+        logits = AlexNet(num_classes, use_lrn, name="alexnet")(batch["image"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"logits": logits, "label": batch["label"]}
+    return model_fn

@@ -1,0 +1,66 @@
+"""GoogleNet / Inception-v1 (twin of ``benchmark/paddle/image/googlenet.py``).
+
+Second published image benchmark of the reference (BASELINE.md).  Auxiliary
+classifier heads are omitted in benchmark mode like the reference's
+--job=time config (they only affect training regularization).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import losses
+
+
+class Inception(nn.Module):
+    def __init__(self, c1, c3r, c3, c5r, c5, proj, name=None):
+        super().__init__(name)
+        self.c1, self.c3r, self.c3 = c1, c3r, c3
+        self.c5r, self.c5, self.proj = c5r, c5, proj
+
+    def forward(self, x):
+        b1 = nn.Conv2D(self.c1, 1, act="relu", name="b1")(x)
+        b3 = nn.Conv2D(self.c3r, 1, act="relu", name="b3r")(x)
+        b3 = nn.Conv2D(self.c3, 3, act="relu", name="b3")(b3)
+        b5 = nn.Conv2D(self.c5r, 1, act="relu", name="b5r")(x)
+        b5 = nn.Conv2D(self.c5, 5, act="relu", name="b5")(b5)
+        bp = nn.Pool2D(3, 1, padding=(1, 1), pool_type="max", name="pool")(x)
+        bp = nn.Conv2D(self.proj, 1, act="relu", name="bp")(bp)
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class GoogleNet(nn.Module):
+    def __init__(self, num_classes: int = 1000, name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        x = nn.Conv2D(64, 7, stride=2, padding=(3, 3), act="relu",
+                      name="conv1")(images)
+        x = nn.Pool2D(3, 2, padding=(1, 1), name="pool1")(x)
+        x = nn.Conv2D(64, 1, act="relu", name="conv2r")(x)
+        x = nn.Conv2D(192, 3, act="relu", name="conv2")(x)
+        x = nn.Pool2D(3, 2, padding=(1, 1), name="pool2")(x)
+        x = Inception(64, 96, 128, 16, 32, 32, name="i3a")(x)
+        x = Inception(128, 128, 192, 32, 96, 64, name="i3b")(x)
+        x = nn.Pool2D(3, 2, padding=(1, 1), name="pool3")(x)
+        x = Inception(192, 96, 208, 16, 48, 64, name="i4a")(x)
+        x = Inception(160, 112, 224, 24, 64, 64, name="i4b")(x)
+        x = Inception(128, 128, 256, 24, 64, 64, name="i4c")(x)
+        x = Inception(112, 144, 288, 32, 64, 64, name="i4d")(x)
+        x = Inception(256, 160, 320, 32, 128, 128, name="i4e")(x)
+        x = nn.Pool2D(3, 2, padding=(1, 1), name="pool4")(x)
+        x = Inception(256, 160, 320, 32, 128, 128, name="i5a")(x)
+        x = Inception(384, 192, 384, 48, 128, 128, name="i5b")(x)
+        x = nn.GlobalPool2D("avg", name="gap")(x)
+        x = nn.Dropout(0.4, name="drop")(x)
+        return nn.Linear(self.num_classes, name="fc")(x)
+
+
+def model_fn_builder(num_classes: int = 1000):
+    def model_fn(batch):
+        logits = GoogleNet(num_classes, name="googlenet")(batch["image"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"logits": logits, "label": batch["label"]}
+    return model_fn

@@ -1,0 +1,37 @@
+"""LeNet-style MNIST CNN.
+
+Twin of the reference's MNIST demo nets (``v1_api_demo/mnist/light_mnist.py``
+conv-pool×2 + fc, and ``mnist_conv_group``): the round-trip workload of
+SURVEY.md §7 stage 6.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import losses
+
+
+class LeNet(nn.Module):
+    def __init__(self, num_classes: int = 10, name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        """images: [b, 784] in [-1, 1] (the mnist dataset contract)."""
+        x = images.reshape(-1, 28, 28, 1)
+        x = nn.Conv2D(32, 5, act="relu", name="conv1")(x)
+        x = nn.Pool2D(2, name="pool1")(x)
+        x = nn.Conv2D(64, 5, act="relu", name="conv2")(x)
+        x = nn.Pool2D(2, name="pool2")(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Linear(256, act="relu", name="fc1")(x)
+        return nn.Linear(self.num_classes, name="fc2")(x)
+
+
+def model_fn(batch):
+    """Trainer-compatible: batch {'image': [b,784], 'label': [b]}."""
+    logits = LeNet(name="lenet")(batch["image"])
+    loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+    return loss, {"logits": logits, "label": batch["label"]}

@@ -1,0 +1,44 @@
+"""Stacked-LSTM text classifier.
+
+Twin of the reference's RNN benchmark net (``benchmark/paddle/rnn/rnn.py``:
+embedding -> 2×LSTM -> seq-pool -> fc softmax, IMDB) and of the
+``stacked_lstm_net`` in the sentiment demo.  This is the flagship bench
+model for LSTM throughput parity (BASELINE.md RNN table).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.recurrent import LSTM
+from paddle_tpu.ops import losses, sequence as so
+
+
+class StackedLSTMClassifier(nn.Module):
+    def __init__(self, vocab_size: int, embed_dim: int = 128,
+                 hidden: int = 256, num_layers: int = 2,
+                 num_classes: int = 2, pool: str = "last", name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.num_classes = num_classes
+        self.pool = pool
+
+    def forward(self, ids, mask):
+        x = nn.Embedding(self.vocab_size, self.embed_dim, name="embed")(ids)
+        for i in range(self.num_layers):
+            x, _ = LSTM(self.hidden, name=f"lstm_{i}")(x, mask)
+        pooled = so.sequence_pool(x, mask, self.pool)
+        return nn.Linear(self.num_classes, name="fc")(pooled)
+
+
+def model_fn_builder(vocab_size: int, **kwargs):
+    def model_fn(batch):
+        net = StackedLSTMClassifier(vocab_size, name="clf", **kwargs)
+        logits = net(batch["ids"], batch["ids_mask"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"logits": logits, "label": batch["label"]}
+    return model_fn

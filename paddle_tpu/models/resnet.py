@@ -1,0 +1,107 @@
+"""ResNet for ImageNet/CIFAR.
+
+Twin of the reference's ResNet configs (``v1_api_demo/model_zoo/resnet/
+resnet.py`` and ``benchmark/paddle/image`` style) — the BASELINE.json
+north-star workload (ResNet-50 ImageNet at ≥60% MFU).  NHWC, bf16-friendly,
+batch-norm in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import losses
+
+
+class BottleneckBlock(nn.Module):
+    expansion = 4
+
+    def __init__(self, filters: int, stride: int = 1, project: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.filters = filters
+        self.stride = stride
+        self.project = project
+
+    def forward(self, x):
+        shortcut = x
+        out = nn.Conv2D(self.filters, 1, bias=False, name="conv1")(x)
+        out = nn.BatchNorm(act="relu", name="bn1")(out)
+        out = nn.Conv2D(self.filters, 3, stride=self.stride, bias=False,
+                        name="conv2")(out)
+        out = nn.BatchNorm(act="relu", name="bn2")(out)
+        out = nn.Conv2D(self.filters * self.expansion, 1, bias=False,
+                        name="conv3")(out)
+        out = nn.BatchNorm(name="bn3")(out)
+        if self.project:
+            shortcut = nn.Conv2D(self.filters * self.expansion, 1,
+                                 stride=self.stride, bias=False,
+                                 name="proj")(x)
+            shortcut = nn.BatchNorm(name="proj_bn")(shortcut)
+        return jnp.maximum(out + shortcut, 0.0)
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, filters: int, stride: int = 1, project: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.filters = filters
+        self.stride = stride
+        self.project = project
+
+    def forward(self, x):
+        shortcut = x
+        out = nn.Conv2D(self.filters, 3, stride=self.stride, bias=False,
+                        name="conv1")(x)
+        out = nn.BatchNorm(act="relu", name="bn1")(out)
+        out = nn.Conv2D(self.filters, 3, bias=False, name="conv2")(out)
+        out = nn.BatchNorm(name="bn2")(out)
+        if self.project:
+            shortcut = nn.Conv2D(self.filters, 1, stride=self.stride,
+                                 bias=False, name="proj")(x)
+            shortcut = nn.BatchNorm(name="proj_bn")(shortcut)
+        return jnp.maximum(out + shortcut, 0.0)
+
+
+_CONFIGS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+}
+
+
+class ResNet(nn.Module):
+    def __init__(self, depth: int = 50, num_classes: int = 1000, name=None):
+        super().__init__(name)
+        self.block_cls, self.stages = _CONFIGS[depth]
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        """images: [b, h, w, 3] NHWC."""
+        x = nn.Conv2D(64, 7, stride=2, bias=False, name="conv0")(images)
+        x = nn.BatchNorm(act="relu", name="bn0")(x)
+        x = nn.Pool2D(3, stride=2, padding=(1, 1), name="pool0")(x)
+        filters = 64
+        for stage, blocks in enumerate(self.stages):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = self.block_cls(filters, stride=stride, project=(b == 0),
+                                   name=f"stage{stage}_block{b}")(x)
+            filters *= 2
+        x = nn.GlobalPool2D("avg", name="gap")(x)
+        return nn.Linear(self.num_classes, name="fc")(x)
+
+
+def model_fn_builder(depth: int = 50, num_classes: int = 1000):
+    def model_fn(batch):
+        logits = ResNet(depth, num_classes, name="resnet")(batch["image"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"logits": logits, "label": batch["label"]}
+    return model_fn

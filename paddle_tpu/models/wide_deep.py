@@ -1,0 +1,96 @@
+"""Wide-and-deep CTR model over sparse id-list features.
+
+Twin of the reference's sparse CTR path (``quick_start`` demo's sparse
+text classification; BASELINE.json config 5 "Sparse CTR / wide-and-deep"):
+the v1 stack streams sparse rows from the pserver
+(``SparsePrefetchRowCpuMatrix``, ``ParameterServer2::getParameterSparse``);
+on TPU the embedding tables live sharded in device memory and the lookup's
+scatter-add gradient keeps updates row-sparse (XLA native) — with optional
+``mp``-axis table sharding via parallel.sharding rules for tables larger
+than one chip.
+
+Input contract: each sparse field is a padded id matrix ``[b, k]`` + mask
+(multi-hot slots); the wide part is a 1-dim embedding (per-id weight)
+summed per field — exactly a sparse linear layer.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.ops import losses
+
+
+class SparseLinear(nn.Module):
+    """Sum of per-id scalar weights (the 'wide' half; sparse lr layer)."""
+
+    def __init__(self, vocab_size: int, name=None):
+        super().__init__(name)
+        self.vocab = vocab_size
+
+    def forward(self, ids, mask):
+        table = nn.Embedding(self.vocab, 1, w_init=init.zeros,
+                             name="w")(ids)[..., 0]      # [b, k]
+        return jnp.where(mask, table, 0.0).sum(-1)       # [b]
+
+
+class FieldEmbedding(nn.Module):
+    """Mean-pooled embedding of a multi-hot field (the 'deep' half input)."""
+
+    def __init__(self, vocab_size: int, dim: int, name=None):
+        super().__init__(name)
+        self.vocab = vocab_size
+        self.dim = dim
+
+    def forward(self, ids, mask):
+        emb = nn.Embedding(self.vocab, self.dim, name="table")(ids)  # [b,k,d]
+        emb = jnp.where(mask[..., None], emb, 0.0)
+        denom = jnp.maximum(mask.sum(-1, keepdims=True), 1.0)
+        return emb.sum(1) / denom                        # [b, d]
+
+
+class WideDeep(nn.Module):
+    def __init__(self, field_vocabs: Sequence[int], embed_dim: int = 16,
+                 hidden: Sequence[int] = (64, 32), name=None):
+        super().__init__(name)
+        self.field_vocabs = list(field_vocabs)
+        self.embed_dim = embed_dim
+        self.hidden = list(hidden)
+
+    def forward(self, fields):
+        """fields: list of (ids [b,k], mask [b,k]) per sparse field.
+        Returns logit [b]."""
+        wide = 0.0
+        deep_in = []
+        for i, (ids, mask) in enumerate(fields):
+            wide = wide + SparseLinear(self.field_vocabs[i],
+                                       name=f"wide_{i}")(ids, mask)
+            deep_in.append(FieldEmbedding(self.field_vocabs[i],
+                                          self.embed_dim,
+                                          name=f"embed_{i}")(ids, mask))
+        x = jnp.concatenate(deep_in, axis=-1)
+        for j, h in enumerate(self.hidden):
+            x = nn.Linear(h, act="relu", name=f"fc_{j}")(x)
+        deep = nn.Linear(1, name="fc_out")(x)[..., 0]
+        bias = nn.param("bias", (1,), jnp.float32, init.zeros)
+        return wide + deep + bias[0]
+
+
+def model_fn_builder(field_vocabs: Sequence[int], **kwargs):
+    def model_fn(batch):
+        n = len(field_vocabs)
+        fields = [(batch[f"f{i}"], batch[f"f{i}_mask"]) for i in range(n)]
+        logit = WideDeep(field_vocabs, name="wd", **kwargs)(fields)
+        label = batch["label"].astype(jnp.float32)
+        loss = losses.sigmoid_cross_entropy(logit[:, None],
+                                            label[:, None]).mean()
+        prob = jnp.clip(jnp.where(
+            logit >= 0, 1.0 / (1.0 + jnp.exp(-logit)),
+            jnp.exp(logit) / (1.0 + jnp.exp(logit))), 1e-6, 1 - 1e-6)
+        return loss, {"prob": prob, "label": batch["label"],
+                      "logit": logit}
+    return model_fn

@@ -1,0 +1,118 @@
+"""Beam-search decoding as a static-shape ``lax.while_loop``.
+
+TPU-native twin of the reference's generation machinery —
+``RecurrentGradientMachine::generateSequence`` (beam expansion
+``RecurrentGradientMachine.cpp:539+``, Path bookkeeping
+``RecurrentGradientMachine.h:188+``, ``beam_size`` flag ``Flags.cpp:74``)
+and the SWIG ``SequenceGenerator`` (``api/SequenceGenerator.cpp``): instead
+of dynamic per-path C++ objects, the beam lives in fixed-shape arrays
+``[batch, beam, ...]`` and one ``lax.while_loop`` steps all beams of all
+batch rows simultaneously; finished beams are frozen by masking — the
+standard static-shape beam search formulation XLA compiles well.
+
+The ``step_fn`` contract: ``step_fn(ids, state) -> (logprobs, new_state)``
+with ids ``[batch*beam]`` (last emitted token) and state an arbitrary pytree
+with leading dim ``batch*beam`` — one decoder step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+class BeamState(NamedTuple):
+    step: jax.Array          # scalar int
+    alive_seq: jax.Array     # [b, k, max_len] token ids
+    alive_logp: jax.Array    # [b, k] cumulative logprob
+    finished: jax.Array      # [b, k] bool
+    state: Any               # decoder state pytree, leaves [b*k, ...]
+
+
+def _flatten_beam(x):
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def _unflatten_beam(x, b, k):
+    return x.reshape((b, k) + x.shape[1:])
+
+
+def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
+                beam_size: int, max_len: int, bos_id: int, eos_id: int,
+                length_penalty: float = 0.0,
+                vocab_size: int = None) -> Tuple[jax.Array, jax.Array]:
+    """Run beam search; returns (sequences [b, k, max_len], scores [b, k])
+    sorted best-first.  ``init_state`` leaves must have leading dim
+    ``batch_size`` (they are tiled to beams internally).
+    """
+    b, k = batch_size, beam_size
+
+    # tile state to [b*k, ...]
+    def tile(x):
+        return jnp.repeat(x, k, axis=0)
+    state0 = jax.tree_util.tree_map(tile, init_state)
+
+    alive_seq = jnp.full((b, k, max_len), eos_id, jnp.int32)
+    alive_seq = alive_seq.at[:, :, 0].set(bos_id)
+    # only beam 0 is live initially (all beams identical otherwise)
+    alive_logp = jnp.tile(
+        jnp.array([0.0] + [NEG_INF] * (k - 1)), (b, 1))
+    finished = jnp.zeros((b, k), bool)
+
+    def cond(s: BeamState):
+        return (s.step < max_len - 1) & ~jnp.all(s.finished)
+
+    def body(s: BeamState):
+        last_ids = jnp.take_along_axis(
+            s.alive_seq, s.step[None, None].repeat(b, 0).repeat(k, 1)[..., None],
+            axis=2)[..., 0]                        # [b, k]
+        logprobs, new_state = step_fn(_flatten_beam(last_ids), s.state)
+        v = logprobs.shape[-1]
+        logprobs = _unflatten_beam(logprobs, b, k)  # [b, k, v]
+
+        # finished beams: only allow emitting eos with prob 1 (freeze)
+        freeze = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)
+        logprobs = jnp.where(s.finished[..., None], freeze[None, None, :],
+                             logprobs)
+
+        cand = s.alive_logp[..., None] + logprobs   # [b, k, v]
+        flat = cand.reshape(b, k * v)
+        top_logp, top_idx = lax.top_k(flat, k)      # [b, k]
+        src_beam = top_idx // v                     # [b, k]
+        tok = top_idx % v                           # [b, k]
+
+        # reorder sequences and states by source beam
+        new_seq = jnp.take_along_axis(s.alive_seq, src_beam[..., None],
+                                      axis=1)
+        new_seq = new_seq.at[:, :, s.step + 1].set(tok)
+
+        def reorder(x):
+            xb = _unflatten_beam(x, b, k)
+            xb = jnp.take_along_axis(
+                xb, src_beam.reshape((b, k) + (1,) * (xb.ndim - 2)), axis=1)
+            return _flatten_beam(xb)
+        new_state = jax.tree_util.tree_map(reorder, new_state)
+
+        was_finished = jnp.take_along_axis(s.finished, src_beam, axis=1)
+        new_finished = was_finished | (tok == eos_id)
+        return BeamState(s.step + 1, new_seq, top_logp, new_finished,
+                         new_state)
+
+    final = lax.while_loop(
+        cond, body, BeamState(jnp.asarray(0), alive_seq, alive_logp,
+                              finished, state0))
+
+    # length-normalized scores (reference's log-prob ordering; penalty 0 =
+    # raw logprob like RecurrentGM)
+    lengths = jnp.sum(final.alive_seq != eos_id, axis=-1).astype(jnp.float32)
+    denom = jnp.power(jnp.maximum(lengths, 1.0), length_penalty)
+    scores = final.alive_logp / denom
+    order = jnp.argsort(-scores, axis=1)
+    seqs = jnp.take_along_axis(final.alive_seq, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores

@@ -1,0 +1,98 @@
+"""Linear-chain CRF: negative log-likelihood and Viterbi decoding.
+
+TPU-native twin of ``paddle/gserver/layers/LinearChainCRF.{h,cpp}`` /
+``CRFLayer.cpp`` / ``CRFDecodingLayer.cpp`` and the new-IR
+``linear_chain_crf_op``: the forward (alpha) recursion and Viterbi both
+become ``lax.scan`` over time with log-space arithmetic, which XLA compiles
+into a tight fused loop — no hand-written forward-backward kernel needed
+because ``jax.grad`` of the log-partition *is* the forward-backward
+algorithm.
+
+Parameters follow the reference layout: a transition matrix ``[n, n]``
+(``trans[i, j]`` = score of moving from tag i to tag j) plus start/stop
+score vectors (the reference packs them as the first two rows of its
+``(n+2) x n`` weight, ``LinearChainCRF.h:21-32``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def crf_log_likelihood(emissions, tags, mask, transitions, start, stop):
+    """Per-example log-likelihood of the gold tag path.
+
+    emissions: [b, t, n] unary scores; tags: [b, t] int; mask: [b, t] bool;
+    transitions: [n, n]; start, stop: [n].
+    Returns [b] log p(tags | emissions) (negate for the loss).
+    """
+    b, t, n = emissions.shape
+    lengths = mask.sum(axis=1).astype(jnp.int32)
+
+    # --- numerator: score of the gold path ---
+    unary = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]
+    unary = jnp.where(mask, unary, 0.0).sum(axis=1)
+    pair = transitions[tags[:, :-1], tags[:, 1:]]           # [b, t-1]
+    pair = jnp.where(mask[:, 1:], pair, 0.0).sum(axis=1)
+    first_tag = tags[:, 0]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(tags, last_idx[:, None], axis=1)[:, 0]
+    gold = unary + pair + start[first_tag] + stop[last_tag]
+
+    # --- denominator: log partition via alpha recursion ---
+    em_t = jnp.swapaxes(emissions, 0, 1)                    # [t, b, n]
+    mask_t = jnp.swapaxes(mask, 0, 1)                       # [t, b]
+    alpha0 = start[None, :] + em_t[0]                       # [b, n]
+
+    def step(alpha, inp):
+        em, m = inp
+        # alpha: [b, n]; broadcast over next tag j
+        scores = alpha[:, :, None] + transitions[None, :, :]  # [b, i, j]
+        new = jax.nn.logsumexp(scores, axis=1) + em
+        new = jnp.where(m[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, (em_t[1:], mask_t[1:]))
+    log_z = jax.nn.logsumexp(alpha + stop[None, :], axis=-1)
+    return gold - log_z
+
+
+def crf_decode(emissions, mask, transitions, start, stop
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Viterbi decoding (twin of CRFDecodingLayer / crf_decoding op).
+
+    Returns (best_tags [b, t] int32, best_score [b]).  Positions beyond the
+    sequence length hold the last valid tag repeated (mask them out).
+    """
+    b, t, n = emissions.shape
+    em_t = jnp.swapaxes(emissions, 0, 1)
+    mask_t = jnp.swapaxes(mask, 0, 1)
+    score0 = start[None, :] + em_t[0]
+
+    def fwd(score, inp):
+        em, m = inp
+        cand = score[:, :, None] + transitions[None, :, :]
+        best_prev = jnp.argmax(cand, axis=1)                 # [b, j]
+        new = jnp.max(cand, axis=1) + em
+        new = jnp.where(m[:, None], new, score)
+        # at masked steps the backpointer is identity
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+        bp = jnp.where(m[:, None], best_prev, ident)
+        return new, bp
+
+    final, bps = lax.scan(fwd, score0, (em_t[1:], mask_t[1:]))
+    final = final + stop[None, :]
+    best_last = jnp.argmax(final, axis=-1)                   # [b]
+    best_score = jnp.max(final, axis=-1)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = lax.scan(back, best_last, bps, reverse=True)
+    tags = jnp.concatenate([first_tag[None, :], tags_rev], axis=0)
+    return jnp.swapaxes(tags, 0, 1).astype(jnp.int32), best_score

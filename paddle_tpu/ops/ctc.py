@@ -1,0 +1,106 @@
+"""CTC loss via the log-space alpha recursion as a ``lax.scan``.
+
+TPU-native twin of the reference's CTC stack (``gserver/layers/CTCLayer.cpp``
++ ``LinearChainCTC.cpp``, and the warp-ctc wrapper ``WarpCTCLayer.cpp`` /
+``hl_warpctc_wrap``): instead of linking an external CUDA library, the
+standard Graves dynamic program runs as a static-shape scan over time with
+the extended label sequence (blank-interleaved) laid out densely — XLA
+vectorizes the per-state transitions across the whole batch.
+
+Conventions: ``blank`` is class 0 by default (matching warp-ctc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _log_add(a, b):
+    m = jnp.maximum(a, b)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # avoid -inf - -inf
+    return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+
+def ctc_loss(logits, logit_lengths, labels, label_lengths, blank: int = 0):
+    """Per-example CTC negative log-likelihood.
+
+    logits: [b, t, n] unnormalized; logit_lengths: [b];
+    labels: [b, l] int (padded with anything); label_lengths: [b].
+    Max label length l must satisfy 2*l+1 <= t for valid examples.
+    """
+    b, t, n = logits.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # Extended sequence: [blank, y1, blank, y2, ..., blank]
+    ext = jnp.full((b, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    # allow skip s-2 -> s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # per-step emission logprob for each extended state
+    logp_t = jnp.swapaxes(logp, 0, 1)                      # [t, b, n]
+
+    def emit(lp):
+        return jnp.take_along_axis(lp, ext, axis=-1)       # [b, s]
+
+    alpha0 = jnp.full((b, s), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit(logp_t[0])[:, 0])
+    valid1 = (label_lengths > 0)
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(valid1, emit(logp_t[0])[:, 1], NEG_INF))
+
+    steps = jnp.arange(1, t)
+
+    def step(alpha, ti):
+        lp = logp_t[ti]
+        a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                          constant_values=NEG_INF)[:, :s]
+        a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                          constant_values=NEG_INF)[:, :s]
+        acc = _log_add(alpha, a_prev1)
+        acc = jnp.where(can_skip, _log_add(acc, a_prev2), acc)
+        new = acc + emit(lp)
+        active = (ti < logit_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, steps)
+
+    # final prob: alpha at states 2*len (last blank) and 2*len-1 (last label)
+    idx_blank = (2 * label_lengths)[:, None]
+    idx_label = jnp.maximum(2 * label_lengths - 1, 0)[:, None]
+    a_blank = jnp.take_along_axis(alpha, idx_blank, axis=1)[:, 0]
+    a_label = jnp.take_along_axis(alpha, idx_label, axis=1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, NEG_INF)
+    return -_log_add(a_blank, a_label)
+
+
+def ctc_greedy_decode(logits, logit_lengths, blank: int = 0):
+    """Best-path decoding: argmax per frame, collapse repeats, drop blanks.
+
+    Returns (decoded [b, t] padded with -1, decoded_lengths [b]).
+    """
+    b, t, n = logits.shape
+    best = jnp.argmax(logits, axis=-1)                     # [b, t]
+    frame_valid = jnp.arange(t)[None, :] < logit_lengths[:, None]
+    prev = jnp.pad(best, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+    keep = frame_valid & (best != blank) & (best != prev)
+    # stable compaction: position of each kept element
+    pos = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.full((b, t), -1, best.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    out = out.at[rows, jnp.where(keep, pos, t - 1)].set(
+        jnp.where(keep, best, -1), mode="drop")
+    # note: when keep is False we write -1 at t-1 (harmless if slot unused)
+    lengths = keep.sum(axis=1).astype(jnp.int32)
+    # re-blank any trailing slot clobbered by the dummy writes
+    out = jnp.where(jnp.arange(t)[None, :] < lengths[:, None], out, -1)
+    return out, lengths

@@ -1,0 +1,156 @@
+"""Sequence ops over masked [batch, time, ...] tensors.
+
+TPU-native twins of the reference's sequence layer family
+(``SequencePoolLayer``, ``SequenceLastInstanceLayer``, ``SequenceConcatLayer``,
+``SequenceSliceLayer``, ``ExpandLayer``, ``KmaxSeqScoreLayer`` — SURVEY.md
+§2.2) and of ``Argument.sequenceStartPositions`` itself: where the reference
+stores ragged sequences packed end-to-end with offset vectors
+(``parameter/Argument.h:84-93``), the TPU representation is a dense padded
+``[batch, time, ...]`` tensor plus a boolean ``mask[batch, time]`` — static
+shapes for XLA, with masking reproducing padding-free semantics exactly.
+
+``lengths_to_mask``/``mask_to_lengths`` convert between the two views.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce_in
+
+
+def lengths_to_mask(lengths, max_len: int):
+    """[batch] lengths -> [batch, max_len] bool mask."""
+    return jnp.arange(max_len)[None, :] < lengths[:, None]
+
+
+def mask_to_lengths(mask):
+    return mask.sum(axis=1).astype(jnp.int32)
+
+
+def _expand_mask(x, mask):
+    # mask [b, t] -> broadcastable to x [b, t, ...]
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+def sequence_pool(x, mask, pool_type: str = "avg"):
+    """Pool over the time axis of a masked sequence batch.
+
+    Twin of SequencePoolLayer (max/average/sum) and
+    SequenceLastInstanceLayer/first (``pool_type`` "last"/"first").
+    x: [batch, time, d...], mask: [batch, time] -> [batch, d...].
+    """
+    enforce_in(pool_type, ("avg", "sum", "max", "sqrt", "last", "first"))
+    m = _expand_mask(x, mask)
+    if pool_type == "max":
+        neg = jnp.full_like(x, -jnp.inf)
+        return jnp.max(jnp.where(m, x, neg), axis=1)
+    if pool_type in ("avg", "sum", "sqrt"):
+        s = jnp.sum(jnp.where(m, x, 0.0), axis=1)
+        if pool_type == "sum":
+            return s
+        n = jnp.maximum(mask.sum(axis=1), 1).astype(x.dtype)
+        n = n.reshape(n.shape + (1,) * (x.ndim - 2))
+        return s / (jnp.sqrt(n) if pool_type == "sqrt" else n)
+    lengths = mask_to_lengths(mask)
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+    else:
+        idx = jnp.zeros_like(lengths)
+    return jnp.take_along_axis(
+        x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1).squeeze(1)
+
+
+def sequence_concat(x1, mask1, x2, mask2):
+    """Concatenate two sequence batches along time, compacting padding.
+
+    Twin of SequenceConcatLayer: per batch row, the valid prefix of x2 is
+    appended right after the valid prefix of x1.
+    """
+    b, t1 = mask1.shape
+    t2 = mask2.shape[1]
+    len1 = mask_to_lengths(mask1)
+    len2 = mask_to_lengths(mask2)
+    t_out = t1 + t2
+    pos = jnp.arange(t_out)[None, :]
+    # For each output slot j: from x1 if j < len1, from x2 if len1 <= j < len1+len2
+    from_x1 = pos < len1[:, None]
+    idx1 = jnp.broadcast_to(jnp.clip(pos, 0, t1 - 1), (b, t_out))
+    idx2 = jnp.clip(pos - len1[:, None], 0, t2 - 1)
+    g1 = jnp.take_along_axis(x1, idx1.reshape((b, t_out) + (1,) * (x1.ndim - 2)), axis=1)
+    g2 = jnp.take_along_axis(x2, idx2.reshape((b, t_out) + (1,) * (x2.ndim - 2)), axis=1)
+    sel = from_x1.reshape((b, t_out) + (1,) * (x1.ndim - 2))
+    out = jnp.where(sel, g1, g2)
+    out_mask = pos < (len1 + len2)[:, None]
+    return jnp.where(out_mask.reshape((b, t_out) + (1,) * (out.ndim - 2)),
+                     out, 0.0), out_mask
+
+
+def sequence_slice(x, mask, starts, sizes):
+    """Take per-row subsequences [start, start+size) (twin of SequenceSliceLayer)."""
+    b, t = mask.shape
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.clip(pos + starts[:, None], 0, t - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape((b, t) + (1,) * (x.ndim - 2)), axis=1)
+    out_mask = pos < sizes[:, None]
+    lengths = mask_to_lengths(mask)
+    out_mask &= (pos + starts[:, None]) < lengths[:, None]
+    return jnp.where(out_mask.reshape((b, t) + (1,) * (out.ndim - 2)),
+                     out, 0.0), out_mask
+
+
+def sequence_expand(vec, mask):
+    """Broadcast a per-sequence vector to every timestep (twin of ExpandLayer).
+
+    vec: [batch, d], mask: [batch, time] -> [batch, time, d] (zeros at pad).
+    """
+    out = jnp.broadcast_to(vec[:, None, :],
+                           (vec.shape[0], mask.shape[1], vec.shape[-1]))
+    return jnp.where(mask[:, :, None], out, 0.0)
+
+
+def sequence_reverse(x, mask):
+    """Reverse each sequence in place, keeping padding at the tail."""
+    b, t = mask.shape
+    lengths = mask_to_lengths(mask)
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.clip(lengths[:, None] - 1 - pos, 0, t - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape((b, t) + (1,) * (x.ndim - 2)), axis=1)
+    return jnp.where(mask.reshape((b, t) + (1,) * (x.ndim - 2)), out, 0.0)
+
+
+def kmax_sequence_score(scores, mask, k: int):
+    """Indices of the k highest-scoring timesteps per sequence
+    (twin of KmaxSeqScoreLayer).  scores: [batch, time] -> [batch, k] int32."""
+    masked = jnp.where(mask, scores, -jnp.inf)
+    _, idx = jax.lax.top_k(masked, k)
+    return idx
+
+
+def context_projection(x, mask, context_len: int, context_start: int):
+    """Sliding-window concat of neighboring steps
+    (twin of ContextProjection, ``function/ContextProjectionOp.cpp``).
+
+    x: [b, t, d] -> [b, t, context_len*d]; out-of-range neighbors are zero
+    (the reference optionally learns boundary vectors; zero-padding here).
+    """
+    b, t, d = x.shape
+    cols = []
+    xz = jnp.where(mask[:, :, None], x, 0.0)
+    for offset in range(context_start, context_start + context_len):
+        shifted = jnp.roll(xz, -offset, axis=1)
+        pos = jnp.arange(t)[None, :] + offset
+        valid = (pos >= 0) & (pos < t)
+        cols.append(jnp.where(valid[:, :, None], shifted, 0.0))
+    return jnp.concatenate(cols, axis=-1)
+
+
+def first_seq(x, mask):
+    return sequence_pool(x, mask, "first")
+
+
+def last_seq(x, mask):
+    return sequence_pool(x, mask, "last")

@@ -1,0 +1,42 @@
+from paddle_tpu.optim.transforms import (Transform, apply_updates, chain,
+                                         scale, identity)
+from paddle_tpu.optim.optimizers import (sgd, momentum, adagrad,
+                                         decayed_adagrad, adadelta, rmsprop,
+                                         adam, adamax, from_name)
+from paddle_tpu.optim import schedules, regularizers, average
+from paddle_tpu.optim.regularizers import (l1_decay, l2_decay, clip_by_value,
+                                           clip_by_global_norm)
+from paddle_tpu.core.config import OptimizationConfig
+from paddle_tpu.core.errors import ConfigError
+
+
+def from_config(config: OptimizationConfig) -> Transform:
+    """Build the full update pipeline from an OptimizationConfig —
+    twin of ParameterOptimizer::create + OptimizerWithRegularizer
+    (``parameter/OptimizerWithRegularizer.h:22-127``): clip -> decay ->
+    optimizer, with the configured LR schedule."""
+    lr = schedules.from_config(config.learning_rate_schedule,
+                               config.learning_rate,
+                               config.learning_rate_decay_a,
+                               config.learning_rate_decay_b)
+    parts = []
+    if config.gradient_clipping_threshold > 0:
+        parts.append(clip_by_global_norm(config.gradient_clipping_threshold))
+    if config.l1_rate > 0:
+        parts.append(l1_decay(config.l1_rate))
+    if config.l2_rate > 0:
+        parts.append(l2_decay(config.l2_rate))
+    kwargs = dict(config.extra)
+    if config.learning_method == "momentum":
+        kwargs.setdefault("mu", config.momentum)
+    parts.append(from_name(config.learning_method, lr, **kwargs))
+    return chain(*parts) if len(parts) > 1 else parts[0]
+
+
+__all__ = [
+    "Transform", "apply_updates", "chain", "scale", "identity", "sgd",
+    "momentum", "adagrad", "decayed_adagrad", "adadelta", "rmsprop", "adam",
+    "adamax", "from_name", "from_config", "schedules", "regularizers",
+    "average", "l1_decay", "l2_decay", "clip_by_value",
+    "clip_by_global_norm",
+]

@@ -1,0 +1,39 @@
+"""Parameter averaging.
+
+Twin of ``paddle/parameter/AverageOptimizer.{h,cpp}`` (``average_window``
+in OptimizationConfig): keeps a running average of parameter values
+alongside training; evaluation/checkpoint can use the averaged weights
+(``doApply``/``restore`` semantics).
+
+Implemented as a stateful tracker driven from the train loop rather than a
+gradient transform, since it observes post-update parameter values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(params):
+    return {"sum": jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p, jnp.float32), params),
+        "count": jnp.zeros((), jnp.float32)}
+
+
+def accumulate(avg_state, params):
+    return {
+        "sum": jax.tree_util.tree_map(
+            lambda s, p: s + p.astype(jnp.float32), avg_state["sum"], params),
+        "count": avg_state["count"] + 1.0,
+    }
+
+
+def averaged_params(avg_state, params):
+    """Return averaged weights (falling back to current if window empty)."""
+    count = avg_state["count"]
+    return jax.tree_util.tree_map(
+        lambda s, p: jnp.where(count > 0,
+                               (s / jnp.maximum(count, 1.0)).astype(p.dtype),
+                               p),
+        avg_state["sum"], params)

@@ -1,0 +1,184 @@
+"""First-order optimizers.
+
+Twins of ``paddle/parameter/FirstOrderOptimizer.h`` (SGD+momentum :24,
+AdaGrad :111, AdaDelta :141, RMSProp :167, DecayedAdaGrad :210, Adam :255,
+Adamax :286) and the vectorized apply kernels in
+``paddle/math/TrainingAlgorithmOp.h:38-114``.  Update formulas follow the
+reference exactly (epsilon placement, bias correction, rou/decay naming) so
+`test_optimizers.py` can check them against independent reference
+implementations the way ``test_TrainingAlgorithm.cpp`` checks against
+``OriginalOptimizerApi.h``.
+
+Each optimizer takes ``lr`` as a float or a schedule (step -> lr).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import ConfigError
+from paddle_tpu.optim.transforms import Transform, _zeros_like
+
+LR = Union[float, Callable]
+
+
+def _lr_at(lr: LR, step):
+    if callable(lr):
+        return lr(step)
+    return jnp.asarray(lr, jnp.float32)
+
+
+def _tm(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def sgd(lr: LR) -> Transform:
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        return _tm(lambda g: -eta * g, g), s
+    return Transform(lambda p: (), update)
+
+
+def momentum(lr: LR, mu: float = 0.9, nesterov: bool = False) -> Transform:
+    """SGD with momentum (SgdOptimizer + momentum semantics,
+    ``sgdUpdate`` in parameter/ParameterUpdateFunctions.cpp:
+    v = mu*v - lr*g; p += v)."""
+    def init(p):
+        return {"v": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        v = _tm(lambda v, g: mu * v - eta * g, s["v"], g)
+        if nesterov:
+            upd = _tm(lambda v, g: mu * v - eta * g, v, g)
+        else:
+            upd = v
+        return upd, {"v": v}
+    return Transform(init, update)
+
+
+def adagrad(lr: LR, epsilon: float = 1e-6) -> Transform:
+    """AdaGrad (adagradApply, TrainingAlgorithmOp.h:54):
+    accum += g^2; p -= lr * g / (sqrt(accum) + eps)."""
+    def init(p):
+        return {"accum": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        accum = _tm(lambda a, g: a + g * g, s["accum"], g)
+        upd = _tm(lambda g, a: -eta * g / (jnp.sqrt(a) + epsilon), g, accum)
+        return upd, {"accum": accum}
+    return Transform(init, update)
+
+
+def decayed_adagrad(lr: LR, rou: float = 0.95,
+                    epsilon: float = 1e-6) -> Transform:
+    """DecayedAdaGrad (decayedAdagradApply, TrainingAlgorithmOp.h:95):
+    accum = rou*accum + (1-rou)*g^2."""
+    def init(p):
+        return {"accum": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        accum = _tm(lambda a, g: rou * a + (1 - rou) * g * g, s["accum"], g)
+        upd = _tm(lambda g, a: -eta * g / (jnp.sqrt(a) + epsilon), g, accum)
+        return upd, {"accum": accum}
+    return Transform(init, update)
+
+
+def adadelta(lr: LR = 1.0, rou: float = 0.95,
+             epsilon: float = 1e-6) -> Transform:
+    """AdaDelta (adadeltaApply, TrainingAlgorithmOp.h:38):
+    E[g^2] = rou*E[g^2] + (1-rou)g^2;
+    dx = -sqrt((E[dx^2]+eps)/(E[g^2]+eps)) * g;
+    E[dx^2] = rou*E[dx^2] + (1-rou)dx^2; p += lr*dx."""
+    def init(p):
+        return {"accum_g": _zeros_like(p), "accum_dx": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        accum_g = _tm(lambda a, g: rou * a + (1 - rou) * g * g,
+                      s["accum_g"], g)
+        dx = _tm(lambda g, ag, adx: -jnp.sqrt((adx + epsilon)
+                                              / (ag + epsilon)) * g,
+                 g, accum_g, s["accum_dx"])
+        accum_dx = _tm(lambda a, d: rou * a + (1 - rou) * d * d,
+                       s["accum_dx"], dx)
+        upd = _tm(lambda d: eta * d, dx)
+        return upd, {"accum_g": accum_g, "accum_dx": accum_dx}
+    return Transform(init, update)
+
+
+def rmsprop(lr: LR, rou: float = 0.95, epsilon: float = 1e-6) -> Transform:
+    """RMSProp with mean-centering (rmspropApply, TrainingAlgorithmOp.h:70 —
+    the reference keeps E[g] too: denom = sqrt(E[g^2] - E[g]^2 + eps))."""
+    def init(p):
+        return {"accum_g2": _zeros_like(p), "accum_g": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        g2 = _tm(lambda a, g: rou * a + (1 - rou) * g * g, s["accum_g2"], g)
+        g1 = _tm(lambda a, g: rou * a + (1 - rou) * g, s["accum_g"], g)
+        upd = _tm(lambda g, a2, a1: -eta * g
+                  / jnp.sqrt(a2 - a1 * a1 + epsilon), g, g2, g1)
+        return upd, {"accum_g2": g2, "accum_g": g1}
+    return Transform(init, update)
+
+
+def adam(lr: LR, beta1: float = 0.9, beta2: float = 0.999,
+         epsilon: float = 1e-8) -> Transform:
+    """Adam (adamApply, TrainingAlgorithmOp.h:102, AdamOptimizer
+    FirstOrderOptimizer.h:255) with bias correction."""
+    def init(p):
+        return {"m": _zeros_like(p), "v": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        t = step.astype(jnp.float32) + 1.0
+        m = _tm(lambda m, g: beta1 * m + (1 - beta1) * g, s["m"], g)
+        v = _tm(lambda v, g: beta2 * v + (1 - beta2) * g * g, s["v"], g)
+        correction = jnp.sqrt(1.0 - jnp.power(beta2, t)) \
+            / (1.0 - jnp.power(beta1, t))
+        upd = _tm(lambda m, v: -eta * correction * m
+                  / (jnp.sqrt(v) + epsilon), m, v)
+        return upd, {"m": m, "v": v}
+    return Transform(init, update)
+
+
+def adamax(lr: LR, beta1: float = 0.9, beta2: float = 0.999) -> Transform:
+    """Adamax (adamaxApply, TrainingAlgorithmOp.h:110):
+    u = max(beta2*u, |g|); p -= lr/(1-beta1^t) * m/u."""
+    def init(p):
+        return {"m": _zeros_like(p), "u": _zeros_like(p)}
+
+    def update(g, s, p, step):
+        eta = _lr_at(lr, step)
+        t = step.astype(jnp.float32) + 1.0
+        m = _tm(lambda m, g: beta1 * m + (1 - beta1) * g, s["m"], g)
+        u = _tm(lambda u, g: jnp.maximum(beta2 * u, jnp.abs(g)), s["u"], g)
+        upd = _tm(lambda m, u: -eta / (1.0 - jnp.power(beta1, t))
+                  * m / jnp.maximum(u, 1e-12), m, u)
+        return upd, {"m": m, "u": u}
+    return Transform(init, update)
+
+
+NAMED = {
+    "sgd": sgd,
+    "momentum": momentum,
+    "adagrad": adagrad,
+    "decayed_adagrad": decayed_adagrad,
+    "adadelta": adadelta,
+    "rmsprop": rmsprop,
+    "adam": adam,
+    "adamax": adamax,
+}
+
+
+def from_name(name: str, lr: LR, **kwargs) -> Transform:
+    if name not in NAMED:
+        raise ConfigError(f"Unknown optimizer {name!r}; "
+                          f"available: {sorted(NAMED)}")
+    return NAMED[name](lr, **kwargs)

@@ -1,0 +1,51 @@
+"""Weight decay / regularization and gradient clipping transforms.
+
+Twins of ``paddle/parameter/Regularizer.{h,cpp}`` (L1/L2 decay applied at
+update time, scaled by learning rate per the v1 semantics) and the gradient
+clipping hook (``ParameterUpdaterHook.cpp`` pathes + clipping in
+``FirstOrderOptimizer.h:342``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optim.transforms import Transform
+
+
+def l2_decay(rate: float) -> Transform:
+    """Add L2 gradient term g += rate * p (L2Regularizer)."""
+    def update(g, s, p, step):
+        new_g = jax.tree_util.tree_map(lambda g, p: g + rate * p, g, p)
+        return new_g, s
+    return Transform(lambda p: (), update)
+
+
+def l1_decay(rate: float) -> Transform:
+    """Add L1 subgradient g += rate * sign(p) (L1Regularizer)."""
+    def update(g, s, p, step):
+        new_g = jax.tree_util.tree_map(
+            lambda g, p: g + rate * jnp.sign(p), g, p)
+        return new_g, s
+    return Transform(lambda p: (), update)
+
+
+def clip_by_value(threshold: float) -> Transform:
+    """Element-wise clip to [-t, t] (error_clipping_threshold semantics)."""
+    def update(g, s, p, step):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), g), s
+    return Transform(lambda p: (), update)
+
+
+def clip_by_global_norm(threshold: float) -> Transform:
+    """Scale all grads so the global L2 norm <= threshold
+    (gradient_clipping_threshold, FirstOrderOptimizer.h:342)."""
+    def update(g, s, p, step):
+        leaves = jax.tree_util.tree_leaves(g)
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                            for x in leaves))
+        scale = jnp.minimum(1.0, threshold / jnp.maximum(norm, 1e-12))
+        return jax.tree_util.tree_map(lambda x: x * scale, g), s
+    return Transform(lambda p: (), update)

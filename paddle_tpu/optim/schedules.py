@@ -1,0 +1,97 @@
+"""Learning-rate schedules.
+
+Twin of ``paddle/parameter/LearningRateScheduler.cpp`` (registered names:
+poly, constant, exp, discexp, linear, manual, pass_manual) and the C lib's
+const/linear policies (``paddle/optimizer/lr_policy.h:18,41``).  A schedule
+is a pure ``step -> multiplier-on-base-lr`` function of the 0-based batch
+counter, usable inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def poly(lr: float, decay_a: float, decay_b: float) -> Schedule:
+    """v1 poly schedule: lr * (1 + a*t)^(-b) (LearningRateScheduler.cpp)."""
+    def sched(step):
+        return lr * jnp.power(1.0 + decay_a * step.astype(jnp.float32),
+                              -decay_b)
+    return sched
+
+
+def exp_decay(lr: float, decay_a: float, decay_b: float) -> Schedule:
+    """lr * a^(t/b) (exp schedule)."""
+    def sched(step):
+        return lr * jnp.power(decay_a, step.astype(jnp.float32) / decay_b)
+    return sched
+
+
+def discexp(lr: float, decay_a: float, decay_b: float) -> Schedule:
+    """lr * a^floor(t/b) (discrete exponential)."""
+    def sched(step):
+        return lr * jnp.power(decay_a,
+                              jnp.floor(step.astype(jnp.float32) / decay_b))
+    return sched
+
+
+def linear(lr: float, decay_a: float, decay_b: float) -> Schedule:
+    """max(lr - a*t, b) (linear decay with floor)."""
+    def sched(step):
+        return jnp.maximum(lr - decay_a * step.astype(jnp.float32), decay_b)
+    return sched
+
+
+def manual(lr: float, segments: Sequence[Tuple[int, float]]) -> Schedule:
+    """Piecewise-constant by step thresholds: [(boundary_step, lr), ...]
+    (twin of the 'manual' schedule's seg=step_range:lr spec)."""
+    boundaries = jnp.asarray([b for b, _ in segments], jnp.int32)
+    values = jnp.asarray([lr] + [v for _, v in segments], jnp.float32)
+
+    def sched(step):
+        idx = jnp.sum(step >= boundaries)
+        return values[idx]
+    return sched
+
+
+def warmup_cosine(lr: float, warmup_steps: int, total_steps: int,
+                  final_scale: float = 0.0) -> Schedule:
+    """Modern extension (not in reference): linear warmup + cosine decay."""
+    def sched(step):
+        stepf = step.astype(jnp.float32)
+        warm = stepf / jnp.maximum(1.0, warmup_steps)
+        progress = jnp.clip((stepf - warmup_steps)
+                            / jnp.maximum(1.0, total_steps - warmup_steps),
+                            0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        scale = final_scale + (1.0 - final_scale) * cos
+        return lr * jnp.where(stepf < warmup_steps, warm, scale)
+    return sched
+
+
+NAMED = {
+    "constant": constant,
+    "poly": poly,
+    "exp": exp_decay,
+    "discexp": discexp,
+    "linear": linear,
+}
+
+
+def from_config(name: str, lr: float, decay_a: float = 0.0,
+                decay_b: float = 0.0) -> Schedule:
+    from paddle_tpu.core.errors import ConfigError
+    if name == "constant":
+        return constant(lr)
+    if name not in NAMED:
+        raise ConfigError(f"Unknown LR schedule {name!r}; "
+                          f"available: {sorted(NAMED)} + manual/warmup_cosine")
+    return NAMED[name](lr, decay_a, decay_b)

@@ -1,0 +1,72 @@
+"""Gradient-transformation core.
+
+TPU-native twin of the reference's optimizer separation
+(``paddle/parameter/FirstOrderOptimizer.h``, ``ParameterOptimizer::create``
+``ParameterOptimizer.cpp:28``, and the standalone C optimizer lib
+``paddle/optimizer``): an optimizer is a pure ``(init, update)`` pair over
+parameter pytrees with explicit, serializable state — the natural JAX
+formulation (same shape as optax, implemented from scratch so state layout
+and semantics exactly mirror the reference's per-parameter buffers,
+``ParameterType`` momentum/accum slots ``utils/GlobalConstants.h:28-53``).
+
+``update`` receives ``step`` (0-based batch counter) so learning-rate
+schedules (``parameter/LearningRateScheduler.cpp``) stay pure functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Transform(NamedTuple):
+    """(init, update) pair.
+
+    init(params) -> state
+    update(grads, state, params, step) -> (updates, new_state)
+
+    ``updates`` are *deltas to add* to params: ``params + updates``.
+    """
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any, jax.Array], Tuple[Any, Any]]
+
+
+def apply_updates(params, updates):
+    return jax.tree_util.tree_map(lambda p, u: (p + u).astype(p.dtype),
+                                  params, updates)
+
+
+def chain(*transforms: Transform) -> Transform:
+    """Compose transforms left-to-right (clip -> regularize -> optimizer)."""
+
+    def init(params):
+        return tuple(t.init(params) for t in transforms)
+
+    def update(grads, state, params, step):
+        new_states = []
+        for t, s in zip(transforms, state):
+            grads, s = t.update(grads, s, params, step)
+            new_states.append(s)
+        return grads, tuple(new_states)
+
+    return Transform(init, update)
+
+
+def _zeros_like(params):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+
+
+def scale(factor: float) -> Transform:
+    return Transform(
+        lambda params: (),
+        lambda g, s, p, step: (jax.tree_util.tree_map(
+            lambda x: x * factor, g), s))
+
+
+def identity() -> Transform:
+    return Transform(lambda params: (),
+                     lambda g, s, p, step: (g, s))

@@ -1,0 +1,6 @@
+from paddle_tpu.parallel.mesh import (make_mesh, batch_sharding, replicated,
+                                      shard_batch, replicate, DP, MP, PP, SP)
+from paddle_tpu.parallel import sharding
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch",
+           "replicate", "sharding", "DP", "MP", "PP", "SP"]

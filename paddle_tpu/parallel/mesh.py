@@ -1,0 +1,67 @@
+"""Device-mesh utilities.
+
+TPU-native replacement for the reference's distribution machinery: where
+the reference splits batches over ``trainer_count`` worker threads with a
+ring gather/scatter (``MultiGradientMachine.h:44-95``) and syncs multi-node
+gradients through a sharded parameter server (``ParameterServer2``), the TPU
+build declares a ``jax.sharding.Mesh`` over the chips and lets XLA compile
+the collectives onto ICI (SURVEY.md §2.4).
+
+Axis conventions:
+  * ``dp`` — data parallelism (batch split; grad psum) — replaces
+    MultiGradientMachine + sync RemoteParameterUpdater
+  * ``mp`` — tensor/model parallelism (weight sharding) — extends
+    ParallelNeuralNetwork's per-layer device placement
+  * ``sp`` — sequence/context parallelism (long-sequence sharding)
+  * ``pp`` — pipeline stages (new capability, absent in reference)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.errors import enforce
+
+DP, MP, PP, SP = "dp", "mp", "pp", "sp"
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axes: Optional[Sequence[str]] = None,
+              devices=None) -> Mesh:
+    """Create a Mesh.  Default: all devices on one ``dp`` axis."""
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+        axes = axes or (DP,)
+    axes = tuple(axes or (DP, MP, PP, SP)[:len(shape)])
+    enforce(int(np.prod(shape)) == len(devices),
+            "mesh shape %s does not cover %d devices", shape, len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DP) -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DP):
+    """Device-put a pytree of host arrays with batch-dim sharding."""
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree)

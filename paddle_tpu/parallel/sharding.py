@@ -1,0 +1,70 @@
+"""Parameter-sharding rules.
+
+TPU-native generalization of the reference's model parallelism
+(``ParallelNeuralNetwork`` per-layer ``device`` placement,
+``ParallelNeuralNetwork.h:34``, ``Layer.h:69``): instead of pinning whole
+layers to devices, parameters are *sharded* across the ``mp`` mesh axis by
+name-pattern rules, and XLA inserts the tensor-parallel collectives.  Rules
+are ``(regex-on-param-path, PartitionSpec)`` pairs, first match wins,
+default replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.module import flatten_names, unflatten_names
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def apply_rules(params, mesh: Mesh, rules: Optional[Rules]):
+    """device_put each param with its matched sharding (replicated default)."""
+    flat = flatten_names(params)
+    out = {}
+    for name, value in flat.items():
+        spec = P()
+        for pattern, candidate in (rules or ()):
+            if re.search(pattern, name):
+                spec = candidate
+                break
+        out[name] = jax.device_put(value, NamedSharding(mesh, spec))
+    return unflatten_names(out)
+
+
+def shardings_like(params, mesh: Mesh, rules: Optional[Rules]):
+    """NamedSharding pytree for params (for jit out_shardings/donation)."""
+    flat = flatten_names(params)
+    out = {}
+    for name in flat:
+        spec = P()
+        for pattern, candidate in (rules or ()):
+            if re.search(pattern, name):
+                spec = candidate
+                break
+        out[name] = NamedSharding(mesh, spec)
+    return unflatten_names(out)
+
+
+def lstm_tp_rules(axis: str = "mp") -> Rules:
+    """Tensor-parallel layout for the LSTM stack: gate projections shard on
+    the 4h output dim, embeddings on vocab rows, the readout on classes."""
+    return (
+        (r"lstm_\d+/w_x$", P(None, axis)),
+        (r"lstm_\d+/w_h$", P(None, axis)),
+        (r"lstm_\d+/b$", P(axis)),
+        (r"embed/w$", P(axis, None)),
+        (r"fc/w$", P(None, axis)),
+    )
+
+
+def mlp_tp_rules(axis: str = "mp") -> Rules:
+    """Megatron-style column/row split for alternating linear layers."""
+    return (
+        (r"linear_0/w$", P(None, axis)),
+        (r"linear_1/w$", P(axis, None)),
+    )

@@ -1,0 +1,91 @@
+"""Testing utilities: numeric gradient checking and tolerant comparison.
+
+TPU-native twin of the reference's core correctness tooling —
+``paddle/gserver/tests/LayerGradUtil.h:203-306`` (``testLayerGrad``) and the
+new-IR ``python/paddle/v2/framework/tests/op_test.py:95``
+(``get_numeric_gradient`` / ``check_grad``): central finite differences of a
+scalarized function compared against ``jax.grad``, applied over whole
+parameter pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_gradient(f: Callable, x: jax.Array, eps: float = 1e-3) -> np.ndarray:
+    """Central finite-difference gradient of scalar-valued f at x."""
+    x = np.array(x, np.float64 if x.dtype == jnp.float64 else np.float32)
+    grad = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f_pos = float(f(jnp.asarray(x)))
+        flat[i] = orig - eps
+        f_neg = float(f(jnp.asarray(x)))
+        flat[i] = orig
+        gflat[i] = (f_pos - f_neg) / (2 * eps)
+    return grad
+
+
+def check_grad(f: Callable, x: jax.Array, eps: float = 1e-3,
+               rtol: float = 1e-2, atol: float = 1e-3) -> None:
+    """Assert jax.grad(f)(x) matches finite differences."""
+    analytic = np.asarray(jax.grad(f)(x), np.float64)
+    numeric = numeric_gradient(f, x, eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                               err_msg="analytic vs numeric gradient mismatch")
+
+
+def check_grad_params(loss_fn: Callable, params, eps: float = 1e-3,
+                      rtol: float = 1e-2, atol: float = 1e-3,
+                      max_elems_per_leaf: int = 16,
+                      seed: int = 0) -> None:
+    """Gradcheck over a parameter pytree, sampling elements of big leaves.
+
+    ``loss_fn(params) -> scalar``.  For each leaf, up to
+    ``max_elems_per_leaf`` random elements are perturbed (the reference's
+    testLayerGrad similarly spot-checks rather than perturbing every weight
+    of every layer).
+    """
+    analytic = jax.grad(loss_fn)(params)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(analytic)
+    rng = np.random.RandomState(seed)
+
+    for li, (leaf, g_leaf) in enumerate(zip(leaves, g_leaves)):
+        leaf_np = np.array(leaf, np.float64)
+        flat = leaf_np.reshape(-1)
+        n = flat.size
+        idxs = (np.arange(n) if n <= max_elems_per_leaf
+                else rng.choice(n, max_elems_per_leaf, replace=False))
+        for i in idxs:
+            orig = flat[i]
+
+            def eval_at(v):
+                flat[i] = v
+                new_leaves = list(leaves)
+                new_leaves[li] = jnp.asarray(leaf_np, leaf.dtype)
+                out = float(loss_fn(jax.tree_util.tree_unflatten(
+                    treedef, new_leaves)))
+                flat[i] = orig
+                return out
+
+            num = (eval_at(orig + eps) - eval_at(orig - eps)) / (2 * eps)
+            ana = float(np.asarray(g_leaf).reshape(-1)[i])
+            if not np.isclose(ana, num, rtol=rtol, atol=atol):
+                raise AssertionError(
+                    f"grad mismatch at leaf {li} elem {i}: "
+                    f"analytic={ana:.6g} numeric={num:.6g}")
+
+
+def assert_allclose(a, b, rtol: float = 1e-5, atol: float = 1e-6,
+                    msg: Optional[str] = None) -> None:
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                               atol=atol, err_msg=msg or "")

@@ -1,0 +1,9 @@
+from paddle_tpu.training.trainer import Trainer
+from paddle_tpu.training import events, evaluators, checkpoint
+from paddle_tpu.training.evaluators import (Evaluator, ClassificationError,
+                                            ValueSum, PrecisionRecall, AUC,
+                                            ChunkEvaluator, iob_decode)
+
+__all__ = ["Trainer", "events", "evaluators", "checkpoint", "Evaluator",
+           "ClassificationError", "ValueSum", "PrecisionRecall", "AUC",
+           "ChunkEvaluator", "iob_decode"]

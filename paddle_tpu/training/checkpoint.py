@@ -1,0 +1,134 @@
+"""Checkpoint save/restore.
+
+Twin of the reference's three checkpoint paths (SURVEY.md §5): v1 per-pass
+parameter dirs (``trainer/ParamUtil.h:58-111``), v2 ``Parameters.to_tar``
+(``v2/parameters.py:324``) and the Go pserver's checkpoint+meta
+(``go/pserver/service.go:272``).  One canonical format here:
+
+``<dir>/pass-NNNNN/`` containing
+  * ``arrays.npz``   — every leaf of every tree, flat-named ``tree:a/b/c``
+  * ``meta.json``    — step counters, data cursor, user metadata, md5 of the
+                       npz (the Go path's integrity check)
+
+plus a ``latest`` symlink-style marker file.  Multi-host sharded arrays
+should be saved via orbax instead; this format covers the single-host /
+replicated case and is the interchange format of the merge/export tool.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.nn.module import flatten_names, unflatten_names
+
+
+def _flatten_trees(trees: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    flat: Dict[str, np.ndarray] = {}
+    for tree_name, tree in trees.items():
+        if tree is None:
+            continue
+        for k, v in flatten_names(_to_plain(tree)).items():
+            flat[f"{tree_name}:{k}"] = np.asarray(v)
+    return flat
+
+
+# Empty containers must survive the flatten/unflatten round-trip: a chained
+# optimizer's state is a tuple like ((), {"v": ...}) and dropping the empty
+# slot would silently misalign transforms with their state after restore.
+_EMPTY_DICT = "__empty_dict__"
+_EMPTY_TUPLE = "__empty_tuple__"
+
+
+def _to_plain(tree):
+    """Convert tuples in optimizer-state pytrees to indexed dicts."""
+    if isinstance(tree, dict):
+        if not tree:
+            return {_EMPTY_DICT: np.zeros(0, np.int8)}
+        return {str(k): _to_plain(v) for k, v in tree.items()}
+    if isinstance(tree, (tuple, list)):
+        if not tree:
+            return {_EMPTY_TUPLE: np.zeros(0, np.int8)}
+        return {f"#{i}": _to_plain(v) for i, v in enumerate(tree)}
+    return tree
+
+
+def _from_plain(tree):
+    if isinstance(tree, dict):
+        keys = list(tree.keys())
+        if keys == [_EMPTY_DICT]:
+            return {}
+        if keys == [_EMPTY_TUPLE]:
+            return ()
+        if keys and all(k.startswith("#") for k in keys):
+            items = sorted(tree.items(), key=lambda kv: int(kv[0][1:]))
+            return tuple(_from_plain(v) for _, v in items)
+        return {k: _from_plain(v) for k, v in tree.items()}
+    return tree
+
+
+def save(directory: str, pass_id: int, trees: Dict[str, Any],
+         metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Save trees (e.g. {"params":…, "state":…, "opt":…}) for a pass."""
+    pass_dir = os.path.join(directory, f"pass-{pass_id:05d}")
+    os.makedirs(pass_dir, exist_ok=True)
+    flat = _flatten_trees(trees)
+    npz_path = os.path.join(pass_dir, "arrays.npz")
+    # atomic-ish write: temp file then rename (pserver checkpoint pattern)
+    # suffix must end in .npz or np.savez silently writes to <tmp>.npz
+    fd, tmp = tempfile.mkstemp(dir=pass_dir, suffix=".tmp.npz")
+    os.close(fd)
+    np.savez(tmp, **flat)
+    os.replace(tmp, npz_path)
+    with open(npz_path, "rb") as f:
+        md5 = hashlib.md5(f.read()).hexdigest()
+    meta = {
+        "pass_id": pass_id,
+        "tree_names": sorted({k.split(":", 1)[0] for k in flat}),
+        "md5": md5,
+        "metadata": metadata or {},
+    }
+    with open(os.path.join(pass_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    with open(os.path.join(directory, "latest"), "w") as f:
+        f.write(f"pass-{pass_id:05d}")
+    return pass_dir
+
+
+def latest_pass(directory: str) -> Optional[int]:
+    marker = os.path.join(directory, "latest")
+    if not os.path.exists(marker):
+        return None
+    with open(marker) as f:
+        return int(f.read().strip().split("-")[-1])
+
+
+def load(directory: str, pass_id: Optional[int] = None,
+         verify_md5: bool = True) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load trees; returns (trees, metadata).  pass_id=None -> latest."""
+    if pass_id is None:
+        pass_id = latest_pass(directory)
+        if pass_id is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+    pass_dir = os.path.join(directory, f"pass-{pass_id:05d}")
+    with open(os.path.join(pass_dir, "meta.json")) as f:
+        meta = json.load(f)
+    npz_path = os.path.join(pass_dir, "arrays.npz")
+    if verify_md5:
+        with open(npz_path, "rb") as f:
+            md5 = hashlib.md5(f.read()).hexdigest()
+        if md5 != meta["md5"]:
+            raise IOError(f"checkpoint md5 mismatch in {pass_dir}")
+    data = np.load(npz_path)
+    trees: Dict[str, Dict[str, np.ndarray]] = {}
+    for key in data.files:
+        tree_name, path = key.split(":", 1)
+        trees.setdefault(tree_name, {})[path] = data[key]
+    out = {name: _from_plain(unflatten_names(flat))
+           for name, flat in trees.items()}
+    return out, meta

@@ -1,0 +1,221 @@
+"""Evaluators: streaming metrics over batches.
+
+Twin of ``paddle/gserver/evaluators/Evaluator.{h,cpp}`` (base contract
+start/evalImp/updateSamplesNum/finish, ``Evaluator.h:42``; zoo at
+``Evaluator.cpp:172-1346``): an evaluator accumulates sufficient statistics
+over batches and reports at pass end.  The ``distributeEval`` merge of the
+reference maps to summing the statistic pytrees across hosts (they are all
+sums, so a psum/allreduce merges them — done by the caller when needed).
+
+Evaluators consume a dict of batch outputs (device arrays ok) — keys are
+chosen by the model ("logits", "label", "weight", ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Evaluator:
+    name = "evaluator"
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def update(self, outputs: Dict[str, np.ndarray]) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> float:
+        raise NotImplementedError
+
+
+class ClassificationError(Evaluator):
+    """Twin of ClassificationErrorEvaluator (Evaluator.cpp:172)."""
+
+    def __init__(self, logits_key: str = "logits", label_key: str = "label",
+                 name: str = "classification_error"):
+        self.logits_key = logits_key
+        self.label_key = label_key
+        self.name = name
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def update(self, outputs):
+        logits = np.asarray(outputs[self.logits_key])
+        labels = np.asarray(outputs[self.label_key])
+        mask = outputs.get(self.label_key + "_mask")
+        pred = logits.argmax(-1)
+        wrong = (pred != labels)
+        if mask is not None:
+            m = np.asarray(mask)
+            self.wrong += float((wrong & m).sum())
+            self.total += float(m.sum())
+        else:
+            self.wrong += float(wrong.sum())
+            self.total += float(wrong.size)
+
+    def finish(self):
+        return self.wrong / max(self.total, 1.0)
+
+
+class ValueSum(Evaluator):
+    """Twin of SumEvaluator / column_sum (Evaluator.cpp:225-330)."""
+
+    def __init__(self, key: str, name: Optional[str] = None,
+                 average: bool = False):
+        self.key = key
+        self.name = name or f"sum({key})"
+        self.average = average
+
+    def start(self):
+        self.total = 0.0
+        self.count = 0.0
+
+    def update(self, outputs):
+        v = np.asarray(outputs[self.key])
+        self.total += float(v.sum())
+        self.count += float(v.shape[0]) if v.ndim else 1.0
+
+    def finish(self):
+        return self.total / max(self.count, 1.0) if self.average else self.total
+
+
+class PrecisionRecall(Evaluator):
+    """Binary/multiclass positive-class P/R/F1
+    (twin of PrecisionRecallEvaluator, Evaluator.cpp:580)."""
+
+    def __init__(self, logits_key: str = "logits", label_key: str = "label",
+                 positive_class: int = 1, name: str = "precision_recall"):
+        self.logits_key = logits_key
+        self.label_key = label_key
+        self.positive = positive_class
+        self.name = name
+
+    def start(self):
+        self.tp = 0.0
+        self.fp = 0.0
+        self.fn = 0.0
+
+    def update(self, outputs):
+        pred = np.asarray(outputs[self.logits_key]).argmax(-1)
+        label = np.asarray(outputs[self.label_key])
+        p = pred == self.positive
+        t = label == self.positive
+        self.tp += float((p & t).sum())
+        self.fp += float((p & ~t).sum())
+        self.fn += float((~p & t).sum())
+
+    def finish(self):
+        precision = self.tp / max(self.tp + self.fp, 1.0)
+        recall = self.tp / max(self.tp + self.fn, 1.0)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-8)
+        return f1
+
+
+class AUC(Evaluator):
+    """Streaming ROC-AUC via score histogram
+    (twin of RankAucEvaluator / AucEvaluator, Evaluator.cpp:334-570)."""
+
+    def __init__(self, score_key: str = "prob", label_key: str = "label",
+                 num_bins: int = 4096, name: str = "auc"):
+        self.score_key = score_key
+        self.label_key = label_key
+        self.num_bins = num_bins
+        self.name = name
+
+    def start(self):
+        self.pos = np.zeros(self.num_bins)
+        self.neg = np.zeros(self.num_bins)
+
+    def update(self, outputs):
+        score = np.asarray(outputs[self.score_key]).reshape(-1)
+        label = np.asarray(outputs[self.label_key]).reshape(-1)
+        bins = np.clip((score * self.num_bins).astype(int), 0,
+                       self.num_bins - 1)
+        self.pos += np.bincount(bins[label == 1], minlength=self.num_bins)
+        self.neg += np.bincount(bins[label == 0], minlength=self.num_bins)
+
+    def finish(self):
+        # trapezoid over descending-score sweep
+        pos_cum = np.cumsum(self.pos[::-1])
+        neg_cum = np.cumsum(self.neg[::-1])
+        total_pos = max(pos_cum[-1], 1.0)
+        total_neg = max(neg_cum[-1], 1.0)
+        tpr = np.concatenate([[0.0], pos_cum / total_pos])
+        fpr = np.concatenate([[0.0], neg_cum / total_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk (NER-style) F1 over IOB tag sequences
+    (twin of ChunkEvaluator.cpp, scheme=IOB).
+
+    Expects integer tag ids where tag%2==1 means B-type and tag%2==0 (and
+    nonzero... configurable) — to stay scheme-agnostic, callers pass a
+    ``decode_chunks(tags) -> set[(start, end, type)]`` function.
+    """
+
+    def __init__(self, pred_key: str, label_key: str, decode_chunks,
+                 mask_key: Optional[str] = None, name: str = "chunk_f1"):
+        self.pred_key = pred_key
+        self.label_key = label_key
+        self.mask_key = mask_key
+        self.decode = decode_chunks
+        self.name = name
+
+    def start(self):
+        self.correct = 0.0
+        self.n_pred = 0.0
+        self.n_label = 0.0
+
+    def update(self, outputs):
+        preds = np.asarray(outputs[self.pred_key])
+        labels = np.asarray(outputs[self.label_key])
+        if self.mask_key:
+            masks = np.asarray(outputs[self.mask_key])
+        else:
+            masks = np.ones(preds.shape, bool)
+        for p_row, l_row, m_row in zip(preds, labels, masks):
+            n = int(m_row.sum())
+            pc = self.decode(list(p_row[:n]))
+            lc = self.decode(list(l_row[:n]))
+            self.correct += len(pc & lc)
+            self.n_pred += len(pc)
+            self.n_label += len(lc)
+
+    def finish(self):
+        precision = self.correct / max(self.n_pred, 1.0)
+        recall = self.correct / max(self.n_label, 1.0)
+        return 2 * precision * recall / max(precision + recall, 1e-8)
+
+
+def iob_decode(tags):
+    """Decode IOB1-coded int tags (odd=B, even-nonneg... simple scheme:
+    0=O, odd=B-k, even=I-k with type k=(tag+1)//2) into chunk triples."""
+    chunks = set()
+    start = None
+    ctype = None
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t == 0:
+            if start is not None:
+                chunks.add((start, i, ctype))
+                start = None
+        elif t % 2 == 1:  # B-
+            if start is not None:
+                chunks.add((start, i, ctype))
+            start = i
+            ctype = (t + 1) // 2
+        else:  # I-
+            if start is None or ctype != t // 2:
+                if start is not None:
+                    chunks.add((start, i, ctype))
+                start = i
+                ctype = t // 2
+    if start is not None:
+        chunks.add((start, len(tags), ctype))
+    return chunks

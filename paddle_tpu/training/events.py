@@ -1,0 +1,44 @@
+"""Training event objects.
+
+Twin of ``python/paddle/v2/event.py``: the trainer invokes a user callback
+with typed events; handlers do logging/plotting/checkpointing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclasses.dataclass
+class EndPass:
+    pass_id: int
+    evaluator_results: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+@dataclasses.dataclass
+class EndIteration:
+    pass_id: int
+    batch_id: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class EndTestPeriod:
+    pass_id: int
+    batch_id: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)

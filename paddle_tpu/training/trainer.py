@@ -1,0 +1,205 @@
+"""The training driver.
+
+Twin of the v2 ``SGD`` trainer (``python/paddle/v2/trainer.py:24`` —
+SGD.__init__/train/test) over the v1 engine stack
+(``Trainer::train`` ``paddle/trainer/Trainer.cpp:261``,
+``TrainerInternal::trainOneBatch`` ``TrainerInternal.cpp:66``): pass loop →
+batch loop → forwardBackward+update → events/evaluators → per-pass
+checkpoint.  The C++ GradientMachine/updater pipeline collapses into ONE
+jitted train_step (value_and_grad + optimizer transform) that XLA fuses and,
+when a mesh is given, shards data-parallel over ``dp`` with gradient psum
+compiled onto ICI — replacing both MultiGradientMachine's thread ring and
+the RemoteParameterUpdater/pserver sync path.
+
+The model callable has signature ``model_fn(batch: dict) -> (loss, outputs)``
+where ``loss`` is a scalar and ``outputs`` is a dict fed to evaluators; it
+uses ``paddle_tpu.nn`` modules (wrapped with ``nn.transform`` internally).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import optim as optim_lib
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import transform
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.training import checkpoint as ckpt_lib
+from paddle_tpu.training import events as ev
+from paddle_tpu.training.evaluators import Evaluator
+
+
+class Trainer:
+    def __init__(self, model_fn: Callable[[Dict[str, Any]], Any],
+                 optimizer: optim_lib.Transform,
+                 seed: int = 0,
+                 mesh=None,
+                 param_rules=None,
+                 average_window: int = 0):
+        self.model = transform(model_fn)
+        self.optimizer = optimizer
+        self.seed = seed
+        self.mesh = mesh
+        self.param_rules = param_rules
+        self.average_window = average_window
+        self.params = None
+        self.net_state = None
+        self.opt_state = None
+        self.avg_state = None
+        self.step = 0
+        self._train_step = None
+        self._eval_step = None
+
+    # ---- initialization ----
+
+    def init(self, sample_batch: Dict[str, Any]) -> None:
+        batch = {k: jnp.asarray(v) for k, v in sample_batch.items()}
+        self.params, self.net_state = self.model.init(
+            jax.random.key(self.seed), batch)
+        if self.mesh is not None:
+            from paddle_tpu.parallel import sharding as sharding_lib
+            # shard params by rule (tensor parallel) before deriving
+            # optimizer state, so the state inherits the same layout
+            self.params = sharding_lib.apply_rules(self.params, self.mesh,
+                                                   self.param_rules)
+            self.net_state = mesh_lib.replicate(self.net_state, self.mesh)
+        self.opt_state = self.optimizer.init(self.params)
+        if self.average_window:
+            self.avg_state = optim_lib.average.init(self.params)
+        self._build_steps()
+
+    def _build_steps(self):
+        model, optimizer = self.model, self.optimizer
+
+        def train_step(params, net_state, opt_state, batch, step):
+            rng = jax.random.fold_in(jax.random.key(self.seed), step)
+
+            def loss_fn(p):
+                (loss, outputs), new_state = model.apply(
+                    p, net_state, rng, batch, train=True)
+                return loss, (outputs, new_state)
+
+            (loss, (outputs, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = optimizer.update(grads, opt_state, params,
+                                                step)
+            new_params = optim_lib.apply_updates(params, updates)
+            return new_params, new_state, new_opt, loss, outputs
+
+        def eval_step(params, net_state, batch):
+            (loss, outputs), _ = model.apply(params, net_state, None, batch,
+                                             train=False)
+            return loss, outputs
+
+        donate = (0, 2)  # params, opt_state buffers are dead after the step
+        self._train_step = jax.jit(train_step, donate_argnums=donate)
+        self._eval_step = jax.jit(eval_step)
+
+    # ---- training ----
+
+    def train_batch(self, batch: Dict[str, Any]):
+        enforce(self.params is not None, "Trainer.init(sample_batch) first")
+        batch = self._put(batch)
+        (self.params, self.net_state, self.opt_state, loss,
+         outputs) = self._train_step(self.params, self.net_state,
+                                     self.opt_state, batch,
+                                     jnp.asarray(self.step))
+        if self.average_window:
+            self.avg_state = optim_lib.average.accumulate(
+                self.avg_state, self.params)
+        self.step += 1
+        return loss, outputs
+
+    def _put(self, batch):
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self.mesh is not None:
+            batch = mesh_lib.shard_batch(batch, self.mesh)
+        return batch
+
+    def train(self, reader: Callable[[], Iterable[Dict[str, Any]]],
+              num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              evaluators: Sequence[Evaluator] = (),
+              test_reader: Optional[Callable] = None,
+              save_dir: Optional[str] = None,
+              log_period: int = 0) -> None:
+        """Pass/batch loop with events (SGD.train twin, v2/trainer.py:117)."""
+        handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            handler(ev.BeginPass(pass_id))
+            for e in evaluators:
+                e.start()
+            for batch_id, batch in enumerate(reader()):
+                handler(ev.BeginIteration(pass_id, batch_id))
+                loss, outputs = self.train_batch(batch)
+                for e in evaluators:
+                    e.update({**outputs, **{k: batch[k] for k in batch}})
+                cost = float(loss)
+                if log_period and (batch_id + 1) % log_period == 0:
+                    print(f"pass {pass_id} batch {batch_id + 1} "
+                          f"cost {cost:.6f}", flush=True)
+                handler(ev.EndIteration(pass_id, batch_id, cost))
+            results = {e.name: e.finish() for e in evaluators}
+            if test_reader is not None:
+                results.update(self.test(test_reader, evaluators))
+            if save_dir is not None:
+                self.save(save_dir, pass_id)
+            handler(ev.EndPass(pass_id, results))
+
+    def test(self, reader, evaluators: Sequence[Evaluator] = ()):
+        """One evaluation pass (Tester::testOnePeriod twin)."""
+        for e in evaluators:
+            e.start()
+        losses = []
+        n = 0
+        for batch in reader():
+            batch = self._put(batch)
+            loss, outputs = self._eval_step(self.params, self.net_state,
+                                            batch)
+            losses.append(float(loss))
+            for e in evaluators:
+                e.update({**outputs, **{k: batch[k] for k in batch}})
+            n += 1
+        results = {f"test_{e.name}": e.finish() for e in evaluators}
+        results["test_cost"] = float(np.mean(losses)) if losses else 0.0
+        return results
+
+    # ---- persistence (ParamUtil twin) ----
+
+    def save(self, directory: str, pass_id: int,
+             metadata: Optional[Dict[str, Any]] = None) -> str:
+        trees = {"params": self.params, "net_state": self.net_state,
+                 "opt_state": self.opt_state}
+        if self.avg_state is not None:
+            trees["avg_state"] = self.avg_state
+        meta = {"step": self.step, **(metadata or {})}
+        return ckpt_lib.save(directory, pass_id, trees, meta)
+
+    def restore(self, directory: str, pass_id: Optional[int] = None) -> int:
+        trees, meta = ckpt_lib.load(directory, pass_id)
+        as_jnp = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        self.params = as_jnp(trees["params"])
+        self.net_state = as_jnp(trees.get("net_state", {}))
+        self.opt_state = as_jnp(trees.get("opt_state", ()))
+        if "avg_state" in trees:
+            self.avg_state = as_jnp(trees["avg_state"])
+        if self.mesh is not None:
+            from paddle_tpu.parallel import sharding as sharding_lib
+            self.params = sharding_lib.apply_rules(self.params, self.mesh,
+                                                   self.param_rules)
+            self.net_state = mesh_lib.replicate(self.net_state, self.mesh)
+            self.opt_state = mesh_lib.replicate(self.opt_state, self.mesh)
+        self.step = int(meta["metadata"].get("step", meta.get("step", 0)))
+        if self._train_step is None:
+            self._build_steps()
+        return meta["pass_id"]
+
+    def averaged_params(self):
+        if self.avg_state is None:
+            return self.params
+        return optim_lib.average.averaged_params(self.avg_state, self.params)

@@ -1,0 +1,4 @@
+from paddle_tpu.utils.stat import StatSet, global_stat, timer
+from paddle_tpu.utils import profiler
+
+__all__ = ["StatSet", "global_stat", "timer", "profiler"]

@@ -1,0 +1,37 @@
+"""Device profiling hooks.
+
+Twin of ``hl_profiler_start/end`` (``cuda/include/hl_cuda.h:338-343``, which
+gated nvprof capture): thin wrappers over the JAX/XLA profiler producing
+XPlane traces viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+def start(logdir: str) -> None:
+    jax.profiler.start_trace(logdir)
+
+
+def stop() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    start(logdir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in the device trace (TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,80 @@
+"""Scoped timers with global aggregation.
+
+Twin of the reference's ``REGISTER_TIMER``/``StatSet`` profiling
+(``paddle/utils/Stat.h:63-234``, dumped by
+``globalStat.printSegTimerStatus()``; used by ``--job=time``): named scope
+timers accumulate count/total/max/min into a process-global registry, and
+``print_status()`` dumps the table.  On-device time is covered by the JAX
+profiler (see ``paddle_tpu.utils.profiler``); these timers measure host-side
+phases (data feed, step dispatch, checkpoint IO).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator
+
+
+class _TimerStat:
+    __slots__ = ("count", "total", "max", "min")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+
+class StatSet:
+    def __init__(self, name: str = "global"):
+        self.name = name
+        self._stats: Dict[str, _TimerStat] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._stats.setdefault(name, _TimerStat()).add(dt)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def status(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {"count": s.count, "total_ms": s.total * 1e3,
+                       "avg_ms": s.total / max(s.count, 1) * 1e3,
+                       "max_ms": s.max * 1e3, "min_ms": s.min * 1e3}
+                for name, s in self._stats.items()
+            }
+
+    def print_status(self) -> None:
+        rows = self.status()
+        if not rows:
+            return
+        width = max(len(n) for n in rows)
+        print(f"===== StatSet[{self.name}] =====")
+        print(f"{'name':<{width}}  {'count':>8} {'total(ms)':>12} "
+              f"{'avg(ms)':>10} {'max(ms)':>10} {'min(ms)':>10}")
+        for name, s in sorted(rows.items()):
+            print(f"{name:<{width}}  {s['count']:>8} {s['total_ms']:>12.2f} "
+                  f"{s['avg_ms']:>10.3f} {s['max_ms']:>10.3f} "
+                  f"{s['min_ms']:>10.3f}")
+
+
+global_stat = StatSet()
+timer = global_stat.timer

@@ -5,16 +5,28 @@ Mirrors the reference's GPU-less test strategy (CUDA stubs,
 exercised on a virtual 8-device CPU mesh; real-TPU execution is covered by
 bench.py and the driver's compile checks.
 
-Must run before jax is imported anywhere.
+The session's sitecustomize boots the axon TPU plugin and initializes the
+backend before any user code runs, so setting JAX_PLATFORMS is not enough —
+we must reset the backend registry after import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._clear_backends()
+assert jax.devices()[0].platform == "cpu" and jax.device_count() == 8, (
+    "tests require the 8-device virtual CPU platform, got "
+    f"{jax.devices()}")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,124 @@
+"""Data-layer tests: reader combinators (twin of
+``python/paddle/v2/reader/tests/decorator_test.py``), feeder, datasets."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data import DataFeeder, Dense, Integer, IntSequence, DenseSequence
+from paddle_tpu.data.datasets import mnist, imdb, uci_housing, imikolov
+
+
+def _range_reader(n):
+    return lambda: iter(range(n))
+
+
+def test_map_readers():
+    r = rd.map_readers(lambda a, b: a + b, _range_reader(3), _range_reader(3))
+    assert list(r()) == [0, 2, 4]
+
+
+def test_shuffle_is_permutation():
+    r = rd.shuffle(_range_reader(100), buf_size=32, seed=1)
+    out = list(r())
+    assert sorted(out) == list(range(100))
+    assert out != list(range(100))
+
+
+def test_chain():
+    r = rd.chain(_range_reader(2), _range_reader(3))
+    assert list(r()) == [0, 1, 0, 1, 2]
+
+
+def test_compose():
+    r = rd.compose(_range_reader(3), _range_reader(3))
+    assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+    bad = rd.compose(_range_reader(2), _range_reader(3))
+    with pytest.raises(RuntimeError, match="different lengths"):
+        list(bad())
+
+
+def test_buffered_preserves_order_and_propagates_errors():
+    r = rd.buffered(_range_reader(50), 8)
+    assert list(r()) == list(range(50))
+
+    def failing():
+        yield 1
+        raise ValueError("boom")
+    r = rd.buffered(lambda: failing(), 4)
+    with pytest.raises(ValueError, match="boom"):
+        list(r())
+
+
+def test_firstn():
+    assert list(rd.firstn(_range_reader(100), 5)()) == [0, 1, 2, 3, 4]
+
+
+def test_xmap_ordered():
+    r = rd.xmap_readers(lambda x: x * 2, _range_reader(40), 4, 8, order=True)
+    assert list(r()) == [2 * i for i in range(40)]
+
+
+def test_xmap_unordered_complete():
+    r = rd.xmap_readers(lambda x: x * 2, _range_reader(40), 4, 8, order=False)
+    assert sorted(r()) == [2 * i for i in range(40)]
+
+
+def test_batch():
+    r = rd.batch(_range_reader(10), 3)
+    batches = list(r())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    r = rd.batch(_range_reader(10), 3, drop_last=False)
+    assert list(r())[-1] == [9]
+
+
+def test_feeder_dense_integer():
+    feeder = DataFeeder([Dense((4,)), Integer()], ["x", "y"])
+    batch = [(np.arange(4), 1), (np.arange(4) + 1, 2)]
+    out = feeder(batch)
+    assert out["x"].shape == (2, 4)
+    assert out["x"].dtype == np.float32
+    assert list(out["y"]) == [1, 2]
+
+
+def test_feeder_sequences():
+    feeder = DataFeeder([IntSequence()], ["ids"])
+    out = feeder([([1, 2, 3],), ([4],)])
+    assert out["ids"].shape == (2, 3)
+    assert out["ids_mask"].tolist() == [[True, True, True],
+                                        [True, False, False]]
+    assert out["ids"][1, 0] == 4
+
+    feeder = DataFeeder([DenseSequence(2)], ["x"])
+    out = feeder([(np.ones((3, 2)),), (np.zeros((1, 2)),)])
+    assert out["x"].shape == (2, 3, 2)
+    assert out["x_mask"].sum() == 4
+
+
+def test_feeder_buckets():
+    feeder = DataFeeder([IntSequence(buckets=[8, 16])], ["ids"])
+    out = feeder([([1] * 5,), ([2] * 3,)])
+    assert out["ids"].shape == (2, 8)  # bucketed up to 8
+    out = feeder([([1] * 12,)])
+    assert out["ids"].shape == (1, 16)
+
+
+def test_datasets_deterministic_and_learnable():
+    a = list(rd.firstn(mnist.train(64), 8)())
+    b = list(rd.firstn(mnist.train(64), 8)())
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_allclose(xa, xb)
+        assert ya == yb
+    assert a[0][0].shape == (784,)
+    assert a[0][0].min() >= -1.0 and a[0][0].max() <= 1.0
+
+    seqs = list(rd.firstn(imdb.train(vocab_size=100, n_synthetic=16), 16)())
+    assert all(0 <= s.max() < 100 for s, _ in seqs)
+    assert {lbl for _, lbl in seqs} <= {0, 1}
+
+    x, y = next(uci_housing.train()())
+    assert x.shape == (13,)
+
+    grams = list(rd.firstn(imikolov.train(n=5, vocab_size=50,
+                                          n_tokens=100), 10)())
+    assert all(len(g) == 5 for g in grams)

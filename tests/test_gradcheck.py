@@ -1,0 +1,230 @@
+"""Numeric-vs-analytic gradient checks for layers and losses.
+
+Twin of the reference's ``test_LayerGrad.cpp`` pattern (SURVEY.md §4.2):
+every layer family gets a finite-difference check through a scalar loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import recurrent
+from paddle_tpu.ops import losses, crf, ctc, sequence as seq_ops
+from paddle_tpu.testing import check_grad, check_grad_params
+
+RS = np.random.RandomState(42)
+
+
+def _randn(*shape):
+    return jnp.asarray(RS.randn(*shape), jnp.float32)
+
+
+@pytest.mark.parametrize("layer_fn", [
+    lambda: nn.Linear(5, act="tanh"),
+    lambda: nn.Linear(5, act="sigmoid", bias=False),
+    lambda: nn.Conv2D(4, 3, act="relu"),
+    lambda: nn.LayerNorm(),
+    lambda: nn.Maxout(2),
+    lambda: nn.CrossChannelNorm(),
+])
+def test_layer_param_grads(layer_fn):
+    x4d = any("Conv" in type(layer_fn()).__name__ for _ in [0])
+    x = _randn(2, 6, 6, 4) if x4d else _randn(3, 4)
+    model = nn.transform(lambda x: layer_fn()(x))
+    params, state = model.init(jax.random.key(0), x)
+
+    def loss(p):
+        out, _ = model.apply(p, state, None, x)
+        return jnp.sum(jnp.square(out)) * 0.5
+
+    if jax.tree_util.tree_leaves(params):
+        check_grad_params(loss, params, max_elems_per_leaf=8)
+
+
+def test_linear_input_grad():
+    model = nn.transform(lambda x: nn.Linear(4, act="tanh", name="fc")(x))
+    x = _randn(3, 5)
+    params, state = model.init(jax.random.key(0), x)
+    check_grad(lambda x: jnp.sum(
+        jnp.square(model.apply(params, state, None, x)[0])), x)
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru", "rnn"])
+def test_recurrent_grads(cell):
+    mk = {"lstm": lambda: recurrent.LSTM(4),
+          "gru": lambda: recurrent.GRU(4),
+          "rnn": lambda: recurrent.SimpleRNN(4)}[cell]
+    x = _randn(2, 5, 3)
+    mask = jnp.array([[1, 1, 1, 1, 0], [1, 1, 0, 0, 0]], bool)
+    model = nn.transform(lambda x: mk()(x, mask)[0])
+    params, state = model.init(jax.random.key(0), x)
+
+    def loss(p):
+        out, _ = model.apply(p, state, None, x)
+        return jnp.sum(jnp.square(out))
+
+    check_grad_params(loss, params, max_elems_per_leaf=6, rtol=2e-2)
+
+
+def test_recurrent_mask_semantics():
+    """Masked (padded) steps must not change outputs of valid steps:
+    run same data with/without trailing padding."""
+    lstm = [None]
+
+    def fn(x, mask):
+        if lstm[0] is None:
+            lstm[0] = recurrent.LSTM(4, name="l")
+        return lstm[0](x, mask)
+
+    model = nn.transform(lambda x, m: recurrent.LSTM(4, name="l")(x, m))
+    x_short = _randn(1, 3, 2)
+    pad = jnp.zeros((1, 2, 2))
+    x_long = jnp.concatenate([x_short, pad], axis=1)
+    params, state = model.init(jax.random.key(0), x_long,
+                               jnp.ones((1, 5), bool))
+    out_s, _ = model.apply(params, state, None, x_short, jnp.ones((1, 3), bool))
+    hs_s, (h_s, c_s) = out_s
+    mask_l = jnp.array([[1, 1, 1, 0, 0]], bool)
+    out_l, _ = model.apply(params, state, None, x_long, mask_l)
+    hs_l, (h_l, c_l) = out_l
+    np.testing.assert_allclose(np.asarray(hs_s), np.asarray(hs_l[:, :3]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_s), np.asarray(h_l), rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("loss_name", [
+    "square", "softmax_ce", "sigmoid_ce", "huber", "smooth_l1", "rank"])
+def test_loss_grads(loss_name):
+    b, n = 4, 6
+    logits = _randn(b, n)
+    labels = jnp.asarray(RS.randint(0, n, b))
+    targets = jnp.asarray(RS.rand(b, n), jnp.float32)
+
+    fns = {
+        "square": lambda x: losses.square_error(x, targets).sum(),
+        "softmax_ce": lambda x: losses.softmax_cross_entropy(x, labels).sum(),
+        "sigmoid_ce": lambda x: losses.sigmoid_cross_entropy(x, targets).sum(),
+        "huber": lambda x: losses.huber_regression(x, targets).sum(),
+        "smooth_l1": lambda x: losses.smooth_l1(x, targets).sum(),
+        "rank": lambda x: losses.rank_cost(
+            x[:, 0], x[:, 1], (jnp.arange(b) % 2).astype(jnp.float32)).sum(),
+    }
+    check_grad(fns[loss_name], logits)
+
+
+def test_softmax_ce_matches_composition():
+    logits = _randn(5, 7)
+    labels = jnp.asarray(RS.randint(0, 7, 5))
+    fused = losses.softmax_cross_entropy(logits, labels)
+    composed = losses.cross_entropy(jax.nn.softmax(logits), labels)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(composed),
+                               rtol=1e-5, atol=1e-6)
+
+
+class TestCRF:
+    n_tags = 4
+
+    def _setup(self):
+        b, t, n = 3, 5, self.n_tags
+        em = _randn(b, t, n)
+        tags = jnp.asarray(RS.randint(0, n, (b, t)))
+        mask = seq_ops.lengths_to_mask(jnp.array([5, 3, 1]), t)
+        trans = _randn(n, n) * 0.3
+        start = _randn(n) * 0.3
+        stop = _randn(n) * 0.3
+        return em, tags, mask, trans, start, stop
+
+    def test_normalization(self):
+        """Sum of exp(loglik) over ALL tag paths must be 1 (length-1 seq)."""
+        n = self.n_tags
+        em = _randn(1, 1, n)
+        mask = jnp.ones((1, 1), bool)
+        trans, start, stop = _randn(n, n), _randn(n), _randn(n)
+        total = 0.0
+        for tag in range(n):
+            ll = crf.crf_log_likelihood(
+                em, jnp.array([[tag]]), mask, trans, start, stop)
+            total += float(jnp.exp(ll[0]))
+        assert abs(total - 1.0) < 1e-5
+
+    def test_normalization_len3(self):
+        import itertools
+        n = 3
+        em = _randn(1, 3, n)
+        mask = jnp.ones((1, 3), bool)
+        trans, start, stop = _randn(n, n), _randn(n), _randn(n)
+        total = 0.0
+        for path in itertools.product(range(n), repeat=3):
+            ll = crf.crf_log_likelihood(
+                em, jnp.array([list(path)]), mask, trans, start, stop)
+            total += float(jnp.exp(ll[0]))
+        assert abs(total - 1.0) < 1e-4
+
+    def test_grad(self):
+        em, tags, mask, trans, start, stop = self._setup()
+        check_grad(lambda e: -crf.crf_log_likelihood(
+            e, tags, mask, trans, start, stop).sum(), em, rtol=2e-2)
+        check_grad(lambda tr: -crf.crf_log_likelihood(
+            em, tags, mask, tr, start, stop).sum(), trans, rtol=2e-2)
+
+    def test_viterbi_is_argmax(self):
+        """Viterbi path must beat (or match) every exhaustively-enumerated path."""
+        import itertools
+        n = 3
+        em = _randn(1, 4, n)
+        mask = jnp.ones((1, 4), bool)
+        trans, start, stop = _randn(n, n), _randn(n), _randn(n)
+        tags, score = crf.crf_decode(em, mask, trans, start, stop)
+        best_ll = crf.crf_log_likelihood(em, tags, mask, trans, start, stop)
+        for path in itertools.product(range(n), repeat=4):
+            ll = crf.crf_log_likelihood(
+                em, jnp.array([list(path)]), mask, trans, start, stop)
+            assert float(ll[0]) <= float(best_ll[0]) + 1e-5
+
+
+class TestCTC:
+    def test_vs_brute_force(self):
+        """CTC loss must equal -log sum over all alignments (brute force)."""
+        import itertools
+        b, t, n = 1, 4, 3  # blank=0, labels {1,2}
+        logits = _randn(b, t, n)
+        labels = jnp.array([[1, 2]])
+        ll = jnp.array([2])
+        loss = ctc.ctc_loss(logits, jnp.array([t]), labels, ll)
+        logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))[0]
+
+        def collapse(path):
+            out, prev = [], None
+            for s in path:
+                if s != prev and s != 0:
+                    out.append(s)
+                prev = s
+            return out
+
+        total = -np.inf
+        for path in itertools.product(range(n), repeat=t):
+            if collapse(path) == [1, 2]:
+                lp = sum(logp[i, s] for i, s in enumerate(path))
+                total = np.logaddexp(total, lp)
+        np.testing.assert_allclose(float(loss[0]), -total, rtol=1e-4)
+
+    def test_grad(self):
+        logits = _randn(2, 6, 4)
+        labels = jnp.array([[1, 2], [3, 0]])
+        lab_len = jnp.array([2, 1])
+        log_len = jnp.array([6, 4])
+        check_grad(lambda lg: ctc.ctc_loss(
+            lg, log_len, labels, lab_len).sum(), logits, rtol=2e-2)
+
+    def test_greedy_decode(self):
+        # frames argmax: [1,1,0,2,2,0] -> collapse -> [1,2]
+        t, n = 6, 3
+        logits = jnp.full((1, t, n), -5.0)
+        path = [1, 1, 0, 2, 2, 0]
+        logits = logits.at[0, jnp.arange(t), jnp.array(path)].set(5.0)
+        out, lengths = ctc.ctc_greedy_decode(logits, jnp.array([t]))
+        assert int(lengths[0]) == 2
+        assert list(np.asarray(out[0, :2])) == [1, 2]

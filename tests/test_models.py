@@ -1,0 +1,94 @@
+"""Model zoo smoke tests: shapes + one train step per model family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import optim
+from paddle_tpu.models import lenet, resnet, alexnet, googlenet
+from paddle_tpu.models.lstm_classifier import model_fn_builder as lstm_builder
+from paddle_tpu.training import Trainer
+
+RS = np.random.RandomState(0)
+
+
+def _one_step(model_fn, batch):
+    t = Trainer(model_fn, optim.sgd(0.01))
+    t.init(batch)
+    l0, _ = t.train_batch(batch)
+    l1, _ = t.train_batch(batch)
+    assert np.isfinite(float(l0)) and np.isfinite(float(l1))
+    return float(l0), float(l1)
+
+
+def test_lenet_step():
+    batch = {"image": RS.randn(4, 784).astype(np.float32),
+             "label": RS.randint(0, 10, 4)}
+    _one_step(lenet.model_fn, batch)
+
+
+def test_resnet18_step_cifar_shape():
+    batch = {"image": RS.randn(2, 32, 32, 3).astype(np.float32),
+             "label": RS.randint(0, 10, 2)}
+    l0, l1 = _one_step(resnet.model_fn_builder(18, 10), batch)
+
+
+def test_resnet50_forward_shape():
+    model = nn.transform(
+        lambda x: resnet.ResNet(50, 1000, name="r")(x))
+    x = jnp.zeros((1, 64, 64, 3))
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x, train=False)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # ResNet-50 has ~25.5M params
+    assert 24e6 < n_params < 27e6, n_params
+
+
+def test_alexnet_forward():
+    model = nn.transform(
+        lambda x: alexnet.AlexNet(1000, name="a")(x))
+    x = jnp.zeros((1, 224, 224, 3))
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x, train=False)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # AlexNet ~61M params
+    assert 55e6 < n_params < 66e6, n_params
+
+
+def test_googlenet_forward():
+    model = nn.transform(
+        lambda x: googlenet.GoogleNet(1000, name="g")(x))
+    x = jnp.zeros((1, 224, 224, 3))
+    params, state = model.init(jax.random.key(0), x)
+    out, _ = model.apply(params, state, None, x, train=False)
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    # GoogleNet ~7M params (no aux heads)
+    assert 5e6 < n_params < 9e6, n_params
+
+
+def test_lstm_classifier_learns():
+    from paddle_tpu.data import reader as rd, DataFeeder, IntSequence, Integer
+    from paddle_tpu.data.datasets import imdb
+    vocab = 64
+    feeder = DataFeeder([IntSequence(buckets=[32]), Integer()],
+                        ["ids", "label"])
+    base = rd.batch(imdb.train(vocab_size=vocab, n_synthetic=128,
+                               min_len=8, max_len=32), 32)
+    reader = lambda: (feeder(b) for b in base())
+    t = Trainer(lstm_builder(vocab, embed_dim=16, hidden=32, num_layers=2),
+                optim.adam(0.01))
+    t.init(next(iter(reader())))
+    losses = []
+    for _ in range(3):
+        for b in reader():
+            l, _ = t.train_batch(b)
+            losses.append(float(l))
+    assert losses[-1] < losses[0], losses

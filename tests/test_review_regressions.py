@@ -1,0 +1,97 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.training import checkpoint as ckpt
+
+
+def test_checkpoint_preserves_chained_optimizer_state(tmp_path):
+    """Chained optimizer state ((), {...}) must survive save/load intact —
+    a dropped empty slot silently turns the restored update into ascent."""
+    t = optim.chain(optim.clip_by_global_norm(1.0), optim.l2_decay(1e-4),
+                    optim.momentum(0.1, 0.9))
+    params = {"w": jnp.ones((3,))}
+    state = t.init(params)
+    # accumulate some momentum
+    u, state = t.update({"w": jnp.ones(3)}, state, params, jnp.asarray(0))
+    ckpt.save(str(tmp_path), 0, {"opt": state})
+    trees, _ = ckpt.load(str(tmp_path))
+    restored = trees["opt"]
+    assert isinstance(restored, tuple) and len(restored) == 3
+    assert restored[0] == () and restored[1] == ()
+    np.testing.assert_allclose(np.asarray(restored[2]["v"]["w"]),
+                               np.asarray(state[2]["v"]["w"]))
+    # restored state must drive identical updates
+    u1, _ = t.update({"w": jnp.ones(3)}, state, params, jnp.asarray(1))
+    as_jnp = jax.tree_util.tree_map(jnp.asarray, restored)
+    u2, _ = t.update({"w": jnp.ones(3)}, as_jnp, params, jnp.asarray(1))
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]),
+                               rtol=1e-6)
+
+
+def test_checkpoint_empty_trees(tmp_path):
+    ckpt.save(str(tmp_path), 0, {"state": {}, "opt": ()})
+    trees, _ = ckpt.load(str(tmp_path))
+    assert trees["state"] == {}
+    assert trees["opt"] == ()
+
+
+def test_recordio_oversized_record_noprefetch_not_skipped(tmp_path):
+    from paddle_tpu.io import recordio
+    path = str(tmp_path / "big.rio")
+    records = [b"a" * 10, b"b" * 500000, b"c" * 10]
+    with recordio.Writer(path) as w:
+        for r in records:
+            w.write(r)
+    with recordio.Reader(path, prefetch=0, buf_size=32) as r:
+        assert list(r) == records  # middle record must not be lost
+
+
+def test_synthetic_rng_is_process_stable():
+    """crc32 seeding: same name+seed must give identical streams (the old
+    hash() seeding was salted per process)."""
+    import subprocess, sys
+    code = ("from paddle_tpu.data.datasets import common; "
+            "print(common.synthetic_rng('mnist', 0).randint(0, 1 << 30))")
+    outs = {subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           env={**os.environ, "PYTHONPATH": "/root/repo",
+                                "PYTHONHASHSEED": str(i)}).stdout.strip()
+            for i in (1, 2)}
+    assert len(outs) == 1, outs
+
+
+def test_averaged_params_empty_window_falls_back():
+    from paddle_tpu.optim import average
+    params = {"w": jnp.full((2,), 7.0)}
+    st = average.init(params)
+    out = average.averaged_params(st, params)
+    np.testing.assert_allclose(np.asarray(out["w"]), [7.0, 7.0])
+
+
+def test_nce_loss_uniform_noise_gradcheck():
+    from paddle_tpu.ops.losses import nce_loss
+    from paddle_tpu.testing import check_grad
+    rs = np.random.RandomState(0)
+    b, d, n, k = 3, 4, 10, 5
+    emb = jnp.asarray(rs.randn(b, d), jnp.float32)
+    weights = jnp.asarray(rs.randn(n, d), jnp.float32)
+    bias = jnp.asarray(rs.randn(n), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, n, b))
+    noise = jnp.asarray(rs.randint(0, n, (b, k)))
+    logq = float(np.log(1.0 / n))
+    check_grad(lambda e: nce_loss(e, weights, bias, labels, noise,
+                                  logq, logq).sum(), emb, rtol=2e-2)
+
+
+def test_poly_schedule_has_no_power_param():
+    from paddle_tpu.optim import schedules
+    with pytest.raises(TypeError):
+        schedules.poly(0.1, 0.01, 0.5, power=-0.5)

@@ -1,0 +1,112 @@
+"""Sequence-op tests (twin of sequence layer tests in gserver/tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import sequence as so
+
+
+def _masked_batch():
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 4, 3))
+    mask = jnp.array([[1, 1, 1, 0], [1, 1, 0, 0]], bool)
+    return x, mask
+
+
+def test_lengths_roundtrip():
+    lengths = jnp.array([3, 1, 0])
+    mask = so.lengths_to_mask(lengths, 4)
+    assert mask.shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(so.mask_to_lengths(mask)),
+                                  np.asarray(lengths))
+
+
+def test_sequence_pool_modes():
+    x, mask = _masked_batch()
+    avg = so.sequence_pool(x, mask, "avg")
+    np.testing.assert_allclose(np.asarray(avg[0]),
+                               np.asarray(x[0, :3].mean(0)))
+    np.testing.assert_allclose(np.asarray(avg[1]),
+                               np.asarray(x[1, :2].mean(0)))
+    mx = so.sequence_pool(x, mask, "max")
+    np.testing.assert_allclose(np.asarray(mx[0]), np.asarray(x[0, 2]))
+    last = so.sequence_pool(x, mask, "last")
+    np.testing.assert_allclose(np.asarray(last[0]), np.asarray(x[0, 2]))
+    np.testing.assert_allclose(np.asarray(last[1]), np.asarray(x[1, 1]))
+    first = so.sequence_pool(x, mask, "first")
+    np.testing.assert_allclose(np.asarray(first[1]), np.asarray(x[1, 0]))
+    s = so.sequence_pool(x, mask, "sum")
+    np.testing.assert_allclose(np.asarray(s[1]), np.asarray(x[1, :2].sum(0)))
+
+
+def test_sequence_concat():
+    x1 = jnp.asarray(np.arange(8, dtype=np.float32).reshape(2, 2, 2))
+    m1 = jnp.array([[1, 1], [1, 0]], bool)
+    x2 = jnp.asarray(100 + np.arange(8, dtype=np.float32).reshape(2, 2, 2))
+    m2 = jnp.array([[1, 0], [1, 1]], bool)
+    out, mask = so.sequence_concat(x1, m1, x2, m2)
+    assert out.shape == (2, 4, 2)
+    # row 0: x1[0,:2] then x2[0,:1]
+    np.testing.assert_allclose(np.asarray(out[0, :2]), np.asarray(x1[0, :2]))
+    np.testing.assert_allclose(np.asarray(out[0, 2]), np.asarray(x2[0, 0]))
+    assert list(np.asarray(mask[0])) == [True, True, True, False]
+    # row 1: x1[1,:1] then x2[1,:2]
+    np.testing.assert_allclose(np.asarray(out[1, 0]), np.asarray(x1[1, 0]))
+    np.testing.assert_allclose(np.asarray(out[1, 1:3]), np.asarray(x2[1, :2]))
+
+
+def test_sequence_reverse():
+    x, mask = _masked_batch()
+    rev = so.sequence_reverse(x, mask)
+    np.testing.assert_allclose(np.asarray(rev[0, 0]), np.asarray(x[0, 2]))
+    np.testing.assert_allclose(np.asarray(rev[0, 2]), np.asarray(x[0, 0]))
+    np.testing.assert_allclose(np.asarray(rev[1, 0]), np.asarray(x[1, 1]))
+    # padding stays zero
+    np.testing.assert_allclose(np.asarray(rev[0, 3]), 0.0)
+
+
+def test_sequence_expand():
+    vec = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    mask = jnp.array([[1, 1, 0], [1, 0, 0]], bool)
+    out = so.sequence_expand(vec, mask)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(np.asarray(out[0, 1]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out[0, 2]), [0.0, 0.0])
+
+
+def test_sequence_slice():
+    x, mask = _masked_batch()
+    out, omask = so.sequence_slice(x, mask, jnp.array([1, 0]),
+                                   jnp.array([2, 1]))
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(x[0, 1]))
+    np.testing.assert_allclose(np.asarray(out[0, 1]), np.asarray(x[0, 2]))
+    assert list(np.asarray(omask[0])) == [True, True, False, False]
+    assert list(np.asarray(omask[1])) == [True, False, False, False]
+
+
+def test_kmax_score():
+    scores = jnp.array([[0.1, 0.9, 0.5, 0.7]])
+    mask = jnp.array([[1, 1, 1, 0]], bool)
+    idx = so.kmax_sequence_score(scores, mask, 2)
+    assert list(np.asarray(idx[0])) == [1, 2]  # 0.7 is masked out
+
+
+def test_context_projection():
+    x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(1, 3, 2))
+    mask = jnp.ones((1, 3), bool)
+    out = so.context_projection(x, mask, context_len=3, context_start=-1)
+    assert out.shape == (1, 3, 6)
+    # middle step: [x0, x1, x2]
+    np.testing.assert_allclose(np.asarray(out[0, 1]),
+                               np.asarray(x[0].reshape(-1)))
+    # first step: [0, x0, x1]
+    np.testing.assert_allclose(np.asarray(out[0, 0, :2]), [0.0, 0.0])
+
+
+def test_sequence_softmax():
+    from paddle_tpu.ops.activations import sequence_softmax
+    x = jnp.array([1.0, 2.0, 3.0, 1.0, 1.0])
+    seg = jnp.array([0, 0, 0, 1, 1])
+    out = sequence_softmax(x, seg, num_segments=2)
+    np.testing.assert_allclose(float(out[:3].sum()), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(out[3:].sum()), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(out[3]), 0.5, rtol=1e-6)
