@@ -1,0 +1,148 @@
+"""Transformer language model / encoder.
+
+The reference has no transformer (2017 snapshot) — this is the TPU build's
+flagship long-context model family, the carrier for the parallelism suite:
+
+* tensor parallelism: attention heads + FFN hidden shard over ``tp``
+  (``parallel.sharding.transformer_tp_rules``);
+* sequence parallelism: ``attn_fn=ring_attention(...)`` shards the time axis
+  over ``sp`` (``parallel.ring_attention``);
+* pipeline parallelism: blocks partition into stages
+  (``parallel.pipeline``);
+* expert parallelism: ``moe_experts>0`` replaces the FFN with a top-k MoE
+  sharded over ``ep`` (``parallel.expert``).
+
+Per-block ``jax.checkpoint`` (rematerialisation) trades FLOPs for HBM, the
+TPU twin of the reference keeping only per-frame activations in
+RecurrentGradientMachine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.module import Module, param
+from paddle_tpu.ops import losses
+from paddle_tpu.ops.attention import MultiHeadAttention
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int
+    dim: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    ffn_mult: int = 4
+    max_len: int = 2048
+    causal: bool = True
+    dropout: float = 0.0
+    remat: bool = False
+    moe_experts: int = 0          # 0 = dense FFN
+    moe_top_k: int = 2
+    moe_every: int = 1            # MoE in every k-th block
+    moe_capacity_factor: float = 2.0
+
+
+class FeedForward(Module):
+    def __init__(self, dim: int, hidden: int, act="gelu", name=None):
+        super().__init__(name)
+        self.dim, self.hidden, self.act = dim, hidden, act
+
+    def forward(self, x):
+        x = nn.Linear(self.hidden, act=self.act, name="in",
+                      w_init=init.xavier_uniform())(x)
+        return nn.Linear(self.dim, name="out",
+                         w_init=init.xavier_uniform())(x)
+
+
+class TransformerBlock(Module):
+    """Pre-LN block: LN→MHA→residual, LN→FFN/MoE→residual."""
+
+    def __init__(self, cfg: TransformerConfig, layer_idx: int = 0,
+                 attn_fn=None, name=None):
+        super().__init__(name)
+        self.cfg = cfg
+        self.layer_idx = layer_idx
+        self.attn_fn = attn_fn
+
+    def forward(self, x, mask=None):
+        cfg = self.cfg
+        h = nn.LayerNorm(name="ln_attn")(x)
+        h = MultiHeadAttention(cfg.num_heads, causal=cfg.causal,
+                               attn_fn=self.attn_fn, name="attn")(h, mask=mask)
+        if cfg.dropout:
+            h = nn.Dropout(cfg.dropout, name="drop_attn")(h)
+        x = x + h
+        h = nn.LayerNorm(name="ln_ffn")(x)
+        use_moe = cfg.moe_experts > 0 and (self.layer_idx % cfg.moe_every == 0)
+        if use_moe:
+            from paddle_tpu.parallel.expert import MoEMLP
+            h = MoEMLP(cfg.dim, cfg.dim * cfg.ffn_mult,
+                       num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor,
+                       name="moe")(h)
+        else:
+            h = FeedForward(cfg.dim, cfg.dim * cfg.ffn_mult, name="ffn")(h)
+        if cfg.dropout:
+            h = nn.Dropout(cfg.dropout, name="drop_ffn")(h)
+        return x + h
+
+
+class TransformerLM(Module):
+    """Decoder-only LM (or encoder when ``causal=False``)."""
+
+    def __init__(self, cfg: TransformerConfig, attn_fn=None, name=None):
+        super().__init__(name)
+        self.cfg = cfg
+        self.attn_fn = attn_fn
+
+    def forward(self, ids, mask=None):
+        cfg = self.cfg
+        policy = get_policy()
+        b, t = ids.shape
+        x = nn.Embedding(cfg.vocab_size, cfg.dim, name="embed")(ids)
+        pos = param("pos_embed", (cfg.max_len, cfg.dim), policy.param_dtype,
+                    init.normal(0.02))
+        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, axis=0)[None]
+        for i in range(cfg.num_layers):
+            block = TransformerBlock(cfg, layer_idx=i, attn_fn=self.attn_fn,
+                                     name=f"block_{i}")
+            if cfg.remat:
+                params_free = jax.checkpoint(
+                    lambda xx, mm, _blk=block: _blk(xx, mm))
+                x = params_free(x, mask)
+            else:
+                x = block(x, mask)
+        x = nn.LayerNorm(name="ln_f")(x)
+        w_out = param("w_out", (cfg.dim, cfg.vocab_size), policy.param_dtype,
+                      init.xavier_uniform())
+        logits = jnp.matmul(policy.cast_to_compute(x),
+                            policy.cast_to_compute(w_out))
+        return policy.cast_to_output(logits)
+
+
+def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
+    """Next-token LM loss over ``batch = {"ids", "ids_mask"}``."""
+
+    def model_fn(batch):
+        ids, mask = batch["ids"], batch.get("ids_mask")
+        net = TransformerLM(cfg, attn_fn=attn_fn, name="lm")
+        logits = net(ids, mask)
+        targets = jnp.concatenate(
+            [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
+        per_tok = losses.softmax_cross_entropy(logits, targets)
+        if mask is not None:
+            valid = jnp.concatenate(
+                [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+            loss = jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1)
+        else:
+            loss = per_tok[:, :-1].mean()
+        return loss, {"logits": logits}
+    return model_fn

@@ -138,6 +138,34 @@ def set_state(name: str, value: jax.Array) -> None:
     _tree_set(frame.new_state, path, value)
 
 
+AUX_LOSS_KEY = "__aux_loss__"
+
+
+def add_aux_loss(value) -> None:
+    """Record an auxiliary loss (e.g. MoE load-balance) at the current scope.
+
+    Stored in the state tree under ``__aux_loss__``; the Trainer adds
+    :func:`collect_aux_losses` of the post-apply state to the main loss.
+    """
+    set_state(AUX_LOSS_KEY, jnp.asarray(value, jnp.float32))
+
+
+def collect_aux_losses(state_tree: State):
+    """Sum every ``__aux_loss__`` leaf in a state tree (0.0 if none)."""
+    total = jnp.zeros((), jnp.float32)
+    if not state_tree:
+        return total
+    stack = [state_tree]
+    while stack:
+        node = stack.pop()
+        for k, v in node.items():
+            if isinstance(v, dict):
+                stack.append(v)
+            elif k == AUX_LOSS_KEY:
+                total = total + v
+    return total
+
+
 class Module:
     """Base class for layers.  Subclasses implement ``forward``."""
 

@@ -71,6 +71,32 @@ def softmax(x, axis: int = -1):
     return jax.nn.softmax(x, axis=axis)
 
 
+# Modern additions beyond the reference zoo (transformer/MoE stacks).
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def hard_sigmoid(x):
+    return jax.nn.hard_sigmoid(x)
+
+
 def sequence_softmax(x, segment_ids, num_segments=None):
     """Softmax within each variable-length sequence of a packed batch.
 
@@ -103,6 +129,13 @@ ACTIVATIONS = {
     "sqrt": sqrt_,
     "log": log_,
     "softmax": softmax,
+    "gelu": gelu,
+    "silu": silu,
+    "swish": silu,
+    "elu": elu,
+    "leaky_relu": leaky_relu,
+    "relu6": relu6,
+    "hard_sigmoid": hard_sigmoid,
 }
 
 

@@ -1,0 +1,153 @@
+"""Scaled dot-product / multi-head attention.
+
+The reference predates transformers — its closest machinery is
+``ContextProjection`` + ``DotMulProjection`` mixed layers and the
+RecurrentGradientMachine attention demos (``demo/seqToseq``).  The TPU build
+makes attention a first-class op because it is the flagship long-context
+workload: this module is the single-device form, and
+``paddle_tpu.parallel.ring_attention`` is the sequence-parallel form that
+shards the same math over an ``sp`` mesh axis.
+
+Layout convention: ``[batch, time, heads, head_dim]`` (BTHD) — XLA's
+preferred TPU attention layout (keeps the lane dim = head_dim contiguous for
+the MXU).  Softmax always runs in float32 regardless of the compute policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.module import Module, param
+
+NEG_INF = -1e30
+
+
+def attn_bias(mask: Optional[jax.Array], causal: bool, q_len: int,
+              k_len: int, q_offset=0, k_offset=0) -> Optional[jax.Array]:
+    """Additive [*, q_len, k_len] bias from a padding mask + causality.
+
+    ``q_offset``/``k_offset`` shift the global positions of the local blocks —
+    ring attention passes the block indices so each (q block, kv block) pair
+    sees the right causal triangle.
+    """
+    bias = None
+    if mask is not None:
+        # mask: [batch, k_len] bool, True = valid key.
+        bias = jnp.where(mask[:, None, None, :], 0.0, NEG_INF)
+    if causal:
+        q_pos = q_offset + jnp.arange(q_len)[:, None]
+        k_pos = k_offset + jnp.arange(k_len)[None, :]
+        causal_bias = jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+        causal_bias = causal_bias[None, None, :, :]
+        bias = causal_bias if bias is None else bias + causal_bias
+    return bias
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None,
+                          causal: bool = False,
+                          scale: Optional[float] = None) -> jax.Array:
+    """Attention over BTHD tensors.  ``mask``: [batch, k_len] key validity."""
+    b, tq, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    bias = attn_bias(mask, causal, tq, k.shape[1])
+    if bias is not None:
+        logits = logits + bias
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def blockwise_attn_chunk(q, k, v, bias, carry):
+    """One flash-attention accumulation step over a KV chunk.
+
+    carry = (acc [b,q,h,d] f32, row_max [b,h,q] f32, row_sum [b,h,q] f32).
+    Returns the updated carry.  This is the merge rule ring attention uses as
+    KV blocks rotate past each device.
+    """
+    acc, row_max, row_sum = carry
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    if bias is not None:
+        logits = logits + bias
+    chunk_max = jnp.max(logits, axis=-1)               # [b,h,q]
+    new_max = jnp.maximum(row_max, chunk_max)
+    correction = jnp.exp(row_max - new_max)
+    probs = jnp.exp(logits - new_max[..., None])       # [b,h,q,k]
+    chunk_sum = jnp.sum(probs, axis=-1)
+    new_sum = row_sum * correction + chunk_sum
+    chunk_out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    acc = acc * jnp.swapaxes(correction, 1, 2)[..., None] + \
+        chunk_out.astype(jnp.float32)
+    return acc, new_max, new_sum
+
+
+def blockwise_init_carry(b, q_len, h, d):
+    return (jnp.zeros((b, q_len, h, d), jnp.float32),
+            jnp.full((b, h, q_len), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, q_len), jnp.float32))
+
+
+def blockwise_finalize(carry):
+    acc, _, row_sum = carry
+    return acc / jnp.maximum(jnp.swapaxes(row_sum, 1, 2), 1e-30)[..., None]
+
+
+class MultiHeadAttention(Module):
+    """Multi-head (self- or cross-) attention block.
+
+    ``attn_fn`` lets callers swap the inner attention math — the XLA einsum
+    default, the Pallas flash kernel, or a ring-attention closure bound to an
+    ``sp`` mesh axis — without touching the projections.
+    """
+
+    def __init__(self, num_heads: int, head_dim: Optional[int] = None,
+                 causal: bool = False, attn_fn=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.causal = causal
+        self.attn_fn = attn_fn
+
+    def forward(self, x, kv=None, mask: Optional[jax.Array] = None):
+        policy = get_policy()
+        b, t, dim = x.shape
+        h = self.num_heads
+        hd = self.head_dim or dim // h
+        enforce(hd * h > 0, "bad head configuration")
+        kv = x if kv is None else kv
+
+        def proj(name, src, out_dim):
+            w = param(name, (src.shape[-1], out_dim), policy.param_dtype,
+                      init.xavier_uniform())
+            y = jnp.matmul(policy.cast_to_compute(src),
+                           policy.cast_to_compute(w))
+            return y
+
+        q = proj("w_q", x, h * hd).reshape(b, t, h, hd)
+        k = proj("w_k", kv, h * hd).reshape(b, kv.shape[1], h, hd)
+        v = proj("w_v", kv, h * hd).reshape(b, kv.shape[1], h, hd)
+
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v, mask=mask, causal=self.causal)
+        else:
+            out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+        out = policy.cast_to_output(out).reshape(b, t, h * hd)
+
+        w_o = param("w_o", (h * hd, dim), policy.param_dtype,
+                    init.xavier_uniform())
+        out = jnp.matmul(policy.cast_to_compute(out),
+                         policy.cast_to_compute(w_o))
+        b_o = param("b_o", (dim,), policy.param_dtype, init.zeros)
+        return policy.cast_to_output(out) + b_o

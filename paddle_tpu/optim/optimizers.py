@@ -137,7 +137,7 @@ def adam(lr: LR, beta1: float = 0.9, beta2: float = 0.999,
 
     def update(g, s, p, step):
         eta = _lr_at(lr, step)
-        t = step.astype(jnp.float32) + 1.0
+        t = jnp.asarray(step, jnp.float32) + 1.0
         m = _tm(lambda m, g: beta1 * m + (1 - beta1) * g, s["m"], g)
         v = _tm(lambda v, g: beta2 * v + (1 - beta2) * g * g, s["v"], g)
         correction = jnp.sqrt(1.0 - jnp.power(beta2, t)) \
@@ -156,7 +156,7 @@ def adamax(lr: LR, beta1: float = 0.9, beta2: float = 0.999) -> Transform:
 
     def update(g, s, p, step):
         eta = _lr_at(lr, step)
-        t = step.astype(jnp.float32) + 1.0
+        t = jnp.asarray(step, jnp.float32) + 1.0
         m = _tm(lambda m, g: beta1 * m + (1 - beta1) * g, s["m"], g)
         u = _tm(lambda u, g: jnp.maximum(beta2 * u, jnp.abs(g)), s["u"], g)
         upd = _tm(lambda m, u: -eta / (1.0 - jnp.power(beta1, t))

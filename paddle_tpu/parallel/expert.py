@@ -1,0 +1,125 @@
+"""Mixture-of-Experts with expert parallelism (``ep`` mesh axis).
+
+The reference's closest ancestor is sparse-parameter distribution — rows of
+huge embeddings living on parameter-server shards with per-batch prefetch
+(``SparseRowMatrix.h:204``, ``ParameterServer2.cpp:572``).  The TPU-native
+generalization: expert weights shard over an ``ep`` mesh axis, tokens are
+routed top-k and dispatched with capacity-bounded einsums, and XLA turns the
+token shuffle into all-to-all over ICI.
+
+Static-shape design (GShard-style): capacity ``C = ceil(T * cf * k / E)``
+per expert; overflowing tokens drop (their combine weight is zero), keeping
+every shape compile-time constant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.module import Module, param, add_aux_loss
+from paddle_tpu.ops import activations
+
+
+def top_k_routing(gate_logits: jax.Array, k: int, capacity: int):
+    """Top-k token→expert routing with capacity.
+
+    gate_logits: [T, E].  Returns (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float, aux_loss scalar).
+    """
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)          # [T, k]
+
+    # Load-balancing aux loss (GShard eq.4): E * mean(frac_tokens * mean_prob)
+    top1 = topk_idx[:, 0]
+    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Position of each (token, choice) in its expert's buffer: running count
+    # of prior tokens routed to the same expert, across choices in priority
+    # order (choice 0 of all tokens first — GShard's priority rule).
+    fill = jnp.zeros((e,), jnp.int32)
+    for choice in range(k):
+        idx = topk_idx[:, choice]                            # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)     # [T, E]
+        pos_within = jnp.cumsum(onehot, axis=0) - onehot     # prior same-expert
+        pos = jnp.sum(pos_within * onehot, axis=1) + fill[idx]
+        keep = pos < capacity
+        gate = topk_probs[:, choice] * keep
+        disp_hot = (jax.nn.one_hot(idx, e, dtype=jnp.float32)[..., None] *
+                    jax.nn.one_hot(jnp.where(keep, pos, 0), capacity,
+                                   dtype=jnp.float32)[:, None, :])
+        disp_hot = disp_hot * keep[:, None, None]
+        dispatch = dispatch + disp_hot
+        combine = combine + disp_hot * gate[:, None, None]
+        fill = fill + jnp.sum(onehot, axis=0)
+    return dispatch, combine, aux
+
+
+class MoEMLP(Module):
+    """Top-k routed expert FFN (dispatch/combine einsums, GShard layout).
+
+    Expert weights carry a leading ``[E, ...]`` axis — shard it over ``ep``
+    via ``sharding.moe_ep_rules()`` and XLA inserts the all-to-all.
+    """
+
+    def __init__(self, dim: int, hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 2.0,
+                 act="gelu", aux_loss_weight: float = 0.01,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim, self.hidden = dim, hidden
+        self.num_experts, self.top_k = num_experts, top_k
+        self.capacity_factor = capacity_factor
+        self.act = activations.get(act)
+        self.aux_loss_weight = aux_loss_weight
+
+    def forward(self, x):
+        policy = get_policy()
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        tokens = x.reshape(-1, d)                            # [T, d]
+        t = tokens.shape[0]
+        e, k = self.num_experts, self.top_k
+        capacity = max(1, int(t * self.capacity_factor * k / e))
+
+        w_gate = param("w_gate", (d, e), policy.param_dtype,
+                       init.xavier_uniform())
+        gate_logits = tokens.astype(jnp.float32) @ w_gate.astype(jnp.float32)
+        dispatch, combine, aux = top_k_routing(gate_logits, k, capacity)
+        add_aux_loss(self.aux_loss_weight * aux)
+
+        w_in = param("w_in", (e, d, self.hidden), policy.param_dtype,
+                     init.xavier_uniform(fan_in=d, fan_out=self.hidden))
+        b_in = param("b_in", (e, self.hidden), policy.param_dtype, init.zeros)
+        w_out = param("w_out", (e, self.hidden, d), policy.param_dtype,
+                      init.xavier_uniform(fan_in=self.hidden, fan_out=d))
+        b_out = param("b_out", (e, d), policy.param_dtype, init.zeros)
+
+        ct = policy.cast_to_compute
+        # dispatch: [T,E,C] × tokens [T,d] → expert inputs [E,C,d]
+        expert_in = jnp.einsum("tec,td->ecd", ct(dispatch), ct(tokens))
+        h = jnp.einsum("ecd,edh->ech", expert_in, ct(w_in)) + ct(b_in)[:, None]
+        h = self.act(h)
+        expert_out = jnp.einsum("ech,ehd->ecd", h, ct(w_out)) \
+            + ct(b_out)[:, None]
+        out = jnp.einsum("tec,ecd->td", ct(combine), expert_out)
+        return policy.cast_to_output(out).reshape(orig_shape)
+
+
+def moe_ep_rules(axis: str = "ep"):
+    """Sharding rules putting the expert axis of MoE weights on ``axis``."""
+    from jax.sharding import PartitionSpec as P
+    return (
+        (r"moe/w_in$", P(axis, None, None)),
+        (r"moe/b_in$", P(axis, None)),
+        (r"moe/w_out$", P(axis, None, None)),
+        (r"moe/b_out$", P(axis, None)),
+    )

@@ -1,0 +1,84 @@
+"""Pipeline parallelism over a ``pp`` mesh axis (GPipe-style microbatching).
+
+New capability vs the reference (SURVEY.md §2.4.6 — the reference's
+"pipelining" is only per-parameter update overlap,
+``TrainerInternal.cpp:69-73``).  TPU-idiomatic design: the model's repeated
+trunk is S identical stages whose parameters carry a leading ``[S, ...]``
+axis sharded over ``pp``; inside ``shard_map`` every device runs the same
+tick loop, activations hop stage→stage via ``ppermute`` (one ICI hop per
+tick), and a ``lax.scan`` over ``M + S - 1`` ticks drains M microbatches
+through the pipe.  Reverse-mode AD through the scan+ppermute produces the
+backward pipeline schedule automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(stage_param_trees):
+    """Stack per-stage param trees into one tree with a leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_param_trees)
+
+
+def pipeline_apply(stage_fn: Callable, mesh: Mesh, axis: str = "pp"):
+    """Build ``run(stacked_params, microbatches) -> outputs``.
+
+    ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` (a
+    residual-block trunk).  ``stacked_params`` leaves are ``[S, ...]`` and
+    should be sharded ``P(axis)``; ``microbatches`` is ``[M, mb, ...]``
+    (replicated).  Output is ``[M, mb, ...]`` replicated.
+    """
+    n_stages = mesh.shape[axis]
+    shift = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def run(stacked_params, xs):
+        from paddle_tpu.core.errors import enforce
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            enforce(leaf.shape[0] == n_stages,
+                    "stacked stage axis %d != pp mesh axis size %d",
+                    leaf.shape[0], n_stages)
+
+        def local(params_blk, xs_full):
+            my_params = jax.tree_util.tree_map(lambda a: a[0], params_blk)
+            s = lax.axis_index(axis)
+            m = xs_full.shape[0]
+            ticks = m + n_stages - 1
+
+            state = jnp.zeros_like(xs_full[0])
+            outputs = jnp.zeros_like(xs_full)
+
+            def tick(carry, t):
+                state, outputs = carry
+                x_t = xs_full[jnp.clip(t, 0, m - 1)]
+                inp = jnp.where(s == 0, x_t, state)
+                out = stage_fn(my_params, inp)
+                widx = t - (n_stages - 1)
+                do_write = (s == n_stages - 1) & (widx >= 0)
+                upd = lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.clip(widx, 0, m - 1), 0)
+                outputs = jnp.where(do_write, upd, outputs)
+                state = lax.ppermute(out, axis, shift)
+                return (state, outputs), None
+
+            (_, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(ticks))
+            # Result lives on the last stage; broadcast over the ring.
+            outputs = jnp.where(s == n_stages - 1, outputs, 0)
+            return lax.psum(outputs, axis)
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stacked_params, xs)
+
+    return run

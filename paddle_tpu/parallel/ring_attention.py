@@ -1,0 +1,77 @@
+"""Ring attention: sequence/context parallelism over an ``sp`` mesh axis.
+
+Absent from the reference (its long-sequence story is padding-free batching,
+``SURVEY.md §5``); first-class here because long context shapes the core
+design.  The sequence axis of q/k/v shards over ``sp``; each device holds one
+query block and the KV blocks rotate around the ring via ``ppermute`` (one
+ICI hop per step), merged with flash-attention log-sum-exp accumulation
+(``ops.attention.blockwise_attn_chunk``) so the result is *exactly* softmax
+attention over the full sequence while no device ever materialises more than
+one KV block.
+
+Differentiable end-to-end: reverse-mode AD through ``shard_map``+``ppermute``
++``scan`` yields the reverse ring automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.ops.attention import (
+    attn_bias, blockwise_attn_chunk, blockwise_finalize, blockwise_init_carry)
+
+
+def ring_attention(mesh: Mesh, axis: str = "sp"):
+    """Returns ``attn_fn(q, k, v, mask=None, causal=False)`` for BTHD tensors
+    whose time axis is sharded over ``axis``.  Drop-in for
+    ``MultiHeadAttention(attn_fn=...)``.
+    """
+    n = mesh.shape[axis]
+    fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attn_fn(q, k, v, mask=None, causal=False):
+        has_mask = mask is not None
+
+        def local(q_blk, k_blk, v_blk, mask_blk):
+            # q_blk: [b, t_blk, h, d] — this device's query block.
+            b, t_blk, h, d = q_blk.shape
+            my_idx = lax.axis_index(axis)
+            carry = blockwise_init_carry(b, t_blk, h, d)
+
+            def step(acc, ring_step):
+                carry, kb, vb, mb = acc
+                kv_idx = (my_idx - ring_step) % n
+                bias = attn_bias(mb if has_mask else None, causal,
+                                 t_blk, t_blk, q_offset=my_idx * t_blk,
+                                 k_offset=kv_idx * t_blk)
+                carry = blockwise_attn_chunk(q_blk, kb, vb, bias, carry)
+                kb = lax.ppermute(kb, axis, fwd_perm)
+                vb = lax.ppermute(vb, axis, fwd_perm)
+                if has_mask:
+                    mb = lax.ppermute(mb, axis, fwd_perm)
+                return (carry, kb, vb, mb), None
+
+            (carry, _, _, _), _ = lax.scan(
+                step, (carry, k_blk, v_blk, mask_blk), jnp.arange(n))
+            return blockwise_finalize(carry).astype(q_blk.dtype)
+
+        qkv_spec = P(None, axis, None, None)
+        mask_spec = P(None, axis)
+        if not has_mask:
+            # feed a dummy all-true mask so the shard_map signature is static
+            mask = jnp.ones(q.shape[:2], bool)
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v, mask)
+
+    return attn_fn

@@ -68,3 +68,19 @@ def mlp_tp_rules(axis: str = "mp") -> Rules:
         (r"linear_0/w$", P(None, axis)),
         (r"linear_1/w$", P(axis, None)),
     )
+
+
+def transformer_tp_rules(axis: str = "mp") -> Rules:
+    """Megatron layout for TransformerLM: q/k/v column-split (heads shard),
+    attention output row-split; FFN in column-split, out row-split; embedding
+    and readout vocab-split."""
+    return (
+        (r"attn/w_[qkv]$", P(None, axis)),
+        (r"attn/w_o$", P(axis, None)),
+        (r"ffn/in/w$", P(None, axis)),
+        (r"ffn/in/b$", P(axis)),
+        (r"ffn/out/w$", P(axis, None)),
+        (r"embed/w$", P(axis, None)),
+        # vocab readout only — MoE expert w_out belongs to moe_ep_rules
+        (r"(?<!moe/)w_out$", P(None, axis)),
+    )

@@ -40,12 +40,14 @@ class Trainer:
                  seed: int = 0,
                  mesh=None,
                  param_rules=None,
-                 average_window: int = 0):
+                 average_window: int = 0,
+                 zero_axis: Optional[str] = None):
         self.model = transform(model_fn)
         self.optimizer = optimizer
         self.seed = seed
         self.mesh = mesh
         self.param_rules = param_rules
+        self.zero_axis = zero_axis
         self.average_window = average_window
         self.params = None
         self.net_state = None
@@ -69,6 +71,10 @@ class Trainer:
                                                    self.param_rules)
             self.net_state = mesh_lib.replicate(self.net_state, self.mesh)
         self.opt_state = self.optimizer.init(self.params)
+        if self.mesh is not None and self.zero_axis:
+            from paddle_tpu.parallel import zero as zero_lib
+            self.opt_state = zero_lib.shard_opt_state(
+                self.opt_state, self.mesh, self.zero_axis)
         if self.average_window:
             self.avg_state = optim_lib.average.init(self.params)
         self._build_steps()
@@ -82,6 +88,8 @@ class Trainer:
             def loss_fn(p):
                 (loss, outputs), new_state = model.apply(
                     p, net_state, rng, batch, train=True)
+                from paddle_tpu.nn.module import collect_aux_losses
+                loss = loss + collect_aux_losses(new_state)
                 return loss, (outputs, new_state)
 
             (loss, (outputs, new_state)), grads = jax.value_and_grad(
@@ -193,7 +201,12 @@ class Trainer:
             self.params = sharding_lib.apply_rules(self.params, self.mesh,
                                                    self.param_rules)
             self.net_state = mesh_lib.replicate(self.net_state, self.mesh)
-            self.opt_state = mesh_lib.replicate(self.opt_state, self.mesh)
+            if self.zero_axis:
+                from paddle_tpu.parallel import zero as zero_lib
+                self.opt_state = zero_lib.shard_opt_state(
+                    self.opt_state, self.mesh, self.zero_axis)
+            else:
+                self.opt_state = mesh_lib.replicate(self.opt_state, self.mesh)
         self.step = int(meta["metadata"].get("step", meta.get("step", 0)))
         if self._train_step is None:
             self._build_steps()
