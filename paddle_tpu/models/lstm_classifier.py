@@ -18,7 +18,8 @@ from paddle_tpu.ops import losses, sequence as so
 class StackedLSTMClassifier(nn.Module):
     def __init__(self, vocab_size: int, embed_dim: int = 128,
                  hidden: int = 256, num_layers: int = 2,
-                 num_classes: int = 2, pool: str = "last", name=None):
+                 num_classes: int = 2, pool: str = "last", name=None,
+                 use_pallas=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
@@ -26,11 +27,16 @@ class StackedLSTMClassifier(nn.Module):
         self.num_layers = num_layers
         self.num_classes = num_classes
         self.pool = pool
+        # None = auto-fuse on TPU.  Pass False when the LSTM weights are
+        # tensor-parallel sharded (lstm_tp_rules): GSPMD cannot partition
+        # the Pallas kernel, so the scan path is required under mp.
+        self.use_pallas = use_pallas
 
     def forward(self, ids, mask):
         x = nn.Embedding(self.vocab_size, self.embed_dim, name="embed")(ids)
         for i in range(self.num_layers):
-            x, _ = LSTM(self.hidden, name=f"lstm_{i}")(x, mask)
+            x, _ = LSTM(self.hidden, name=f"lstm_{i}",
+                        use_pallas=self.use_pallas)(x, mask)
         pooled = so.sequence_pool(x, mask, self.pool)
         return nn.Linear(self.num_classes, name="fc")(pooled)
 

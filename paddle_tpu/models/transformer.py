@@ -115,9 +115,7 @@ class TransformerLM(Module):
             block = TransformerBlock(cfg, layer_idx=i, attn_fn=self.attn_fn,
                                      name=f"block_{i}")
             if cfg.remat:
-                params_free = jax.checkpoint(
-                    lambda xx, mm, _blk=block: _blk(xx, mm))
-                x = params_free(x, mask)
+                x = nn.remat(block, x, mask)
             else:
                 x = block(x, mask)
         x = nn.LayerNorm(name="ln_f")(x)

@@ -166,6 +166,83 @@ def collect_aux_losses(state_tree: State):
     return total
 
 
+def _deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _clone_dicts(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Copy every dict node (leaves shared) so merges never alias the
+    caller's tree."""
+    return {k: _clone_dicts(v) if isinstance(v, dict) else v
+            for k, v in tree.items()}
+
+
+def remat(fn: Callable, *args):
+    """``jax.checkpoint`` for stateful module calls.
+
+    Plain ``jax.checkpoint`` cannot wrap a module call directly: ``param()``
+    reads and ``state`` writes would leak checkpoint tracers into the ambient
+    transform frame.  This lifts the frame through the checkpoint boundary —
+    params/state/rng enter as explicit operands of a pure function that runs
+    ``fn`` under a nested frame (same scope and naming counters, so ``init``
+    and the rematerialised ``apply`` agree on parameter names), and state
+    writes flow back out as returns.
+
+    The TPU twin of trading FLOPs for HBM that the reference gets from
+    keeping only per-frame activations in RecurrentGradientMachine
+    (``RecurrentGradientMachine.cpp:293``): activations inside ``fn`` are
+    recomputed during backward instead of stored.
+
+    Usage: ``x = nn.remat(block, x, mask)`` instead of ``x = block(x, mask)``.
+    """
+    if not in_transform():
+        return jax.checkpoint(fn)(*args)
+    frame = current_frame()
+    if frame.mode == "init":
+        # Params are being created; no gradient pass happens at init.
+        return fn(*args)
+
+    rng_key = frame.rng.next() if frame.rng is not None else None
+    scope = list(frame.scope)
+    counters_in = {k: dict(v) for k, v in frame.counters.items()}
+    names_in = dict(frame.module_names)
+    captured: Dict[str, Any] = {}
+
+    def pure(params, st, key, *inner_args):
+        inner = _Frame("apply", params, st,
+                       KeySeq(key) if key is not None else None,
+                       train=frame.train)
+        inner.scope = list(scope)
+        inner.counters = {k: dict(v) for k, v in counters_in.items()}
+        inner.module_names = dict(names_in)
+        _frames().append(inner)
+        try:
+            out = fn(*inner_args)
+        finally:
+            _frames().pop()
+        # Naming side effects are replay-invariant; keep the last trace's.
+        captured["counters"] = inner.counters
+        captured["module_names"] = inner.module_names
+        return out, inner.new_state
+
+    # State written earlier in this apply must be visible inside the
+    # checkpointed segment, exactly as in inline execution.  Dict nodes are
+    # cloned so the merge cannot mutate the caller's state tree.
+    merged_state = _clone_dicts(frame.state)
+    _deep_merge(merged_state, _clone_dicts(frame.new_state))
+    out, new_state = jax.checkpoint(pure)(
+        frame.params, merged_state, rng_key, *args)
+    if captured:
+        frame.counters = captured["counters"]
+        frame.module_names = captured["module_names"]
+    _deep_merge(frame.new_state, new_state)
+    return out
+
+
 class Module:
     """Base class for layers.  Subclasses implement ``forward``."""
 

@@ -27,7 +27,7 @@ from jax import lax
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.nn import initializers as init
 from paddle_tpu.nn.module import Module, param
-from paddle_tpu.ops import activations
+from paddle_tpu.ops import activations, pallas_kernels
 
 
 def _mask_state(new, old, mask_t):
@@ -44,11 +44,20 @@ class LSTM(Module):
     """
 
     def __init__(self, hidden: int, act="tanh", gate_act="sigmoid",
-                 reverse: bool = False, name: Optional[str] = None):
+                 reverse: bool = False, name: Optional[str] = None,
+                 use_pallas: Optional[bool] = None):
         super().__init__(name)
         self.hidden = hidden
         self.act = activations.get(act)
         self.gate_act = activations.get(gate_act)
+        # With the default activations (tanh/sigmoid — the reference's
+        # hl_lstm_ops.cuh config) the recurrence routes through
+        # ops/pallas_kernels.lstm_scan and is always carried in f32 (cell
+        # state precision), on every backend — so numerics never depend on
+        # batch size or backend.  Custom activations use the policy-dtype
+        # scan below.  ``use_pallas`` forces the kernel choice (tests).
+        self._fusable = act == "tanh" and gate_act == "sigmoid"
+        self.use_pallas = use_pallas
         self.reverse = reverse
 
     def forward(self, x, mask=None, initial_state=None):
@@ -81,25 +90,35 @@ class LSTM(Module):
             xw_t = xw_t[::-1]
             mask_t = mask_t[::-1]
 
-        w_h_c = policy.cast_to_compute(w_h)
+        if self._fusable:
+            out_dtype = xw_t.dtype
+            hs, h_last, c_last = pallas_kernels.lstm_scan(
+                xw_t.astype(jnp.float32), w_h.astype(jnp.float32),
+                h0.astype(jnp.float32), c0.astype(jnp.float32), mask_t,
+                use_pallas=self.use_pallas)
+            hs = hs.astype(out_dtype)
+            h_last = h_last.astype(out_dtype)
+            c_last = c_last.astype(out_dtype)
+        else:
+            w_h_c = policy.cast_to_compute(w_h)
 
-        def step(carry, inp):
-            h_prev, c_prev = carry
-            gates_x, m = inp
-            gates = gates_x + policy.cast_to_output(
-                policy.cast_to_compute(h_prev) @ w_h_c)
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            i = self.gate_act(i)
-            f = self.gate_act(f)
-            o = self.gate_act(o)
-            g = self.act(g)
-            c = f * c_prev + i * g
-            hh = o * self.act(c)
-            c = _mask_state(c, c_prev, m)
-            hh = _mask_state(hh, h_prev, m)
-            return (hh, c), hh
+            def step(carry, inp):
+                h_prev, c_prev = carry
+                gates_x, m = inp
+                gates = gates_x + policy.cast_to_output(
+                    policy.cast_to_compute(h_prev) @ w_h_c)
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = self.gate_act(i)
+                f = self.gate_act(f)
+                o = self.gate_act(o)
+                g = self.act(g)
+                c = f * c_prev + i * g
+                hh = o * self.act(c)
+                c = _mask_state(c, c_prev, m)
+                hh = _mask_state(hh, h_prev, m)
+                return (hh, c), hh
 
-        (h_last, c_last), hs = lax.scan(step, (h0, c0), (xw_t, mask_t))
+            (h_last, c_last), hs = lax.scan(step, (h0, c0), (xw_t, mask_t))
         if self.reverse:
             hs = hs[::-1]
         return jnp.swapaxes(hs, 0, 1), (h_last, c_last)

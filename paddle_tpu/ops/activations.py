@@ -90,7 +90,7 @@ def leaky_relu(x, negative_slope: float = 0.01):
 
 
 def relu6(x):
-    return jnp.clip(x, 0.0, 6.0)
+    return jax.nn.relu6(x)
 
 
 def hard_sigmoid(x):

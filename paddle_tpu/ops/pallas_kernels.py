@@ -1,0 +1,389 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+TPU-native twin of the reference's fused CUDA kernels: where the reference
+hand-fuses the per-frame LSTM gate math into one device kernel
+(``paddle/cuda/include/hl_lstm_ops.cuh``, ``hl_cuda_lstm.cu``,
+``hl_recurrent_apply.cuh``) driven by the SequenceToBatch batching scheme
+(``gserver/layers/SequenceToBatch.h:23-46``), we fuse the *entire sequence
+scan* into a single Pallas kernel: the grid walks time, the recurrent
+(h, c) state lives in VMEM scratch across grid steps (never round-tripping
+to HBM), and each step is one MXU matmul ``[b,h] @ [h,4h]`` plus VPU gate
+math.  The backward pass is a second Pallas kernel scanning time in reverse
+with gate recomputation (rematerialisation — trades one matmul for not
+storing gate activations, the same memory/FLOP trade ``jax.checkpoint``
+makes).
+
+The kernels are exposed through :func:`fused_lstm_scan`, a ``custom_vjp``
+drop-in for the ``lax.scan`` LSTM recurrence in
+``paddle_tpu/nn/recurrent.py``.  On non-TPU backends they run in Pallas
+interpret mode, which is how the unit tests cross-check them against the
+``lax.scan`` reference implementation (the CPU↔GPU twin-kernel test pattern
+of ``paddle/math/tests/test_matrixCompare.cpp``, re-targeted).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable everywhere jax is, but guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16MB/core VMEM
+
+
+def pallas_supported(b: int, h: int) -> bool:
+    """Fused kernels need MXU/VPU-friendly shapes and a VMEM-resident
+    working set.
+
+    The backward kernel holds w_h [h,4h], the dW_h accumulator [h,4h], the
+    per-step gate blocks [b,4h]×3 and several [b,h] state blocks in VMEM at
+    once; past ~h=512 the weights alone blow the 16MB/core budget and the
+    XLA scan (which streams w_h from HBM) is the right schedule.
+    """
+    if h % 128 != 0 or b < 8 or b % 8 != 0:
+        return False
+    working_set = (2 * h * 4 * h      # w_h + dW_h accumulator
+                   + 5 * b * 4 * h    # gate blocks (xw, dxw, dgates, ...)
+                   + 10 * b * h) * 4  # h/c state blocks + scratch
+    return working_set <= _VMEM_BUDGET
+
+
+_fusion_enabled = threading.local()
+
+
+def _fusion_on() -> bool:
+    return getattr(_fusion_enabled, "value", True)
+
+
+@contextlib.contextmanager
+def fusion_disabled():
+    """Disable Pallas kernel auto-selection under this context.
+
+    The Trainer enters this while tracing when parameter sharding rules are
+    active: GSPMD cannot partition a pallas_call over a tensor-parallel
+    axis, so sharded runs must take the XLA scan.  (Explicit
+    ``use_pallas=True`` still overrides.)
+    """
+    prev = getattr(_fusion_enabled, "value", True)
+    _fusion_enabled.value = False
+    try:
+        yield
+    finally:
+        _fusion_enabled.value = prev
+
+
+def should_fuse(b: int, h: int) -> bool:
+    """True when the fused Pallas path is the right schedule: on a TPU
+    backend, with kernel-eligible shapes, and not inside a
+    :func:`fusion_disabled` (sharded-params) region."""
+    return _fusion_on() and _on_tpu() and pallas_supported(b, h)
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel: grid over time, (h, c) carried in VMEM scratch.
+# ---------------------------------------------------------------------------
+
+def _make_fwd_kernel(with_cs: bool):
+    """Build the forward kernel; ``with_cs`` adds the cell-state-sequence
+    output needed only as a VJP residual (the inference/primal call skips it
+    to avoid a dead [t,b,h] HBM write)."""
+
+    def kernel(xw_ref, w_h_ref, h0_ref, c0_ref, mask_ref, *rest):
+        if with_cs:
+            hs_ref, cs_ref, h_last_ref, c_last_ref, h_s, c_s = rest
+        else:
+            hs_ref, h_last_ref, c_last_ref, h_s, c_s = rest
+        i = pl.program_id(0)
+        t = pl.num_programs(0)
+        h = h0_ref.shape[1]
+
+        @pl.when(i == 0)
+        def _():
+            h_s[:] = h0_ref[:]
+            c_s[:] = c0_ref[:]
+
+        h_prev = h_s[:]
+        c_prev = c_s[:]
+        gates = xw_ref[0] + jnp.dot(h_prev, w_h_ref[:],
+                                    preferred_element_type=jnp.float32)
+        i_g = _sigmoid(gates[:, :h])
+        f_g = _sigmoid(gates[:, h:2 * h])
+        g_g = jnp.tanh(gates[:, 2 * h:3 * h])
+        o_g = _sigmoid(gates[:, 3 * h:])
+        c_new = f_g * c_prev + i_g * g_g
+        h_new = o_g * jnp.tanh(c_new)
+
+        m = mask_ref[0]
+        c_t = m * c_new + (1.0 - m) * c_prev
+        h_t = m * h_new + (1.0 - m) * h_prev
+
+        hs_ref[0] = h_t
+        if with_cs:
+            cs_ref[0] = c_t
+        h_s[:] = h_t
+        c_s[:] = c_t
+
+        @pl.when(i == t - 1)
+        def _():
+            h_last_ref[:] = h_t
+            c_last_ref[:] = c_t
+
+    return kernel
+
+
+def _lstm_fwd_pallas(xw_t, w_h, h0, c0, mask_t, interpret: bool,
+                     with_cs: bool):
+    t, b, four_h = xw_t.shape
+    h = four_h // 4
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    seq_out = [pl.BlockSpec((1, b, h), lambda i: (i, 0, 0))]
+    seq_shape = [jax.ShapeDtypeStruct((t, b, h), jnp.float32)]
+    if with_cs:
+        seq_out = seq_out * 2
+        seq_shape = seq_shape * 2
+    return pl.pallas_call(
+        _make_fwd_kernel(with_cs),
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((h, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=seq_out + [
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_shape=seq_shape + [
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw_t, w_h, h0, c0, mask_t[:, :, None])
+
+
+# ---------------------------------------------------------------------------
+# Backward kernel: reverse-time grid, gate recomputation, dW_h accumulated
+# in VMEM scratch.
+# ---------------------------------------------------------------------------
+
+def _lstm_bwd_kernel(xw_ref, w_h_ref, h_prev_ref, c_prev_ref, mask_ref,
+                     dhs_ref, dh_last_ref, dc_last_ref,
+                     dxw_ref, dwh_ref, dh0_ref, dc0_ref,
+                     dh_s, dc_s, dwh_s):
+    i = pl.program_id(0)
+    t = pl.num_programs(0)
+    h = h_prev_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _():
+        dh_s[:] = dh_last_ref[:]
+        dc_s[:] = dc_last_ref[:]
+        dwh_s[:] = jnp.zeros_like(dwh_s)
+
+    h_prev = h_prev_ref[0]
+    c_prev = c_prev_ref[0]
+    m = mask_ref[0]
+
+    # Recompute this step's gates (remat: one extra MXU matmul instead of
+    # storing i/f/g/o activations for every step).
+    gates = xw_ref[0] + jnp.dot(h_prev, w_h_ref[:],
+                                preferred_element_type=jnp.float32)
+    i_g = _sigmoid(gates[:, :h])
+    f_g = _sigmoid(gates[:, h:2 * h])
+    g_g = jnp.tanh(gates[:, 2 * h:3 * h])
+    o_g = _sigmoid(gates[:, 3 * h:])
+    c_new = f_g * c_prev + i_g * g_g
+    tanh_c = jnp.tanh(c_new)
+
+    dh = dh_s[:] + dhs_ref[0]
+    dc = dc_s[:]
+
+    do = dh * tanh_c * m
+    dc_new = dh * o_g * (1.0 - tanh_c * tanh_c) * m + dc * m
+    di = dc_new * g_g
+    df = dc_new * c_prev
+    dg = dc_new * i_g
+
+    dgi = di * i_g * (1.0 - i_g)
+    dgf = df * f_g * (1.0 - f_g)
+    dgg = dg * (1.0 - g_g * g_g)
+    dgo = do * o_g * (1.0 - o_g)
+    dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=-1)
+
+    dxw_ref[0] = dgates
+    # dh_prev via W_h^T: contract the 4h axis of both operands.
+    dh_prev = lax.dot_general(
+        dgates, w_h_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + (1.0 - m) * dh
+    dc_prev = dc_new * f_g + (1.0 - m) * dc
+    # dW_h += h_prev^T @ dgates (contract the batch axis).
+    dwh_s[:] += lax.dot_general(
+        h_prev, dgates, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    dh_s[:] = dh_prev
+    dc_s[:] = dc_prev
+
+    @pl.when(i == t - 1)
+    def _():
+        dh0_ref[:] = dh_prev
+        dc0_ref[:] = dc_prev
+        dwh_ref[:] = dwh_s[:]
+
+
+def _lstm_bwd_pallas(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
+                     dhs, dh_last, dc_last, interpret: bool):
+    t, b, four_h = xw_t.shape
+    h = four_h // 4
+    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    dxw_r, dwh, dh0, dc0 = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_h), rev),
+            pl.BlockSpec((h, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((1, b, 1), rev),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, four_h), rev),
+            pl.BlockSpec((h, four_h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, four_h), jnp.float32),
+            jax.ShapeDtypeStruct((h, four_h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((h, four_h), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t[:, :, None], dhs,
+      dh_last, dc_last)
+    return dxw_r, dwh, dh0, dc0
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper — drop-in for the lax.scan recurrence.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_lstm_scan(xw_t, w_h, h0, c0, mask_t, interpret: bool = False):
+    """Fused LSTM recurrence over precomputed input projections.
+
+    Args:
+      xw_t:   [time, batch, 4*hidden] f32 — x @ W_x + bias per step,
+              gate order (input, forget, cell, output) as in the reference
+              (``hl_lstm_ops.cuh`` active/state layout).
+      w_h:    [hidden, 4*hidden] f32 recurrent weights.
+      h0/c0:  [batch, hidden] f32 initial state.
+      mask_t: [time, batch] f32 validity mask (padding steps carry state).
+      interpret: run the Pallas kernels in interpret mode (tests/CPU).
+
+    Returns: (hs [time, batch, hidden], h_last, c_last).
+    """
+    hs, h_last, c_last = _lstm_fwd_pallas(
+        xw_t, w_h, h0, c0, mask_t, interpret, with_cs=False)
+    return hs, h_last, c_last
+
+
+def _fused_fwd(xw_t, w_h, h0, c0, mask_t, interpret):
+    hs, cs, h_last, c_last = _lstm_fwd_pallas(
+        xw_t, w_h, h0, c0, mask_t, interpret, with_cs=True)
+    return (hs, h_last, c_last), (xw_t, w_h, h0, c0, mask_t, hs, cs)
+
+
+def _fused_bwd(interpret, res, grads):
+    xw_t, w_h, h0, c0, mask_t, hs, cs = res
+    dhs, dh_last, dc_last = grads
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    dxw, dwh, dh0, dc0 = _lstm_bwd_pallas(
+        xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
+        dhs, dh_last, dc_last, interpret)
+    return dxw, dwh, dh0, dc0, None
+
+
+fused_lstm_scan.defvjp(_fused_fwd, _fused_bwd)
+
+
+def lstm_scan(xw_t, w_h, h0, c0, mask_t,
+              use_pallas: Optional[bool] = None
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """LSTM recurrence: Pallas-fused on TPU, ``lax.scan`` elsewhere.
+
+    All inputs/outputs f32 (the dtype policy casts around this op).
+    ``mask_t`` may be bool or float.
+    """
+    t, b, four_h = xw_t.shape
+    h = four_h // 4
+    if use_pallas is None:
+        use_pallas = should_fuse(b, h)
+    mask_f = mask_t.astype(jnp.float32)
+    if use_pallas:
+        return fused_lstm_scan(xw_t, w_h, h0, c0, mask_f,
+                               not _on_tpu())
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        gates_x, m = inp
+        gates = gates_x + h_prev @ w_h
+        i_g = _sigmoid(gates[:, :h])
+        f_g = _sigmoid(gates[:, h:2 * h])
+        g_g = jnp.tanh(gates[:, 2 * h:3 * h])
+        o_g = _sigmoid(gates[:, 3 * h:])
+        c = f_g * c_prev + i_g * g_g
+        hh = o_g * jnp.tanh(c)
+        mm = m[:, None]
+        c = mm * c + (1.0 - mm) * c_prev
+        hh = mm * hh + (1.0 - mm) * h_prev
+        return (hh, c), hh
+
+    (h_last, c_last), hs = lax.scan(step, (h0, c0), (xw_t, mask_f))
+    return hs, h_last, c_last

@@ -14,6 +14,7 @@ every shape compile-time constant.
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -88,7 +89,7 @@ class MoEMLP(Module):
         tokens = x.reshape(-1, d)                            # [T, d]
         t = tokens.shape[0]
         e, k = self.num_experts, self.top_k
-        capacity = max(1, int(t * self.capacity_factor * k / e))
+        capacity = max(1, math.ceil(t * self.capacity_factor * k / e))
 
         w_gate = param("w_gate", (d, e), policy.param_dtype,
                        init.xavier_uniform())

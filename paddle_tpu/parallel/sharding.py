@@ -52,7 +52,11 @@ def shardings_like(params, mesh: Mesh, rules: Optional[Rules]):
 
 def lstm_tp_rules(axis: str = "mp") -> Rules:
     """Tensor-parallel layout for the LSTM stack: gate projections shard on
-    the 4h output dim, embeddings on vocab rows, the readout on classes."""
+    the 4h output dim, embeddings on vocab rows, the readout on classes.
+
+    Under these rules construct the LSTM layers with ``use_pallas=False``:
+    GSPMD cannot partition the fused Pallas recurrence over ``axis``, so the
+    XLA scan (which shards cleanly) is the right schedule."""
     return (
         (r"lstm_\d+/w_x$", P(None, axis)),
         (r"lstm_\d+/w_h$", P(None, axis)),

@@ -81,13 +81,24 @@ class Trainer:
 
     def _build_steps(self):
         model, optimizer = self.model, self.optimizer
+        # Sharded params cannot flow through Pallas kernels (GSPMD cannot
+        # partition a pallas_call), so rule-sharded runs trace with kernel
+        # fusion disabled — the mechanism-level twin of picking the XLA
+        # scan schedule under tensor parallelism.
+        if self.param_rules is not None:
+            from paddle_tpu.ops.pallas_kernels import fusion_disabled
+            fusion_ctx = fusion_disabled
+        else:
+            import contextlib
+            fusion_ctx = contextlib.nullcontext
 
         def train_step(params, net_state, opt_state, batch, step):
             rng = jax.random.fold_in(jax.random.key(self.seed), step)
 
             def loss_fn(p):
-                (loss, outputs), new_state = model.apply(
-                    p, net_state, rng, batch, train=True)
+                with fusion_ctx():
+                    (loss, outputs), new_state = model.apply(
+                        p, net_state, rng, batch, train=True)
                 from paddle_tpu.nn.module import collect_aux_losses
                 loss = loss + collect_aux_losses(new_state)
                 return loss, (outputs, new_state)
@@ -100,8 +111,9 @@ class Trainer:
             return new_params, new_state, new_opt, loss, outputs
 
         def eval_step(params, net_state, batch):
-            (loss, outputs), _ = model.apply(params, net_state, None, batch,
-                                             train=False)
+            with fusion_ctx():
+                (loss, outputs), _ = model.apply(params, net_state, None,
+                                                 batch, train=False)
             return loss, outputs
 
         donate = (0, 2)  # params, opt_state buffers are dead after the step

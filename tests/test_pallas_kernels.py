@@ -1,0 +1,98 @@
+"""Pallas fused-kernel cross-checks (interpret mode on the CPU platform).
+
+The twin-kernel test pattern of the reference (same op on CpuMatrix and
+GpuMatrix compared within tolerance, ``math/tests/test_matrixCompare.cpp``):
+here the Pallas kernel (interpret mode) is checked against the ``lax.scan``
+reference recurrence, forward and backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import pallas_kernels as pk
+
+
+def _inputs(rs, t=6, b=8, h=128):
+    xw = jnp.asarray(rs.randn(t, b, 4 * h), jnp.float32) * 0.1
+    wh = jnp.asarray(rs.randn(h, 4 * h), jnp.float32) * 0.1
+    h0 = jnp.asarray(rs.randn(b, h), jnp.float32) * 0.1
+    c0 = jnp.asarray(rs.randn(b, h), jnp.float32) * 0.1
+    mask = (rs.rand(t, b) > 0.3).astype(np.float32)
+    mask[0] = 1.0
+    return xw, wh, h0, c0, jnp.asarray(mask)
+
+
+def test_fused_lstm_forward_matches_scan(rng):
+    args = _inputs(rng)
+    ref = pk.lstm_scan(*args, use_pallas=False)
+    pal = pk.lstm_scan(*args, use_pallas=True)
+    for r, p in zip(ref, pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_fused_lstm_grad_matches_scan(rng):
+    xw, wh, h0, c0, mask = _inputs(rng, t=5, b=8, h=128)
+
+    def loss(use_pallas):
+        def f(xw, wh, h0, c0):
+            hs, hl, cl = pk.lstm_scan(xw, wh, h0, c0, mask,
+                                      use_pallas=use_pallas)
+            return (jnp.sum(jnp.sin(hs)) + jnp.sum(hl * cl))
+        return f
+
+    g_ref = jax.grad(loss(False), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    g_pal = jax.grad(loss(True), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    for r, p in zip(g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_lstm_mask_carries_state(rng):
+    # A fully-masked tail must leave (h, c) untouched — the padding-free
+    # semantics of the reference's sequenceStartPositions batching.
+    xw, wh, h0, c0, _ = _inputs(rng, t=6, b=8, h=128)
+    mask = np.ones((6, 8), np.float32)
+    mask[3:] = 0.0
+    hs, h_last, c_last = pk.lstm_scan(xw, wh, h0, c0, jnp.asarray(mask),
+                                      use_pallas=True)
+    np.testing.assert_allclose(np.asarray(hs[2]), np.asarray(h_last),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hs[3]), np.asarray(hs[5]),
+                               rtol=1e-6)
+
+
+def test_pallas_supported_gate():
+    assert pk.pallas_supported(8, 128)
+    assert not pk.pallas_supported(8, 100)
+    assert not pk.pallas_supported(3, 128)
+
+
+def test_lstm_layer_fused_matches_scan(rng):
+    # Layer-level wiring: same params, fused (interpret) vs scan recurrence.
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.recurrent import LSTM
+
+    x = jnp.asarray(rng.randn(8, 6, 32), jnp.float32)
+    mask = jnp.asarray(rng.rand(8, 6) > 0.3)
+
+    def run(use_pallas):
+        m = nn.transform(lambda x, mk: LSTM(
+            128, name="l", use_pallas=use_pallas)(x, mk))
+        params, _ = m.init(jax.random.key(0), x, mask)
+        (hs, (hl, cl)), _ = m.apply(params, {}, None, x, mask)
+        return params, hs, hl, cl
+
+    p1, hs1, hl1, cl1 = run(False)
+    p2, hs2, hl2, cl2 = run(True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), p1, p2)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hl1), np.asarray(hl2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cl1), np.asarray(cl2),
+                               rtol=1e-5, atol=1e-5)
