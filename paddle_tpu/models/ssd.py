@@ -1,0 +1,106 @@
+"""SSD object detector (twin of the reference's SSD stack:
+``PriorBoxLayer.cpp`` + ``MultiBoxLossLayer.cpp`` + ``DetectionOutputLayer.cpp``
+wired as in the Pascal-VOC SSD config the detection layers were built for).
+
+A compact multi-scale detector: conv backbone → K feature maps → per-map
+(loc, conf) conv heads → concatenated predictions over all priors.
+Anchors come from :func:`paddle_tpu.ops.detection.prior_boxes` (host-side,
+static); loss is :func:`multibox_loss`; inference decodes with
+:func:`detection_output`.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import detection
+
+
+class ConvBlock(nn.Module):
+    def __init__(self, ch: int, n: int = 2, name=None):
+        super().__init__(name)
+        self.ch = ch
+        self.n = n
+
+    def forward(self, x):
+        for i in range(self.n):
+            x = nn.Conv2D(self.ch, 3, act="relu", name=f"conv_{i}")(x)
+        return nn.Pool2D(2, name="pool")(x)
+
+
+class SSD(nn.Module):
+    """Single-shot detector over ``image_size``² inputs.
+
+    ``num_classes`` includes background (class 0).
+    """
+
+    def __init__(self, num_classes: int, image_size: int = 128,
+                 base_channels: int = 32, num_scales: int = 3,
+                 aspect_ratios: Sequence[float] = (2.0,), name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.base_channels = base_channels
+        self.num_scales = num_scales
+        self.aspect_ratios = aspect_ratios
+        # priors per cell: 1 (min) + 1 (sqrt(min*max)) + 2*len(ars)
+        self.priors_per_cell = 2 + 2 * len(aspect_ratios)
+
+    def feature_hw(self) -> List[Tuple[int, int]]:
+        hw = self.image_size // 4  # two stride-2 pools in the stem
+        out = []
+        for _ in range(self.num_scales):
+            hw //= 2
+            out.append((hw, hw))
+        return out
+
+    def priors(self) -> np.ndarray:
+        """Static anchor set for all scales, [P, 4] numpy."""
+        img = (self.image_size, self.image_size)
+        all_boxes = []
+        for k, fhw in enumerate(self.feature_hw()):
+            scale = self.image_size * (0.2 + 0.6 * k / max(
+                1, self.num_scales - 1))
+            nxt = self.image_size * (0.2 + 0.6 * (k + 1) / max(
+                1, self.num_scales - 1))
+            all_boxes.append(detection.prior_boxes(
+                fhw, img, min_sizes=[scale], max_sizes=[nxt],
+                aspect_ratios=self.aspect_ratios))
+        return np.concatenate(all_boxes, axis=0)
+
+    def forward(self, images):
+        x = ConvBlock(self.base_channels, name="stem_0")(images)
+        x = ConvBlock(self.base_channels * 2, name="stem_1")(x)
+        locs, confs = [], []
+        for k in range(self.num_scales):
+            x = ConvBlock(self.base_channels * 4, n=1, name=f"scale_{k}")(x)
+            loc = nn.Conv2D(self.priors_per_cell * 4, 3,
+                            name=f"loc_{k}")(x)
+            conf = nn.Conv2D(self.priors_per_cell * self.num_classes, 3,
+                             name=f"conf_{k}")(x)
+            b = loc.shape[0]
+            locs.append(loc.reshape(b, -1, 4))
+            confs.append(conf.reshape(b, -1, self.num_classes))
+        return jnp.concatenate(locs, 1), jnp.concatenate(confs, 1)
+
+
+def model_fn_builder(num_classes: int, image_size: int = 128, **kwargs):
+    """Training model_fn: batch = {image, gt_boxes, gt_labels, gt_mask}."""
+    net_holder = {}
+
+    def model_fn(batch):
+        net = SSD(num_classes, image_size, name="ssd", **kwargs)
+        net_holder["net"] = net
+        loc, conf = net(batch["image"])
+        priors = jnp.asarray(net.priors())
+        loss = detection.multibox_loss(
+            loc, conf, priors, batch["gt_boxes"], batch["gt_labels"],
+            batch["gt_mask"])
+        return loss, {"loc": loc, "conf": conf}
+
+    return model_fn

@@ -1,15 +1,27 @@
 from paddle_tpu.nn.module import (Module, Transformed, transform, param, state,
                                   set_state, is_training, next_rng_key,
-                                  flatten_names, unflatten_names)
+                                  flatten_names, unflatten_names, remat)
 from paddle_tpu.nn import initializers
 from paddle_tpu.nn.layers import (Linear, Embedding, Conv2D, Pool2D,
                                   GlobalPool2D, BatchNorm, LayerNorm, Dropout,
                                   Maxout, CrossChannelNorm, Sequential)
+from paddle_tpu.nn.layers_extra import (
+    Conv2DTranspose, Conv3D, Pool3D, SpatialPyramidPool, RowConv, BlockExpand,
+    BilinearInterp, Interpolation, Crop, Pad, Rotate, SwitchOrder,
+    FeatureMapExpand, Multiplex, SelectiveFC, DataNorm, SumToOneNorm, Scaling,
+    SlopeIntercept, Addto, DotMulProjection, ScalingProjection,
+    IdentityProjection, TransposedFullMatrixProjection, Mixed)
 
 __all__ = [
     "Module", "Transformed", "transform", "param", "state", "set_state",
     "is_training", "next_rng_key", "flatten_names", "unflatten_names",
-    "initializers", "Linear", "Embedding", "Conv2D", "Pool2D", "GlobalPool2D",
-    "BatchNorm", "LayerNorm", "Dropout", "Maxout", "CrossChannelNorm",
-    "Sequential",
+    "remat", "initializers", "Linear", "Embedding", "Conv2D", "Pool2D",
+    "GlobalPool2D", "BatchNorm", "LayerNorm", "Dropout", "Maxout",
+    "CrossChannelNorm", "Sequential",
+    "Conv2DTranspose", "Conv3D", "Pool3D", "SpatialPyramidPool", "RowConv",
+    "BlockExpand", "BilinearInterp", "Interpolation", "Crop", "Pad", "Rotate",
+    "SwitchOrder", "FeatureMapExpand", "Multiplex", "SelectiveFC", "DataNorm",
+    "SumToOneNorm", "Scaling", "SlopeIntercept", "Addto", "DotMulProjection",
+    "ScalingProjection", "IdentityProjection",
+    "TransposedFullMatrixProjection", "Mixed",
 ]

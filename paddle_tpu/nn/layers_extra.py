@@ -1,0 +1,511 @@
+"""Extended layer zoo — the rest of the reference's 92 registered layers.
+
+TPU-native twins of the remaining ``paddle/gserver/layers/*`` families
+(SURVEY.md §2.2): transposed/3-D convolution, spatial-pyramid pooling,
+row (lookahead) convolution, block-expand (im2col-as-layer), interpolation
+and bilinear upsampling, crop/pad/rotate/switch-order, feature-map expand,
+multiplex, selective FC, data normalization, and the MixedLayer
+projection/operator family (``MixedLayer.{h,cpp}``, ``Projection.h``,
+``Operator.h``).
+
+Everything is a thin composition of jnp/lax ops: XLA fuses what the
+reference hand-wrote as CUDA kernels (``hl_cnn.h``: ``hl_maxout_forward``,
+``hl_expand_feature`` etc.), and convolution variants lower straight onto
+the MXU without im2col.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.core.errors import enforce, enforce_in
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.layers import IntOrPair, _pair
+from paddle_tpu.nn.module import Module, param, next_rng_key
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]), int(v[2]))
+    return (int(v),) * 3
+
+
+class Conv2DTranspose(Module):
+    """Transposed (fractionally-strided) conv, NHWC/HWIO — twin of the
+    backward-as-forward conv layers (ExpandConvTransLayer,
+    ``gserver/layers/ConvTransBaseLayer.h``)."""
+
+    def __init__(self, channels: int, kernel: IntOrPair,
+                 stride: IntOrPair = 1, padding: Union[str, IntOrPair] = "SAME",
+                 act="linear", bias: bool = True, w_init=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.channels = channels
+        self.kernel = _pair(kernel)
+        self.stride = _pair(stride)
+        if isinstance(padding, str):
+            self.padding = padding.upper()
+        else:
+            p = _pair(padding)
+            self.padding = [(p[0], p[0]), (p[1], p[1])]
+        self.act = activations.get(act)
+        self.bias = bias
+        self.w_init = w_init or init.he_normal()
+
+    def forward(self, x):
+        policy = get_policy()
+        in_ch = x.shape[-1]
+        kshape = (*self.kernel, in_ch, self.channels)
+        w = param("w", kshape, policy.param_dtype, self.w_init)
+        y = lax.conv_transpose(
+            policy.cast_to_compute(x), policy.cast_to_compute(w),
+            strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = policy.cast_to_output(y)
+        if self.bias:
+            b = param("b", (self.channels,), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)
+
+
+class Conv3D(Module):
+    """3-D convolution, NDHWC/DHWIO (twin of Conv3DLayer.cpp)."""
+
+    def __init__(self, channels: int, kernel, stride=1,
+                 padding: Union[str, Sequence[int]] = "SAME", act="linear",
+                 bias: bool = True, w_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.channels = channels
+        self.kernel = _triple(kernel)
+        self.stride = _triple(stride)
+        if isinstance(padding, str):
+            self.padding = padding.upper()
+        else:
+            p = _triple(padding)
+            self.padding = [(pi, pi) for pi in p]
+        self.act = activations.get(act)
+        self.bias = bias
+        self.w_init = w_init or init.he_normal()
+
+    def forward(self, x):
+        policy = get_policy()
+        in_ch = x.shape[-1]
+        kshape = (*self.kernel, in_ch, self.channels)
+        w = param("w", kshape, policy.param_dtype, self.w_init)
+        y = lax.conv_general_dilated(
+            policy.cast_to_compute(x), policy.cast_to_compute(w),
+            window_strides=self.stride, padding=self.padding,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        y = policy.cast_to_output(y)
+        if self.bias:
+            b = param("b", (self.channels,), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)
+
+
+class Pool3D(Module):
+    """3-D max/avg pooling over NDHWC (twin of Pool3DLayer.cpp)."""
+
+    def __init__(self, kernel, stride=None, pool_type: str = "max",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        enforce_in(pool_type, ("max", "avg"))
+        self.kernel = _triple(kernel)
+        self.stride = _triple(stride) if stride is not None else self.kernel
+        self.pool_type = pool_type
+
+    def forward(self, x):
+        window = (1, *self.kernel, 1)
+        strides = (1, *self.stride, 1)
+        if self.pool_type == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                     "VALID")
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, "VALID")
+        return summed / (self.kernel[0] * self.kernel[1] * self.kernel[2])
+
+
+def _adaptive_pool2d(x, bins: int, pool_type: str):
+    """Adaptive pooling to a bins×bins grid: output cell (i, j) reduces the
+    input window [floor(i*h/bins), ceil((i+1)*h/bins)) × (same for w) —
+    every window is non-empty and windows tile the valid region exactly, so
+    no padding values ever enter the reduction (the reference's ceil-mode
+    pooling semantics).  Bin edges are static Python ints; the loop unrolls
+    into ``2*bins`` static slices XLA fuses."""
+    _, h, w, _ = x.shape
+
+    def edges(size):
+        return [((i * size) // bins, -(-((i + 1) * size) // bins))
+                for i in range(bins)]
+
+    red = jnp.max if pool_type == "max" else jnp.mean
+    rows = jnp.stack([red(x[:, s:e], axis=1) for s, e in edges(h)], axis=1)
+    cols = jnp.stack([red(rows[:, :, s:e], axis=2) for s, e in edges(w)],
+                     axis=2)
+    return cols  # [n, bins, bins, c]
+
+
+class SpatialPyramidPool(Module):
+    """SPP layer (twin of SpatialPyramidPoolLayer.cpp): pools the feature
+    map at ``levels`` pyramid scales (1x1, 2x2, 4x4, ...) and concatenates
+    the flattened bins — output size is input-size independent."""
+
+    def __init__(self, levels: int = 3, pool_type: str = "max",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        enforce_in(pool_type, ("max", "avg"))
+        self.levels = levels
+        self.pool_type = pool_type
+
+    def forward(self, x):
+        n = x.shape[0]
+        outs = []
+        for lvl in range(self.levels):
+            pooled = _adaptive_pool2d(x, 2 ** lvl, self.pool_type)
+            outs.append(pooled.reshape(n, -1))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class RowConv(Module):
+    """Row (lookahead) convolution over [batch, time, dim] — twin of
+    RowConvLayer / ``paddle/function/RowConvOp.cpp``: each timestep mixes
+    the next ``future_steps`` frames with a per-dim learned window
+    (DeepSpeech2-style streaming context)."""
+
+    def __init__(self, future_steps: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.future_steps = future_steps
+
+    def forward(self, x):
+        policy = get_policy()
+        d = x.shape[-1]
+        k = self.future_steps + 1
+        w = param("w", (k, d), policy.param_dtype, init.paddle_default())
+        # depthwise 1-D conv looking forward: pad the time axis on the right.
+        xp = jnp.pad(x, ((0, 0), (0, self.future_steps), (0, 0)))
+        y = jnp.zeros_like(x)
+        for i in range(k):  # k is small and static; XLA unrolls+fuses.
+            y = y + xp[:, i:i + x.shape[1], :] * w[i]
+        return y
+
+
+class BlockExpand(Module):
+    """im2col as a layer (twin of BlockExpandLayer.cpp): cuts NHWC feature
+    maps into (block_h × block_w) patches and returns [batch, n_blocks,
+    block_h*block_w*c] — the sequence form used by OCR/CTC pipelines."""
+
+    def __init__(self, block: IntOrPair, stride: IntOrPair,
+                 padding: IntOrPair = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.block = _pair(block)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+
+    def forward(self, x):
+        n, hh, ww, c = x.shape
+        ph, pw = self.padding
+        xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        bh, bw = self.block
+        patches = lax.conv_general_dilated_patches(
+            jnp.moveaxis(xp, -1, 1), (bh, bw), self.stride, "VALID")
+        # [n, c*bh*bw, oh, ow] -> [n, oh*ow, bh*bw*c]
+        n_, cb, oh, ow = patches.shape
+        return jnp.moveaxis(patches.reshape(n_, cb, oh * ow), 1, 2)
+
+
+class BilinearInterp(Module):
+    """Bilinear upsampling to a fixed output size (twin of
+    BilinearInterpLayer.cpp / ``hl_cnn.h`` bilinear kernels)."""
+
+    def __init__(self, out_h: int, out_w: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.out_h = out_h
+        self.out_w = out_w
+
+    def forward(self, x):
+        n, hh, ww, c = x.shape
+        return jax.image.resize(x, (n, self.out_h, self.out_w, c),
+                                method="bilinear")
+
+
+class Interpolation(Module):
+    """Learned-free lerp of two inputs by a per-sample weight (twin of
+    InterpolationLayer.cpp): ``out = w*x + (1-w)*y``."""
+
+    def forward(self, w, x, y):
+        w = w.reshape(w.shape[0], *([1] * (x.ndim - 1)))
+        return w * x + (1.0 - w) * y
+
+
+class Crop(Module):
+    """Static crop of NHWC maps (twin of CropLayer / crop_op)."""
+
+    def __init__(self, offsets: Sequence[int], shape: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.offsets = tuple(offsets)
+        self.shape = tuple(shape)
+
+    def forward(self, x):
+        starts = (0,) + self.offsets + (0,)
+        sizes = (x.shape[0],) + self.shape + (x.shape[-1],)
+        return lax.dynamic_slice(x, starts, sizes)
+
+
+class Pad(Module):
+    """Zero-pad NHWC maps (twin of PadLayer / pad_op)."""
+
+    def __init__(self, pad_h: Tuple[int, int], pad_w: Tuple[int, int],
+                 pad_c: Tuple[int, int] = (0, 0), name: Optional[str] = None):
+        super().__init__(name)
+        self.pads = ((0, 0), tuple(pad_h), tuple(pad_w), tuple(pad_c))
+
+    def forward(self, x):
+        return jnp.pad(x, self.pads)
+
+
+class Rotate(Module):
+    """90° CCW rotation of the spatial dims (twin of RotateLayer.cpp)."""
+
+    def forward(self, x):
+        return jnp.rot90(x, k=1, axes=(1, 2))
+
+
+class SwitchOrder(Module):
+    """Axis permutation (twin of SwitchOrderLayer / transpose_op)."""
+
+    def __init__(self, perm: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.perm = tuple(perm)
+
+    def forward(self, x):
+        return jnp.transpose(x, self.perm)
+
+
+class FeatureMapExpand(Module):
+    """Broadcast a [batch, dim] vector across ``num_filters`` feature maps
+    (twin of FeatureMapExpandLayer.cpp)."""
+
+    def __init__(self, num_filters: int, as_row: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.num_filters = num_filters
+        self.as_row = as_row
+
+    def forward(self, x):
+        if self.as_row:
+            return jnp.repeat(x[:, None, :], self.num_filters, axis=1)
+        return jnp.repeat(x[:, :, None], self.num_filters, axis=2)
+
+
+class Multiplex(Module):
+    """Row-wise select among K inputs by index (twin of MultiplexLayer.cpp)."""
+
+    def forward(self, index, *inputs):
+        stacked = jnp.stack(inputs, axis=0)          # [K, batch, ...]
+        return jnp.take_along_axis(
+            stacked, index.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+            axis=0)[0]
+
+
+class SelectiveFC(Module):
+    """Fully-connected layer that only computes selected output columns
+    (twin of SelectiveFullyConnectedLayer.cpp, used for large-vocab softmax
+    shortlists).  ``sel`` is [batch, k] int32 column ids; TPU-style this is
+    a gather of weight columns + a batched matmul — dense, static-shape,
+    MXU-friendly."""
+
+    def __init__(self, size: int, act="linear",
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.size = size
+        self.act = activations.get(act)
+        self.bias = bias
+
+    def forward(self, x, sel=None):
+        policy = get_policy()
+        in_dim = x.shape[-1]
+        w = param("w", (in_dim, self.size), policy.param_dtype,
+                  init.paddle_default(fan_in_axis=0))
+        b = (param("b", (self.size,), policy.param_dtype, init.zeros)
+             if self.bias else None)
+        if sel is None:
+            y = policy.cast_to_output(
+                policy.cast_to_compute(x) @ policy.cast_to_compute(w))
+            if b is not None:
+                y = y + b
+            return self.act(y)
+        w_sel = jnp.take(w, sel, axis=1)             # [in, batch, k]
+        w_sel = jnp.moveaxis(w_sel, 1, 0)            # [batch, in, k]
+        y = jnp.einsum("bi,bik->bk", policy.cast_to_compute(x),
+                       policy.cast_to_compute(w_sel))
+        y = policy.cast_to_output(y)
+        if b is not None:
+            y = y + jnp.take(b, sel)
+        return self.act(y)
+
+
+class DataNorm(Module):
+    """Input feature normalization from precomputed dataset statistics
+    (twin of DataNormLayer.cpp: z-score / min-max / decimal-scaling)."""
+
+    def __init__(self, mean, std=None, min_=None, max_=None,
+                 strategy: str = "z-score", name: Optional[str] = None):
+        super().__init__(name)
+        enforce_in(strategy, ("z-score", "min-max", "decimal-scaling"))
+        self.strategy = strategy
+        self.mean = jnp.asarray(mean)
+        self.std = None if std is None else jnp.asarray(std)
+        self.min = None if min_ is None else jnp.asarray(min_)
+        self.max = None if max_ is None else jnp.asarray(max_)
+
+    def forward(self, x):
+        if self.strategy == "z-score":
+            enforce(self.std is not None, "z-score needs std")
+            return (x - self.mean) / (self.std + 1e-8)
+        if self.strategy == "min-max":
+            enforce(self.min is not None and self.max is not None,
+                    "min-max needs min_/max_")
+            return (x - self.min) / (self.max - self.min + 1e-8)
+        enforce(self.max is not None, "decimal-scaling needs max_")
+        digits = jnp.ceil(jnp.log10(jnp.maximum(jnp.abs(self.max), 1e-8)))
+        return x / jnp.power(10.0, digits)
+
+
+class SumToOneNorm(Module):
+    """Row-normalize to sum 1 (twin of SumToOneNormLayer.cpp)."""
+
+    def forward(self, x):
+        return x / (jnp.sum(x, axis=-1, keepdims=True) + 1e-12)
+
+
+class Scaling(Module):
+    """Scale each row of y by scalar x (twin of ScalingLayer.cpp)."""
+
+    def forward(self, scale, y):
+        return scale.reshape(-1, *([1] * (y.ndim - 1))) * y
+
+
+class SlopeIntercept(Module):
+    """``out = slope * x + intercept`` (twin of SlopeInterceptLayer.cpp)."""
+
+    def __init__(self, slope: float = 1.0, intercept: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.slope = slope
+        self.intercept = intercept
+
+    def forward(self, x):
+        return self.slope * x + self.intercept
+
+
+class Addto(Module):
+    """Sum of inputs + optional bias, then activation (twin of
+    AddtoLayer.cpp)."""
+
+    def __init__(self, act="linear", bias: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.act = activations.get(act)
+        self.bias = bias
+
+    def forward(self, *inputs):
+        policy = get_policy()
+        y = inputs[0]
+        for v in inputs[1:]:
+            y = y + v
+        if self.bias:
+            b = param("b", (y.shape[-1],), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)
+
+
+# ---------------------------------------------------------------------------
+# MixedLayer projection/operator family.
+# ---------------------------------------------------------------------------
+
+class DotMulProjection(Module):
+    """Learned elementwise scale (twin of DotMulProjection)."""
+
+    def forward(self, x):
+        policy = get_policy()
+        w = param("w", (x.shape[-1],), policy.param_dtype, init.ones)
+        return x * w
+
+
+class ScalingProjection(Module):
+    """Single learned scalar multiplier (twin of ScalingProjection)."""
+
+    def forward(self, x):
+        policy = get_policy()
+        w = param("w", (1,), policy.param_dtype, init.ones)
+        return x * w[0]
+
+
+class IdentityProjection(Module):
+    """Pass-through, optionally offset into the output (twin of
+    IdentityProjection / IdentityOffsetProjection)."""
+
+    def __init__(self, offset: int = 0, size: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.offset = offset
+        self.size = size
+
+    def forward(self, x):
+        if self.size is None:
+            return x
+        pad_right = self.size - self.offset - x.shape[-1]
+        enforce(pad_right >= 0, "identity projection overflows output")
+        return jnp.pad(x, ((0, 0),) * (x.ndim - 1)
+                       + ((self.offset, pad_right),))
+
+
+class TransposedFullMatrixProjection(Module):
+    """x @ W^T (twin of TransposedFullMatrixProjection)."""
+
+    def __init__(self, size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+
+    def forward(self, x):
+        policy = get_policy()
+        w = param("w", (self.size, x.shape[-1]), policy.param_dtype,
+                  init.paddle_default(fan_in_axis=1))
+        return policy.cast_to_output(
+            policy.cast_to_compute(x) @ policy.cast_to_compute(w).T)
+
+
+class Mixed(Module):
+    """Sum of projection outputs + bias + activation (twin of
+    MixedLayer.cpp): ``Mixed([proj1, proj2], act="relu")(x1, x2)``."""
+
+    def __init__(self, projections: Sequence[Module], act="linear",
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.projections = list(projections)
+        self.act = activations.get(act)
+        self.bias = bias
+
+    def forward(self, *inputs):
+        policy = get_policy()
+        enforce(len(inputs) == len(self.projections),
+                "Mixed: %d inputs for %d projections", len(inputs),
+                len(self.projections))
+        y = None
+        for proj, x in zip(self.projections, inputs):
+            out = proj(x)
+            y = out if y is None else y + out
+        if self.bias:
+            b = param("b", (y.shape[-1],), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)

@@ -1,0 +1,318 @@
+"""SSD-style detection ops.
+
+TPU-native twins of the reference's detection stack
+(``gserver/layers/PriorBox.cpp``, ``MultiBoxLossLayer.cpp``,
+``DetectionOutputLayer.cpp``, ``DetectionUtil.cpp``): anchor generation,
+encode/decode between boxes and regression targets, bipartite-ish target
+matching, hard-negative mining, and class-wise NMS.
+
+Everything is static-shape and batched: matching is an argmax over the
+[priors, gt] IoU matrix (padded gt boxes masked out), hard-negative mining
+is a top-k over negative confidences (the reference sorts loss values,
+``MultiBoxLossLayer.cpp``), and NMS keeps a fixed ``keep_top_k`` with a
+validity mask instead of dynamic-size outputs — the XLA-friendly forms of
+the same algorithms.
+
+Boxes are ``[xmin, ymin, xmax, ymax]`` normalized to [0, 1].
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import losses
+
+
+# ---------------------------------------------------------------------------
+# Anchors (PriorBox)
+# ---------------------------------------------------------------------------
+
+def prior_boxes(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
+                min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
+                aspect_ratios: Sequence[float] = (2.0,),
+                flip: bool = True, clip: bool = True) -> np.ndarray:
+    """Anchor grid for one feature map (twin of PriorBoxLayer.cpp).
+
+    Per cell: one box per min_size, one sqrt(min*max) box per max_size, and
+    one per aspect ratio (+reciprocal when ``flip``).  Returns
+    [H*W*num_priors, 4] float32 — host-side numpy, computed once per model.
+    """
+    fh, fw = feature_hw
+    ih, iw = image_hw
+    ratios = [1.0]
+    for ar in aspect_ratios:
+        ratios.append(ar)
+        if flip:
+            ratios.append(1.0 / ar)
+    out = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + 0.5) / fw
+            cy = (y + 0.5) / fh
+            for k, ms in enumerate(min_sizes):
+                w, h = ms / iw, ms / ih
+                out.append([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2])
+                if k < len(max_sizes):
+                    s = math.sqrt(ms * max_sizes[k])
+                    w, h = s / iw, s / ih
+                    out.append([cx - w / 2, cy - h / 2,
+                                cx + w / 2, cy + h / 2])
+                for ar in ratios[1:]:
+                    w = ms / iw * math.sqrt(ar)
+                    h = ms / ih / math.sqrt(ar)
+                    out.append([cx - w / 2, cy - h / 2,
+                                cx + w / 2, cy + h / 2])
+    boxes = np.asarray(out, np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# ---------------------------------------------------------------------------
+# Box arithmetic
+# ---------------------------------------------------------------------------
+
+def box_iou(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pairwise IoU: a [N,4], b [M,4] -> [N,M] (DetectionUtil jaccard twin)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0.0) * jnp.clip(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0.0) * jnp.clip(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+_VAR = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)  # SSD encode variances
+
+
+def encode_boxes(gt: jax.Array, priors: jax.Array) -> jax.Array:
+    """Encode gt boxes against priors as (dcx, dcy, dw, dh) regression
+    targets with SSD variances (DetectionUtil encodeBBox twin)."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    g_wh = jnp.clip(gt[..., 2:] - gt[..., :2], 1e-6)
+    g_c = (gt[..., :2] + gt[..., 2:]) / 2
+    d_c = (g_c - p_c) / (p_wh * _VAR[:2])
+    d_wh = jnp.log(g_wh / p_wh) / _VAR[2:]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jax.Array, priors: jax.Array) -> jax.Array:
+    """Inverse of :func:`encode_boxes` (decodeBBox twin)."""
+    p_wh = priors[:, 2:] - priors[:, :2]
+    p_c = (priors[:, :2] + priors[:, 2:]) / 2
+    c = loc[..., :2] * _VAR[:2] * p_wh + p_c
+    wh = jnp.exp(loc[..., 2:] * _VAR[2:]) * p_wh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Target assignment + MultiBox loss
+# ---------------------------------------------------------------------------
+
+def match_priors(priors: jax.Array, gt_boxes: jax.Array, gt_mask: jax.Array,
+                 threshold: float = 0.5):
+    """Match each prior to a gt box (matchBBox twin).
+
+    gt_boxes [G,4] padded, gt_mask [G] bool.  Returns (matched_idx [P],
+    pos_mask [P]): argmax-IoU match, with every gt's best prior forced
+    positive (the reference's bipartite step).
+    """
+    iou = box_iou(priors, gt_boxes)
+    iou = jnp.where(gt_mask[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)                       # [P]
+    best_gt_iou = jnp.max(iou, axis=1)
+    pos = best_gt_iou >= threshold
+    # Force-match: each valid gt claims its best prior.
+    p = priors.shape[0]
+    # Route masked gts to index P: JAX drops out-of-bounds scatters, so
+    # padded entries can never clobber a real gt's force-match.
+    best_prior = jnp.where(gt_mask, jnp.argmax(iou, axis=0), p)   # [G]
+    force = jnp.zeros((p,), bool)
+    force = force.at[best_prior].set(True, mode="drop")
+    forced_gt = jnp.zeros((p,), jnp.int32)
+    forced_gt = forced_gt.at[best_prior].set(
+        jnp.arange(gt_boxes.shape[0]), mode="drop")
+    matched = jnp.where(force, forced_gt, best_gt)
+    return matched, pos | force
+
+
+def multibox_loss(loc_pred: jax.Array, conf_logits: jax.Array,
+                  priors: jax.Array, gt_boxes: jax.Array,
+                  gt_labels: jax.Array, gt_mask: jax.Array,
+                  neg_pos_ratio: float = 3.0,
+                  threshold: float = 0.5) -> jax.Array:
+    """SSD MultiBox loss, batched (MultiBoxLossLayer.cpp twin).
+
+    loc_pred [B,P,4], conf_logits [B,P,C] (class 0 = background),
+    gt_boxes [B,G,4], gt_labels [B,G] (1..C-1), gt_mask [B,G].
+    Smooth-L1 on positives + softmax CE with hard-negative mining at
+    ``neg_pos_ratio``.  Returns scalar loss (sum / num_pos).
+    """
+    def one(loc_p, conf_l, gtb, gtl, gtm):
+        matched, pos = match_priors(priors, gtb, gtm, threshold)
+        target_box = jnp.take(gtb, matched, axis=0)
+        loc_t = encode_boxes(target_box, priors)
+        loc_loss = jnp.sum(
+            losses.smooth_l1(loc_p, loc_t) * pos[:, None].astype(jnp.float32))
+
+        labels = jnp.where(pos, jnp.take(gtl, matched), 0)
+        ce = losses.softmax_cross_entropy(conf_l, labels)    # [P]
+        num_pos = jnp.sum(pos)
+        num_neg = jnp.minimum(
+            (neg_pos_ratio * num_pos).astype(jnp.int32),
+            jnp.asarray(pos.shape[0], jnp.int32))
+        # Hard negative mining: top-k CE among negatives.
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        sorted_neg = jnp.sort(neg_ce)[::-1]
+        kth = sorted_neg[jnp.clip(num_neg - 1, 0)]
+        neg = (~pos) & (ce >= kth) & (num_neg > 0)
+        conf_loss = jnp.sum(ce * (pos | neg).astype(jnp.float32))
+        return loc_loss + conf_loss, num_pos
+
+    per, npos = jax.vmap(one)(loc_pred, conf_logits, gt_boxes, gt_labels,
+                              gt_mask)
+    total_pos = jnp.maximum(jnp.sum(npos), 1)
+    return jnp.sum(per) / total_pos.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# DetectionOutput (decode + class-wise NMS), static shapes
+# ---------------------------------------------------------------------------
+
+def nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+        keep_top_k: int) -> Tuple[jax.Array, jax.Array]:
+    """Greedy NMS with a static keep count (applyNMSFast twin).
+
+    Returns (indices [keep_top_k], valid [keep_top_k] bool).
+    """
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+
+    def body(carry, _):
+        active, = carry
+        masked = jnp.where(active, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        suppress = iou[best] > iou_threshold
+        active = active & ~suppress & (jnp.arange(n) != best)
+        return (active,), (best, valid)
+
+    (_,), (idx, ok) = jax.lax.scan(body, (jnp.ones((n,), bool),),
+                                   None, length=keep_top_k)
+    return idx, ok
+
+
+def detection_output(loc_pred: jax.Array, conf_logits: jax.Array,
+                     priors: jax.Array, score_threshold: float = 0.01,
+                     iou_threshold: float = 0.45, keep_top_k: int = 100):
+    """Decode + per-class NMS for one image (DetectionOutputLayer twin).
+
+    Returns (boxes [C-1, keep, 4], scores [C-1, keep], valid [C-1, keep]):
+    static-shape per-class detections; class 0 (background) excluded.
+    """
+    decoded = decode_boxes(loc_pred, priors)               # [P,4]
+    probs = jax.nn.softmax(conf_logits, axis=-1)           # [P,C]
+
+    def per_class(c_scores):
+        s = jnp.where(c_scores > score_threshold, c_scores, -jnp.inf)
+        idx, ok = nms(decoded, s, iou_threshold, keep_top_k)
+        return (jnp.take(decoded, idx, axis=0),
+                jnp.where(ok, jnp.take(c_scores, idx), 0.0), ok)
+
+    boxes, scores, valid = jax.vmap(per_class)(
+        jnp.moveaxis(probs[:, 1:], -1, 0))
+    return boxes, scores, valid
+
+
+# ---------------------------------------------------------------------------
+# mAP (host-side metric, DetectionMAPEvaluator twin)
+# ---------------------------------------------------------------------------
+
+def _np_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pairwise IoU in numpy (host-side metrics path)."""
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (np.clip(a[:, 2] - a[:, 0], 0, None)
+              * np.clip(a[:, 3] - a[:, 1], 0, None))
+    area_b = (np.clip(b[:, 2] - b[:, 0], 0, None)
+              * np.clip(b[:, 3] - b[:, 1], 0, None))
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def average_precision(tp: np.ndarray, fp: np.ndarray, num_gt: int,
+                      mode: str = "11point") -> float:
+    """AP from a score-sorted tp/fp sequence (11-point or integral)."""
+    if num_gt == 0 or tp.size == 0:
+        return 0.0
+    ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+    recall = ctp / num_gt
+    precision = ctp / np.maximum(ctp + cfp, 1e-9)
+    if mode == "11point":
+        ap = 0.0
+        for r in np.linspace(0, 1, 11):
+            p = precision[recall >= r]
+            ap += (p.max() if p.size else 0.0) / 11.0
+        return float(ap)
+    # integral (VOC2010-style)
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(mpre.size - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    changed = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[changed + 1] - mrec[changed])
+                        * mpre[changed + 1]))
+
+
+def detection_map(detections: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+                  ground_truths: List[Tuple[np.ndarray, np.ndarray]],
+                  num_classes: int, iou_threshold: float = 0.5,
+                  mode: str = "11point") -> float:
+    """Mean AP over classes 1..num_classes-1.
+
+    ``detections[i]`` = (boxes [N,4], scores [N], labels [N]) for image i;
+    ``ground_truths[i]`` = (boxes [G,4], labels [G]).
+    """
+    aps = []
+    for cls in range(1, num_classes):
+        rows = []   # (score, tp, fp)
+        num_gt = 0
+        for (dboxes, dscores, dlabels), (gboxes, glabels) in zip(
+                detections, ground_truths):
+            gsel = gboxes[glabels == cls]
+            num_gt += len(gsel)
+            dsel = dlabels == cls
+            db, ds = dboxes[dsel], dscores[dsel]
+            order = np.argsort(-ds)
+            db, ds = db[order], ds[order]
+            taken = np.zeros(len(gsel), bool)
+            if len(gsel) and len(db):
+                iou_mat = _np_iou(db, gsel)          # [N, G], one shot
+            for n_i, (box, score) in enumerate(zip(db, ds)):
+                if len(gsel) == 0:
+                    rows.append((score, 0, 1))
+                    continue
+                ious = iou_mat[n_i]
+                j = int(np.argmax(ious))
+                if ious[j] >= iou_threshold and not taken[j]:
+                    taken[j] = True
+                    rows.append((score, 1, 0))
+                else:
+                    rows.append((score, 0, 1))
+        if num_gt == 0:
+            continue
+        rows.sort(key=lambda r: -r[0])
+        tp = np.asarray([r[1] for r in rows], np.float64)
+        fp = np.asarray([r[2] for r in rows], np.float64)
+        aps.append(average_precision(tp, fp, num_gt, mode))
+    return float(np.mean(aps)) if aps else 0.0

@@ -219,3 +219,170 @@ def iob_decode(tags):
     if start is not None:
         chunks.add((start, len(tags), ctype))
     return chunks
+
+
+class ColumnSum(Evaluator):
+    """Per-column sums of an output matrix (twin of ColumnSumEvaluator,
+    ``Evaluator.cpp:225``)."""
+
+    def __init__(self, key: str, name: Optional[str] = None):
+        self.key = key
+        self.name = name or f"column_sum({key})"
+
+    def start(self):
+        self.total = None
+
+    def update(self, outputs):
+        v = np.asarray(outputs[self.key], np.float64)
+        v = v.reshape(-1, v.shape[-1])
+        s = v.sum(axis=0)
+        self.total = s if self.total is None else self.total + s
+
+    def finish(self):
+        return 0.0 if self.total is None else self.total
+
+
+class CTCError(Evaluator):
+    """Sequence edit-distance rate (twin of CTCErrorEvaluator.cpp):
+    sum(editdist(pred, label)) / sum(len(label)) over greedy-decoded,
+    blank/dup-collapsed predictions."""
+
+    def __init__(self, pred_key: str = "decoded", label_key: str = "label",
+                 pred_len_key: Optional[str] = None,
+                 label_len_key: Optional[str] = None, name: str = "ctc_error"):
+        self.pred_key = pred_key
+        self.label_key = label_key
+        self.pred_len_key = pred_len_key
+        self.label_len_key = label_len_key
+        self.name = name
+
+    @staticmethod
+    def _edit_distance(a, b):
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[-1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+    def start(self):
+        self.dist = 0.0
+        self.len = 0.0
+
+    def update(self, outputs):
+        preds = np.asarray(outputs[self.pred_key])
+        labels = np.asarray(outputs[self.label_key])
+        plens = (np.asarray(outputs[self.pred_len_key])
+                 if self.pred_len_key else
+                 np.full(preds.shape[0], preds.shape[1]))
+        llens = (np.asarray(outputs[self.label_len_key])
+                 if self.label_len_key else
+                 np.full(labels.shape[0], labels.shape[1]))
+        for p, l, pl, ll in zip(preds, labels, plens, llens):
+            self.dist += self._edit_distance(list(p[:int(pl)]),
+                                             list(l[:int(ll)]))
+            self.len += float(ll)
+
+    def finish(self):
+        return self.dist / max(self.len, 1.0)
+
+
+class PnPair(Evaluator):
+    """Positive/negative pair ordering within query groups (twin of
+    PnpairEvaluator, ``Evaluator.cpp``): over all pairs in a query with
+    different labels, the fraction where the higher-labelled one scored
+    higher.  Reports pos/neg ratio like the reference."""
+
+    def __init__(self, score_key: str = "score", label_key: str = "label",
+                 query_key: str = "query_id", name: str = "pnpair"):
+        self.score_key = score_key
+        self.label_key = label_key
+        self.query_key = query_key
+        self.name = name
+
+    def start(self):
+        self.rows = []
+
+    def update(self, outputs):
+        score = np.asarray(outputs[self.score_key]).reshape(-1)
+        label = np.asarray(outputs[self.label_key]).reshape(-1)
+        query = np.asarray(outputs[self.query_key]).reshape(-1)
+        self.rows.append((query, label, score))
+
+    def finish(self):
+        if not self.rows:
+            return 0.0
+        query = np.concatenate([r[0] for r in self.rows])
+        label = np.concatenate([r[1] for r in self.rows])
+        score = np.concatenate([r[2] for r in self.rows])
+        pos = neg = 0.0
+        for q in np.unique(query):
+            sel = query == q
+            l, s = label[sel], score[sel]
+            dl = l[:, None] - l[None, :]
+            ds = s[:, None] - s[None, :]
+            upper = np.triu(np.ones_like(dl, bool), 1)
+            pairs = upper & (dl != 0)
+            good = np.sign(dl) == np.sign(ds)
+            tie = (ds == 0) & pairs
+            pos += float((pairs & good & ~tie).sum()) + 0.5 * float(tie.sum())
+            neg += float((pairs & ~good & ~tie).sum()) + 0.5 * float(tie.sum())
+        return pos / max(neg, 1e-8)
+
+
+class ValuePrinter(Evaluator):
+    """Debug printer (twin of ValuePrinter/GradientPrinter,
+    ``Evaluator.cpp:1009-1046``): logs summary stats of chosen outputs."""
+
+    def __init__(self, keys, log_fn=print, name: str = "printer"):
+        self.keys = list(keys)
+        self.log_fn = log_fn
+        self.name = name
+
+    def start(self):
+        self.batches = 0
+
+    def update(self, outputs):
+        self.batches += 1
+        for k in self.keys:
+            if k in outputs:
+                v = np.asarray(outputs[k])
+                self.log_fn(f"[{self.name}] batch {self.batches} {k}: "
+                            f"shape={v.shape} absmax={np.abs(v).max():.6g} "
+                            f"mean={v.mean():.6g}")
+
+    def finish(self):
+        return float(self.batches)
+
+
+class DetectionMAP(Evaluator):
+    """Detection mean-AP (twin of DetectionMAPEvaluator.cpp), fed with
+    per-image decoded detections and ground truths."""
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 mode: str = "11point", name: str = "detection_map"):
+        self.num_classes = num_classes
+        self.iou_threshold = iou_threshold
+        self.mode = mode
+        self.name = name
+
+    def start(self):
+        self.dets = []
+        self.gts = []
+
+    def update(self, outputs):
+        """Expects per-image lists: ``det_boxes``/``det_scores``/
+        ``det_labels`` and ``gt_boxes``/``gt_labels`` (arrays or lists)."""
+        for i in range(len(outputs["det_boxes"])):
+            self.dets.append((np.asarray(outputs["det_boxes"][i]),
+                              np.asarray(outputs["det_scores"][i]),
+                              np.asarray(outputs["det_labels"][i])))
+            self.gts.append((np.asarray(outputs["gt_boxes"][i]),
+                             np.asarray(outputs["gt_labels"][i])))
+
+    def finish(self):
+        from paddle_tpu.ops.detection import detection_map
+        return detection_map(self.dets, self.gts, self.num_classes,
+                             self.iou_threshold, self.mode)

@@ -1,0 +1,154 @@
+"""Detection suite tests: box math, matching, loss grads, NMS, mAP, SSD
+end-to-end step (model of the reference's DetectionUtil/MultiBoxLoss/
+DetectionMAPEvaluator coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import detection as det
+
+
+def test_box_iou_known_values():
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.asarray([[0.5, 0.5, 1.5, 1.5], [0.0, 0.0, 1.0, 1.0],
+                     [2.0, 2.0, 3.0, 3.0]])
+    iou = np.asarray(det.box_iou(a, b))[0]
+    np.testing.assert_allclose(iou, [0.25 / 1.75, 1.0, 0.0], rtol=1e-6)
+
+
+def test_encode_decode_roundtrip(rng):
+    priors = jnp.asarray(rng.uniform(0.1, 0.4, (10, 2)))
+    priors = jnp.concatenate([priors, priors + 0.3], axis=-1)
+    gt = jnp.asarray(rng.uniform(0.05, 0.45, (10, 2)))
+    gt = jnp.concatenate([gt, gt + jnp.asarray(rng.uniform(0.1, 0.4, (10, 2)))],
+                         axis=-1)
+    enc = det.encode_boxes(gt, priors)
+    dec = det.decode_boxes(enc, priors)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_prior_boxes_count_and_range():
+    boxes = det.prior_boxes((4, 4), (64, 64), min_sizes=[16.0],
+                            max_sizes=[32.0], aspect_ratios=[2.0])
+    # per cell: 1 min + 1 sqrt + 2 ar = 4
+    assert boxes.shape == (4 * 4 * 4, 4)
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    assert (boxes[:, 2] >= boxes[:, 0]).all()
+
+
+def test_match_priors_force_matches_every_gt():
+    priors = jnp.asarray([[0.0, 0.0, 0.2, 0.2], [0.4, 0.4, 0.6, 0.6],
+                          [0.7, 0.7, 1.0, 1.0]])
+    gt = jnp.asarray([[0.41, 0.41, 0.59, 0.59], [0.9, 0.9, 0.95, 0.95]])
+    mask = jnp.asarray([True, True])
+    matched, pos = det.match_priors(priors, gt, mask, threshold=0.5)
+    assert bool(pos[1]) and int(matched[1]) == 0
+    assert bool(pos[2]) and int(matched[2]) == 1  # forced despite low IoU
+    assert not bool(pos[0])
+
+
+def test_multibox_loss_grad_and_padding(rng):
+    p = 12
+    priors = jnp.asarray(np.linspace(0.05, 0.75, p, dtype=np.float32))
+    priors = jnp.stack([priors, priors, priors + 0.2, priors + 0.2], -1)
+    gt_boxes = jnp.asarray([[[0.1, 0.1, 0.3, 0.3], [0.0, 0.0, 0.0, 0.0]]],
+                           jnp.float32)
+    gt_labels = jnp.asarray([[1, 0]], jnp.int32)
+    gt_mask = jnp.asarray([[True, False]])
+
+    def loss(loc, conf):
+        return det.multibox_loss(loc, conf, priors, gt_boxes, gt_labels,
+                                 gt_mask)
+
+    loc = jnp.asarray(rng.randn(1, p, 4), jnp.float32) * 0.1
+    conf = jnp.asarray(rng.randn(1, p, 3), jnp.float32) * 0.1
+    l = float(loss(loc, conf))
+    assert np.isfinite(l) and l > 0
+    g = jax.grad(lambda a, b: loss(a, b), argnums=(0, 1))(loc, conf)
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+    # padded gt row must not influence: change it, loss identical
+    gt_boxes2 = gt_boxes.at[0, 1].set(jnp.asarray([0.5, 0.5, 0.9, 0.9]))
+    l2 = float(det.multibox_loss(loc, conf, priors, gt_boxes2, gt_labels,
+                                 gt_mask))
+    np.testing.assert_allclose(l, l2, rtol=1e-6)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.01, 0.01, 0.51, 0.51],
+                         [0.6, 0.6, 0.9, 0.9]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, ok = det.nms(boxes, scores, iou_threshold=0.5, keep_top_k=3)
+    kept = [int(i) for i, o in zip(idx, ok) if bool(o)]
+    assert kept == [0, 2]
+
+
+def test_detection_output_shapes(rng):
+    p, c = 20, 4
+    priors = jnp.asarray(rng.uniform(0.1, 0.5, (p, 4)), jnp.float32)
+    priors = priors.at[:, 2:].set(priors[:, :2] + 0.3)
+    loc = jnp.asarray(rng.randn(p, 4), jnp.float32) * 0.1
+    conf = jnp.asarray(rng.randn(p, c), jnp.float32)
+    boxes, scores, valid = det.detection_output(loc, conf, priors,
+                                                keep_top_k=5)
+    assert boxes.shape == (c - 1, 5, 4)
+    assert scores.shape == (c - 1, 5)
+    assert valid.shape == (c - 1, 5)
+
+
+def test_detection_map_perfect_and_miss():
+    gt = [(np.asarray([[0.1, 0.1, 0.4, 0.4]]), np.asarray([1]))]
+    perfect = [(np.asarray([[0.1, 0.1, 0.4, 0.4]]), np.asarray([0.9]),
+                np.asarray([1]))]
+    miss = [(np.asarray([[0.6, 0.6, 0.9, 0.9]]), np.asarray([0.9]),
+             np.asarray([1]))]
+    assert det.detection_map(perfect, gt, num_classes=2) == pytest.approx(1.0)
+    assert det.detection_map(miss, gt, num_classes=2) == pytest.approx(0.0)
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.training.evaluators import DetectionMAP
+    ev = DetectionMAP(num_classes=2)
+    ev.start()
+    ev.update({
+        "det_boxes": [np.asarray([[0.1, 0.1, 0.4, 0.4]])],
+        "det_scores": [np.asarray([0.9])],
+        "det_labels": [np.asarray([1])],
+        "gt_boxes": [np.asarray([[0.1, 0.1, 0.4, 0.4]])],
+        "gt_labels": [np.asarray([1])],
+    })
+    assert ev.finish() == pytest.approx(1.0)
+
+
+def test_ssd_train_step_decreases_loss(rng):
+    from paddle_tpu import optim
+    from paddle_tpu.models.ssd import model_fn_builder
+    from paddle_tpu.training import Trainer
+
+    b, s = 2, 64
+    batch = {
+        "image": rng.randn(b, s, s, 3).astype(np.float32),
+        "gt_boxes": np.asarray(
+            [[[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]]] * b,
+            np.float32),
+        "gt_labels": np.asarray([[1, 2]] * b, np.int32),
+        "gt_mask": np.ones((b, 2), bool),
+    }
+    trainer = Trainer(model_fn_builder(num_classes=3, image_size=s,
+                                       base_channels=8),
+                      optim.adam(1e-3))
+    trainer.init(batch)
+    losses = [float(trainer.train_batch(batch)[0]) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_match_priors_masked_gt_cannot_clobber_force_match():
+    # Regression: a padded gt row argmaxes to prior 0; it must not erase the
+    # force-match that a real gt placed on prior 0.
+    priors = jnp.asarray([[0.0, 0.0, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]])
+    gt = jnp.asarray([[0.0, 0.0, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]])
+    mask = jnp.asarray([True, False])
+    matched, pos = det.match_priors(priors, gt, mask, threshold=0.5)
+    assert bool(pos[0]) and int(matched[0]) == 0

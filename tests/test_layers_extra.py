@@ -1,0 +1,236 @@
+"""Extended layer zoo: shape/semantics checks + spot gradchecks.
+
+Model: the reference's per-layer coverage in
+``gserver/tests/test_LayerGrad.cpp`` (every layer × configs, analytic vs
+finite-difference) — here trimmed to shape checks for the pure-reshaping
+layers and gradchecks for the parameterized ones.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import testing
+
+
+def _run(module_fn, *args):
+    m = nn.transform(lambda *a: module_fn()(*a))
+    params, st = m.init(jax.random.key(0), *args)
+    out, _ = m.apply(params, st, None, *args)
+    return params, out
+
+
+def test_conv2d_transpose_upsamples(rng):
+    x = jnp.asarray(rng.randn(2, 8, 8, 3), jnp.float32)
+    _, y = _run(lambda: nn.Conv2DTranspose(5, 4, stride=2, name="t"), x)
+    assert y.shape == (2, 16, 16, 5)
+
+
+def test_conv2d_transpose_gradcheck(rng):
+    x = jnp.asarray(rng.randn(1, 4, 4, 2), jnp.float32)
+    m = nn.transform(lambda a: nn.Conv2DTranspose(3, 3, stride=2,
+                                                  name="t")(a))
+    params, _ = m.init(jax.random.key(0), x)
+    testing.check_grad_params(
+        lambda p: jnp.sum(jnp.tanh(m.apply(p, {}, None, x)[0])), params)
+
+
+def test_conv3d_and_pool3d(rng):
+    x = jnp.asarray(rng.randn(2, 6, 6, 6, 2), jnp.float32)
+    _, y = _run(lambda: nn.Conv3D(4, 3, name="c"), x)
+    assert y.shape == (2, 6, 6, 6, 4)
+    _, z = _run(lambda: nn.Pool3D(2, pool_type="avg"), y)
+    assert z.shape == (2, 3, 3, 3, 4)
+    np.testing.assert_allclose(
+        float(z[0, 0, 0, 0, 0]),
+        float(jnp.mean(y[0, :2, :2, :2, 0])), rtol=1e-5)
+
+
+def test_spatial_pyramid_pool_fixed_output(rng):
+    for hw in [(7, 9), (12, 12)]:
+        x = jnp.asarray(rng.randn(2, *hw, 3), jnp.float32)
+        _, y = _run(lambda: nn.SpatialPyramidPool(levels=3), x)
+        # 1 + 4 + 16 bins × 3 channels, independent of input size
+        assert y.shape == (2, 21 * 3)
+
+
+def test_row_conv_lookahead(rng):
+    x = jnp.asarray(rng.randn(2, 6, 4), jnp.float32)
+    m = nn.transform(lambda a: nn.RowConv(2, name="rc")(a))
+    params, _ = m.init(jax.random.key(0), x)
+    out, _ = m.apply(params, {}, None, x)
+    assert out.shape == x.shape
+    w = params["rc"]["w"]
+    # manual: y[t] = sum_i w[i] * x[t+i]
+    expect = (x[0, 3] * w[0] + x[0, 4] * w[1] + x[0, 5] * w[2])
+    np.testing.assert_allclose(np.asarray(out[0, 3]), np.asarray(expect),
+                               rtol=1e-5)
+
+
+def test_block_expand_patches(rng):
+    x = jnp.asarray(rng.randn(1, 4, 4, 2), jnp.float32)
+    _, y = _run(lambda: nn.BlockExpand((2, 2), (2, 2)), x)
+    assert y.shape == (1, 4, 8)
+
+
+def test_bilinear_interp_and_rotate(rng):
+    x = jnp.asarray(rng.randn(2, 4, 6, 3), jnp.float32)
+    _, y = _run(lambda: nn.BilinearInterp(8, 12), x)
+    assert y.shape == (2, 8, 12, 3)
+    _, r = _run(lambda: nn.Rotate(), x)
+    assert r.shape == (2, 6, 4, 3)
+    np.testing.assert_allclose(np.asarray(r[0, 0, 0]),
+                               np.asarray(x[0, 0, 5]), rtol=1e-6)
+
+
+def test_interpolation_crop_pad(rng):
+    w = jnp.asarray([[0.25], [0.75]], jnp.float32)
+    x = jnp.ones((2, 3), jnp.float32)
+    y = jnp.zeros((2, 3), jnp.float32)
+    _, out = _run(lambda: nn.Interpolation(), w, x, y)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), [0.25, 0.75])
+
+    img = jnp.asarray(np.arange(32, dtype=np.float32).reshape(1, 4, 4, 2))
+    _, c = _run(lambda: nn.Crop((1, 1), (2, 2)), img)
+    assert c.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(c[0, 0, 0]),
+                               np.asarray(img[0, 1, 1]))
+    _, p = _run(lambda: nn.Pad((1, 1), (0, 2)), img)
+    assert p.shape == (1, 6, 6, 2)
+
+
+def test_multiplex_and_feature_map_expand(rng):
+    a = jnp.zeros((3, 4)); b = jnp.ones((3, 4)) * 2
+    idx = jnp.asarray([1, 0, 1])
+    _, out = _run(lambda: nn.Multiplex(), idx, a, b)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), [2.0, 0.0, 2.0])
+    v = jnp.asarray(rng.randn(2, 5), jnp.float32)
+    _, fm = _run(lambda: nn.FeatureMapExpand(3), v)
+    assert fm.shape == (2, 3, 5)
+
+
+def test_selective_fc_matches_dense_columns(rng):
+    x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+    sel = jnp.asarray(rng.randint(0, 16, (4, 5)), jnp.int32)
+    m = nn.transform(lambda a, s: nn.SelectiveFC(16, name="sfc")(a, s))
+    params, _ = m.init(jax.random.key(0), x, sel)
+    out, _ = m.apply(params, {}, None, x, sel)
+    dense = nn.transform(lambda a: nn.SelectiveFC(16, name="sfc")(a))
+    full, _ = dense.apply(params, {}, None, x)
+    expect = jnp.take_along_axis(full, sel, axis=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_data_norm_and_sum_to_one(rng):
+    x = jnp.asarray(rng.randn(6, 3) * 4 + 2, jnp.float32)
+    mean, std = jnp.mean(x, 0), jnp.std(x, 0)
+    _, y = _run(lambda: nn.DataNorm(mean, std=std), x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), np.zeros(3),
+                               atol=1e-5)
+    p = jnp.abs(jnp.asarray(rng.randn(4, 5), jnp.float32))
+    _, q = _run(lambda: nn.SumToOneNorm(), p)
+    np.testing.assert_allclose(np.asarray(jnp.sum(q, -1)), np.ones(4),
+                               rtol=1e-5)
+
+
+def test_mixed_projections_gradcheck(rng):
+    x1 = jnp.asarray(rng.randn(3, 6), jnp.float32)
+    x2 = jnp.asarray(rng.randn(3, 6), jnp.float32)
+
+    def build(a, b):
+        return nn.Mixed([nn.DotMulProjection(name="dm"),
+                         nn.TransposedFullMatrixProjection(6, name="tp")],
+                        act="tanh", name="mix")(a, b)
+
+    m = nn.transform(build)
+    params, _ = m.init(jax.random.key(0), x1, x2)
+    out, _ = m.apply(params, {}, None, x1, x2)
+    assert out.shape == (3, 6)
+    testing.check_grad_params(
+        lambda p: jnp.sum(m.apply(p, {}, None, x1, x2)[0] ** 2), params)
+
+
+def test_scaling_slope_addto(rng):
+    s = jnp.asarray([2.0, 0.5], jnp.float32)
+    y = jnp.ones((2, 3), jnp.float32)
+    _, out = _run(lambda: nn.Scaling(), s, y)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), [2.0, 0.5])
+    _, si = _run(lambda: nn.SlopeIntercept(3.0, 1.0), y)
+    np.testing.assert_allclose(np.asarray(si), 4 * np.ones((2, 3)))
+    _, ad = _run(lambda: nn.Addto(act="relu", name="a"), y, -2 * y)
+    np.testing.assert_allclose(np.asarray(ad), np.zeros((2, 3)))
+
+
+def test_identity_projection_offset():
+    x = jnp.ones((2, 3), jnp.float32)
+    _, y = _run(lambda: nn.IdentityProjection(offset=2, size=8), x)
+    assert y.shape == (2, 8)
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               [0, 0, 1, 1, 1, 0, 0, 0])
+
+
+def test_remat_transformer_matches_no_remat(rng):
+    """cfg.remat=True must produce identical loss/grads to remat=False."""
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    ids = jnp.asarray(rng.randint(0, 50, (2, 8)), jnp.int32)
+    batch = {"ids": ids, "ids_mask": jnp.ones((2, 8), bool)}
+
+    def run(remat):
+        cfg = TransformerConfig(vocab_size=50, dim=16, num_heads=2,
+                                num_layers=2, max_len=16, remat=remat)
+        m = nn.transform(lambda b: lm_model_fn_builder(cfg)(b))
+        params, st = m.init(jax.random.key(0), batch)
+
+        def loss(p):
+            (l, _), _ = m.apply(p, st, None, batch)
+            return l
+        return params, jax.jit(loss)(params), jax.jit(jax.grad(loss))(params)
+
+    p1, l1, g1 = run(False)
+    p2, l2, g2 = run(True)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g1, g2)
+
+
+def test_spp_non_divisible_input_no_inf(rng):
+    # Regression: 5x5 input at levels=3 (bins up to 4) must not produce
+    # -inf (max) or padding-diluted averages.
+    x = jnp.ones((1, 5, 5, 2), jnp.float32)
+    for pool_type in ("max", "avg"):
+        m = nn.transform(lambda a: nn.SpatialPyramidPool(
+            3, pool_type=pool_type)(a))
+        out, _ = m.apply({}, {}, None, x)
+        np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)
+
+
+def test_remat_sees_earlier_state_writes(rng):
+    # Regression: state written before a remat'd segment must be visible
+    # inside it (same as inline execution).
+    from paddle_tpu.nn.module import set_state, state as get_state
+
+    class Writer(nn.Module):
+        def forward(self):
+            set_state("v", jnp.asarray(7.0))
+
+    class Reader(nn.Module):
+        def forward(self, x):
+            v = get_state("v", (), jnp.float32, lambda s, d: jnp.zeros(s, d))
+            return x * v
+
+    def build(x):
+        w = Writer(name="shared")
+        r = Reader(name="shared")
+        w()
+        return nn.remat(r, x)
+
+    m = nn.transform(build)
+    x = jnp.asarray(2.0)
+    params, st = m.init(jax.random.key(0), x)
+    out, _ = m.apply(params, st, None, x)
+    assert float(out) == 14.0
