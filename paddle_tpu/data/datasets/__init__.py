@@ -1,3 +1,8 @@
-from paddle_tpu.data.datasets import mnist, cifar, imdb, uci_housing, imikolov
+from paddle_tpu.data.datasets import (mnist, cifar, imdb, uci_housing,
+                                      imikolov, ctr, movielens, conll05,
+                                      wmt14, sentiment, mq2007, flowers,
+                                      voc2012)
 
-__all__ = ["mnist", "cifar", "imdb", "uci_housing", "imikolov"]
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "imikolov", "ctr",
+           "movielens", "conll05", "wmt14", "sentiment", "mq2007", "flowers",
+           "voc2012"]

@@ -1,0 +1,67 @@
+"""CoNLL-2005 semantic-role-labeling dataset (twin of
+``python/paddle/v2/dataset/conll05.py``).
+
+Samples are ``(word_ids, predicate_id, ctx_n2/n1/0/p1/p2, mark, label_ids)``
+— the 8-slot feature layout of the reference's SRL demo (sequence tagging
+with B/I/O argument labels).  Synthetic fallback: template-generated
+sentences where argument spans correlate with distance to the predicate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+WORD_VOCAB = 44068
+PREDICATE_VOCAB = 3162
+# 0 = O; odd = B-type k, even = I-type k (iob_decode scheme)
+NUM_LABEL_TYPES = 10
+NUM_LABELS = 2 * NUM_LABEL_TYPES + 1
+
+
+def word_dict_len() -> int:
+    return WORD_VOCAB
+
+
+def predicate_dict_len() -> int:
+    return PREDICATE_VOCAB
+
+
+def label_dict_len() -> int:
+    return NUM_LABELS
+
+
+def _synthetic(n, seed, min_len=8, max_len=40):
+    rng = common.synthetic_rng("conll05", seed)
+    for _ in range(n):
+        length = int(rng.randint(min_len, max_len + 1))
+        words = rng.randint(0, WORD_VOCAB, length).astype(np.int32)
+        pred_pos = int(rng.randint(0, length))
+        predicate = int(rng.randint(0, PREDICATE_VOCAB))
+        labels = np.zeros(length, np.int32)
+        # one argument span on each side of the predicate when room allows
+        for lo, hi in ((0, pred_pos), (pred_pos + 1, length)):
+            if hi - lo >= 2:
+                s = int(rng.randint(lo, hi - 1))
+                e = min(hi, s + int(rng.randint(1, 4)))
+                t = int(rng.randint(0, NUM_LABEL_TYPES))
+                labels[s] = 2 * t + 1          # B-t
+                labels[s + 1:e] = 2 * t + 2    # I-t
+        mark = np.zeros(length, np.int32)
+        mark[pred_pos] = 1
+        ctx = [words[np.clip(pred_pos + d, 0, length - 1)]
+               for d in (-2, -1, 0, 1, 2)]
+        yield (words, predicate, *map(int, ctx), mark, labels)
+
+
+def train(n_synthetic: int = 1024):
+    def reader():
+        yield from _synthetic(n_synthetic, 0)
+    return reader
+
+
+def test(n_synthetic: int = 128):
+    def reader():
+        yield from _synthetic(n_synthetic, 1)
+    return reader

@@ -1,0 +1,42 @@
+"""Oxford-102 flowers dataset (twin of
+``python/paddle/v2/dataset/flowers.py``): ``(image_hwc_float, label)`` with
+102 classes.  Synthetic fallback: class-colored noise images so a CNN can
+separate them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+NUM_CLASSES = 102
+IMAGE_SIZE = 64  # reference pipeline resizes/crops; synthetic uses 64²
+
+
+def _synthetic(n, seed, size=IMAGE_SIZE):
+    rng = common.synthetic_rng("flowers", seed)
+    palette = rng.rand(NUM_CLASSES, 3).astype(np.float32)
+    for _ in range(n):
+        label = int(rng.randint(0, NUM_CLASSES))
+        img = (palette[label][None, None, :]
+               + 0.25 * rng.randn(size, size, 3)).astype(np.float32)
+        yield np.clip(img, 0.0, 1.0), label
+
+
+def train(n_synthetic: int = 1024):
+    def reader():
+        yield from _synthetic(n_synthetic, 0)
+    return reader
+
+
+def valid(n_synthetic: int = 128):
+    def reader():
+        yield from _synthetic(n_synthetic, 1)
+    return reader
+
+
+def test(n_synthetic: int = 128):
+    def reader():
+        yield from _synthetic(n_synthetic, 2)
+    return reader

@@ -1,0 +1,70 @@
+"""MovieLens-1M ratings dataset (twin of
+``python/paddle/v2/dataset/movielens.py``).
+
+Samples are ``(user_id, gender, age, occupation, movie_id, category_ids,
+title_ids, rating)`` — the feature layout the reference's recommender demo
+consumes.  Synthetic fallback: latent-factor users/movies so a
+matrix-factorization or wide&deep model actually has signal to fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+NUM_USERS = 6040
+NUM_MOVIES = 3952
+NUM_AGES = 7
+NUM_OCCUPATIONS = 21
+NUM_CATEGORIES = 18
+TITLE_VOCAB = 5174
+MAX_CATEGORIES = 3
+TITLE_LEN = 4
+
+
+def max_user_id() -> int:
+    return NUM_USERS
+
+
+def max_movie_id() -> int:
+    return NUM_MOVIES
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _factors(rng, n, d=8):
+    return rng.randn(n, d).astype(np.float32) * 0.5
+
+
+def _synthetic(n, seed):
+    rng = common.synthetic_rng("movielens", seed)
+    uf = _factors(rng, NUM_USERS)
+    mf = _factors(rng, NUM_MOVIES)
+    genders = rng.randint(0, 2, NUM_USERS)
+    ages = rng.randint(0, NUM_AGES, NUM_USERS)
+    occs = rng.randint(0, NUM_OCCUPATIONS, NUM_USERS)
+    movie_cats = rng.randint(0, NUM_CATEGORIES, (NUM_MOVIES, MAX_CATEGORIES))
+    movie_titles = rng.randint(0, TITLE_VOCAB, (NUM_MOVIES, TITLE_LEN))
+    for _ in range(n):
+        u = int(rng.randint(0, NUM_USERS))
+        m = int(rng.randint(0, NUM_MOVIES))
+        score = float(uf[u] @ mf[m]) + 0.3 * float(rng.randn())
+        rating = int(np.clip(np.round(3.0 + score), 1, 5))
+        yield (u, int(genders[u]), int(ages[u]), int(occs[u]),
+               m, movie_cats[m].astype(np.int32),
+               movie_titles[m].astype(np.int32), rating)
+
+
+def train(n_synthetic: int = 4096):
+    def reader():
+        yield from _synthetic(n_synthetic, 0)
+    return reader
+
+
+def test(n_synthetic: int = 512):
+    def reader():
+        yield from _synthetic(n_synthetic, 1)
+    return reader

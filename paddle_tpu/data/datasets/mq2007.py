@@ -1,0 +1,56 @@
+"""LETOR MQ2007 learning-to-rank dataset (twin of
+``python/paddle/v2/dataset/mq2007.py``).
+
+Modes match the reference: ``pointwise`` yields (features, relevance),
+``pairwise`` yields (features_hi, features_lo) with rel(hi) > rel(lo),
+``listwise`` yields (query_features [n, 46], relevances [n]).  Synthetic
+fallback: relevance is a noisy linear function of the 46 features.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+NUM_FEATURES = 46
+
+
+def _queries(n_queries, seed, docs_per_query=(5, 20)):
+    rng = common.synthetic_rng("mq2007", seed)
+    w = rng.randn(NUM_FEATURES).astype(np.float32)
+    for _ in range(n_queries):
+        n_docs = int(rng.randint(*docs_per_query))
+        feats = rng.randn(n_docs, NUM_FEATURES).astype(np.float32)
+        score = feats @ w + 0.5 * rng.randn(n_docs).astype(np.float32)
+        rel = np.digitize(score, np.quantile(score, [0.5, 0.8])) \
+            .astype(np.int32)  # 0/1/2 relevance grades
+        yield feats, rel
+
+
+def train(mode: str = "pairwise", n_queries: int = 200):
+    return _reader(mode, n_queries, seed=0)
+
+
+def test(mode: str = "pairwise", n_queries: int = 40):
+    return _reader(mode, n_queries, seed=1)
+
+
+def _reader(mode, n_queries, seed):
+    def reader():
+        for feats, rel in _queries(n_queries, seed):
+            if mode == "listwise":
+                yield feats, rel
+            elif mode == "pointwise":
+                for f, r in zip(feats, rel):
+                    yield f, int(r)
+            elif mode == "pairwise":
+                hi = np.argsort(-rel)
+                for i in hi:
+                    for j in hi[::-1]:
+                        if rel[i] > rel[j]:
+                            yield feats[i], feats[j]
+                            break
+            else:
+                raise ValueError(f"unknown mq2007 mode {mode!r}")
+    return reader

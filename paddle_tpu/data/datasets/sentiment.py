@@ -1,0 +1,22 @@
+"""NLTK movie-review sentiment dataset (twin of
+``python/paddle/v2/dataset/sentiment.py``) — same sample contract as imdb
+(``(word_ids, label)``), smaller vocabulary.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.data.datasets import imdb
+
+VOCAB = 2000
+
+
+def get_word_dict():
+    return imdb.word_dict(VOCAB)
+
+
+def train(n_synthetic: int = 800):
+    return imdb.train(VOCAB, n_synthetic, min_len=5, max_len=60)
+
+
+def test(n_synthetic: int = 200):
+    return imdb.test(VOCAB, n_synthetic, min_len=5, max_len=60)

@@ -1,0 +1,56 @@
+"""Pascal VOC-2012 detection/segmentation dataset (twin of
+``python/paddle/v2/dataset/voc2012.py``, extended with the detection sample
+layout the SSD stack consumes).
+
+``train/val`` yield ``(image_hwc_float, gt_boxes [G,4] normalized,
+gt_labels [G] in 1..20)``.  Synthetic fallback: colored rectangles on noise
+backgrounds — detectable objects with exact ground truth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+NUM_CLASSES = 21  # 20 object classes + background (0)
+IMAGE_SIZE = 96
+
+
+def _synthetic(n, seed, size=IMAGE_SIZE, max_objects=3):
+    rng = common.synthetic_rng("voc2012", seed)
+    palette = rng.rand(NUM_CLASSES, 3).astype(np.float32)
+    for _ in range(n):
+        img = 0.1 * rng.rand(size, size, 3).astype(np.float32)
+        g = int(rng.randint(1, max_objects + 1))
+        boxes, labels = [], []
+        for _ in range(g):
+            w, h = rng.uniform(0.15, 0.5, 2)
+            x0 = rng.uniform(0, 1 - w)
+            y0 = rng.uniform(0, 1 - h)
+            cls = int(rng.randint(1, NUM_CLASSES))
+            xi0, yi0 = int(x0 * size), int(y0 * size)
+            xi1, yi1 = int((x0 + w) * size), int((y0 + h) * size)
+            img[yi0:yi1, xi0:xi1] = palette[cls]
+            boxes.append([x0, y0, x0 + w, y0 + h])
+            labels.append(cls)
+        yield (img, np.asarray(boxes, np.float32),
+               np.asarray(labels, np.int32))
+
+
+def train(n_synthetic: int = 512):
+    def reader():
+        yield from _synthetic(n_synthetic, 0)
+    return reader
+
+
+def val(n_synthetic: int = 64):
+    def reader():
+        yield from _synthetic(n_synthetic, 1)
+    return reader
+
+
+def test(n_synthetic: int = 64):
+    def reader():
+        yield from _synthetic(n_synthetic, 2)
+    return reader

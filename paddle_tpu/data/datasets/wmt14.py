@@ -1,0 +1,44 @@
+"""WMT-14 French→English translation dataset (twin of
+``python/paddle/v2/dataset/wmt14.py``).
+
+Samples are ``(src_ids, trg_ids_in, trg_ids_out)`` with <s>/<e>/<unk>
+conventions matching the reference (ids 0/1/2).  Synthetic fallback: an
+invertible toy "translation" (digit-reversal language pair) so a seq2seq
+model can reach near-zero loss — exercising attention and beam search the
+way the real corpus would.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.data.datasets import common
+
+START_ID, END_ID, UNK_ID = 0, 1, 2
+RESERVED = 3
+DEFAULT_DICT_SIZE = 30000
+
+
+def _synthetic(n, dict_size, seed, min_len=4, max_len=20):
+    rng = common.synthetic_rng("wmt14", seed)
+    for _ in range(n):
+        length = int(rng.randint(min_len, max_len + 1))
+        src = rng.randint(RESERVED, dict_size, length).astype(np.int32)
+        # toy alignment: target = reversed source with a fixed offset map
+        trg = ((src[::-1] - RESERVED + 7) % (dict_size - RESERVED)
+               + RESERVED).astype(np.int32)
+        trg_in = np.concatenate([[START_ID], trg]).astype(np.int32)
+        trg_out = np.concatenate([trg, [END_ID]]).astype(np.int32)
+        yield src, trg_in, trg_out
+
+
+def train(dict_size: int = DEFAULT_DICT_SIZE, n_synthetic: int = 2048):
+    def reader():
+        yield from _synthetic(n_synthetic, dict_size, 0)
+    return reader
+
+
+def test(dict_size: int = DEFAULT_DICT_SIZE, n_synthetic: int = 256):
+    def reader():
+        yield from _synthetic(n_synthetic, dict_size, 1)
+    return reader

@@ -122,3 +122,42 @@ def test_datasets_deterministic_and_learnable():
     grams = list(rd.firstn(imikolov.train(n=5, vocab_size=50,
                                           n_tokens=100), 10)())
     assert all(len(g) == 5 for g in grams)
+
+
+def test_new_datasets_shapes_and_determinism():
+    from paddle_tpu.data.datasets import (movielens, conll05, wmt14,
+                                          sentiment, mq2007, flowers,
+                                          voc2012)
+    u, g, a, o, m, cats, title, r = next(movielens.train(4)())
+    assert 0 <= u < movielens.NUM_USERS and 1 <= r <= 5
+    assert cats.shape == (movielens.MAX_CATEGORIES,)
+
+    sample = next(conll05.train(4)())
+    words, pred, c_n2, c_n1, c_0, c_p1, c_p2, mark, labels = sample
+    assert words.shape == labels.shape == mark.shape
+    assert mark.sum() == 1
+
+    src, ti, to = next(wmt14.train(n_synthetic=4)())
+    assert ti[0] == wmt14.START_ID and to[-1] == wmt14.END_ID
+    assert len(ti) == len(to) == len(src) + 1
+
+    seq, label = next(sentiment.train(4)())
+    assert seq.max() < sentiment.VOCAB and label in (0, 1)
+
+    hi, lo = next(mq2007.train("pairwise", 4)())
+    assert hi.shape == lo.shape == (mq2007.NUM_FEATURES,)
+    feats, rel = next(mq2007.train("listwise", 4)())
+    assert feats.shape[0] == rel.shape[0]
+
+    img, lbl = next(flowers.train(4)())
+    assert img.shape == (64, 64, 3) and 0 <= lbl < flowers.NUM_CLASSES
+
+    img, boxes, labels2 = next(voc2012.train(4)())
+    assert img.shape == (96, 96, 3)
+    assert boxes.shape[0] == labels2.shape[0]
+    assert (boxes[:, 2:] > boxes[:, :2]).all()
+
+    # determinism across calls
+    a1 = next(movielens.train(4)())
+    a2 = next(movielens.train(4)())
+    assert a1[0] == a2[0] and a1[-1] == a2[-1]
