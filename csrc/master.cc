@@ -1,0 +1,331 @@
+// master: fault-tolerant dataset/task dispatch state machine.
+//
+// TPU-native twin of the reference's Go master service (SURVEY.md §2.4:
+// go/master/service.go — todo/pending/done/failed queues, per-task timeout
+// and retry budget, snapshot-to-etcd recovery, pass barrier semantics).
+// Design is new, not a port: a single C++ state machine behind a C API
+// (ctypes-consumed), with snapshot/restore to a local file standing in for
+// the etcd store; the RPC skin lives in Python
+// (paddle_tpu/distributed/master.py) since control-plane QPS is tiny.
+//
+// Task lifecycle:  todo --get--> pending --finished--> done
+//                   ^               |timeout/fail
+//                   +---(failures < max)---+   else -> failed (dropped)
+//
+// get_task() return codes mirror the reference's ErrPassBefore/ErrPassAfter
+// (go/master/service.go:27-33): a trainer asking while the pass is draining
+// gets WAIT; once todo and pending are both empty the pass is over.
+//
+// Build: csrc/Makefile -> paddle_tpu/distributed/libmaster.so
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+double now_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Task {
+  int64_t id = 0;
+  std::string payload;
+  int failures = 0;
+};
+
+struct Pending {
+  Task task;
+  double deadline = 0;
+  int64_t trainer = -1;
+};
+
+class Master {
+ public:
+  Master(double timeout_s, int max_failures)
+      : timeout_s_(timeout_s), max_failures_(max_failures) {}
+
+  void SetTasks(std::vector<std::string> payloads) {
+    std::lock_guard<std::mutex> lk(mu_);
+    todo_.clear();
+    pending_.clear();
+    done_.clear();
+    failed_.clear();
+    all_.clear();
+    next_id_ = 0;
+    for (auto& p : payloads) {
+      Task t;
+      t.id = next_id_++;
+      t.payload = std::move(p);
+      all_.push_back(t);
+      todo_.push_back(t);
+    }
+    pass_ = 0;
+  }
+
+  // >=0: task id, payload copied out. -1: wait (pass draining).
+  // -2: pass end (todo+pending empty). -3: payload larger than cap —
+  // the task stays at the front of todo (NOT assigned); *needed reports
+  // the size so the caller can retry with a bigger buffer.
+  int64_t GetTask(int64_t trainer, size_t cap, std::string* payload,
+                  size_t* needed) {
+    std::lock_guard<std::mutex> lk(mu_);
+    RequeueTimedOutLocked();
+    if (todo_.empty()) {
+      return pending_.empty() ? -2 : -1;
+    }
+    if (todo_.front().payload.size() > cap) {
+      *needed = todo_.front().payload.size();
+      return -3;
+    }
+    Task t = todo_.front();
+    todo_.pop_front();
+    Pending p;
+    p.task = t;
+    p.trainer = trainer;
+    p.deadline = now_seconds() + timeout_s_;
+    pending_[t.id] = p;
+    *payload = p.task.payload;
+    *needed = p.task.payload.size();
+    return t.id;
+  }
+
+  bool TaskFinished(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(id);
+    if (it == pending_.end()) return false;
+    done_.push_back(it->second.task);
+    pending_.erase(it);
+    return true;
+  }
+
+  bool TaskFailed(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(id);
+    if (it == pending_.end()) return false;
+    FailLocked(it->second.task);
+    pending_.erase(it);
+    return true;
+  }
+
+  // Re-queue expired pending tasks; returns how many were recycled.
+  int Tick() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return RequeueTimedOutLocked();
+  }
+
+  // All done -> recycle done into todo for the next pass; returns new pass.
+  int64_t StartNextPass() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!todo_.empty() || !pending_.empty()) return -1;
+    for (auto& t : all_) {
+      bool is_failed =
+          std::find_if(failed_.begin(), failed_.end(), [&](const Task& f) {
+            return f.id == t.id;
+          }) != failed_.end();
+      if (!is_failed) {
+        Task fresh = t;
+        fresh.failures = 0;
+        todo_.push_back(fresh);
+      }
+    }
+    done_.clear();
+    return ++pass_;
+  }
+
+  int64_t NumTodo() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)todo_.size();
+  }
+  int64_t NumPending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)pending_.size();
+  }
+  int64_t NumDone() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)done_.size();
+  }
+  int64_t NumFailed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int64_t)failed_.size();
+  }
+  int64_t Pass() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pass_;
+  }
+
+  // Snapshot format: text header + length-prefixed payloads. Pending tasks
+  // snapshot as todo (the reference's recovery likewise re-dispatches them).
+  bool Snapshot(const char* path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    auto write_list = [&](const std::deque<Task>& list) {
+      uint64_t n = list.size();
+      std::fwrite(&n, sizeof n, 1, f);
+      for (const auto& t : list) {
+        uint64_t len = t.payload.size();
+        std::fwrite(&t.id, sizeof t.id, 1, f);
+        std::fwrite(&t.failures, sizeof t.failures, 1, f);
+        std::fwrite(&len, sizeof len, 1, f);
+        std::fwrite(t.payload.data(), 1, len, f);
+      }
+    };
+    std::fwrite(&pass_, sizeof pass_, 1, f);
+    std::fwrite(&next_id_, sizeof next_id_, 1, f);
+    std::deque<Task> todo_snapshot = todo_;
+    for (const auto& kv : pending_) todo_snapshot.push_back(kv.second.task);
+    write_list(todo_snapshot);
+    write_list(done_);
+    write_list(failed_);
+    write_list(std::deque<Task>(all_.begin(), all_.end()));
+    bool ok = std::fclose(f) == 0;
+    return ok;
+  }
+
+  bool Restore(const char* path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    auto read_list = [&](std::deque<Task>* list) -> bool {
+      uint64_t n = 0;
+      if (std::fread(&n, sizeof n, 1, f) != 1) return false;
+      list->clear();
+      for (uint64_t i = 0; i < n; i++) {
+        Task t;
+        uint64_t len = 0;
+        if (std::fread(&t.id, sizeof t.id, 1, f) != 1) return false;
+        if (std::fread(&t.failures, sizeof t.failures, 1, f) != 1)
+          return false;
+        if (std::fread(&len, sizeof len, 1, f) != 1) return false;
+        t.payload.resize(len);
+        if (len && std::fread(&t.payload[0], 1, len, f) != len) return false;
+        list->push_back(t);
+      }
+      return true;
+    };
+    bool ok = std::fread(&pass_, sizeof pass_, 1, f) == 1 &&
+              std::fread(&next_id_, sizeof next_id_, 1, f) == 1;
+    std::deque<Task> all_list;
+    ok = ok && read_list(&todo_) && read_list(&done_) &&
+         read_list(&failed_) && read_list(&all_list);
+    std::fclose(f);
+    if (ok) {
+      pending_.clear();
+      all_.assign(all_list.begin(), all_list.end());
+    }
+    return ok;
+  }
+
+ private:
+  int RequeueTimedOutLocked() {
+    double now = now_seconds();
+    int recycled = 0;
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (it->second.deadline <= now) {
+        FailLocked(it->second.task);
+        it = pending_.erase(it);
+        recycled++;
+      } else {
+        ++it;
+      }
+    }
+    return recycled;
+  }
+
+  void FailLocked(Task t) {
+    t.failures++;
+    if (t.failures >= max_failures_) {
+      failed_.push_back(t);  // dropped, like processFailedTask's discard
+    } else {
+      todo_.push_back(t);
+    }
+  }
+
+  std::mutex mu_;
+  double timeout_s_;
+  int max_failures_;
+  int64_t next_id_ = 0;
+  int64_t pass_ = 0;
+  std::deque<Task> todo_;
+  std::map<int64_t, Pending> pending_;
+  std::deque<Task> done_;
+  std::deque<Task> failed_;
+  std::vector<Task> all_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mst_create(double timeout_s, int max_failures) {
+  return new Master(timeout_s, max_failures);
+}
+
+void mst_destroy(void* m) { delete static_cast<Master*>(m); }
+
+// payloads: n pointers + n lengths.
+void mst_set_tasks(void* m, const char** payloads, const int64_t* lens,
+                   int64_t n) {
+  std::vector<std::string> v;
+  v.reserve(n);
+  for (int64_t i = 0; i < n; i++) v.emplace_back(payloads[i], lens[i]);
+  static_cast<Master*>(m)->SetTasks(std::move(v));
+}
+
+// Returns task id (>=0), -1 wait, -2 pass end, -3 buffer too small
+// (task NOT assigned; *out_len is the needed size — retry with a bigger
+// buffer).
+int64_t mst_get_task(void* m, int64_t trainer, char* buf, int64_t cap,
+                     int64_t* out_len) {
+  std::string payload;
+  size_t needed = 0;
+  int64_t id = static_cast<Master*>(m)->GetTask(trainer, (size_t)cap,
+                                                &payload, &needed);
+  *out_len = (int64_t)needed;
+  if (id >= 0) std::memcpy(buf, payload.data(), payload.size());
+  return id;
+}
+
+int mst_task_finished(void* m, int64_t id) {
+  return static_cast<Master*>(m)->TaskFinished(id) ? 0 : -1;
+}
+
+int mst_task_failed(void* m, int64_t id) {
+  return static_cast<Master*>(m)->TaskFailed(id) ? 0 : -1;
+}
+
+int mst_tick(void* m) { return static_cast<Master*>(m)->Tick(); }
+
+int64_t mst_start_next_pass(void* m) {
+  return static_cast<Master*>(m)->StartNextPass();
+}
+
+int64_t mst_num_todo(void* m) { return static_cast<Master*>(m)->NumTodo(); }
+int64_t mst_num_pending(void* m) {
+  return static_cast<Master*>(m)->NumPending();
+}
+int64_t mst_num_done(void* m) { return static_cast<Master*>(m)->NumDone(); }
+int64_t mst_num_failed(void* m) {
+  return static_cast<Master*>(m)->NumFailed();
+}
+int64_t mst_pass(void* m) { return static_cast<Master*>(m)->Pass(); }
+
+int mst_snapshot(void* m, const char* path) {
+  return static_cast<Master*>(m)->Snapshot(path) ? 0 : -1;
+}
+
+int mst_restore(void* m, const char* path) {
+  return static_cast<Master*>(m)->Restore(path) ? 0 : -1;
+}
+
+}  // extern "C"

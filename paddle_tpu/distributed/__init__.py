@@ -1,0 +1,9 @@
+from paddle_tpu.distributed.master import (Master, MasterServer, MasterClient,
+                                           task_reader)
+from paddle_tpu.distributed.runtime import (initialize, process_index,
+                                            process_count, is_coordinator,
+                                            local_data_shard)
+
+__all__ = ["Master", "MasterServer", "MasterClient", "task_reader",
+           "initialize", "process_index", "process_count", "is_coordinator",
+           "local_data_shard"]

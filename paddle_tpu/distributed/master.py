@@ -1,0 +1,312 @@
+"""Fault-tolerant dataset/task dispatch (the reference's Go master twin).
+
+The state machine is native C++ (``csrc/master.cc`` — todo/pending/done/
+failed queues, per-task timeout + retry budget, snapshot/restore; twin of
+``go/master/service.go``) behind ctypes.  This module adds the service
+skin the reference built on net/rpc + etcd:
+
+* :class:`Master` — in-process handle (library mode).
+* :class:`MasterServer` — TCP JSON-lines service run by the coordinator
+  (JAX process 0); control-plane QPS is tiny, so Python sockets suffice.
+* :class:`MasterClient` — trainer-side client with reconnect + retry
+  (twin of ``go/connection/conn.go``).
+* :func:`task_reader` — a reader combinator that pulls task payloads
+  (e.g. recordio shard descriptors) and streams their records, reporting
+  completion/failure back — the trainer loop of ``go/master/client.go``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Iterable, List, Optional, Sequence
+
+from paddle_tpu.utils.native import load_library
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libmaster.so")
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+PASS_WAIT = -1
+PASS_END = -2
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = load_library("master.cc", _LIB_PATH)
+        lib.mst_create.restype = ctypes.c_void_p
+        lib.mst_create.argtypes = [ctypes.c_double, ctypes.c_int]
+        lib.mst_destroy.argtypes = [ctypes.c_void_p]
+        lib.mst_set_tasks.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.mst_get_task.restype = ctypes.c_int64
+        lib.mst_get_task.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        for fn in ("mst_task_finished", "mst_task_failed", "mst_tick",
+                   "mst_snapshot", "mst_restore"):
+            getattr(lib, fn).restype = ctypes.c_int
+        lib.mst_task_finished.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mst_task_failed.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mst_tick.argtypes = [ctypes.c_void_p]
+        lib.mst_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.mst_restore.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for fn in ("mst_start_next_pass", "mst_num_todo", "mst_num_pending",
+                   "mst_num_done", "mst_num_failed", "mst_pass"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class Master:
+    """In-process task dispatcher over the native state machine."""
+
+    def __init__(self, timeout_s: float = 60.0, max_failures: int = 3,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_every: int = 32,
+                 snapshot_interval_s: float = 10.0):
+        self._lib = _load()
+        self._h = ctypes.c_void_p(self._lib.mst_create(timeout_s,
+                                                       max_failures))
+        # Periodic snapshot cadence (the reference checkpoints its master
+        # state on an interval, not per ack — per-ack would be O(n^2) I/O).
+        self.snapshot_path = snapshot_path
+        self.snapshot_every = snapshot_every
+        self.snapshot_interval_s = snapshot_interval_s
+        self._acks_since_snapshot = 0
+        self._last_snapshot_t = time.monotonic()
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._lib.mst_restore(self._h, snapshot_path.encode())
+
+    def close(self):
+        if self._h:
+            self._lib.mst_destroy(self._h)
+            self._h = None
+
+    def set_tasks(self, payloads: Sequence[bytes]) -> None:
+        n = len(payloads)
+        arr = (ctypes.c_char_p * n)(*payloads)
+        lens = (ctypes.c_int64 * n)(*[len(p) for p in payloads])
+        self._lib.mst_set_tasks(
+            self._h, ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)),
+            lens, n)
+
+    def get_task(self, trainer: int = 0):
+        """Returns (task_id, payload) | (PASS_WAIT, None) | (PASS_END, None)."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            out_len = ctypes.c_int64()
+            tid = self._lib.mst_get_task(self._h, trainer, buf, cap,
+                                         ctypes.byref(out_len))
+            if tid == -3:
+                # Buffer too small; the task was NOT assigned — retry with
+                # the exact size the library reported.
+                cap = out_len.value
+                continue
+            if tid < 0:
+                return int(tid), None
+            return int(tid), buf.raw[:out_len.value]
+
+    def task_finished(self, task_id: int) -> bool:
+        ok = self._lib.mst_task_finished(self._h, task_id) == 0
+        if ok and self.snapshot_path:
+            self._acks_since_snapshot += 1
+            now = time.monotonic()
+            if (self._acks_since_snapshot >= self.snapshot_every
+                    or now - self._last_snapshot_t
+                    >= self.snapshot_interval_s):
+                self.snapshot(self.snapshot_path)
+                self._acks_since_snapshot = 0
+                self._last_snapshot_t = now
+        return ok
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._lib.mst_task_failed(self._h, task_id) == 0
+
+    def tick(self) -> int:
+        return self._lib.mst_tick(self._h)
+
+    def start_next_pass(self) -> int:
+        return self._lib.mst_start_next_pass(self._h)
+
+    def counts(self):
+        return {
+            "todo": self._lib.mst_num_todo(self._h),
+            "pending": self._lib.mst_num_pending(self._h),
+            "done": self._lib.mst_num_done(self._h),
+            "failed": self._lib.mst_num_failed(self._h),
+            "pass": self._lib.mst_pass(self._h),
+        }
+
+    def snapshot(self, path: str) -> bool:
+        return self._lib.mst_snapshot(self._h, path.encode()) == 0
+
+    def restore(self, path: str) -> bool:
+        return self._lib.mst_restore(self._h, path.encode()) == 0
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: Master = self.server.master  # type: ignore[attr-defined]
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+                op = req["op"]
+                if op == "get":
+                    tid, payload = master.get_task(req.get("trainer", 0))
+                    resp = {"id": tid,
+                            "payload": payload.decode("latin-1")
+                            if payload is not None else None}
+                elif op == "finished":
+                    resp = {"ok": master.task_finished(req["id"])}
+                elif op == "failed":
+                    resp = {"ok": master.task_failed(req["id"])}
+                elif op == "next_pass":
+                    resp = {"pass": master.start_next_pass()}
+                elif op == "counts":
+                    resp = {k: int(v) for k, v in master.counts().items()}
+                else:
+                    resp = {"error": f"unknown op {op!r}"}
+            except Exception as e:  # noqa: BLE001 - report to client
+                resp = {"error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class MasterServer:
+    """TCP JSON-lines service around a :class:`Master` (coordinator side)."""
+
+    def __init__(self, master: Master, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.master = master
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.master = master  # type: ignore[attr-defined]
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient:
+    """Trainer-side client with reconnect (``go/connection/conn.go`` twin)."""
+
+    def __init__(self, address, trainer: int = 0, retry_interval: float = 0.5,
+                 max_retries: int = 20):
+        self.address = tuple(address)
+        self.trainer = trainer
+        self.retry_interval = retry_interval
+        self.max_retries = max_retries
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+
+    def _call(self, req: dict) -> dict:
+        last_err: Optional[Exception] = None
+        for _ in range(self.max_retries):
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self.address,
+                                                          timeout=30)
+                    self._file = self._sock.makefile("rwb")
+                self._file.write((json.dumps(req) + "\n").encode())
+                self._file.flush()
+                line = self._file.readline()
+                if not line:
+                    raise ConnectionError("master closed connection")
+                return json.loads(line)
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                last_err = e
+                self.close()
+                time.sleep(self.retry_interval)
+        raise ConnectionError(f"master unreachable: {last_err}")
+
+    def get_task(self):
+        resp = self._call({"op": "get", "trainer": self.trainer})
+        payload = resp.get("payload")
+        return resp["id"], (payload.encode("latin-1")
+                            if payload is not None else None)
+
+    def task_finished(self, task_id: int) -> bool:
+        return bool(self._call({"op": "finished", "id": task_id}).get("ok"))
+
+    def task_failed(self, task_id: int) -> bool:
+        return bool(self._call({"op": "failed", "id": task_id}).get("ok"))
+
+    def start_next_pass(self) -> int:
+        return int(self._call({"op": "next_pass"}).get("pass", -1))
+
+    def counts(self) -> dict:
+        return self._call({"op": "counts"})
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+                self._file = None
+
+
+def recordio_tasks(paths: Sequence[str],
+                   records_per_task: int = 1024) -> List[bytes]:
+    """Partition recordio files into task payloads (the partition step of
+    ``go/master/service.go:106``): each task is a JSON shard descriptor
+    ``{"path", "start", "count"}``."""
+    from paddle_tpu.io import recordio
+    tasks = []
+    for path in paths:
+        n = recordio.num_records(path)
+        for start in range(0, n, records_per_task):
+            tasks.append(json.dumps({
+                "path": path, "start": start,
+                "count": min(records_per_task, n - start)}).encode())
+    return tasks
+
+
+def task_reader(client, poll_interval: float = 0.2,
+                max_passes: int = 1) -> Callable[[], Iterable[bytes]]:
+    """Reader over master-dispatched recordio shards (trainer loop of
+    ``go/master/client.go:119-239``): pull a task, stream its records,
+    ack; on reader error, nack so another trainer can retry it."""
+    from paddle_tpu.io import recordio
+
+    def reader():
+        passes = 0
+        while passes < max_passes:
+            tid, payload = client.get_task()
+            if tid == PASS_END:
+                passes += 1
+                if passes >= max_passes:
+                    return
+                client.start_next_pass()
+                continue
+            if tid == PASS_WAIT:
+                time.sleep(poll_interval)
+                continue
+            desc = json.loads(payload)
+            try:
+                for rec in recordio.read_range(desc["path"], desc["start"],
+                                               desc["count"]):
+                    yield rec
+            except Exception:
+                client.task_failed(tid)
+                raise
+            client.task_finished(tid)
+
+    return reader

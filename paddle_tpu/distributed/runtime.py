@@ -1,0 +1,75 @@
+"""Multi-host runtime: process bootstrap + data sharding helpers.
+
+TPU-native replacement for the reference's cluster plumbing: where the
+reference wires trainers to pservers over gflag-configured TCP endpoints
+(``pserver/LightNetwork.*``, ``scripts/cluster_train/paddle.py``) and
+discovers peers through etcd (``go/pserver/etcd_client.go``), a JAX job
+uses the built-in coordination service — ``jax.distributed.initialize``
+connects every host to process 0, after which ``jax.devices()`` spans the
+whole slice/pod and XLA compiles cross-host collectives onto ICI/DCN
+directly; no parameter-server processes exist.
+
+What remains framework-level is (a) bootstrap conventions, (b) "which rows
+of the global batch does this host feed" (the per-trainer dataset split of
+``scripts/cluster_train``), and (c) the coordinator role for the dataset
+master (paddle_tpu.distributed.master).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Connect this host to the JAX distributed runtime.
+
+    No-op on single-process jobs (everything auto-detects on Cloud TPU via
+    the metadata server; explicit args cover manual clusters).  Safe to call
+    more than once.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None \
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and os.environ.get("TPU_WORKER_HOSTNAMES") is None:
+        _initialized = True  # single-process: nothing to do
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """Process 0 hosts the dataset master and writes checkpoints metadata."""
+    return jax.process_index() == 0
+
+
+def local_data_shard(global_batch: int) -> Tuple[int, int]:
+    """(start, size) of this host's slice of each global batch — the twin of
+    the reference's per-trainer dataset split (each trainer reads its own
+    file shard, ``scripts/cluster_train/conf.py``)."""
+    n = jax.process_count()
+    i = jax.process_index()
+    base = global_batch // n
+    extra = global_batch % n
+    start = i * base + min(i, extra)
+    size = base + (1 if i < extra else 0)
+    return start, size

@@ -22,24 +22,12 @@ _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 _lib: Optional[ctypes.CDLL] = None
 
 
-def _build() -> None:
-    src = os.path.join(_CSRC, "recordio.cc")
-    subprocess.run(
-        ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",
-         "-o", _LIB_PATH, src],
-        check=True, capture_output=True)
-
-
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    src = os.path.join(_CSRC, "recordio.cc")
-    if (not os.path.exists(_LIB_PATH)
-            or (os.path.exists(src)
-                and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))):
-        _build()
-    lib = ctypes.CDLL(_LIB_PATH)
+    from paddle_tpu.utils.native import load_library
+    lib = load_library("recordio.cc", _LIB_PATH)
     lib.recordio_writer_open.restype = ctypes.c_void_p
     lib.recordio_writer_open.argtypes = [ctypes.c_char_p]
     lib.recordio_writer_put.restype = ctypes.c_int
@@ -155,3 +143,17 @@ def reader_creator(path: str, prefetch: int = 64):
         with Reader(path, prefetch) as r:
             yield from r
     return reader
+
+
+def num_records(path: str) -> int:
+    with Reader(path, prefetch=1) as r:
+        return len(r)
+
+
+def read_range(path: str, start: int, count: int) -> Iterator[bytes]:
+    """Stream ``count`` records starting at ``start`` (O(1) index seek) —
+    the shard-read primitive the master's task dispatch hands to trainers."""
+    with Reader(path, prefetch=1) as r:
+        n = len(r)
+        for i in range(start, min(start + count, n)):
+            yield r.get(i)

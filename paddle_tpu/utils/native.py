@@ -1,0 +1,36 @@
+"""Build-on-demand loader for the native (C++) runtime libraries.
+
+One place owns the compile-if-stale logic for every ``csrc/*.cc`` →
+``lib*.so`` pair (recordio, master) so the g++ flags exist exactly once in
+Python (mirroring ``csrc/Makefile``) and loading is thread-safe.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_FLAGS = ["-O2", "-fPIC", "-std=c++17", "-pthread", "-shared"]
+_lock = threading.Lock()
+_cache: Dict[str, ctypes.CDLL] = {}
+
+
+def load_library(src_name: str, lib_path: str) -> ctypes.CDLL:
+    """Load ``lib_path``, rebuilding from ``csrc/<src_name>`` if the source
+    is newer (or the .so is missing).  Cached per path, thread-safe."""
+    with _lock:
+        if lib_path in _cache:
+            return _cache[lib_path]
+        src = os.path.join(_CSRC, src_name)
+        if (not os.path.exists(lib_path)
+                or (os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(lib_path))):
+            subprocess.run(["g++", *_FLAGS, "-o", lib_path, src],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(lib_path)
+        _cache[lib_path] = lib
+        return lib
