@@ -1,0 +1,28 @@
+"""Declarative v2-style user API.
+
+Twin of the reference's ``paddle.v2`` workflow (``python/paddle/v2/``:
+``layer.py`` declarative layer graph → ``topology.py`` extraction →
+``trainer.py`` SGD loop → ``inference.py``), re-imagined for the TPU build:
+instead of emitting a protobuf ``ModelConfig`` interpreted by a C++ engine
+(``v2/layer.py:263 parse_network``), the layer functions build a small DAG
+of :class:`LayerOutput` nodes that *compiles to a model_fn* — a pure JAX
+function over named batch fields — which jit/pjit then lower to XLA.  The
+"config → IR" step of the reference becomes "DAG → jaxpr".
+
+    import paddle_tpu.api as api
+    img    = api.layer.data("pixel", shape=(784,))
+    label  = api.layer.data("label", dtype="int32")
+    hidden = api.layer.fc(img, size=200, act="tanh")
+    pred   = api.layer.fc(hidden, size=10, act="softmax")
+    cost   = api.layer.classification_cost(pred, label)
+    trainer = api.SGD(cost, api.optimizer.Momentum(learning_rate=0.1))
+    trainer.train(reader, num_passes=5)
+"""
+
+from paddle_tpu.api import layer
+from paddle_tpu.api.graph import LayerOutput, topology, compile_model
+from paddle_tpu.api.trainer import SGD, infer
+from paddle_tpu.api import optimizer
+
+__all__ = ["layer", "LayerOutput", "topology", "compile_model", "SGD",
+           "infer", "optimizer"]

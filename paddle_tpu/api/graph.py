@@ -1,0 +1,123 @@
+"""The declarative layer DAG and its compilation to a model_fn.
+
+Twin of the reference's ``Topology`` (``python/paddle/v2/topology.py:26`` —
+walks the layer graph behind a cost and extracts the serialized model
+config) except the "serialized config" here is (a) a JSON-able topology
+description for introspection/checkpoint metadata and (b) a compiled pure
+``model_fn(batch) -> (loss, outputs)`` consumed by the Trainer — tracing
+under jit replaces the protobuf→C++ interpreter path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.core.errors import enforce
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerOutput:
+    """A node in the declarative graph.
+
+    ``fn(ctx, *input_values, **attrs)`` produces the node's value; data
+    nodes read ``ctx.batch[name]`` instead.  Nodes are frozen/hashable so
+    the graph memoizes shared sub-expressions exactly like the reference's
+    name-keyed layer map.
+    """
+    name: str
+    kind: str
+    fn: Optional[Callable] = dataclasses.field(default=None, compare=False,
+                                               hash=False, repr=False)
+    inputs: Tuple["LayerOutput", ...] = ()
+    attrs: Tuple[Tuple[str, Any], ...] = ()
+
+    def attr_dict(self) -> Dict[str, Any]:
+        return dict(self.attrs)
+
+
+class _Ctx:
+    def __init__(self, batch: Dict[str, Any], is_train: bool):
+        self.batch = batch
+        self.is_train = is_train
+        self.cache: Dict[LayerOutput, Any] = {}
+        self.outputs: Dict[str, Any] = {}
+
+
+_name_counters: Dict[str, int] = {}
+
+
+def auto_name(kind: str, explicit: Optional[str]) -> str:
+    if explicit is not None:
+        return explicit
+    idx = _name_counters.get(kind, 0)
+    _name_counters[kind] = idx + 1
+    return f"{kind}_{idx}"
+
+
+def reset_names() -> None:
+    """Reset auto-naming (call between independent model builds)."""
+    _name_counters.clear()
+
+
+def _evaluate(node: LayerOutput, ctx: _Ctx):
+    if node in ctx.cache:
+        return ctx.cache[node]
+    if node.kind == "data":
+        enforce(node.name in ctx.batch,
+                "data layer %r missing from batch (has %s)", node.name,
+                sorted(ctx.batch))
+        value = ctx.batch[node.name]
+    else:
+        args = [_evaluate(i, ctx) for i in node.inputs]
+        value = node.fn(ctx, *args, **node.attr_dict())
+    ctx.cache[node] = value
+    return value
+
+
+def _walk(nodes: Sequence[LayerOutput]) -> List[LayerOutput]:
+    seen: Dict[LayerOutput, None] = {}
+
+    def visit(n: LayerOutput):
+        if n in seen:
+            return
+        for i in n.inputs:
+            visit(i)
+        seen[n] = None
+
+    for n in nodes:
+        visit(n)
+    return list(seen)
+
+
+def topology(*outputs: LayerOutput) -> List[Dict[str, Any]]:
+    """JSON-able description of the graph behind ``outputs`` in topological
+    order (the Topology.proto() twin)."""
+    desc = []
+    for n in _walk(outputs):
+        desc.append({
+            "name": n.name,
+            "type": n.kind,
+            "inputs": [i.name for i in n.inputs],
+            "attrs": {k: v for k, v in n.attrs
+                      if isinstance(v, (int, float, str, bool, type(None)))},
+        })
+    return desc
+
+
+def compile_model(cost: LayerOutput,
+                  extra_outputs: Sequence[LayerOutput] = ()):
+    """Compile the DAG behind ``cost`` into ``model_fn(batch)`` for the
+    Trainer: returns (loss, outputs) where outputs includes every
+    ``extra_outputs`` node by name plus any label fields the cost saw."""
+
+    def model_fn(batch: Dict[str, Any]):
+        from paddle_tpu.nn.module import is_training
+        ctx = _Ctx(batch, is_training())
+        loss = _evaluate(cost, ctx)
+        outs = dict(ctx.outputs)
+        for node in extra_outputs:
+            outs[node.name] = _evaluate(node, ctx)
+        return loss, outs
+
+    return model_fn

@@ -1,0 +1,262 @@
+"""Declarative layer functions (the ``paddle.v2.layer`` /
+``trainer_config_helpers/layers.py`` twin).
+
+Each function returns a :class:`LayerOutput` node; calling conventions
+mirror the v1 helper API (``layers.py:34`` — ``fc_layer``, ``embedding``,
+``lstmemory``, cost layers...) while the bodies are thin closures over the
+``paddle_tpu.nn`` modules and ``paddle_tpu.ops`` functions, created with
+stable names so parameters live at predictable paths.
+
+Sequence-valued nodes are (value, mask) pairs — the TPU-native stand-in for
+the reference's ``Argument.sequenceStartPositions`` padding-free batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.api.graph import LayerOutput, auto_name
+from paddle_tpu.ops import losses as loss_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+def _node(kind, fn, inputs, name=None, **attrs):
+    return LayerOutput(name=auto_name(kind, name), kind=kind, fn=fn,
+                       inputs=tuple(inputs),
+                       attrs=tuple(sorted(attrs.items())))
+
+
+def _is_seq(v) -> bool:
+    return isinstance(v, tuple) and len(v) == 2
+
+
+def _val(v):
+    return v[0] if _is_seq(v) else v
+
+
+def _mask(v):
+    return v[1] if _is_seq(v) else None
+
+
+# ---- inputs ----------------------------------------------------------------
+
+def data(name: str, dtype: str = "float32", sequence: bool = False):
+    """Input node reading ``batch[name]`` (v2 ``layer.data`` twin).  With
+    ``sequence=True`` the node reads ``batch[name]`` and
+    ``batch[name + "_mask"]`` as a (value, mask) pair."""
+    if not sequence:
+        return LayerOutput(name=name, kind="data")
+    base = LayerOutput(name=name, kind="data")
+    mask = LayerOutput(name=f"{name}_mask", kind="data")
+    return _node("seq_pair", lambda ctx, v, m: (v, m), [base, mask],
+                 name=f"{name}_seq")
+
+
+# ---- core layers -----------------------------------------------------------
+
+def fc(input, size: int, act: str = "linear", bias: bool = True,
+       name: Optional[str] = None):
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.Linear(a["size"], act=a["act"], bias=a["bias"],
+                      name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("fc", name)
+    return _node("fc", run, [input], name=n, size=size, act=act, bias=bias,
+                 _name=n)
+
+
+def embedding(input, size: int, vocab_size: int, name: Optional[str] = None):
+    def run(ctx, ids, **a):
+        m = _mask(ids)
+        y = nn.Embedding(a["vocab_size"], a["size"], name=a["_name"])(_val(ids))
+        return (y, m) if m is not None else y
+    n = auto_name("embedding", name)
+    return _node("embedding", run, [input], name=n, size=size,
+                 vocab_size=vocab_size, _name=n)
+
+
+def conv2d(input, channels: int, kernel: int = 3, stride: int = 1,
+           act: str = "relu", padding="SAME", name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.Conv2D(a["channels"], a["kernel"], stride=a["stride"],
+                         padding=a["padding"], act=a["act"],
+                         name=a["_name"])(x)
+    n = auto_name("conv2d", name)
+    return _node("conv2d", run, [input], name=n, channels=channels,
+                 kernel=kernel, stride=stride, act=act, padding=padding,
+                 _name=n)
+
+
+def pool2d(input, kernel: int = 2, stride: Optional[int] = None,
+           pool_type: str = "max", name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.Pool2D(a["kernel"], stride=a["stride"],
+                         pool_type=a["pool_type"])(x)
+    return _node("pool2d", run, [input], name=name, kernel=kernel,
+                 stride=stride, pool_type=pool_type)
+
+
+def batch_norm(input, act: str = "linear", name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.BatchNorm(act=a["act"], name=a["_name"])(x)
+    n = auto_name("batch_norm", name)
+    return _node("batch_norm", run, [input], name=n, act=act, _name=n)
+
+
+def dropout(input, rate: float, name: Optional[str] = None):
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.Dropout(a["rate"], name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("dropout", name)
+    return _node("dropout", run, [input], name=n, rate=rate, _name=n)
+
+
+def concat(inputs: Sequence[LayerOutput], name: Optional[str] = None):
+    def run(ctx, *xs):
+        return jnp.concatenate([_val(x) for x in xs], axis=-1)
+    return _node("concat", run, list(inputs), name=name)
+
+
+def addto(inputs: Sequence[LayerOutput], act: str = "linear",
+          name: Optional[str] = None):
+    def run(ctx, *xs, **a):
+        return nn.Addto(act=a["act"], name=a["_name"])(*[_val(x) for x in xs])
+    n = auto_name("addto", name)
+    return _node("addto", run, list(inputs), name=n, act=act, _name=n)
+
+
+# ---- recurrent / sequence --------------------------------------------------
+
+def lstmemory(input, size: int, reverse: bool = False,
+              name: Optional[str] = None):
+    """Full-sequence LSTM over a (value, mask) pair (lstmemory twin)."""
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "lstmemory needs a sequence input")
+        from paddle_tpu.nn.recurrent import LSTM
+        hs, _ = LSTM(a["size"], reverse=a["reverse"], name=a["_name"])(
+            x[0], x[1])
+        return (hs, x[1])
+    n = auto_name("lstmemory", name)
+    return _node("lstmemory", run, [input], name=n, size=size,
+                 reverse=reverse, _name=n)
+
+
+def grumemory(input, size: int, reverse: bool = False,
+              name: Optional[str] = None):
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "grumemory needs a sequence input")
+        from paddle_tpu.nn.recurrent import GRU
+        hs, _ = GRU(a["size"], reverse=a["reverse"], name=a["_name"])(
+            x[0], x[1])
+        return (hs, x[1])
+    n = auto_name("grumemory", name)
+    return _node("grumemory", run, [input], name=n, size=size,
+                 reverse=reverse, _name=n)
+
+
+def seq_pool(input, pool_type: str = "avg", name: Optional[str] = None):
+    """Sequence pooling to a fixed vector (pooling_layer twin)."""
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "seq_pool needs a sequence input")
+        return seq_ops.sequence_pool(x[0], x[1], a["pool_type"])
+    return _node("seq_pool", run, [input], name=name, pool_type=pool_type)
+
+
+def last_seq(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return seq_ops.last_seq(x[0], x[1])
+    return _node("last_seq", run, [input], name=name)
+
+
+def first_seq(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return seq_ops.first_seq(x[0], x[1])
+    return _node("first_seq", run, [input], name=name)
+
+
+def context_projection(input, context_len: int, context_start: int,
+                       name: Optional[str] = None):
+    def run(ctx, x, **a):
+        y = seq_ops.context_projection(x[0], x[1], a["context_len"],
+                                       a["context_start"])
+        return (y, x[1])
+    return _node("context_projection", run, [input], name=name,
+                 context_len=context_len, context_start=context_start)
+
+
+# ---- costs -----------------------------------------------------------------
+
+def _record_label(ctx, logits, label, extra=None):
+    ctx.outputs["logits"] = logits
+    ctx.outputs["label"] = label
+    if extra:
+        ctx.outputs.update(extra)
+
+
+def classification_cost(input, label, name: Optional[str] = None):
+    """Softmax cross-entropy against integer labels
+    (classification_cost twin).  Records logits/label for evaluators."""
+    def run(ctx, logits, y):
+        logits = _val(logits)
+        _record_label(ctx, logits, y)
+        return loss_ops.softmax_cross_entropy(logits, y).mean()
+    return _node("classification_cost", run, [input, label], name=name)
+
+
+def square_error_cost(input, label, name: Optional[str] = None):
+    def run(ctx, pred, y):
+        pred = _val(pred)
+        ctx.outputs["pred"] = pred
+        ctx.outputs["label"] = y
+        return loss_ops.square_error(pred, y).mean()
+    return _node("square_error_cost", run, [input, label], name=name)
+
+
+def cross_entropy_with_sequence(input, label, name: Optional[str] = None):
+    """Per-step CE over a (logits, mask) sequence vs int labels [b, t]."""
+    def run(ctx, logits, y):
+        enforce(_is_seq(logits), "needs sequence logits")
+        val, mask = logits
+        ce = loss_ops.softmax_cross_entropy(val, y)
+        m = mask.astype(val.dtype)
+        _record_label(ctx, val, y, {"label_mask": mask})
+        return (ce * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return _node("seq_cross_entropy", run, [input, label], name=name)
+
+
+def crf_cost(input, label, num_tags: int, name: Optional[str] = None):
+    """Linear-chain CRF negative log-likelihood over a sequence
+    (crf_layer twin, ``LinearChainCRF.cpp``)."""
+    def run(ctx, emissions, y, **a):
+        enforce(_is_seq(emissions), "crf needs sequence emissions")
+        val, mask = emissions
+        from paddle_tpu.ops import crf as crf_ops
+        from paddle_tpu.nn.module import param
+        from paddle_tpu.nn import initializers as init
+        k = a["num_tags"]
+        trans = param(f"{a['_name']}/transitions", (k, k), jnp.float32,
+                      init.zeros)
+        start = param(f"{a['_name']}/start", (k,), jnp.float32, init.zeros)
+        stop = param(f"{a['_name']}/stop", (k,), jnp.float32, init.zeros)
+        ll = crf_ops.crf_log_likelihood(val, y, mask, trans, start, stop)
+        ctx.outputs["emissions"] = val
+        ctx.outputs["label"] = y
+        ctx.outputs["label_mask"] = mask
+        return -ll.mean()
+    n = auto_name("crf", name)
+    return _node("crf", run, [input, label], name=n, num_tags=num_tags,
+                 _name=n)
+
+
+# ---- misc ------------------------------------------------------------------
+
+def max_id(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return jnp.argmax(_val(x), axis=-1)
+    return _node("max_id", run, [input], name=name)

@@ -1,0 +1,72 @@
+"""v2-style optimizer config objects (``paddle.v2.optimizer`` twin).
+
+Each class is a thin builder over :class:`OptimizationConfig` →
+``optim.from_config`` (clip → decay → optimizer with LR schedule), matching
+the constructor shapes of the reference's ``v2/optimizer.py``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import optim
+from paddle_tpu.core.config import OptimizationConfig
+
+
+class _Base:
+    method = "sgd"
+
+    def __init__(self, learning_rate: float = 0.01,
+                 learning_rate_schedule: str = "constant",
+                 learning_rate_decay_a: float = 0.0,
+                 learning_rate_decay_b: float = 0.0,
+                 l1_rate: float = 0.0, l2_rate: float = 0.0,
+                 gradient_clipping_threshold: float = 0.0,
+                 average_window: int = 0, **extra):
+        self.config = OptimizationConfig(
+            learning_rate=learning_rate,
+            learning_method=self.method,
+            learning_rate_schedule=learning_rate_schedule,
+            learning_rate_decay_a=learning_rate_decay_a,
+            learning_rate_decay_b=learning_rate_decay_b,
+            l1_rate=l1_rate, l2_rate=l2_rate,
+            gradient_clipping_threshold=gradient_clipping_threshold,
+            average_window=average_window,
+            extra=extra)
+
+    def build(self) -> optim.Transform:
+        return optim.from_config(self.config)
+
+
+class SGDOpt(_Base):
+    method = "sgd"
+
+
+class Momentum(_Base):
+    method = "momentum"
+
+    def __init__(self, momentum: float = 0.9, **kwargs):
+        super().__init__(**kwargs)
+        self.config.momentum = momentum
+
+
+class AdaGrad(_Base):
+    method = "adagrad"
+
+
+class AdaDelta(_Base):
+    method = "adadelta"
+
+
+class RMSProp(_Base):
+    method = "rmsprop"
+
+
+class DecayedAdaGrad(_Base):
+    method = "decayed_adagrad"
+
+
+class Adam(_Base):
+    method = "adam"
+
+
+class Adamax(_Base):
+    method = "adamax"

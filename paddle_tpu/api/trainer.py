@@ -1,0 +1,81 @@
+"""v2-style SGD trainer + infer over the declarative graph.
+
+Twin of ``paddle.v2.trainer.SGD`` (``python/paddle/v2/trainer.py:24`` —
+``SGD(cost, parameters, update_equation).train(reader, event_handler,
+num_passes)``) and ``paddle.v2.infer`` (``v2/inference.py:111``), layered
+on the framework Trainer: the declarative cost node compiles to a
+model_fn, events/evaluators/checkpointing come along for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.api.graph import LayerOutput, compile_model, topology
+from paddle_tpu.training import Trainer as _Trainer
+from paddle_tpu.training import events as ev
+from paddle_tpu.training.evaluators import Evaluator
+
+
+class SGD:
+    """Declarative-graph trainer.
+
+    ``optimizer`` is a ``paddle_tpu.api.optimizer`` config object (or a raw
+    ``optim.Transform``).  ``extra_outputs`` nodes are evaluated alongside
+    the cost and appear in batch outputs (for evaluators/events).
+    """
+
+    def __init__(self, cost: LayerOutput, optimizer,
+                 extra_outputs: Sequence[LayerOutput] = (),
+                 mesh=None, param_rules=None, seed: int = 0):
+        self.cost = cost
+        transform = optimizer.build() if hasattr(optimizer, "build") \
+            else optimizer
+        self.trainer = _Trainer(compile_model(cost, extra_outputs),
+                                transform, seed=seed, mesh=mesh,
+                                param_rules=param_rules)
+
+    @property
+    def parameters(self):
+        return self.trainer.params
+
+    def topology(self):
+        return topology(self.cost)
+
+    def train(self, reader: Callable[[], Iterable[Dict[str, Any]]],
+              num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              evaluators: Sequence[Evaluator] = (),
+              save_dir: Optional[str] = None):
+        return self.trainer.train(reader, num_passes=num_passes,
+                                  event_handler=event_handler,
+                                  evaluators=evaluators, save_dir=save_dir)
+
+    def test(self, reader, evaluators: Sequence[Evaluator] = ()):
+        return self.trainer.test(reader, evaluators=evaluators)
+
+    def save(self, directory: str, pass_id: int = 0):
+        return self.trainer.save(directory, pass_id)
+
+    def restore(self, directory: str, pass_id: Optional[int] = None):
+        return self.trainer.restore(directory, pass_id)
+
+
+def infer(output: LayerOutput, parameters, batch: Dict[str, Any],
+          net_state=None):
+    """Evaluate an output node under trained parameters
+    (``paddle.v2.infer`` twin)."""
+    import jax
+    import paddle_tpu.nn as nn
+    from paddle_tpu.api.graph import compile_model
+
+    def fwd(b):
+        from paddle_tpu.api.graph import _Ctx, _evaluate
+        ctx = _Ctx(b, is_train=False)
+        return _evaluate(output, ctx)
+
+    model = nn.transform(fwd)
+    out, _ = model.apply(parameters, net_state or {}, None, batch)
+    return np.asarray(out)

@@ -1,0 +1,165 @@
+"""Command-line entry point: ``python -m paddle_tpu <command>``.
+
+Twin of the reference's CLI surface (``paddle`` shell →
+``paddle_trainer --job=train|test|time`` ``trainer/TrainerMain.cpp:31``,
+``paddle_merge_model`` ``trainer/MergeModel.cpp``, ``paddle version``):
+
+    python -m paddle_tpu train       --config cfg.py --num-passes 5
+    python -m paddle_tpu test        --config cfg.py --checkpoint-dir d/
+    python -m paddle_tpu time        --config cfg.py --batches 50
+    python -m paddle_tpu merge_model --config cfg.py --checkpoint-dir d/ -o m/
+    python -m paddle_tpu version
+
+A config file is plain Python (the reference's config DSL was too —
+``config_parser.py`` ran user Python to emit protobuf) defining:
+
+    model_fn(batch) -> (loss, outputs)      # required
+    optimizer                               # optim.Transform | api optimizer
+    train_reader() -> iterable of batches   # required for train/time
+    test_reader()                           # optional
+    evaluators = [...]                      # optional
+    config_args(args_dict)                  # optional hook, receives
+                                            # --config-args k=v,k=v pairs
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from typing import Any, Dict
+
+__version__ = "0.1.0"
+
+
+def _load_config(path: str, config_args: str):
+    spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
+                                                  path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)  # type: ignore[union-attr]
+    if config_args and hasattr(module, "config_args"):
+        kv = dict(item.split("=", 1) for item in config_args.split(",")
+                  if item)
+        module.config_args(kv)
+    if not hasattr(module, "model_fn"):
+        raise SystemExit(f"{path}: config must define model_fn(batch)")
+    return module
+
+
+def _build_trainer(cfg):
+    from paddle_tpu.training import Trainer
+    opt = getattr(cfg, "optimizer", None)
+    if opt is None:
+        from paddle_tpu import optim
+        opt = optim.sgd(0.01)
+    if hasattr(opt, "build"):
+        opt = opt.build()
+    return Trainer(cfg.model_fn, opt)
+
+
+def cmd_train(args):
+    cfg = _load_config(args.config, args.config_args)
+    trainer = _build_trainer(cfg)
+    if args.checkpoint_dir and args.resume:
+        trainer.restore(args.checkpoint_dir)
+    metrics = trainer.train(
+        cfg.train_reader,
+        num_passes=args.num_passes,
+        evaluators=list(getattr(cfg, "evaluators", [])),
+        test_reader=getattr(cfg, "test_reader", None),
+        save_dir=args.checkpoint_dir,
+        log_period=args.log_period)
+    print(json.dumps(metrics))
+
+
+def cmd_test(args):
+    cfg = _load_config(args.config, args.config_args)
+    trainer = _build_trainer(cfg)
+    reader = getattr(cfg, "test_reader", None) or cfg.train_reader
+    sample = next(iter(reader()))
+    trainer.init(sample)
+    if args.checkpoint_dir:
+        trainer.restore(args.checkpoint_dir)
+    results = trainer.test(reader, list(getattr(cfg, "evaluators", [])))
+    print(json.dumps(results))
+
+
+def cmd_time(args):
+    """Throughput benchmark (TrainerBenchmark.cpp:27-66 twin: burn-in then
+    timed batches, ms/batch printed)."""
+    import itertools
+    import jax
+    cfg = _load_config(args.config, args.config_args)
+    trainer = _build_trainer(cfg)
+
+    batches = list(itertools.islice(iter(cfg.train_reader()),
+                                    max(args.batches, 1)))
+    cycle = itertools.cycle(batches)
+    for _ in range(args.burn_in):
+        trainer.train_batch(next(cycle))
+    jax.block_until_ready(trainer.params)
+    t0 = time.perf_counter()
+    for _ in range(args.batches):
+        loss, _ = trainer.train_batch(next(cycle))
+    jax.block_until_ready(trainer.params)
+    ms = (time.perf_counter() - t0) / args.batches * 1000.0
+    print(json.dumps({"ms_per_batch": ms, "batches": args.batches,
+                      "last_cost": float(loss)}))
+
+
+def cmd_merge_model(args):
+    from paddle_tpu import inference
+    from paddle_tpu.training import checkpoint as ckpt_lib
+    cfg = _load_config(args.config, args.config_args)
+    trees, meta = ckpt_lib.load(args.checkpoint_dir)
+    path = inference.export_model(
+        args.output, trees["params"], trees.get("net_state"),
+        config={"source_checkpoint": args.checkpoint_dir, "meta": meta})
+    print(json.dumps({"exported": path}))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def common(p, need_config=True):
+        if need_config:
+            p.add_argument("--config", required=True,
+                           help="Python config file (see module docstring)")
+            p.add_argument("--config-args", default="",
+                           help="k=v,k=v passed to config_args() hook")
+        p.add_argument("--checkpoint-dir", default=None)
+
+    p = sub.add_parser("train", help="train a model")
+    common(p)
+    p.add_argument("--num-passes", type=int, default=1)
+    p.add_argument("--log-period", type=int, default=0)
+    p.add_argument("--resume", action="store_true")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("test", help="evaluate a checkpoint")
+    common(p)
+    p.set_defaults(fn=cmd_test)
+
+    p = sub.add_parser("time", help="benchmark ms/batch (--job=time twin)")
+    common(p)
+    p.add_argument("--batches", type=int, default=50)
+    p.add_argument("--burn-in", type=int, default=10)
+    p.set_defaults(fn=cmd_time)
+
+    p = sub.add_parser("merge_model", help="export checkpoint for serving")
+    common(p)
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_merge_model)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=lambda a: print(__version__))
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -123,7 +123,8 @@ class Trainer:
     # ---- training ----
 
     def train_batch(self, batch: Dict[str, Any]):
-        enforce(self.params is not None, "Trainer.init(sample_batch) first")
+        if self.params is None:
+            self.init(batch)
         batch = self._put(batch)
         (self.params, self.net_state, self.opt_state, loss,
          outputs) = self._train_step(self.params, self.net_state,
@@ -147,29 +148,38 @@ class Trainer:
               evaluators: Sequence[Evaluator] = (),
               test_reader: Optional[Callable] = None,
               save_dir: Optional[str] = None,
-              log_period: int = 0) -> None:
-        """Pass/batch loop with events (SGD.train twin, v2/trainer.py:117)."""
+              log_period: int = 0) -> Dict[str, Any]:
+        """Pass/batch loop with events (SGD.train twin, v2/trainer.py:117).
+
+        Returns the final pass's metrics: mean ``loss`` plus each
+        evaluator's result (and ``test_*`` metrics when a test_reader is
+        given)."""
         handler = event_handler or (lambda e: None)
+        results: Dict[str, Any] = {}
         for pass_id in range(num_passes):
             handler(ev.BeginPass(pass_id))
             for e in evaluators:
                 e.start()
+            costs = []
             for batch_id, batch in enumerate(reader()):
                 handler(ev.BeginIteration(pass_id, batch_id))
                 loss, outputs = self.train_batch(batch)
                 for e in evaluators:
                     e.update({**outputs, **{k: batch[k] for k in batch}})
                 cost = float(loss)
+                costs.append(cost)
                 if log_period and (batch_id + 1) % log_period == 0:
                     print(f"pass {pass_id} batch {batch_id + 1} "
                           f"cost {cost:.6f}", flush=True)
                 handler(ev.EndIteration(pass_id, batch_id, cost))
             results = {e.name: e.finish() for e in evaluators}
+            results["loss"] = float(np.mean(costs)) if costs else 0.0
             if test_reader is not None:
                 results.update(self.test(test_reader, evaluators))
             if save_dir is not None:
                 self.save(save_dir, pass_id)
             handler(ev.EndPass(pass_id, results))
+        return results
 
     def test(self, reader, evaluators: Sequence[Evaluator] = ()):
         """One evaluation pass (Tester::testOnePeriod twin)."""

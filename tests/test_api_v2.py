@@ -1,0 +1,128 @@
+"""Declarative v2-style API tests: graph build, topology extraction,
+MNIST-style MLP training, sequence LSTM classifier, CRF tagger, infer —
+the workload shapes of the reference's v2 demos run through the
+declarative front end."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.api as api
+from paddle_tpu.api.graph import reset_names
+from paddle_tpu.training.evaluators import ClassificationError
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_names()
+    yield
+
+
+def _mlp_cost():
+    img = api.layer.data("pixel")
+    label = api.layer.data("label", dtype="int32")
+    h = api.layer.fc(img, size=32, act="tanh")
+    pred = api.layer.fc(h, size=10, act="linear", name="pred")
+    return api.layer.classification_cost(pred, label), pred
+
+
+def test_topology_extraction():
+    cost, pred = _mlp_cost()
+    topo = api.topology(cost)
+    kinds = [n["type"] for n in topo]
+    assert kinds.count("fc") == 2
+    assert "data" in kinds and "classification_cost" in kinds
+    names = [n["name"] for n in topo]
+    assert "pred" in names and "pixel" in names
+
+
+def test_mlp_trains_and_infers(rng):
+    cost, pred = _mlp_cost()
+    sgd = api.SGD(cost, api.optimizer.Momentum(momentum=0.9,
+                                               learning_rate=0.1))
+
+    xs = rng.randn(256, 20).astype(np.float32)
+    w = rng.randn(20, 10).astype(np.float32)
+    ys = (xs @ w).argmax(-1).astype(np.int32)
+
+    def reader():
+        for i in range(0, 256, 32):
+            yield {"pixel": xs[i:i + 32], "label": ys[i:i + 32]}
+
+    seen = {}
+
+    def handler(event):
+        if isinstance(event, type(seen)):
+            pass
+
+    metrics = sgd.train(reader, num_passes=8,
+                        evaluators=[ClassificationError()])
+    assert metrics["classification_error"] < 0.35
+
+    out = api.infer(pred, sgd.parameters, {"pixel": xs[:16]})
+    assert out.shape == (16, 10)
+    acc = (out.argmax(-1) == ys[:16]).mean()
+    assert acc > 0.5
+
+
+def test_sequence_lstm_classifier(rng):
+    ids = api.layer.data("ids", dtype="int32", sequence=True)
+    label = api.layer.data("label", dtype="int32")
+    emb = api.layer.embedding(ids, size=16, vocab_size=50)
+    h = api.layer.lstmemory(emb, size=32)
+    pooled = api.layer.seq_pool(h, pool_type="last")
+    pred = api.layer.fc(pooled, size=2)
+    cost = api.layer.classification_cost(pred, label)
+
+    sgd = api.SGD(cost, api.optimizer.Adam(learning_rate=0.01))
+
+    n, t = 64, 12
+    seqs = rng.randint(0, 50, (n, t)).astype(np.int32)
+    labels = (seqs[:, 0] > 24).astype(np.int32)   # first-token rule
+    mask = np.ones((n, t), bool)
+
+    def reader():
+        for i in range(0, n, 16):
+            yield {"ids": seqs[i:i + 16], "ids_mask": mask[i:i + 16],
+                   "label": labels[i:i + 16]}
+
+    losses = []
+    for _ in range(6):
+        m = sgd.train(reader, num_passes=1)
+        losses.append(m["loss"])
+    assert losses[-1] < losses[0]
+
+
+def test_crf_tagger_cost_decreases(rng):
+    words = api.layer.data("words", dtype="int32", sequence=True)
+    tags = api.layer.data("tags", dtype="int32")
+    emb = api.layer.embedding(words, size=8, vocab_size=30)
+    h = api.layer.grumemory(emb, size=16)
+    emissions = api.layer.fc(h, size=5)
+    cost = api.layer.crf_cost(emissions, tags, num_tags=5)
+
+    sgd = api.SGD(cost, api.optimizer.Adam(learning_rate=0.02))
+    n, t = 32, 8
+    w = rng.randint(0, 30, (n, t)).astype(np.int32)
+    y = (w % 5).astype(np.int32)                  # learnable mapping
+    mask = np.ones((n, t), bool)
+
+    def reader():
+        for i in range(0, n, 16):
+            yield {"words": w[i:i + 16], "words_mask": mask[i:i + 16],
+                   "tags": y[i:i + 16]}
+
+    first = sgd.train(reader, num_passes=1)["loss"]
+    for _ in range(6):
+        last = sgd.train(reader, num_passes=1)["loss"]
+    assert last < first
+
+
+def test_data_layer_missing_field_error():
+    img = api.layer.data("pixel")
+    label = api.layer.data("label", dtype="int32")
+    cost = api.layer.classification_cost(api.layer.fc(img, size=4), label)
+    sgd = api.SGD(cost, api.optimizer.SGDOpt())
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError, match="pixel"):
+        sgd.train(lambda: iter([{"label": np.zeros(4, np.int32)}]),
+                  num_passes=1)
