@@ -1,0 +1,55 @@
+"""Program IR: the TPU-native twin of the reference's pre-Fluid framework.
+
+The reference's nascent graph direction (``paddle/framework`` +
+``paddle/operators``, SURVEY.md §2.5) represents a model as a protobuf
+``ProgramDesc`` ⊃ ``BlockDesc`` ⊃ ``OpDesc``/``VarDesc``
+(``framework/framework.proto:33-132``), builds gradients by appending grad
+ops (``framework/backward.cc:426``), and interprets the block with an
+``Executor`` (``framework/executor.cc:59``) dispatching per-op kernels.
+
+Here the same Program/Block/Op/Var IR exists as Python dataclasses (JSON
+serializable instead of protobuf), the "kernel" of every op is a pure
+jax.numpy function, and the Executor offers two modes:
+
+* ``Executor.run`` — eager per-op interpretation (the reference's serial
+  ``Executor::Run`` walk), useful for debugging and op unit tests;
+* ``Executor.compile`` — traces the same walk once into a jittable callable,
+  so the *whole block* becomes one XLA computation: the idiomatic TPU
+  execution of a graph IR.
+
+Gradients: ``append_backward`` mirrors ``AppendBackward`` — reverse walk,
+one grad op per forward op, ``sum`` ops inserted for fan-out.  Each op's
+grad kernel defaults to the jax VJP of its forward kernel (autodiff *is*
+the registered grad variant), with explicit overrides possible exactly like
+``REGISTER_OP(op, class, maker, grad_op, grad_class)``.
+"""
+
+from paddle_tpu.framework.program import (
+    AttrMap,
+    BlockDesc,
+    OpDesc,
+    Program,
+    VarDesc,
+)
+from paddle_tpu.framework.registry import (OpInfo, get_op_info, register_op,
+                                            registered_ops)
+from paddle_tpu.framework.scope import Scope, Variable
+from paddle_tpu.framework.backward import append_backward, grad_var_name
+from paddle_tpu.framework.executor import Executor
+from paddle_tpu.framework import ops as _ops  # noqa: F401  (registers op zoo)
+
+__all__ = [
+    "AttrMap",
+    "BlockDesc",
+    "Executor",
+    "OpDesc",
+    "OpInfo",
+    "Program",
+    "Scope",
+    "VarDesc",
+    "Variable",
+    "append_backward",
+    "get_op_info",
+    "grad_var_name",
+    "register_op",
+]

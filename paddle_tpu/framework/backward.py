@@ -1,0 +1,139 @@
+"""Gradient-op construction.
+
+Twin of ``paddle/framework/backward.cc`` — ``AppendBackward(program)``
+(``backward.cc:426``) / ``BackwardRecursive`` (``backward.cc:100``): walk the
+block's ops in reverse, append one grad op per forward op, insert ``sum``
+ops where a forward variable fans out to several consumers (each consumer
+contributes a ``@GRAD@RENAME@k`` partial, summed before use —
+``backward.cc:233``'s insert-sum-for-duplicated-outputs logic), and honor a
+``no_grad`` set.
+
+Grad ops default to the generic VJP form (``<type>_grad`` executed by the
+Executor via ``jax.vjp`` of the forward kernel); ops registered with an
+explicit ``grad`` maker emit custom descs instead (``GradOpDescMaker`` twin).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.framework.program import BlockDesc, OpDesc, Program
+from paddle_tpu.framework.registry import get_op_info
+
+GRAD_SUFFIX = "@GRAD"  # kGradVarSuffix twin
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def _finalize_grad(block: BlockDesc, var: str,
+                   contribs: Dict[str, List[str]]) -> Optional[str]:
+    """Collapse the pending grad contributions for ``var`` into one name,
+    inserting a ``sum`` op on fan-out (backward.cc:233)."""
+    parts = contribs.pop(var, [])
+    if not parts:
+        return None
+    if len(parts) == 1:
+        return parts[0]
+    out = grad_var_name(var)
+    block.append_op("sum", {"X": parts}, {"Out": out})
+    return out
+
+
+def append_backward(program: Program, loss_name: str,
+                    no_grad_set: Optional[Set[str]] = None,
+                    block_id: int = 0) -> Dict[str, str]:
+    """Append grad ops for every op contributing to ``loss_name``.
+
+    Returns a map ``forward var -> grad var`` for all vars that received a
+    gradient (the caller looks up parameter grads here, as the reference's
+    optimizer ops did by the ``@GRAD`` naming convention).
+    """
+    block = program.block(block_id)
+    no_grad = set(no_grad_set or ())
+    forward_ops = list(block.ops)
+
+    # Which vars feed the loss? Prune the backward walk to the loss closure
+    # (the reference prunes via the no_grad/linkage analysis in
+    # BackwardRecursive).
+    needed: Set[str] = {loss_name}
+    relevant: List[OpDesc] = []
+    for op in reversed(forward_ops):
+        if any(o in needed for o in op.output_names()):
+            relevant.append(op)
+            needed.update(op.input_names())
+    # pending grad contributions: forward var -> [partial grad var names]
+    contribs: Dict[str, List[str]] = {}
+    grad_map: Dict[str, str] = {}
+
+    loss_grad = grad_var_name(loss_name)
+    block.append_op("fill_ones_like", {"X": loss_name}, {"Out": loss_grad})
+    contribs[loss_name] = [loss_grad]
+
+    for op in relevant:
+        info = get_op_info(op.type)
+        # Finalize grads of this op's outputs (contributions all come from
+        # ops later in the program, already processed in this reverse walk).
+        out_grads: Dict[str, Optional[str]] = {}
+        any_grad = False
+        for slot, names in op.outputs.items():
+            for n in names:
+                g = _finalize_grad(block, n, contribs)
+                out_grads[n] = g
+                if g is not None:
+                    grad_map[n] = g
+                    any_grad = True
+        if not any_grad:
+            continue
+
+        def fresh_grad_name(n: str) -> str:
+            """Unique partial-grad name for var ``n``: the first contribution
+            is ``n@GRAD``, later ones ``n@GRAD@RENAME@k`` (fan-out across
+            consumers *or* the same var in two slots of one op)."""
+            k = len(contribs.setdefault(n, []))
+            gname = grad_var_name(n) if k == 0 else \
+                f"{grad_var_name(n)}@RENAME@{k}"
+            contribs[n].append(gname)
+            return gname
+
+        if info.grad is not None:
+            # Explicit maker (GradOpDescMaker twin): receives a name
+            # allocator and returns the grad op descs to append.
+            descs = info.grad(op, out_grads, fresh_grad_name)
+            for type_, inputs, outputs, attrs in descs:
+                block.append_op(type_, inputs, outputs, attrs)
+        else:
+            # Generic VJP grad op: inputs = forward inputs + output grads.
+            # OutGrad is ordered by the op's registered out_slots (the order
+            # the kernel returns outputs in), NOT the desc's dict order.
+            gi: Dict[str, List[str]] = {f"X:{s}": list(ns)
+                                        for s, ns in op.inputs.items()}
+            gi["OutGrad"] = [out_grads[n] or ""
+                             for slot in info.out_slots
+                             for n in op.outputs.get(slot, [])]
+            go: Dict[str, List[str]] = {"InGrad": []}
+            n_grads = 0
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if slot in info.no_grad_slots or n in no_grad:
+                        go["InGrad"].append("")
+                        continue
+                    go["InGrad"].append(fresh_grad_name(n))
+                    n_grads += 1
+            if not n_grads:
+                continue
+            block.append_op(op.type + "_grad", gi, go,
+                            {"__forward__": op.to_dict()})
+
+    # Finalize any vars never consumed as inputs by earlier ops (leaf params).
+    for var in list(contribs):
+        g = _finalize_grad(block, var, contribs)
+        if g is not None:
+            grad_map[var] = g
+    # Normalize: expose every grad under the canonical @GRAD name.
+    for var, g in list(grad_map.items()):
+        if var in no_grad:
+            grad_map.pop(var)
+    return grad_map

@@ -1,0 +1,186 @@
+"""Block execution.
+
+Twin of ``paddle/framework/executor.cc`` — ``Executor::Run`` (:59):
+instantiate the block's vars in a scope, prune to the feed/fetch closure
+(``Prune``), and run ops in order.  Two modes:
+
+* :meth:`Executor.run` — eager walk, one jax call per op (the reference's
+  serial interpreter; here each op still executes on device, just unfused);
+* :meth:`Executor.compile` — the same walk traced once under ``jax.jit`` so
+  the whole block fuses into a single XLA computation.  This is the step the
+  reference never reached (its Executor stayed an interpreter; XLA is our
+  "kernel fusion pass" for free).
+
+Generic ``<type>_grad`` ops (emitted by ``append_backward`` for ops without
+an explicit grad maker) are executed via ``jax.vjp`` of the forward kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.framework.program import BlockDesc, OpDesc, Program
+from paddle_tpu.framework.registry import get_op_info
+from paddle_tpu.framework.scope import Scope
+
+
+def _gather_inputs(op: OpDesc, info, scope: Scope) -> List[Any]:
+    args: List[Any] = []
+    for slot in info.in_slots:
+        names = op.inputs.get(slot, [])
+        if slot in info.variadic:
+            args.append([scope.get(n) for n in names])
+        elif not names:
+            args.append(None)
+        else:
+            enforce(len(names) == 1, "op %s slot %s expects one var, got %s",
+                    op.type, slot, names)
+            args.append(scope.get(names[0]))
+    return args
+
+
+def _scatter_outputs(op: OpDesc, info, scope: Scope, result) -> None:
+    outs = result if isinstance(result, (tuple, list)) else (result,)
+    enforce(len(info.out_slots) == len(outs),
+            "op %s returned %d outputs, expected %d (%s)", op.type,
+            len(outs), len(info.out_slots), info.out_slots)
+    for slot, value in zip(info.out_slots, outs):
+        names = op.outputs.get(slot, [])
+        if slot in info.variadic:
+            enforce(len(names) == len(value),
+                    "op %s variadic out slot %s arity mismatch", op.type, slot)
+            for n, v in zip(names, value):
+                scope.set(n, v)
+        elif names:
+            scope.set(names[0], value)
+
+
+def _run_vjp_grad(op: OpDesc, scope: Scope) -> None:
+    """Execute a generic ``<type>_grad`` op via jax.vjp of the forward."""
+    fwd = OpDesc.from_dict(op.attrs["__forward__"])
+    info = get_op_info(fwd.type)
+
+    # Positional forward inputs in in_slots order, remembering list slots.
+    args = _gather_inputs(fwd, info, scope)
+
+    def forward(*xs):
+        out = info.fn(*xs, **fwd.attrs)
+        if isinstance(out, list):  # normalize (lax.top_k returns a list)
+            return tuple(out)
+        return out if isinstance(out, tuple) else (out,)
+
+    primals, vjp_fn = jax.vjp(forward, *args)
+
+    def zero_ct(p):
+        # Integer outputs (e.g. top_k Indices) take float0 cotangents.
+        if jnp.issubdtype(p.dtype, jnp.inexact):
+            return jnp.zeros_like(p)
+        return np.zeros(p.shape, dtype=jax.dtypes.float0)
+
+    # Cotangents: the grad op's OutGrad inputs, zeros where missing ("").
+    # OutGrad order matches info.out_slots (backward.py), as do primals;
+    # variadic output slots (split) group a list of names per slot.
+    outgrad_names = list(op.inputs["OutGrad"])
+    cotangents: List[Any] = []
+    i = 0
+    for slot, p in zip(info.out_slots, primals):
+        if slot in info.variadic:
+            group = []
+            for pj in p:
+                n = outgrad_names[i]
+                i += 1
+                group.append(scope.get(n) if n else zero_ct(pj))
+            cotangents.append(group)
+        else:
+            n = outgrad_names[i]
+            i += 1
+            cotangents.append(scope.get(n) if n else zero_ct(p))
+    in_grads = vjp_fn(tuple(cotangents))
+
+    # Flatten per-slot grads into the per-var order used by
+    # ``append_backward`` (forward op's input_names(): slots in insertion
+    # order, vars in slot order), then bind the named InGrad outputs.
+    slot_grads = dict(zip(info.in_slots, in_grads))
+    per_var: List[Any] = []
+    for slot, ns in fwd.inputs.items():
+        g = slot_grads.get(slot)
+        if slot in info.variadic:
+            per_var.extend(list(g) if g is not None else [None] * len(ns))
+        else:
+            per_var.append(g)
+    names = op.outputs["InGrad"]
+    enforce(len(per_var) == len(names),
+            "grad arity mismatch for %s", fwd.type)
+    for gname, g in zip(names, per_var):
+        if gname:
+            enforce(g is not None, "no vjp grad for output %s of %s",
+                    gname, fwd.type)
+            scope.set(gname, g)
+
+
+def prune(block: BlockDesc, feeds: Set[str],
+          fetches: Sequence[str]) -> List[OpDesc]:
+    """Keep only ops in the feed→fetch closure (executor.cc Prune twin)."""
+    needed = set(fetches)
+    kept: List[OpDesc] = []
+    for op in reversed(block.ops):
+        if any(o in needed for o in op.output_names()):
+            kept.append(op)
+            needed.update(n for n in op.input_names() if n not in feeds)
+    return list(reversed(kept))
+
+
+class Executor:
+    """Runs a program block over a scope."""
+
+    def __init__(self, prune_graph: bool = True):
+        self.prune_graph = prune_graph
+
+    def _walk(self, program: Program, scope: Scope, block_id: int,
+              feeds: Set[str], fetch_list: Sequence[str]) -> List[Any]:
+        block = program.block(block_id)
+        ops = (prune(block, feeds, fetch_list) if self.prune_graph
+               else block.ops)
+        for op in ops:
+            if op.type.endswith("_grad") and "__forward__" in op.attrs:
+                _run_vjp_grad(op, scope)
+                continue
+            info = get_op_info(op.type)
+            args = _gather_inputs(op, info, scope)
+            result = info.fn(*args, **op.attrs)
+            _scatter_outputs(op, info, scope, result)
+        return [scope.get(n) for n in fetch_list]
+
+    def run(self, program: Program, scope: Scope,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Sequence[str] = (), block_id: int = 0) -> List[Any]:
+        """Eager interpretation (Executor::Run twin)."""
+        feed = feed or {}
+        for name, value in feed.items():
+            scope.set(name, jnp.asarray(value))
+        return self._walk(program, scope, block_id, set(feed), fetch_list)
+
+    def compile(self, program: Program, feed_names: Sequence[str],
+                fetch_list: Sequence[str], scope: Optional[Scope] = None,
+                block_id: int = 0) -> Callable[..., List[Any]]:
+        """Trace the block walk into one jitted callable.
+
+        ``scope`` holds persistable vars (parameters) captured as constants;
+        feeds become traced arguments.  Returns ``fn(*feed_values)``.
+        """
+        base = scope or Scope()
+
+        @jax.jit
+        def fn(*feed_values):
+            local = base.new_child()
+            for name, value in zip(feed_names, feed_values):
+                local.set(name, value)
+            return self._walk(program, local, block_id, set(feed_names),
+                              fetch_list)
+
+        return fn

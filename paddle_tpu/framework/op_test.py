@@ -1,0 +1,137 @@
+"""Per-op test harness.
+
+Twin of ``python/paddle/v2/framework/tests/op_test.py`` —
+``get_numeric_gradient`` (``op_test.py:95``) and
+``OpTest.check_output/check_grad`` (``op_test.py:200-300``): build a
+one-op program, run it through the Executor, compare outputs against a
+numpy reference, and compare ``append_backward`` gradients against central
+finite differences.  Where the reference iterated CPUPlace/GPUPlace, we run
+both the eager interpreter and the jit-compiled path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.framework import (Executor, Program, Scope, append_backward,
+                                  get_op_info)
+
+
+def build_single_op_program(op_type: str, inputs: Dict[str, Any],
+                            attrs: Dict[str, Any],
+                            out_arity: Optional[Dict[str, int]] = None):
+    """Program with one op; returns (program, feed, out_names).
+
+    ``out_arity`` gives the variable count for variadic *output* slots
+    (e.g. ``split``'s Out), which is data-dependent.
+    """
+    info = get_op_info(op_type)
+    prog = Program()
+    block = prog.global_block()
+    feed = {}
+    in_desc: Dict[str, List[str]] = {}
+    for slot, value in inputs.items():
+        if slot in info.variadic:
+            names = [f"{slot.lower()}{i}" for i in range(len(value))]
+            for n, v in zip(names, value):
+                feed[n] = np.asarray(v)
+            in_desc[slot] = names
+        else:
+            name = slot.lower()
+            feed[name] = np.asarray(value)
+            in_desc[slot] = [name]
+    out_names = {}
+    flat_outs = []
+    for slot in info.out_slots:
+        if slot in info.variadic:
+            n = (out_arity or {}).get(slot, 1)
+            out_names[slot] = [f"{slot.lower()}_out{i}" for i in range(n)]
+        else:
+            out_names[slot] = [slot.lower() + "_out"]
+        flat_outs.extend(out_names[slot])
+    block.append_op(op_type, in_desc, out_names, attrs)
+    return prog, feed, flat_outs
+
+
+def check_output(op_type: str, inputs: Dict[str, Any],
+                 expected: Sequence[Any], attrs: Optional[Dict] = None,
+                 atol: float = 1e-5) -> None:
+    """Run the op eager and jitted; both must match ``expected``.
+
+    ``expected`` has one entry per registered out slot; variadic slots
+    (split) pass a list, which also fixes the slot's arity.
+    """
+    info = get_op_info(op_type)
+    out_arity, flat_expected = {}, []
+    for slot, e in zip(info.out_slots, expected):
+        if slot in info.variadic:
+            out_arity[slot] = len(e)
+            flat_expected.extend(e)
+        else:
+            flat_expected.append(e)
+    expected = flat_expected
+    prog, feed, outs = build_single_op_program(op_type, inputs, attrs or {},
+                                               out_arity)
+    executor = Executor()
+    got = executor.run(prog, Scope(), feed, outs)
+    fn = executor.compile(prog, list(feed), outs)
+    got_jit = fn(*[jnp.asarray(v) for v in feed.values()])
+    for g, gj, e in zip(got, got_jit, expected):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e), atol=atol,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(gj), np.asarray(e), atol=atol,
+                                   rtol=1e-4)
+
+
+def numeric_gradient(run, feed: Dict[str, np.ndarray], wrt: str,
+                     delta: float = 1e-3) -> np.ndarray:
+    """Central finite differences of ``run(feed) -> scalar`` wrt one input
+    (get_numeric_gradient twin)."""
+    x = feed[wrt].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat, gflat = x.ravel(), grad.ravel()
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = run({**feed, wrt: x.reshape(x.shape).astype(np.float32)})
+        flat[i] = orig - delta
+        lo = run({**feed, wrt: x.reshape(x.shape).astype(np.float32)})
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def check_grad(op_type: str, inputs: Dict[str, Any],
+               wrt: Sequence[str], attrs: Optional[Dict] = None,
+               out_index: int = 0, atol: float = 5e-3) -> None:
+    """append_backward gradient vs finite differences on sum(out)."""
+    attrs = attrs or {}
+    prog, feed, outs = build_single_op_program(op_type, inputs, attrs)
+    block = prog.global_block()
+    block.append_op("reduce_sum", {"X": outs[out_index]}, {"Out": "loss_s"})
+    block.append_op("reshape", {"X": "loss_s"}, {"Out": "loss"},
+                    {"shape": (1,)})
+    grad_map = append_backward(prog, "loss")
+    executor = Executor()
+
+    # Coerce only float inputs to f32; integer index/label inputs keep
+    # their dtype (they are never differentiated).
+    feed = {k: (np.asarray(v, np.float32)
+                if np.issubdtype(np.asarray(v).dtype, np.floating)
+                else np.asarray(v))
+            for k, v in feed.items()}
+
+    def run_loss(f) -> float:
+        return float(np.asarray(
+            executor.run(prog, Scope(), f, ["loss"])[0])[0])
+
+    for name in wrt:
+        assert name in grad_map, (name, grad_map)
+        analytic = np.asarray(executor.run(prog, Scope(), feed,
+                                           [grad_map[name]])[0])
+        numeric = numeric_gradient(run_loss, dict(feed), name)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=5e-3,
+                                   err_msg=f"{op_type} grad wrt {name}")

@@ -1,0 +1,399 @@
+"""The framework op zoo.
+
+Twin of ``paddle/operators/`` (86 ``REGISTER_OP`` sites, SURVEY.md §2.5):
+every op is a pure jax.numpy kernel registered once — no (dtype, Place)
+kernel maps, no Eigen/cuBLAS split (``operators/math/math_function.*``);
+XLA compiles each for TPU and fuses across ops under ``Executor.compile``.
+
+Gradients come from ``jax.vjp`` of these kernels (see ``backward.py``), so
+no ``*_grad`` kernels are written by hand — the twin of the reference's
+per-op grad classes (e.g. ``mul_grad`` in ``operators/mul_op.cc``) is
+autodiff.  Ops over integer inputs declare ``no_grad_slots``.
+
+Elementwise ops follow numpy broadcasting (the reference's ``axis`` attr on
+``elementwise_*`` emulated a subset of this).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.framework.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.* — 15 kinds, plus the leaky/elu family)
+# ---------------------------------------------------------------------------
+def _act(name, fn):
+    register_op(name, fn, ["X"])
+
+
+_act("sigmoid", jax.nn.sigmoid)
+_act("logsigmoid", jax.nn.log_sigmoid)
+_act("exp", jnp.exp)
+_act("relu", jax.nn.relu)
+_act("tanh", jnp.tanh)
+_act("tanh_shrink", lambda x: x - jnp.tanh(x))
+_act("sqrt", jnp.sqrt)
+_act("abs", jnp.abs)
+_act("reciprocal", lambda x: 1.0 / x)
+_act("log", jnp.log)
+_act("square", jnp.square)
+_act("softsign", jax.nn.soft_sign)
+_act("softplus", jax.nn.softplus)
+register_op("brelu", lambda x, t_min=0.0, t_max=24.0:
+            jnp.clip(x, t_min, t_max), ["X"])
+register_op("soft_relu", lambda x, threshold=40.0:
+            jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold))), ["X"])
+register_op("pow", lambda x, factor=1.0: jnp.power(x, factor), ["X"])
+register_op("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+            scale_b * jnp.tanh(scale_a * x), ["X"])
+register_op("leaky_relu", lambda x, alpha=0.02:
+            jnp.where(x >= 0, x, alpha * x), ["X"])
+register_op("elu", lambda x, alpha=1.0: jax.nn.elu(x, alpha), ["X"])
+register_op("relu6", lambda x: jnp.clip(x, 0.0, 6.0), ["X"])
+register_op("softmax", lambda x: jax.nn.softmax(x, axis=-1), ["X"])
+register_op("log_softmax", lambda x: jax.nn.log_softmax(x, axis=-1), ["X"])
+register_op("hard_shrink", lambda x, threshold=0.5:
+            jnp.where(jnp.abs(x) > threshold, x, 0.0), ["X"])
+register_op("softshrink", lambda x, lambda_=0.5:
+            jnp.sign(x) * jax.nn.relu(jnp.abs(x) - lambda_), ["X"])
+
+# ---------------------------------------------------------------------------
+# elementwise / scale / compare  (elementwise_op.*, scale_op, minus_op)
+# ---------------------------------------------------------------------------
+register_op("elementwise_add", jnp.add, ["X", "Y"])
+register_op("elementwise_sub", jnp.subtract, ["X", "Y"])
+register_op("elementwise_mul", jnp.multiply, ["X", "Y"])
+register_op("elementwise_div", jnp.divide, ["X", "Y"])
+register_op("elementwise_max", jnp.maximum, ["X", "Y"])
+register_op("elementwise_min", jnp.minimum, ["X", "Y"])
+register_op("elementwise_pow", jnp.power, ["X", "Y"])
+register_op("minus", jnp.subtract, ["X", "Y"])
+register_op("scale", lambda x, scale=1.0, bias=0.0:
+            scale * x + bias, ["X"])
+register_op("clip", lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max),
+            ["X"])
+register_op("clip_by_norm", lambda x, max_norm=1.0:
+            x * jnp.minimum(1.0, max_norm /
+                            (jnp.linalg.norm(x.ravel()) + 1e-12)), ["X"])
+
+# ---------------------------------------------------------------------------
+# matmul / fc / sums  (mul_op, fc_op.cc, sum_op, mean_op)
+# ---------------------------------------------------------------------------
+def _mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    # Flatten leading num_col_dims axes into rows, the rest into cols
+    # (mul_op's x_num_col_dims/y_num_col_dims semantics).
+    xm = x.reshape((math.prod(x.shape[:x_num_col_dims]) or 1, -1))
+    ym = y.reshape((math.prod(y.shape[:y_num_col_dims]) or 1, -1))
+    return xm @ ym
+
+
+register_op("mul", _mul, ["X", "Y"])
+register_op("matmul", lambda x, y, transpose_x=False, transpose_y=False:
+            jnp.matmul(jnp.swapaxes(x, -1, -2) if transpose_x else x,
+                       jnp.swapaxes(y, -1, -2) if transpose_y else y),
+            ["X", "Y"])
+
+
+def _fc(x, w, b=None, activation="identity"):
+    out = x.reshape(x.shape[0], -1) @ w
+    if b is not None:
+        out = out + b
+    if activation == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    elif activation == "relu":
+        out = jax.nn.relu(out)
+    elif activation == "tanh":
+        out = jnp.tanh(out)
+    elif activation == "softmax":
+        out = jax.nn.softmax(out, axis=-1)
+    return out
+
+
+register_op("fc", _fc, ["X", "W", "B"])
+register_op("sum", lambda xs: sum(xs[1:], xs[0]), ["X"], variadic=["X"])
+register_op("mean", jnp.mean, ["X"])
+register_op("fill_ones_like", jnp.ones_like, ["X"])
+register_op("fill_zeros_like", jnp.zeros_like, ["X"])
+register_op("fill_constant",
+            lambda shape=(1,), value=0.0, dtype="float32":
+            jnp.full(tuple(shape), value, dtype), [])
+register_op("cast", lambda x, dtype="float32": x.astype(dtype), ["X"])
+
+# ---------------------------------------------------------------------------
+# reductions / shapes  (reduce_op, reshape_op, transpose_op, squeeze...)
+# ---------------------------------------------------------------------------
+register_op("reduce_sum", lambda x, dim=None, keep_dim=False:
+            jnp.sum(x, axis=dim, keepdims=keep_dim), ["X"])
+register_op("reduce_mean", lambda x, dim=None, keep_dim=False:
+            jnp.mean(x, axis=dim, keepdims=keep_dim), ["X"])
+register_op("reduce_max", lambda x, dim=None, keep_dim=False:
+            jnp.max(x, axis=dim, keepdims=keep_dim), ["X"])
+register_op("reduce_min", lambda x, dim=None, keep_dim=False:
+            jnp.min(x, axis=dim, keepdims=keep_dim), ["X"])
+register_op("squared_l2_norm", lambda x: jnp.sum(jnp.square(x)), ["X"])
+register_op("squared_l2_distance", lambda x, y:
+            jnp.sum(jnp.square(x - y), axis=-1), ["X", "Y"])
+register_op("reshape", lambda x, shape=(-1,): x.reshape(tuple(shape)), ["X"])
+register_op("transpose", lambda x, axis=None:
+            jnp.transpose(x, axis), ["X"])
+register_op("concat", lambda xs, axis=0: jnp.concatenate(xs, axis),
+            ["X"], variadic=["X"])
+register_op("split",
+            lambda x, num=2, axis=0: (jnp.split(x, num, axis),),
+            ["X"], out_slots=("Out",), variadic=["Out"])
+register_op("pad", lambda x, paddings=(), pad_value=0.0:
+            jnp.pad(x, [tuple(p) for p in paddings],
+                    constant_values=pad_value), ["X"])
+register_op("crop", lambda x, offsets=(), shape=():
+            lax.dynamic_slice(x, tuple(offsets), tuple(shape)), ["X"])
+
+# ---------------------------------------------------------------------------
+# gather / scatter / lookup / multiplex  (gather_op, lookup_table_op...)
+# ---------------------------------------------------------------------------
+register_op("gather", lambda x, ids: jnp.take(x, ids, axis=0),
+            ["X", "Index"], no_grad_slots=["Index"])
+register_op("scatter", lambda ref, ids, upd: ref.at[ids].add(upd),
+            ["Ref", "Index", "Updates"], no_grad_slots=["Index"])
+register_op("lookup_table", lambda w, ids: jnp.take(w, ids, axis=0),
+            ["W", "Ids"], no_grad_slots=["Ids"])
+register_op("multiplex",
+            lambda ids, xs: jnp.stack(xs, 1)[jnp.arange(len(ids)), ids],
+            ["Ids", "X"], variadic=["X"], no_grad_slots=["Ids"])
+register_op("one_hot", lambda x, depth=2: jax.nn.one_hot(x, depth),
+            ["X"], no_grad_slots=["X"])
+
+# ---------------------------------------------------------------------------
+# losses  (cross_entropy_op, softmax_with_cross_entropy_op, rank_loss_op,
+# margin_rank_loss_op, huber_loss_op, smooth_l1_loss_op)
+# ---------------------------------------------------------------------------
+def _xent(p, label):
+    if label.ndim == p.ndim:  # soft labels
+        return -jnp.sum(label * jnp.log(jnp.maximum(p, 1e-20)), -1,
+                        keepdims=True)
+    return -jnp.log(jnp.maximum(
+        jnp.take_along_axis(p, label[..., None], -1), 1e-20))
+
+
+register_op("cross_entropy", _xent, ["X", "Label"],
+            no_grad_slots=["Label"])
+register_op("softmax_with_cross_entropy",
+            lambda logits, label:
+            (jax.nn.softmax(logits, -1),
+             -jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                  label[..., None], -1)),
+            ["Logits", "Label"], out_slots=("Softmax", "Loss"),
+            no_grad_slots=["Label"])
+register_op("sigmoid_cross_entropy_with_logits",
+            lambda x, label: jax.nn.relu(x) - x * label +
+            jnp.log1p(jnp.exp(-jnp.abs(x))),
+            ["X", "Label"], no_grad_slots=["Label"])
+register_op("rank_loss",
+            lambda label, left, right:
+            jnp.log1p(jnp.exp(left - right)) - label * (left - right),
+            ["Label", "Left", "Right"], no_grad_slots=["Label"])
+register_op("margin_rank_loss",
+            lambda label, x1, x2, margin=0.0:
+            jax.nn.relu(-label * (x1 - x2) + margin),
+            ["Label", "X1", "X2"], no_grad_slots=["Label"])
+register_op("huber_loss",
+            lambda x, y, delta=1.0:
+            jnp.where(jnp.abs(y - x) <= delta,
+                      0.5 * jnp.square(y - x),
+                      delta * (jnp.abs(y - x) - 0.5 * delta)), ["X", "Y"])
+register_op("smooth_l1_loss",
+            lambda x, y, sigma=1.0:
+            jnp.sum(jnp.where(jnp.abs(x - y) < 1.0 / sigma**2,
+                              0.5 * jnp.square((x - y) * sigma),
+                              jnp.abs(x - y) - 0.5 / sigma**2), -1),
+            ["X", "Y"])
+
+# ---------------------------------------------------------------------------
+# conv / pool / norm  (conv2d_op, pool_op, batch_norm_op — cuDNN twins are
+# XLA's native conv/reduce-window lowerings, which tile onto the MXU)
+# ---------------------------------------------------------------------------
+def _conv2d(x, w, stride=1, padding=0, groups=1):
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = ((padding, padding), (padding, padding)) \
+        if isinstance(padding, int) else tuple(padding)
+    return lax.conv_general_dilated(
+        x, w, s, p, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+register_op("conv2d", _conv2d, ["Input", "Filter"])
+
+
+def _pool2d(x, ksize=2, stride=2, padding=0, pooling_type="max"):
+    k = (ksize, ksize) if isinstance(ksize, int) else tuple(ksize)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = ((0, 0), (0, 0), (padding, padding), (padding, padding)) \
+        if isinstance(padding, int) else ((0, 0), (0, 0)) + tuple(padding)
+    dims, strides = (1, 1) + k, (1, 1) + s
+    if pooling_type == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, p)
+    total = lax.reduce_window(x, 0.0, lax.add, dims, strides, p)
+    ones = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, p)
+    return total / ones
+
+
+register_op("pool2d", _pool2d, ["X"])
+
+
+def _batch_norm(x, scale, bias, mean, var, epsilon=1e-5, is_test=True):
+    # Inference-form batch norm (training form lives in nn.BatchNorm where
+    # running stats thread through the module state system).
+    shp = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var.reshape(shp) + epsilon)
+    return (x - mean.reshape(shp)) * inv * scale.reshape(shp) + \
+        bias.reshape(shp)
+
+
+register_op("batch_norm", _batch_norm,
+            ["X", "Scale", "Bias", "Mean", "Variance"])
+register_op("lrn", lambda x, n=5, k=2.0, alpha=1e-4, beta=0.75:
+            x * lax.pow(k + alpha * lax.reduce_window(
+                jnp.square(x), 0.0, lax.add,
+                (1, n, 1, 1), (1, 1, 1, 1),
+                ((0, 0), (n // 2, n - n // 2 - 1), (0, 0), (0, 0))),
+                -beta), ["X"])
+register_op("l2_normalize", lambda x, axis=-1, epsilon=1e-12:
+            x * lax.rsqrt(jnp.sum(jnp.square(x), axis, keepdims=True)
+                          + epsilon), ["X"])
+register_op("dropout",
+            lambda x, mask=None, dropout_prob=0.5, is_test=True:
+            x if is_test or mask is None else x * mask / (1 - dropout_prob),
+            ["X", "Mask"], no_grad_slots=["Mask"])
+
+# ---------------------------------------------------------------------------
+# recurrent units  (lstm_unit_op, gru_unit_op)
+# ---------------------------------------------------------------------------
+def _lstm_unit(x, c_prev, forget_bias=0.0):
+    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return c, h
+
+
+register_op("lstm_unit", _lstm_unit, ["X", "C_prev"],
+            out_slots=("C", "H"))
+
+
+def _gru_unit(x, h_prev, w_hh):
+    # x: precomputed input projection [B, 3H]; gates follow the reference's
+    # update/reset/candidate layout (operators/gru_unit_op.h).
+    H = h_prev.shape[-1]
+    xu, xr, xc = x[..., :H], x[..., H:2 * H], x[..., 2 * H:]
+    hu = h_prev @ w_hh[:, :H]
+    hr = h_prev @ w_hh[:, H:2 * H]
+    u = jax.nn.sigmoid(xu + hu)
+    r = jax.nn.sigmoid(xr + hr)
+    c = jnp.tanh(xc + (r * h_prev) @ w_hh[:, 2 * H:])
+    return u * h_prev + (1 - u) * c
+
+
+register_op("gru_unit", _gru_unit, ["X", "H_prev", "W_hh"])
+
+# ---------------------------------------------------------------------------
+# sequence ops over masked [B, T, ...] batches (sequence_pool/concat/softmax;
+# masks replace LoD — SURVEY.md §5 long-context notes)
+# ---------------------------------------------------------------------------
+def _seq_pool(x, mask, pool_type="average"):
+    m = mask[..., None].astype(x.dtype)
+    if pool_type == "max":
+        return jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+    s = jnp.sum(x * m, axis=1)
+    if pool_type == "sum":
+        return s
+    n = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return s / n if pool_type == "average" else s / jnp.sqrt(n)
+
+
+register_op("sequence_pool", _seq_pool, ["X", "Mask"],
+            no_grad_slots=["Mask"])
+register_op("sequence_softmax",
+            lambda x, mask: jax.nn.softmax(
+                jnp.where(mask, x, -1e9), axis=-1), ["X", "Mask"],
+            no_grad_slots=["Mask"])
+register_op("sequence_concat",
+            lambda xs, axis=1: jnp.concatenate(xs, axis),
+            ["X"], variadic=["X"])
+register_op("sequence_expand",
+            lambda x, t: jnp.broadcast_to(x[:, None, :],
+                                          (x.shape[0], t, x.shape[-1])),
+            ["X"])
+
+# ---------------------------------------------------------------------------
+# metrics / search  (top_k_op, accuracy_op)
+# ---------------------------------------------------------------------------
+register_op("top_k", lambda x, k=1: lax.top_k(x, k), ["X"],
+            out_slots=("Out", "Indices"))
+register_op("accuracy",
+            lambda out, label:
+            jnp.mean((jnp.argmax(out, -1) == label).astype(jnp.float32)),
+            ["Out", "Label"], no_grad_slots=["Out", "Label"])
+
+# ---------------------------------------------------------------------------
+# random  (gaussian_random_op, uniform_random_op) — seeded explicitly, the
+# jax functional-RNG twin of the reference's global generator
+# ---------------------------------------------------------------------------
+register_op("gaussian_random",
+            lambda shape=(1,), mean=0.0, std=1.0, seed=0:
+            mean + std * jax.random.normal(jax.random.key(seed),
+                                           tuple(shape)), [])
+register_op("uniform_random",
+            lambda shape=(1,), min=-1.0, max=1.0, seed=0:
+            jax.random.uniform(jax.random.key(seed), tuple(shape),
+                               minval=min, maxval=max), [])
+
+# ---------------------------------------------------------------------------
+# optimizer ops (sgd_op, momentum_op, adam_op... — the reference made the
+# update step part of the graph; same here, so Executor.compile fuses
+# forward+backward+update into one XLA program)
+# ---------------------------------------------------------------------------
+register_op("sgd", lambda p, g, lr: p - lr * g,
+            ["Param", "Grad", "LearningRate"], out_slots=("ParamOut",))
+register_op("momentum",
+            lambda p, g, v, lr, mu=0.9, use_nesterov=False:
+            ((lambda v2: (p - lr * (g + mu * v2) if use_nesterov
+                          else p - lr * v2, v2))(mu * v + g)),
+            ["Param", "Grad", "Velocity", "LearningRate"],
+            out_slots=("ParamOut", "VelocityOut"))
+
+
+def _adam(p, g, m, v, beta1_pow, beta2_pow, lr, beta1=0.9, beta2=0.999,
+          epsilon=1e-8):
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m2 / (1 - beta1_pow)
+    vhat = v2 / (1 - beta2_pow)
+    return (p - lr * mhat / (jnp.sqrt(vhat) + epsilon), m2, v2,
+            beta1_pow * beta1, beta2_pow * beta2)
+
+
+register_op("adam", _adam,
+            ["Param", "Grad", "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+             "LearningRate"],
+            out_slots=("ParamOut", "Moment1Out", "Moment2Out",
+                       "Beta1PowOut", "Beta2PowOut"))
+register_op("adagrad",
+            lambda p, g, mom, lr, epsilon=1e-6:
+            ((lambda m2: (p - lr * g / (jnp.sqrt(m2) + epsilon), m2))
+             (mom + jnp.square(g))),
+            ["Param", "Grad", "Moment", "LearningRate"],
+            out_slots=("ParamOut", "MomentOut"))
+register_op("rmsprop",
+            lambda p, g, ms, mom, lr, epsilon=1e-6, decay=0.95,
+            momentum=0.0:
+            ((lambda ms2, mom2: (p - mom2, ms2, mom2))
+             (decay * ms + (1 - decay) * jnp.square(g),
+              momentum * mom + lr * g / jnp.sqrt(
+                  decay * ms + (1 - decay) * jnp.square(g) + epsilon))),
+            ["Param", "Grad", "MeanSquare", "Moment", "LearningRate"],
+            out_slots=("ParamOut", "MeanSquareOut", "MomentOut"))

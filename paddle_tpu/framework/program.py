@@ -1,0 +1,174 @@
+"""Program/Block/Op/Var descriptors.
+
+Twin of ``paddle/framework/framework.proto:33-132`` (``OpDesc``/``VarDesc``/
+``BlockDesc``/``ProgramDesc``) and their C++ mirrors (``program_desc.*``,
+``block_desc.*``, ``op_desc.*``, ``var_desc.*``).  Plain dataclasses instead
+of protobuf; ``to_dict``/``from_dict`` give a JSON-stable serialization so
+programs can be saved alongside checkpoints (the reference serialized the
+proto bytes).
+
+Ops name their inputs/outputs through *slots* (``OpDesc.Var`` in the proto:
+a parameter name mapping to a list of variable names) — preserved here as
+``Dict[str, List[str]]`` so multi-input slots (e.g. ``sum``'s ``X``) work
+the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.core.errors import enforce
+
+AttrMap = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class VarDesc:
+    """A named variable slot in a block (``framework.proto:106`` VarDesc).
+
+    ``shape``/``dtype`` are advisory metadata filled by shape inference;
+    ``persistable`` marks parameters that outlive a single run (the
+    reference's distinction between scope-local temporaries and parameter
+    variables).
+    """
+
+    name: str
+    shape: Optional[Tuple[int, ...]] = None
+    dtype: Optional[str] = None
+    persistable: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "persistable": self.persistable,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "VarDesc":
+        shape = tuple(d["shape"]) if d.get("shape") is not None else None
+        return VarDesc(d["name"], shape, d.get("dtype"),
+                       d.get("persistable", False))
+
+
+@dataclasses.dataclass
+class OpDesc:
+    """One operator invocation (``framework.proto:33`` OpDesc)."""
+
+    type: str
+    inputs: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    outputs: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    attrs: AttrMap = dataclasses.field(default_factory=dict)
+
+    def input_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "inputs": {k: list(v) for k, v in self.inputs.items()},
+            "outputs": {k: list(v) for k, v in self.outputs.items()},
+            "attrs": dict(self.attrs),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "OpDesc":
+        return OpDesc(d["type"], {k: list(v) for k, v in d["inputs"].items()},
+                      {k: list(v) for k, v in d["outputs"].items()},
+                      dict(d.get("attrs", {})))
+
+
+class BlockDesc:
+    """An ordered op list + var table (``framework.proto:118`` BlockDesc).
+
+    Blocks chain to a parent (sub-blocks for control flow), mirroring the
+    proto's ``parent_idx``.
+    """
+
+    def __init__(self, program: "Program", idx: int,
+                 parent_idx: Optional[int] = None):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, VarDesc] = {}
+        self.ops: List[OpDesc] = []
+
+    # -- var management ----------------------------------------------------
+    def var(self, name: str, **kwargs) -> VarDesc:
+        """Create or fetch the VarDesc called ``name`` in this block."""
+        if name not in self.vars:
+            self.vars[name] = VarDesc(name, **kwargs)
+        return self.vars[name]
+
+    def find_var(self, name: str) -> Optional[VarDesc]:
+        """Look up ``name`` here or in ancestor blocks (scope chaining)."""
+        if name in self.vars:
+            return self.vars[name]
+        if self.parent_idx is not None:
+            return self.program.block(self.parent_idx).find_var(name)
+        return None
+
+    # -- op management -----------------------------------------------------
+    def append_op(self, type: str, inputs: Dict[str, Any] = None,
+                  outputs: Dict[str, Any] = None,
+                  attrs: AttrMap = None) -> OpDesc:
+        """Append an op; scalar string slot values are promoted to lists."""
+        def norm(d):
+            out: Dict[str, List[str]] = {}
+            for k, v in (d or {}).items():
+                out[k] = [v] if isinstance(v, str) else list(v)
+            return out
+
+        op = OpDesc(type, norm(inputs), norm(outputs), dict(attrs or {}))
+        for name in op.output_names():
+            if name:  # "" marks a skipped grad slot, not a variable
+                self.var(name)
+        self.ops.append(op)
+        return op
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": {k: v.to_dict() for k, v in self.vars.items()},
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+
+class Program:
+    """The whole graph: a list of blocks, block 0 global
+    (``framework.proto:128`` ProgramDesc)."""
+
+    def __init__(self):
+        self.blocks: List[BlockDesc] = [BlockDesc(self, 0)]
+
+    def block(self, idx: int) -> BlockDesc:
+        enforce(0 <= idx < len(self.blocks), "no block %d", idx)
+        return self.blocks[idx]
+
+    def global_block(self) -> BlockDesc:
+        return self.blocks[0]
+
+    def create_block(self, parent_idx: int = 0) -> BlockDesc:
+        b = BlockDesc(self, len(self.blocks), parent_idx)
+        self.blocks.append(b)
+        return b
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"blocks": [b.to_dict() for b in self.blocks]}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Program":
+        prog = Program()
+        prog.blocks = []
+        for bd in d["blocks"]:
+            b = BlockDesc(prog, bd["idx"], bd.get("parent_idx"))
+            b.vars = {k: VarDesc.from_dict(v) for k, v in bd["vars"].items()}
+            b.ops = [OpDesc.from_dict(od) for od in bd["ops"]]
+            prog.blocks.append(b)
+        return prog

@@ -1,0 +1,65 @@
+"""Op registry.
+
+Twin of ``paddle/framework/op_registry.h:160`` (``REGISTER_OP(op, class,
+maker, grad_op, grad_class)``) + ``op_info.*`` (``OpInfoMap``).  Each op
+registers:
+
+* ``fn(*inputs, **attrs) -> output | tuple`` — the kernel, written in pure
+  jax.numpy (one kernel serves interpreter and jit paths; the reference
+  needed a (dtype, Place)-keyed kernel map, ``operator.h:537-589``);
+* ``infer_shape`` — optional shape inference (``shape_inference.h`` twin);
+* ``grad`` — optional explicit grad maker ``(op, out_grads) -> [OpDesc]``
+  (the twin of ``GradOpDescMaker``, ``grad_op_desc_maker.h``).  When absent,
+  ``append_backward`` synthesizes a VJP-based grad op — on a framework whose
+  kernels are jax-traceable, autodiff *is* the registered grad variant.
+
+``n_outputs``/``out_slots`` describe the output arity so the executor can
+map the kernel's return tuple onto the OpDesc's output slots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.core.errors import enforce
+
+
+@dataclasses.dataclass
+class OpInfo:
+    type: str
+    fn: Callable[..., Any]
+    in_slots: Tuple[str, ...]
+    out_slots: Tuple[str, ...]
+    # slots whose value may be a *list* of variables (e.g. sum's X)
+    variadic: Tuple[str, ...] = ()
+    grad: Optional[Callable[..., List[Any]]] = None
+    infer_shape: Optional[Callable[..., Any]] = None
+    # input slots that are not differentiable (integer ids, labels...)
+    no_grad_slots: Tuple[str, ...] = ()
+
+
+_OP_INFO: Dict[str, OpInfo] = {}
+
+
+def register_op(type: str, fn: Callable[..., Any],
+                in_slots: Sequence[str], out_slots: Sequence[str] = ("Out",),
+                variadic: Sequence[str] = (),
+                grad: Optional[Callable[..., List[Any]]] = None,
+                infer_shape: Optional[Callable[..., Any]] = None,
+                no_grad_slots: Sequence[str] = ()) -> OpInfo:
+    enforce(type not in _OP_INFO, "op %r already registered", type)
+    info = OpInfo(type, fn, tuple(in_slots), tuple(out_slots),
+                  tuple(variadic), grad, infer_shape, tuple(no_grad_slots))
+    _OP_INFO[type] = info
+    return info
+
+
+def get_op_info(type: str) -> OpInfo:
+    enforce(type in _OP_INFO, "unregistered op %r (have %s)", type,
+            sorted(_OP_INFO))
+    return _OP_INFO[type]
+
+
+def registered_ops() -> List[str]:
+    return sorted(_OP_INFO)

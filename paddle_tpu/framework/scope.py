@@ -1,0 +1,56 @@
+"""Scope/Variable: name → value map with parent chaining.
+
+Twin of ``paddle/framework/scope.h:37-66`` (``Scope::Var/FindVar`` with
+parent fallback) and the type-erased ``Variable`` (``variable.h``).  Values
+are jax arrays (or any pytree leaf); the buddy-allocated ``holder_``
+indirection disappears — XLA owns device memory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+from paddle_tpu.core.errors import enforce
+
+
+class Variable:
+    """A typed box; ``value`` is usually a jax array."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, value: Any = None):
+        self.name = name
+        self.value = value
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self._vars: Dict[str, Variable] = {}
+
+    def var(self, name: str) -> Variable:
+        """Find or create ``name`` in *this* scope (Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = Variable(name)
+        return self._vars[name]
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        """Find ``name`` here or up the parent chain (Scope::FindVar)."""
+        if name in self._vars:
+            return self._vars[name]
+        return self.parent.find_var(name) if self.parent else None
+
+    def get(self, name: str) -> Any:
+        v = self.find_var(name)
+        enforce(v is not None and v.value is not None,
+                "variable %r not set in scope", name)
+        return v.value
+
+    def set(self, name: str, value: Any) -> None:
+        self.var(name).value = value
+
+    def new_child(self) -> "Scope":
+        return Scope(self)
+
+    def local_names(self) -> Iterator[str]:
+        return iter(self._vars)
