@@ -1,0 +1,403 @@
+/* C inference API implementation: embeds CPython and drives
+ * paddle_tpu/capi_bridge.py.  See capi.h for the surface contract and the
+ * reference mapping (paddle/capi/*).
+ *
+ * Threading model: every entry point takes the GIL (PyGILState_Ensure), so
+ * concurrent callers serialize at the Python boundary exactly like the
+ * reference's shared-param clones serialized on the compute device.  If
+ * this process already hosts a Python interpreter (e.g. the test suite
+ * loading us via ctypes), we attach to it instead of initializing.
+ */
+#include "capi.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+std::mutex g_err_mu;
+
+void set_last_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_last_error = msg;
+}
+
+struct Matrix {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t rows() const { return shape.empty() ? 0 : shape[0]; }
+  int64_t row_elems() const {
+    int64_t n = 1;
+    for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
+    return n;
+  }
+};
+
+struct IVector {
+  std::vector<int32_t> data;
+};
+
+struct Slot {
+  bool is_ids = false;
+  Matrix mat;
+  IVector ids;
+};
+
+struct Arguments {
+  std::vector<Slot> slots;
+};
+
+struct Machine {
+  long handle = 0;
+};
+
+bool g_we_initialized = false;
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+/* Import paddle_tpu.capi_bridge and fetch an attr (new ref). */
+PyObject* bridge_fn(const char* name) {
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_bridge");
+  if (!mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return fn;
+}
+
+}  // namespace
+
+extern "C" {
+
+paddle_error paddle_init(int argc, char** argv) {
+  (void)argc;
+  (void)argv;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    /* Release the GIL so worker threads can PyGILState_Ensure. */
+    (void)PyEval_SaveThread();
+  }
+  return kPD_NO_ERROR;
+}
+
+/* ---- matrix ---- */
+paddle_error paddle_matrix_create(paddle_matrix* mat, uint64_t h, uint64_t w) {
+  if (!mat) return kPD_NULLPTR;
+  auto* m = new Matrix();
+  m->shape = {(int64_t)h, (int64_t)w};
+  m->data.assign(h * w, 0.f);
+  *mat = m;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_create_nd(paddle_matrix* mat, const int64_t* shape,
+                                     int ndim) {
+  if (!mat || !shape || ndim <= 0) return kPD_NULLPTR;
+  auto* m = new Matrix();
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    m->shape.push_back(shape[i]);
+    n *= shape[i];
+  }
+  m->data.assign(n, 0.f);
+  *mat = m;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_destroy(paddle_matrix mat) {
+  delete static_cast<Matrix*>(mat);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_set_row(paddle_matrix mat, uint64_t row,
+                                   float* row_array) {
+  auto* m = static_cast<Matrix*>(mat);
+  if (!m || !row_array) return kPD_NULLPTR;
+  if ((int64_t)row >= m->rows()) return kPD_OUT_OF_RANGE;
+  std::memcpy(m->data.data() + row * m->row_elems(), row_array,
+              m->row_elems() * sizeof(float));
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_get_row(paddle_matrix mat, uint64_t row,
+                                   float** buf) {
+  auto* m = static_cast<Matrix*>(mat);
+  if (!m || !buf) return kPD_NULLPTR;
+  if ((int64_t)row >= m->rows()) return kPD_OUT_OF_RANGE;
+  *buf = m->data.data() + row * m->row_elems();
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_get_shape(paddle_matrix mat, uint64_t* h,
+                                     uint64_t* w) {
+  auto* m = static_cast<Matrix*>(mat);
+  if (!m || !h || !w) return kPD_NULLPTR;
+  *h = m->rows();
+  *w = m->row_elems();
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_set_data(paddle_matrix mat, float* data) {
+  auto* m = static_cast<Matrix*>(mat);
+  if (!m || !data) return kPD_NULLPTR;
+  std::memcpy(m->data.data(), data, m->data.size() * sizeof(float));
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_matrix_get_data(paddle_matrix mat, float** data,
+                                    uint64_t* size) {
+  auto* m = static_cast<Matrix*>(mat);
+  if (!m || !data || !size) return kPD_NULLPTR;
+  *data = m->data.data();
+  *size = m->data.size();
+  return kPD_NO_ERROR;
+}
+
+/* ---- ivector ---- */
+paddle_error paddle_ivector_create(paddle_ivector* vec, int32_t* array,
+                                   uint64_t size) {
+  if (!vec || !array) return kPD_NULLPTR;
+  auto* v = new IVector();
+  v->data.assign(array, array + size);
+  *vec = v;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_ivector_destroy(paddle_ivector vec) {
+  delete static_cast<IVector*>(vec);
+  return kPD_NO_ERROR;
+}
+
+/* ---- arguments ---- */
+paddle_error paddle_arguments_create_none(paddle_arguments* args) {
+  if (!args) return kPD_NULLPTR;
+  *args = new Arguments();
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_destroy(paddle_arguments args) {
+  delete static_cast<Arguments*>(args);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_resize(paddle_arguments args, uint64_t size) {
+  auto* a = static_cast<Arguments*>(args);
+  if (!a) return kPD_NULLPTR;
+  a->slots.resize(size);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_get_size(paddle_arguments args,
+                                       uint64_t* size) {
+  auto* a = static_cast<Arguments*>(args);
+  if (!a || !size) return kPD_NULLPTR;
+  *size = a->slots.size();
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_set_value(paddle_arguments args, uint64_t id,
+                                        paddle_matrix mat) {
+  auto* a = static_cast<Arguments*>(args);
+  auto* m = static_cast<Matrix*>(mat);
+  if (!a || !m) return kPD_NULLPTR;
+  if (id >= a->slots.size()) return kPD_OUT_OF_RANGE;
+  a->slots[id].is_ids = false;
+  a->slots[id].mat = *m;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_get_value(paddle_arguments args, uint64_t id,
+                                        paddle_matrix mat) {
+  auto* a = static_cast<Arguments*>(args);
+  auto* m = static_cast<Matrix*>(mat);
+  if (!a || !m) return kPD_NULLPTR;
+  if (id >= a->slots.size()) return kPD_OUT_OF_RANGE;
+  if (a->slots[id].is_ids) return kPD_NOT_SUPPORTED;
+  *m = a->slots[id].mat;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_arguments_set_ids(paddle_arguments args, uint64_t id,
+                                      paddle_ivector ids) {
+  auto* a = static_cast<Arguments*>(args);
+  auto* v = static_cast<IVector*>(ids);
+  if (!a || !v) return kPD_NULLPTR;
+  if (id >= a->slots.size()) return kPD_OUT_OF_RANGE;
+  a->slots[id].is_ids = true;
+  a->slots[id].ids = *v;
+  return kPD_NO_ERROR;
+}
+
+/* ---- gradient machine ---- */
+paddle_error paddle_gradient_machine_create_for_inference_with_parameters(
+    paddle_gradient_machine* machine, const char* merged_model_dir) {
+  if (!machine || !merged_model_dir) return kPD_NULLPTR;
+  Gil gil;
+  PyObject* fn = bridge_fn("load");
+  if (!fn) {
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+  PyObject* ret = PyObject_CallFunction(fn, "s", merged_model_dir);
+  Py_DECREF(fn);
+  if (!ret) {
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+  auto* m = new Machine();
+  m->handle = PyLong_AsLong(ret);
+  Py_DECREF(ret);
+  *machine = m;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_create_shared_param(
+    paddle_gradient_machine origin, paddle_gradient_machine* clone) {
+  auto* o = static_cast<Machine*>(origin);
+  if (!o || !clone) return kPD_NULLPTR;
+  Gil gil;
+  PyObject* fn = bridge_fn("share");
+  if (!fn) {
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+  PyObject* ret = PyObject_CallFunction(fn, "l", o->handle);
+  Py_DECREF(fn);
+  if (!ret) {
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+  auto* m = new Machine();
+  m->handle = PyLong_AsLong(ret);
+  Py_DECREF(ret);
+  *clone = m;
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
+                                             paddle_arguments in_args,
+                                             paddle_arguments out_args,
+                                             int is_train) {
+  (void)is_train; /* inference machines ignore it, like kTesting mode */
+  auto* m = static_cast<Machine*>(machine);
+  auto* in = static_cast<Arguments*>(in_args);
+  auto* out = static_cast<Arguments*>(out_args);
+  if (!m || !in || !out) return kPD_NULLPTR;
+  Gil gil;
+
+  /* Build [(bytes, shape, dtype), ...] for the bridge. */
+  PyObject* tensors = PyList_New((Py_ssize_t)in->slots.size());
+  if (!tensors) return kPD_UNDEFINED_ERROR;
+  for (size_t i = 0; i < in->slots.size(); ++i) {
+    const Slot& s = in->slots[i];
+    PyObject* triple;
+    if (s.is_ids) {
+      PyObject* shape = Py_BuildValue("(n)", (Py_ssize_t)s.ids.data.size());
+      triple = Py_BuildValue(
+          "(y#Ns)", (const char*)s.ids.data.data(),
+          (Py_ssize_t)(s.ids.data.size() * sizeof(int32_t)), shape, "int32");
+    } else {
+      PyObject* shape = PyTuple_New((Py_ssize_t)s.mat.shape.size());
+      for (size_t d = 0; d < s.mat.shape.size(); ++d)
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(s.mat.shape[d]));
+      triple = Py_BuildValue(
+          "(y#Ns)", (const char*)s.mat.data.data(),
+          (Py_ssize_t)(s.mat.data.size() * sizeof(float)), shape, "float32");
+    }
+    if (!triple) {
+      Py_DECREF(tensors);
+      set_last_error_from_python();
+      return kPD_UNDEFINED_ERROR;
+    }
+    PyList_SET_ITEM(tensors, (Py_ssize_t)i, triple);
+  }
+
+  PyObject* fn = bridge_fn("forward");
+  if (!fn) {
+    Py_DECREF(tensors);
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+  PyObject* ret = PyObject_CallFunction(fn, "lN", m->handle, tensors);
+  Py_DECREF(fn);
+  if (!ret) {
+    set_last_error_from_python();
+    return kPD_UNDEFINED_ERROR;
+  }
+
+  /* Unpack [(bytes, shape, dtype), ...] into out slots (float32 only). */
+  Py_ssize_t n = PySequence_Size(ret);
+  out->slots.assign((size_t)n, Slot());
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* triple = PySequence_GetItem(ret, i);
+    PyObject* buf = PySequence_GetItem(triple, 0);
+    PyObject* shape = PySequence_GetItem(triple, 1);
+    char* raw = nullptr;
+    Py_ssize_t raw_len = 0;
+    PyBytes_AsStringAndSize(buf, &raw, &raw_len);
+    Slot& s = out->slots[(size_t)i];
+    Py_ssize_t nd = PySequence_Size(shape);
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      PyObject* dim = PySequence_GetItem(shape, d);
+      s.mat.shape.push_back(PyLong_AsLongLong(dim));
+      Py_DECREF(dim);
+    }
+    s.mat.data.resize((size_t)raw_len / sizeof(float));
+    std::memcpy(s.mat.data.data(), raw, (size_t)raw_len);
+    Py_DECREF(shape);
+    Py_DECREF(buf);
+    Py_DECREF(triple);
+  }
+  Py_DECREF(ret);
+  return kPD_NO_ERROR;
+}
+
+paddle_error paddle_gradient_machine_destroy(paddle_gradient_machine machine) {
+  auto* m = static_cast<Machine*>(machine);
+  if (!m) return kPD_NULLPTR;
+  {
+    Gil gil;
+    PyObject* fn = bridge_fn("release");
+    if (fn) {
+      PyObject* r = PyObject_CallFunction(fn, "l", m->handle);
+      Py_XDECREF(r);
+      Py_DECREF(fn);
+    }
+    if (PyErr_Occurred()) PyErr_Clear();
+  }
+  delete m;
+  return kPD_NO_ERROR;
+}
+
+const char* paddle_last_error(void) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  return g_last_error.c_str();
+}
+
+}  /* extern "C" */

@@ -1,0 +1,94 @@
+/* paddle_tpu C inference API.
+ *
+ * Twin of the reference's pure-C serving surface (paddle/capi/):
+ *   error.h            -> paddle_error
+ *   matrix.h           -> paddle_matrix  (dense row-major float32)
+ *   arguments.h        -> paddle_arguments (positional tensor slots)
+ *   gradient_machine.h -> paddle_gradient_machine (create from merged
+ *                         model dir, forward, shared-param clones)
+ *
+ * The implementation (capi.cc) embeds CPython and drives the JAX inference
+ * machine through paddle_tpu/capi_bridge.py; callers need no Python.
+ * All calls are thread-safe (serialized on the GIL), and shared-param
+ * clones may be used concurrently from many threads, matching
+ * paddle_gradient_machine_create_shared_param semantics
+ * (capi/gradient_machine.h:87-91).
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  kPD_NO_ERROR = 0,
+  kPD_NULLPTR = 1,
+  kPD_OUT_OF_RANGE = 2,
+  kPD_PROTOBUF_ERROR = 3,
+  kPD_NOT_SUPPORTED = 4,
+  kPD_UNDEFINED_ERROR = -1,
+} paddle_error;
+
+typedef void* paddle_matrix;
+typedef void* paddle_ivector;
+typedef void* paddle_arguments;
+typedef void* paddle_gradient_machine;
+
+/* ---- process init (paddle_init twin: argv forwarded to the runtime) ---- */
+paddle_error paddle_init(int argc, char** argv);
+
+/* ---- matrix (capi/matrix.h twin; float32, row-major) ---- */
+paddle_error paddle_matrix_create(paddle_matrix* mat, uint64_t height,
+                                  uint64_t width);
+paddle_error paddle_matrix_destroy(paddle_matrix mat);
+paddle_error paddle_matrix_set_row(paddle_matrix mat, uint64_t row,
+                                   float* row_array);
+paddle_error paddle_matrix_get_row(paddle_matrix mat, uint64_t row,
+                                   float** raw_row_buffer);
+paddle_error paddle_matrix_get_shape(paddle_matrix mat, uint64_t* height,
+                                     uint64_t* width);
+/* N-d extension beyond the reference's 2-D matrices (conv inputs). */
+paddle_error paddle_matrix_create_nd(paddle_matrix* mat, const int64_t* shape,
+                                     int ndim);
+paddle_error paddle_matrix_set_data(paddle_matrix mat, float* data);
+paddle_error paddle_matrix_get_data(paddle_matrix mat, float** data,
+                                    uint64_t* size);
+
+/* ---- integer vector (capi/vector.h twin; ids input) ---- */
+paddle_error paddle_ivector_create(paddle_ivector* vec, int32_t* array,
+                                   uint64_t size);
+paddle_error paddle_ivector_destroy(paddle_ivector vec);
+
+/* ---- arguments (capi/arguments.h twin; positional slots) ---- */
+paddle_error paddle_arguments_create_none(paddle_arguments* args);
+paddle_error paddle_arguments_destroy(paddle_arguments args);
+paddle_error paddle_arguments_resize(paddle_arguments args, uint64_t size);
+paddle_error paddle_arguments_get_size(paddle_arguments args, uint64_t* size);
+paddle_error paddle_arguments_set_value(paddle_arguments args, uint64_t id,
+                                        paddle_matrix mat);
+paddle_error paddle_arguments_get_value(paddle_arguments args, uint64_t id,
+                                        paddle_matrix mat);
+paddle_error paddle_arguments_set_ids(paddle_arguments args, uint64_t id,
+                                      paddle_ivector ids);
+
+/* ---- gradient machine (capi/gradient_machine.h twin) ---- */
+paddle_error paddle_gradient_machine_create_for_inference_with_parameters(
+    paddle_gradient_machine* machine, const char* merged_model_dir);
+paddle_error paddle_gradient_machine_create_shared_param(
+    paddle_gradient_machine origin, paddle_gradient_machine* clone);
+paddle_error paddle_gradient_machine_forward(paddle_gradient_machine machine,
+                                             paddle_arguments in_args,
+                                             paddle_arguments out_args,
+                                             int is_train);
+paddle_error paddle_gradient_machine_destroy(paddle_gradient_machine machine);
+
+/* Last Python error message for kPD_UNDEFINED_ERROR (debug aid). */
+const char* paddle_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

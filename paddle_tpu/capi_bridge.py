@@ -1,0 +1,116 @@
+"""Python side of the C inference API.
+
+The native ``libpaddle_capi.so`` (``csrc/capi.cc``) embeds CPython and calls
+the three functions here.  Together they are the twin of the reference's
+pure-C serving surface (``paddle/capi/gradient_machine.h:36-112`` +
+``capi/matrix.h``/``arguments.h``): a C program loads a merged model
+directory and runs forward passes without writing any Python.
+
+The merged model (``inference.export_model``) must carry a ``model_ref`` in
+its ``model_config.json`` — ``"module:function"`` resolved by import, the
+twin of the reference's serialized ``ModelConfig`` proto reconstructing the
+layer graph (``capi/gradient_machine.h:51`` created the GradientMachine
+from merged config+param bytes the same way).
+
+Data crosses the boundary as (bytes, shape, dtype) triples — one memcpy per
+tensor per call, the same cost the reference paid marshalling into
+``paddle_matrix`` buffers.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+
+_machines: Dict[int, Any] = {}
+_meta: Dict[int, Dict[str, Any]] = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+def resolve_model_fn(ref: str, kwargs: Dict[str, Any]):
+    """``"pkg.module:factory"`` → model_fn via the factory(**kwargs)."""
+    mod_name, _, fn_name = ref.partition(":")
+    enforce(fn_name, "model_ref must be 'module:factory', got %r", ref)
+    factory = getattr(importlib.import_module(mod_name), fn_name)
+    return factory(**kwargs)
+
+
+def load(model_dir: str) -> int:
+    """Create an InferenceMachine from a merged-model dir; returns handle."""
+    from paddle_tpu import inference
+
+    cfg_path = os.path.join(model_dir, "model_config.json")
+    enforce(os.path.exists(cfg_path), "no model_config.json under %r",
+            model_dir)
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    enforce("model_ref" in cfg,
+            "model_config.json lacks 'model_ref' (module:factory) — export "
+            "with inference.export_model(..., config={'model_ref': ...})")
+    model_fn = resolve_model_fn(cfg["model_ref"],
+                                cfg.get("model_kwargs", {}))
+    machine = inference.load_model(model_dir, model_fn)
+    with _lock:
+        handle = _next_id[0]
+        _next_id[0] += 1
+        _machines[handle] = machine
+        _meta[handle] = cfg
+    return handle
+
+
+def share(handle: int) -> int:
+    """Shared-param clone (``paddle_gradient_machine_create_shared_param``
+    twin).  JAX machines are pure, so clones share everything."""
+    with _lock:
+        enforce(handle in _machines, "bad machine handle %d", handle)
+        new = _next_id[0]
+        _next_id[0] += 1
+        _machines[new] = _machines[handle]
+        _meta[new] = _meta[handle]
+    return new
+
+
+def forward(handle: int,
+            tensors: List[Tuple[bytes, Tuple[int, ...], str]]
+            ) -> List[Tuple[bytes, Tuple[int, ...], str]]:
+    """Run the machine on positional inputs; returns output triples.
+
+    Input order follows ``input_names`` from the model config (the
+    reference's positional ``paddle_arguments`` slots).
+    """
+    with _lock:
+        enforce(handle in _machines, "bad machine handle %d", handle)
+        machine, cfg = _machines[handle], _meta[handle]
+    names = cfg.get("input_names")
+    enforce(names is not None and len(names) == len(tensors),
+            "model expects inputs %s, got %d tensors", names, len(tensors))
+    batch = {}
+    for name, (buf, shape, dtype) in zip(names, tensors):
+        batch[name] = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    out = machine.infer(batch)
+    if isinstance(out, dict):
+        out_names = cfg.get("output_names") or sorted(out)
+        outs = [out[n] for n in out_names]
+    elif isinstance(out, (list, tuple)):
+        outs = list(out)
+    else:
+        outs = [out]
+    result = []
+    for o in outs:
+        arr = np.asarray(o)
+        result.append((arr.tobytes(), tuple(arr.shape), str(arr.dtype)))
+    return result
+
+
+def release(handle: int) -> None:
+    with _lock:
+        _machines.pop(handle, None)
+        _meta.pop(handle, None)

@@ -110,9 +110,19 @@ def append_backward(program: Program, loss_name: str,
             # the kernel returns outputs in), NOT the desc's dict order.
             gi: Dict[str, List[str]] = {f"X:{s}": list(ns)
                                         for s, ns in op.inputs.items()}
-            gi["OutGrad"] = [out_grads[n] or ""
-                             for slot in info.out_slots
-                             for n in op.outputs.get(slot, [])]
+            # One entry per primal the executor will see: non-variadic slots
+            # always contribute one entry ("" when the desc omits the slot —
+            # _scatter_outputs tolerates missing output names), variadic
+            # slots one per named var.
+            out_grad_names: List[str] = []
+            for slot in info.out_slots:
+                ns = op.outputs.get(slot, [])
+                if slot in info.variadic:
+                    out_grad_names.extend(out_grads[n] or "" for n in ns)
+                else:
+                    out_grad_names.append(
+                        (out_grads.get(ns[0]) or "") if ns else "")
+            gi["OutGrad"] = out_grad_names
             go: Dict[str, List[str]] = {"InGrad": []}
             n_grads = 0
             for slot, names in op.inputs.items():

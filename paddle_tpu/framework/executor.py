@@ -129,9 +129,12 @@ def prune(block: BlockDesc, feeds: Set[str],
     needed = set(fetches)
     kept: List[OpDesc] = []
     for op in reversed(block.ops):
-        if any(o in needed for o in op.output_names()):
+        # "" entries are skipped-grad placeholders, not variables — they
+        # must neither match nor propagate as dependencies.
+        if any(o and o in needed for o in op.output_names()):
             kept.append(op)
-            needed.update(n for n in op.input_names() if n not in feeds)
+            needed.update(n for n in op.input_names()
+                          if n and n not in feeds)
     return list(reversed(kept))
 
 

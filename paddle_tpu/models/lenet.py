@@ -35,3 +35,15 @@ def model_fn(batch):
     logits = LeNet(name="lenet")(batch["image"])
     loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
     return loss, {"logits": logits, "label": batch["label"]}
+
+
+def inference_fn_builder(num_classes: int = 10):
+    """Serving factory for merged-model export (``model_ref`` target —
+    see ``capi_bridge.resolve_model_fn``)."""
+    import jax
+
+    def fn(batch):
+        logits = LeNet(num_classes, name="lenet")(batch["image"])
+        return {"prob": jax.nn.softmax(logits, axis=-1)}
+
+    return fn

