@@ -1,0 +1,146 @@
+"""GAN on image data (MNIST/CIFAR scale).
+
+Twin of the reference's ``v1_api_demo/gan`` (``gan_conf_image.py``:
+DCGAN-style conv generator/discriminator trained by alternating updaters,
+driven by the raw-API loop in ``gan_trainer.py``).  Here the two players
+are separate param trees and `make_gan_steps` returns two jitted steps
+(train D / train G) — the twin of the demo's two GradientMachines sharing
+one noise source — each fusing forward+backward+update under XLA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu import optim as optim_lib
+
+
+class Generator(nn.Module):
+    """Noise [b, noise_dim] → images [b, H, W, C] in (-1, 1)."""
+
+    def __init__(self, out_hw: int = 28, channels: int = 1,
+                 base: int = 64, noise_dim: int = 100, name=None):
+        super().__init__(name)
+        self.out_hw = out_hw
+        self.channels = channels
+        self.base = base
+        self.noise_dim = noise_dim
+
+    def forward(self, z):
+        s = self.out_hw // 4
+        x = nn.Linear(s * s * 2 * self.base, act="relu", name="fc")(z)
+        x = x.reshape(-1, s, s, 2 * self.base)
+        x = nn.BatchNorm(name="bn1")(x)
+        x = nn.Conv2DTranspose(self.base, 5, stride=2, padding="SAME",
+                               act="relu", name="deconv1")(x)
+        x = nn.BatchNorm(name="bn2")(x)
+        x = nn.Conv2DTranspose(self.channels, 5, stride=2, padding="SAME",
+                               name="deconv2")(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """Images → real/fake logit [b]."""
+
+    def __init__(self, base: int = 64, name=None):
+        super().__init__(name)
+        self.base = base
+
+    def forward(self, img):
+        leaky = lambda v: jnp.where(v >= 0, v, 0.2 * v)
+        x = leaky(nn.Conv2D(self.base, 5, stride=2, padding=2,
+                            name="conv1")(img))
+        x = leaky(nn.Conv2D(2 * self.base, 5, stride=2, padding=2,
+                            name="conv2")(x))
+        x = x.reshape(x.shape[0], -1)
+        x = leaky(nn.Linear(1024, name="fc1")(x))
+        return nn.Linear(1, name="fc_out")(x)[:, 0]
+
+
+def _bce_logits(logits, target):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * target +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def make_gan_steps(out_hw: int = 28, channels: int = 1, base: int = 16,
+                   noise_dim: int = 100,
+                   g_opt: optim_lib.Transform = None,
+                   d_opt: optim_lib.Transform = None):
+    """Build (init_fn, d_step, g_step, sample_fn), all jitted.
+
+    d_step maximizes log D(x) + log(1-D(G(z))); g_step maximizes
+    log D(G(z)) (the non-saturating loss the reference demo uses).
+    """
+    g_opt = g_opt or optim_lib.adam(2e-4, beta1=0.5)
+    d_opt = d_opt or optim_lib.adam(2e-4, beta1=0.5)
+
+    gen = nn.transform(lambda z: Generator(out_hw, channels, base,
+                                           noise_dim, name="gen")(z))
+    dis = nn.transform(lambda img: Discriminator(base, name="dis")(img))
+
+    def init_fn(key, batch_size: int = 8):
+        kg, kd, kz = jax.random.split(key, 3)
+        z = jax.random.normal(kz, (batch_size, noise_dim))
+        g_params, g_state = gen.init(kg, z)
+        fake, _ = gen.apply(g_params, g_state, None, z, train=False)
+        d_params, d_state = dis.init(kd, fake)
+        return {"g": g_params, "d": d_params,
+                "g_state": g_state, "d_state": d_state,
+                "g_opt": g_opt.init(g_params), "d_opt": d_opt.init(d_params),
+                "g_steps": jnp.zeros((), jnp.int32),
+                "d_steps": jnp.zeros((), jnp.int32)}
+
+    @jax.jit
+    def d_step(st: Dict[str, Any], real, key):
+        z = jax.random.normal(key, (real.shape[0], noise_dim))
+        fake, g_state = gen.apply(st["g"], st["g_state"], None, z)
+
+        def loss_fn(d_params):
+            real_logit, d_state = dis.apply(d_params, st["d_state"], None,
+                                            real)
+            fake_logit, d_state = dis.apply(d_params, d_state, None,
+                                            jax.lax.stop_gradient(fake))
+            loss = _bce_logits(real_logit, jnp.ones_like(real_logit)) + \
+                _bce_logits(fake_logit, jnp.zeros_like(fake_logit))
+            return loss, d_state
+
+        (loss, d_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(st["d"])
+        updates, opt_state = d_opt.update(grads, st["d_opt"], st["d"],
+                                          st["d_steps"])
+        new = dict(st, d=optim_lib.apply_updates(st["d"], updates),
+                   d_opt=opt_state, d_state=d_state, g_state=g_state,
+                   d_steps=st["d_steps"] + 1)
+        return new, loss
+
+    @partial(jax.jit, static_argnums=1)
+    def g_step(st: Dict[str, Any], batch_size, key):
+        z = jax.random.normal(key, (batch_size, noise_dim))
+
+        def loss_fn(g_params):
+            fake, g_state = gen.apply(g_params, st["g_state"], None, z)
+            fake_logit, _ = dis.apply(st["d"], st["d_state"], None, fake)
+            return _bce_logits(fake_logit, jnp.ones_like(fake_logit)), \
+                g_state
+
+        (loss, g_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(st["g"])
+        updates, opt_state = g_opt.update(grads, st["g_opt"], st["g"],
+                                          st["g_steps"])
+        new = dict(st, g=optim_lib.apply_updates(st["g"], updates),
+                   g_opt=opt_state, g_state=g_state,
+                   g_steps=st["g_steps"] + 1)
+        return new, loss
+
+    @partial(jax.jit, static_argnums=2)
+    def sample_fn(st: Dict[str, Any], key, n: int = 16):
+        z = jax.random.normal(key, (n, noise_dim))
+        img, _ = gen.apply(st["g"], st["g_state"], None, z, train=False)
+        return img
+
+    return init_fn, d_step, g_step, sample_fn

@@ -1,0 +1,87 @@
+"""Sequence tagging with a linear-chain CRF.
+
+Twin of the reference's ``demo/sequence_tagging`` (atis slot filling:
+``linear_crf.py`` — word/context features + CRF layer — and ``rnn_crf.py``
+— embedding + bi-recurrent + CRF) and of the CRF machinery itself
+(``gserver/layers/CRFLayer.cpp``, ``LinearChainCRF.cpp``, decoding layer
+``CRFDecodingLayer.cpp``).  The forward-backward recursions run as
+``lax.scan`` over the masked batch (``ops/crf.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.recurrent import GRU
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+class CRFTagger(nn.Module):
+    """Emissions net + CRF parameters; mode picks linear vs rnn features."""
+
+    def __init__(self, vocab_size: int, num_tags: int, embed_dim: int = 64,
+                 hidden: int = 128, context_len: int = 5,
+                 mode: str = "rnn", name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.num_tags = num_tags
+        self.embed_dim = embed_dim
+        self.hidden = hidden
+        self.context_len = context_len
+        self.mode = mode
+
+    def emissions(self, ids, mask):
+        x = nn.Embedding(self.vocab_size, self.embed_dim, name="embed")(ids)
+        if self.mode == "linear":
+            # context-window features, the linear_crf.py config
+            x = seq_ops.context_projection(
+                x, mask, self.context_len, -(self.context_len // 2))
+            h = nn.Linear(self.hidden, act="relu", name="feat")(x)
+        else:
+            # bi-GRU features, the rnn_crf.py config
+            fwd, _ = GRU(self.hidden, name="gru_fwd")(x, mask)
+            bwd, _ = GRU(self.hidden, reverse=True, name="gru_bwd")(x, mask)
+            h = jnp.concatenate([fwd, bwd], axis=-1)
+        return nn.Linear(self.num_tags, name="emit")(h)
+
+    def crf_params(self):
+        T = self.num_tags
+        trans = nn.param("transitions", (T, T), jnp.float32, init.zeros)
+        start = nn.param("start", (T,), jnp.float32, init.zeros)
+        stop = nn.param("stop", (T,), jnp.float32, init.zeros)
+        return trans, start, stop
+
+    def forward(self, ids, mask, tags=None):
+        e = self.emissions(ids, mask)
+        trans, start, stop = self.crf_params()
+        if tags is None:
+            return crf_ops.crf_decode(e, mask, trans, start, stop)
+        ll = crf_ops.crf_log_likelihood(e, tags, mask, trans, start, stop)
+        return -jnp.mean(ll), e
+
+
+def model_fn_builder(vocab_size: int, num_tags: int, mode: str = "rnn",
+                     **kwargs):
+    def model_fn(batch):
+        tagger = CRFTagger(vocab_size, num_tags, mode=mode, name="tagger",
+                           **kwargs)
+        loss, emissions = tagger(batch["ids"], batch["ids_mask"],
+                                 batch["tags"])
+        return loss, {"emissions": emissions, "label": batch["tags"],
+                      "mask": batch["ids_mask"]}
+
+    return model_fn
+
+
+def decode_fn_builder(vocab_size: int, num_tags: int, mode: str = "rnn",
+                      **kwargs):
+    """Viterbi decoding entry (CRFDecodingLayer twin) for inference."""
+    def decode_fn(batch):
+        tagger = CRFTagger(vocab_size, num_tags, mode=mode, name="tagger",
+                           **kwargs)
+        return tagger(batch["ids"], batch["ids_mask"])
+
+    return decode_fn

@@ -1,0 +1,75 @@
+"""Quick-start text classification nets.
+
+Twin of the reference's ``demo/quick_start`` configs over the sparse
+product-review data: ``trainer_config.lr.py`` (logistic regression over a
+bag of words), ``trainer_config.emb.py`` (embedding + pooling),
+``trainer_config.cnn.py`` (sequence_conv_pool), ``trainer_config.lstm.py``
+(the stacked-LSTM classifier lives in ``models/lstm_classifier.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import losses, sequence as seq_ops
+
+
+class BowClassifier(nn.Module):
+    """Bag-of-words logistic regression (trainer_config.lr.py twin):
+    sum-pooled word embeddings → linear softmax."""
+
+    def __init__(self, vocab_size: int, num_classes: int = 2,
+                 embed_dim: int = 0, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+        # embed_dim 0 = pure sparse-logistic (one weight row per word)
+        self.embed_dim = embed_dim
+
+    def forward(self, ids, mask):
+        if self.embed_dim:
+            x = nn.Embedding(self.vocab_size, self.embed_dim,
+                             name="embed")(ids)
+            pooled = seq_ops.sequence_pool(x, mask, "sum")
+            return nn.Linear(self.num_classes, name="fc")(pooled)
+        # one logit row per vocab word, summed over the bag — equivalent to
+        # logistic regression on sparse counts
+        w = nn.Embedding(self.vocab_size, self.num_classes,
+                         name="word_logits")(ids)
+        return seq_ops.sequence_pool(w, mask, "sum")
+
+
+class CNNClassifier(nn.Module):
+    """sequence_conv_pool twin (trainer_config.cnn.py): context-window
+    projection → linear → max-pool over time."""
+
+    def __init__(self, vocab_size: int, num_classes: int = 2,
+                 embed_dim: int = 64, hidden: int = 128,
+                 context_len: int = 3, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+        self.embed_dim = embed_dim
+        self.hidden = hidden
+        self.context_len = context_len
+
+    def forward(self, ids, mask):
+        x = nn.Embedding(self.vocab_size, self.embed_dim, name="embed")(ids)
+        ctx = seq_ops.context_projection(x, mask, self.context_len,
+                                         -(self.context_len // 2))
+        h = nn.Linear(self.hidden, act="relu", name="conv_fc")(ctx)
+        pooled = seq_ops.sequence_pool(h, mask, "max")
+        return nn.Linear(self.num_classes, name="fc")(pooled)
+
+
+def model_fn_builder(vocab_size: int, arch: str = "bow", **kwargs):
+    cls = {"bow": BowClassifier, "cnn": CNNClassifier}[arch]
+
+    def model_fn(batch):
+        logits = cls(vocab_size, name=arch, **kwargs)(batch["ids"],
+                                                      batch["ids_mask"])
+        loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
+        return loss, {"logits": logits, "label": batch["label"]}
+
+    return model_fn

@@ -1,0 +1,48 @@
+"""Traffic-flow time-series prediction.
+
+Twin of the reference's ``v1_api_demo/traffic_prediction`` demo
+(``trainer_config.py``: per-sensor embedded road-id + recurrent net over a
+history window regressing the next flow values; square-error cost).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.recurrent import GRU
+from paddle_tpu.ops import losses
+
+
+class TrafficPredictor(nn.Module):
+    def __init__(self, num_sensors: int, embed_dim: int = 16,
+                 hidden: int = 64, horizon: int = 1, name=None):
+        super().__init__(name)
+        self.num_sensors = num_sensors
+        self.embed_dim = embed_dim
+        self.hidden = hidden
+        self.horizon = horizon
+
+    def forward(self, sensor_id, history):
+        """sensor_id: [b] int; history: [b, t] past flow readings.
+        Returns [b, horizon] predicted flows."""
+        emb = nn.Embedding(self.num_sensors, self.embed_dim,
+                           name="sensor_embed")(sensor_id)        # [b, e]
+        t = history.shape[1]
+        feats = jnp.concatenate(
+            [history[..., None],
+             jnp.broadcast_to(emb[:, None, :],
+                              (emb.shape[0], t, emb.shape[1]))], axis=-1)
+        hs, h_last = GRU(self.hidden, name="gru")(feats)
+        return nn.Linear(self.horizon, name="out")(h_last)
+
+
+def model_fn_builder(num_sensors: int, **kwargs):
+    def model_fn(batch):
+        pred = TrafficPredictor(num_sensors, name="traffic",
+                                **kwargs)(batch["sensor_id"],
+                                          batch["history"])
+        loss = losses.square_error(pred, batch["target"]).mean()
+        return loss, {"pred": pred, "label": batch["target"]}
+
+    return model_fn

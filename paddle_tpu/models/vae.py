@@ -1,0 +1,65 @@
+"""Variational autoencoder on MNIST.
+
+Twin of the reference's ``v1_api_demo/vae`` (``vae_conf.py``: MLP
+encoder/decoder with reparameterized Gaussian latent, BCE reconstruction +
+KL).  TPU notes: the sampling path draws from the module RNG stream
+(``nn.next_rng_key``) so the whole loss stays jittable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+
+
+class VAE(nn.Module):
+    def __init__(self, latent_dim: int = 32, hidden: int = 400,
+                 x_dim: int = 784, name=None):
+        super().__init__(name)
+        self.latent_dim = latent_dim
+        self.hidden = hidden
+        self.x_dim = x_dim
+
+    def encode(self, x):
+        h = nn.Linear(self.hidden, act="relu", name="enc_fc1")(x)
+        h = nn.Linear(self.hidden, act="relu", name="enc_fc2")(h)
+        mu = nn.Linear(self.latent_dim, name="enc_mu")(h)
+        logvar = nn.Linear(self.latent_dim, name="enc_logvar")(h)
+        return mu, logvar
+
+    def decode(self, z):
+        h = nn.Linear(self.hidden, act="relu", name="dec_fc1")(z)
+        h = nn.Linear(self.hidden, act="relu", name="dec_fc2")(h)
+        return nn.Linear(self.x_dim, name="dec_out")(h)  # logits
+
+    def forward(self, x):
+        mu, logvar = self.encode(x)
+        if nn.is_training():
+            eps = jax.random.normal(nn.next_rng_key(), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+        else:
+            z = mu
+        return self.decode(z), mu, logvar
+
+
+def elbo_loss(x, logits, mu, logvar):
+    """Per-batch mean of BCE(recon) + KL(q(z|x) || N(0,1))."""
+    bce = jnp.sum(
+        jnp.maximum(logits, 0) - logits * x + jnp.log1p(
+            jnp.exp(-jnp.abs(logits))), axis=-1)
+    kl = -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar),
+                        axis=-1)
+    return jnp.mean(bce + kl), jnp.mean(bce), jnp.mean(kl)
+
+
+def model_fn_builder(latent_dim: int = 32, hidden: int = 400,
+                     x_dim: int = 784):
+    def model_fn(batch):
+        logits, mu, logvar = VAE(latent_dim, hidden, x_dim,
+                                 name="vae")(batch["image"])
+        loss, bce, kl = elbo_loss(batch["image"], logits, mu, logvar)
+        return loss, {"recon_logits": logits, "bce": bce, "kl": kl}
+
+    return model_fn

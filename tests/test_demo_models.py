@@ -1,0 +1,107 @@
+"""Demo-workload parity tests: VAE, GAN, CRF tagging, traffic prediction,
+quick-start text classification (the reference's v1_api_demo/* and
+demo/quick_start, demo/sequence_tagging)."""
+
+import jax
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu import optim
+from paddle_tpu.models import gan, vae
+from paddle_tpu.models.sequence_tagging import (CRFTagger,
+                                                decode_fn_builder,
+                                                model_fn_builder as
+                                                tagging_builder)
+from paddle_tpu.models.text_classification import (
+    model_fn_builder as text_builder)
+from paddle_tpu.models.traffic_prediction import (
+    model_fn_builder as traffic_builder)
+from paddle_tpu.training import Trainer
+
+RS = np.random.RandomState(0)
+
+
+def _steps(model_fn, batch, n=6, lr=0.05):
+    t = Trainer(model_fn, optim.adam(lr))
+    t.init(batch)
+    losses = [float(t.train_batch(batch)[0]) for _ in range(n)]
+    assert all(np.isfinite(l) for l in losses)
+    return losses
+
+
+def test_vae_trains():
+    batch = {"image": (RS.rand(8, 784) > 0.5).astype(np.float32)}
+    losses = _steps(vae.model_fn_builder(latent_dim=8, hidden=64), batch,
+                    n=10, lr=1e-3)
+    assert losses[-1] < losses[0]
+
+
+def test_gan_alternating_steps():
+    init_fn, d_step, g_step, sample_fn = gan.make_gan_steps(
+        out_hw=28, channels=1, base=8, noise_dim=16)
+    st = init_fn(jax.random.key(0), batch_size=4)
+    real = RS.rand(4, 28, 28, 1).astype(np.float32) * 2 - 1
+    key = jax.random.key(1)
+    for i in range(3):
+        key, k1, k2 = jax.random.split(key, 3)
+        st, d_loss = d_step(st, real, k1)
+        st, g_loss = g_step(st, 4, k2)
+    assert np.isfinite(float(d_loss)) and np.isfinite(float(g_loss))
+    imgs = sample_fn(st, key, 2)
+    assert imgs.shape == (2, 28, 28, 1)
+    assert float(np.abs(np.asarray(imgs)).max()) <= 1.0
+
+
+def test_crf_tagger_rnn_trains_and_decodes():
+    vocab, tags, b, t = 50, 5, 4, 7
+    batch = {"ids": RS.randint(0, vocab, (b, t)).astype(np.int32),
+             "ids_mask": np.arange(t)[None, :] < np.array([7, 5, 3, 6])[:, None],
+             "tags": RS.randint(0, tags, (b, t)).astype(np.int32)}
+    losses = _steps(tagging_builder(vocab, tags, mode="rnn", embed_dim=16,
+                                    hidden=16), batch, n=12, lr=0.05)
+    assert losses[-1] < losses[0]
+
+    # Viterbi decode path shares the same parameter scope names
+    train_model = nn.transform(tagging_builder(vocab, tags, mode="rnn",
+                                               embed_dim=16, hidden=16))
+    params, _ = train_model.init(jax.random.key(0), batch)
+    decode_model = nn.transform(decode_fn_builder(vocab, tags, mode="rnn",
+                                                  embed_dim=16, hidden=16))
+    (best_tags, best_score), _ = decode_model.apply(
+        params, {}, None, {"ids": batch["ids"],
+                           "ids_mask": batch["ids_mask"]}, train=False)
+    assert best_tags.shape == (b, t)
+    assert best_tags.dtype == np.int32
+    assert np.all(np.asarray(best_tags) < tags)
+
+
+def test_crf_tagger_linear_mode():
+    vocab, tags, b, t = 30, 4, 2, 5
+    batch = {"ids": RS.randint(0, vocab, (b, t)).astype(np.int32),
+             "ids_mask": np.ones((b, t), bool),
+             "tags": RS.randint(0, tags, (b, t)).astype(np.int32)}
+    losses = _steps(tagging_builder(vocab, tags, mode="linear",
+                                    embed_dim=8, hidden=8), batch, n=8)
+    assert losses[-1] < losses[0]
+
+
+def test_traffic_prediction_trains():
+    b, t = 8, 12
+    batch = {"sensor_id": RS.randint(0, 20, b).astype(np.int32),
+             "history": RS.rand(b, t).astype(np.float32),
+             "target": RS.rand(b, 1).astype(np.float32)}
+    losses = _steps(traffic_builder(20, hidden=16, horizon=1), batch, n=10,
+                    lr=0.01)
+    assert losses[-1] < losses[0]
+
+
+def test_text_classification_bow_and_cnn():
+    vocab, b, t = 100, 8, 9
+    batch = {"ids": RS.randint(0, vocab, (b, t)).astype(np.int32),
+             "ids_mask": np.ones((b, t), bool),
+             "label": RS.randint(0, 2, b).astype(np.int32)}
+    for arch, kwargs in [("bow", {}), ("bow", {"embed_dim": 16}),
+                         ("cnn", {"embed_dim": 16, "hidden": 16})]:
+        losses = _steps(text_builder(vocab, arch=arch, **kwargs), batch,
+                        n=8)
+        assert losses[-1] < losses[0], arch
