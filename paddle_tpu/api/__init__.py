@@ -23,6 +23,10 @@ from paddle_tpu.api import layer
 from paddle_tpu.api.graph import LayerOutput, topology, compile_model
 from paddle_tpu.api.trainer import SGD, infer
 from paddle_tpu.api import optimizer
+from paddle_tpu.api import networks
+from paddle_tpu.api.recurrent import (recurrent_group, memory, beam_search,
+                                      StaticInput, GeneratedInput)
 
 __all__ = ["layer", "LayerOutput", "topology", "compile_model", "SGD",
-           "infer", "optimizer"]
+           "infer", "optimizer", "networks", "recurrent_group", "memory",
+           "beam_search", "StaticInput", "GeneratedInput"]

@@ -62,8 +62,13 @@ def fc(input, size: int, act: str = "linear", bias: bool = True,
        name: Optional[str] = None):
     def run(ctx, x, **a):
         m = _mask(x)
+        v = _val(x)
+        if m is None and v.ndim > 2:
+            # Non-sequence multi-dim input (e.g. conv feature maps): the
+            # reference's fc_layer treats it as one flat vector per sample.
+            v = v.reshape(v.shape[0], -1)
         y = nn.Linear(a["size"], act=a["act"], bias=a["bias"],
-                      name=a["_name"])(_val(x))
+                      name=a["_name"])(v)
         return (y, m) if m is not None else y
     n = auto_name("fc", name)
     return _node("fc", run, [input], name=n, size=size, act=act, bias=bias,
@@ -254,9 +259,446 @@ def crf_cost(input, label, num_tags: int, name: Optional[str] = None):
                  _name=n)
 
 
+# ---- image layers ----------------------------------------------------------
+
+def conv2d_transpose(input, channels: int, kernel: int = 3, stride: int = 1,
+                     act: str = "relu", name: Optional[str] = None):
+    """Transposed conv (img_conv_layer(trans=True) twin, ConvTransLayer)."""
+    def run(ctx, x, **a):
+        return nn.Conv2DTranspose(a["channels"], a["kernel"],
+                                  stride=a["stride"], act=a["act"],
+                                  name=a["_name"])(x)
+    n = auto_name("conv2d_transpose", name)
+    return _node("conv2d_transpose", run, [input], name=n, channels=channels,
+                 kernel=kernel, stride=stride, act=act, _name=n)
+
+
+def spp(input, pyramid_height: int = 3, pool_type: str = "max",
+        name: Optional[str] = None):
+    """Spatial pyramid pooling (spp_layer twin, SpatialPyramidPoolLayer)."""
+    def run(ctx, x, **a):
+        return nn.SpatialPyramidPool(levels=a["pyramid_height"],
+                                     pool_type=a["pool_type"])(x)
+    return _node("spp", run, [input], name=name,
+                 pyramid_height=pyramid_height, pool_type=pool_type)
+
+
+def maxout(input, groups: int, name: Optional[str] = None):
+    """Maxout over channel groups (maxout_layer twin, MaxOutLayer)."""
+    def run(ctx, x, **a):
+        return nn.Maxout(a["groups"])(x)
+    return _node("maxout", run, [input], name=name, groups=groups)
+
+
+def img_cmrnorm(input, size: int = 5, scale: float = 0.0001,
+                power: float = 0.75, name: Optional[str] = None):
+    """Cross-map response normalization (img_cmrnorm_layer twin,
+    CMRProjectionNormLayer — AlexNet's LRN)."""
+    def run(ctx, x, **a):
+        from paddle_tpu.models.alexnet import _lrn
+        return _lrn(x, size=a["size"], alpha=a["scale"], beta=a["power"])
+    return _node("img_cmrnorm", run, [input], name=name, size=size,
+                 scale=scale, power=power)
+
+
+def bilinear_interp(input, out_h: int, out_w: int,
+                    name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.BilinearInterp(a["out_h"], a["out_w"])(x)
+    return _node("bilinear_interp", run, [input], name=name, out_h=out_h,
+                 out_w=out_w)
+
+
+def crop(input, offsets, shape, name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.Crop(a["offsets"], a["shape"])(x)
+    return _node("crop", run, [input], name=name, offsets=tuple(offsets),
+                 shape=tuple(shape))
+
+
+def pad(input, pad_c=(0, 0), pad_h=(0, 0), pad_w=(0, 0),
+        name: Optional[str] = None):
+    def run(ctx, x, **a):
+        return nn.Pad(a["pad_h"], a["pad_w"], pad_c=a["pad_c"])(x)
+    return _node("pad", run, [input], name=name, pad_c=tuple(pad_c),
+                 pad_h=tuple(pad_h), pad_w=tuple(pad_w))
+
+
+def rotate(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return nn.Rotate()(x)
+    return _node("rotate", run, [input], name=name)
+
+
+def block_expand(input, block_x: int, block_y: int, stride_x: int = 1,
+                 stride_y: int = 1, name: Optional[str] = None):
+    """im2col-as-sequence (block_expand_layer twin); output is a
+    (value, mask) sequence of patches for OCR/CTC pipelines."""
+    def run(ctx, x, **a):
+        y = nn.BlockExpand((a["block_y"], a["block_x"]),
+                           (a["stride_y"], a["stride_x"]))(x)
+        mask = jnp.ones(y.shape[:2], bool)
+        return (y, mask)
+    return _node("block_expand", run, [input], name=name, block_x=block_x,
+                 block_y=block_y, stride_x=stride_x, stride_y=stride_y)
+
+
+# ---- elementwise / math layers ---------------------------------------------
+
+def interpolation(weight, input_a, input_b, name: Optional[str] = None):
+    """out = w*a + (1-w)*b with per-sample scalar w (interpolation_layer)."""
+    def run(ctx, w, x, y):
+        m = _mask(x) if _mask(x) is not None else _mask(y)
+        out = nn.Interpolation()(_val(w), _val(x), _val(y))
+        return (out, m) if m is not None else out
+    return _node("interpolation", run, [weight, input_a, input_b], name=name)
+
+
+def scaling(weight, input, name: Optional[str] = None):
+    """Per-sample scalar scaling of a vector input (scaling_layer twin)."""
+    def run(ctx, w, x):
+        m = _mask(x)
+        w = _val(w)
+        v = _val(x)
+        y = w.reshape(w.shape[0], *([1] * (v.ndim - 1))) * v
+        return (y, m) if m is not None else y
+    return _node("scaling", run, [weight, input], name=name)
+
+
+def slope_intercept(input, slope: float = 1.0, intercept: float = 0.0,
+                    name: Optional[str] = None):
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = a["slope"] * _val(x) + a["intercept"]
+        return (y, m) if m is not None else y
+    return _node("slope_intercept", run, [input], name=name, slope=slope,
+                 intercept=intercept)
+
+
+def sum_to_one_norm(input, name: Optional[str] = None):
+    def run(ctx, x):
+        m = _mask(x)
+        y = nn.SumToOneNorm()(_val(x))
+        return (y, m) if m is not None else y
+    return _node("sum_to_one_norm", run, [input], name=name)
+
+
+def power(input, exponent, name: Optional[str] = None):
+    """Per-sample elementwise power: out = x ** e (power_layer twin)."""
+    def run(ctx, x, e):
+        m = _mask(x)
+        v, e = _val(x), _val(e)
+        y = v ** e.reshape(e.shape[0], *([1] * (v.ndim - 1)))
+        return (y, m) if m is not None else y
+    return _node("power", run, [input, exponent], name=name)
+
+
+def dotmul(input_a, input_b, name: Optional[str] = None):
+    """Elementwise product of two layers (dotmul_operator twin)."""
+    def run(ctx, x, y):
+        m = _mask(x) if _mask(x) is not None else _mask(y)
+        out = _val(x) * _val(y)
+        return (out, m) if m is not None else out
+    return _node("dotmul", run, [input_a, input_b], name=name)
+
+
+def trans(input, name: Optional[str] = None):
+    """Matrix transpose of [batch-free] 2-D output (trans_layer twin)."""
+    def run(ctx, x):
+        return jnp.swapaxes(_val(x), -1, -2)
+    return _node("trans", run, [input], name=name)
+
+
+def cos_sim(input_a, input_b, scale: float = 1.0,
+            name: Optional[str] = None):
+    """Cosine similarity of two [b, d] inputs (cos_sim twin,
+    CosSimLayer.cpp)."""
+    def run(ctx, x, y, **a):
+        x, y = _val(x), _val(y)
+        nx = jnp.sqrt(jnp.sum(x * x, -1) + 1e-12)
+        ny = jnp.sqrt(jnp.sum(y * y, -1) + 1e-12)
+        return a["scale"] * jnp.sum(x * y, -1) / (nx * ny)
+    return _node("cos_sim", run, [input_a, input_b], name=name, scale=scale)
+
+
+def linear_comb(weights, input, size: int, name: Optional[str] = None):
+    """Weighted row combination (linear_comb_layer twin): input [b, m*size]
+    seen as m rows of size, weights [b, m] -> [b, size]."""
+    def run(ctx, w, x, **a):
+        w, x = _val(w), _val(x)
+        m = w.shape[-1]
+        rows = x.reshape(x.shape[0], m, a["size"])
+        return jnp.einsum("bm,bmd->bd", w, rows)
+    return _node("linear_comb", run, [weights, input], name=name, size=size)
+
+
+def multiplex(index, *inputs, name: Optional[str] = None):
+    """Row-wise select among inputs by per-sample index (multiplex_layer)."""
+    def run(ctx, idx, *xs):
+        return nn.Multiplex()(_val(idx), *[_val(x) for x in xs])
+    return _node("multiplex", run, [index, *inputs], name=name)
+
+
+def repeat(input, num_repeats: int, name: Optional[str] = None):
+    """Tile features along the last axis (repeat_layer twin)."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        v = _val(x)
+        y = jnp.tile(v, (1,) * (v.ndim - 1) + (a["num_repeats"],))
+        return (y, m) if m is not None else y
+    return _node("repeat", run, [input], name=name, num_repeats=num_repeats)
+
+
+def expand(input, expand_as, name: Optional[str] = None):
+    """Broadcast a per-sequence vector over the steps of ``expand_as``
+    (expand_layer twin)."""
+    def run(ctx, vec, seq):
+        enforce(_is_seq(seq), "expand needs a sequence to expand as")
+        return (seq_ops.sequence_expand(_val(vec), seq[1]), seq[1])
+    return _node("expand", run, [input, expand_as], name=name)
+
+
+def selective_fc(input, select, size: int, act: str = "linear",
+                 name: Optional[str] = None):
+    """FC computing only selected output columns (selective_fc_layer)."""
+    def run(ctx, x, sel, **a):
+        return nn.SelectiveFC(a["size"], act=a["act"],
+                              name=a["_name"])(_val(x), _val(sel))
+    n = auto_name("selective_fc", name)
+    return _node("selective_fc", run, [input, select], name=n, size=size,
+                 act=act, _name=n)
+
+
+def mixed(inputs: Sequence[LayerOutput], projections, act: str = "linear",
+          bias: bool = True, name: Optional[str] = None):
+    """Sum-of-projections layer (mixed_layer twin, MixedLayer.cpp);
+    ``projections`` are ``nn`` projection modules, one per input."""
+    def run(ctx, *xs, **a):
+        return nn.Mixed(list(a["_projections"]), act=a["act"],
+                        bias=a["bias"], name=a["_name"])(
+            *[_val(x) for x in xs])
+    n = auto_name("mixed", name)
+    return _node("mixed", run, list(inputs), name=n, act=act, bias=bias,
+                 _name=n, _projections=tuple(projections))
+
+
+# ---- more sequence layers --------------------------------------------------
+
+def seq_reverse(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return (seq_ops.sequence_reverse(x[0], x[1]), x[1])
+    return _node("seq_reverse", run, [input], name=name)
+
+
+def seq_concat(input_a, input_b, name: Optional[str] = None):
+    """Concatenate two sequences end-to-end per sample (seq_concat_layer)."""
+    def run(ctx, a, b):
+        v, m = seq_ops.sequence_concat(a[0], a[1], b[0], b[1])
+        return (v, m)
+    return _node("seq_concat", run, [input_a, input_b], name=name)
+
+
+def seq_slice(input, starts, sizes, name: Optional[str] = None):
+    def run(ctx, x, s, z):
+        v, m = seq_ops.sequence_slice(x[0], x[1], _val(s), _val(z))
+        return (v, m)
+    return _node("seq_slice", run, [input, starts, sizes], name=name)
+
+
+def kmax_seq_score(input, k: int, name: Optional[str] = None):
+    """Top-k step indices by score (kmax_sequence_score_layer twin)."""
+    def run(ctx, x, **a):
+        v = x[0]
+        if v.ndim == 3:
+            v = v[..., 0]
+        return seq_ops.kmax_sequence_score(v, x[1], a["k"])
+    return _node("kmax_seq_score", run, [input], name=name, k=k)
+
+
+# ---- cost zoo --------------------------------------------------------------
+
+def cross_entropy_cost(input, label, name: Optional[str] = None):
+    """CE against probabilities (cross_entropy twin — input already
+    softmaxed, e.g. act='softmax' fc output)."""
+    def run(ctx, probs, y):
+        probs = _val(probs)
+        _record_label(ctx, probs, y)
+        return loss_ops.cross_entropy(probs, y).mean()
+    return _node("cross_entropy_cost", run, [input, label], name=name)
+
+
+def soft_cross_entropy_cost(input, label_probs, name: Optional[str] = None):
+    """CE against a soft label distribution (soft_binary_class CE twin)."""
+    def run(ctx, logits, y):
+        return loss_ops.softmax_cross_entropy_soft(_val(logits),
+                                                   _val(y)).mean()
+    return _node("soft_cross_entropy_cost", run, [input, label_probs],
+                 name=name)
+
+
+def multi_binary_label_cross_entropy_cost(input, label,
+                                          name: Optional[str] = None):
+    """Sigmoid CE over independent binary labels
+    (multi_binary_label_cross_entropy twin)."""
+    def run(ctx, logits, y):
+        logits = _val(logits)
+        return loss_ops.sigmoid_cross_entropy(
+            logits, _val(y).astype(logits.dtype)).sum(-1).mean()
+    return _node("multi_binary_ce_cost", run, [input, label], name=name)
+
+
+def huber_regression_cost(input, label, delta: float = 1.0,
+                          name: Optional[str] = None):
+    def run(ctx, pred, y, **a):
+        return loss_ops.huber_regression(_val(pred), _val(y),
+                                         a["delta"]).mean()
+    return _node("huber_regression_cost", run, [input, label], name=name,
+                 delta=delta)
+
+
+def huber_classification_cost(input, label, name: Optional[str] = None):
+    """Huber loss for binary classification with -1/+1 labels
+    (huber_classification_cost twin, CostLayer.cpp HuberTwoClassification)."""
+    def run(ctx, pred, y):
+        return loss_ops.huber_classification(_val(pred), _val(y)).mean()
+    return _node("huber_classification_cost", run, [input, label], name=name)
+
+
+def smooth_l1_cost(input, label, name: Optional[str] = None):
+    def run(ctx, pred, y):
+        return loss_ops.smooth_l1(_val(pred), _val(y)).mean()
+    return _node("smooth_l1_cost", run, [input, label], name=name)
+
+
+def rank_cost(left, right, label, name: Optional[str] = None):
+    """Pairwise ranking cost (rank_cost twin, RankingCost)."""
+    def run(ctx, l, r, y):
+        lv, rv = _val(l), _val(r)
+        lv = lv[:, 0] if lv.ndim == 2 else lv
+        rv = rv[:, 0] if rv.ndim == 2 else rv
+        return loss_ops.rank_cost(lv, rv, _val(y)).mean()
+    return _node("rank_cost", run, [left, right, label], name=name)
+
+
+def lambda_cost(input, score, ndcg_num: int = 5,
+                name: Optional[str] = None):
+    """LambdaRank over a (scores, mask) sequence (lambda_cost twin)."""
+    def run(ctx, pred, rel, **a):
+        enforce(_is_seq(pred), "lambda_cost needs sequence scores")
+        val, mask = pred
+        rv = _val(rel)
+        rv = rv[..., 0] if rv.ndim == 3 else rv
+        return loss_ops.lambda_rank(val[..., 0] if val.ndim == 3 else val,
+                                    rv, mask, a["ndcg_num"]).mean()
+    return _node("lambda_cost", run, [input, score], name=name,
+                 ndcg_num=ndcg_num)
+
+
+def sum_cost(input, name: Optional[str] = None):
+    def run(ctx, x):
+        return _val(x).sum()
+    return _node("sum_cost", run, [input], name=name)
+
+
+def ctc_cost(input, label, blank: int = 0, name: Optional[str] = None):
+    """CTC loss over (logits, mask) vs (label_ids, label_mask)
+    (ctc_layer / warp_ctc twin — ops/ctc.py is the scan-based impl)."""
+    def run(ctx, logits, y, **a):
+        enforce(_is_seq(logits) and _is_seq(y),
+                "ctc_cost needs sequence logits and labels")
+        from paddle_tpu.ops import ctc as ctc_ops
+        lv, lm = logits
+        yv, ym = y
+        loss = ctc_ops.ctc_loss(lv, seq_ops.mask_to_lengths(lm), yv,
+                                seq_ops.mask_to_lengths(ym), a["blank"])
+        return loss.mean()
+    return _node("ctc_cost", run, [input, label], name=name, blank=blank)
+
+
+def nce_cost(input, label, num_classes: int, num_neg_samples: int = 10,
+             name: Optional[str] = None):
+    """Noise-contrastive estimation cost (nce_layer twin, NCELayer.cpp).
+    Uniform noise distribution; owns the [num_classes, d] output table."""
+    def run(ctx, x, y, **a):
+        from paddle_tpu.nn.module import param, next_rng_key
+        from paddle_tpu.nn import initializers as init
+        import jax
+        x = _val(x)
+        k, n = a["num_neg_samples"], a["num_classes"]
+        w = param(f"{a['_name']}/w", (n, x.shape[-1]), jnp.float32,
+                  init.paddle_default(fan_in_axis=1))
+        b = param(f"{a['_name']}/b", (n,), jnp.float32, init.zeros)
+        noise = jax.random.randint(next_rng_key(), (x.shape[0], k), 0, n)
+        logq = jnp.log(jnp.asarray(1.0 / n, x.dtype))
+        return loss_ops.nce_loss(x, w, b, y, noise, logq, logq).mean()
+    n_ = auto_name("nce", name)
+    return _node("nce", run, [input, label], name=n_,
+                 num_classes=num_classes, num_neg_samples=num_neg_samples,
+                 _name=n_)
+
+
+def hsigmoid_cost(input, label, num_classes: int,
+                  name: Optional[str] = None):
+    """Hierarchical sigmoid cost over a complete binary tree
+    (hsigmoid twin, HierarchicalSigmoidLayer.cpp: the label's path codes
+    are the bits of ``label + num_classes`` below its leading bit)."""
+    def run(ctx, x, y, **a):
+        from paddle_tpu.nn.module import param
+        from paddle_tpu.nn import initializers as init
+        x = _val(x)
+        n = a["num_classes"]
+        depth = max(1, (n - 1).bit_length())
+        w = param(f"{a['_name']}/w", (n, x.shape[-1]), jnp.float32,
+                  init.paddle_default(fan_in_axis=1))
+        b = param(f"{a['_name']}/b", (n,), jnp.float32, init.zeros)
+        code = y + n                                  # heap index of leaf
+        bit = jnp.arange(depth - 1, -1, -1)
+        path = code[:, None] >> (bit[None, :] + 1)    # ancestors, root..parent
+        signs = jnp.where((code[:, None] >> bit[None, :]) & 1, -1.0, 1.0)
+        mask = path >= 1
+        nodes = jnp.clip(path - 1, 0, n - 1)
+        return loss_ops.hierarchical_sigmoid(x, w, b, nodes, signs,
+                                             mask).mean()
+    n_ = auto_name("hsigmoid", name)
+    return _node("hsigmoid", run, [input, label], name=n_,
+                 num_classes=num_classes, _name=n_)
+
+
 # ---- misc ------------------------------------------------------------------
 
 def max_id(input, name: Optional[str] = None):
     def run(ctx, x):
         return jnp.argmax(_val(x), axis=-1)
     return _node("max_id", run, [input], name=name)
+
+
+def sampling_id(input, name: Optional[str] = None):
+    """Sample a class id from a probability row (sampling_id_layer twin)."""
+    def run(ctx, x):
+        from paddle_tpu.nn.module import next_rng_key
+        import jax
+        p = _val(x)
+        return jax.random.categorical(next_rng_key(), jnp.log(p + 1e-9),
+                                      axis=-1)
+    return _node("sampling_id", run, [input], name=name)
+
+
+def eos(input, eos_id: int, name: Optional[str] = None):
+    """1.0 where the argmax id equals ``eos_id`` (eos_layer twin)."""
+    def run(ctx, x, **a):
+        ids = _val(x)
+        if ids.ndim > 1:
+            ids = jnp.argmax(ids, axis=-1)
+        return (ids == a["eos_id"]).astype(jnp.float32)
+    return _node("eos", run, [input], name=name, eos_id=eos_id)
+
+
+def print_layer(input, label: str = "", name: Optional[str] = None):
+    """Debug-print a node's value at trace/run time (print_layer twin,
+    PrintLayer.cpp) via jax.debug.print; passes the value through."""
+    def run(ctx, x, **a):
+        import jax
+        safe = a["label"].replace("{", "{{").replace("}", "}}")
+        jax.debug.print(safe + " {}", _val(x))
+        return x
+    return _node("print", run, [input], name=name, label=label or "print")

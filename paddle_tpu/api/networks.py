@@ -1,0 +1,157 @@
+"""Composite network helpers (``trainer_config_helpers/networks.py`` twin).
+
+The reference ships pre-wired compositions of its layer functions —
+``simple_img_conv_pool``, ``img_conv_group``, ``vgg_16_network``,
+``simple_lstm``, ``bidirectional_lstm``, ``simple_gru``,
+``sequence_conv_pool``, ``simple_attention`` — that demos and benchmarks
+build on.  Same surface here, composed from ``paddle_tpu.api.layer`` nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from paddle_tpu.api import layer
+from paddle_tpu.api.graph import LayerOutput, auto_name
+from paddle_tpu.core.errors import enforce
+
+
+def simple_img_conv_pool(input, filter_size: int, num_filters: int,
+                         pool_size: int, pool_stride: Optional[int] = None,
+                         act: str = "relu", pool_type: str = "max",
+                         name: Optional[str] = None):
+    """conv + pool pair (simple_img_conv_pool twin)."""
+    conv = layer.conv2d(input, channels=num_filters, kernel=filter_size,
+                        act=act, name=f"{name}_conv" if name else None)
+    return layer.pool2d(conv, kernel=pool_size,
+                        stride=pool_stride or pool_size,
+                        pool_type=pool_type,
+                        name=f"{name}_pool" if name else None)
+
+
+def img_conv_bn_pool(input, filter_size: int, num_filters: int,
+                     pool_size: int, pool_stride: Optional[int] = None,
+                     act: str = "relu", pool_type: str = "max",
+                     name: Optional[str] = None):
+    """conv + batch-norm + pool (img_conv_bn_pool twin)."""
+    conv = layer.conv2d(input, channels=num_filters, kernel=filter_size,
+                        act="linear", name=f"{name}_conv" if name else None)
+    bn = layer.batch_norm(conv, act=act,
+                          name=f"{name}_bn" if name else None)
+    return layer.pool2d(bn, kernel=pool_size,
+                        stride=pool_stride or pool_size,
+                        pool_type=pool_type)
+
+
+def img_conv_group(input, conv_num_filter: Sequence[int],
+                   conv_filter_size: int = 3, conv_act: str = "relu",
+                   conv_with_batchnorm: bool = False,
+                   pool_size: int = 2, pool_stride: int = 2,
+                   pool_type: str = "max", name: Optional[str] = None):
+    """A VGG block: N convs then one pool (img_conv_group twin)."""
+    gname = auto_name("conv_group", name)
+    h = input
+    for i, nf in enumerate(conv_num_filter):
+        if conv_with_batchnorm:
+            h = layer.conv2d(h, channels=nf, kernel=conv_filter_size,
+                             act="linear", name=f"{gname}_conv{i}")
+            h = layer.batch_norm(h, act=conv_act, name=f"{gname}_bn{i}")
+        else:
+            h = layer.conv2d(h, channels=nf, kernel=conv_filter_size,
+                             act=conv_act, name=f"{gname}_conv{i}")
+    return layer.pool2d(h, kernel=pool_size, stride=pool_stride,
+                        pool_type=pool_type)
+
+
+def vgg_16_network(input, num_classes: int = 1000,
+                   name: Optional[str] = None):
+    """VGG-16 (vgg_16_network twin, ``networks.py`` / vgg_16_mnist demo)."""
+    h = input
+    for i, (n, nf) in enumerate([(2, 64), (2, 128), (3, 256), (3, 512),
+                                 (3, 512)]):
+        h = img_conv_group(h, [nf] * n, conv_with_batchnorm=True,
+                           name=f"vgg_b{i}")
+    h = layer.fc(h, size=4096, act="relu", name="vgg_fc6")
+    h = layer.dropout(h, 0.5)
+    h = layer.fc(h, size=4096, act="relu", name="vgg_fc7")
+    h = layer.dropout(h, 0.5)
+    return layer.fc(h, size=num_classes, act="linear", name="vgg_fc8")
+
+
+def simple_lstm(input, size: int, reverse: bool = False,
+                name: Optional[str] = None):
+    """fc (4×size mixed input projection) + lstmemory (simple_lstm twin)."""
+    n = auto_name("simple_lstm", name)
+    proj = layer.fc(input, size=size * 4, act="linear", name=f"{n}_proj")
+    return layer.lstmemory(proj, size=size, reverse=reverse, name=f"{n}_lstm")
+
+
+def bidirectional_lstm(input, size: int, return_concat: bool = True,
+                       name: Optional[str] = None):
+    """Forward + backward LSTM, concatenated per step
+    (bidirectional_lstm twin)."""
+    n = auto_name("bilstm", name)
+    fwd = layer.lstmemory(input, size=size, name=f"{n}_fwd")
+    bwd = layer.lstmemory(input, size=size, reverse=True, name=f"{n}_bwd")
+    if not return_concat:
+        return [fwd, bwd]
+
+    def run(ctx, a, b):
+        return (jnp.concatenate([a[0], b[0]], axis=-1), a[1])
+    return LayerOutput(name=f"{n}_concat", kind="bilstm_concat", fn=run,
+                       inputs=(fwd, bwd))
+
+
+def simple_gru(input, size: int, reverse: bool = False,
+               name: Optional[str] = None):
+    n = auto_name("simple_gru", name)
+    return layer.grumemory(input, size=size, reverse=reverse,
+                           name=f"{n}_gru")
+
+
+def sequence_conv_pool(input, context_len: int, hidden_size: int,
+                       act: str = "tanh", pool_type: str = "max",
+                       name: Optional[str] = None):
+    """context window + fc + sequence pooling (sequence_conv_pool /
+    text_conv_pool twin, the quick_start text-CNN block)."""
+    n = auto_name("seq_conv_pool", name)
+    ctx_proj = layer.context_projection(input, context_len=context_len,
+                                        context_start=-(context_len // 2))
+    h = layer.fc(ctx_proj, size=hidden_size, act=act, name=f"{n}_fc")
+    return layer.seq_pool(h, pool_type=pool_type)
+
+
+text_conv_pool = sequence_conv_pool
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name: Optional[str] = None):
+    """Additive attention context (simple_attention twin,
+    ``networks.py``): score_t = v·tanh(proj_t + W·state); context = softmax
+    over valid steps applied to encoded_sequence."""
+    n = auto_name("attention", name)
+
+    def run(ctx, enc, proj, state, **a):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.module import param
+        from paddle_tpu.nn import initializers as init
+        enforce(isinstance(enc, tuple), "encoded_sequence must be a sequence")
+        enc_v, mask = enc
+        proj_v = proj[0] if isinstance(proj, tuple) else proj
+        d = proj_v.shape[-1]
+        st = nn.Linear(d, act="linear", bias=False,
+                       name=f"{a['_name']}_state_proj")(state)
+        v = param(f"{a['_name']}/v", (d,), jnp.float32,
+                  init.paddle_default(fan_in_axis=0))
+        scores = jnp.einsum("btd,d->bt", jnp.tanh(proj_v + st[:, None, :]), v)
+        scores = jnp.where(mask, scores, -1e9)
+        w = jnp.exp(scores - scores.max(axis=1, keepdims=True))
+        w = w * mask
+        w = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-9)
+        return jnp.einsum("bt,btd->bd", w, enc_v)
+
+    return LayerOutput(name=n, kind="attention", fn=run,
+                       inputs=(encoded_sequence, encoded_proj, decoder_state),
+                       attrs=(("_name", n),))

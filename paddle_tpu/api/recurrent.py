@@ -1,0 +1,375 @@
+"""recurrent_group / memory / beam-search generation for the v2 API.
+
+Twin of the reference's recurrent layer-group machinery — the v1/v2
+``recurrent_group(step=..., input=...)`` + ``memory(name=..., size=...)``
+user surface (``trainer_config_helpers/layers.py`` recurrent_group,
+``config_parser.py`` RecurrentLayerGroup*) executed by
+``RecurrentGradientMachine`` (``RecurrentGradientMachine.cpp:293``
+per-timestep frames, ``:428/:468`` in-frame/memory wiring, generation at
+``:539``).
+
+TPU-native execution instead of per-step ``NeuralNetwork`` frames:
+
+* The user's ``step`` function is traced ONCE at graph-build time against
+  placeholder nodes, yielding a step sub-DAG.
+* At run time the group evaluates as ``lax.scan`` over the time axis of its
+  sequence inputs.  Sub-DAG nodes that do not depend on a placeholder are
+  hoisted out of the scan and evaluated once (the XLA twin of the
+  reference's StaticInput broadcast).
+* ``memory(name=N, ...)`` follows the reference's semantics exactly: its
+  value at step t is the step-graph node *named* N evaluated at step t-1
+  (boot layer or zeros at t=0).
+* The first timestep is unrolled outside the scan so parameter creation at
+  ``init`` happens eagerly (concrete arrays, not scan tracers); steps
+  1..T-1 run inside ``lax.scan`` and reuse the created parameters.
+* Generation replaces the reference's dynamic beam Path expansion
+  (``RecurrentGradientMachine.h:188``) with the static-shape
+  ``ops.beam_search`` while_loop.
+
+Limitation vs the reference: layers with mutable state (batch-norm running
+stats) inside a step net update state only for the unrolled first step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.api.graph import LayerOutput, auto_name, _walk
+from paddle_tpu.api.layer import _is_seq
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticInput:
+    """Non-sequence input broadcast to every step (StaticInput twin)."""
+    input: LayerOutput
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneratedInput:
+    """Generation-mode input: at each step the previous beam token is
+    embedded through the (shared) table named ``embedding_name``
+    (GeneratedInput twin)."""
+    size: int                 # target vocab size
+    embedding_name: str       # nn.Embedding module name to share
+    embedding_size: int
+
+
+_build_stack: List[Dict[str, Any]] = []
+
+
+def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
+           boot_with_const_id: Optional[int] = None):
+    """Previous-step value of the step node named ``name`` (memory twin).
+
+    Must be called inside a ``recurrent_group``/``beam_search`` step
+    function.  ``boot_layer`` (an outer node) or zeros boots step 0.
+    """
+    enforce(_build_stack, "memory() must be called inside a step function")
+    rg = _build_stack[-1]
+    ph = LayerOutput(name=f"{rg['name']}@mem:{name}", kind="rg_memory",
+                     attrs=(("link", name), ("size", size)))
+    rg["memories"].append({"ph": ph, "link": name, "size": size,
+                           "boot": boot_layer,
+                           "boot_id": boot_with_const_id})
+    return ph
+
+
+def _mark_dynamic(nodes: Sequence[LayerOutput]) -> Dict[LayerOutput, bool]:
+    """Which step-DAG nodes transitively depend on a placeholder."""
+    dyn: Dict[LayerOutput, bool] = {}
+    for n in nodes:  # nodes are in topological order from _walk
+        if n.kind in ("rg_in", "rg_memory"):
+            dyn[n] = True
+        else:
+            dyn[n] = any(dyn.get(i, False) for i in n.inputs)
+    return dyn
+
+
+def _eval_subgraph(node: LayerOutput, bindings: Dict[LayerOutput, Any], ctx):
+    if node in bindings:
+        return bindings[node]
+    args = [_eval_subgraph(i, bindings, ctx) for i in node.inputs]
+    enforce(node.fn is not None,
+            "node %r inside a recurrent step is unbound — declare it as a "
+            "group input", node.name)
+    value = node.fn(ctx, *args, **node.attr_dict())
+    bindings[node] = value
+    return value
+
+
+def _build_step(name: str, step: Callable, placeholders: Sequence[Any]):
+    """Trace the user's step function into a sub-DAG + memory declarations."""
+    rg = {"name": name, "memories": []}
+    _build_stack.append(rg)
+    try:
+        outs = step(*placeholders)
+    finally:
+        _build_stack.pop()
+    out_nodes = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    # Resolve each memory's link: the step node with the linked name.
+    walk_roots = list(out_nodes)
+    by_name: Dict[str, LayerOutput] = {}
+    for n in _walk(walk_roots):
+        by_name[n.name] = n
+    for m in rg["memories"]:
+        enforce(m["link"] in by_name,
+                "memory(name=%r): no step node with that name (have %s)",
+                m["link"], sorted(by_name)[:20])
+        m["node"] = by_name[m["link"]]
+    return out_nodes, rg["memories"], isinstance(outs, (list, tuple))
+
+
+def recurrent_group(step: Callable, input, reverse: bool = False,
+                    name: Optional[str] = None):
+    """Run ``step`` over the timesteps of the sequence inputs
+    (recurrent_group twin).
+
+    ``input``: a node, ``StaticInput``, or a list of them; at least one
+    sequence node (a ``(value, mask)`` pair) is required.  ``step``
+    receives one placeholder per input (per-step ``[batch, d]`` slices for
+    sequences, the full value for statics) and returns a node or tuple of
+    nodes; each returned node becomes a sequence output of the group.
+    """
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gname = auto_name("recurrent_group", name)
+
+    seq_idx = [i for i, x in enumerate(inputs)
+               if not isinstance(x, StaticInput)]
+    enforce(seq_idx, "recurrent_group needs at least one sequence input")
+
+    placeholders: List[LayerOutput] = []
+    for i, x in enumerate(inputs):
+        if isinstance(x, StaticInput):
+            placeholders.append(LayerOutput(name=f"{gname}@static{i}",
+                                            kind="rg_in"))
+        else:
+            placeholders.append(LayerOutput(name=f"{gname}@in{i}",
+                                            kind="rg_in"))
+    out_nodes, memories, multi = _build_step(gname, step, placeholders)
+
+    step_nodes = _walk(list(out_nodes) + [m["node"] for m in memories])
+    dyn = _mark_dynamic(step_nodes)
+    # Outer closure nodes: roots of the static part that the outer graph
+    # must evaluate for us (hoisted out of the scan).
+    hoisted = [n for n in step_nodes
+               if not dyn.get(n, False) and n.kind != "rg_in"]
+
+    outer_inputs: List[LayerOutput] = []
+    for x in inputs:
+        outer_inputs.append(x.input if isinstance(x, StaticInput) else x)
+    boot_nodes = [m["boot"] for m in memories if m["boot"] is not None]
+    group_inputs = outer_inputs + boot_nodes + hoisted
+
+    n_in = len(inputs)
+    n_boot = len(boot_nodes)
+
+    def run(ctx, *vals):
+        in_vals = vals[:n_in]
+        boot_vals = list(vals[n_in:n_in + n_boot])
+        hoisted_vals = vals[n_in + n_boot:]
+
+        seqs, statics = {}, {}
+        mask = None
+        for i, x in enumerate(inputs):
+            if isinstance(x, StaticInput):
+                statics[i] = in_vals[i]
+            else:
+                v = in_vals[i]
+                enforce(_is_seq(v),
+                        "recurrent_group input %d is not a sequence", i)
+                seqs[i] = v
+                if mask is None:
+                    mask = v[1]
+        b, t = mask.shape
+
+        # Boot memory values.
+        carry = []
+        bi = 0
+        for m in memories:
+            if m["boot"] is not None:
+                carry.append(boot_vals[bi])
+                bi += 1
+            elif m["boot_id"] is not None:
+                carry.append(jnp.full((b, m["size"]), float(m["boot_id"]),
+                                      jnp.float32))
+            else:
+                carry.append(jnp.zeros((b, m["size"]), jnp.float32))
+
+        base_bind: Dict[LayerOutput, Any] = {}
+        for node, val in zip(hoisted, hoisted_vals):
+            base_bind[node] = val
+        for i, v in statics.items():
+            base_bind[placeholders[i]] = v
+
+        time_index = (jnp.arange(t - 1, -1, -1) if reverse
+                      else jnp.arange(t))
+
+        def eval_at(step_slices, mems):
+            bind = dict(base_bind)
+            for i, x in step_slices.items():
+                bind[placeholders[i]] = x
+            for m, v in zip(memories, mems):
+                bind[m["ph"]] = v
+            outs = [_eval_subgraph(n, bind, ctx) for n in out_nodes]
+            new_mems = [bind[m["node"]] for m in memories]
+            return outs, new_mems
+
+        def slices_at(ti):
+            return {i: jnp.take(v[0], ti, axis=1) for i, v in seqs.items()}
+
+        def masked(new_mems, old_mems, m_t):
+            return [jnp.where(m_t[:, None] if nm.ndim > 1 else m_t, nm, om)
+                    for nm, om in zip(new_mems, old_mems)]
+
+        # Step 0 unrolled (parameter creation happens here, eagerly).
+        t0 = time_index[0]
+        outs0, mems0 = eval_at(slices_at(t0), carry)
+        carry1 = masked(mems0, carry, jnp.take(mask, t0, axis=1))
+
+        if t == 1:
+            stacked = [jnp.expand_dims(o, 1) for o in outs0]
+        else:
+            def body(c, ti):
+                outs, new_mems = eval_at(slices_at(ti), c)
+                c2 = masked(new_mems, c, jnp.take(mask, ti, axis=1))
+                return c2, outs
+
+            _, rest = lax.scan(body, carry1, time_index[1:])
+            stacked = [jnp.concatenate(
+                [jnp.expand_dims(o0, 1), jnp.moveaxis(r, 0, 1)], axis=1)
+                for o0, r in zip(outs0, rest)]
+        if reverse:
+            stacked = [s[:, ::-1] for s in stacked]
+        pairs = []
+        for s in stacked:
+            md = mask.reshape((b, t) + (1,) * (s.ndim - 2))
+            pairs.append((jnp.where(md, s, 0.0), mask))
+        return pairs if multi else pairs[0]
+
+    return LayerOutput(name=gname, kind="recurrent_group", fn=run,
+                       inputs=tuple(group_inputs))
+
+
+def beam_search(step: Callable, input, bos_id: int, eos_id: int,
+                beam_size: int = 5, max_length: int = 50,
+                name: Optional[str] = None):
+    """Beam-search sequence generation (layer.beam_search twin).
+
+    ``input`` must contain exactly one :class:`GeneratedInput` (the
+    recursively generated token, embedded through the shared table) plus any
+    number of :class:`StaticInput` nodes.  ``step`` receives placeholders in
+    the declared order and must return a node of per-class *probabilities*
+    (the reference convention — an ``act="softmax"`` output).
+
+    Evaluates to ``(ids [batch, beam, max_length] int32, scores
+    [batch, beam])`` — the twin of ``RecurrentGradientMachine``'s Path
+    results exposed through ``SequenceGenerator``.
+    """
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gname = auto_name("beam_search", name)
+
+    gen_idx = [i for i, x in enumerate(inputs)
+               if isinstance(x, GeneratedInput)]
+    enforce(len(gen_idx) == 1,
+            "beam_search needs exactly one GeneratedInput (got %d)",
+            len(gen_idx))
+    gi = gen_idx[0]
+    gen: GeneratedInput = inputs[gi]
+
+    placeholders = []
+    for i in range(len(inputs)):
+        placeholders.append(LayerOutput(name=f"{gname}@in{i}", kind="rg_in"))
+    out_nodes, memories, _ = _build_step(gname, step, placeholders)
+    enforce(len(out_nodes) == 1,
+            "beam_search step must return a single probability node")
+
+    step_nodes = _walk(out_nodes + [m["node"] for m in memories])
+    dyn = _mark_dynamic(step_nodes)
+    hoisted = [n for n in step_nodes
+               if not dyn.get(n, False) and n.kind != "rg_in"]
+
+    outer_inputs = [x.input for x in inputs if isinstance(x, StaticInput)]
+    boot_nodes = [m["boot"] for m in memories if m["boot"] is not None]
+    group_inputs = outer_inputs + boot_nodes + hoisted
+    static_pos = [i for i, x in enumerate(inputs)
+                  if isinstance(x, StaticInput)]
+    n_static = len(static_pos)
+    n_boot = len(boot_nodes)
+
+    def run(ctx, *vals):
+        from paddle_tpu.ops import beam_search as bs
+        import paddle_tpu.nn as nn
+
+        static_vals = vals[:n_static]
+        boot_vals = list(vals[n_static:n_static + n_boot])
+        hoisted_vals = vals[n_static + n_boot:]
+
+        if static_vals:
+            first = static_vals[0]
+            bsz = (first[0] if _is_seq(first) else first).shape[0]
+        elif boot_vals:
+            bsz = boot_vals[0].shape[0]
+        else:
+            bsz = 1
+
+        base_bind: Dict[LayerOutput, Any] = {}
+        for node, val in zip(hoisted, hoisted_vals):
+            base_bind[node] = val
+
+        boot = []
+        bi = 0
+        for m in memories:
+            if m["boot"] is not None:
+                boot.append(boot_vals[bi])
+                bi += 1
+            elif m["boot_id"] is not None:
+                boot.append(jnp.full((bsz, m["size"]), float(m["boot_id"]),
+                                     jnp.float32))
+            else:
+                boot.append(jnp.zeros((bsz, m["size"]), jnp.float32))
+
+        embed = nn.Embedding(gen.size, gen.embedding_size,
+                             name=gen.embedding_name)
+        # Create/fetch the shared table outside the while_loop.
+        _ = embed(jnp.zeros((1,), jnp.int32))
+
+        # Static inputs must ride along as state so the while_loop sees
+        # beam-tiled copies (bs.beam_search tiles the state pytree).
+        def step_fn(last_ids, state):
+            bind = dict(base_bind)
+            for k, i in enumerate(static_pos):
+                bind[placeholders[i]] = state[f"static{k}"]
+            bind[placeholders[gi]] = embed(last_ids)
+            for m in memories:
+                bind[m["ph"]] = state[f"mem:{m['link']}"]
+            probs = _eval_subgraph(out_nodes[0], bind, ctx)
+            new_state = dict(state)
+            for m in memories:
+                new_state[f"mem:{m['link']}"] = bind[m["node"]]
+            return jnp.log(probs + 1e-9), new_state
+
+        state: Dict[str, Any] = {}
+        for k, i in enumerate(static_pos):
+            state[f"static{k}"] = static_vals[i]
+        for m, v in zip(memories, boot):
+            state[f"mem:{m['link']}"] = v
+
+        # Priming call outside the while_loop so parameter creation at init
+        # happens on concrete arrays, not loop tracers.
+        step_fn(jnp.full((bsz,), bos_id, jnp.int32), state)
+
+        ids, scores = bs.beam_search(
+            step_fn, state, batch_size=bsz, beam_size=beam_size,
+            max_len=max_length, bos_id=bos_id, eos_id=eos_id)
+        ctx.outputs[f"{gname}_ids"] = ids
+        ctx.outputs[f"{gname}_scores"] = scores
+        return ids
+
+    return LayerOutput(name=gname, kind="beam_search", fn=run,
+                       inputs=tuple(group_inputs))
