@@ -125,6 +125,31 @@ def _build_step(name: str, step: Callable, placeholders: Sequence[Any]):
     return out_nodes, rg["memories"], isinstance(outs, (list, tuple))
 
 
+def _plan_group(out_nodes, memories):
+    """Shared plumbing: hoisted static sub-DAG nodes + boot-layer nodes."""
+    step_nodes = _walk(list(out_nodes) + [m["node"] for m in memories])
+    dyn = _mark_dynamic(step_nodes)
+    hoisted = [n for n in step_nodes
+               if not dyn.get(n, False) and n.kind != "rg_in"]
+    boot_nodes = [m["boot"] for m in memories if m["boot"] is not None]
+    return hoisted, boot_nodes
+
+
+def _boot_values(memories, boot_vals, bsz):
+    """Initial memory values: boot layer > boot_with_const_id > zeros."""
+    out, bi = [], 0
+    for m in memories:
+        if m["boot"] is not None:
+            out.append(boot_vals[bi])
+            bi += 1
+        elif m["boot_id"] is not None:
+            out.append(jnp.full((bsz, m["size"]), float(m["boot_id"]),
+                                jnp.float32))
+        else:
+            out.append(jnp.zeros((bsz, m["size"]), jnp.float32))
+    return out
+
+
 def recurrent_group(step: Callable, input, reverse: bool = False,
                     name: Optional[str] = None):
     """Run ``step`` over the timesteps of the sequence inputs
@@ -153,17 +178,13 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                                             kind="rg_in"))
     out_nodes, memories, multi = _build_step(gname, step, placeholders)
 
-    step_nodes = _walk(list(out_nodes) + [m["node"] for m in memories])
-    dyn = _mark_dynamic(step_nodes)
-    # Outer closure nodes: roots of the static part that the outer graph
-    # must evaluate for us (hoisted out of the scan).
-    hoisted = [n for n in step_nodes
-               if not dyn.get(n, False) and n.kind != "rg_in"]
+    # Hoisted = roots of the static part that the outer graph must
+    # evaluate for us (pulled out of the scan).
+    hoisted, boot_nodes = _plan_group(out_nodes, memories)
 
     outer_inputs: List[LayerOutput] = []
     for x in inputs:
         outer_inputs.append(x.input if isinstance(x, StaticInput) else x)
-    boot_nodes = [m["boot"] for m in memories if m["boot"] is not None]
     group_inputs = outer_inputs + boot_nodes + hoisted
 
     n_in = len(inputs)
@@ -188,18 +209,7 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                     mask = v[1]
         b, t = mask.shape
 
-        # Boot memory values.
-        carry = []
-        bi = 0
-        for m in memories:
-            if m["boot"] is not None:
-                carry.append(boot_vals[bi])
-                bi += 1
-            elif m["boot_id"] is not None:
-                carry.append(jnp.full((b, m["size"]), float(m["boot_id"]),
-                                      jnp.float32))
-            else:
-                carry.append(jnp.zeros((b, m["size"]), jnp.float32))
+        carry = _boot_values(memories, boot_vals, b)
 
         base_bind: Dict[LayerOutput, Any] = {}
         for node, val in zip(hoisted, hoisted_vals):
@@ -289,13 +299,9 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
     enforce(len(out_nodes) == 1,
             "beam_search step must return a single probability node")
 
-    step_nodes = _walk(out_nodes + [m["node"] for m in memories])
-    dyn = _mark_dynamic(step_nodes)
-    hoisted = [n for n in step_nodes
-               if not dyn.get(n, False) and n.kind != "rg_in"]
+    hoisted, boot_nodes = _plan_group(out_nodes, memories)
 
     outer_inputs = [x.input for x in inputs if isinstance(x, StaticInput)]
-    boot_nodes = [m["boot"] for m in memories if m["boot"] is not None]
     group_inputs = outer_inputs + boot_nodes + hoisted
     static_pos = [i for i, x in enumerate(inputs)
                   if isinstance(x, StaticInput)]
@@ -322,17 +328,7 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
         for node, val in zip(hoisted, hoisted_vals):
             base_bind[node] = val
 
-        boot = []
-        bi = 0
-        for m in memories:
-            if m["boot"] is not None:
-                boot.append(boot_vals[bi])
-                bi += 1
-            elif m["boot_id"] is not None:
-                boot.append(jnp.full((bsz, m["size"]), float(m["boot_id"]),
-                                     jnp.float32))
-            else:
-                boot.append(jnp.zeros((bsz, m["size"]), jnp.float32))
+        boot = _boot_values(memories, boot_vals, bsz)
 
         embed = nn.Embedding(gen.size, gen.embedding_size,
                              name=gen.embedding_name)
@@ -355,8 +351,8 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
             return jnp.log(probs + 1e-9), new_state
 
         state: Dict[str, Any] = {}
-        for k, i in enumerate(static_pos):
-            state[f"static{k}"] = static_vals[i]
+        for k in range(n_static):
+            state[f"static{k}"] = static_vals[k]
         for m, v in zip(memories, boot):
             state[f"mem:{m['link']}"] = v
 

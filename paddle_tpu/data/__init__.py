@@ -1,6 +1,7 @@
-from paddle_tpu.data import reader, datasets
+from paddle_tpu.data import reader, datasets, provider
 from paddle_tpu.data.feeder import (DataFeeder, Dense, Integer, IntSequence,
-                                    DenseSequence)
+                                    DenseSequence, SparseBinary, SparseFloat)
 
-__all__ = ["reader", "datasets", "DataFeeder", "Dense", "Integer",
-           "IntSequence", "DenseSequence"]
+__all__ = ["reader", "datasets", "provider", "DataFeeder", "Dense",
+           "Integer", "IntSequence", "DenseSequence", "SparseBinary",
+           "SparseFloat"]

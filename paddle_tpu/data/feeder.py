@@ -51,6 +51,24 @@ class DenseSequence:
     dtype: Any = np.float32
 
 
+@dataclasses.dataclass(frozen=True)
+class SparseBinary:
+    """Sparse 0/1 vector slot given as active indices (twin of
+    sparse_binary_vector).  Densified to a multi-hot [dim] float row — the
+    TPU-native layout (static shapes; XLA has no CSR) of the reference's
+    binary CSR rows (``Matrix.h:66`` CpuSparseMatrix NO_VALUE)."""
+    dim: int
+    dtype: Any = np.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseFloat:
+    """Sparse float vector slot given as (index, value) pairs (twin of
+    sparse_float_vector); densified to a [dim] float row."""
+    dim: int
+    dtype: Any = np.float32
+
+
 def _bucket_len(n: int, buckets: Optional[Sequence[int]]) -> int:
     if not buckets:
         return n
@@ -102,6 +120,17 @@ class DataFeeder:
                     mask[i, :n] = True
                 out[name] = arr
                 out[name + "_mask"] = mask
+            elif isinstance(ftype, SparseBinary):
+                arr = np.zeros((len(col), ftype.dim), ftype.dtype)
+                for i, idxs in enumerate(col):
+                    arr[i, np.asarray(list(idxs), np.int64)] = 1.0
+                out[name] = arr
+            elif isinstance(ftype, SparseFloat):
+                arr = np.zeros((len(col), ftype.dim), ftype.dtype)
+                for i, pairs in enumerate(col):
+                    for j, v in pairs:
+                        arr[i, j] = v
+                out[name] = arr
             else:
                 raise TypeError(f"Unknown feed type {ftype!r}")
         return out

@@ -1,0 +1,107 @@
+"""Image preprocessing utilities (``python/paddle/v2/image.py`` twin).
+
+The reference wraps PIL/cv2 for load/resize/crop/flip/normalize used by the
+image demos and the ImageNet input pipeline.  Pure-numpy implementations
+here (bilinear resize included) so the pipeline has no extra dependencies;
+layouts are HWC uint8/float like the reference's, with ``to_chw`` for
+converting to its CHW convention (our conv layers take NHWC).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+
+__all__ = ["resize_short", "resize", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "to_chw", "batch_images"]
+
+
+def resize(im: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
+    """Bilinear resize of an HWC (or HW) image to (h, w)."""
+    h, w = im.shape[:2]
+    oh, ow = size
+    if (h, w) == (oh, ow):
+        return im
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    fim = im.astype(np.float32)
+    top = fim[y0][:, x0] * (1 - wx) + fim[y0][:, x1] * wx
+    bot = fim[y1][:, x0] * (1 - wx) + fim[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return np.rint(out).astype(im.dtype) \
+        if np.issubdtype(im.dtype, np.integer) else out
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the shorter edge equals ``size`` (resize_short twin)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return resize(im, (size, int(round(w * size / h))))
+    return resize(im, (int(round(h * size / w)), size))
+
+
+def center_crop(im: np.ndarray, size: int) -> np.ndarray:
+    h, w = im.shape[:2]
+    enforce(h >= size and w >= size,
+            "center_crop: image %dx%d smaller than crop %d", h, w, size)
+    y = (h - size) // 2
+    x = (w - size) // 2
+    return im[y:y + size, x:x + size]
+
+
+def random_crop(im: np.ndarray, size: int,
+                rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    enforce(h >= size and w >= size,
+            "random_crop: image %dx%d smaller than crop %d", h, w, size)
+    y = rng.randint(0, h - size + 1)
+    x = rng.randint(0, w - size + 1)
+    return im[y:y + size, x:x + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def to_chw(im: np.ndarray) -> np.ndarray:
+    """HWC -> CHW (the reference's layout; our conv layers take NHWC)."""
+    return np.transpose(im, (2, 0, 1))
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool,
+                     mean: Optional[Sequence[float]] = None,
+                     scale: float = 1.0,
+                     rng: Optional[np.random.RandomState] = None
+                     ) -> np.ndarray:
+    """resize-short + crop (+ random flip when training) + normalize
+    (simple_transform twin) — returns float32 HWC."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng)
+        if (rng or np.random).randint(0, 2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = im.astype(np.float32) * scale
+    if mean is not None:
+        im = im - np.asarray(mean, np.float32)
+    return im
+
+
+def batch_images(images: Sequence[np.ndarray]) -> np.ndarray:
+    """Stack HWC images into an NHWC batch."""
+    return np.stack([np.asarray(im, np.float32) for im in images])

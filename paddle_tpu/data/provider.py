@@ -1,0 +1,211 @@
+"""``@provider`` data-provider protocol (PyDataProvider2 twin).
+
+Re-creation of the reference's Python data-provider surface —
+``python/paddle/trainer/PyDataProvider2.py:365`` (the ``@provider``
+decorator, ``input_types``, ``init_hook``, ``pool_size`` shuffle pool,
+``cache`` modes) and the C++ host that pulls from it
+(``gserver/dataproviders/PyDataProvider2.cpp:195,334``) — except the host
+here is pure Python: a provider instance *is* a reader over file names,
+composable with ``paddle_tpu.data.reader`` combinators and fed through
+:class:`~paddle_tpu.data.feeder.DataFeeder`.
+
+Input-type constructors carry the reference's exact names
+(``dense_vector``, ``integer_value_sequence``, ...) and map onto the
+feeder's slot types; sparse slots densify to multi-hot rows (static shapes
+for XLA — the capability delta vs CSR is documented in the feeder).
+
+Example, mirroring the reference's mnist_provider.py idiom::
+
+    from paddle_tpu.data import provider as pv
+
+    @pv.provider(input_types={"pixel": pv.dense_vector(784),
+                              "label": pv.integer_value(10)},
+                 cache=pv.CacheType.CACHE_PASS_IN_MEM)
+    def process(settings, filename):
+        for img, lab in read_file(filename):
+            yield {"pixel": img, "label": lab}
+
+    reader = process(["train.list.1", "train.list.2"])   # a reader()
+    feeder = reader.feeder()                              # DataFeeder
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.data import feeder as feeder_mod
+
+
+# ---- input types (names match PyDataProvider2.py) ---------------------------
+
+def dense_vector(dim: int):
+    return feeder_mod.Dense((dim,))
+
+
+def dense_array(shape: Sequence[int]):
+    return feeder_mod.Dense(tuple(shape))
+
+
+def integer_value(value_range: int = 0):
+    # value_range is metadata only (the reference used it for checks).
+    return feeder_mod.Integer()
+
+
+def dense_vector_sequence(dim: int,
+                          buckets: Optional[Sequence[int]] = None):
+    return feeder_mod.DenseSequence(dim, buckets=buckets)
+
+
+def integer_value_sequence(value_range: int = 0,
+                           buckets: Optional[Sequence[int]] = None):
+    return feeder_mod.IntSequence(buckets=buckets)
+
+
+def sparse_binary_vector(dim: int):
+    return feeder_mod.SparseBinary(dim)
+
+
+def sparse_float_vector(dim: int):
+    return feeder_mod.SparseFloat(dim)
+
+
+class CacheType(enum.Enum):
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class Settings:
+    """The ``settings`` object handed to init_hook and the process fn
+    (PyDataProvider2's DataProviderWrapper settings twin): carries
+    ``input_types``, a logger, and any attributes the init_hook sets."""
+
+    def __init__(self, input_types: Dict[str, Any], **kwargs):
+        self.input_types = input_types
+        self.logger = logging.getLogger("paddle_tpu.provider")
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class DataProvider:
+    """A bound provider: iterate samples from a file list.
+
+    Calling it returns a fresh sample iterator (the reader protocol), so it
+    plugs into ``reader.shuffle``/``reader.batch``/... directly.
+    """
+
+    def __init__(self, process: Callable, files: Sequence[str],
+                 settings: Settings, pool_size: int, cache: CacheType,
+                 should_shuffle: bool, seed: Optional[int]):
+        self._process = process
+        self.files = list(files)
+        self.settings = settings
+        self.pool_size = pool_size
+        self.cache = cache
+        self.should_shuffle = should_shuffle
+        self._rng = random.Random(seed)
+        self._pass_cache: Optional[List[Any]] = None
+
+    @property
+    def input_types(self) -> Dict[str, Any]:
+        return self.settings.input_types
+
+    def feeder(self) -> feeder_mod.DataFeeder:
+        """A DataFeeder matching this provider's input_types (dict samples
+        are converted to tuples in declaration order)."""
+        names = list(self.settings.input_types)
+        types = [self.settings.input_types[n] for n in names]
+        return feeder_mod.DataFeeder(types, names)
+
+    def _iter_raw(self):
+        files = list(self.files)
+        if self.should_shuffle:
+            self._rng.shuffle(files)
+        for fname in files:
+            for sample in self._process(self.settings, fname):
+                if isinstance(sample, dict):
+                    sample = tuple(sample[k]
+                                   for k in self.settings.input_types)
+                yield sample
+
+    def __call__(self):
+        if self.cache == CacheType.CACHE_PASS_IN_MEM:
+            if self._pass_cache is None:
+                self._pass_cache = list(self._iter_raw())
+            data: Any = list(self._pass_cache)
+            if self.should_shuffle:
+                self._rng.shuffle(data)
+            return iter(data)
+        if self.should_shuffle:
+            if self.pool_size > 0:
+                return self._pooled_iter()
+            # pool_size 0 = unlimited pool (the reference's default):
+            # full-pass in-memory shuffle.
+            data = list(self._iter_raw())
+            self._rng.shuffle(data)
+            return iter(data)
+        return self._iter_raw()
+
+    def _pooled_iter(self):
+        """Reservoir-pool shuffle (the reference's pool_size semantics:
+        fill a pool, emit randomly, refill — bounded memory)."""
+        pool: List[Any] = []
+        for sample in self._iter_raw():
+            pool.append(sample)
+            if len(pool) >= self.pool_size:
+                self._rng.shuffle(pool)
+                half = len(pool) // 2
+                for s in pool[:half]:
+                    yield s
+                pool = pool[half:]
+        self._rng.shuffle(pool)
+        yield from pool
+
+
+def provider(input_types: Union[Dict[str, Any], Sequence[Any], None] = None,
+             cache: CacheType = CacheType.NO_CACHE,
+             pool_size: int = 0,
+             should_shuffle: bool = True,
+             init_hook: Optional[Callable] = None,
+             calc_batch_size: Optional[Callable] = None,
+             seed: Optional[int] = 0,
+             **extra_settings):
+    """Decorator turning ``process(settings, filename)`` generators into
+    :class:`DataProvider` factories (``@provider`` twin,
+    ``PyDataProvider2.py:365``).
+
+    The decorated function becomes ``factory(files, **hook_kwargs) ->
+    DataProvider``.  ``input_types`` may be a name→type dict (preferred
+    here; samples may then be dicts) or a positional list.  ``init_hook``
+    runs once per construction: ``init_hook(settings, files=files,
+    **hook_kwargs)`` and may set/replace ``settings.input_types``.
+    ``calc_batch_size`` is accepted for signature parity (batch sizing
+    lives in ``reader.batch`` here).
+    """
+
+    def wrap(process: Callable) -> Callable:
+        def factory(files: Union[str, Sequence[str]],
+                    **hook_kwargs) -> DataProvider:
+            if isinstance(files, str):
+                files = [files]
+            types = input_types
+            if isinstance(types, (list, tuple)):
+                types = {f"slot{i}": t for i, t in enumerate(types)}
+            settings = Settings(dict(types or {}), **extra_settings)
+            if init_hook is not None:
+                init_hook(settings, files=list(files), **hook_kwargs)
+            enforce(settings.input_types,
+                    "provider %r has no input_types (pass input_types= or "
+                    "set settings.input_types in init_hook)",
+                    getattr(process, "__name__", "?"))
+            return DataProvider(process, files, settings, pool_size, cache,
+                                should_shuffle, seed)
+
+        factory.__name__ = getattr(process, "__name__", "provider")
+        factory.origin = process
+        return factory
+
+    return wrap

@@ -1,0 +1,78 @@
+"""Training-curve plotting (``python/paddle/v2/plot/plot.py`` twin).
+
+The reference's ``Ploter`` collects per-step costs and redraws a matplotlib
+figure from event handlers; headless runs fall back to appending values to
+a log.  Same shape here: matplotlib is optional (this image has no display),
+and the data is always retained so tests and notebooks can read it back.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step: List[int] = []
+        self.value: List[float] = []
+
+    def append(self, step: int, value: float) -> None:
+        self.step.append(int(step))
+        self.value.append(float(value))
+
+    def reset(self) -> None:
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    """``Ploter("train_cost", "test_cost")`` — call ``append(title, step,
+    value)`` from event handlers and ``plot()`` to draw/save."""
+
+    def __init__(self, *titles: str):
+        self.__args__ = titles
+        self.__plot_data__: Dict[str, PlotData] = {t: PlotData()
+                                                   for t in titles}
+        self._disabled = bool(os.environ.get("DISABLE_PLOT"))
+        try:  # headless-safe matplotlib import
+            import matplotlib
+            if not os.environ.get("DISPLAY"):
+                # Only force the file-only backend when there is no
+                # display; never hijack an interactive/notebook backend.
+                matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            self._plt = plt
+        except Exception:
+            self._plt = None
+
+    def append(self, title: str, step: int, value: float) -> None:
+        assert title in self.__plot_data__, (
+            f"unknown curve {title!r}; have {list(self.__plot_data__)}")
+        self.__plot_data__[title].append(step, value)
+
+    def data(self, title: str) -> PlotData:
+        return self.__plot_data__[title]
+
+    def plot(self, path: Optional[str] = None) -> None:
+        """Draw all curves; save to ``path`` when given (headless mode
+        without a path is a no-op beyond data retention)."""
+        if self._plt is None or self._disabled:
+            return
+        self._plt.clf()
+        for title, d in self.__plot_data__.items():
+            if d.step:
+                self._plt.plot(d.step, d.value, label=title)
+        self._plt.legend()
+        self._plt.xlabel("step")
+        if path:
+            self._plt.savefig(path)
+        elif os.environ.get("DISPLAY"):
+            self._plt.draw()
+            self._plt.pause(0.001)
+
+    def reset(self) -> None:
+        for d in self.__plot_data__.values():
+            d.reset()

@@ -163,6 +163,33 @@ def test_beam_search_generation():
     assert (ids[:, :, 0] == 0).all()
 
 
+def test_beam_search_generated_input_first():
+    """GeneratedInput declared before the StaticInput (regression: the
+    initial state must index static_vals by static-only position)."""
+    b, vocab, emb, h = 2, 9, 4, 6
+    rs = np.random.RandomState(21)
+    batch = {"enc": rs.randn(b, h).astype(np.float32)}
+    enc = layer.data("enc")
+
+    def step(tok_emb, enc_v):
+        mem = api.memory(name="d2", size=h)
+        dec = layer.fc(layer.concat([tok_emb, enc_v, mem]), size=h,
+                       act="tanh", name="d2")
+        return layer.fc(dec, size=vocab, act="softmax", name="p2")
+
+    gen = api.beam_search(
+        step=step,
+        input=[api.GeneratedInput(size=vocab, embedding_name="e_first",
+                                  embedding_size=emb),
+               api.StaticInput(enc)],
+        bos_id=0, eos_id=1, beam_size=2, max_length=5)
+    model_fn = api.compile_model(gen, extra_outputs=[gen])
+    model = nn.transform(lambda bt: model_fn(bt))
+    params, state = model.init(jax.random.key(0), batch)
+    (_, outs), _ = model.apply(params, state, None, batch)
+    assert np.asarray(outs[gen.name]).shape == (b, 2, 5)
+
+
 # ---- cost zoo --------------------------------------------------------------
 
 def test_cost_zoo_smoke():

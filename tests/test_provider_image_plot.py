@@ -1,0 +1,144 @@
+"""Tests for the @provider protocol, sparse feed slots, image utils, and
+the Ploter (reference: test_PyDataProvider2.cpp/.py provider configs,
+``v2/tests/test_image.py``, ``v2/plot/tests``)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import provider as pv
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data import image as img
+from paddle_tpu.data.feeder import DataFeeder, SparseBinary, SparseFloat
+from paddle_tpu.utils.plot import Ploter
+
+
+def _mk_provider(**kw):
+    @pv.provider(input_types={"x": pv.dense_vector(4),
+                              "label": pv.integer_value(3)}, **kw)
+    def process(settings, filename):
+        base = int(filename.split("-")[1])
+        for i in range(5):
+            yield {"x": np.full(4, base + i, np.float32),
+                   "label": (base + i) % 3}
+    return process
+
+
+def test_provider_basic_iteration():
+    process = _mk_provider(should_shuffle=False)
+    dp = process(["f-0", "f-100"])
+    samples = list(dp())
+    assert len(samples) == 10
+    # dict samples converted to tuples in input_types order
+    assert samples[0][0].shape == (4,) and samples[0][1] == 0
+    # a second pass re-reads the generator
+    assert len(list(dp())) == 10
+
+
+def test_provider_is_a_reader_and_feeds():
+    process = _mk_provider(should_shuffle=False)
+    dp = process(["f-0", "f-100"])
+    feeder = dp.feeder()
+    batches = [feeder(b) for b in rd.batch(dp, 4, drop_last=False)()]
+    assert batches[0]["x"].shape == (4, 4)
+    assert batches[0]["label"].dtype == np.int32
+    assert sum(b["x"].shape[0] for b in batches) == 10
+
+
+def test_provider_cache_pass_in_mem():
+    calls = {"n": 0}
+
+    @pv.provider(input_types={"v": pv.integer_value()},
+                 cache=pv.CacheType.CACHE_PASS_IN_MEM,
+                 should_shuffle=False)
+    def process(settings, filename):
+        calls["n"] += 1
+        for i in range(3):
+            yield {"v": i}
+
+    dp = process(["only"])
+    a = list(dp())
+    b = list(dp())
+    assert calls["n"] == 1  # second pass served from cache
+    assert a == b and len(a) == 3
+
+
+def test_provider_pool_shuffle_covers_all():
+    @pv.provider(input_types={"v": pv.integer_value()},
+                 pool_size=8, should_shuffle=True, seed=7)
+    def process(settings, filename):
+        for i in range(30):
+            yield {"v": i}
+
+    got = sorted(s[0] for s in process(["f"])())
+    assert got == list(range(30))
+
+
+def test_provider_init_hook_and_settings():
+    @pv.provider(init_hook=lambda settings, files, dict_size:
+                 setattr(settings, "input_types",
+                         {"w": pv.integer_value_sequence(dict_size)}))
+    def process(settings, filename):
+        yield {"w": [1, 2, 3]}
+
+    dp = process(["f"], dict_size=10)
+    feeder = dp.feeder()
+    batch = feeder(list(dp()))
+    assert batch["w"].shape == (1, 3)
+    assert batch["w_mask"].all()
+
+
+def test_sparse_slots_densify():
+    feeder = DataFeeder([SparseBinary(8), SparseFloat(8)], ["b", "f"])
+    batch = feeder([([1, 3], [(0, 0.5), (7, 2.0)]),
+                    ([0], [(2, 1.5)])])
+    want_b = np.zeros((2, 8), np.float32)
+    want_b[0, [1, 3]] = 1
+    want_b[1, 0] = 1
+    np.testing.assert_array_equal(batch["b"], want_b)
+    assert batch["f"][0, 7] == 2.0 and batch["f"][1, 2] == 1.5
+
+
+def test_image_utils():
+    rs = np.random.RandomState(0)
+    im = rs.randint(0, 255, (40, 60, 3)).astype(np.uint8)
+    r = img.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[1] == 30
+    c = img.center_crop(r, 16)
+    assert c.shape == (16, 16, 3)
+    rc = img.random_crop(r, 16, np.random.RandomState(1))
+    assert rc.shape == (16, 16, 3)
+    f = img.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    t = img.simple_transform(im, 24, 20, is_train=True,
+                             mean=[127.5, 127.5, 127.5], scale=1.0,
+                             rng=np.random.RandomState(2))
+    assert t.shape == (20, 20, 3) and t.dtype == np.float32
+    assert abs(float(t.mean())) < 128
+    chw = img.to_chw(t)
+    assert chw.shape == (3, 20, 20)
+    nb = img.batch_images([t, t])
+    assert nb.shape == (2, 20, 20, 3)
+
+
+def test_resize_identity_and_upscale():
+    im = np.arange(12, dtype=np.float32).reshape(3, 4)
+    same = img.resize(im, (3, 4))
+    np.testing.assert_array_equal(same, im)
+    up = img.resize(im, (6, 8))
+    assert up.shape == (6, 8)
+    # corners approximately preserved
+    assert abs(float(up[0, 0]) - im[0, 0]) < 1.0
+    assert abs(float(up[-1, -1]) - im[-1, -1]) < 1.0
+
+
+def test_ploter_collects_and_plots(tmp_path):
+    p = Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 0, 0.5)
+    assert p.data("train").value[0] == 1.0
+    p.plot(str(tmp_path / "curve.png"))  # headless-safe either way
+    p.reset()
+    assert p.data("train").step == []
+    with pytest.raises(AssertionError):
+        p.append("nope", 0, 1.0)
