@@ -36,6 +36,11 @@ class OptimizationConfig:
     l2_rate: float = 0.0
     gradient_clipping_threshold: float = 0.0
     average_window: int = 0
+    # Row-lazy sparse updates for embedding-like tables (the reference's
+    # sparse_update=True on param attrs + OptimizerWithRegularizerSparse):
+    # params matching sparse_patterns get per-row lazy decay + updates.
+    sparse_update: bool = False
+    sparse_patterns: tuple = ("emb",)
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     to_dict = _asdict

@@ -37,8 +37,9 @@ def resize(im: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
         wy = wy[..., None]
         wx = wx[..., None]
     fim = im.astype(np.float32)
-    top = fim[y0][:, x0] * (1 - wx) + fim[y0][:, x1] * wx
-    bot = fim[y1][:, x0] * (1 - wx) + fim[y1][:, x1] * wx
+    r0, r1 = fim[y0], fim[y1]
+    top = r0[:, x0] * (1 - wx) + r0[:, x1] * wx
+    bot = r1[:, x0] * (1 - wx) + r1[:, x1] * wx
     out = top * (1 - wy) + bot * wy
     return np.rint(out).astype(im.dtype) \
         if np.issubdtype(im.dtype, np.integer) else out

@@ -3,7 +3,7 @@ from paddle_tpu.optim.transforms import (Transform, apply_updates, chain,
 from paddle_tpu.optim.optimizers import (sgd, momentum, adagrad,
                                          decayed_adagrad, adadelta, rmsprop,
                                          adam, adamax, from_name)
-from paddle_tpu.optim import schedules, regularizers, average
+from paddle_tpu.optim import schedules, regularizers, average, sparse
 from paddle_tpu.optim.regularizers import (l1_decay, l2_decay, clip_by_value,
                                            clip_by_global_norm)
 from paddle_tpu.core.config import OptimizationConfig
@@ -29,7 +29,25 @@ def from_config(config: OptimizationConfig) -> Transform:
     kwargs = dict(config.extra)
     if config.learning_method == "momentum":
         kwargs.setdefault("mu", config.momentum)
-    parts.append(from_name(config.learning_method, lr, **kwargs))
+    base = from_name(config.learning_method, lr, **kwargs)
+    if config.sparse_update:
+        # Embedding-like tables go row-lazy: decay catches up only when a
+        # row is touched (lr-scaled, matching the dense l1/l2_decay
+        # semantics), optimizer state frozen in between.  Gradient clipping
+        # applies on both sides; the global-norm is per-partition, which
+        # matches the reference's per-parameter clipping
+        # (FirstOrderOptimizer.h:342) more closely than a whole-tree norm.
+        dense = chain(*parts, base) if parts else base
+        sparse_inner = (chain(clip_by_global_norm(
+            config.gradient_clipping_threshold), base)
+            if config.gradient_clipping_threshold > 0 else base)
+        lazy = sparse.sparse_rows(sparse_inner, l2=config.l2_rate,
+                                  l1=config.l1_rate, lr=lr)
+        return sparse.partition(
+            {"sparse": lazy, "dense": dense},
+            sparse.embedding_label_fn(patterns=tuple(
+                config.sparse_patterns)))
+    parts.append(base)
     return chain(*parts) if len(parts) > 1 else parts[0]
 
 
@@ -37,6 +55,6 @@ __all__ = [
     "Transform", "apply_updates", "chain", "scale", "identity", "sgd",
     "momentum", "adagrad", "decayed_adagrad", "adadelta", "rmsprop", "adam",
     "adamax", "from_name", "from_config", "schedules", "regularizers",
-    "average", "l1_decay", "l2_decay", "clip_by_value",
+    "average", "sparse", "l1_decay", "l2_decay", "clip_by_value",
     "clip_by_global_norm",
 ]

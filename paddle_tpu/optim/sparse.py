@@ -1,0 +1,212 @@
+"""Row-sparse (lazy) optimization for embedding tables.
+
+Twin of the reference's sparse-parameter machinery:
+
+* ``SparseRowCpuMatrix::sgdUpdate`` (``math/SparseRowMatrix.h:116``) —
+  optimizer math applied only to the rows a batch touched;
+* ``OptimizerWithRegularizerSparse`` (``parameter/OptimizerWithRegularizer.h:
+  22-127``) — L1/L2 regularization applied *lazily*: each row catches up on
+  the decay it missed since the last time it was touched (the reference's
+  per-row ``t0`` vector);
+* per-parameter optimizer routing (``ParameterOptimizer::create`` choosing
+  sparse vs dense paths per ``ParameterConfig``), reproduced here as a
+  ``partition`` combinator (one Transform per label).
+
+TPU-native formulation: gradients stay dense ``[rows, dim]`` arrays (XLA's
+scatter-add from the embedding backward keeps untouched rows exactly zero),
+and "row touched" is a mask computed from the gradient — the *semantics*
+are per-row-lazy while the *compute* is a dense masked update the TPU
+vectorizes.  Numerics match the reference's lazy scheme exactly: untouched
+rows carry NO optimizer-state evolution and NO weight decay until next
+touched, then catch up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.optim.transforms import Transform
+
+
+def partition(transforms: Dict[str, Transform],
+              label_fn: Callable[[str, Any], str]) -> Transform:
+    """Route each parameter to one of several transforms by label
+    (the per-parameter optimizer choice of ``ParameterOptimizer::create``).
+
+    ``label_fn(path, leaf) -> label``; paths are '/'-joined names.  Each
+    transform sees a sub-pytree holding only its params (others pruned), so
+    its state mirrors exactly the params it owns.
+    """
+    labels = sorted(transforms)
+
+    def _split(tree):
+        """tree -> {label: subtree-with-only-that-label's-leaves}"""
+        out: Dict[str, Any] = {lab: {} for lab in labels}
+
+        def walk(node, path, outs):
+            for k, v in node.items():
+                p = f"{path}/{k}" if path else k
+                if isinstance(v, dict):
+                    subs = {lab: {} for lab in labels}
+                    walk(v, p, subs)
+                    for lab in labels:
+                        if subs[lab]:
+                            outs[lab][k] = subs[lab]
+                else:
+                    lab = label_fn(p, v)
+                    enforce(lab in transforms,
+                            "partition: label %r for param %s not in %s",
+                            lab, p, labels)
+                    outs[lab][k] = v
+
+        walk(tree, "", out)
+        return out
+
+    def _merge(parts):
+        out: Dict[str, Any] = {}
+        for part in parts.values():
+            def fold(dst, src):
+                for k, v in src.items():
+                    if isinstance(v, dict):
+                        fold(dst.setdefault(k, {}), v)
+                    else:
+                        dst[k] = v
+            fold(out, part)
+        return out
+
+    def init(params):
+        split = _split(params)
+        return {lab: transforms[lab].init(split[lab]) for lab in labels}
+
+    def update(grads, state, params, step):
+        gsplit = _split(grads)
+        psplit = _split(params)
+        new_updates = {}
+        new_state = {}
+        for lab in labels:
+            upd, st = transforms[lab].update(gsplit[lab], state[lab],
+                                             psplit[lab], step)
+            new_updates[lab] = upd
+            new_state[lab] = st
+        return _merge(new_updates), new_state
+
+    return Transform(init, update)
+
+
+def sparse_rows(inner: Transform, l2: float = 0.0, l1: float = 0.0,
+                shrink: float = 1.0, lr=None) -> Transform:
+    """Row-lazy wrapper: apply ``inner`` + decay only to touched rows.
+
+    Meant for a subtree of 2-D ``[rows, dim]`` tables (route it there with
+    :func:`partition`).  A row is "touched" when its gradient row is
+    non-zero.  Untouched rows keep their value AND their optimizer state
+    frozen; when touched again they first catch up ``dt`` steps of decay:
+    ``p *= (1 - eta*l2)**dt`` then soft-threshold by ``eta * l1 * dt``,
+    where ``eta`` is the learning rate at catch-up time — matching the
+    lr-scaled per-step decay dense params get from ``l1/l2_decay``
+    (``OptimizerWithRegularizerSparse`` semantics with the reference's t0
+    bookkeeping, ``Regularizer.cpp``).  ``lr`` is a float or
+    ``schedules``-style callable of ``step``; default 1.0 (unscaled decay).
+    ``shrink`` scales the whole decay (the ``shrinkRatio`` of
+    CacheRowCpuMatrix-style setups).
+    """
+
+    def _lr_at(step):
+        if lr is None:
+            return 1.0
+        return lr(step) if callable(lr) else lr
+
+    def init(params):
+        t0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((p.shape[0],), jnp.int32), params)
+        return {"inner": inner.init(params), "t0": t0}
+
+    def _catch_up(p, touched, dt, eta):
+        dtf = dt.astype(jnp.float32)[:, None]
+        out = p
+        if l2:
+            out = out * jnp.power(1.0 - eta * l2 * shrink, dtf)
+        if l1:
+            thresh = eta * l1 * shrink * dtf
+            out = jnp.sign(out) * jnp.maximum(jnp.abs(out) - thresh, 0.0)
+        return jnp.where(touched[:, None], out, p)
+
+    def update(grads, state, params, step):
+        touched = jax.tree_util.tree_map(
+            lambda g: jnp.any(g != 0, axis=tuple(range(1, g.ndim))), grads)
+        # catch-up regularization on touched rows (dt steps missed);
+        # expressed as an additive update (Transform contract).
+        dt = jax.tree_util.tree_map(
+            lambda t0: (step + 1 - t0).astype(jnp.int32), state["t0"])
+        eta = _lr_at(step)
+        reg_params = jax.tree_util.tree_map(
+            lambda p, m, d: _catch_up(p, m, d, eta), params, touched, dt)
+
+        upd, inner_state = inner.update(grads, state["inner"], reg_params,
+                                        step)
+
+        def mask_rows(u, m):
+            return jnp.where(m.reshape((-1,) + (1,) * (u.ndim - 1)), u, 0.0)
+
+        # final delta = (reg_params - params) + masked inner update
+        deltas = jax.tree_util.tree_map(
+            lambda rp, p, u, m: (rp - p) + mask_rows(u, m),
+            reg_params, params, upd, touched)
+
+        def _mirrors(slot):
+            """Does this state slot mirror the params-tree structure?"""
+            try:
+                return (jax.tree_util.tree_structure(slot)
+                        == jax.tree_util.tree_structure(touched))
+            except Exception:
+                return False
+
+        # Freeze inner state on untouched rows.  State containers are
+        # walked recursively (dict slots of per-optimizer buffers, tuple
+        # states of chain()); any sub-slot that mirrors the params tree is
+        # row-masked, scalar/global leaves (step counters, beta powers)
+        # update normally.
+        def freeze_leaf(new_s, old_s, m):
+            if not hasattr(new_s, "ndim"):
+                return new_s
+            if new_s.ndim >= 1 and new_s.shape[:1] == m.shape:
+                return jnp.where(
+                    m.reshape((-1,) + (1,) * (new_s.ndim - 1)), new_s, old_s)
+            return new_s
+
+        def freeze_any(new_s, old_s):
+            if _mirrors(new_s):
+                return jax.tree_util.tree_map(freeze_leaf, new_s, old_s,
+                                              touched)
+            if isinstance(new_s, dict):
+                return {k: freeze_any(new_s[k], old_s[k]) for k in new_s}
+            if isinstance(new_s, (tuple, list)):
+                return type(new_s)(freeze_any(a, b)
+                                   for a, b in zip(new_s, old_s))
+            return new_s
+
+        new_inner = freeze_any(inner_state, state["inner"])
+
+        new_t0 = jax.tree_util.tree_map(
+            lambda t0, m: jnp.where(m, step + 1, t0), state["t0"], touched)
+        return deltas, {"inner": new_inner, "t0": new_t0}
+
+    return Transform(init, update)
+
+
+def embedding_label_fn(patterns=("emb",), sparse_label="sparse",
+                       dense_label="dense"):
+    """label_fn for :func:`partition`: 2-D params whose path contains one
+    of ``patterns`` go to the sparse transform."""
+
+    def fn(path: str, leaf) -> str:
+        if getattr(leaf, "ndim", 0) == 2 and any(s in path
+                                                 for s in patterns):
+            return sparse_label
+        return dense_label
+
+    return fn

@@ -5,8 +5,12 @@ from paddle_tpu.parallel import zero
 from paddle_tpu.parallel.ring_attention import ring_attention
 from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from paddle_tpu.parallel.expert import MoEMLP, moe_ep_rules
+from paddle_tpu.parallel.embedding import (ShardedEmbedding, sharded_lookup,
+                                           table_sharding, embedding_rules)
 
 __all__ = ["make_mesh", "batch_sharding", "replicated", "shard_batch",
            "replicate", "sharding", "zero", "ring_attention",
            "pipeline_apply", "stack_stage_params", "MoEMLP", "moe_ep_rules",
+           "ShardedEmbedding", "sharded_lookup", "table_sharding",
+           "embedding_rules",
            "DP", "MP", "PP", "SP"]

@@ -1,0 +1,99 @@
+"""Sharded embedding tables (the sparse-parameter-distribution twin).
+
+The reference shards huge embedding rows across parameter servers and has
+trainers prefetch the rows each batch needs (``SparseRowMatrix.h:204``
+SparsePrefetchRowCpuMatrix, pserver ``getParameterSparse``
+``ParameterServer2.cpp:572``, trainer prefetch ``TrainerInternal.cpp:93``).
+
+TPU-native design: the table's ROW axis shards over a mesh axis; lookup
+runs under ``shard_map`` — each device gathers the requested rows it owns
+(out-of-range ids hit a zero row) and one ``psum`` over the axis assembles
+full rows on every device.  The psum rides ICI and moves exactly
+``batch × dim`` floats per device — the same traffic as the reference's
+prefetch round-trip, with no server process.  The backward is the mirrored
+scatter-add: each device keeps the gradient rows it owns (psum's transpose
+is identity on the cotangent, and the local mask zeroes foreign rows), so
+gradient memory stays sharded too.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.nn.module import Module, param
+
+
+def sharded_lookup(table: jax.Array, ids: jax.Array, mesh: Mesh,
+                   axis: str) -> jax.Array:
+    """Gather rows of a row-sharded ``[vocab, dim]`` table.
+
+    ``table`` must be sharded ``P(axis, None)`` (see :func:`table_sharding`);
+    ``ids`` replicated.  Returns ``[*ids.shape, dim]`` replicated.
+    """
+    n_shards = mesh.shape[axis]
+    vocab = table.shape[0]
+    enforce(vocab % n_shards == 0,
+            "vocab %d must divide by mesh axis %r size %d", vocab, axis,
+            n_shards)
+    rows_per = vocab // n_shards
+
+    def local(table_shard, ids_):
+        # Which of my rows does each id hit?  Foreign ids gather row 0 of
+        # my shard and are masked to zero; the psum sums one real
+        # contribution per id.
+        idx = jax.lax.axis_index(axis)
+        lo = idx * rows_per
+        local_ids = ids_ - lo
+        mine = (local_ids >= 0) & (local_ids < rows_per)
+        safe = jnp.clip(local_ids, 0, rows_per - 1)
+        rows = jnp.take(table_shard, safe, axis=0)
+        rows = jnp.where(mine[..., None], rows, 0)
+        return jax.lax.psum(rows, axis)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P())(table, ids)
+
+
+def table_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """Row-sharded layout for an embedding table."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+class ShardedEmbedding(Module):
+    """Embedding whose table rows shard over ``axis``
+    (SparsePrefetchRowCpuMatrix + pserver distribution twin).
+
+    Use ``paddle_tpu.parallel.sharding.apply_rules`` (or ``jax.device_put``
+    with :func:`table_sharding`) to place the created table; the lookup is
+    layout-correct either way — ``shard_map`` re-shards as declared.
+    """
+
+    def __init__(self, vocab_size: int, dim: int, mesh: Mesh, axis: str,
+                 w_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.mesh = mesh
+        self.axis = axis
+        self.w_init = w_init or init.normal(0.01)
+
+    def forward(self, ids):
+        table = param("w", (self.vocab_size, self.dim), jnp.float32,
+                      self.w_init)
+        return sharded_lookup(table, ids, self.mesh, self.axis)
+
+
+def embedding_rules(axis: str, patterns=("emb",)):
+    """Sharding rules routing embedding tables' row axis onto ``axis``
+    (for ``sharding.apply_rules``)."""
+    return [(p, P(axis, None)) for p in patterns]

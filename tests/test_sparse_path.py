@@ -1,0 +1,255 @@
+"""Sparse/CTR path tests: partitioned optimizers, row-lazy sparse updates
+(SparseRowCpuMatrix::sgdUpdate / OptimizerWithRegularizerSparse twins), and
+mesh-sharded embedding lookup (SparsePrefetchRowCpuMatrix + pserver
+distribution twin).  Reference test model: test_CompareSparse.cpp — sparse
+vs dense training must agree where both are defined."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu import optim
+from paddle_tpu.optim import sparse as sp
+from paddle_tpu.optim.transforms import apply_updates
+from paddle_tpu.parallel import (make_mesh, sharded_lookup, table_sharding,
+                                 ShardedEmbedding)
+import paddle_tpu.nn as nn
+
+
+def _row_grad(rows, touched_rows, dim, value=1.0):
+    g = np.zeros((rows, dim), np.float32)
+    for r in touched_rows:
+        g[r] = value
+    return jnp.asarray(g)
+
+
+def test_partition_routes_params():
+    params = {"emb": {"w": jnp.ones((4, 2))}, "fc": {"w": jnp.ones((2, 2)),
+                                                     "b": jnp.ones((2,))}}
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    tr = sp.partition(
+        {"sparse": optim.sgd(1.0), "dense": optim.sgd(0.5)},
+        sp.embedding_label_fn(patterns=("emb",)))
+    state = tr.init(params)
+    upd, state = tr.update(grads, state, params, jnp.asarray(0))
+    # sparse (lr=1) on emb, dense (lr=0.5) elsewhere
+    np.testing.assert_allclose(np.asarray(upd["emb"]["w"]), -1.0)
+    np.testing.assert_allclose(np.asarray(upd["fc"]["w"]), -0.5)
+    np.testing.assert_allclose(np.asarray(upd["fc"]["b"]), -0.5)
+    new = apply_updates(params, upd)
+    assert float(new["emb"]["w"][0, 0]) == 0.0
+
+
+def test_sparse_rows_only_touched_rows_move():
+    rows, dim = 6, 3
+    params = {"w": jnp.ones((rows, dim))}
+    tr = sp.sparse_rows(optim.momentum(0.1, mu=0.9))
+    state = tr.init(params)
+
+    g = {"w": _row_grad(rows, [1, 4], dim)}
+    upd, state = tr.update(g, state, params, jnp.asarray(0))
+    params = apply_updates(params, upd)
+    w = np.asarray(params["w"])
+    assert np.allclose(w[0], 1.0) and np.allclose(w[2], 1.0)
+    assert not np.allclose(w[1], 1.0) and not np.allclose(w[4], 1.0)
+    # momentum state frozen at zero for untouched rows
+    v = np.asarray(state["inner"]["v"]["w"])
+    assert np.allclose(v[0], 0.0) and not np.allclose(v[1], 0.0)
+
+
+def test_sparse_rows_momentum_freezes_untouched_state():
+    """Momentum must not decay on rows that were not touched (the
+    reference's sparse momentum keeps per-row state untouched)."""
+    rows, dim = 4, 2
+    params = {"w": jnp.zeros((rows, dim))}
+    tr = sp.sparse_rows(optim.momentum(0.1, mu=0.5))
+    state = tr.init(params)
+
+    # step 0: touch row 0 -> v[0] = -0.1*g
+    upd, state = tr.update({"w": _row_grad(rows, [0], dim)}, state, params,
+                           jnp.asarray(0))
+    params = apply_updates(params, upd)
+    v_after_0 = np.asarray(state["inner"]["v"]["w"][0]).copy()
+
+    # steps 1..3: touch only row 1; row 0's momentum must stay EXACTLY
+    for i in range(1, 4):
+        upd, state = tr.update({"w": _row_grad(rows, [1], dim)}, state,
+                               params, jnp.asarray(i))
+        params = apply_updates(params, upd)
+    np.testing.assert_array_equal(np.asarray(state["inner"]["v"]["w"][0]),
+                                  v_after_0)
+
+
+def test_sparse_rows_freezes_state_inside_chain():
+    """chain() state is a tuple — freezing must recurse into it
+    (regression: non-dict inner state was silently left unfrozen)."""
+    rows, dim = 3, 2
+    params = {"w": jnp.zeros((rows, dim))}
+    tr = sp.sparse_rows(optim.chain(optim.clip_by_value(10.0),
+                                    optim.momentum(0.1, mu=0.5)))
+    state = tr.init(params)
+    upd, state = tr.update({"w": _row_grad(rows, [0], dim)}, state, params,
+                           jnp.asarray(0))
+    v0 = np.asarray(state["inner"][1]["v"]["w"][0]).copy()
+    assert not np.allclose(v0, 0.0)
+    for i in range(1, 3):
+        upd, state = tr.update({"w": _row_grad(rows, [1], dim)}, state,
+                               params, jnp.asarray(i))
+    np.testing.assert_array_equal(np.asarray(state["inner"][1]["v"]["w"][0]),
+                                  v0)
+
+
+def test_sparse_rows_lazy_l2_catch_up():
+    """A row untouched for dt steps catches up (1-l2)^dt of decay when
+    touched again — identical to applying decay every step (the
+    reference's lazy-regularization equivalence)."""
+    rows, dim = 3, 2
+    l2 = 0.01
+    params = {"w": jnp.full((rows, dim), 2.0)}
+    tr = sp.sparse_rows(optim.sgd(0.0), l2=l2)  # lr 0: isolate decay
+    state = tr.init(params)
+
+    # touch row 0 at steps 0 and 4 -> catch-up of (1-l2)^1 then (1-l2)^4
+    upd, state = tr.update({"w": _row_grad(rows, [0], dim, 1e-9)}, state,
+                           params, jnp.asarray(0))
+    params = apply_updates(params, upd)
+    for i in range(1, 4):
+        upd, state = tr.update({"w": _row_grad(rows, [1], dim, 1e-9)},
+                               state, params, jnp.asarray(i))
+        params = apply_updates(params, upd)
+    upd, state = tr.update({"w": _row_grad(rows, [0], dim, 1e-9)}, state,
+                           params, jnp.asarray(4))
+    params = apply_updates(params, upd)
+
+    w = np.asarray(params["w"])
+    want_r0 = 2.0 * (1 - l2) ** 5      # touched at t=0 (dt=1) and t=4 (dt=4)
+    np.testing.assert_allclose(w[0], want_r0, rtol=1e-5)
+    # row 2 never touched: no decay at all
+    np.testing.assert_allclose(w[2], 2.0)
+
+
+def test_sparse_rows_lazy_l1_soft_threshold():
+    params = {"w": jnp.asarray([[0.05, -0.5], [1.0, 1.0]], jnp.float32)}
+    tr = sp.sparse_rows(optim.sgd(0.0), l1=0.1)
+    state = tr.init(params)
+    upd, state = tr.update({"w": _row_grad(2, [0], 2, 1e-9)}, state, params,
+                           jnp.asarray(0))
+    params = apply_updates(params, upd)
+    w = np.asarray(params["w"])
+    np.testing.assert_allclose(w[0], [0.0, -0.4], atol=1e-6)
+    np.testing.assert_allclose(w[1], [1.0, 1.0])
+
+
+def test_sparse_vs_dense_equivalence_when_all_rows_touched():
+    """With every row touched each step and no regularization, the lazy
+    path must match the plain dense optimizer (test_CompareSparse twin)."""
+    rows, dim = 5, 3
+    rs = np.random.RandomState(0)
+    p0 = jnp.asarray(rs.randn(rows, dim), jnp.float32)
+
+    dense = optim.adagrad(0.1)
+    lazy = sp.sparse_rows(optim.adagrad(0.1))
+    pd = {"w": p0}
+    pl = {"w": p0}
+    sd = dense.init(pd)
+    sl = lazy.init(pl)
+    for i in range(5):
+        g = {"w": jnp.asarray(rs.randn(rows, dim), jnp.float32)}
+        ud, sd = dense.update(g, sd, pd, jnp.asarray(i))
+        ul, sl = lazy.update(g, sl, pl, jnp.asarray(i))
+        pd = apply_updates(pd, ud)
+        pl = apply_updates(pl, ul)
+    np.testing.assert_allclose(np.asarray(pd["w"]), np.asarray(pl["w"]),
+                               rtol=1e-6)
+
+
+def test_from_config_sparse_update():
+    """settings(..., sparse_update=True) builds the partitioned lazy
+    pipeline and trains an embedding model."""
+    cfg = optim.OptimizationConfig(learning_rate=0.5,
+                                   learning_method="momentum", momentum=0.9,
+                                   l2_rate=0.01, sparse_update=True)
+    tr = optim.from_config(cfg)
+    params = {"emb": {"w": jnp.ones((6, 2))}, "fc": {"w": jnp.ones((2, 2))}}
+    state = tr.init(params)
+    g = {"emb": {"w": _row_grad(6, [2], 2)},
+         "fc": {"w": jnp.ones((2, 2))}}
+    upd, state = tr.update(g, state, params, jnp.asarray(0))
+    new = apply_updates(params, upd)
+    # untouched emb rows unchanged (lazy), fc moved (dense)
+    np.testing.assert_allclose(np.asarray(new["emb"]["w"][0]), 1.0)
+    assert not np.allclose(np.asarray(new["emb"]["w"][2]), 1.0)
+    assert not np.allclose(np.asarray(new["fc"]["w"]), 1.0)
+
+
+# ---- sharded embedding ------------------------------------------------------
+
+def test_sharded_lookup_matches_dense():
+    mesh = make_mesh((8,), ("mp",))
+    vocab, dim = 64, 16
+    rs = np.random.RandomState(1)
+    table = jnp.asarray(rs.randn(vocab, dim), jnp.float32)
+    ids = jnp.asarray(rs.randint(0, vocab, (4, 7)), jnp.int32)
+
+    table_sharded = jax.device_put(table, table_sharding(mesh, "mp"))
+    got = sharded_lookup(table_sharded, ids, mesh, "mp")
+    want = jnp.take(table, ids, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_sharded_lookup_gradient_is_row_scatter():
+    mesh = make_mesh((8,), ("mp",))
+    vocab, dim = 32, 4
+    rs = np.random.RandomState(2)
+    table = jnp.asarray(rs.randn(vocab, dim), jnp.float32)
+    ids = jnp.asarray([0, 5, 5, 31], jnp.int32)
+
+    def loss_sharded(tb):
+        return jnp.sum(sharded_lookup(tb, ids, mesh, "mp") ** 2)
+
+    def loss_dense(tb):
+        return jnp.sum(jnp.take(tb, ids, axis=0) ** 2)
+
+    g_sharded = jax.grad(loss_sharded)(
+        jax.device_put(table, table_sharding(mesh, "mp")))
+    g_dense = jax.grad(loss_dense)(table)
+    np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_dense),
+                               rtol=1e-5)
+    # untouched rows have exactly zero grad (row-sparse structure)
+    assert np.all(np.asarray(g_dense)[1] == 0)
+
+
+def test_sharded_embedding_module_trains():
+    mesh = make_mesh((4, 2), ("dp", "mp"))
+    vocab, dim = 40, 8
+    model = nn.transform(
+        lambda ids: ShardedEmbedding(vocab, dim, mesh, "mp",
+                                     name="emb")(ids).sum(axis=1))
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, vocab, (8, 5)), jnp.int32)
+    params, _ = model.init(jax.random.key(0), ids)
+    params = {"emb": {"w": jax.device_put(params["emb"]["w"],
+                                          table_sharding(mesh, "mp"))}}
+
+    tr = sp.partition({"sparse": sp.sparse_rows(optim.sgd(0.5)),
+                       "dense": optim.sgd(0.5)},
+                      sp.embedding_label_fn())
+    state = tr.init(params)
+
+    @jax.jit
+    def step(params, state, i):
+        def loss_fn(p):
+            out, _ = model.apply(p, {}, None, ids)
+            return jnp.mean(out ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        upd, state = tr.update(grads, state, params, i)
+        return apply_updates(params, upd), state, loss
+
+    l0 = None
+    for i in range(10):
+        params, state, loss = step(params, state, jnp.asarray(i))
+        l0 = l0 or float(loss)
+    assert float(loss) < l0
