@@ -37,6 +37,10 @@ from paddle_tpu.framework.scope import Scope, Variable
 from paddle_tpu.framework.backward import append_backward, grad_var_name
 from paddle_tpu.framework.executor import Executor
 from paddle_tpu.framework import ops as _ops  # noqa: F401  (registers op zoo)
+from paddle_tpu.framework import control_flow  # noqa: F401  (recurrent/cond)
+from paddle_tpu.framework.control_flow import (append_recurrent_op,
+                                               append_cond_op)
+from paddle_tpu.framework.tensor_array import TensorArray
 
 __all__ = [
     "AttrMap",
@@ -49,6 +53,9 @@ __all__ = [
     "VarDesc",
     "Variable",
     "append_backward",
+    "append_cond_op",
+    "append_recurrent_op",
+    "TensorArray",
     "get_op_info",
     "grad_var_name",
     "register_op",

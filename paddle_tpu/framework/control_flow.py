@@ -1,0 +1,204 @@
+"""Control-flow operators for the Program IR: recurrent + cond.
+
+Twins of the reference's dynamic-graph ops (SURVEY.md §2.5):
+
+* ``RecurrentOp`` (``operators/recurrent_op.cc``, step-scopes +
+  ``rnn/recurrent_op_utils``): unrolls a step net over the time axis with
+  memory links (``pre_memories`` read the previous step's ``memories``,
+  boot values at t=0).
+* ``CondOp`` (``operators/cond_op.cc`` / ``doc/design/if_else_op.md``):
+  row-wise branch — the reference gathers true/false subsets, runs each
+  sub-net, scatters back.
+
+TPU-native execution: both are *registered ops with pure kernels* whose
+attributes carry the serialized step/branch block (a list of OpDesc
+dicts).  The kernel interprets that block inside ``lax.scan`` (recurrent)
+or evaluates both branches and blends rows with ``jnp.where`` (cond —
+identical semantics to gather/scatter, static shapes).  Because outer
+variables the sub-block reads (parameters) are explicit ``Outer`` inputs
+of the op, the generic VJP grad op differentiates straight through the
+scan/where — the reference needed bespoke RNN handling in
+``backward.cc:233``; here autodiff through ``lax.scan`` *is* the grad
+variant, including BPTT and parameter gradients.
+
+Builder helpers (:func:`append_recurrent_op`, :func:`append_cond_op`)
+analyze the sub-block, compute the outer-variable closure, and append a
+correctly-wired OpDesc.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.framework.program import BlockDesc, OpDesc, Program
+from paddle_tpu.framework.registry import get_op_info, register_op
+from paddle_tpu.framework.scope import Scope
+
+
+def _exec_block(op_dicts: Sequence[Dict[str, Any]],
+                env: Dict[str, Any]) -> Dict[str, Any]:
+    """Interpret serialized ops over a name→value dict (traceable).
+
+    Reuses the executor's checked gather/scatter by staging the env in a
+    Scope (same slot-mapping rules, same arity enforcement)."""
+    from paddle_tpu.framework.executor import (_gather_inputs,
+                                               _scatter_outputs)
+    scope = Scope()
+    for name, value in env.items():
+        scope.set(name, value)
+    for od in op_dicts:
+        op = OpDesc.from_dict(od)
+        info = get_op_info(op.type)
+        result = info.fn(*_gather_inputs(op, info, scope), **op.attrs)
+        _scatter_outputs(op, info, scope, result)
+    return {name: scope.get(name) for name in scope.local_names()}
+
+
+def _block_outer_vars(block: BlockDesc,
+                      bound: Sequence[str]) -> List[str]:
+    """Vars a block reads but neither produces nor has bound — the closure
+    that must come from the outer scope (parameters, constants)."""
+    produced = set(bound)
+    outer: List[str] = []
+    for op in block.ops:
+        for n in op.input_names():
+            if n and n not in produced and n not in outer:
+                outer.append(n)
+        produced.update(o for o in op.output_names() if o)
+    return outer
+
+
+# ---- recurrent -------------------------------------------------------------
+
+def _recurrent_fn(xs, boots, outers, *, x_names, pre_memories, memories,
+                  out_names, outer_names, step_ops, reverse=False):
+    """xs: list of [b, t, ...] sequences; boots: initial memory values;
+    outers: closure vars.  Returns the stacked [b, t, ...] out sequences
+    then the final memory values."""
+    enforce(xs, "recurrent op needs at least one sequence input")
+    base_env = dict(zip(outer_names, outers))
+    t = xs[0].shape[1]
+
+    def step(carry, x_ts):
+        env = dict(base_env)
+        env.update(zip(x_names, x_ts))
+        env.update(zip(pre_memories, carry))
+        env = _exec_block(step_ops, env)
+        new_carry = [env[m] for m in memories]
+        return new_carry, [env[o] for o in out_names]
+
+    # scan over time-major slices
+    xs_tm = [jnp.moveaxis(x, 1, 0) for x in xs]
+    if reverse:
+        xs_tm = [x[::-1] for x in xs_tm]
+    final, stacked = lax.scan(step, list(boots), xs_tm, length=t)
+    outs = [jnp.moveaxis(s, 0, 1) for s in stacked]
+    if reverse:
+        outs = [o[:, ::-1] for o in outs]
+    return (outs, final)
+
+
+register_op("recurrent", _recurrent_fn, ["X", "Boot", "Outer"],
+            out_slots=("Out", "MemOut"), variadic=("X", "Boot", "Outer",
+                                                   "Out", "MemOut"))
+
+
+def append_recurrent_op(program: Program, block: BlockDesc,
+                        step_block: BlockDesc,
+                        inputs: Dict[str, str],
+                        memories: Dict[str, Any],
+                        outputs: Dict[str, str],
+                        reverse: bool = False) -> OpDesc:
+    """Wire a recurrent op over ``step_block``.
+
+    ``inputs``:  {outer sequence var [b,t,..] -> in-block per-step name}
+    ``memories``: {in-block pre-memory name -> (in-block step var that
+                  updates it, outer boot var)} — the ``memory(name=...)``
+                  twin; boot is required (create zeros with
+                  ``fill_constant`` for a cold start).
+    ``outputs``: {in-block step var -> outer sequence var to create}
+    """
+    x_outer = list(inputs)
+    x_names = [inputs[k] for k in x_outer]
+    pre_memories = list(memories)
+    mem_steps = [memories[m][0] for m in pre_memories]
+    boots = [memories[m][1] for m in pre_memories]
+    enforce(all(boots), "every memory needs a boot var (use fill_constant)")
+    out_steps = list(outputs)
+    out_outer = [outputs[k] for k in out_steps]
+
+    enforce(step_block in program.blocks and block in program.blocks,
+            "append_recurrent_op: blocks must belong to the given program")
+    outer_names = _block_outer_vars(
+        step_block, bound=x_names + pre_memories)
+    step_ops = [op.to_dict() for op in step_block.ops]
+    # Final-state names must be unique per op in the outer block: key them
+    # by this op's position so stacked layers reusing conventional memory
+    # names ("h_pre") cannot clobber each other.  Read them back from the
+    # returned OpDesc's outputs["MemOut"].
+    tag = len(block.ops)
+    mem_out = [f"{m}@FINAL@{tag}" for m in pre_memories]
+    return block.append_op(
+        "recurrent",
+        {"X": x_outer, "Boot": boots, "Outer": outer_names},
+        {"Out": out_outer, "MemOut": mem_out},
+        {"x_names": x_names, "pre_memories": pre_memories,
+         "memories": mem_steps, "out_names": out_steps,
+         "outer_names": outer_names, "step_ops": step_ops,
+         "reverse": reverse})
+
+
+# ---- cond ------------------------------------------------------------------
+
+def _cond_fn(cond, xs, outers, *, x_names, out_names, outer_names,
+             true_ops, false_ops):
+    """Row-wise branch: run both blocks on the full batch, blend rows by
+    ``cond`` (the static-shape equivalent of CondOp's gather/run/scatter)."""
+    base = dict(zip(outer_names, outers))
+    base.update(zip(x_names, xs))
+    t_env = _exec_block(true_ops, dict(base))
+    f_env = _exec_block(false_ops, dict(base))
+    outs = []
+    for n in out_names:
+        tv, fv = t_env[n], f_env[n]
+        c = cond.reshape(cond.shape[:1] + (1,) * (tv.ndim - 1))
+        outs.append(jnp.where(c, tv, fv))
+    return (outs,)
+
+
+register_op("cond", _cond_fn, ["Cond", "X", "Outer"],
+            out_slots=("Out",), variadic=("X", "Outer", "Out"),
+            no_grad_slots=("Cond",))
+
+
+def append_cond_op(program: Program, block: BlockDesc,
+                   cond_var: str,
+                   true_block: BlockDesc, false_block: BlockDesc,
+                   inputs: Dict[str, str],
+                   outputs: Dict[str, str]) -> OpDesc:
+    """Wire a cond op: ``inputs`` maps outer vars to in-block names (shared
+    by both branches); ``outputs`` maps in-block result names (defined by
+    BOTH branches) to outer vars."""
+    enforce(true_block in program.blocks and false_block in program.blocks
+            and block in program.blocks,
+            "append_cond_op: blocks must belong to the given program")
+    x_outer = list(inputs)
+    x_names = [inputs[k] for k in x_outer]
+    out_names = list(outputs)
+    out_outer = [outputs[k] for k in out_names]
+    outer = _block_outer_vars(true_block, bound=x_names)
+    for n in _block_outer_vars(false_block, bound=x_names):
+        if n not in outer:
+            outer.append(n)
+    return block.append_op(
+        "cond",
+        {"Cond": [cond_var], "X": x_outer, "Outer": outer},
+        {"Out": out_outer},
+        {"x_names": x_names, "out_names": out_names,
+         "outer_names": outer,
+         "true_ops": [op.to_dict() for op in true_block.ops],
+         "false_ops": [op.to_dict() for op in false_block.ops]})

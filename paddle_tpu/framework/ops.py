@@ -397,3 +397,37 @@ register_op("rmsprop",
                   decay * ms + (1 - decay) * jnp.square(g) + epsilon))),
             ["Param", "Grad", "MeanSquare", "Moment", "LearningRate"],
             out_slots=("ParamOut", "MeanSquareOut", "MomentOut"))
+
+
+def _adamax(p, g, m, inf_norm, beta1_pow, lr, beta1=0.9, beta2=0.999,
+            epsilon=1e-8):
+    m2 = beta1 * m + (1 - beta1) * g
+    u2 = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    return (p - lr / (1 - beta1_pow) * m2 / (u2 + epsilon), m2, u2,
+            beta1_pow * beta1)
+
+
+register_op("adamax", _adamax,
+            ["Param", "Grad", "Moment", "InfNorm", "Beta1Pow",
+             "LearningRate"],
+            out_slots=("ParamOut", "MomentOut", "InfNormOut",
+                       "Beta1PowOut"))
+
+
+def _adadelta(p, g, avg_sq_grad, avg_sq_update, rho=0.95, epsilon=1e-6):
+    asg = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((avg_sq_update + epsilon) / (asg + epsilon)) * g
+    asu = rho * avg_sq_update + (1 - rho) * jnp.square(upd)
+    return (p + upd, asg, asu)
+
+
+register_op("adadelta", _adadelta,
+            ["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+            out_slots=("ParamOut", "AvgSquaredGradOut",
+                       "AvgSquaredUpdateOut"))
+register_op("decayed_adagrad",
+            lambda p, g, mom, lr, decay=0.95, epsilon=1e-6:
+            ((lambda m2: (p - lr * g / (jnp.sqrt(m2) + epsilon), m2))
+             (decay * mom + (1 - decay) * jnp.square(g))),
+            ["Param", "Grad", "Moment", "LearningRate"],
+            out_slots=("ParamOut", "MomentOut"))

@@ -1,0 +1,86 @@
+"""TensorArray: the dynamic-RNN staging buffer.
+
+Twin of ``paddle/framework/tensor_array.h:53-116`` —
+``TensorArray::{Read,Write,Pack,Unpack,Stack,Unstack}`` — which the
+reference's DynamicRecurrentOp used to shuttle per-timestep slices of a
+LoD-packed batch.  Here the batch layout is dense-with-mask
+(docs/design/sequences.md), so:
+
+* Stack/Unstack convert between a time-list of ``[b, ...]`` slices and one
+  ``[b, t, ...]`` array;
+* Pack/Unpack additionally apply the reference's *length-descending
+  reordering* (``SequenceToBatch`` twin): rows sorted by sequence length so
+  every prefix of the time axis is a dense batch of still-active rows —
+  the layout DynamicRecurrentOp ran its step nets on.
+
+All methods are pure and jit-traceable; the class is a thin builder over a
+python list of slices (writes must use static indices, like the
+reference's per-step loop).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+
+
+class TensorArray:
+    def __init__(self, slices: Optional[List[jax.Array]] = None):
+        self._slices: List[jax.Array] = list(slices or [])
+
+    # ---- Read/Write (tensor_array.h Read/Write twins) ----
+
+    def size(self) -> int:
+        return len(self._slices)
+
+    def read(self, index: int) -> jax.Array:
+        enforce(0 <= index < len(self._slices),
+                "TensorArray.read(%d) out of range (size %d)", index,
+                len(self._slices))
+        return self._slices[index]
+
+    def write(self, index: int, value: jax.Array) -> "TensorArray":
+        slices = list(self._slices)
+        if index == len(slices):
+            slices.append(value)
+        else:
+            enforce(0 <= index < len(slices),
+                    "TensorArray.write(%d) out of range (size %d)", index,
+                    len(slices))
+            slices[index] = value
+        return TensorArray(slices)
+
+    # ---- Stack/Unstack ----
+
+    def stack(self) -> jax.Array:
+        """[b, ...] slices -> [b, t, ...] (tensor_array.h Stack twin is
+        time-major; batch-major here per the framework convention)."""
+        enforce(self._slices, "stack() of empty TensorArray")
+        return jnp.stack(self._slices, axis=1)
+
+    @staticmethod
+    def unstack(value: jax.Array) -> "TensorArray":
+        return TensorArray([value[:, i] for i in range(value.shape[1])])
+
+    # ---- Pack/Unpack (length-descending reorder) ----
+
+    @staticmethod
+    def pack(value: jax.Array, mask: jax.Array
+             ) -> Tuple["TensorArray", jax.Array]:
+        """Sort rows by descending length and unstack
+        (DynamicRecurrentOp's batch layout).  Returns (array, order) where
+        ``order`` restores the original row order via :meth:`unpack`."""
+        lengths = mask.sum(axis=1)
+        order = jnp.argsort(-lengths, stable=True)
+        sorted_v = jnp.take(value, order, axis=0)
+        return TensorArray.unstack(sorted_v), order
+
+    def unpack(self, order: jax.Array) -> jax.Array:
+        """Inverse of :meth:`pack`: stack and undo the row reorder."""
+        stacked = self.stack()
+        inv = jnp.argsort(order, stable=True)
+        return jnp.take(stacked, inv, axis=0)
