@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu`` — the `paddle` CLI twin (see cli.py)."""
+
+from paddle_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -61,16 +61,23 @@ def _build_trainer(cfg):
 
 def cmd_train(args):
     cfg = _load_config(args.config, args.config_args)
+    if getattr(args, "fp_checks", False):
+        from paddle_tpu.training.aux import enable_fp_checks
+        enable_fp_checks()
     trainer = _build_trainer(cfg)
     if args.checkpoint_dir and args.resume:
         trainer.restore(args.checkpoint_dir)
+    if args.checkpoint_dir:
+        from paddle_tpu.training.aux import PreemptionHandler
+        PreemptionHandler(trainer, args.checkpoint_dir).install()
     metrics = trainer.train(
         cfg.train_reader,
         num_passes=args.num_passes,
         evaluators=list(getattr(cfg, "evaluators", [])),
         test_reader=getattr(cfg, "test_reader", None),
         save_dir=args.checkpoint_dir,
-        log_period=args.log_period)
+        log_period=args.log_period,
+        stats_period=getattr(args, "stats_period", 0))
     print(json.dumps(metrics))
 
 
@@ -109,6 +116,36 @@ def cmd_time(args):
                       "last_cost": float(loss)}))
 
 
+def cmd_checkgrad(args):
+    """Finite-difference gradient check of the configured model
+    (--job=checkgrad twin, Trainer::checkGradient)."""
+    from paddle_tpu import testing
+    import paddle_tpu.nn as nn
+    import jax
+    # (check_grad_params forces f32-precision matmuls internally; the TPU
+    # default bf16 tier would swamp the numeric gradient.)
+    cfg = _load_config(args.config, args.config_args)
+    if not hasattr(cfg, "train_reader"):
+        raise SystemExit(f"{args.config}: checkgrad needs train_reader()")
+    try:
+        sample = next(iter(cfg.train_reader()))
+    except StopIteration:
+        raise SystemExit(f"{args.config}: train_reader() yielded no batches")
+    import jax.numpy as jnp
+    batch = {k: jnp.asarray(v) for k, v in sample.items()}
+    model = nn.transform(lambda b: cfg.model_fn(b))
+    params, state = model.init(jax.random.key(0), batch)
+
+    def loss_fn(p):
+        (loss, _), _ = model.apply(p, state, None, batch)
+        return loss
+
+    testing.check_grad_params(loss_fn, params, eps=args.eps,
+                              max_elems_per_leaf=args.elems)
+    print(json.dumps({"checkgrad": "ok",
+                      "params": len(jax.tree_util.tree_leaves(params))}))
+
+
 def cmd_merge_model(args):
     from paddle_tpu import inference
     from paddle_tpu.training import checkpoint as ckpt_lib
@@ -136,7 +173,12 @@ def main(argv=None):
     common(p)
     p.add_argument("--num-passes", type=int, default=1)
     p.add_argument("--log-period", type=int, default=0)
+    p.add_argument("--stats-period", type=int, default=0,
+                   help="print per-parameter stats every N batches "
+                        "(--show_parameter_stats_period twin)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--fp-checks", action="store_true",
+                   help="raise on NaN under jit (feenableexcept twin)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test", help="evaluate a checkpoint")
@@ -148,6 +190,13 @@ def main(argv=None):
     p.add_argument("--batches", type=int, default=50)
     p.add_argument("--burn-in", type=int, default=10)
     p.set_defaults(fn=cmd_time)
+
+    p = sub.add_parser("checkgrad",
+                       help="finite-difference grad check (--job=checkgrad)")
+    common(p)
+    p.add_argument("--eps", type=float, default=1e-3)
+    p.add_argument("--elems", type=int, default=8)
+    p.set_defaults(fn=cmd_checkgrad)
 
     p = sub.add_parser("merge_model", help="export checkpoint for serving")
     common(p)

@@ -53,7 +53,19 @@ def check_grad_params(loss_fn: Callable, params, eps: float = 1e-3,
     ``max_elems_per_leaf`` random elements are perturbed (the reference's
     testLayerGrad similarly spot-checks rather than perturbing every weight
     of every layer).
+
+    Runs under ``jax.default_matmul_precision("highest")``: TPU matmuls
+    default to bf16-tier precision, whose ~2^-8 quantization swallows the
+    finite-difference perturbation entirely (the config-flag form of this
+    setting is not honored by all backends; the context manager is).
     """
+    with jax.default_matmul_precision("highest"):
+        return _check_grad_params(loss_fn, params, eps, rtol, atol,
+                                  max_elems_per_leaf, seed)
+
+
+def _check_grad_params(loss_fn, params, eps, rtol, atol,
+                       max_elems_per_leaf, seed) -> None:
     analytic = jax.grad(loss_fn)(params)
     leaves, treedef = jax.tree_util.tree_flatten(params)
     g_leaves = jax.tree_util.tree_leaves(analytic)
@@ -69,13 +81,15 @@ def check_grad_params(loss_fn: Callable, params, eps: float = 1e-3,
             orig = flat[i]
 
             def eval_at(v):
-                flat[i] = v
+                # Fresh ndarray per evaluation: some backends cache
+                # host->device transfers by array identity, so mutating
+                # one buffer in place re-reads the stale device copy.
+                pert = leaf_np.copy()
+                pert.reshape(-1)[i] = v
                 new_leaves = list(leaves)
-                new_leaves[li] = jnp.asarray(leaf_np, leaf.dtype)
-                out = float(loss_fn(jax.tree_util.tree_unflatten(
+                new_leaves[li] = jnp.asarray(pert, leaf.dtype)
+                return float(loss_fn(jax.tree_util.tree_unflatten(
                     treedef, new_leaves)))
-                flat[i] = orig
-                return out
 
             num = (eval_at(orig + eps) - eval_at(orig - eps)) / (2 * eps)
             ana = float(np.asarray(g_leaf).reshape(-1)[i])

@@ -1,0 +1,131 @@
+"""Training auxiliaries: parameter stats, FP hygiene, preemption handling.
+
+Twins of the reference's observability/safety knobs (SURVEY.md §5):
+
+* ``--show_parameter_stats_period`` — per-parameter value/gradient
+  abs-max/avg dumps (``TrainerInternal.cpp:80-110,155``);
+* ``feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)`` at trainer start
+  (``TrainerMain.cpp:48``) — here ``jax.config.debug_nans``, which raises
+  on the first NaN-producing op under jit;
+* preemption-safe checkpointing — the elastic-recovery contract the Go
+  stack provided via task re-dispatch; for an SPMD job the equivalent is
+  save-on-SIGTERM + restore-latest (docs/design/checkpoint.md).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.nn.module import flatten_names
+
+
+def parameter_stats(params, grads=None) -> Dict[str, Dict[str, float]]:
+    """Per-parameter stats dict: {name: {max_abs, avg_abs, min, max}}
+    (+ grad_* when grads given) — the show_parameter_stats dump."""
+    out: Dict[str, Dict[str, float]] = {}
+    flat_p = flatten_names(params)
+    flat_g = flatten_names(grads) if grads is not None else {}
+    for name, v in flat_p.items():
+        a = np.asarray(v, np.float32)
+        s = {"max_abs": float(np.abs(a).max()) if a.size else 0.0,
+             "avg_abs": float(np.abs(a).mean()) if a.size else 0.0,
+             "min": float(a.min()) if a.size else 0.0,
+             "max": float(a.max()) if a.size else 0.0}
+        if name in flat_g:
+            g = np.asarray(flat_g[name], np.float32)
+            s["grad_max_abs"] = float(np.abs(g).max()) if g.size else 0.0
+            s["grad_avg_abs"] = float(np.abs(g).mean()) if g.size else 0.0
+        out[name] = s
+    return out
+
+
+def format_parameter_stats(stats: Dict[str, Dict[str, float]]) -> str:
+    """Human-readable table (the log_period print twin)."""
+    lines = [f"{'parameter':<40} {'max_abs':>12} {'avg_abs':>12} "
+             f"{'min':>12} {'max':>12}"]
+    for name, s in sorted(stats.items()):
+        lines.append(f"{name:<40} {s['max_abs']:>12.6g} "
+                     f"{s['avg_abs']:>12.6g} {s['min']:>12.6g} "
+                     f"{s['max']:>12.6g}")
+    return "\n".join(lines)
+
+
+def enable_fp_checks(enable: bool = True) -> None:
+    """Raise on NaN production anywhere under jit
+    (the feenableexcept twin; debug_nans re-runs the offending op eagerly
+    to locate it, so keep this off in production runs)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+class PreemptionHandler:
+    """Save a checkpoint on SIGTERM/SIGINT, then re-raise the default
+    behavior.  Usage::
+
+        handler = PreemptionHandler(trainer, save_dir)
+        handler.install()
+        ...training loop...
+
+    The trainer's ``pass_id`` is recorded as ``pass-<current>`` with a
+    ``preempted`` marker in the metadata; ``Trainer.restore(save_dir)``
+    resumes from it (step counter + data cursor included).
+    """
+
+    def __init__(self, trainer, save_dir: str,
+                 on_save: Optional[Callable[[str], None]] = None):
+        self.trainer = trainer
+        self.save_dir = save_dir
+        self.on_save = on_save
+        self.triggered = False
+        self._signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+        trainer._preemption_handler = self
+
+    def _save(self) -> None:
+        if self.trainer.params is None:
+            return
+        path = self.trainer.save(
+            self.save_dir,
+            pass_id=getattr(self.trainer, "current_pass", 0),
+            metadata={"preempted": True, "signal": int(self._signum or 0)})
+        if self.on_save:
+            self.on_save(path)
+
+    def _exit(self, frame=None) -> None:
+        signum = self._signum
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        else:
+            raise SystemExit(128 + (signum or 0))
+
+    def save_and_exit(self) -> None:
+        """Checkpoint then re-raise the signal's behavior — called by the
+        Trainer at the next batch boundary after a mid-step signal."""
+        self._save()
+        self._exit()
+
+    def _handle(self, signum, frame):
+        self.triggered = True
+        self._signum = int(signum)
+        if getattr(self.trainer, "_in_step", False):
+            # The jitted step donated the previous params/opt_state
+            # buffers; saving here would read deleted arrays.  Defer to
+            # the batch boundary (train_batch checks ``triggered``).
+            return
+        self._save()
+        self._exit(frame)
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT)) -> None:
+        for s in signals:
+            self._prev[s] = signal.signal(s, self._handle)
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()

@@ -116,8 +116,13 @@ class Trainer:
                                                  batch, train=False)
             return loss, outputs
 
-        donate = (0, 2)  # params, opt_state buffers are dead after the step
-        self._train_step = jax.jit(train_step, donate_argnums=donate)
+        # params/opt_state buffers are dead after the step — donate them,
+        # EXCEPT under debug_nans: its diagnostic re-run needs the original
+        # arguments, which donation would have deleted.
+        if jax.config.jax_debug_nans:
+            self._train_step = jax.jit(train_step)
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=(0, 2))
         self._eval_step = jax.jit(eval_step)
 
     # ---- training ----
@@ -126,14 +131,23 @@ class Trainer:
         if self.params is None:
             self.init(batch)
         batch = self._put(batch)
-        (self.params, self.net_state, self.opt_state, loss,
-         outputs) = self._train_step(self.params, self.net_state,
-                                     self.opt_state, batch,
-                                     jnp.asarray(self.step))
+        self._in_step = True
+        try:
+            (self.params, self.net_state, self.opt_state, loss,
+             outputs) = self._train_step(self.params, self.net_state,
+                                         self.opt_state, batch,
+                                         jnp.asarray(self.step))
+        finally:
+            self._in_step = False
         if self.average_window:
             self.avg_state = optim_lib.average.accumulate(
                 self.avg_state, self.params)
         self.step += 1
+        handler = getattr(self, "_preemption_handler", None)
+        if handler is not None and handler.triggered:
+            # A signal arrived mid-step (buffers were donated then);
+            # checkpoint now at the batch boundary, then stop.
+            handler.save_and_exit()
         return loss, outputs
 
     def _put(self, batch):
@@ -148,7 +162,8 @@ class Trainer:
               evaluators: Sequence[Evaluator] = (),
               test_reader: Optional[Callable] = None,
               save_dir: Optional[str] = None,
-              log_period: int = 0) -> Dict[str, Any]:
+              log_period: int = 0,
+              stats_period: int = 0) -> Dict[str, Any]:
         """Pass/batch loop with events (SGD.train twin, v2/trainer.py:117).
 
         Returns the final pass's metrics: mean ``loss`` plus each
@@ -157,6 +172,7 @@ class Trainer:
         handler = event_handler or (lambda e: None)
         results: Dict[str, Any] = {}
         for pass_id in range(num_passes):
+            self.current_pass = pass_id
             handler(ev.BeginPass(pass_id))
             for e in evaluators:
                 e.start()
@@ -171,6 +187,11 @@ class Trainer:
                 if log_period and (batch_id + 1) % log_period == 0:
                     print(f"pass {pass_id} batch {batch_id + 1} "
                           f"cost {cost:.6f}", flush=True)
+                if stats_period and (batch_id + 1) % stats_period == 0:
+                    # --show_parameter_stats_period twin
+                    from paddle_tpu.training import aux as aux_lib
+                    print(aux_lib.format_parameter_stats(
+                        aux_lib.parameter_stats(self.params)), flush=True)
                 handler(ev.EndIteration(pass_id, batch_id, cost))
             results = {e.name: e.finish() for e in evaluators}
             results["loss"] = float(np.mean(costs)) if costs else 0.0
