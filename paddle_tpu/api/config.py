@@ -1,0 +1,124 @@
+"""``parse_config`` — the v1 config-DSL entry point.
+
+Twin of ``python/paddle/trainer/config_parser.py:126`` ``parse_config()``:
+the reference executed a user Python file (with ``--config_args`` k=v
+variables injected) and returned a serialized ``TrainerConfig`` proto
+(model topology + optimization + data settings).  Here the user file is
+plain Python too (see ``cli.py``'s module docstring for the contract) and
+the result is a JSON-able dict:
+
+    {"model": <api.topology node list> | {"model_fn": name},
+     "optimization": OptimizationConfig dict,
+     "data": {"train_reader": bool, "test_reader": bool},
+     "config_args": {...}}
+
+Configs may describe the model either as a declarative ``cost`` node
+(``api.layer`` DAG — the v1/v2 style, fully serializable) or as a raw
+``model_fn`` (jax-native style, recorded by name only since a Python
+function has no topology proto).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from typing import Any, Dict, Optional, Union
+
+from paddle_tpu.core.config import OptimizationConfig
+from paddle_tpu.core.errors import enforce
+
+# config_args of the module currently executing (get_config_arg reads it).
+_current_config_args: Dict[str, str] = {}
+
+
+def parse_kv(config_args: str) -> Dict[str, str]:
+    """Parse the ``k=v,k=v`` --config_args string."""
+    out: Dict[str, str] = {}
+    for item in config_args.split(","):
+        if not item:
+            continue
+        enforce("=" in item, "--config_args item %r is not k=v", item)
+        k, v = item.split("=", 1)
+        out[k] = v
+    return out
+
+
+def get_config_arg(name: str, type_=str, default=None):
+    """Read a --config_args value from inside an executing config file —
+    the reference's ``get_config_arg`` (``config_parser.py``), which made
+    overrides available DURING config execution (so they can change layer
+    sizes, not just post-hoc settings)."""
+    if name in _current_config_args:
+        return type_(_current_config_args[name])
+    return default
+
+
+def load_config_module(path: str, config_args: str = ""):
+    """Execute a config file with config_args available via
+    :func:`get_config_arg` during execution, plus the post-exec
+    ``config_args(kv)`` hook (``--config_args=k=v,k=v`` twin)."""
+    global _current_config_args
+    spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
+                                                  path)
+    enforce(spec is not None and spec.loader is not None,
+            "cannot load config file %r", path)
+    module = importlib.util.module_from_spec(spec)
+    kv = parse_kv(config_args)
+    prev = _current_config_args
+    _current_config_args = kv
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        _current_config_args = prev
+    if kv and hasattr(module, "config_args"):
+        module.config_args(kv)
+    return module
+
+
+def parse_config(config: Union[str, Any],
+                 config_args: str = "") -> Dict[str, Any]:
+    """Parse a config file (path or already-loaded module) into the
+    serialized bundle described in the module docstring."""
+    module = (load_config_module(config, config_args)
+              if isinstance(config, str) else config)
+
+    out: Dict[str, Any] = {}
+    cost = getattr(module, "cost", None)
+    if cost is not None:
+        from paddle_tpu.api.graph import LayerOutput, topology
+        enforce(isinstance(cost, LayerOutput),
+                "config 'cost' must be an api.layer node, got %r",
+                type(cost).__name__)
+        out["model"] = topology(cost)
+    elif hasattr(module, "model_fn"):
+        out["model"] = {"model_fn": module.model_fn.__name__}
+    else:
+        enforce(False, "config must define 'cost' (api.layer DAG) or "
+                       "'model_fn(batch)'")
+
+    opt = getattr(module, "optimization", None)
+    if opt is None:
+        opt = OptimizationConfig()
+    elif isinstance(opt, dict):
+        opt = OptimizationConfig.from_dict(opt)
+    enforce(isinstance(opt, OptimizationConfig),
+            "config 'optimization' must be an OptimizationConfig or dict")
+    out["optimization"] = opt.to_dict()
+
+    out["data"] = {"train_reader": hasattr(module, "train_reader"),
+                   "test_reader": hasattr(module, "test_reader")}
+    if config_args:
+        out["config_args"] = parse_kv(config_args)
+    return out
+
+
+def settings(**kwargs) -> OptimizationConfig:
+    """The ``settings(...)`` helper of trainer_config_helpers
+    (``optimizers.py:358``): keyword args onto an OptimizationConfig, with
+    the reference's argument-name aliases."""
+    aliases = {"learning_method_name": "learning_method",
+               "regularization_l1": "l1_rate",
+               "regularization_l2": "l2_rate"}
+    mapped = {aliases.get(k, k): v for k, v in kwargs.items()}
+    # The reference accepted an optimizer object for learning_method too;
+    # here it is always the method name string.
+    return OptimizationConfig(**mapped)

@@ -35,14 +35,8 @@ __version__ = "0.1.0"
 
 
 def _load_config(path: str, config_args: str):
-    spec = importlib.util.spec_from_file_location("paddle_tpu_user_config",
-                                                  path)
-    module = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(module)  # type: ignore[union-attr]
-    if config_args and hasattr(module, "config_args"):
-        kv = dict(item.split("=", 1) for item in config_args.split(",")
-                  if item)
-        module.config_args(kv)
+    from paddle_tpu.api.config import load_config_module
+    module = load_config_module(path, config_args)
     if not hasattr(module, "model_fn"):
         raise SystemExit(f"{path}: config must define model_fn(batch)")
     return module

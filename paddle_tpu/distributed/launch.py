@@ -1,0 +1,122 @@
+"""Multi-process job launcher (the cluster_train/paddle.py twin).
+
+The reference launched clusters with a fabric/SSH script that copied the
+workspace and started pservers then trainers with derived flags
+(``paddle/scripts/cluster_train/paddle.py:63``).  A JAX job has no
+pservers; the launcher's job is to start N identical processes with the
+coordination-service environment set, locally (one per chip/host-slot) or
+via a user-supplied remote-shell command per host.
+
+CLI::
+
+    python -m paddle_tpu.distributed.launch \
+        --nproc 4 [--coordinator 127.0.0.1:8476] [--hosts h1,h2 --ssh ssh] \
+        -- python train.py --my-flags
+
+Each child gets ``PADDLE_TPU_COORDINATOR``, ``PADDLE_TPU_NUM_PROCESSES``
+and ``PADDLE_TPU_PROCESS_ID`` — the env contract
+``distributed.runtime.initialize()`` reads.  Local mode is also the
+in-process test harness for multi-host logic (SURVEY.md §4.5's
+"distributed tests without a real cluster" discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+
+def launch_local(nproc: int, argv: Sequence[str],
+                 coordinator: str = "127.0.0.1:8476",
+                 extra_env: Optional[dict] = None) -> int:
+    """Start ``nproc`` local copies of ``argv``; returns the first nonzero
+    exit code (killing the rest), else 0."""
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(nproc):
+            env = dict(os.environ)
+            env.update(PADDLE_TPU_COORDINATOR=coordinator,
+                       PADDLE_TPU_NUM_PROCESSES=str(nproc),
+                       PADDLE_TPU_PROCESS_ID=str(rank))
+            env.update(extra_env or {})   # caller overrides win
+            procs.append(subprocess.Popen(list(argv), env=env))
+        # Poll rather than wait sequentially: one failed child must kill
+        # the rest (a dead coordinator leaves peers blocked forever).
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                return failed[0]
+            if all(c is not None for c in codes):
+                return 0
+            time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def launch_remote(hosts: Sequence[str], argv: Sequence[str],
+                  coordinator: str, ssh_cmd: str = "ssh") -> int:
+    """One process per host via a remote shell (the fabric-script twin).
+    The command and env are forwarded verbatim; the workspace is assumed
+    synced (the reference rsync'd it; use your fleet tooling)."""
+    procs: List[subprocess.Popen] = []
+    n = len(hosts)
+    cmd = " ".join(shlex.quote(a) for a in argv)
+    try:
+        for rank, host in enumerate(hosts):
+            remote = (f"PADDLE_TPU_COORDINATOR={shlex.quote(coordinator)} "
+                      f"PADDLE_TPU_NUM_PROCESSES={n} "
+                      f"PADDLE_TPU_PROCESS_ID={rank} {cmd}")
+            procs.append(subprocess.Popen(
+                shlex.split(ssh_cmd) + [host, remote]))
+        rc = 0
+        for p in procs:
+            code = p.wait()   # wait ALL hosts (same semantics as local)
+            rc = rc or code
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="start N coordinated processes (cluster_train twin)")
+    parser.add_argument("--nproc", type=int, default=1)
+    parser.add_argument("--coordinator", default="127.0.0.1:8476")
+    parser.add_argument("--hosts", default="",
+                        help="comma-separated hosts for remote mode "
+                             "(overrides --nproc)")
+    parser.add_argument("--ssh", default="ssh")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- command to run")
+    args = parser.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given (append: -- python train.py ...)")
+    if args.hosts:
+        hosts = [h for h in args.hosts.split(",") if h]
+        sys.exit(launch_remote(hosts, cmd, args.coordinator, args.ssh))
+    sys.exit(launch_local(args.nproc, cmd, args.coordinator))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""parse_config / settings (config_parser.py twins) and the multi-process
+launcher (cluster_train/paddle.py twin)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.api.config import parse_config, settings, load_config_module
+from paddle_tpu.core.config import OptimizationConfig
+from paddle_tpu.distributed.launch import launch_local
+
+
+def test_parse_config_declarative(tmp_path):
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(textwrap.dedent("""
+        import paddle_tpu.api as api
+        from paddle_tpu.api import layer
+        from paddle_tpu.api.config import get_config_arg
+        from paddle_tpu.api.graph import reset_names
+        reset_names()
+
+        hidden = get_config_arg("hidden", int, 16)
+
+        x = layer.data("x")
+        label = layer.data("label", dtype="int32")
+        pred = layer.fc(layer.fc(x, size=hidden, act="tanh",
+                                 name="h1"), size=2, name="pred")
+        cost = layer.classification_cost(pred, label)
+        optimization = {"learning_rate": 0.1, "learning_method": "momentum",
+                        "momentum": 0.9}
+
+        def train_reader():
+            yield {}
+    """))
+    bundle = parse_config(str(cfg), config_args="hidden=32")
+    names = [n["name"] for n in bundle["model"]]
+    assert "h1" in names and "pred" in names and "x" in names
+    # the override must reach the topology (get_config_arg runs DURING
+    # config execution, like the reference's config_parser)
+    h1 = next(n for n in bundle["model"] if n["name"] == "h1")
+    assert h1["attrs"]["size"] == 32
+    assert bundle["optimization"]["learning_method"] == "momentum"
+    assert bundle["data"]["train_reader"] is True
+    assert bundle["data"]["test_reader"] is False
+    assert bundle["config_args"] == {"hidden": "32"}
+    json.dumps(bundle)  # serializable
+
+
+def test_parse_config_model_fn(tmp_path):
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text("def model_fn(batch):\n    return 0.0, {}\n")
+    bundle = parse_config(str(cfg))
+    assert bundle["model"] == {"model_fn": "model_fn"}
+    assert bundle["optimization"]["learning_method"] == "sgd"
+
+
+def test_parse_config_rejects_bad(tmp_path):
+    from paddle_tpu.core.errors import EnforceError
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text("x = 1\n")
+    with pytest.raises(EnforceError):
+        parse_config(str(cfg))
+
+
+def test_settings_aliases():
+    cfg = settings(learning_rate=0.01, learning_method_name="adam",
+                   regularization_l2=1e-4, batch_size=128)
+    assert isinstance(cfg, OptimizationConfig)
+    assert cfg.learning_method == "adam"
+    assert cfg.l2_rate == 1e-4
+    assert cfg.batch_size == 128
+
+
+def test_launch_local_sets_env(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TPU_PROCESS_ID"]
+        n = os.environ["PADDLE_TPU_NUM_PROCESSES"]
+        coord = os.environ["PADDLE_TPU_COORDINATOR"]
+        out = os.path.join(os.path.dirname(__file__), f"out_{rank}.txt")
+        open(out, "w").write(f"{rank}/{n}@{coord}")
+    """))
+    rc = launch_local(3, [sys.executable, str(script)],
+                      coordinator="127.0.0.1:9999")
+    assert rc == 0
+    got = sorted((tmp_path / f"out_{i}.txt").read_text() for i in range(3))
+    assert got == ["0/3@127.0.0.1:9999", "1/3@127.0.0.1:9999",
+                   "2/3@127.0.0.1:9999"]
+
+
+def test_launch_local_propagates_failure(tmp_path):
+    script = tmp_path / "fail.py"
+    script.write_text("import os, sys; "
+                      "sys.exit(3 if os.environ['PADDLE_TPU_PROCESS_ID'] "
+                      "== '1' else 0)")
+    rc = launch_local(2, [sys.executable, str(script)])
+    assert rc == 3
+
+
+def test_runtime_reads_launcher_env(monkeypatch):
+    """runtime.initialize honors the launcher's env contract (a real
+    2-process jax.distributed cluster can't form in this test image: the
+    session sitecustomize initializes JAX before child main() runs, which
+    breaks the before-backend-init ordering jax.distributed requires)."""
+    from paddle_tpu.distributed import runtime
+    calls = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        calls.update(addr=coordinator_address, n=num_processes,
+                     rank=process_id)
+
+    monkeypatch.setattr(runtime.jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(runtime, "_initialized", False)
+    monkeypatch.setenv("PADDLE_TPU_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("PADDLE_TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("PADDLE_TPU_PROCESS_ID", "2")
+    runtime.initialize()
+    assert calls == {"addr": "10.0.0.1:8476", "n": 4, "rank": 2}
+    monkeypatch.setattr(runtime, "_initialized", False)
