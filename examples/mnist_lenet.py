@@ -1,0 +1,41 @@
+"""MNIST LeNet — the reference's `v1_api_demo/mnist` demo.
+
+    python -m paddle_tpu train --config examples/mnist_lenet.py \
+        --num-passes 3 --log-period 10
+    python -m paddle_tpu checkgrad --config examples/mnist_lenet.py
+
+--config-args knobs: batch_size (default 64), n_train (synthetic sample
+count when the real dataset is not cached).
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data.datasets import mnist
+from paddle_tpu.models.lenet import model_fn  # noqa: F401  (CLI contract)
+from paddle_tpu.training import ClassificationError
+
+BATCH = get_config_arg("batch_size", int, 64)
+N_TRAIN = get_config_arg("n_train", int, 1024)
+
+optimizer = optim.from_config(settings(
+    learning_rate=0.01, learning_method_name="momentum", momentum=0.9))
+
+evaluators = [ClassificationError()]
+
+
+def _to_batches(sample_reader):
+    batched = rd.batch(sample_reader, BATCH)
+
+    def reader():
+        for rows in batched():
+            imgs, labels = zip(*rows)
+            yield {"image": np.stack(imgs).reshape(len(imgs), -1),
+                   "label": np.asarray(labels, np.int32)}
+    return reader
+
+
+train_reader = _to_batches(rd.shuffle(mnist.train(N_TRAIN), 1024))
+test_reader = _to_batches(mnist.test(max(N_TRAIN // 4, 64)))

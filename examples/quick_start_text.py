@@ -1,0 +1,55 @@
+"""Text classification — the reference's `demo/quick_start` (sentiment /
+CTR-style text over a word sequence).
+
+    python -m paddle_tpu train --config examples/quick_start_text.py
+
+--config-args: arch=bow|cnn|lstm (quick_start's trainer_config.*.py
+variants), vocab_size, batch_size.
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data.feeder import DataFeeder, IntSequence, Integer
+from paddle_tpu.data.datasets import imdb
+from paddle_tpu.models.text_classification import model_fn_builder
+from paddle_tpu.training import ClassificationError, AUC
+
+ARCH = get_config_arg("arch", str, "bow")
+VOCAB = get_config_arg("vocab_size", int, 5148)
+BATCH = get_config_arg("batch_size", int, 64)
+
+_base_model_fn = model_fn_builder(VOCAB, arch=ARCH)
+
+
+def model_fn(batch):
+    import jax
+    loss, outputs = _base_model_fn(batch)
+    # positive-class probability for the AUC evaluator (quick_start's
+    # trainer config attaches an auc evaluator the same way)
+    outputs["prob"] = jax.nn.softmax(outputs["logits"], axis=-1)[:, 1]
+    return loss, outputs
+
+
+optimizer = optim.from_config(settings(
+    learning_rate=1e-3, learning_method_name="adam",
+    regularization_l2=1e-4))
+evaluators = [ClassificationError(), AUC()]
+
+_feeder = DataFeeder([IntSequence(buckets=(25, 50, 100)), Integer()],
+                     ["ids", "label"])
+
+
+def _to_batches(sample_reader):
+    batched = rd.batch(sample_reader, BATCH)
+
+    def reader():
+        for rows in batched():
+            yield _feeder(rows)
+    return reader
+
+
+train_reader = _to_batches(rd.shuffle(imdb.train(VOCAB, 512), 512))
+test_reader = _to_batches(imdb.test(VOCAB, 128))

@@ -1,0 +1,44 @@
+"""ResNet on CIFAR-10 — the reference's `v1_api_demo/model_zoo/resnet` +
+image benchmark family (SURVEY.md §6).
+
+    python -m paddle_tpu train --config examples/resnet_cifar.py
+    python -m paddle_tpu time  --config examples/resnet_cifar.py --batches 20
+
+--config-args: depth=18|34|50, batch_size.
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data.datasets import cifar
+from paddle_tpu.models.resnet import model_fn_builder
+from paddle_tpu.training import ClassificationError
+
+DEPTH = get_config_arg("depth", int, 18)
+BATCH = get_config_arg("batch_size", int, 64)
+
+model_fn = model_fn_builder(depth=DEPTH, num_classes=10)
+optimizer = optim.from_config(settings(
+    learning_rate=0.05, learning_method_name="momentum", momentum=0.9,
+    regularization_l2=1e-4, learning_rate_schedule="poly",
+    learning_rate_decay_a=0.9, learning_rate_decay_b=4000))
+evaluators = [ClassificationError()]
+
+
+def _to_batches(sample_reader):
+    batched = rd.batch(sample_reader, BATCH)
+
+    def reader():
+        for rows in batched():
+            imgs, labels = zip(*rows)
+            # CHW-flat [3072] in [0,1] -> NHWC [32,32,3], centered
+            x = np.stack(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            yield {"image": (x - 0.5) * 2.0,
+                   "label": np.asarray(labels, np.int32)}
+    return reader
+
+
+train_reader = _to_batches(rd.shuffle(cifar.train10(512), 512))
+test_reader = _to_batches(cifar.test10(128))

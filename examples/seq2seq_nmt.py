@@ -1,0 +1,43 @@
+"""Attention seq2seq NMT — the reference's seq2seq demo
+(`demo/seqToseq`, WMT14 fr-en) with beam-search generation.
+
+    python -m paddle_tpu train --config examples/seq2seq_nmt.py
+    python -m paddle_tpu checkgrad --config examples/seq2seq_nmt.py
+"""
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data.feeder import DataFeeder, IntSequence
+from paddle_tpu.data.datasets import wmt14
+from paddle_tpu.models.seq2seq import model_fn_builder
+
+DICT = get_config_arg("dict_size", int, 1000)
+BATCH = get_config_arg("batch_size", int, 32)
+
+model_fn = model_fn_builder(DICT, DICT, embed_dim=64, hidden=64)
+optimizer = optim.from_config(settings(
+    learning_rate=1e-3, learning_method_name="adam",
+    gradient_clipping_threshold=5.0))
+
+_feeder = DataFeeder([IntSequence(buckets=(8, 16, 24)),
+                      IntSequence(buckets=(8, 16, 24)),
+                      IntSequence(buckets=(8, 16, 24))],
+                     ["src", "tgt_in", "tgt_out"])
+
+
+def _to_batches(sample_reader):
+    batched = rd.batch(sample_reader, BATCH)
+
+    def reader():
+        for rows in batched():
+            out = _feeder(rows)
+            # tgt_in/tgt_out share one mask (teacher forcing shifts)
+            out["tgt_mask"] = out.pop("tgt_in_mask")
+            del out["tgt_out_mask"]
+            yield out
+    return reader
+
+
+train_reader = _to_batches(rd.shuffle(wmt14.train(DICT, 512), 512))
+test_reader = _to_batches(wmt14.test(DICT, 128))

@@ -48,7 +48,12 @@ def get_config_arg(name: str, type_=str, default=None):
     overrides available DURING config execution (so they can change layer
     sizes, not just post-hoc settings)."""
     if name in _current_config_args:
-        return type_(_current_config_args[name])
+        raw = _current_config_args[name]
+        if type_ is bool:
+            # bool("false") is True; mirror the reference's explicit
+            # truthy-string handling.
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return type_(raw)
     return default
 
 
@@ -78,8 +83,13 @@ def parse_config(config: Union[str, Any],
                  config_args: str = "") -> Dict[str, Any]:
     """Parse a config file (path or already-loaded module) into the
     serialized bundle described in the module docstring."""
-    module = (load_config_module(config, config_args)
-              if isinstance(config, str) else config)
+    if isinstance(config, str):
+        module = load_config_module(config, config_args)
+    else:
+        enforce(not config_args,
+                "config_args can only apply when parse_config loads the "
+                "file itself (an already-executed module cannot see them)")
+        module = config
 
     out: Dict[str, Any] = {}
     cost = getattr(module, "cost", None)

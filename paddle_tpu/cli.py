@@ -25,7 +25,6 @@ A config file is plain Python (the reference's config DSL was too —
 from __future__ import annotations
 
 import argparse
-import importlib.util
 import json
 import sys
 import time

@@ -83,11 +83,19 @@ def launch_remote(hosts: Sequence[str], argv: Sequence[str],
                       f"PADDLE_TPU_PROCESS_ID={rank} {cmd}")
             procs.append(subprocess.Popen(
                 shlex.split(ssh_cmd) + [host, remote]))
-        rc = 0
-        for p in procs:
-            code = p.wait()   # wait ALL hosts (same semantics as local)
-            rc = rc or code
-        return rc
+        # Same failure-kill poll loop as launch_local: one dead host must
+        # not leave the launcher (and the surviving peers) blocked.  NOTE:
+        # terminating kills the local ssh client; the remote command may
+        # outlive it unless ssh allocates a tty (pass --ssh "ssh -t") or
+        # the fleet supervisor reaps it.
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                return failed[0]
+            if all(c is not None for c in codes):
+                return 0
+            time.sleep(0.1)
     finally:
         for p in procs:
             if p.poll() is None:

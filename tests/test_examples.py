@@ -1,0 +1,38 @@
+"""Every example config must train one pass through the CLI
+(test_TrainerOnePass.cpp discipline, applied to the shipped demo configs)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    ("mnist_lenet.py", "batch_size=32,n_train=128"),
+    ("quick_start_text.py", "batch_size=16,vocab_size=200"),
+    ("sequence_tagging_crf.py", "batch_size=8,mode=linear"),
+    ("seq2seq_nmt.py", "batch_size=8,dict_size=120"),
+    ("resnet_cifar.py", "batch_size=8,depth=18"),
+]
+
+
+@pytest.mark.parametrize("config,args", CONFIGS,
+                         ids=[c for c, _ in CONFIGS])
+def test_example_trains_one_pass(config, args, tmp_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "train",
+         "--config", os.path.join(REPO, "examples", config),
+         "--config-args", args, "--num-passes", "1",
+         "--checkpoint-dir", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-3000:]
+    metrics = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "loss" in metrics and metrics["loss"] == metrics["loss"]
+    # a checkpoint pass dir was written
+    assert (tmp_path / "pass-00000" / "arrays.npz").exists()
