@@ -21,6 +21,7 @@ import paddle_tpu.nn as nn
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.api.graph import LayerOutput, auto_name
 from paddle_tpu.ops import losses as loss_ops
+from paddle_tpu.ops import nested as nested_ops
 from paddle_tpu.ops import sequence as seq_ops
 
 
@@ -166,11 +167,44 @@ def grumemory(input, size: int, reverse: bool = False,
 
 
 def seq_pool(input, pool_type: str = "avg", name: Optional[str] = None):
-    """Sequence pooling to a fixed vector (pooling_layer twin)."""
+    """Sequence pooling (pooling_layer twin).  Flat sequences pool to a
+    fixed vector; NESTED sequences ([b,o,i,...], [b,o,i] mask) pool each
+    sub-sequence, yielding a flat sequence — the reference's pooling at
+    ``AggregateLevel.EACH_SEQUENCE``."""
     def run(ctx, x, **a):
         enforce(_is_seq(x), "seq_pool needs a sequence input")
+        if x[1].ndim == 3:
+            return nested_ops.nested_pool(x[0], x[1], a["pool_type"])
         return seq_ops.sequence_pool(x[0], x[1], a["pool_type"])
     return _node("seq_pool", run, [input], name=name, pool_type=pool_type)
+
+
+def seq_reshape(input, inner: Optional[int] = None,
+                name: Optional[str] = None):
+    """Nested<->flat sequence conversion (seq_reshape_layer /
+    Argument-degrade twin): with ``inner`` given, cut a flat sequence into
+    ``inner``-sized sub-sequences; without it, flatten a nested sequence
+    back to flat (valid steps left-packed)."""
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "seq_reshape needs a sequence input")
+        if a["inner"] is not None:
+            enforce(x[1].ndim == 2, "inner= requires a flat sequence")
+            return nested_ops.split_to_nested(x[0], x[1], a["inner"])
+        enforce(x[1].ndim == 3, "flattening requires a nested sequence")
+        return nested_ops.flatten_nested(x[0], x[1])
+    return _node("seq_reshape", run, [input], name=name, inner=inner)
+
+
+def sub_nested_seq(input, selected_indices, k: int,
+                   name: Optional[str] = None):
+    """Select k sub-sequences per row by index
+    (sub_nested_seq_layer twin; pair with kmax_seq_score)."""
+    def run(ctx, x, idx, **a):
+        enforce(_is_seq(x) and x[1].ndim == 3,
+                "sub_nested_seq needs a nested sequence input")
+        return nested_ops.sub_nested_seq(x[0], x[1], _val(idx), a["k"])
+    return _node("sub_nested_seq", run, [input, selected_indices],
+                 name=name, k=k)
 
 
 def last_seq(input, name: Optional[str] = None):

@@ -206,7 +206,11 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                         "recurrent_group input %d is not a sequence", i)
                 seqs[i] = v
                 if mask is None:
-                    mask = v[1]
+                    # For a NESTED input ([b, o, i, ...], [b, o, i]) the
+                    # group iterates the OUTER axis: its step mask is
+                    # "which sub-sequences exist" (the reference's
+                    # SubsequenceInput semantics).
+                    mask = (v[1].any(-1) if v[1].ndim == 3 else v[1])
         b, t = mask.shape
 
         carry = _boot_values(memories, boot_vals, b)
@@ -231,7 +235,15 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             return outs, new_mems
 
         def slices_at(ti):
-            return {i: jnp.take(v[0], ti, axis=1) for i, v in seqs.items()}
+            out = {}
+            for i, v in seqs.items():
+                if v[1].ndim == 3:
+                    # nested: each outer step is a (value, mask) sequence
+                    out[i] = (jnp.take(v[0], ti, axis=1),
+                              jnp.take(v[1], ti, axis=1))
+                else:
+                    out[i] = jnp.take(v[0], ti, axis=1)
+            return out
 
         def masked(new_mems, old_mems, m_t):
             return [jnp.where(m_t[:, None] if nm.ndim > 1 else m_t, nm, om)
@@ -242,8 +254,12 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
         outs0, mems0 = eval_at(slices_at(t0), carry)
         carry1 = masked(mems0, carry, jnp.take(mask, t0, axis=1))
 
+        def expand1(o):
+            return jax.tree_util.tree_map(
+                lambda a: jnp.expand_dims(a, 1), o)
+
         if t == 1:
-            stacked = [jnp.expand_dims(o, 1) for o in outs0]
+            stacked = [expand1(o) for o in outs0]
         else:
             def body(c, ti):
                 outs, new_mems = eval_at(slices_at(ti), c)
@@ -251,15 +267,26 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
                 return c2, outs
 
             _, rest = lax.scan(body, carry1, time_index[1:])
-            stacked = [jnp.concatenate(
-                [jnp.expand_dims(o0, 1), jnp.moveaxis(r, 0, 1)], axis=1)
-                for o0, r in zip(outs0, rest)]
+            stacked = [jax.tree_util.tree_map(
+                lambda o0, r: jnp.concatenate(
+                    [jnp.expand_dims(o0, 1), jnp.moveaxis(r, 0, 1)],
+                    axis=1), o0s, r)
+                for o0s, r in zip(outs0, rest)]
         if reverse:
-            stacked = [s[:, ::-1] for s in stacked]
+            stacked = [jax.tree_util.tree_map(lambda s: s[:, ::-1], s)
+                       for s in stacked]
         pairs = []
         for s in stacked:
-            md = mask.reshape((b, t) + (1,) * (s.ndim - 2))
-            pairs.append((jnp.where(md, s, 0.0), mask))
+            if isinstance(s, tuple):
+                # step emitted a (value, mask) sequence -> NESTED output:
+                # value [b, outer, inner, ...], mask [b, outer, inner]
+                val, im = s
+                im = im & mask.reshape((b, t) + (1,) * (im.ndim - 2))
+                md = im.reshape(im.shape + (1,) * (val.ndim - im.ndim))
+                pairs.append((jnp.where(md, val, 0.0), im))
+            else:
+                md = mask.reshape((b, t) + (1,) * (s.ndim - 2))
+                pairs.append((jnp.where(md, s, 0.0), mask))
         return pairs if multi else pairs[0]
 
     return LayerOutput(name=gname, kind="recurrent_group", fn=run,

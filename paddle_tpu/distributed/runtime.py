@@ -39,18 +39,24 @@ def initialize(coordinator_address: Optional[str] = None,
     if _initialized:
         return
     # Env contract set by paddle_tpu.distributed.launch (the cluster_train
-    # launcher twin); explicit args override.  All three vars must be
-    # present — a stray coordinator address alone (stale shell export)
-    # must not drag a single-process run into a blocking connect.
-    env_keys = ("PADDLE_TPU_COORDINATOR", "PADDLE_TPU_NUM_PROCESSES",
-                "PADDLE_TPU_PROCESS_ID")
-    if all(k in os.environ for k in env_keys):
-        if coordinator_address is None:
-            coordinator_address = os.environ["PADDLE_TPU_COORDINATOR"]
-        if num_processes is None:
-            num_processes = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
-        if process_id is None:
-            process_id = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    # launcher twin); each var fills in independently where the arg is
+    # None, so mixed arg+env setups (scheduler-provided rank, shared env
+    # for the rest) work.  A coordinator WITHOUT a process count (e.g. a
+    # stale shell export) is ignored with a loud warning instead of
+    # silently blocking on a nonexistent coordinator.
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if num_processes is None and "PADDLE_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    if process_id is None and "PADDLE_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    if coordinator_address is not None and num_processes is None:
+        import logging
+        logging.getLogger("paddle_tpu.distributed").warning(
+            "coordinator %s set but no process count — treating as "
+            "single-process (set PADDLE_TPU_NUM_PROCESSES / pass "
+            "num_processes for distributed init)", coordinator_address)
+        coordinator_address = None
     if coordinator_address is None and num_processes is None \
             and "JAX_COORDINATOR_ADDRESS" not in os.environ \
             and os.environ.get("TPU_WORKER_HOSTNAMES") is None:

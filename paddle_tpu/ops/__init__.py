@@ -1,3 +1,4 @@
 from paddle_tpu.ops import activations
+from paddle_tpu.ops import nested
 
-__all__ = ["activations"]
+__all__ = ["activations", "nested"]

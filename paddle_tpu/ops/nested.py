@@ -1,0 +1,137 @@
+"""Nested (2-level) sequence ops.
+
+The reference carries nested variable-length sequences everywhere —
+``Argument.subSequenceStartPositions`` (``parameter/Argument.h:93``), the
+2-level ``LoD`` of the new IR (``lod_tensor.h:53``), and the sub-sequence
+layer family (SubSequenceLayer, SequenceReshapeLayer,
+SubNestedSequenceLayer, SequenceSoftmax over sub-sequences,
+AverageLayer/MaxLayer at ``AverageLevel=kNonSeq|kSeq``).
+
+TPU-native representation (docs/design/sequences.md): a nested batch is
+``value [batch, outer, inner, ...]`` + ``mask [batch, outer, inner]`` —
+one extra dense axis + one extra mask level, all shapes static.  The outer
+sequence's own mask is ``outer_mask(mask) = mask.any(-1)``.
+
+Every op here reduces to the flat ops of ``ops/sequence.py`` applied over
+an extra leading axis (vmap-style reshaping), which is exactly how the
+reference's layers loop sub-sequences inside each sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.ops import sequence as seq
+
+
+def outer_mask(mask: jax.Array) -> jax.Array:
+    """[b, outer, inner] -> [b, outer]: which sub-sequences exist."""
+    return mask.any(axis=-1)
+
+
+def nested_pool(x: jax.Array, mask: jax.Array, pool_type: str = "avg"
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Pool each sub-sequence to one vector — the reference's sequence
+    pooling at ``kSeq`` level (nested input -> plain sequence output).
+
+    x: [b, o, i, d...], mask: [b, o, i] -> ([b, o, d...], [b, o]).
+    """
+    x = jnp.asarray(x)
+    mask = jnp.asarray(mask)
+    b, o = mask.shape[:2]
+    flat_x = x.reshape((b * o,) + x.shape[2:])
+    flat_m = mask.reshape(b * o, mask.shape[2])
+    # Empty sub-sequences: give them one fake valid step so pooling is
+    # well-defined, then zero the result via the outer mask.
+    safe_m = flat_m.at[:, 0].set(flat_m[:, 0] | ~flat_m.any(-1))
+    pooled = seq.sequence_pool(flat_x, safe_m, pool_type)
+    pooled = pooled.reshape((b, o) + pooled.shape[1:])
+    om = outer_mask(mask)
+    pooled = jnp.where(om.reshape((b, o) + (1,) * (pooled.ndim - 2)),
+                       pooled, 0.0)
+    return pooled, om
+
+
+def flatten_nested(x: jax.Array, mask: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Degrade a nested sequence to a flat one, compacting the per-row
+    concatenation of its sub-sequences (SequenceReshapeLayer /
+    Argument degrade-to-sequence twin).
+
+    x: [b, o, i, d...], mask: [b, o, i] -> ([b, o*i, d...], [b, o*i])
+    with all valid steps left-packed per batch row.
+    """
+    b, o, i = mask.shape
+    t = o * i
+    flat_x = x.reshape((b, t) + x.shape[3:])
+    flat_m = mask.reshape(b, t)
+    # left-pack: stable argsort of ~mask moves valid steps to the front
+    order = jnp.argsort(~flat_m, axis=1, stable=True)
+    packed = jnp.take_along_axis(
+        flat_x, order.reshape((b, t) + (1,) * (flat_x.ndim - 2)), axis=1)
+    packed_m = jnp.take_along_axis(flat_m, order, axis=1)
+    packed = jnp.where(
+        packed_m.reshape((b, t) + (1,) * (packed.ndim - 2)), packed,
+        jnp.zeros((), packed.dtype))
+    return packed, packed_m
+
+
+def split_to_nested(x: jax.Array, mask: jax.Array, inner: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Promote a flat sequence to nested by cutting fixed-size ``inner``
+    windows (the static-shape seq->nested reshape; the reference's
+    SequenceReshapeLayer reshaped by a dimension factor the same way).
+
+    x: [b, t, d...], mask: [b, t] -> ([b, ceil(t/inner), inner, d...], ...)
+    """
+    b, t = mask.shape
+    o = -(-t // inner)
+    pad = o * inner - t
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    return (x.reshape((b, o, inner) + x.shape[2:]),
+            mask.reshape(b, o, inner))
+
+
+def sub_nested_seq(x: jax.Array, mask: jax.Array, indices: jax.Array,
+                   k: int) -> Tuple[jax.Array, jax.Array]:
+    """Select ``k`` sub-sequences per row by index
+    (SubNestedSequenceLayer twin — e.g. keep the kmax-scored ones).
+
+    x: [b, o, i, d...], mask: [b, o, i], indices: [b, k] int32 ->
+    ([b, k, i, d...], [b, k, i]).
+    """
+    b, o, i = mask.shape
+    idx = jnp.clip(indices, 0, o - 1)
+    sel = jnp.take_along_axis(
+        x, idx.reshape((b, k) + (1,) * (x.ndim - 2)), axis=1)
+    sel_m = jnp.take_along_axis(mask, idx[:, :, None], axis=1)
+    valid = (indices >= 0) & (indices < o)
+    sel_m = sel_m & valid[:, :, None]
+    sel = jnp.where(sel_m.reshape((b, k, i) + (1,) * (sel.ndim - 3)),
+                    sel, jnp.zeros((), sel.dtype))
+    return sel, sel_m
+
+
+def nested_softmax(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Softmax within each sub-sequence (sequence_softmax at the
+    sub-sequence level; x: [b, o, i] scores)."""
+    neg = jnp.where(mask, x, -jnp.inf)
+    m = jnp.max(neg, axis=-1, keepdims=True)
+    e = jnp.exp(jnp.where(mask, x - m, -jnp.inf))
+    e = jnp.where(mask, e, 0.0)
+    denom = jnp.maximum(e.sum(axis=-1, keepdims=True), 1e-9)
+    return e / denom
+
+
+def nested_expand(vec: jax.Array, mask: jax.Array) -> jax.Array:
+    """Broadcast one vector per sub-sequence over its steps
+    (expand_layer at kSeq level).  vec: [b, o, d], mask: [b, o, i]."""
+    out = jnp.broadcast_to(vec[:, :, None, :],
+                           mask.shape + (vec.shape[-1],))
+    return jnp.where(mask[..., None], out, 0.0)

@@ -1,0 +1,189 @@
+"""Nested (2-level) sequence tests: ops/nested.py semantics against numpy
+re-derivations and recurrent_group over sub-sequences (the reference's
+nested RecurrentGradientMachine configs — sequence_nest_rnn.conf family,
+test_RecurrentGradientMachine.cpp)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.api as api
+from paddle_tpu.api import layer
+from paddle_tpu.api.graph import reset_names
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import nested
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_names()
+    yield
+
+
+def _nested_batch(rng, b=3, o=4, i=5, d=2):
+    x = rng.randn(b, o, i, d).astype(np.float32)
+    mask = np.zeros((b, o, i), bool)
+    # row 0: 3 subseqs of lens 2,5,1; row 1: 1 subseq len 4; row 2: full
+    lens = [[2, 5, 1, 0], [4, 0, 0, 0], [5, 5, 5, 5]][:b]
+    for bi, row in enumerate(lens):
+        for oi, n in enumerate(row):
+            mask[bi, oi, :n] = True
+    x = np.where(mask[..., None], x, 0.0)
+    return x, mask
+
+
+def test_nested_pool_matches_manual(rng):
+    x, mask = _nested_batch(rng)
+    pooled, om = nested.nested_pool(jnp.asarray(x), jnp.asarray(mask),
+                                    "avg")
+    pooled = np.asarray(pooled)
+    for bi in range(x.shape[0]):
+        for oi in range(x.shape[1]):
+            m = mask[bi, oi]
+            if m.any():
+                want = x[bi, oi][m].mean(axis=0)
+                np.testing.assert_allclose(pooled[bi, oi], want, rtol=1e-5)
+                assert om[bi, oi]
+            else:
+                np.testing.assert_allclose(pooled[bi, oi], 0.0)
+                assert not om[bi, oi]
+
+
+def test_flatten_nested_left_packs(rng):
+    x, mask = _nested_batch(rng)
+    flat, fm = nested.flatten_nested(jnp.asarray(x), jnp.asarray(mask))
+    flat, fm = np.asarray(flat), np.asarray(fm)
+    for bi in range(x.shape[0]):
+        want = x[bi][mask[bi]]           # valid steps in order
+        n = want.shape[0]
+        assert fm[bi, :n].all() and not fm[bi, n:].any()
+        np.testing.assert_allclose(flat[bi, :n], want, rtol=1e-6)
+
+
+def test_split_to_nested_roundtrip(rng):
+    b, t, d = 2, 7, 3
+    x = rng.randn(b, t, d).astype(np.float32)
+    mask = np.ones((b, t), bool)
+    mask[1, 5:] = False
+    x = np.where(mask[..., None], x, 0.0)
+    nx, nm = nested.split_to_nested(jnp.asarray(x), jnp.asarray(mask), 3)
+    assert nx.shape == (b, 3, 3, d)
+    flat, fm = nested.flatten_nested(nx, nm)
+    np.testing.assert_allclose(np.asarray(flat)[:, :t], x, rtol=1e-6)
+
+
+def test_sub_nested_seq_select(rng):
+    x, mask = _nested_batch(rng)
+    idx = jnp.asarray([[1, 0], [0, 3], [3, 2]], jnp.int32)
+    sel, sm = nested.sub_nested_seq(jnp.asarray(x), jnp.asarray(mask),
+                                    idx, k=2)
+    np.testing.assert_allclose(np.asarray(sel)[0, 0], x[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sel)[2, 0], x[2, 3], rtol=1e-6)
+    # row 1 selected subseq 3 which is empty -> masked out
+    assert not np.asarray(sm)[1, 1].any()
+
+
+def test_nested_softmax_and_expand(rng):
+    _, mask = _nested_batch(rng)
+    scores = rng.randn(*mask.shape).astype(np.float32)
+    p = np.asarray(nested.nested_softmax(jnp.asarray(scores),
+                                         jnp.asarray(mask)))
+    sums = p.sum(-1)
+    np.testing.assert_allclose(sums[mask.any(-1)], 1.0, rtol=1e-5)
+    assert (p[~mask] == 0).all()
+
+    vec = rng.randn(mask.shape[0], mask.shape[1], 4).astype(np.float32)
+    ex = np.asarray(nested.nested_expand(jnp.asarray(vec),
+                                         jnp.asarray(mask)))
+    bi, oi = 0, 1
+    np.testing.assert_allclose(ex[bi, oi][mask[bi, oi]],
+                               np.tile(vec[bi, oi], (mask[bi, oi].sum(), 1)))
+
+
+# ---- api-level nested layers ----------------------------------------------
+
+def test_api_nested_pool_and_reshape(rng):
+    x, mask = _nested_batch(rng)
+    batch = {"x": x, "x_mask": mask, "y": rng.randn(3, 2).astype(np.float32)}
+    seq = layer.data("x", sequence=True)
+    inner_pooled = layer.seq_pool(seq, pool_type="avg")   # nested -> flat
+    outer_pooled = layer.seq_pool(inner_pooled, pool_type="max")
+    cost = layer.square_error_cost(outer_pooled, layer.data("y"))
+    model_fn = api.compile_model(cost, extra_outputs=[inner_pooled])
+    model = nn.transform(lambda bt: model_fn(bt))
+    params, state = model.init(jax.random.key(0), batch)
+    (loss, outs), _ = model.apply(params, state, None, batch)
+    val, om = outs[inner_pooled.name]
+    assert val.shape == (3, 4, 2) and om.shape == (3, 4)
+    assert np.isfinite(float(loss))
+
+
+def test_recurrent_group_over_subsequences(rng):
+    """Outer recurrence over sub-sequences: each step pools its
+    sub-sequence and updates a memory — the sequence_nest_rnn pattern.
+    Must equal the hand computation."""
+    x, mask = _nested_batch(rng)
+    b, o, i, d = x.shape
+    h = 4
+    batch = {"x": x, "x_mask": mask}
+    seq = layer.data("x", sequence=True)
+
+    def step(sub):
+        # sub is a (value [b, i, d], mask [b, i]) flat sequence
+        pooled = layer.seq_pool(sub, pool_type="sum")
+        mem = api.memory(name="s", size=h)
+        return layer.fc(layer.concat([pooled, mem]), size=h, act="tanh",
+                        name="s")
+
+    out = api.recurrent_group(step=step, input=seq)
+    cost = layer.sum_cost(layer.last_seq(out))
+    model_fn = api.compile_model(cost, extra_outputs=[out])
+    model = nn.transform(lambda bt: model_fn(bt))
+    params, state = model.init(jax.random.key(0), batch)
+    (loss, outs), _ = model.apply(params, state, None, batch)
+    got, gm = outs[out.name]
+    assert got.shape == (b, o, h)
+    np.testing.assert_array_equal(np.asarray(gm), mask.any(-1))
+
+    w = np.asarray(params["s"]["w"])
+    bias = np.asarray(params["s"]["b"])
+    st = np.zeros((b, h), np.float32)
+    want = np.zeros((b, o, h), np.float32)
+    om = mask.any(-1)
+    for oi in range(o):
+        pooled = (x[:, oi] * mask[:, oi][..., None]).sum(axis=1)
+        new = np.tanh(np.concatenate([pooled, st], -1) @ w + bias)
+        st = np.where(om[:, oi][:, None], new, st)
+        want[:, oi] = np.where(om[:, oi][:, None], new, 0.0)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+    # gradients flow
+    def loss_fn(p):
+        (l, _), _ = model.apply(p, state, None, batch)
+        return l
+    grads = jax.grad(loss_fn)(params)
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_recurrent_group_emits_nested_output(rng):
+    """A step returning a sequence pair makes the group output nested:
+    per-step fc over each sub-sequence."""
+    x, mask = _nested_batch(rng)
+    batch = {"x": x, "x_mask": mask}
+    seq = layer.data("x", sequence=True)
+
+    def step(sub):
+        return layer.fc(sub, size=3, act="tanh", name="proj")
+
+    out = api.recurrent_group(step=step, input=seq)
+    cost = layer.sum_cost(layer.seq_pool(layer.seq_pool(out), "sum"))
+    model_fn = api.compile_model(cost, extra_outputs=[out])
+    model = nn.transform(lambda bt: model_fn(bt))
+    params, state = model.init(jax.random.key(0), batch)
+    (_, outs), _ = model.apply(params, state, None, batch)
+    val, m = outs[out.name]
+    assert val.shape == (3, 4, 5, 3) and m.shape == (3, 4, 5)
+    np.testing.assert_array_equal(np.asarray(m), mask)
