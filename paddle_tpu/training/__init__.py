@@ -1,5 +1,6 @@
 from paddle_tpu.training.trainer import Trainer
-from paddle_tpu.training import events, evaluators, checkpoint, aux
+from paddle_tpu.training import (events, evaluators, checkpoint,
+                                 checkpoint_sharded, aux)
 from paddle_tpu.training.aux import (parameter_stats,
                                      format_parameter_stats,
                                      enable_fp_checks, PreemptionHandler)
@@ -7,7 +8,8 @@ from paddle_tpu.training.evaluators import (Evaluator, ClassificationError,
                                             ValueSum, PrecisionRecall, AUC,
                                             ChunkEvaluator, iob_decode)
 
-__all__ = ["Trainer", "events", "evaluators", "checkpoint", "aux",
+__all__ = ["Trainer", "events", "evaluators", "checkpoint",
+           "checkpoint_sharded", "aux",
            "parameter_stats", "format_parameter_stats", "enable_fp_checks",
            "PreemptionHandler", "Evaluator",
            "ClassificationError", "ValueSum", "PrecisionRecall", "AUC",
