@@ -1,29 +1,38 @@
 """Throughput benchmark — prints ONE JSON line.
 
 Twin of the reference's ``paddle train --job=time`` harness
-(``trainer/TrainerBenchmark.cpp:27-66``: 10 burn-in batches, then timed
+(``trainer/TrainerBenchmark.cpp:27-66``: burn-in batches, then timed
 batches) on its RNN benchmark config (``benchmark/paddle/rnn/rnn.py``:
 IMDB-style stacked 2×LSTM classifier, seq_len=100, dict 30k).
 
-Baseline: LSTM h=256 bs=64 = 83 ms/batch on a K40m (BASELINE.md RNN table).
-``vs_baseline`` is the speedup factor (baseline_ms / our_ms, >1 = faster).
-Full train step (forward+backward+update) like the reference's --job=time.
+Timing protocol: **differential** — time N batches and 4N batches, each
+run ended by a host transfer of the final loss (the only sync that
+provably waits for execution everywhere), and report
+``(T(4N) - T(N)) / (3N)``.  The subtraction cancels constant overheads
+(compile cache hits, host->device transfer of the first batch, and — on
+tunneled/remote TPU attachments — the control-channel round trip), so the
+number is the marginal cost of one more training batch.  On a
+directly-attached chip this equals device step time; ``block_until_ready``
+is deliberately NOT used as the sync because some transport plugins
+report readiness before execution completes.
+
+Baseline: LSTM h=256 bs=64 = 83 ms/batch on a K40m (BASELINE.md RNN
+table).  ``vs_baseline`` is the speedup factor (baseline_ms / our_ms,
+>1 = faster).  Full train step (forward+backward+update) like the
+reference's --job=time.
 """
 
 import json
-import time
 
 import numpy as np
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
-
     from paddle_tpu import optim
     from paddle_tpu.core.dtypes import mixed_precision
     from paddle_tpu.models.lstm_classifier import model_fn_builder
     from paddle_tpu.training import Trainer
+    from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
 
     vocab, b, t = 30000, 64, 100
     hidden = 256
@@ -42,19 +51,12 @@ def main():
             optim.adam(1e-3))
         trainer.init(batch)
 
-        # burn-in (compile + warm caches), TrainerBenchmark.cpp style
-        for _ in range(10):
-            loss, _ = trainer.train_batch(batch)
-        jax.block_until_ready(trainer.params)
+        step_fn = lambda: trainer.train_batch(batch)[0]
+        # burn-in (compile + warm transport), TrainerBenchmark.cpp style
+        timed_run(step_fn, 10)
 
-        n_timed = 50
-        t0 = time.perf_counter()
-        for _ in range(n_timed):
-            loss, _ = trainer.train_batch(batch)
-        jax.block_until_ready(trainer.params)
-        elapsed = time.perf_counter() - t0
+        ms_per_batch = marginal_ms_per_batch(step_fn, n=10)
 
-    ms_per_batch = elapsed / n_timed * 1000.0
     baseline_ms = 83.0  # K40m, benchmark/README.md:117-120
     print(json.dumps({
         "metric": "stacked-LSTM cls train step, h=256 bs=64 seq=100 dict=30k",

@@ -1,0 +1,61 @@
+"""Image-CNN benchmark config (benchmark/paddle/image/{alexnet,googlenet,
+smallnet_mnist_cifar}.py twin, driven like run.sh through the CLI's time
+job — `TrainerBenchmark.cpp:27` burn-in + timed batches):
+
+    python -m paddle_tpu time --config benchmark/image.py \
+        --config-args model=alexnet,batch_size=128 --batches 50
+
+Baselines (BASELINE.md, 1×K40m): alexnet bs=128 = 334 ms/batch,
+googlenet bs=128 = 1149 ms/batch, smallnet bs=64 = 10.46 ms/batch.
+Synthetic data (the reference benchmarked synthetic-shaped batches too —
+the timing isolates the train step, not IO).
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+
+MODEL = get_config_arg("model", str, "alexnet")
+BATCH = get_config_arg("batch_size", int, 128)
+CLASSES = get_config_arg("classes", int, 1000)
+
+_hw = {"alexnet": 224, "googlenet": 224, "smallnet": 32,
+       "resnet50": 224}[MODEL]
+
+mixed_precision = True  # bf16 compute (CLI honors this config attr)
+
+if MODEL == "alexnet":
+    from paddle_tpu.models.alexnet import model_fn_builder
+    model_fn = model_fn_builder(CLASSES)
+elif MODEL == "googlenet":
+    from paddle_tpu.models.googlenet import model_fn_builder
+    model_fn = model_fn_builder(CLASSES)
+elif MODEL == "resnet50":
+    from paddle_tpu.models.resnet import model_fn_builder
+    model_fn = model_fn_builder(depth=50, num_classes=CLASSES)
+else:  # smallnet_mnist_cifar: conv32-pool-conv64-pool-fc
+    import paddle_tpu.nn as nn
+    from paddle_tpu.ops import losses
+
+    def model_fn(batch):
+        x = nn.Conv2D(32, 5, act="relu", name="c1")(batch["image"])
+        x = nn.Pool2D(3, stride=2)(x)
+        x = nn.Conv2D(64, 5, act="relu", name="c2")(x)
+        x = nn.Pool2D(3, stride=2)(x)
+        logits = nn.Linear(CLASSES, name="fc")(
+            x.reshape(x.shape[0], -1))
+        loss = losses.softmax_cross_entropy(
+            logits, batch["label"]).mean()
+        return loss, {}
+
+optimizer = optim.from_config(settings(
+    learning_rate=0.01, learning_method_name="momentum", momentum=0.9))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {"image": rs.randn(BATCH, _hw, _hw, 3).astype(np.float32),
+             "label": rs.randint(0, CLASSES, BATCH).astype(np.int32)}
+    while True:
+        yield batch

@@ -1,0 +1,37 @@
+"""RNN benchmark config (benchmark/paddle/rnn/rnn.py twin: IMDB-style
+stacked-LSTM classifier, seq_len=100, dict 30k):
+
+    python -m paddle_tpu time --config benchmark/rnn.py \
+        --config-args hidden=256,batch_size=64 --batches 50
+
+Baselines (BASELINE.md, 1×K40m): h=256 bs=64 = 83 ms/batch,
+h=512 bs=128 = 261, h=1280 bs=256 = 1655.  bench.py at the repo root runs
+the h=256 bs=64 point as the driver's canonical one-line metric.
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.models.lstm_classifier import model_fn_builder
+
+HIDDEN = get_config_arg("hidden", int, 256)
+BATCH = get_config_arg("batch_size", int, 64)
+SEQ = get_config_arg("seq_len", int, 100)
+VOCAB = get_config_arg("dict_size", int, 30000)
+
+mixed_precision = True  # bf16 compute (CLI honors this config attr)
+model_fn = model_fn_builder(VOCAB, embed_dim=128, hidden=HIDDEN,
+                            num_layers=2)
+
+optimizer = optim.from_config(settings(
+    learning_rate=1e-3, learning_method_name="adam"))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {"ids": rs.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32),
+             "ids_mask": np.ones((BATCH, SEQ), bool),
+             "label": rs.randint(0, 2, BATCH).astype(np.int32)}
+    while True:
+        yield batch

@@ -43,6 +43,11 @@ def _load_config(path: str, config_args: str):
 
 def _build_trainer(cfg):
     from paddle_tpu.training import Trainer
+    if getattr(cfg, "mixed_precision", False):
+        # bf16 compute policy for the whole run (the policy is read at
+        # trace time, so it must be set process-wide before jit)
+        from paddle_tpu.core import dtypes
+        dtypes.set_policy(dtypes.MIXED_BF16)
     opt = getattr(cfg, "optimizer", None)
     if opt is None:
         from paddle_tpu import optim
@@ -88,25 +93,31 @@ def cmd_test(args):
 
 def cmd_time(args):
     """Throughput benchmark (TrainerBenchmark.cpp:27-66 twin: burn-in then
-    timed batches, ms/batch printed)."""
+    timed batches).  Differential protocol — (T(4n)-T(n))/3n with a
+    host-transfer sync — so constant overheads (incl. remote-attachment
+    round trips) cancel; see bench.py's docstring for the rationale."""
     import itertools
-    import jax
+    from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
     cfg = _load_config(args.config, args.config_args)
     trainer = _build_trainer(cfg)
 
     batches = list(itertools.islice(iter(cfg.train_reader()),
                                     max(args.batches, 1)))
     cycle = itertools.cycle(batches)
-    for _ in range(args.burn_in):
-        trainer.train_batch(next(cycle))
-    jax.block_until_ready(trainer.params)
-    t0 = time.perf_counter()
-    for _ in range(args.batches):
+    last = {}
+
+    def step_fn():
         loss, _ = trainer.train_batch(next(cycle))
-    jax.block_until_ready(trainer.params)
-    ms = (time.perf_counter() - t0) / args.batches * 1000.0
+        last["cost"] = loss
+        return loss
+
+    timed_run(step_fn, args.burn_in)
+    # --batches N sets the differential scale: arms of N and 4N batches.
+    n = max(args.batches, 1)
+    ms = marginal_ms_per_batch(step_fn, n=n)
     print(json.dumps({"ms_per_batch": ms, "batches": args.batches,
-                      "last_cost": float(loss)}))
+                      "last_cost": float(last["cost"]),
+                      "protocol": "differential"}))
 
 
 def cmd_checkgrad(args):
@@ -180,7 +191,9 @@ def main(argv=None):
 
     p = sub.add_parser("time", help="benchmark ms/batch (--job=time twin)")
     common(p)
-    p.add_argument("--batches", type=int, default=50)
+    p.add_argument("--batches", type=int, default=10,
+                   help="differential scale n: timing arms run n and 4n "
+                        "batches (2 repeats each)")
     p.add_argument("--burn-in", type=int, default=10)
     p.set_defaults(fn=cmd_time)
 

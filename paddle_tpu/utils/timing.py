@@ -1,0 +1,40 @@
+"""Differential throughput timing (the --job=time measurement core).
+
+Why differential: ``block_until_ready`` is not a trustworthy execution
+barrier on every transport (remote/tunneled TPU attachments may report
+readiness before execution finishes), and a host transfer per run pays a
+constant control-channel round trip.  Timing N and 4N batches, each ended
+by ONE host transfer of the final loss, and reporting
+``(T(4N) - T(N)) / 3N`` cancels every constant cost and measures the
+marginal execution time of one training batch — on a directly-attached
+chip this equals device step time.  Used by both ``bench.py`` and the
+CLI's ``time`` job so the protocol cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Tuple
+
+
+def timed_run(step_fn: Callable[[], object], n: int) -> float:
+    """Wall time of ``n`` calls of ``step_fn`` ended by a host sync on the
+    last returned loss.  ``n`` == 0 times just the sync when a loss is
+    available (returns ~0 otherwise)."""
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(n):
+        loss = step_fn()
+    if loss is not None:
+        float(loss)  # host transfer: provably waits for execution
+    return time.perf_counter() - t0
+
+
+def marginal_ms_per_batch(step_fn: Callable[[], object], n: int = 10,
+                          repeats: int = 2) -> float:
+    """Differential timing: ``(T(4n) - T(n)) / 3n`` ms, best of
+    ``repeats`` for each arm."""
+    n = max(n, 1)
+    t_small = min(timed_run(step_fn, n) for _ in range(max(repeats, 1)))
+    t_large = min(timed_run(step_fn, 4 * n) for _ in range(max(repeats, 1)))
+    return max(t_large - t_small, 1e-9) / (3 * n) * 1000.0

@@ -1,0 +1,133 @@
+"""Numeric gradient checks for the api-level cost zoo (nce, hsigmoid,
+rank/lambda, huber, ctc-through-api, crf-through-api) — the
+test_LayerGrad.cpp discipline applied at the declarative layer level,
+where param creation and wiring can introduce bugs the ops-level checks
+miss."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.api as api
+from paddle_tpu.api import layer
+from paddle_tpu.api.graph import reset_names
+import paddle_tpu.nn as nn
+from paddle_tpu.testing import check_grad_params
+
+RS = np.random.RandomState(7)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_names()
+    yield
+
+
+def _check_cost(cost, batch, rng_needed=False):
+    model_fn = api.compile_model(cost)
+    model = nn.transform(lambda b: model_fn(b))
+    params, state = model.init(jax.random.key(0), batch)
+
+    def loss(p):
+        # fixed rng key: nce's noise sampling must be deterministic
+        # across finite-difference evaluations
+        (l, _), _ = model.apply(p, state,
+                                jax.random.key(1) if rng_needed else None,
+                                batch, train=True)
+        return l
+
+    check_grad_params(loss, params, max_elems_per_leaf=6)
+
+
+def test_nce_cost_grads():
+    b, d, classes = 6, 5, 12
+    batch = {"x": RS.randn(b, d).astype(np.float32),
+             "y": RS.randint(0, classes, b).astype(np.int32)}
+    h = layer.fc(layer.data("x"), size=d, act="tanh", name="h")
+    cost = layer.nce_cost(h, layer.data("y", dtype="int32"),
+                          num_classes=classes, num_neg_samples=4)
+    _check_cost(cost, batch, rng_needed=True)
+
+
+def test_hsigmoid_cost_grads():
+    b, d, classes = 5, 4, 9
+    batch = {"x": RS.randn(b, d).astype(np.float32),
+             "y": RS.randint(0, classes, b).astype(np.int32)}
+    h = layer.fc(layer.data("x"), size=d, act="tanh", name="h")
+    cost = layer.hsigmoid_cost(h, layer.data("y", dtype="int32"),
+                               num_classes=classes)
+    _check_cost(cost, batch)
+
+
+def test_rank_cost_grads():
+    b = 6
+    batch = {"l": RS.randn(b, 3).astype(np.float32),
+             "r": RS.randn(b, 3).astype(np.float32),
+             "y": RS.randint(0, 2, b).astype(np.float32)}
+    left = layer.fc(layer.data("l"), size=1, name="fl")
+    right = layer.fc(layer.data("r"), size=1, name="fr")
+    _check_cost(layer.rank_cost(left, right, layer.data("y")), batch)
+
+
+def test_lambda_cost_grads():
+    b, t = 3, 5
+    batch = {"q": RS.randn(b, t, 4).astype(np.float32),
+             "q_mask": np.ones((b, t), bool),
+             "rel": RS.randint(0, 3, (b, t)).astype(np.float32)}
+    scores = layer.fc(layer.data("q", sequence=True), size=1, name="sc")
+    _check_cost(layer.lambda_cost(scores, layer.data("rel"), ndcg_num=3),
+                batch)
+
+
+def test_huber_costs_grads():
+    b = 5
+    batch = {"x": RS.randn(b, 4).astype(np.float32),
+             "yv": RS.randn(b, 2).astype(np.float32),
+             "ypm": (RS.randint(0, 2, (b, 1)) * 2 - 1).astype(np.float32)}
+    pred2 = layer.fc(layer.data("x"), size=2, name="p2")
+    pred1 = layer.fc(layer.data("x"), size=1, name="p1")
+    _check_cost(layer.huber_regression_cost(pred2, layer.data("yv")), batch)
+    reset_names()
+    _check_cost(layer.huber_classification_cost(pred1, layer.data("ypm")),
+                batch)
+
+
+def test_ctc_cost_grads():
+    b, t, lt, nc = 2, 6, 2, 4
+    batch = {"x": RS.randn(b, t, 3).astype(np.float32),
+             "x_mask": np.ones((b, t), bool),
+             "lab": RS.randint(1, nc, (b, lt)).astype(np.int32),
+             "lab_mask": np.ones((b, lt), bool)}
+    logits = layer.fc(layer.data("x", sequence=True), size=nc, name="f")
+    _check_cost(layer.ctc_cost(logits, layer.data("lab", sequence=True)),
+                batch)
+
+
+def test_crf_cost_grads():
+    b, t, k = 2, 5, 4
+    batch = {"x": RS.randn(b, t, 6).astype(np.float32),
+             "x_mask": np.arange(t)[None, :] < np.asarray([5, 3])[:, None],
+             "tags": RS.randint(0, k, (b, t)).astype(np.int32)}
+    em = layer.fc(layer.data("x", sequence=True), size=k, name="em")
+    _check_cost(layer.crf_cost(em, layer.data("tags", dtype="int32"),
+                               num_tags=k), batch)
+
+
+def test_recurrent_group_param_grads():
+    """Gradcheck through the scan-based recurrent group (BPTT)."""
+    b, t, d, h = 2, 4, 3, 3
+    batch = {"x": RS.randn(b, t, d).astype(np.float32),
+             "x_mask": np.ones((b, t), bool),
+             "y": RS.randn(b, h).astype(np.float32)}
+    seq = layer.data("x", sequence=True)
+
+    def step(x_t):
+        mem = api.memory(name="gh", size=h)
+        return layer.fc(layer.concat([x_t, mem]), size=h, act="tanh",
+                        name="gh")
+
+    out = api.recurrent_group(step=step, input=seq)
+    cost = layer.square_error_cost(layer.last_seq(out), layer.data("y"))
+    _check_cost(cost, batch)
