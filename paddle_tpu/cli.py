@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict
@@ -150,6 +151,53 @@ def cmd_checkgrad(args):
                       "params": len(jax.tree_util.tree_leaves(params))}))
 
 
+def cmd_master(args):
+    """Run the native task-dispatch master standalone (go/cmd/master twin):
+    serves GetTask/TaskFinished/TaskFailed over TCP with timeout+retry
+    queues and optional snapshot recovery."""
+    import signal as _signal
+    from paddle_tpu.distributed.master import Master, MasterServer
+
+    # Master restores from snapshot_path in __init__ (and snapshots on its
+    # own ack/interval cadence — not per tick, which would be constant IO).
+    restored = bool(args.snapshot and os.path.exists(args.snapshot))
+    master = Master(timeout_s=args.task_timeout,
+                    max_failures=args.max_failures,
+                    snapshot_path=args.snapshot)
+    if restored:
+        print(json.dumps({"restored": args.snapshot}), flush=True)
+    elif args.files:
+        # set_tasks resets ALL queues — only on a fresh start, never after
+        # a snapshot restore (it would wipe completed work).
+        payloads = [p.encode() for p in args.files.split(",") if p]
+        master.set_tasks(payloads)
+    server = MasterServer(master, host=args.host, port=args.port)
+
+    # Handlers BEFORE the readiness line: a supervisor may TERM us the
+    # moment it has read the address, and the default action would skip
+    # the final snapshot.
+    stop = {"flag": False}
+
+    def _on_term(signum, frame):
+        stop["flag"] = True
+
+    _signal.signal(_signal.SIGTERM, _on_term)
+    _signal.signal(_signal.SIGINT, _on_term)
+
+    host, port = server.address[0], server.address[1]
+    print(json.dumps({"listening": f"{host}:{port}",
+                      "tasks": master.counts()}), flush=True)
+    try:
+        while not stop["flag"]:
+            time.sleep(1.0)
+            master.tick()  # requeue timed-out tasks
+    finally:
+        if args.snapshot:
+            master.snapshot(args.snapshot)  # final state on shutdown
+        server.close()
+        master.close()
+
+
 def cmd_merge_model(args):
     from paddle_tpu import inference
     from paddle_tpu.training import checkpoint as ckpt_lib
@@ -203,6 +251,18 @@ def main(argv=None):
     p.add_argument("--eps", type=float, default=1e-3)
     p.add_argument("--elems", type=int, default=8)
     p.set_defaults(fn=cmd_checkgrad)
+
+    p = sub.add_parser("master",
+                       help="standalone task-dispatch master (go master twin)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--files", default="",
+                   help="comma-separated task payloads (e.g. shard paths)")
+    p.add_argument("--task-timeout", type=float, default=60.0)
+    p.add_argument("--max-failures", type=int, default=3)
+    p.add_argument("--snapshot", default=None,
+                   help="snapshot file for crash recovery")
+    p.set_defaults(fn=cmd_master)
 
     p = sub.add_parser("merge_model", help="export checkpoint for serving")
     common(p)

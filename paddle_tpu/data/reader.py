@@ -55,6 +55,36 @@ def chain(*readers: Reader) -> Reader:
     return reader
 
 
+def mix(readers_with_ratios) -> Reader:
+    """Interleave readers in sample-count proportion (MultiDataProvider
+    twin, ``gserver/dataproviders/MultiDataProvider.cpp``: ratio-mixed
+    sub-providers).  ``readers_with_ratios``: [(reader, ratio), ...];
+    exhausted readers drop out, iteration ends when all are done."""
+    pairs = list(readers_with_ratios)
+    for _, w in pairs:
+        if not w > 0:
+            raise ValueError(f"mix: ratios must be positive, got {w!r}")
+
+    def reader():
+        its = [iter(r()) for r, _ in pairs]
+        ratios = [float(w) for _, w in pairs]
+        credit = [0.0] * len(its)
+        alive = [True] * len(its)
+        while any(alive):
+            for i, it in enumerate(its):
+                if not alive[i]:
+                    continue
+                credit[i] += ratios[i]
+                while credit[i] >= 1.0 and alive[i]:
+                    try:
+                        yield next(it)
+                    except StopIteration:
+                        alive[i] = False
+                        break
+                    credit[i] -= 1.0
+    return reader
+
+
 def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
     """Zip readers into combined tuples (decorator.py compose:120).
 

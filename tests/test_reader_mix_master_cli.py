@@ -1,0 +1,108 @@
+"""reader.mix ratio semantics + the standalone master CLI subcommand."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.data import reader as rd
+
+
+def test_mix_ratio_proportions():
+    a = rd.np_array(list(range(100)))           # tagged by value < 100
+    b = rd.np_array(list(range(100, 160)))
+    mixed = list(rd.mix([(a, 2), (b, 1)])())
+    # all samples appear exactly once
+    assert sorted(int(x) for x in mixed) == list(range(160))
+    # in the first 30 samples the 2:1 ratio holds
+    head = mixed[:30]
+    n_a = sum(1 for x in head if int(x) < 100)
+    assert 18 <= n_a <= 22
+
+
+def test_mix_exhausted_reader_drops_out():
+    a = rd.np_array([1, 2])
+    b = rd.np_array([10, 20, 30, 40, 50, 60])
+    mixed = list(rd.mix([(a, 1), (b, 1)])())
+    assert sorted(int(x) for x in mixed) == [1, 2, 10, 20, 30, 40, 50, 60]
+
+
+def test_mix_rejects_nonpositive_ratio():
+    with pytest.raises(ValueError):
+        rd.mix([(rd.np_array([1]), 0)])
+
+
+def _run_master(tmp_path, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "master", "--host", "127.0.0.1",
+         "--files", "shard-a,shard-b,shard-c",
+         "--snapshot", str(tmp_path / "snap.bin"), *extra],
+        stdout=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    lines = [json.loads(proc.stdout.readline())]
+    if "restored" in lines[0]:
+        lines.append(json.loads(proc.stdout.readline()))
+    return proc, lines
+
+
+def test_master_cli_restore_keeps_completed_work(tmp_path):
+    """Kill the master after finishing one task; a restarted master with
+    the same --files must RESTORE (not reset) — completed work stays done
+    (regression: set_tasks after restore wiped the queues)."""
+    from paddle_tpu.distributed.master import MasterClient
+    proc, lines = _run_master(tmp_path)
+    try:
+        host, port = lines[-1]["listening"].rsplit(":", 1)
+        client = MasterClient((host, int(port)))
+        tid, payload = client.get_task()
+        assert client.task_finished(tid)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+
+    proc2, lines2 = _run_master(tmp_path)
+    try:
+        assert "restored" in lines2[0]
+        info = lines2[-1]
+        assert info["tasks"]["done"] == 1
+        assert info["tasks"]["todo"] == 2
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        proc2.wait(timeout=15)
+
+
+def test_master_cli_serves_tasks(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "master", "--host", "127.0.0.1",
+         "--files", "shard-a,shard-b,shard-c",
+         "--snapshot", str(tmp_path / "snap.bin")],
+        stdout=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        addr = info["listening"]
+        host, port = addr.rsplit(":", 1)
+        assert info["tasks"]["todo"] == 3
+
+        from paddle_tpu.distributed.master import MasterClient
+        client = MasterClient((host, int(port)), trainer=0)
+        got = set()
+        for _ in range(3):
+            task_id, payload = client.get_task()
+            got.add(payload.decode())
+            assert client.task_finished(task_id)
+        assert got == {"shard-a", "shard-b", "shard-c"}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    assert (tmp_path / "snap.bin").exists()  # final snapshot written
