@@ -295,6 +295,8 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
 
 def beam_search(step: Callable, input, bos_id: int, eos_id: int,
                 beam_size: int = 5, max_length: int = 50,
+                candidate_adjust_fn: Optional[Callable] = None,
+                stop_fn: Optional[Callable] = None,
                 name: Optional[str] = None):
     """Beam-search sequence generation (layer.beam_search twin).
 
@@ -389,7 +391,8 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
 
         ids, scores = bs.beam_search(
             step_fn, state, batch_size=bsz, beam_size=beam_size,
-            max_len=max_length, bos_id=bos_id, eos_id=eos_id)
+            max_len=max_length, bos_id=bos_id, eos_id=eos_id,
+            candidate_adjust_fn=candidate_adjust_fn, stop_fn=stop_fn)
         ctx.outputs[f"{gname}_ids"] = ids
         ctx.outputs[f"{gname}_scores"] = scores
         return ids

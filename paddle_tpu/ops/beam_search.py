@@ -17,7 +17,7 @@ with leading dim ``batch*beam`` — one decoder step.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,10 +45,25 @@ def _unflatten_beam(x, b, k):
 def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
                 beam_size: int, max_len: int, bos_id: int, eos_id: int,
                 length_penalty: float = 0.0,
-                vocab_size: int = None) -> Tuple[jax.Array, jax.Array]:
+                vocab_size: int = None,
+                candidate_adjust_fn: Optional[Callable] = None,
+                stop_fn: Optional[Callable] = None
+                ) -> Tuple[jax.Array, jax.Array]:
     """Run beam search; returns (sequences [b, k, max_len], scores [b, k])
     sorted best-first.  ``init_state`` leaves must have leading dim
     ``batch_size`` (they are tiled to beams internally).
+
+    User hooks (the RecurrentGradientMachine callback twins,
+    ``RecurrentGradientMachine.h:73-188``):
+
+    * ``candidate_adjust_fn(logprobs [b, k, v], step) -> logprobs`` —
+      rewrite candidate scores before expansion (beamSearchCandidateAdjust;
+      e.g. ban tokens by adding ``NEG_INF``, apply coverage bonuses).
+    * ``stop_fn(alive_seq [b, k, max_len], alive_logp [b, k], step) ->
+      scalar bool`` — early-stop the whole search (stopBeamSearch).
+      ``alive_seq`` is the full static buffer (eos fill past the current
+      position; the newest token sits at index ``step``); non-scalar
+      returns are ``any()``-reduced.
     """
     b, k = batch_size, beam_size
 
@@ -65,7 +80,12 @@ def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
     finished = jnp.zeros((b, k), bool)
 
     def cond(s: BeamState):
-        return (s.step < max_len - 1) & ~jnp.all(s.finished)
+        go = (s.step < max_len - 1) & ~jnp.all(s.finished)
+        if stop_fn is not None:
+            stop = jnp.any(jnp.asarray(stop_fn(s.alive_seq, s.alive_logp,
+                                               s.step), bool))
+            go = go & jnp.logical_not(stop)
+        return go
 
     def body(s: BeamState):
         last_ids = jnp.take_along_axis(
@@ -74,6 +94,9 @@ def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
         logprobs, new_state = step_fn(_flatten_beam(last_ids), s.state)
         v = logprobs.shape[-1]
         logprobs = _unflatten_beam(logprobs, b, k)  # [b, k, v]
+
+        if candidate_adjust_fn is not None:
+            logprobs = candidate_adjust_fn(logprobs, s.step)
 
         # finished beams: only allow emitting eos with prob 1 (freeze)
         freeze = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)

@@ -461,3 +461,37 @@ def test_simple_attention_composite():
     ctx_v = networks.simple_attention(enc, enc, st)
     cost = layer.square_error_cost(ctx_v, layer.data("y"))
     _run_cost(cost, batch)
+
+
+def test_beam_search_hooks():
+    """candidate_adjust_fn can ban tokens; stop_fn ends the search early
+    (RecurrentGM beamSearchCandidateAdjust/stopBeamSearch twins)."""
+    from paddle_tpu.ops import beam_search as bs
+
+    b, k, v = 2, 3, 8
+    rs = np.random.RandomState(0)
+    table = jnp.asarray(rs.randn(v, v), jnp.float32)
+
+    def step_fn(last_ids, state):
+        logits = jnp.take(table, last_ids, axis=0)
+        return jax.nn.log_softmax(logits), state
+
+    banned = 5
+
+    def adjust(logprobs, step):
+        return logprobs.at[:, :, banned].set(-1e9)
+
+    ids, scores = bs.beam_search(step_fn, {"d": jnp.zeros((b, 1))},
+                                 batch_size=b, beam_size=k, max_len=9,
+                                 bos_id=0, eos_id=1,
+                                 candidate_adjust_fn=adjust)
+    assert not np.any(np.asarray(ids) == banned)
+
+    def stop_after_3(alive_seq, alive_logp, step):
+        return step >= 3
+
+    ids2, _ = bs.beam_search(step_fn, {"d": jnp.zeros((b, 1))},
+                             batch_size=b, beam_size=k, max_len=20,
+                             bos_id=0, eos_id=1, stop_fn=stop_after_3)
+    # stop at step>=3: bodies run for steps 0-2, last written position 3
+    assert np.all(np.asarray(ids2)[:, :, 4:] == 1)
