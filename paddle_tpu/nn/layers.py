@@ -207,7 +207,10 @@ class BatchNorm(Module):
         if is_training():
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
+            # two-pass variance: E[x^2]-E[x]^2 cancels catastrophically in
+            # f32 for large-mean/small-spread channels (negative var ->
+            # rsqrt NaN, persisted into moving_var)
+            var = jnp.var(xf, axis=reduce_axes)
             from paddle_tpu.nn.module import set_state
             m = self.momentum
             set_state("moving_mean", m * mean_s + (1 - m) * mean)
@@ -216,9 +219,15 @@ class BatchNorm(Module):
             mean, var = mean_s, var_s
         shape = [1] * x.ndim
         shape[self.axis % x.ndim] = dim
-        inv = lax.rsqrt(var + self.epsilon) * gamma
-        y = (x - mean.reshape(shape)) * inv.reshape(shape) + beta.reshape(shape)
-        return self.act(y.astype(x.dtype))
+        # Statistics stay f32; the normalization itself applies in the
+        # activation dtype — under bf16 compute an f32 apply would double
+        # the VPU + HBM cost of the hottest elementwise op in conv nets
+        # (and its backward).
+        inv = (lax.rsqrt(var + self.epsilon)
+               * gamma.astype(jnp.float32)).astype(x.dtype)
+        y = ((x - mean.astype(x.dtype).reshape(shape))
+             * inv.reshape(shape) + beta.astype(x.dtype).reshape(shape))
+        return self.act(y)
 
 
 class LayerNorm(Module):
