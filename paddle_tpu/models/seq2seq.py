@@ -160,6 +160,21 @@ class Seq2SeqAttention(Module):
                               max_len=max_len, bos_id=bos_id, eos_id=eos_id)
 
 
+def generate_fn_builder(src_vocab: int, tgt_vocab: int, beam_size: int = 5,
+                        max_len: int = 50, bos_id: int = 0, eos_id: int = 1,
+                        **kwargs):
+    """Generation entry sharing the TRAINED parameter paths: the net is
+    invoked under the same "s2s" scope as model_fn_builder (via
+    Module.scoped), so ``nn.transform(generate_fn).apply(trained_params,
+    ...)`` works directly — the SequenceGenerator-over-trained-model
+    workflow."""
+    def generate_fn(src, src_mask):
+        net = Seq2SeqAttention(src_vocab, tgt_vocab, name="s2s", **kwargs)
+        return net.scoped("generate", src, src_mask, beam_size=beam_size,
+                          max_len=max_len, bos_id=bos_id, eos_id=eos_id)
+    return generate_fn
+
+
 def model_fn_builder(src_vocab: int, tgt_vocab: int, **kwargs):
     def model_fn(batch):
         net = Seq2SeqAttention(src_vocab, tgt_vocab, name="s2s", **kwargs)

@@ -265,11 +265,22 @@ class Module:
         return name
 
     def __call__(self, *args, **kwargs):
+        return self.scoped("forward", *args, **kwargs)
+
+    def scoped(self, method: str, *args, **kwargs):
+        """Invoke a non-``forward`` method under this module's name scope.
+
+        ``__call__`` pushes the module's scope before ``forward``; alternate
+        entry points (``generate``, ``decode``...) invoked directly would
+        create/look up parameters at the WRONG paths and silently not share
+        weights with the trained model.  ``net.scoped("generate", ...)``
+        gives them the same scope as training.
+        """
         frame = current_frame()
         name = self._scope_name(frame)
         frame.scope.append(name)
         try:
-            return self.forward(*args, **kwargs)
+            return getattr(self, method)(*args, **kwargs)
         finally:
             frame.scope.pop()
 

@@ -116,3 +116,36 @@ def test_beam_search_respects_eos_freeze():
     second = seqs[0, 1]
     assert (second[1:] == 3).all()
     np.testing.assert_allclose(scores[0, 1], 4 * np.log(0.5), rtol=1e-5)
+
+
+def test_generate_shares_trained_params():
+    """generate_fn_builder must consume the TRAINING transform's param tree
+    directly (regression: direct .generate() bypassed the module scope and
+    created parameters at different paths)."""
+    from paddle_tpu.models.seq2seq import (generate_fn_builder,
+                                           model_fn_builder)
+    from paddle_tpu import optim
+    from paddle_tpu.training import Trainer
+
+    rs = np.random.RandomState(0)
+    b, t = 4, 6
+    batch = {
+        "src": rs.randint(3, VOCAB, (b, t)).astype(np.int32),
+        "src_mask": np.ones((b, t), bool),
+        "tgt_in": rs.randint(3, VOCAB, (b, t)).astype(np.int32),
+        "tgt_out": rs.randint(3, VOCAB, (b, t)).astype(np.int32),
+        "tgt_mask": np.ones((b, t), bool),
+    }
+    tr = Trainer(model_fn_builder(VOCAB, VOCAB, embed_dim=16, hidden=16),
+                 optim.sgd(0.1))
+    tr.init(batch)
+    tr.train_batch(batch)
+
+    gen = nn.transform(generate_fn_builder(
+        VOCAB, VOCAB, beam_size=2, max_len=7, bos_id=BOS, eos_id=EOS,
+        embed_dim=16, hidden=16))
+    (ids, scores), _ = gen.apply(tr.params, {}, None,
+                                 jnp.asarray(batch["src"]),
+                                 jnp.asarray(batch["src_mask"]))
+    assert np.asarray(ids).shape == (b, 2, 7)
+    assert (np.asarray(ids)[:, :, 0] == BOS).all()
