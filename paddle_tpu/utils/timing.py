@@ -32,9 +32,20 @@ def timed_run(step_fn: Callable[[], object], n: int) -> float:
 
 def marginal_ms_per_batch(step_fn: Callable[[], object], n: int = 10,
                           repeats: int = 2) -> float:
-    """Differential timing: ``(T(4n) - T(n)) / 3n`` ms, best of
-    ``repeats`` for each arm."""
+    """Differential timing: median over ``repeats`` of paired
+    ``(T(4n) - T(n)) / 3n`` ms.
+
+    The arms of each difference run back-to-back (paired) so slow-drifting
+    transport congestion cancels; taking independent minima per arm would
+    let a lucky window on one arm fabricate an arbitrarily small (or
+    large) difference."""
     n = max(n, 1)
-    t_small = min(timed_run(step_fn, n) for _ in range(max(repeats, 1)))
-    t_large = min(timed_run(step_fn, 4 * n) for _ in range(max(repeats, 1)))
-    return max(t_large - t_small, 1e-9) / (3 * n) * 1000.0
+    diffs = []
+    for _ in range(max(repeats, 1)):
+        t_small = timed_run(step_fn, n)
+        t_large = timed_run(step_fn, 4 * n)
+        diffs.append(max(t_large - t_small, 1e-9) / (3 * n) * 1000.0)
+    diffs.sort()
+    m = len(diffs)
+    return (diffs[m // 2] if m % 2 else
+            0.5 * (diffs[m // 2 - 1] + diffs[m // 2]))
