@@ -59,10 +59,9 @@ def main():
         # burn-in (compile + warm transport), TrainerBenchmark.cpp style
         timed_run(step_fn, 10)
 
-        # extra repeats: the min-of-arms subtraction is what
-        # rejects transport jitter on tunneled attachments
-        ms_per_batch = marginal_ms_per_batch(step_fn, n=10,
-                                             repeats=4)
+        # repeats beyond the default: the paired-difference median is
+        # what rejects transport jitter on tunneled attachments
+        ms_per_batch = marginal_ms_per_batch(step_fn, n=10, repeats=5)
 
     baseline_ms = 83.0  # K40m, BASELINE.md RNN table (h=256 bs=64)
     print(json.dumps({

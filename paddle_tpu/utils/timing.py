@@ -13,6 +13,7 @@ CLI's ``time`` job so the protocol cannot drift between them.
 
 from __future__ import annotations
 
+import statistics
 import time
 from typing import Callable, Iterator, Tuple
 
@@ -31,21 +32,21 @@ def timed_run(step_fn: Callable[[], object], n: int) -> float:
 
 
 def marginal_ms_per_batch(step_fn: Callable[[], object], n: int = 10,
-                          repeats: int = 2) -> float:
+                          repeats: int = 3) -> float:
     """Differential timing: median over ``repeats`` of paired
     ``(T(4n) - T(n)) / 3n`` ms.
 
     The arms of each difference run back-to-back (paired) so slow-drifting
     transport congestion cancels; taking independent minima per arm would
     let a lucky window on one arm fabricate an arbitrarily small (or
-    large) difference."""
+    large) difference.  Negative per-pair diffs (jitter spikes on the
+    small arm) stay in the sample so they cancel in the median; only the
+    final result is floored.  Odd default ``repeats`` keeps the median a
+    real order statistic."""
     n = max(n, 1)
     diffs = []
     for _ in range(max(repeats, 1)):
         t_small = timed_run(step_fn, n)
         t_large = timed_run(step_fn, 4 * n)
-        diffs.append(max(t_large - t_small, 1e-9) / (3 * n) * 1000.0)
-    diffs.sort()
-    m = len(diffs)
-    return (diffs[m // 2] if m % 2 else
-            0.5 * (diffs[m // 2 - 1] + diffs[m // 2]))
+        diffs.append((t_large - t_small) / (3 * n) * 1000.0)
+    return max(statistics.median(diffs), 1e-9)
