@@ -26,9 +26,14 @@ from paddle_tpu.ops import sequence as seq_ops
 
 
 def _node(kind, fn, inputs, name=None, **attrs):
-    return LayerOutput(name=auto_name(kind, name), kind=kind, fn=fn,
+    node = LayerOutput(name=auto_name(kind, name), kind=kind, fn=fn,
                        inputs=tuple(inputs),
                        attrs=tuple(sorted(attrs.items())))
+    # Inside a recurrent_group step trace, register the node so memory()
+    # can link to it even when it is not a group output.
+    from paddle_tpu.api import recurrent as _rec
+    _rec._register_node(node)
+    return node
 
 
 def _is_seq(v) -> bool:
@@ -508,9 +513,11 @@ def mixed(inputs: Sequence[LayerOutput], projections, act: str = "linear",
     """Sum-of-projections layer (mixed_layer twin, MixedLayer.cpp);
     ``projections`` are ``nn`` projection modules, one per input."""
     def run(ctx, *xs, **a):
-        return nn.Mixed(list(a["_projections"]), act=a["act"],
-                        bias=a["bias"], name=a["_name"])(
+        y = nn.Mixed(list(a["_projections"]), act=a["act"],
+                     bias=a["bias"], name=a["_name"])(
             *[_val(x) for x in xs])
+        masks = [_mask(x) for x in xs if _mask(x) is not None]
+        return (y, masks[0]) if masks else y
     n = auto_name("mixed", name)
     return _node("mixed", run, list(inputs), name=n, act=act, bias=bias,
                  _name=n, _projections=tuple(projections))
@@ -736,3 +743,454 @@ def print_layer(input, label: str = "", name: Optional[str] = None):
         jax.debug.print(safe + " {}", _val(x))
         return x
     return _node("print", run, [input], name=name, label=label or "print")
+
+
+# ---- remaining registered-layer twins (completeness sweep) -----------------
+
+def prelu(input, init_slope: float = 0.25, name: Optional[str] = None):
+    """Parametric ReLU (prelu_layer twin, PReluLayer)."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.PReLU(a["init_slope"], name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("prelu", name)
+    return _node("prelu", run, [input], name=n, init_slope=init_slope,
+                 _name=n)
+
+
+def clip(input, min: float, max: float, name: Optional[str] = None):
+    """Elementwise clamp (clip_layer twin, ClipLayer)."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = jnp.clip(_val(x), a["min_v"], a["max_v"])
+        return (y, m) if m is not None else y
+    return _node("clip", run, [input], name=name, min_v=min, max_v=max)
+
+
+def resize(input, size: int, name: Optional[str] = None):
+    """Reshape each sample batch to rows of width ``size`` (resize_layer
+    twin, ResizeLayer)."""
+    def run(ctx, x, **a):
+        return _val(x).reshape(-1, a["size"])
+    return _node("resize", run, [input], name=name, size=size)
+
+
+def scale_shift(input, bias: bool = True, name: Optional[str] = None):
+    """Scalar learned scale + shift (scale_shift_layer twin)."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.ScaleShift(bias=a["bias"], name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("scale_shift", name)
+    return _node("scale_shift", run, [input], name=n, bias=bias, _name=n)
+
+
+def row_l2_norm(input, name: Optional[str] = None):
+    """Row-wise L2 normalization (row_l2_norm_layer twin)."""
+    def run(ctx, x):
+        m = _mask(x)
+        y = nn.RowL2Norm()(_val(x))
+        return (y, m) if m is not None else y
+    return _node("row_l2_norm", run, [input], name=name)
+
+
+def cross_channel_norm(input, name: Optional[str] = None):
+    """L2 normalize across channels with learned per-channel scale
+    (cross_channel_norm_layer twin — SSD's Normalize)."""
+    def run(ctx, x, **a):
+        return nn.CrossChannelNorm(name=a["_name"])(x)
+    n = auto_name("cross_channel_norm", name)
+    return _node("cross_channel_norm", run, [input], name=n, _name=n)
+
+
+def out_prod(input_a, input_b, name: Optional[str] = None):
+    """Flattened outer product (out_prod_layer twin, OuterProdLayer)."""
+    def run(ctx, x, y):
+        return nn.OutProd()(_val(x), _val(y))
+    return _node("out_prod", run, [input_a, input_b], name=name)
+
+
+def tensor(input_a, input_b, size: int, act: str = "linear",
+           bias: bool = True, name: Optional[str] = None):
+    """Bilinear tensor product layer (tensor_layer twin, TensorLayer)."""
+    def run(ctx, x, y, **a):
+        return nn.TensorLayer(a["size"], act=a["act"], bias=a["bias"],
+                              name=a["_name"])(_val(x), _val(y))
+    n = auto_name("tensor", name)
+    return _node("tensor", run, [input_a, input_b], name=n, size=size,
+                 act=act, bias=bias, _name=n)
+
+
+def gated_unit(input, size: int, act: str = "linear",
+               name: Optional[str] = None):
+    """act(xW) * sigmoid(xW_g) (gated_unit_layer twin)."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.GatedUnit(a["size"], act=a["act"], name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("gated_unit", name)
+    return _node("gated_unit", run, [input], name=n, size=size, act=act,
+                 _name=n)
+
+
+def conv_shift(input_a, input_b, name: Optional[str] = None):
+    """Circular correlation (conv_shift_layer twin, ConvShiftLayer)."""
+    def run(ctx, x, y):
+        return nn.ConvShift()(_val(x), _val(y))
+    return _node("conv_shift", run, [input_a, input_b], name=name)
+
+
+def row_conv(input, future_steps: int, name: Optional[str] = None):
+    """Lookahead row convolution over a sequence (row_conv_layer twin,
+    RowConvLayer — the DeepSpeech2 op)."""
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "row_conv needs a sequence input")
+        v, m = x
+        # zero padding frames FIRST: the lookahead window at positions
+        # near a sequence end must not read garbage beyond the length
+        # (the reference RowConvOp truncates context at the boundary)
+        v = jnp.where(m[..., None], v, 0.0)
+        y = nn.RowConv(a["future_steps"], name=a["_name"])(v)
+        y = jnp.where(m[..., None], y, 0.0)
+        return (y, m)
+    n = auto_name("row_conv", name)
+    return _node("row_conv", run, [input], name=n,
+                 future_steps=future_steps, _name=n)
+
+
+def switch_order(input, perm, name: Optional[str] = None):
+    """Dimension permutation (switch_order_layer twin, SwitchOrderLayer)."""
+    def run(ctx, x, **a):
+        return nn.SwitchOrder(a["perm"])(_val(x))
+    return _node("switch_order", run, [input], name=name, perm=tuple(perm))
+
+
+def img_conv3d(input, channels: int, kernel=3, stride=1, act: str = "relu",
+               padding="SAME", name: Optional[str] = None):
+    """3-D convolution, NDHWC (img_conv3d_layer twin, Conv3DLayer)."""
+    def run(ctx, x, **a):
+        return nn.Conv3D(a["channels"], a["kernel"], stride=a["stride"],
+                         padding=a["padding"], act=a["act"],
+                         name=a["_name"])(x)
+    n = auto_name("img_conv3d", name)
+    return _node("img_conv3d", run, [input], name=n, channels=channels,
+                 kernel=kernel, stride=stride, act=act, padding=padding,
+                 _name=n)
+
+
+def img_pool3d(input, kernel=2, stride=None, pool_type: str = "max",
+               name: Optional[str] = None):
+    """3-D pooling (img_pool3d_layer twin, Pool3DLayer)."""
+    def run(ctx, x, **a):
+        return nn.Pool3D(a["kernel"], stride=a["stride"],
+                         pool_type=a["pool_type"])(x)
+    return _node("img_pool3d", run, [input], name=name, kernel=kernel,
+                 stride=stride, pool_type=pool_type)
+
+
+def get_output(input, arg_name: str, name: Optional[str] = None):
+    """Fetch a named auxiliary output of a multi-output layer
+    (get_output_layer twin, GetOutputLayer): e.g. the cell state of
+    ``lstm_step`` via ``arg_name="state"``."""
+    def run(ctx, x, **a):
+        key = f"{a['_src']}:{a['arg_name']}"
+        enforce(key in ctx.outputs,
+                "get_output: no auxiliary output %r (have %s)", key,
+                sorted(ctx.outputs))
+        return ctx.outputs[key]
+    return _node("get_output", run, [input], name=name, arg_name=arg_name,
+                 _src=input.name)
+
+
+def lstm_step(input, state, size: int, act: str = "tanh",
+              gate_act: str = "sigmoid", name: Optional[str] = None):
+    """One LSTM step for use inside ``recurrent_group`` (lstm_step_layer
+    twin, LstmStepLayer): ``input`` is the pre-computed 4h gate
+    projection, ``state`` the previous cell (a ``memory``).  Returns the
+    hidden; fetch the new cell with ``get_output(h, "state")``."""
+    from paddle_tpu.ops import activations as act_ops
+    def run(ctx, gates, c_prev, **a):
+        h = a["size"]
+        g = _val(gates)
+        enforce(g.shape[-1] == 4 * h,
+                "lstm_step input must be 4*size gates, got %d", g.shape[-1])
+        ga = act_ops.get(a["gate_act"])
+        av = act_ops.get(a["act"])
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        c = ga(f) * _val(c_prev) + ga(i) * av(gg)
+        hh = ga(o) * av(c)
+        ctx.outputs[f"{a['_name']}:state"] = c
+        return hh
+    n = auto_name("lstm_step", name)
+    return _node("lstm_step", run, [input, state], name=n, size=size,
+                 act=act, gate_act=gate_act, _name=n)
+
+
+def gru_step(input, output_mem, size: int, act: str = "tanh",
+             gate_act: str = "sigmoid", name: Optional[str] = None):
+    """One GRU step for ``recurrent_group`` (gru_step_layer twin,
+    GruStepLayer): ``input`` is the 3h projection of x, ``output_mem``
+    the previous hidden (a ``memory``)."""
+    from paddle_tpu.ops import activations as act_ops
+    def run(ctx, gates, h_prev, **a):
+        h = a["size"]
+        g = _val(gates)
+        enforce(g.shape[-1] == 3 * h,
+                "gru_step input must be 3*size gates, got %d", g.shape[-1])
+        from paddle_tpu.core.dtypes import get_policy
+        from paddle_tpu.nn.module import param
+        from paddle_tpu.nn import initializers as init
+        from paddle_tpu.nn.recurrent import gru_cell
+        policy = get_policy()
+        w_hz = param(f"{a['_name']}/w_hz", (h, 2 * h), policy.param_dtype,
+                     init.paddle_default())
+        w_hc = param(f"{a['_name']}/w_hc", (h, h), policy.param_dtype,
+                     init.paddle_default())
+        return gru_cell(g, _val(h_prev), policy.cast_to_compute(w_hz),
+                        policy.cast_to_compute(w_hc),
+                        act_ops.get(a["act"]), act_ops.get(a["gate_act"]),
+                        policy)
+    n = auto_name("gru_step", name)
+    return _node("gru_step", run, [input, output_mem], name=n, size=size,
+                 act=act, gate_act=gate_act, _name=n)
+
+
+def gru_step_naive(input, output_mem, size: int, act: str = "tanh",
+                   gate_act: str = "sigmoid", name: Optional[str] = None):
+    """Unfused-reference-equivalent GRU step (gru_step_naive_layer twin)
+    — numerically identical to :func:`gru_step` here, since XLA fuses
+    either form the same way."""
+    return gru_step(input, output_mem, size, act, gate_act, name)
+
+
+# ---- projection / operator constructors for mixed() ------------------------
+
+def full_matrix_projection(size: int):
+    """x @ W projection (full_matrix_projection twin)."""
+    return nn.FullMatrixProjection(size)
+
+
+def trans_full_matrix_projection(size: int):
+    """x @ W^T projection (trans_full_matrix_projection twin)."""
+    return nn.TransposedFullMatrixProjection(size)
+
+
+def identity_projection(offset: int = 0, size: Optional[int] = None):
+    """Pass-through / offset projection (identity_projection twin)."""
+    return nn.IdentityProjection(offset=offset, size=size)
+
+
+def table_projection(size: int, vocab_size: int):
+    """Embedding-lookup projection (table_projection twin)."""
+    return nn.TableProjection(size, vocab_size)
+
+
+def scaling_projection():
+    """Learned-scalar projection (scaling_projection twin)."""
+    return nn.ScalingProjection()
+
+
+def dotmul_projection():
+    """Learned elementwise-scale projection (dotmul_projection twin)."""
+    return nn.DotMulProjection()
+
+
+def slice_projection(slices):
+    """Column-slice-concat projection (slice_projection twin)."""
+    return nn.SliceProjection(slices)
+
+
+def conv_projection(channels: int, kernel=3, stride=1, padding="SAME"):
+    """Convolution-as-projection (conv_projection / conv_operator twin,
+    flattened output so it sums with other projections)."""
+    return nn.ConvProjection(channels, kernel, stride, padding)
+
+
+def conv_operator(img, filter, channels: int, kernel: int,
+                  name: Optional[str] = None):
+    """Convolve an image layer with a *filter layer* (conv_operator twin,
+    ConvOperator — the filter comes from the graph, not parameters).
+    ``img`` is NHWC; ``filter`` is [b, kernel*kernel*in_ch*channels],
+    applied per-sample."""
+    def run(ctx, x, w, **a):
+        import jax
+        v, f = _val(x), _val(w)
+        k, c = a["kernel"], a["channels"]
+        in_ch = v.shape[-1]
+        f = f.reshape(f.shape[0], k, k, in_ch, c)
+        def one(img1, w1):
+            return jax.lax.conv_general_dilated(
+                img1[None], w1, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))[0]
+        return jax.vmap(one)(v, f)
+    return _node("conv_operator", run, [img, filter], name=name,
+                 channels=channels, kernel=kernel)
+
+
+def dotmul_operator(input_a, input_b, scale: float = 1.0,
+                    name: Optional[str] = None):
+    """scale * x .* y (dotmul_operator twin, DotMulOperator)."""
+    def run(ctx, x, y, **a):
+        return a["scale"] * _val(x) * _val(y)
+    return _node("dotmul_operator", run, [input_a, input_b], name=name,
+                 scale=scale)
+
+
+# ---- remaining cost layers -------------------------------------------------
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha:
+                                float = 0.1, name: Optional[str] = None):
+    """CE plus an alpha * log(Z)^2 self-normalization penalty
+    (cross_entropy_with_selfnorm twin, MultiClassCrossEntropyWithSelfNorm)
+    — keeps the softmax partition function near 1 so inference can skip
+    normalization."""
+    def run(ctx, logits, y, **a):
+        import jax
+        v = _val(logits)
+        log_z = jax.scipy.special.logsumexp(v, axis=-1)
+        ce = loss_ops.softmax_cross_entropy(v, _val(y))
+        _record_label(ctx, v, _val(y))
+        return (ce + a["alpha"] * jnp.square(log_z)).mean()
+    return _node("ce_selfnorm", run, [input, label], name=name,
+                 alpha=softmax_selfnorm_alpha)
+
+
+def cross_entropy_over_beam(beams, name: Optional[str] = None):
+    """Beam-level cross-entropy (cross_entropy_over_beam twin,
+    CrossEntropyOverBeam.cpp): ``beams`` is a list of (scores, gold)
+    node pairs — per-slot candidate scores [b, k] and the gold candidate
+    index [b] (or -1 when the gold fell out of the beam; such slots are
+    skipped, matching the reference's cost-of-dropped-gold = 0).  Items
+    may also be ``BeamInput`` objects (candidate_scores/gold attributes;
+    ``selected_candidates`` is implicit here — scores are already per
+    selected candidate)."""
+    flat = []
+    for beam in beams:
+        if hasattr(beam, "candidate_scores"):
+            s, g = beam.candidate_scores, beam.gold
+        else:
+            s, g = beam
+        flat.extend([s, g])
+    def run(ctx, *vals):
+        total = 0.0
+        count = None
+        for i in range(0, len(vals), 2):
+            scores, gold = _val(vals[i]), _val(vals[i + 1])
+            valid = gold >= 0
+            safe_gold = jnp.where(valid, gold, 0)
+            ce = loss_ops.softmax_cross_entropy(scores, safe_gold)
+            ce = jnp.where(valid, ce, 0.0)
+            total = total + ce.sum()
+            c = valid.sum()
+            count = c if count is None else count + c
+        return total / jnp.maximum(count, 1).astype(jnp.float32)
+    return _node("ce_over_beam", run, flat, name=name)
+
+
+def warp_ctc(input, label, blank: int = 0, name: Optional[str] = None):
+    """warp_ctc_layer twin — same loss as :func:`ctc_cost` (one CTC
+    implementation serves both registrations on TPU)."""
+    return ctc_cost(input, label, blank=blank, name=name)
+
+
+# ---- detection layers ------------------------------------------------------
+
+def priorbox(input, image_hw, min_sizes, max_sizes=(),
+             aspect_ratios=(2.0,), name: Optional[str] = None):
+    """Anchor grid for a feature-map node (priorbox_layer twin,
+    PriorBoxLayer): emits [P, 4] prior boxes, computed from the node's
+    static spatial shape."""
+    def run(ctx, x, **a):
+        v = _val(x)
+        from paddle_tpu.ops import detection as det
+        boxes = det.prior_boxes((v.shape[1], v.shape[2]), a["image_hw"],
+                                a["min_sizes"], a["max_sizes"],
+                                a["aspect_ratios"])
+        return jnp.asarray(boxes)
+    return _node("priorbox", run, [input], name=name,
+                 image_hw=tuple(image_hw), min_sizes=tuple(min_sizes),
+                 max_sizes=tuple(max_sizes),
+                 aspect_ratios=tuple(aspect_ratios))
+
+
+def multibox_loss(loc_pred, conf_logits, priors, gt_boxes, gt_labels,
+                  gt_mask, neg_pos_ratio: float = 3.0,
+                  threshold: float = 0.5, name: Optional[str] = None):
+    """SSD MultiBox loss node (multibox_loss_layer twin)."""
+    def run(ctx, loc, conf, pri, gtb, gtl, gtm, **a):
+        from paddle_tpu.ops import detection as det
+        return det.multibox_loss(_val(loc), _val(conf), _val(pri),
+                                 _val(gtb), _val(gtl), _val(gtm),
+                                 a["neg_pos_ratio"], a["threshold"])
+    return _node("multibox_loss", run,
+                 [loc_pred, conf_logits, priors, gt_boxes, gt_labels,
+                  gt_mask], name=name, neg_pos_ratio=neg_pos_ratio,
+                 threshold=threshold)
+
+
+def detection_output(loc_pred, conf_logits, priors,
+                     score_threshold: float = 0.01,
+                     iou_threshold: float = 0.45, keep_top_k: int = 100,
+                     name: Optional[str] = None):
+    """Decode + per-class NMS (detection_output_layer twin)."""
+    def run(ctx, loc, conf, pri, **a):
+        from paddle_tpu.ops import detection as det
+        import jax
+        return jax.vmap(
+            lambda l, c: det.detection_output(
+                l, c, _val(pri), a["score_threshold"], a["iou_threshold"],
+                a["keep_top_k"]))(_val(loc), _val(conf))
+    return _node("detection_output", run, [loc_pred, conf_logits, priors],
+                 name=name, score_threshold=score_threshold,
+                 iou_threshold=iou_threshold, keep_top_k=keep_top_k)
+
+
+def crf_decoding(input, num_tags: int, label=None,
+                 parameter_name: Optional[str] = None,
+                 name: Optional[str] = None):
+    """Viterbi decode with the CRF's transition parameters
+    (crf_decoding_layer twin): emits the best tag path [b, t]; with
+    ``label`` emits the per-step error indicator instead.  Pass
+    ``parameter_name`` equal to the ``crf_cost`` node's name to share its
+    trained transitions."""
+    def run(ctx, emissions, *rest, **a):
+        enforce(_is_seq(emissions), "crf_decoding needs sequence emissions")
+        val, mask = emissions
+        from paddle_tpu.ops import crf as crf_ops
+        from paddle_tpu.nn.module import param
+        from paddle_tpu.nn import initializers as init
+        k = a["num_tags"]
+        pname = a["param_name"]
+        trans = param(f"{pname}/transitions", (k, k), jnp.float32,
+                      init.zeros)
+        start = param(f"{pname}/start", (k,), jnp.float32, init.zeros)
+        stop = param(f"{pname}/stop", (k,), jnp.float32, init.zeros)
+        path = crf_ops.crf_decode(val, mask, trans, start, stop)
+        if isinstance(path, tuple):
+            path = path[0]
+        if rest:
+            y = _val(rest[0])
+            err = (path != y) & mask
+            return (err.astype(jnp.float32), mask)
+        return (path, mask)
+    n = auto_name("crf_decoding", name)
+    inputs = [input] if label is None else [input, label]
+    return _node("crf_decoding", run, inputs, name=n, num_tags=num_tags,
+                 param_name=parameter_name or n, _name=n)
+
+
+def recurrent(input, act: str = "tanh", reverse: bool = False,
+              name: Optional[str] = None):
+    """Full-sequence simple RNN (recurrent_layer twin, RecurrentLayer):
+    the input is the pre-computed projection; only the h-recurrence
+    scans."""
+    def run(ctx, x, **a):
+        enforce(_is_seq(x), "recurrent needs a sequence input")
+        from paddle_tpu.nn.recurrent import SimpleRNN
+        hs, _ = SimpleRNN(x[0].shape[-1], act=a["act"],
+                          reverse=a["reverse"], project_input=False,
+                          name=a["_name"])(x[0], x[1])
+        return (hs, x[1])
+    n = auto_name("recurrent", name)
+    return _node("recurrent", run, [input], name=n, act=act,
+                 reverse=reverse, _name=n)

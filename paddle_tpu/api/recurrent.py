@@ -63,6 +63,14 @@ class GeneratedInput:
 _build_stack: List[Dict[str, Any]] = []
 
 
+def _register_node(node: LayerOutput) -> None:
+    """Record nodes created while tracing a step function, so memory()
+    can link to internal step nodes that are not group outputs (the
+    reference links memories by name to ANY layer in the step net)."""
+    if _build_stack:
+        _build_stack[-1].setdefault("created", []).append(node)
+
+
 def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
            boot_with_const_id: Optional[int] = None):
     """Previous-step value of the step node named ``name`` (memory twin).
@@ -112,8 +120,11 @@ def _build_step(name: str, step: Callable, placeholders: Sequence[Any]):
     finally:
         _build_stack.pop()
     out_nodes = list(outs) if isinstance(outs, (list, tuple)) else [outs]
-    # Resolve each memory's link: the step node with the linked name.
-    walk_roots = list(out_nodes)
+    # Resolve each memory's link: the step node with the linked name —
+    # searching every node created during the trace, not just those
+    # reachable from the outputs (e.g. a get_output(lstm_step, "state")
+    # cell node that exists only to carry the memory).
+    walk_roots = list(out_nodes) + rg.get("created", [])
     by_name: Dict[str, LayerOutput] = {}
     for n in _walk(walk_roots):
         by_name[n.name] = n
@@ -231,7 +242,8 @@ def recurrent_group(step: Callable, input, reverse: bool = False,
             for m, v in zip(memories, mems):
                 bind[m["ph"]] = v
             outs = [_eval_subgraph(n, bind, ctx) for n in out_nodes]
-            new_mems = [bind[m["node"]] for m in memories]
+            new_mems = [_eval_subgraph(m["node"], bind, ctx)
+                        for m in memories]
             return outs, new_mems
 
         def slices_at(ti):
@@ -376,7 +388,8 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
             probs = _eval_subgraph(out_nodes[0], bind, ctx)
             new_state = dict(state)
             for m in memories:
-                new_state[f"mem:{m['link']}"] = bind[m["node"]]
+                new_state[f"mem:{m['link']}"] = _eval_subgraph(
+                    m["node"], bind, ctx)
             return jnp.log(probs + 1e-9), new_state
 
         state: Dict[str, Any] = {}

@@ -1,0 +1,222 @@
+"""v1 ``trainer_config_helpers`` name-compat surface.
+
+The reference has two user-facing layer namespaces: the v1 helper API with
+``*_layer``-suffixed names (``trainer_config_helpers/layers.py:34``) and the
+v2 API that strips the suffix by reflection (``v2/layer.py``).  Our DSL
+(:mod:`paddle_tpu.api.layer`) follows the v2 naming; this module republishes
+every public v1 helper name so a reference user can port a v1 config by
+changing only the import line:
+
+    from paddle_tpu.api.v1_compat import *
+
+    out = fc_layer(input=img, size=10, act="softmax")
+
+Each alias binds the same callable — no wrapper, no behavior drift.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.api import layer as _L
+from paddle_tpu.api.graph import LayerOutput                        # noqa: F401
+from paddle_tpu.api.recurrent import (GeneratedInput, StaticInput,  # noqa: F401
+                                      beam_search, memory,
+                                      recurrent_group)
+
+
+class AggregateLevel:
+    """Sequence aggregation levels (AggregateLevel twin).  Here nesting is
+    carried by the mask's rank, so the level is implied by the input — the
+    constants exist for config compatibility."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """Sequence expansion levels (ExpandLevel twin); see AggregateLevel."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class LayerType:
+    """Node-kind names (LayerType twin): our graph kinds are plain strings;
+    this namespace exists for config compatibility."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    CONV_LAYER = "conv2d"
+    POOL_LAYER = "pool2d"
+    BATCH_NORM_LAYER = "batch_norm"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "grumemory"
+    RECURRENT_LAYER = "recurrent"
+    MIXED_LAYER = "mixed"
+    COST = "cost"
+
+
+class BaseGeneratedInput:
+    """Base marker for generation-mode inputs (BaseGeneratedInput twin)."""
+
+
+# A nested-sequence group input needs no wrapper here: recurrent_group
+# detects nesting from the mask rank (SubsequenceInput semantics).
+def SubsequenceInput(input):
+    return input
+
+
+class BeamInput:
+    """One beam for cross_entropy_over_beam (BeamInput twin): scores over
+    candidates, the selected top-k candidate ids, and the gold index.
+    ``selected_candidates`` is accepted for signature compatibility; the
+    loss here consumes scores-per-selected-candidate + gold directly."""
+
+    def __init__(self, candidate_scores, selected_candidates=None,
+                 gold=None):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+    def as_pair(self):
+        return (self.candidate_scores, self.gold)
+
+# ---- v1 name → DSL callable ------------------------------------------------
+
+data_layer = _L.data
+fc_layer = _L.fc
+embedding_layer = _L.embedding
+img_conv_layer = _L.conv2d
+img_conv3d_layer = _L.img_conv3d
+img_pool_layer = _L.pool2d
+img_pool3d_layer = _L.img_pool3d
+batch_norm_layer = _L.batch_norm
+dropout_layer = _L.dropout
+concat_layer = _L.concat
+addto_layer = _L.addto
+lstmemory = _L.lstmemory
+grumemory = _L.grumemory
+recurrent_layer = _L.recurrent
+lstm_step_layer = _L.lstm_step
+gru_step_layer = _L.gru_step
+gru_step_naive_layer = _L.gru_step_naive
+get_output_layer = _L.get_output
+
+
+class _PoolingType:
+    def __init__(self, kind):
+        self.kind = kind
+
+
+MaxPooling = lambda: _PoolingType("max")       # noqa: E731
+AvgPooling = lambda: _PoolingType("avg")       # noqa: E731
+SumPooling = lambda: _PoolingType("sum")       # noqa: E731
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kwargs):
+    """Sequence pooling with the v1 default (MaxPooling when
+    ``pooling_type`` is omitted — ``layers.py:1376``); accepts the v1
+    pooling-type objects or plain strings."""
+    if pooling_type is None:
+        kind = "max"
+    elif isinstance(pooling_type, str):
+        kind = pooling_type
+    else:
+        kind = pooling_type.kind
+    return _L.seq_pool(input, pool_type=kind, name=name)
+seq_reshape_layer = _L.seq_reshape
+seq_concat_layer = _L.seq_concat
+seq_slice_layer = _L.seq_slice
+sub_nested_seq_layer = _L.sub_nested_seq
+kmax_seq_score_layer = _L.kmax_seq_score
+first_seq = _L.first_seq
+last_seq = _L.last_seq
+expand_layer = _L.expand
+repeat_layer = _L.repeat
+rotate_layer = _L.rotate
+resize_layer = _L.resize
+trans_layer = _L.trans
+crop_layer = _L.crop
+pad_layer = _L.pad
+block_expand_layer = _L.block_expand
+maxout_layer = _L.maxout
+spp_layer = _L.spp
+img_cmrnorm_layer = _L.img_cmrnorm
+bilinear_interp_layer = _L.bilinear_interp
+interpolation_layer = _L.interpolation
+scaling_layer = _L.scaling
+slope_intercept_layer = _L.slope_intercept
+sum_to_one_norm_layer = _L.sum_to_one_norm
+row_l2_norm_layer = _L.row_l2_norm
+cross_channel_norm_layer = _L.cross_channel_norm
+clip_layer = _L.clip
+power_layer = _L.power
+mixed_layer = _L.mixed
+linear_comb_layer = _L.linear_comb
+cos_sim = _L.cos_sim
+out_prod_layer = _L.out_prod
+tensor_layer = _L.tensor
+gated_unit_layer = _L.gated_unit
+conv_shift_layer = _L.conv_shift
+row_conv_layer = _L.row_conv
+switch_order_layer = _L.switch_order
+multiplex_layer = _L.multiplex
+selective_fc_layer = _L.selective_fc
+prelu_layer = _L.prelu
+scale_shift_layer = _L.scale_shift
+maxid_layer = _L.max_id
+sampling_id_layer = _L.sampling_id
+eos_layer = _L.eos
+printer_layer = _L.print_layer
+print_layer = _L.print_layer
+convex_comb_layer = _L.linear_comb
+
+
+def layer_support(*args, **kwargs):
+    """No-op decorator (layer_support twin): device/dropout attrs are
+    handled by the DSL functions themselves here."""
+    def deco(fn):
+        return fn
+    return deco
+
+# projections / operators (same names in v1)
+full_matrix_projection = _L.full_matrix_projection
+trans_full_matrix_projection = _L.trans_full_matrix_projection
+identity_projection = _L.identity_projection
+table_projection = _L.table_projection
+scaling_projection = _L.scaling_projection
+dotmul_projection = _L.dotmul_projection
+slice_projection = _L.slice_projection
+conv_projection = _L.conv_projection
+context_projection = _L.context_projection
+conv_operator = _L.conv_operator
+dotmul_operator = _L.dotmul_operator
+
+# cost layers
+classification_cost = _L.classification_cost
+square_error_cost = _L.square_error_cost
+mse_cost = _L.square_error_cost
+regression_cost = _L.square_error_cost
+cross_entropy = _L.cross_entropy_cost
+cross_entropy_with_selfnorm = _L.cross_entropy_with_selfnorm
+cross_entropy_over_beam = _L.cross_entropy_over_beam
+soft_cross_entropy = _L.soft_cross_entropy_cost
+multi_binary_label_cross_entropy = _L.multi_binary_label_cross_entropy_cost
+huber_regression_cost = _L.huber_regression_cost
+huber_classification_cost = _L.huber_classification_cost
+smooth_l1_cost = _L.smooth_l1_cost
+rank_cost = _L.rank_cost
+lambda_cost = _L.lambda_cost
+sum_cost = _L.sum_cost
+ctc_layer = _L.ctc_cost
+warp_ctc_layer = _L.warp_ctc
+crf_layer = _L.crf_cost
+crf_decoding_layer = _L.crf_decoding
+nce_layer = _L.nce_cost
+hsigmoid = _L.hsigmoid_cost
+
+# detection
+priorbox_layer = _L.priorbox
+multibox_loss_layer = _L.multibox_loss
+detection_output_layer = _L.detection_output
+
+__all__ = [n for n in dir() if not n.startswith("_") and n != "annotations"]

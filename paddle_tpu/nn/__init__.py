@@ -10,7 +10,9 @@ from paddle_tpu.nn.layers_extra import (
     BilinearInterp, Interpolation, Crop, Pad, Rotate, SwitchOrder,
     FeatureMapExpand, Multiplex, SelectiveFC, DataNorm, SumToOneNorm, Scaling,
     SlopeIntercept, Addto, DotMulProjection, ScalingProjection,
-    IdentityProjection, TransposedFullMatrixProjection, Mixed)
+    IdentityProjection, TransposedFullMatrixProjection, Mixed,
+    FullMatrixProjection, TableProjection, SliceProjection, ConvProjection,
+    PReLU, TensorLayer, GatedUnit, ConvShift, OutProd, RowL2Norm, ScaleShift)
 
 __all__ = [
     "Module", "Transformed", "transform", "param", "state", "set_state",
@@ -24,4 +26,7 @@ __all__ = [
     "SumToOneNorm", "Scaling", "SlopeIntercept", "Addto", "DotMulProjection",
     "ScalingProjection", "IdentityProjection",
     "TransposedFullMatrixProjection", "Mixed",
+    "FullMatrixProjection", "TableProjection", "SliceProjection",
+    "ConvProjection", "PReLU", "TensorLayer", "GatedUnit", "ConvShift",
+    "OutProd", "RowL2Norm", "ScaleShift",
 ]

@@ -25,7 +25,7 @@ from jax import lax
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.core.errors import enforce, enforce_in
 from paddle_tpu.nn import initializers as init
-from paddle_tpu.nn.layers import IntOrPair, _pair
+from paddle_tpu.nn.layers import Conv2D, IntOrPair, _pair
 from paddle_tpu.nn.module import Module, param, next_rng_key
 
 
@@ -484,6 +484,69 @@ class TransposedFullMatrixProjection(Module):
             policy.cast_to_compute(x) @ policy.cast_to_compute(w).T)
 
 
+class FullMatrixProjection(Module):
+    """x @ W (twin of FullMatrixProjection — the workhorse of MixedLayer)."""
+
+    def __init__(self, size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+
+    def forward(self, x):
+        policy = get_policy()
+        w = param("w", (x.shape[-1], self.size), policy.param_dtype,
+                  init.paddle_default())
+        return policy.cast_to_output(
+            policy.cast_to_compute(x) @ policy.cast_to_compute(w))
+
+
+class TableProjection(Module):
+    """Embedding-table lookup projection (twin of TableProjection):
+    input is an id array, output rows of a learned table."""
+
+    def __init__(self, size: int, vocab_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.vocab_size = vocab_size
+
+    def forward(self, ids):
+        policy = get_policy()
+        table = param("w", (self.vocab_size, self.size), policy.param_dtype,
+                      init.paddle_default())
+        return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+
+class SliceProjection(Module):
+    """Concatenation of column slices of the input (twin of
+    SliceProjection): ``slices`` is a list of (start, end) pairs."""
+
+    def __init__(self, slices: Sequence[Tuple[int, int]],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.slices = [(int(s), int(e)) for s, e in slices]
+
+    def forward(self, x):
+        return jnp.concatenate([x[..., s:e] for s, e in self.slices],
+                               axis=-1)
+
+
+class ConvProjection(Module):
+    """2-D convolution as a Mixed projection (twin of ConvProjection /
+    conv_operator): input is NHWC, output flattened to [batch, -1] so it
+    can be summed with other projections of the same output size."""
+
+    def __init__(self, channels: int, kernel: IntOrPair, stride: IntOrPair = 1,
+                 padding: str = "SAME", flatten: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.conv = Conv2D(channels, kernel, stride, padding, name="conv")
+        self.flatten = flatten
+
+    def forward(self, x):
+        y = self.conv(x)
+        return y.reshape(y.shape[0], -1) if self.flatten else y
+
+
 class Mixed(Module):
     """Sum of projection outputs + bias + activation (twin of
     MixedLayer.cpp): ``Mixed([proj1, proj2], act="relu")(x1, x2)``."""
@@ -509,3 +572,116 @@ class Mixed(Module):
             b = param("b", (y.shape[-1],), policy.param_dtype, init.zeros)
             y = y + b
         return self.act(y)
+
+
+# ---------------------------------------------------------------------------
+# Remaining registered-layer twins.
+# ---------------------------------------------------------------------------
+
+class PReLU(Module):
+    """Parametric ReLU with a learned per-channel slope (twin of
+    PReluLayer; ``partial_sum`` channel grouping collapses to the
+    per-channel case, the only one the demos use)."""
+
+    def __init__(self, init_slope: float = 0.25,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.init_slope = init_slope
+
+    def forward(self, x):
+        policy = get_policy()
+        a = param("a", (x.shape[-1],), policy.param_dtype,
+                  init.constant(self.init_slope))
+        return jnp.where(x > 0, x, a * x)
+
+
+class TensorLayer(Module):
+    """Bilinear tensor product (twin of TensorLayer):
+    ``out[b, k] = x1[b] @ W[k] @ x2[b]`` with ``W: [size, d1, d2]``."""
+
+    def __init__(self, size: int, act="linear", bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.ops import activations
+        self.size = size
+        self.act = activations.get(act)
+        self.bias = bias
+
+    def forward(self, x1, x2):
+        policy = get_policy()
+        w = param("w", (self.size, x1.shape[-1], x2.shape[-1]),
+                  policy.param_dtype, init.paddle_default(fan_in_axis=1))
+        y = jnp.einsum("bi,kij,bj->bk", policy.cast_to_compute(x1),
+                       policy.cast_to_compute(w),
+                       policy.cast_to_compute(x2))
+        y = policy.cast_to_output(y)
+        if self.bias:
+            b = param("b", (self.size,), policy.param_dtype, init.zeros)
+            y = y + b
+        return self.act(y)
+
+
+class GatedUnit(Module):
+    """Gated linear unit (twin of gated_unit_layer):
+    ``act(x W) * sigmoid(x W_g)`` — the GLU of the conv-seq2seq line."""
+
+    def __init__(self, size: int, act="linear", name: Optional[str] = None):
+        super().__init__(name)
+        from paddle_tpu.nn.layers import Linear
+        self.value = Linear(size, act=act, name="value")
+        self.gate = Linear(size, act="sigmoid", name="gate")
+
+    def forward(self, x):
+        return self.value(x) * self.gate(x)
+
+
+class ConvShift(Module):
+    """Circular correlation of two layers (twin of ConvShiftLayer — the
+    NTM attention-shift op): ``out[b, i] = sum_j b[b, j] *
+    a[b, (i + j - (N-1)//2) mod M]`` with ``N`` odd and static, so the
+    gather indices are compile-time constants."""
+
+    def forward(self, a, b):
+        m, n = a.shape[-1], b.shape[-1]
+        enforce(n % 2 == 1, "conv_shift filter width must be odd, got %d", n)
+        idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :]
+               - (n - 1) // 2) % m          # [M, N]
+        return jnp.einsum("bmn,bn->bm", a[:, idx], b)
+
+
+class OutProd(Module):
+    """Flattened outer product of two vectors (twin of OuterProdLayer)."""
+
+    def forward(self, x, y):
+        out = jnp.einsum("bi,bj->bij", x, y)
+        return out.reshape(out.shape[0], -1)
+
+
+class RowL2Norm(Module):
+    """Row-wise L2 normalization (twin of RowL2NormLayer)."""
+
+    def __init__(self, epsilon: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def forward(self, x):
+        sq = jnp.sum(jnp.square(x), axis=-1, keepdims=True)
+        return x * lax.rsqrt(sq + self.epsilon)
+
+
+class ScaleShift(Module):
+    """``w * x + b`` with scalar learned w and b (twin of
+    ScaleShiftLayer)."""
+
+    def __init__(self, bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.bias = bias
+
+    def forward(self, x):
+        policy = get_policy()
+        w = param("w", (1,), policy.param_dtype, init.ones)
+        y = x * w[0]
+        if self.bias:
+            b = param("b", (1,), policy.param_dtype, init.zeros)
+            y = y + b[0]
+        return y

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import initializers as init
 from paddle_tpu.nn.module import Module, param
 from paddle_tpu.ops import activations, pallas_kernels
@@ -34,6 +35,20 @@ def _mask_state(new, old, mask_t):
     # mask_t: [batch] bool; keep old state where this step is padding.
     m = mask_t[:, None]
     return jnp.where(m, new, old)
+
+
+def gru_cell(gates_x, h_prev, w_hz, w_hc, act, gate_act, policy):
+    """One GRU step shared by the scan layer and the api gru_step node:
+    ``gates_x`` is the 3h input projection [batch, 3h] (z, r, candidate),
+    ``w_hz``/``w_hc`` already in compute dtype."""
+    h = h_prev.shape[-1]
+    zr = gates_x[:, :2 * h] + policy.cast_to_output(
+        policy.cast_to_compute(h_prev) @ w_hz)
+    z, r = jnp.split(gate_act(zr), 2, axis=-1)
+    cand = gates_x[:, 2 * h:] + policy.cast_to_output(
+        policy.cast_to_compute(r * h_prev) @ w_hc)
+    cand = act(cand)
+    return (1.0 - z) * h_prev + z * cand
 
 
 class LSTM(Module):
@@ -169,14 +184,8 @@ class GRU(Module):
 
         def step(h_prev, inp):
             gates_x, m = inp
-            zr_x, cand_x = gates_x[:, :2 * h], gates_x[:, 2 * h:]
-            zr = zr_x + policy.cast_to_output(
-                policy.cast_to_compute(h_prev) @ w_hz_c)
-            z, r = jnp.split(self.gate_act(zr), 2, axis=-1)
-            cand = cand_x + policy.cast_to_output(
-                policy.cast_to_compute(r * h_prev) @ w_hc_c)
-            cand = self.act(cand)
-            hh = (1.0 - z) * h_prev + z * cand
+            hh = gru_cell(gates_x, h_prev, w_hz_c, w_hc_c, self.act,
+                          self.gate_act, policy)
             hh = _mask_state(hh, h_prev, m)
             return hh, hh
 
@@ -187,26 +196,38 @@ class GRU(Module):
 
 
 class SimpleRNN(Module):
-    """Plain recurrent layer (twin of RecurrentLayer.cpp)."""
+    """Plain recurrent layer (twin of RecurrentLayer.cpp).
+
+    With ``project_input=False`` the input IS the pre-computed projection
+    (must already be ``hidden`` wide) and only ``w_h`` + bias are learned —
+    the reference RecurrentLayer's exact contract (its only weight is the
+    hidden-hidden ``getSize() x getSize()`` matrix)."""
 
     def __init__(self, hidden: int, act="tanh", reverse: bool = False,
-                 name: Optional[str] = None):
+                 project_input: bool = True, name: Optional[str] = None):
         super().__init__(name)
         self.hidden = hidden
         self.act = activations.get(act)
         self.reverse = reverse
+        self.project_input = project_input
 
     def forward(self, x, mask=None, initial_state=None):
         policy = get_policy()
         b, t, d = x.shape
         h = self.hidden
-        w_x = param("w_x", (d, h), policy.param_dtype, init.paddle_default())
         w_h = param("w_h", (h, h), policy.param_dtype, init.paddle_default())
         bias = param("b", (h,), policy.param_dtype, init.zeros)
 
-        xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
-                        policy.cast_to_compute(w_x))
-        xw = policy.cast_to_output(xw) + bias
+        if self.project_input:
+            w_x = param("w_x", (d, h), policy.param_dtype,
+                        init.paddle_default())
+            xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
+                            policy.cast_to_compute(w_x))
+            xw = policy.cast_to_output(xw) + bias
+        else:
+            enforce(d == h, "SimpleRNN(project_input=False): input width "
+                    "%d must equal hidden %d", d, h)
+            xw = x + bias
         h0 = jnp.zeros((b, h), x.dtype) if initial_state is None else initial_state
         if mask is None:
             mask = jnp.ones((b, t), bool)

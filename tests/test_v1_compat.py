@@ -1,0 +1,336 @@
+"""v1 helper-API surface: every public reference name resolves, and the
+new completeness-sweep layers compute/differentiate correctly.
+
+Coverage oracle: the reference's ``trainer_config_helpers/layers.py``
+``__all__`` (101 names) must all exist in ``paddle_tpu.api.v1_compat``.
+"""
+
+import ast
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.api as api
+import paddle_tpu.api.layer as L
+import paddle_tpu.nn as nn
+from paddle_tpu.api import v1_compat
+from paddle_tpu.api.graph import compile_model, reset_names
+
+REF_LAYERS = "/root/reference/python/paddle/trainer_config_helpers/layers.py"
+
+
+def _reference_all():
+    import warnings
+    with open(REF_LAYERS) as f, warnings.catch_warnings():
+        warnings.simplefilter("ignore", SyntaxWarning)
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                getattr(t, "id", None) == "__all__" for t in node.targets):
+            return [ast.literal_eval(el) for el in node.value.elts]
+    raise AssertionError("reference __all__ not found")
+
+
+@pytest.mark.skipif(not os.path.exists(REF_LAYERS),
+                    reason="reference tree not mounted")
+def test_every_reference_name_exists():
+    missing = [n for n in _reference_all() if not hasattr(v1_compat, n)]
+    assert not missing, f"v1 names missing from v1_compat: {missing}"
+
+
+def _loss_and_grads(cost, batch, seed=0):
+    reset_names()
+    model_fn = compile_model(cost)
+    t = nn.transform(lambda b: model_fn(b)[0])
+    params, _ = t.init(jax.random.key(seed), batch)
+    loss, grads = jax.value_and_grad(
+        lambda p: t.apply(p, {}, None, batch)[0])(params)
+    return loss, grads
+
+
+def test_new_simple_layers_forward_and_grad(rng):
+    reset_names()
+    x = L.data("x")
+    y = L.data("y")
+    label = L.data("label", dtype="int32")
+    h = L.prelu(L.fc(x, 16, name="fc_in"), name="pr")
+    h = L.gated_unit(h, 16, name="gu")
+    h = L.scale_shift(h, name="ss")
+    h = L.row_l2_norm(h)
+    h2 = L.tensor(h, y, 8, name="tl")
+    h3 = L.out_prod(L.fc(x, 4, name="p1"), L.fc(y, 3, name="p2"))
+    h4 = L.conv_shift(h, L.fc(y, 5, act="softmax", name="shift"))
+    h = L.concat([h2, h3, h4])
+    h = L.clip(h, -5.0, 5.0)
+    cost = L.classification_cost(L.fc(h, 3, act="linear", name="out"), label)
+
+    batch = {"x": rng.randn(4, 12).astype(np.float32),
+             "y": rng.randn(4, 10).astype(np.float32),
+             "label": rng.randint(0, 3, 4).astype(np.int32)}
+    loss, grads = _loss_and_grads(cost, batch)
+    assert np.isfinite(float(loss))
+    flat = nn.flatten_names(grads)
+    # the bilinear tensor layer's W must receive gradient
+    assert any("tl" in k for k in flat), sorted(flat)
+    assert all(np.all(np.isfinite(v)) for v in flat.values())
+
+
+def test_conv_shift_matches_naive_circular_corr(rng):
+    a = rng.randn(2, 7).astype(np.float32)
+    b = rng.randn(2, 3).astype(np.float32)
+    got = np.asarray(nn.transform(
+        lambda u, v: nn.ConvShift()(u, v)).apply({}, {}, None, a, b)[0])
+    want = np.zeros_like(a)
+    for bi in range(2):
+        for i in range(7):
+            for j in range(3):
+                want[bi, i] += b[bi, j] * a[bi, (i + j - 1) % 7]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_mixed_with_new_projections(rng):
+    reset_names()
+    x = L.data("x")
+    ids = L.data("ids", dtype="int32")
+    out = L.mixed(
+        [x, x, x, ids],
+        projections=[L.full_matrix_projection(6),
+                     L.trans_full_matrix_projection(6),
+                     L.slice_projection([(0, 3), (5, 8)]),
+                     L.table_projection(6, vocab_size=11)],
+        act="relu", name="mx")
+    label = L.data("label", dtype="int32")
+    cost = L.classification_cost(L.fc(out, 2, name="out"), label)
+    batch = {"x": rng.randn(4, 8).astype(np.float32),
+             "ids": rng.randint(0, 11, 4).astype(np.int32),
+             "label": rng.randint(0, 2, 4).astype(np.int32)}
+    loss, grads = _loss_and_grads(cost, batch)
+    assert np.isfinite(float(loss))
+    flat = nn.flatten_names(grads)
+    assert any("mx" in k for k in flat)
+
+
+def test_lstm_step_in_recurrent_group_matches_lstmemory(rng):
+    """An explicit lstm_step + memory recurrence must equal the fused
+    lstmemory layer (the reference's lstm_step_layer contract)."""
+    b, t, d, h = 3, 5, 4, 6
+    xs = rng.randn(b, t, d).astype(np.float32)
+    mask = np.ones((b, t), bool)
+    mask[1, 3:] = False
+
+    reset_names()
+    seq = L.data("seq", sequence=True)
+    ref_out = L.lstmemory(seq, h, name="lstm")
+    pooled = L.seq_pool(ref_out, "last")
+    cost_ref = L.sum_cost(L.fc(pooled, 1, name="head"))
+    model_ref = compile_model(cost_ref)
+    t_ref = nn.transform(lambda bb: model_ref(bb)[0])
+    batch = {"seq": xs, "seq_mask": mask}
+    params_ref, _ = t_ref.init(jax.random.key(3), batch)
+
+    reset_names()
+    seq = L.data("seq", sequence=True)
+    # gates projection shares the lstmemory parameter layout: w_x + b
+    proj = L.mixed([seq], [L.full_matrix_projection(4 * h)],
+                   bias=True, name="lstm_gates")
+
+    def step(g):
+        c_prev = v1_compat.memory(name="c_out", size=h)
+        hh = L.lstm_step(g, c_prev, size=h, name="h_out")
+        L.get_output(hh, "state", name="c_out")
+        return hh
+
+    out = api.recurrent_group(step, [proj], name="rg")
+    pooled = L.seq_pool(out, "last")
+    cost_step = L.sum_cost(L.fc(pooled, 1, name="head"))
+    model_step = compile_model(cost_step)
+    t_step = nn.transform(lambda bb: model_step(bb)[0])
+    params_step, _ = t_step.init(jax.random.key(3), batch)
+
+    # copy the trained-path weights: lstmemory {w_x, w_h, b} vs
+    # mixed-projection w + bias and the step's recurrent weights.
+    flat_ref = nn.flatten_names(params_ref)
+    flat_step = nn.flatten_names(params_step)
+    wx = flat_ref["lstm/w_x"]
+    wh = flat_ref["lstm/w_h"]
+    bb_ = flat_ref["lstm/b"]
+    # step path: projection w, bias; lstm_step has no recurrent weights —
+    # fold w_h by augmenting the projection is impossible, so instead drive
+    # the reference with w_h = 0 to compare the step semantics.
+    flat_ref0 = dict(flat_ref)
+    flat_ref0["lstm/w_h"] = np.zeros_like(wh)
+    loss_ref = float(t_ref.apply(
+        nn.unflatten_names(flat_ref0), {}, None, batch)[0])
+
+    key = [k for k in flat_step if k.endswith("lstm_gates/b")]
+    wkey = [k for k in flat_step if "lstm_gates" in k and k.endswith("/w")]
+    assert key and wkey, sorted(flat_step)
+    flat_step[wkey[0]] = wx
+    flat_step[key[0]] = bb_
+    for k in flat_step:                      # align the shared head too
+        if k in flat_ref0 and k not in (wkey[0], key[0]):
+            flat_step[k] = flat_ref0[k]
+    loss_step = float(t_step.apply(
+        nn.unflatten_names(flat_step), {}, None, batch)[0])
+    np.testing.assert_allclose(loss_step, loss_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gru_step_recurrent_group_runs(rng):
+    b, t, d, h = 2, 4, 3, 5
+    reset_names()
+    seq = L.data("seq", sequence=True)
+    proj = L.mixed([seq], [L.full_matrix_projection(3 * h)], bias=True,
+                   name="gru_gates")
+
+    def step(g):
+        h_prev = v1_compat.memory(name="h_out", size=h)
+        return L.gru_step(g, h_prev, size=h, name="h_out")
+
+    out = api.recurrent_group(step, [proj], name="rg")
+    cost = L.sum_cost(L.fc(L.seq_pool(out, "last"), 1, name="head"))
+    batch = {"seq": rng.randn(b, t, d).astype(np.float32),
+             "seq_mask": np.ones((b, t), bool)}
+    loss, grads = _loss_and_grads(cost, batch, seed=1)
+    assert np.isfinite(float(loss))
+    flat = nn.flatten_names(grads)
+    assert any("w_hz" in k for k in flat), sorted(flat)
+
+
+def test_crf_decoding_shares_crf_cost_params(rng):
+    b, t, k = 3, 6, 4
+    emissions = rng.randn(b, t, k).astype(np.float32)
+    mask = np.ones((b, t), bool)
+    mask[0, 4:] = False
+    labels = rng.randint(0, k, (b, t)).astype(np.int32)
+
+    reset_names()
+    seq = L.data("em", sequence=True)
+    lab = L.data("lab", dtype="int32")
+    cost = L.crf_cost(seq, lab, num_tags=k, name="crf")
+    decode = L.crf_decoding(seq, num_tags=k, parameter_name="crf",
+                            name="path")
+    model_fn = compile_model(cost, extra_outputs=[decode])
+    tr = nn.transform(lambda bb: model_fn(bb))
+    batch = {"em": emissions, "em_mask": mask, "lab": labels}
+    params, _ = tr.init(jax.random.key(0), batch)
+    (loss, outs), _ = tr.apply(params, {}, None, batch)
+    path, pmask = outs["path"]
+    assert path.shape == (b, t) and np.isfinite(float(loss))
+    # the decode node must NOT have created second copies of the params
+    flat = nn.flatten_names(params)
+    crf_params = [p for p in flat if "transitions" in p]
+    assert len(crf_params) == 1, crf_params
+
+
+def test_row_conv_and_recurrent_layer(rng):
+    b, t, d = 2, 6, 4
+    reset_names()
+    seq = L.data("seq", sequence=True)
+    h = L.row_conv(seq, future_steps=2, name="rc")
+    h = L.recurrent(h, name="rnn")
+    cost = L.sum_cost(L.fc(L.seq_pool(h, "avg"), 1, name="head"))
+    batch = {"seq": rng.randn(b, t, d).astype(np.float32),
+             "seq_mask": np.ones((b, t), bool)}
+    loss, grads = _loss_and_grads(cost, batch)
+    assert np.isfinite(float(loss))
+    flat = nn.flatten_names(grads)
+    assert any("rc" in k for k in flat) and any("rnn" in k for k in flat)
+
+
+def test_detection_dsl_pipeline(rng):
+    """priorbox → multibox_loss → detection_output as graph nodes."""
+    b, hw, c = 2, 4, 8
+    num_classes, num_gt = 3, 5
+    reset_names()
+    feat = L.data("feat")
+    pri = L.priorbox(feat, image_hw=(32, 32), min_sizes=(8.0,),
+                     aspect_ratios=(2.0,))
+    num_priors_per_cell = 3        # min_size + ar 2 + ar 0.5
+    p = hw * hw * num_priors_per_cell
+    loc = L.resize(L.fc(feat, p * 4, name="loc"), 4)
+    loc = _node_reshape(loc, (b, p, 4))
+    conf = _node_reshape(L.fc(feat, p * num_classes, name="conf"),
+                         (b, p, num_classes))
+    gtb = L.data("gt_boxes")
+    gtl = L.data("gt_labels", dtype="int32")
+    gtm = L.data("gt_mask")
+    cost = L.multibox_loss(loc, conf, pri, gtb, gtl, gtm)
+    det = L.detection_output(loc, conf, pri, keep_top_k=7, name="det")
+
+    model_fn = compile_model(cost, extra_outputs=[det])
+    tr = nn.transform(lambda bb: model_fn(bb))
+    batch = {
+        "feat": rng.randn(b, hw, hw, c).astype(np.float32),
+        "gt_boxes": np.abs(rng.rand(b, num_gt, 4)).astype(np.float32),
+        "gt_labels": rng.randint(1, num_classes, (b, num_gt)).astype(np.int32),
+        "gt_mask": np.ones((b, num_gt), np.float32),
+    }
+    batch["gt_boxes"][..., 2:] = batch["gt_boxes"][..., :2] + 0.2
+    params, _ = tr.init(jax.random.key(0), batch)
+    (loss, outs), _ = tr.apply(params, {}, None, batch)
+    boxes, scores, valid = outs["det"]
+    assert np.isfinite(float(loss))
+    assert boxes.shape == (b, num_classes - 1, 7, 4)
+
+
+def _node_reshape(node, shape):
+    from paddle_tpu.api.layer import _node, _val
+    return _node("reshape", lambda ctx, x, **a: _val(x).reshape(a["shape"]),
+                 [node], shape=tuple(shape))
+
+
+def test_cost_additions(rng):
+    b, k = 6, 5
+    reset_names()
+    x = L.data("x")
+    lab = L.data("label", dtype="int32")
+    logits = L.fc(x, k, name="out")
+    cost = L.cross_entropy_with_selfnorm(logits, lab,
+                                         softmax_selfnorm_alpha=0.5)
+    batch = {"x": rng.randn(b, 8).astype(np.float32),
+             "label": rng.randint(0, k, b).astype(np.int32)}
+    loss, _ = _loss_and_grads(cost, batch)
+    # selfnorm penalty makes it >= plain CE
+    reset_names()
+    x2 = L.data("x")
+    lab2 = L.data("label", dtype="int32")
+    plain = L.classification_cost(L.fc(x2, k, name="out"), lab2)
+    loss_plain, _ = _loss_and_grads(plain, batch)
+    assert float(loss) >= float(loss_plain) - 1e-6
+
+
+def test_cross_entropy_over_beam(rng):
+    b, k = 4, 6
+    reset_names()
+    s1 = L.data("s1")
+    g1 = L.data("g1", dtype="int32")
+    s2 = L.data("s2")
+    g2 = L.data("g2", dtype="int32")
+    cost = L.cross_entropy_over_beam([(s1, g1), (s2, g2)])
+    batch = {"s1": rng.randn(b, k).astype(np.float32),
+             "g1": rng.randint(0, k, b).astype(np.int32),
+             "s2": rng.randn(b, k).astype(np.float32),
+             # gold dropped out of beam for half the slots
+             "g2": np.array([-1, 2, -1, 0], np.int32)}
+    loss, _ = _loss_and_grads(cost, batch)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def test_conv_operator_and_3d(rng):
+    reset_names()
+    img = L.data("img")
+    filt = L.data("filt")
+    y = L.conv_operator(img, filt, channels=2, kernel=3)
+    cost = L.sum_cost(y)
+    vol = L.data("vol")
+    v = L.img_pool3d(L.img_conv3d(vol, 4, name="c3"), 2)
+    cost2 = L.sum_cost(v)
+    batch = {"img": rng.randn(2, 5, 5, 3).astype(np.float32),
+             "filt": rng.randn(2, 3 * 3 * 3 * 2).astype(np.float32),
+             "vol": rng.randn(2, 4, 6, 6, 3).astype(np.float32)}
+    loss1, _ = _loss_and_grads(cost, batch)
+    loss2, _ = _loss_and_grads(cost2, batch)
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
