@@ -113,21 +113,45 @@ def cmd_time(args):
     # attachments with slow links).
     trainer.init(batches[0])
     batches = [{k: jnp.asarray(v) for k, v in b.items()} for b in batches]
-    cycle = itertools.cycle(batches)
     last = {}
 
-    def step_fn():
-        loss, _ = trainer.train_batch(next(cycle))
-        last["cost"] = loss
-        return loss
-
-    timed_run(step_fn, args.burn_in)
-    # --batches N sets the differential scale: arms of N and 4N batches.
+    # Same protocol as bench.py (shared helper + shared step path, so the
+    # two cannot drift): when the batches stack (uniform shapes, no mesh),
+    # time the compiled multi-batch loop — one dispatch per K batches —
+    # and divide; otherwise fall back to per-dispatch train_batch.
+    shapes = {k: v.shape for k, v in batches[0].items()}
+    stackable = (trainer.mesh is None and not trainer.average_window
+                 and all({k: v.shape for k, v in b.items()} == shapes
+                         for b in batches))
     n = max(args.batches, 1)
-    ms = marginal_ms_per_batch(step_fn, n=n)
+    if stackable:
+        K = len(batches)
+        stack = {k: jnp.stack([b[k] for b in batches])
+                 for k in batches[0]}
+
+        def step_fn():
+            losses = trainer.train_batches(stack)
+            last["cost"] = losses[-1]
+            return losses[-1]
+
+        timed_run(step_fn, max(1, args.burn_in // K))
+        ms = marginal_ms_per_batch(step_fn, n=max(1, n // K)) / K
+        protocol = "differential-scan"
+    else:
+        cycle = itertools.cycle(batches)
+
+        def step_fn():
+            loss, _ = trainer.train_batch(next(cycle))
+            last["cost"] = loss
+            return loss
+
+        timed_run(step_fn, args.burn_in)
+        # --batches N sets the differential scale: arms of N and 4N.
+        ms = marginal_ms_per_batch(step_fn, n=n)
+        protocol = "differential"
     print(json.dumps({"ms_per_batch": ms, "batches": args.batches,
                       "last_cost": float(last["cost"]),
-                      "protocol": "differential"}))
+                      "protocol": protocol}))
 
 
 def cmd_checkgrad(args):

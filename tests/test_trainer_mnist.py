@@ -104,3 +104,42 @@ def test_mnist_data_parallel_matches_single(tmp_path):
         s_losses.append(float(l1))
         p_losses.append(float(l2))
     np.testing.assert_allclose(s_losses, p_losses, rtol=2e-3, atol=1e-5)
+
+
+def test_train_batches_matches_sequential_steps():
+    """The compiled multi-batch loop (train_batches = one lax.scan
+    dispatch) must produce the same params and losses as K sequential
+    train_batch calls."""
+    rs = np.random.RandomState(7)
+    k, b = 4, 16
+    stack = {"image": rs.randn(k, b, 784).astype(np.float32),
+             "label": rs.randint(0, 10, (k, b)).astype(np.int32)}
+
+    t1 = _make_trainer()
+    seq_losses = [float(t1.train_batch(
+        {n: v[i] for n, v in stack.items()})[0]) for i in range(k)]
+
+    t2 = _make_trainer()
+    scan_losses = np.asarray(t2.train_batches(stack))
+
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=1e-5,
+                               atol=1e-6)
+    assert t2.step == k
+    from paddle_tpu.nn import flatten_names
+    f1 = {p: np.asarray(v) for p, v in flatten_names(t1.params).items()}
+    f2 = {p: np.asarray(v) for p, v in flatten_names(t2.params).items()}
+    for p in f1:
+        np.testing.assert_allclose(f2[p], f1[p], rtol=1e-5, atol=1e-6,
+                                   err_msg=p)
+
+
+def test_train_batches_then_train_batch_continues():
+    """Step counter and states stay consistent across the two paths."""
+    rs = np.random.RandomState(1)
+    stack = {"image": rs.randn(3, 8, 784).astype(np.float32),
+             "label": rs.randint(0, 10, (3, 8)).astype(np.int32)}
+    t = _make_trainer()
+    t.train_batches(stack)
+    assert t.step == 3
+    loss, _ = t.train_batch({n: v[0] for n, v in stack.items()})
+    assert np.isfinite(float(loss)) and t.step == 4
