@@ -134,7 +134,9 @@ def cmd_time(args):
             last["cost"] = losses[-1]
             return losses[-1]
 
-        timed_run(step_fn, max(1, args.burn_in // K))
+        # ceil-divide so any positive --burn-in warms at least one scan
+        # call, while --burn-in 0 still times cold (as in the fallback)
+        timed_run(step_fn, -(-args.burn_in // K))
         ms = marginal_ms_per_batch(step_fn, n=max(1, n // K)) / K
         protocol = "differential-scan"
     else:
@@ -273,8 +275,11 @@ def main(argv=None):
     p = sub.add_parser("time", help="benchmark ms/batch (--job=time twin)")
     common(p)
     p.add_argument("--batches", type=int, default=10,
-                   help="differential scale n: timing arms run n and 4n "
-                        "batches (2 repeats each)")
+                   help="differential scale n. Uniform-shape configs load "
+                        "n batches, stack them, and time the compiled "
+                        "multi-batch loop (arms of max(1, n//K) and "
+                        "4*max(1, n//K) scan calls over the K=n stack); "
+                        "otherwise arms run n and 4n per-dispatch batches")
     p.add_argument("--burn-in", type=int, default=10)
     p.set_defaults(fn=cmd_time)
 

@@ -142,15 +142,21 @@ class LSTM(Module):
 class GRU(Module):
     """GRU over [batch, time, dim] (twin of GruLayer / hl_gru_ops.cuh).
 
-    Gate order: update (z), reset (r), candidate.
+    Gate order: update (z), reset (r), candidate.  With the default
+    tanh/sigmoid activations the recurrence routes through
+    ``ops/pallas_kernels.gru_scan`` (fused VMEM-resident kernel on TPU,
+    ``lax.scan`` elsewhere) carried in f32, like the LSTM.
     """
 
     def __init__(self, hidden: int, act="tanh", gate_act="sigmoid",
-                 reverse: bool = False, name: Optional[str] = None):
+                 reverse: bool = False, name: Optional[str] = None,
+                 use_pallas: Optional[bool] = None):
         super().__init__(name)
         self.hidden = hidden
         self.act = activations.get(act)
         self.gate_act = activations.get(gate_act)
+        self._fusable = act == "tanh" and gate_act == "sigmoid"
+        self.use_pallas = use_pallas
         self.reverse = reverse
 
     def forward(self, x, mask=None, initial_state=None):
@@ -179,17 +185,26 @@ class GRU(Module):
             xw_t = xw_t[::-1]
             mask_t = mask_t[::-1]
 
-        w_hz_c = policy.cast_to_compute(w_hz)
-        w_hc_c = policy.cast_to_compute(w_hc)
+        if self._fusable:
+            out_dtype = xw_t.dtype
+            hs, h_last = pallas_kernels.gru_scan(
+                xw_t.astype(jnp.float32), w_hz.astype(jnp.float32),
+                w_hc.astype(jnp.float32), h0.astype(jnp.float32), mask_t,
+                use_pallas=self.use_pallas)
+            hs = hs.astype(out_dtype)
+            h_last = h_last.astype(out_dtype)
+        else:
+            w_hz_c = policy.cast_to_compute(w_hz)
+            w_hc_c = policy.cast_to_compute(w_hc)
 
-        def step(h_prev, inp):
-            gates_x, m = inp
-            hh = gru_cell(gates_x, h_prev, w_hz_c, w_hc_c, self.act,
-                          self.gate_act, policy)
-            hh = _mask_state(hh, h_prev, m)
-            return hh, hh
+            def step(h_prev, inp):
+                gates_x, m = inp
+                hh = gru_cell(gates_x, h_prev, w_hz_c, w_hc_c, self.act,
+                              self.gate_act, policy)
+                hh = _mask_state(hh, h_prev, m)
+                return hh, hh
 
-        h_last, hs = lax.scan(step, h0, (xw_t, mask_t))
+            h_last, hs = lax.scan(step, h0, (xw_t, mask_t))
         if self.reverse:
             hs = hs[::-1]
         return jnp.swapaxes(hs, 0, 1), h_last

@@ -90,11 +90,14 @@ def fusion_disabled():
         _fusion_enabled.value = prev
 
 
-def should_fuse(b: int, h: int) -> bool:
+def should_fuse(b: int, h: int, supported=None) -> bool:
     """True when the fused Pallas path is the right schedule: on a TPU
-    backend, with kernel-eligible shapes, and not inside a
+    backend, with kernel-eligible shapes (``supported`` is the per-kernel
+    shape/VMEM gate, default the LSTM's), and not inside a
     :func:`fusion_disabled` (sharded-params) region."""
-    return _fusion_on() and _on_tpu() and pallas_supported(b, h)
+    if supported is None:
+        supported = pallas_supported
+    return _fusion_on() and _on_tpu() and supported(b, h)
 
 
 def _sigmoid(x):
@@ -387,3 +390,241 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
 
     (h_last, c_last), hs = lax.scan(step, (h0, c0), (xw_t, mask_f))
     return hs, h_last, c_last
+
+
+# ---------------------------------------------------------------------------
+# Fused GRU recurrence (twin of the reference's hl_gru_ops.cuh per-frame
+# fused kernels): same VMEM-resident scan scheme as the LSTM above.
+# Gate layout follows nn.recurrent.GRU: xw_t = [z, r, candidate] blocks,
+# w_hz: [h, 2h] (z+r recurrent weights), w_hc: [h, h] (candidate).
+# ---------------------------------------------------------------------------
+
+def _gru_fwd_kernel(xw_ref, w_hz_ref, w_hc_ref, h0_ref, mask_ref,
+                    hs_ref, h_last_ref, h_s):
+    i = pl.program_id(0)
+    t = pl.num_programs(0)
+    h = h0_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+
+    h_prev = h_s[:]
+    a = xw_ref[0]
+    zr = _sigmoid(a[:, :2 * h] + jnp.dot(
+        h_prev, w_hz_ref[:], preferred_element_type=jnp.float32))
+    z = zr[:, :h]
+    r = zr[:, h:]
+    cand = jnp.tanh(a[:, 2 * h:] + jnp.dot(
+        r * h_prev, w_hc_ref[:], preferred_element_type=jnp.float32))
+    h_new = (1.0 - z) * h_prev + z * cand
+
+    m = mask_ref[0]
+    h_t = m * h_new + (1.0 - m) * h_prev
+    hs_ref[0] = h_t
+    h_s[:] = h_t
+
+    @pl.when(i == t - 1)
+    def _():
+        h_last_ref[:] = h_t
+
+
+def _gru_fwd_pallas(xw_t, w_hz, w_hc, h0, mask_t, interpret: bool):
+    t, b, three_h = xw_t.shape
+    h = three_h // 3
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, three_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, h), jnp.float32)]
+        if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw_t, w_hz, w_hc, h0, mask_t[:, :, None])
+
+
+def _gru_bwd_kernel(xw_ref, w_hz_ref, w_hc_ref, h_prev_ref, mask_ref,
+                    dhs_ref, dh_last_ref,
+                    dxw_ref, dwhz_ref, dwhc_ref, dh0_ref,
+                    dh_s, dwhz_s, dwhc_s):
+    i = pl.program_id(0)
+    t = pl.num_programs(0)
+    h = h_prev_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _():
+        dh_s[:] = dh_last_ref[:]
+        dwhz_s[:] = jnp.zeros_like(dwhz_s)
+        dwhc_s[:] = jnp.zeros_like(dwhc_s)
+
+    h_prev = h_prev_ref[0]
+    m = mask_ref[0]
+
+    # Recompute this step's gates (remat, as in the LSTM backward).
+    a = xw_ref[0]
+    zr = _sigmoid(a[:, :2 * h] + jnp.dot(
+        h_prev, w_hz_ref[:], preferred_element_type=jnp.float32))
+    z = zr[:, :h]
+    r = zr[:, h:]
+    rh = r * h_prev
+    cand = jnp.tanh(a[:, 2 * h:] + jnp.dot(
+        rh, w_hc_ref[:], preferred_element_type=jnp.float32))
+
+    dh = dh_s[:] + dhs_ref[0]
+    dh_eff = m * dh
+    dz = dh_eff * (cand - h_prev)
+    dcand = dh_eff * z
+    dh_prev = dh_eff * (1.0 - z) + (1.0 - m) * dh
+
+    da_c = dcand * (1.0 - cand * cand)
+    drh = lax.dot_general(da_c, w_hc_ref[:], (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    dr = drh * h_prev
+    dh_prev += drh * r
+
+    da_z = dz * z * (1.0 - z)
+    da_r = dr * r * (1.0 - r)
+    da_zr = jnp.concatenate([da_z, da_r], axis=-1)
+    dh_prev += lax.dot_general(da_zr, w_hz_ref[:], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+    dxw_ref[0] = jnp.concatenate([da_zr, da_c], axis=-1)
+    dwhz_s[:] += lax.dot_general(h_prev, da_zr, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    dwhc_s[:] += lax.dot_general(rh, da_c, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    dh_s[:] = dh_prev
+
+    @pl.when(i == t - 1)
+    def _():
+        dh0_ref[:] = dh_prev
+        dwhz_ref[:] = dwhz_s[:]
+        dwhc_ref[:] = dwhc_s[:]
+
+
+def _gru_bwd_pallas(xw_t, w_hz, w_hc, h_prev_seq, mask_t, dhs, dh_last,
+                    interpret: bool):
+    t, b, three_h = xw_t.shape
+    h = three_h // 3
+    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, three_h), rev),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((1, b, 1), rev),
+            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, three_h), rev),
+            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
+            pl.BlockSpec((h, h), lambda i: (0, 0)),
+            pl.BlockSpec((b, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, three_h), jnp.float32),
+            jax.ShapeDtypeStruct((h, 2 * h), jnp.float32),
+            jax.ShapeDtypeStruct((h, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((h, 2 * h), jnp.float32),
+            pltpu.VMEM((h, h), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw_t, w_hz, w_hc, h_prev_seq, mask_t[:, :, None], dhs, dh_last)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_gru_scan(xw_t, w_hz, w_hc, h0, mask_t, interpret: bool = False):
+    """Fused GRU recurrence over precomputed input projections.
+
+    xw_t: [time, batch, 3*hidden] f32 (z, r, candidate blocks);
+    w_hz: [hidden, 2*hidden]; w_hc: [hidden, hidden]; h0: [batch, hidden];
+    mask_t: [time, batch] f32.  Returns (hs, h_last).
+    """
+    hs, h_last = _gru_fwd_pallas(xw_t, w_hz, w_hc, h0, mask_t, interpret)
+    return hs, h_last
+
+
+def _gru_fused_fwd(xw_t, w_hz, w_hc, h0, mask_t, interpret):
+    hs, h_last = _gru_fwd_pallas(xw_t, w_hz, w_hc, h0, mask_t, interpret)
+    return (hs, h_last), (xw_t, w_hz, w_hc, h0, mask_t, hs)
+
+
+def _gru_fused_bwd(interpret, res, grads):
+    xw_t, w_hz, w_hc, h0, mask_t, hs = res
+    dhs, dh_last = grads
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    dxw, dwhz, dwhc, dh0 = _gru_bwd_pallas(
+        xw_t, w_hz, w_hc, h_prev_seq, mask_t, dhs, dh_last, interpret)
+    return dxw, dwhz, dwhc, dh0, None
+
+
+fused_gru_scan.defvjp(_gru_fused_fwd, _gru_fused_bwd)
+
+
+def gru_supported(b: int, h: int) -> bool:
+    """Shape/VMEM gate for the fused GRU (smaller working set than the
+    LSTM: weights are 3h² vs 4h² and there is no cell state)."""
+    if h % 128 != 0 or b < 8 or b % 8 != 0:
+        return False
+    working_set = (2 * (h * 2 * h + h * h)   # w_hz/w_hc + accumulators
+                   + 4 * b * 3 * h           # gate blocks
+                   + 8 * b * h) * 4
+    return working_set <= _VMEM_BUDGET
+
+
+def gru_scan(xw_t, w_hz, w_hc, h0, mask_t,
+             use_pallas: Optional[bool] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """GRU recurrence: Pallas-fused on TPU, ``lax.scan`` elsewhere.
+    All f32; ``mask_t`` may be bool or float."""
+    t, b, three_h = xw_t.shape
+    h = three_h // 3
+    if use_pallas is None:
+        use_pallas = should_fuse(b, h, gru_supported)
+    mask_f = mask_t.astype(jnp.float32)
+    if use_pallas:
+        return fused_gru_scan(xw_t, w_hz, w_hc, h0, mask_f, not _on_tpu())
+
+    def step(h_prev, inp):
+        a, m = inp
+        zr = _sigmoid(a[:, :2 * h] + h_prev @ w_hz)
+        z, r = zr[:, :h], zr[:, h:]
+        cand = jnp.tanh(a[:, 2 * h:] + (r * h_prev) @ w_hc)
+        hh = (1.0 - z) * h_prev + z * cand
+        mm = m[:, None]
+        hh = mm * hh + (1.0 - mm) * h_prev
+        return hh, hh
+
+    h_last, hs = lax.scan(step, h0, (xw_t, mask_f))
+    return hs, h_last

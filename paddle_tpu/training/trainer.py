@@ -204,8 +204,7 @@ class Trainer:
                 "train_batches: per-step model averaging needs the "
                 "step-by-step train_batch path")
         if self.params is None:
-            self.init(jax.tree_util.tree_map(lambda x: np.asarray(x)[0],
-                                             batch_stack))
+            self.init(jax.tree_util.tree_map(lambda x: x[0], batch_stack))
         batch_stack = self._put(batch_stack)
         k = jax.tree_util.tree_leaves(batch_stack)[0].shape[0]
         step_arr = self._step_array()
