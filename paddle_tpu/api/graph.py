@@ -42,6 +42,10 @@ class _Ctx:
         self.is_train = is_train
         self.cache: Dict[LayerOutput, Any] = {}
         self.outputs: Dict[str, Any] = {}
+        # Auxiliary multi-output channel (lstm_step state → get_output):
+        # NOT returned from model_fn — entries written inside a lax.scan
+        # body are scan-trace-local and must not escape as model outputs.
+        self.aux: Dict[str, Any] = {}
 
 
 _name_counters: Dict[str, int] = {}

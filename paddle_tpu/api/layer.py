@@ -894,10 +894,10 @@ def get_output(input, arg_name: str, name: Optional[str] = None):
     ``lstm_step`` via ``arg_name="state"``."""
     def run(ctx, x, **a):
         key = f"{a['_src']}:{a['arg_name']}"
-        enforce(key in ctx.outputs,
+        enforce(key in ctx.aux,
                 "get_output: no auxiliary output %r (have %s)", key,
-                sorted(ctx.outputs))
-        return ctx.outputs[key]
+                sorted(ctx.aux))
+        return ctx.aux[key]
     return _node("get_output", run, [input], name=name, arg_name=arg_name,
                  _src=input.name)
 
@@ -919,7 +919,7 @@ def lstm_step(input, state, size: int, act: str = "tanh",
         i, f, gg, o = jnp.split(g, 4, axis=-1)
         c = ga(f) * _val(c_prev) + ga(i) * av(gg)
         hh = ga(o) * av(c)
-        ctx.outputs[f"{a['_name']}:state"] = c
+        ctx.aux[f"{a['_name']}:state"] = c
         return hh
     n = auto_name("lstm_step", name)
     return _node("lstm_step", run, [input, state], name=n, size=size,

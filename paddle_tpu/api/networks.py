@@ -88,20 +88,27 @@ def simple_lstm(input, size: int, reverse: bool = False,
     return layer.lstmemory(proj, size=size, reverse=reverse, name=f"{n}_lstm")
 
 
-def bidirectional_lstm(input, size: int, return_concat: bool = True,
-                       name: Optional[str] = None):
-    """Forward + backward LSTM, concatenated per step
-    (bidirectional_lstm twin)."""
-    n = auto_name("bilstm", name)
-    fwd = layer.lstmemory(input, size=size, name=f"{n}_fwd")
-    bwd = layer.lstmemory(input, size=size, reverse=True, name=f"{n}_bwd")
+def _bidirectional(mem_layer, kind: str, input, size: int,
+                   return_concat: bool, name: Optional[str]):
+    """Shared fwd+bwd wiring for bidirectional_{lstm,gru}."""
+    n = auto_name(kind, name)
+    fwd = mem_layer(input, size=size, name=f"{n}_fwd")
+    bwd = mem_layer(input, size=size, reverse=True, name=f"{n}_bwd")
     if not return_concat:
         return [fwd, bwd]
 
     def run(ctx, a, b):
         return (jnp.concatenate([a[0], b[0]], axis=-1), a[1])
-    return LayerOutput(name=f"{n}_concat", kind="bilstm_concat", fn=run,
+    return LayerOutput(name=f"{n}_concat", kind=f"{kind}_concat", fn=run,
                        inputs=(fwd, bwd))
+
+
+def bidirectional_lstm(input, size: int, return_concat: bool = True,
+                       name: Optional[str] = None):
+    """Forward + backward LSTM, concatenated per step
+    (bidirectional_lstm twin)."""
+    return _bidirectional(layer.lstmemory, "bilstm", input, size,
+                          return_concat, name)
 
 
 def simple_gru(input, size: int, reverse: bool = False,
@@ -155,3 +162,100 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
     return LayerOutput(name=n, kind="attention", fn=run,
                        inputs=(encoded_sequence, encoded_proj, decoder_state),
                        attrs=(("_name", n),))
+
+
+def small_vgg(input, num_classes: int = 10, name: Optional[str] = None):
+    """CIFAR-sized VGG (small_vgg twin, ``networks.py``): four
+    batch-normed conv groups (64, 128, 256, 512) then fc-512 + softmax."""
+    n = auto_name("small_vgg", name)
+    h = input
+    for i, (times, nf) in enumerate([(2, 64), (2, 128), (3, 256),
+                                     (3, 512)]):
+        h = img_conv_group(h, [nf] * times, conv_with_batchnorm=True,
+                           name=f"{n}_b{i}")
+    h = layer.dropout(h, 0.5)
+    h = layer.fc(h, size=512, act="linear", name=f"{n}_fc1")
+    h = layer.batch_norm(h, act="relu", name=f"{n}_bn")
+    h = layer.dropout(h, 0.5)
+    return layer.fc(h, size=num_classes, act="linear", name=f"{n}_out")
+
+
+def lstmemory_unit(input, size: int, name: Optional[str] = None):
+    """One LSTM step for use inside a step function (lstmemory_unit twin):
+    projects [input, h_prev] to the 4h gates, advances (h, c) via
+    ``lstm_step`` + linked memories.  Call inside ``recurrent_group``."""
+    from paddle_tpu.api.recurrent import memory
+    n = auto_name("lstm_unit", name)
+    h_mem = memory(name=f"{n}_out", size=size)
+    c_mem = memory(name=f"{n}_state", size=size)
+    gates = layer.mixed(
+        [input, h_mem],
+        projections=[layer.full_matrix_projection(4 * size),
+                     layer.full_matrix_projection(4 * size)],
+        bias=True, name=f"{n}_gates")
+    out = layer.lstm_step(gates, c_mem, size=size, name=f"{n}_out")
+    layer.get_output(out, "state", name=f"{n}_state")
+    return out
+
+
+def lstmemory_group(input, size: int, reverse: bool = False,
+                    name: Optional[str] = None):
+    """LSTM over a sequence expressed as a recurrent_group of
+    lstmemory_unit steps (lstmemory_group twin) — same math as
+    ``lstmemory``, but the step net is user-extensible."""
+    from paddle_tpu.api.recurrent import recurrent_group
+    n = auto_name("lstm_group", name)
+    return recurrent_group(
+        lambda x: lstmemory_unit(x, size, name=f"{n}_unit"),
+        [input], reverse=reverse, name=n)
+
+
+def gru_unit(input, size: int, name: Optional[str] = None):
+    """One GRU step for a step function (gru_unit twin): ``input`` is the
+    pre-computed 3h projection; the hidden memory is linked internally."""
+    from paddle_tpu.api.recurrent import memory
+    n = auto_name("gru_unit", name)
+    h_mem = memory(name=f"{n}_out", size=size)
+    return layer.gru_step(input, h_mem, size=size, name=f"{n}_out")
+
+
+def gru_group(input, size: int, reverse: bool = False,
+              name: Optional[str] = None):
+    """GRU over a sequence as a recurrent_group of gru_unit steps
+    (gru_group twin); ``input`` must be a 3h-projected sequence."""
+    from paddle_tpu.api.recurrent import recurrent_group
+    n = auto_name("gru_group", name)
+    return recurrent_group(
+        lambda x: gru_unit(x, size, name=f"{n}_unit"),
+        [input], reverse=reverse, name=n)
+
+
+def simple_gru2(input, size: int, reverse: bool = False,
+                name: Optional[str] = None):
+    """fc(3h) + gru_group (simple_gru2 twin — the group-based variant of
+    simple_gru; same math, step-extensible form)."""
+    n = auto_name("simple_gru2", name)
+    proj = layer.fc(input, size=size * 3, act="linear", name=f"{n}_proj")
+    return gru_group(proj, size, reverse=reverse, name=f"{n}_group")
+
+
+def bidirectional_gru(input, size: int, return_concat: bool = True,
+                      name: Optional[str] = None):
+    """Forward + backward GRU, concatenated per step
+    (bidirectional_gru twin)."""
+    return _bidirectional(layer.grumemory, "bigru", input, size,
+                          return_concat, name)
+
+
+def inputs(*layers):
+    """v1 ``inputs(...)`` marker: our graphs infer inputs from ``data``
+    nodes, so this just returns its arguments (port-compat no-op)."""
+    return list(layers) if len(layers) > 1 else (layers[0] if layers
+                                                 else None)
+
+
+def outputs(*layers):
+    """v1 ``outputs(...)`` marker: returns the output node(s) — hand the
+    cost node to ``compile_model``/``SGD`` as usual."""
+    return list(layers) if len(layers) > 1 else (layers[0] if layers
+                                                 else None)

@@ -219,4 +219,214 @@ priorbox_layer = _L.priorbox
 multibox_loss_layer = _L.multibox_loss
 detection_output_layer = _L.detection_output
 
+
+
+# ---------------------------------------------------------------------------
+# The sibling trainer_config_helpers modules: activations, poolings, attrs,
+# optimizers, evaluators, networks — every public name from their __all__.
+# ---------------------------------------------------------------------------
+
+# activations.py: v1 passes activation OBJECTS; our DSL takes strings.
+# Each factory returns the DSL string so `act=ReluActivation()` works.
+def _act(name_str):
+    def factory():
+        return name_str
+    factory.__name__ = name_str
+    return factory
+
+
+BaseActivation = str
+TanhActivation = _act("tanh")
+SigmoidActivation = _act("sigmoid")
+SoftmaxActivation = _act("softmax")
+SequenceSoftmaxActivation = _act("sequence_softmax")
+IdentityActivation = _act("linear")
+LinearActivation = _act("linear")
+ReluActivation = _act("relu")
+BReluActivation = _act("brelu")
+SoftReluActivation = _act("softrelu")
+STanhActivation = _act("stanh")
+AbsActivation = _act("abs")
+SquareActivation = _act("square")
+ExpActivation = _act("exp")
+LogActivation = _act("log")
+SqrtActivation = _act("sqrt")
+ReciprocalActivation = _act("reciprocal")
+
+# poolings.py (MaxPooling/AvgPooling/SumPooling defined above)
+BasePoolingType = _PoolingType
+SquareRootNPooling = lambda: _PoolingType("sqrt")   # noqa: E731
+CudnnMaxPooling = MaxPooling        # vendor-specific impls collapse on TPU
+CudnnAvgPooling = AvgPooling
+
+
+# attrs.py: parameter/layer attribute bundles.  Initialization and
+# regularization live in initializers/optim here; the classes accept the
+# v1 kwargs so configs parse, and carry them for introspection.
+class ParameterAttribute:
+    """ParamAttr twin: accepted everywhere, consumed where meaningful."""
+
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, **extra):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+        self.extra = extra
+
+
+class ExtraLayerAttribute:
+    """ExtraAttr twin (drop_rate/device placement hints)."""
+
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None, **extra):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+        self.extra = extra
+
+
+class HookAttr:
+    """HookAttr twin (pruning-hook metadata carrier)."""
+
+    def __init__(self, type="pruning", sparsity_ratio=None, **extra):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+        self.extra = extra
+
+
+ParamAttr = ParameterAttribute
+ExtraAttr = ExtraLayerAttribute
+
+# optimizers.py: *Optimizer class names over our api.optimizer classes.
+from paddle_tpu.api import optimizer as _opt                 # noqa: E402
+from paddle_tpu.api.config import settings                   # noqa: E402,F401
+
+Optimizer = _opt._Base
+BaseSGDOptimizer = _opt._Base
+MomentumOptimizer = _opt.Momentum
+AdamOptimizer = _opt.Adam
+AdamaxOptimizer = _opt.Adamax
+AdaGradOptimizer = _opt.AdaGrad
+DecayedAdaGradOptimizer = _opt.DecayedAdaGrad
+AdaDeltaOptimizer = _opt.AdaDelta
+RMSPropOptimizer = _opt.RMSProp
+
+
+class BaseRegularization:
+    """Marker base (BaseRegularization twin)."""
+
+    def __init__(self, rate: float = 0.0):
+        self.rate = rate
+
+
+class L2Regularization(BaseRegularization):
+    """L2Regularization twin: pass rate via settings(regularization=...)
+    or the optimizer's l2_rate."""
+
+
+class ModelAverage:
+    """ModelAverage twin: carries average_window for settings()."""
+
+    def __init__(self, average_window: float = 0,
+                 max_average_window: int = 0, **extra):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+
+
+# evaluators.py: v1 snake_case evaluator constructors over
+# paddle_tpu.training.evaluators classes.
+from paddle_tpu.training import evaluators as _ev            # noqa: E402
+
+evaluator_base = _ev.Evaluator
+
+
+def classification_error_evaluator(name=None, **kw):
+    return _ev.ClassificationError(name=name or "classification_error")
+
+
+def auc_evaluator(name=None, **kw):
+    return _ev.AUC(name=name or "auc")
+
+
+def pnpair_evaluator(name=None, **kw):
+    return _ev.PnPair(name=name or "pnpair")
+
+
+def precision_recall_evaluator(name=None, **kw):
+    return _ev.PrecisionRecall(name=name or "precision_recall")
+
+
+def ctc_error_evaluator(name=None, **kw):
+    return _ev.CTCError(name=name or "ctc_error")
+
+
+def chunk_evaluator(chunk_scheme="IOB", num_chunk_types=1, name=None,
+                    pred_key="pred", label_key="label", **kw):
+    if chunk_scheme != "IOB":
+        raise ValueError("chunk_evaluator: only the IOB scheme (the "
+                         "reference default) is wired here")
+    decode = lambda tags: _ev.iob_chunks(tags, num_chunk_types)
+    return _ev.ChunkEvaluator(pred_key, label_key, decode,
+                              name=name or "chunk_f1")
+
+
+def sum_evaluator(name=None, key="loss", **kw):
+    return _ev.ValueSum(key, name=name)
+
+
+def column_sum_evaluator(name=None, key="logits", **kw):
+    return _ev.ColumnSum(key, name=name)
+
+
+def value_printer_evaluator(input=None, name=None, keys=("logits",), **kw):
+    return _ev.ValuePrinter(keys, name=name or "value_printer")
+
+
+def gradient_printer_evaluator(input=None, name=None, keys=("logits",),
+                               **kw):
+    return _ev.ValuePrinter(keys, name=name or "gradient_printer")
+
+
+def maxid_printer_evaluator(input=None, name=None, keys=("logits",), **kw):
+    return _ev.ValuePrinter(keys, name=name or "maxid_printer")
+
+
+def maxframe_printer_evaluator(input=None, name=None, keys=("logits",),
+                               **kw):
+    return _ev.ValuePrinter(keys, name=name or "maxframe_printer")
+
+
+def seqtext_printer_evaluator(input=None, name=None, keys=("logits",),
+                              **kw):
+    return _ev.ValuePrinter(keys, name=name or "seqtext_printer")
+
+
+def classification_error_printer_evaluator(input=None, name=None, **kw):
+    return _ev.ValuePrinter(("logits",),
+                            name=name or "classification_error_printer")
+
+
+def detection_map_evaluator(num_classes=2, name=None,
+                            overlap_threshold=0.5, **kw):
+    return _ev.DetectionMAP(num_classes=num_classes,
+                            iou_threshold=overlap_threshold,
+                            name=name or "detection_map")
+
+
+# networks.py composites
+from paddle_tpu.api.networks import (                        # noqa: E402,F401
+    sequence_conv_pool, simple_lstm, simple_img_conv_pool, img_conv_bn_pool,
+    lstmemory_group, lstmemory_unit, small_vgg, img_conv_group,
+    vgg_16_network, gru_unit, gru_group, simple_gru, simple_attention,
+    simple_gru2, bidirectional_gru, text_conv_pool, bidirectional_lstm,
+    inputs, outputs)
+
 __all__ = [n for n in dir() if not n.startswith("_") and n != "annotations"]

@@ -386,3 +386,31 @@ class DetectionMAP(Evaluator):
         from paddle_tpu.ops.detection import detection_map
         return detection_map(self.dets, self.gts, self.num_classes,
                              self.iou_threshold, self.mode)
+
+
+def iob_chunks(tags, num_chunk_types: int):
+    """Decode an IOB tag-id sequence into chunks (the reference's default
+    ChunkEvaluator encoding, ``ChunkEvaluator.cpp``): tag = type*2 + {B:0,
+    I:1}; the "outside" tag is ``num_chunk_types*2``.  Returns a set of
+    (start, end_exclusive, type)."""
+    tags = list(tags)
+    chunks = set()
+    start = None
+    ctype = None
+    for i, tag in enumerate(tags):
+        tag = int(tag)
+        is_o = tag >= num_chunk_types * 2
+        t, b_or_i = (None, None) if is_o else divmod(tag, 2)
+        begins = (not is_o) and (b_or_i == 0)
+        continues = (not is_o) and (b_or_i == 1) and ctype == t
+        if start is not None and not continues:
+            chunks.add((start, i, ctype))
+            start, ctype = None, None
+        if begins:
+            start, ctype = i, t
+        elif not is_o and not continues:
+            # I-tag opening a chunk (IOB allows this as a new chunk)
+            start, ctype = i, t
+    if start is not None:
+        chunks.add((start, len(tags), ctype))
+    return chunks
