@@ -69,6 +69,16 @@ class SparseFloat:
     dtype: Any = np.float32
 
 
+@dataclasses.dataclass(frozen=True)
+class SparseBinarySequence:
+    """Variable-length sequence of sparse 0/1 vectors, each given as
+    active indices (twin of sparse_binary_vector_sequence); densified to
+    (multi-hot [b, t, dim], mask [b, t])."""
+    dim: int
+    buckets: Optional[Sequence[int]] = None
+    dtype: Any = np.float32
+
+
 def _bucket_len(n: int, buckets: Optional[Sequence[int]]) -> int:
     if not buckets:
         return n
@@ -131,6 +141,18 @@ class DataFeeder:
                     for j, v in pairs:
                         arr[i, j] = v
                 out[name] = arr
+            elif isinstance(ftype, SparseBinarySequence):
+                max_len = _bucket_len(max(len(x) for x in col), ftype.buckets)
+                b = len(col)
+                arr = np.zeros((b, max_len, ftype.dim), ftype.dtype)
+                mask = np.zeros((b, max_len), bool)
+                for i, steps in enumerate(col):
+                    n = min(len(steps), max_len)
+                    for t, idxs in enumerate(list(steps)[:n]):
+                        arr[i, t, np.asarray(list(idxs), np.int64)] = 1.0
+                    mask[i, :n] = True
+                out[name] = arr
+                out[name + "_mask"] = mask
             else:
                 raise TypeError(f"Unknown feed type {ftype!r}")
         return out

@@ -1,0 +1,509 @@
+"""The ``paddle.v2`` user namespace, assembled (``python/paddle/v2/__init__.py``
+twin).
+
+A reference v2 script ports by changing one import line:
+
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(10))
+    pred = paddle.layer.fc(images, size=10,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(learning_rate=0.1)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+    trainer.train(reader=paddle.batch(train_reader, 128),
+                  num_passes=5, event_handler=handler)
+    probs = paddle.infer(output_layer=pred, parameters=parameters,
+                         input=test_samples)
+
+Everything proxies the framework modules (``api``, ``data``, ``training``);
+the v2-isms handled here: ``data_type`` specs flowing into ``layer.data``,
+tuple-sample readers converted by an implicit DataFeeder, Parameters as a
+live dict-view with tar round-trip, and the ``update_equation`` trainer
+signature (``python/paddle/v2/trainer.py:50``).
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.api import layer as _api_layer
+from paddle_tpu.api import networks, optimizer, topology   # noqa: F401
+from paddle_tpu.api import trainer as _api_trainer
+from paddle_tpu.api import v1_compat as _v1
+from paddle_tpu.api.graph import LayerOutput
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.data import feeder as _feeder
+from paddle_tpu.data import provider as _provider
+from paddle_tpu.data import datasets as dataset            # noqa: F401
+from paddle_tpu.data import image, reader                  # noqa: F401
+from paddle_tpu.data.reader import batch as batch          # minibatch twin
+from paddle_tpu.training import events as event            # noqa: F401
+from paddle_tpu.utils import plot                          # noqa: F401
+
+
+def init(**kwargs) -> None:
+    """paddle.init twin.  ``use_gpu``/``trainer_count`` pick devices in the
+    reference; device selection is JAX's job here, so the call records the
+    flags and returns (``trainer_count`` maps to a dp mesh — see
+    ``paddle_tpu.parallel``)."""
+    init.flags = dict(kwargs)
+
+
+# ---------------------------------------------------------------------------
+# data_type — feeder specs (v2/data_type.py twin).
+# ---------------------------------------------------------------------------
+
+class _DataType:
+    """A v2 input-type spec: carries the feeder column type and whether
+    the field is a (value, mask) sequence."""
+
+    def __init__(self, feed_type, sequence: bool):
+        self.feed_type = feed_type
+        self.sequence = sequence
+
+
+class _DataTypeNS:
+    """v2 input-type constructors — thin wrappers over the provider
+    protocol's constructors (``data/provider.py``, the single home of the
+    feeder-type mapping incl. bucket support) plus the sequence flag."""
+
+    @staticmethod
+    def dense_vector(dim: int):
+        return _DataType(_provider.dense_vector(dim), False)
+
+    @staticmethod
+    def dense_array(shape):
+        return _DataType(_provider.dense_array(
+            shape if isinstance(shape, (tuple, list)) else (shape,)), False)
+
+    @staticmethod
+    def dense_vector_sequence(dim: int, buckets=None):
+        return _DataType(_provider.dense_vector_sequence(dim, buckets),
+                         True)
+
+    @staticmethod
+    def integer_value(value_range: int = 0):
+        return _DataType(_provider.integer_value(value_range), False)
+
+    @staticmethod
+    def integer_value_sequence(value_range: int = 0, buckets=None):
+        return _DataType(_provider.integer_value_sequence(value_range,
+                                                          buckets), True)
+
+    @staticmethod
+    def integer_value_sub_sequence(value_range: int = 0, buckets=None):
+        return _DataType(_provider.integer_value_sequence(value_range,
+                                                          buckets), True)
+
+    @staticmethod
+    def sparse_binary_vector(dim: int):
+        return _DataType(_provider.sparse_binary_vector(dim), False)
+
+    @staticmethod
+    def sparse_binary_vector_sequence(dim: int, buckets=None):
+        return _DataType(_feeder.SparseBinarySequence(dim, buckets), True)
+
+    @staticmethod
+    def sparse_float_vector(dim: int):
+        return _DataType(_provider.sparse_float_vector(dim), False)
+
+    sparse_vector = sparse_float_vector
+
+
+data_type = _DataTypeNS()
+
+# data-layer name -> _DataType; _declare_order tracks the most-recent
+# declaration sequence number — the implicit ``feeding`` of v2 scripts.
+# Re-declaring a name (a new model in the same process) refreshes its
+# position, so each model's inputs order among themselves correctly even
+# though the registry is process-global.
+_declared_inputs: Dict[str, _DataType] = {}
+_declare_order: Dict[str, int] = {}
+_declare_counter = [0]
+
+
+class _LayerNS:
+    """paddle.v2.layer twin: every DSL function, plus ``data`` accepting a
+    ``type=`` spec."""
+
+    def __getattr__(self, name):
+        return getattr(_api_layer, name)
+
+    @staticmethod
+    def data(name: str, type: Optional[_DataType] = None,
+             dtype: str = "float32", sequence: bool = False, **kw):
+        if type is not None:
+            _declared_inputs[name] = type
+            _declare_counter[0] += 1
+            _declare_order[name] = _declare_counter[0]
+            sequence = type.sequence
+            if isinstance(type.feed_type, (_feeder.Integer,
+                                           _feeder.IntSequence)):
+                dtype = "int32"
+        return _api_layer.data(name, dtype=dtype, sequence=sequence)
+
+
+layer = _LayerNS()
+
+
+# ---------------------------------------------------------------------------
+# Namespaces whose v2 names strip a suffix from the v1 helper names.
+# ---------------------------------------------------------------------------
+
+class _SuffixNS:
+    def __init__(self, source, suffix: str):
+        self._source = source
+        self._suffix = suffix
+
+    def __getattr__(self, name):
+        return getattr(self._source, name + self._suffix)
+
+
+activation = _SuffixNS(_v1, "Activation")      # paddle.activation.Softmax()
+pooling = _SuffixNS(_v1, "Pooling")            # paddle.pooling.Max()
+
+
+class _AttrNS:
+    Param = _v1.ParameterAttribute
+    ParamAttr = _v1.ParameterAttribute
+    ParameterAttribute = _v1.ParameterAttribute
+    Extra = _v1.ExtraLayerAttribute
+    ExtraAttr = _v1.ExtraLayerAttribute
+    ExtraLayerAttribute = _v1.ExtraLayerAttribute
+    Hook = _v1.HookAttr
+    HookAttr = _v1.HookAttr
+
+
+attr = _AttrNS()
+
+
+class _EvaluatorNS:
+    """paddle.v2.evaluator twin: v1 names minus the _evaluator suffix."""
+
+    def __getattr__(self, name):
+        return getattr(_v1, name + "_evaluator")
+
+
+evaluator = _EvaluatorNS()
+
+
+class _OptimizerNS:
+    """paddle.v2.optimizer twin: the api.optimizer classes plus the v2
+    extras — a v2-local proxy rather than a mutation of the shared
+    ``api.optimizer`` module."""
+    ModelAverage = _v1.ModelAverage
+    L2Regularization = _v1.L2Regularization
+
+    def __getattr__(self, name):
+        return getattr(_api_optimizer, name)
+
+
+_api_optimizer = optimizer
+optimizer = _OptimizerNS()
+
+
+# ---------------------------------------------------------------------------
+# Parameters (v2/parameters.py twin): live dict-view over the trainer's
+# param tree with tar serialization.
+# ---------------------------------------------------------------------------
+
+class Parameters:
+    def __init__(self):
+        self._trainer = None       # bound by trainer.SGD
+        self._pending: Dict[str, np.ndarray] = {}
+
+    # -- binding ----------------------------------------------------------
+    def _attach(self, trainer) -> None:
+        self._trainer = trainer
+        if self._pending and trainer.params is not None:
+            self._apply_pending()
+
+    def _apply_pending(self) -> None:
+        import paddle_tpu.nn as nn
+        flat = nn.flatten_names(self._trainer.params)
+        for k, v in self._pending.items():
+            enforce(k in flat, "Parameters.from_tar: unknown parameter %s "
+                    "(have %s)", k, sorted(flat)[:10])
+            flat[k] = np.asarray(v, np.asarray(flat[k]).dtype)
+        self._trainer.params = nn.unflatten_names(flat)
+        self._pending.clear()
+
+    def _flat_raw(self) -> Dict[str, Any]:
+        """Name -> leaf, WITHOUT host conversion (device transfers happen
+        per requested leaf, not per lookup).  Falls back to the pending
+        (tar-loaded, not-yet-attached) values so inference-only scripts
+        work straight from ``Parameters.from_tar``."""
+        if self._trainer is not None and self._trainer.params is not None:
+            import paddle_tpu.nn as nn
+            return nn.flatten_names(self._trainer.params)
+        enforce(bool(self._pending),
+                "Parameters not materialized yet — run (or init) the "
+                "trainer first, or load values with from_tar")
+        return dict(self._pending)
+
+    def _flat(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self._flat_raw().items()}
+
+    # -- dict view --------------------------------------------------------
+    def names(self) -> List[str]:
+        return sorted(self._flat_raw())
+
+    def keys(self):
+        return self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._flat_raw()
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        flat = self._flat_raw()
+        enforce(name in flat, "unknown parameter %r", name)
+        return np.asarray(flat[name])
+
+    def get(self, name: str) -> np.ndarray:
+        return self[name]
+
+    def __setitem__(self, name: str, value) -> None:
+        if self._trainer is None or self._trainer.params is None:
+            self._pending[name] = np.asarray(value)
+            return
+        import paddle_tpu.nn as nn
+        flat = nn.flatten_names(self._trainer.params)
+        enforce(name in flat, "unknown parameter %r", name)
+        flat[name] = np.asarray(value, np.asarray(flat[name]).dtype)
+        self._trainer.params = nn.unflatten_names(flat)
+
+    def set(self, name: str, value) -> None:
+        self[name] = value
+
+    # -- serialization (Parameters.to_tar/from_tar twin) ------------------
+    def to_tar(self, fobj) -> None:
+        flat = self._flat()
+        with tarfile.open(fileobj=fobj, mode="w") as tar:
+            for name, value in sorted(flat.items()):
+                buf = io.BytesIO()
+                np.save(buf, value)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name.replace("/", "%2F")
+                                       + ".npy")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(fobj) -> "Parameters":
+        params = Parameters()
+        with tarfile.open(fileobj=fobj, mode="r") as tar:
+            for member in tar.getmembers():
+                name = member.name
+                if name.endswith(".npy"):
+                    name = name[:-4]
+                name = name.replace("%2F", "/")
+                data = tar.extractfile(member).read()
+                params._pending[name] = np.load(io.BytesIO(data))
+        return params
+
+    def init_from_tar(self, fobj) -> None:
+        other = Parameters.from_tar(fobj)
+        self._pending.update(other._pending)
+        if self._trainer is not None and self._trainer.params is not None:
+            self._apply_pending()
+
+
+class _ParametersNS:
+    Parameters = Parameters
+
+    @staticmethod
+    def create(cost) -> Parameters:
+        """v2 ``parameters.create(cost)`` twin: a live view bound by
+        ``trainer.SGD``; values materialize at the first batch (static
+        shapes come from data, which v2 encoded in the config)."""
+        return Parameters()
+
+
+parameters = _ParametersNS()
+
+
+# ---------------------------------------------------------------------------
+# trainer.SGD with the v2 signature + tuple-sample readers.
+# ---------------------------------------------------------------------------
+
+def _spec_names_for(cost) -> List[str]:
+    """Data-layer names the graph behind ``cost`` actually reads, in
+    declaration order."""
+    from paddle_tpu.api.graph import _walk
+    used = {n.name for n in _walk([cost]) if n.kind == "data"}
+    return sorted((n for n in _declared_inputs if n in used),
+                  key=lambda n: _declare_order[n])
+
+
+def _make_feeder(names: Sequence[str], feeding=None) -> _feeder.DataFeeder:
+    enforce(all(n in _declared_inputs for n in names),
+            "no data_type declared for inputs %s — declare layer.data("
+            "type=...)", [n for n in names if n not in _declared_inputs])
+    order = list(names)
+    if feeding:
+        order = sorted(order, key=lambda n: feeding[n])
+    return _feeder.DataFeeder(
+        [_declared_inputs[n].feed_type for n in order], order)
+
+
+class _TrainerNS:
+    class SGD:
+        """v2 SGD twin (``v2/trainer.py:50``): ``update_equation`` is the
+        optimizer; tuple-sample readers are converted through the declared
+        ``data_type`` specs."""
+
+        def __init__(self, cost, parameters=None, update_equation=None,
+                     extra_layers: Sequence[LayerOutput] = (),
+                     is_local: bool = True, optimizer=None, **kw):
+            opt = update_equation if update_equation is not None else optimizer
+            enforce(opt is not None, "SGD needs update_equation")
+            self._sgd = _api_trainer.SGD(cost, opt,
+                                         extra_outputs=tuple(extra_layers))
+            self._names = _spec_names_for(cost)
+            self._parameters = parameters
+            if parameters is not None:
+                parameters._attach(self._sgd.trainer)
+
+        # expose the underlying step trainer
+        @property
+        def trainer(self):
+            return self._sgd.trainer
+
+        def _wrap_reader(self, reader_creator, feeding):
+            feeder = _make_feeder(self._names, feeding)
+
+            def creator():
+                for item in reader_creator():
+                    if isinstance(item, dict):
+                        yield item
+                    else:
+                        yield feeder(item)
+            return creator
+
+        def train(self, reader, num_passes: int = 1, event_handler=None,
+                  feeding=None, evaluators=(), save_dir=None):
+            wrapped = self._wrap_reader(reader, feeding)
+            # Pending (tar-loaded) values must land BEFORE the first step:
+            # materialize the params from one peeked batch, then apply.
+            if (self._parameters is not None and self._parameters._pending
+                    and self.trainer.params is None):
+                first = next(iter(wrapped()), None)
+                enforce(first is not None, "train: reader yielded nothing")
+                self.trainer.init(first)
+            if self._parameters is not None:
+                self._parameters._attach(self.trainer)
+            out = self._sgd.train(wrapped, num_passes=num_passes,
+                                  event_handler=event_handler,
+                                  evaluators=evaluators, save_dir=save_dir)
+            if self._parameters is not None:
+                self._parameters._attach(self._sgd.trainer)
+            return out
+
+        def test(self, reader, feeding=None, evaluators=()):
+            return self._sgd.test(self._wrap_reader(reader, feeding),
+                                  evaluators=evaluators)
+
+        def save_parameter_to_tar(self, f) -> None:
+            params = self._parameters
+            if params is None:
+                params = Parameters()
+            params._attach(self._sgd.trainer)
+            params.to_tar(f)
+
+
+trainer = _TrainerNS()
+
+
+def infer(output_layer, parameters, input=None, feeding=None,
+          field: str = "value", batch=None):
+    """v2 ``paddle.infer`` twin: ``input`` is a list of tuple samples
+    (converted via the declared data_types); ``parameters`` is the
+    Parameters view — live, or loaded with ``from_tar`` (params-only, as
+    in the reference tar: models with running stats need a trainer-bound
+    view for the state) — or a raw param tree.  ``field``: "value"/"prob"
+    return the output values, "id" the argmax ids (v2 inference.py field
+    selection); a list of fields returns a list."""
+    out_node = output_layer
+    enforce(isinstance(out_node, LayerOutput), "output_layer must be a node")
+    if batch is None:
+        enforce(input is not None, "infer needs input samples")
+        names = _spec_names_for(out_node)
+        feeder = _make_feeder(names, feeding)
+        batch = feeder(list(input))
+    if isinstance(parameters, Parameters):
+        import paddle_tpu.nn as nn
+        tree = nn.unflatten_names(parameters._flat())
+        net_state = parameters._trainer.net_state if parameters._trainer \
+            else None
+    else:
+        tree, net_state = parameters, None
+    value = _api_trainer.infer(out_node, tree, batch, net_state=net_state)
+
+    def pick(f):
+        if f in ("value", "prob"):
+            return value
+        if f == "id":
+            return np.argmax(value, axis=-1)
+        raise ValueError(f"infer: unknown field {f!r} "
+                         "(expected 'value', 'prob', or 'id')")
+
+    if isinstance(field, (list, tuple)):
+        return [pick(f) for f in field]
+    return pick(field)
+
+
+class _ModelNS:
+    """v2 ``model`` twin (cloud model save): parameter tar + pass dirs."""
+
+    @staticmethod
+    def save_parameters_to_tar(params: Parameters, path: str) -> None:
+        with open(path, "wb") as f:
+            params.to_tar(f)
+
+    @staticmethod
+    def load_parameters_from_tar(path: str) -> Parameters:
+        with open(path, "rb") as f:
+            return Parameters.from_tar(f)
+
+
+model = _ModelNS()
+
+try:                                           # master client (optional)
+    from paddle_tpu.distributed import master  # noqa: F401
+except Exception:                              # pragma: no cover
+    master = None
+
+
+class _EventNS:
+    """paddle.v2.event twin: the training event classes plus the v2
+    ``TestResult`` name — a v2-local proxy, not a mutation of the shared
+    events module."""
+    TestResult = event.EndTestPeriod
+
+    def __getattr__(self, name):
+        return getattr(_events_mod, name)
+
+
+_events_mod = event
+event = _EventNS()
+
+__all__ = [
+    "init", "layer", "activation", "pooling", "attr", "data_type",
+    "parameters", "trainer", "event", "optimizer", "networks", "evaluator",
+    "dataset", "reader", "batch", "infer", "topology", "plot", "image",
+    "model", "master", "Parameters",
+]

@@ -56,7 +56,15 @@ def test_recurrent_grads(cell):
     mk = {"lstm": lambda: recurrent.LSTM(4),
           "gru": lambda: recurrent.GRU(4),
           "rnn": lambda: recurrent.SimpleRNN(4)}[cell]
-    x = _randn(2, 5, 3)
+    # Own RNG stream: with the shared module stream the data here depends
+    # on which tests ran before, and a f32 finite-difference check at
+    # rtol=2e-2 is data-sensitive enough to flake on unlucky draws.
+    rs = np.random.RandomState(11)
+    x = jnp.asarray(rs.randn(2, 5, 3), jnp.float32)
+    # eps=1e-3 sits below the f32 noise floor of a 5-step scan loss (the
+    # central difference is then noise: verified numeric converges to the
+    # analytic value only for eps >= ~3e-3).
+    eps = 1e-2
     mask = jnp.array([[1, 1, 1, 1, 0], [1, 1, 0, 0, 0]], bool)
     model = nn.transform(lambda x: mk()(x, mask)[0])
     params, state = model.init(jax.random.key(0), x)
@@ -65,7 +73,8 @@ def test_recurrent_grads(cell):
         out, _ = model.apply(p, state, None, x)
         return jnp.sum(jnp.square(out))
 
-    check_grad_params(loss, params, max_elems_per_leaf=6, rtol=2e-2)
+    check_grad_params(loss, params, eps=eps, max_elems_per_leaf=6,
+                      rtol=2e-2)
 
 
 def test_recurrent_mask_semantics():

@@ -1,0 +1,239 @@
+"""The assembled ``paddle_tpu.v2`` namespace: a reference v2 script runs
+with only the import line changed (``python/paddle/v2/__init__.py``
+surface — init, data_type, layer.data(type=...), parameters.create,
+trainer.SGD(update_equation=...), tuple-sample readers, infer, tar
+round-trip, activation/pooling/attr/evaluator namespaces)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.api.graph import reset_names
+
+
+def _mnist_like(n=96, dim=64, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(dim, classes)
+    xs = rs.randn(n, dim).astype(np.float32)
+    ys = np.argmax(xs @ w, -1).astype(np.int64)
+    return [(xs[i], int(ys[i])) for i in range(n)]
+
+
+def test_v2_script_end_to_end(tmp_path):
+    reset_names()
+    paddle.init(use_gpu=False, trainer_count=1)
+
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(64))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(4))
+    hidden = paddle.layer.fc(images, size=32,
+                             act=paddle.activation.Relu(), name="h")
+    pred = paddle.layer.fc(hidden, size=4,
+                           act=paddle.activation.Softmax(), name="out")
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=optimizer)
+
+    samples = _mnist_like()
+    events_seen = []
+
+    def handler(ev):
+        events_seen.append(type(ev).__name__)
+
+    trainer.train(reader=paddle.batch(lambda: iter(samples), 32),
+                  num_passes=8, event_handler=handler)
+    assert "EndIteration" in events_seen and "EndPass" in events_seen
+
+    # live Parameters view
+    names = params.names()
+    assert any(n.endswith("h/w") for n in names), names
+    w = params[[n for n in names if n.endswith("h/w")][0]]
+    assert w.shape == (64, 32)
+
+    # infer on raw tuple samples (cost layers excluded)
+    probs = paddle.infer(output_layer=pred, parameters=params,
+                         input=[(s[0],) for s in samples])
+    assert probs.shape == (96, 4)
+    acc = (np.argmax(probs, -1) ==
+           np.array([s[1] for s in samples])).mean()
+    assert acc >= 0.6, acc
+
+    # tar round-trip: perturb -> restore -> identical predictions
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    wkey = [n for n in names if n.endswith("h/w")][0]
+    params[wkey] = np.zeros_like(w)
+    probs_zero = paddle.infer(output_layer=pred, parameters=params,
+                              input=[(s[0],) for s in samples[:8]])
+    assert not np.allclose(probs_zero, probs[:8])
+    buf.seek(0)
+    params.init_from_tar(buf)
+    probs_back = paddle.infer(output_layer=pred, parameters=params,
+                              input=[(s[0],) for s in samples[:8]])
+    np.testing.assert_allclose(probs_back, probs[:8], rtol=1e-6)
+
+    # model save to disk
+    path = str(tmp_path / "model.tar")
+    paddle.model.save_parameters_to_tar(params, path)
+    restored = paddle.model.load_parameters_from_tar(path)
+    assert wkey in restored._pending
+
+
+def test_v2_sequence_reader_and_pooling():
+    reset_names()
+    vocab, classes = 50, 2
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(classes))
+    emb = paddle.layer.embedding(words, size=8, vocab_size=vocab)
+    lstm = paddle.networks.simple_lstm(emb, size=16, name="sl")
+    pooled = paddle.layer.seq_pool(lstm, "last")
+    pred = paddle.layer.fc(pooled, size=classes, name="out")
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+
+    rs = np.random.RandomState(0)
+    samples = []
+    for _ in range(48):
+        n = rs.randint(3, 9)
+        seq = rs.randint(0, vocab, n).tolist()
+        samples.append((seq, int(seq[0] % classes)))
+
+    losses = []
+
+    def handler(ev):
+        if isinstance(ev, paddle.event.EndIteration):
+            losses.append(ev.cost)
+
+    trainer.train(reader=paddle.batch(lambda: iter(samples), 16),
+                  num_passes=6, event_handler=handler)
+    assert losses and np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_v2_namespaces_resolve():
+    assert paddle.pooling.Max().kind == "max"
+    assert paddle.pooling.SquareRootN().kind == "sqrt"
+    assert paddle.activation.Tanh() == "tanh"
+    assert paddle.attr.Param(initial_std=0.1).initial_std == 0.1
+    ev = paddle.evaluator.classification_error()
+    assert ev.name == "classification_error"
+    assert paddle.event.TestResult is paddle.event.EndTestPeriod
+    assert callable(paddle.dataset.mnist.train)
+    assert paddle.optimizer.ModelAverage(average_window=0.5).average_window
+
+
+def test_v2_feeding_reorders_columns():
+    reset_names()
+    x = paddle.layer.data(name="x2", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y2", type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(
+        paddle.layer.fc(x, size=2, name="out2"), y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.SGDOpt(learning_rate=0.1))
+    rs = np.random.RandomState(1)
+    # samples ordered (label, x) — feeding says so
+    samples = [(int(rs.randint(2)), rs.randn(4).astype(np.float32))
+               for _ in range(8)]
+    trainer.train(reader=paddle.batch(lambda: iter(samples), 4),
+                  num_passes=1, feeding={"y2": 0, "x2": 1})
+
+
+def test_infer_from_tar_only_parameters():
+    """The canonical deploy script: load a params tar, infer — no
+    trainer attached."""
+    reset_names()
+    x = paddle.layer.data(name="xi", type=paddle.data_type.dense_vector(6))
+    pred = paddle.layer.fc(x, size=3, name="oi")
+    # train briefly to get real params
+    y = paddle.layer.data(name="yi", type=paddle.data_type.integer_value(3))
+    cost = paddle.layer.classification_cost(pred, y)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.SGDOpt(
+                                learning_rate=0.1))
+    rs = np.random.RandomState(0)
+    samples = [(rs.randn(6).astype(np.float32), int(rs.randint(3)))
+               for _ in range(8)]
+    tr.train(reader=paddle.batch(lambda: iter(samples), 4), num_passes=1)
+
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    loaded = paddle.Parameters.from_tar(buf)      # never attached
+    probs = paddle.infer(output_layer=pred, parameters=loaded,
+                         input=[(s[0],) for s in samples[:4]])
+    live = paddle.infer(output_layer=pred, parameters=params,
+                        input=[(s[0],) for s in samples[:4]])
+    np.testing.assert_allclose(probs, live, rtol=1e-6)
+    # field selection
+    ids = paddle.infer(output_layer=pred, parameters=loaded,
+                       input=[(s[0],) for s in samples[:4]], field="id")
+    assert ids.shape == (4,) and ids.dtype.kind == "i"
+    both = paddle.infer(output_layer=pred, parameters=loaded,
+                        input=[(s[0],) for s in samples[:4]],
+                        field=["value", "id"])
+    assert len(both) == 2
+
+
+def test_pretrained_tar_applies_before_training():
+    """Fine-tuning: from_tar values must be in place BEFORE the first
+    step (not clobber the trained weights after)."""
+    reset_names()
+    x = paddle.layer.data(name="xp", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="yp", type=paddle.data_type.integer_value(2))
+    pred = paddle.layer.fc(x, size=2, name="op")
+    cost = paddle.layer.classification_cost(pred, y)
+
+    rs = np.random.RandomState(3)
+    samples = [(rs.randn(4).astype(np.float32), int(rs.randint(2)))
+               for _ in range(8)]
+
+    # round 1: train, save
+    p1 = paddle.parameters.create(cost)
+    t1 = paddle.trainer.SGD(cost=cost, parameters=p1,
+                            update_equation=paddle.optimizer.SGDOpt(
+                                learning_rate=0.0))   # lr=0: params frozen
+    t1.train(reader=paddle.batch(lambda: iter(samples), 4), num_passes=1)
+    wkey = [n for n in p1.names() if n.endswith("op/w")][0]
+    marker = np.full_like(p1[wkey], 0.123)
+    p1[wkey] = marker
+    buf = io.BytesIO()
+    p1.to_tar(buf)
+    buf.seek(0)
+
+    # round 2: load tar, train with lr=0 — final weights must STILL be
+    # the marker (loaded before training, not clobbered after)
+    reset_names()
+    x = paddle.layer.data(name="xp", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="yp", type=paddle.data_type.integer_value(2))
+    pred = paddle.layer.fc(x, size=2, name="op")
+    cost = paddle.layer.classification_cost(pred, y)
+    p2 = paddle.Parameters.from_tar(buf)
+    t2 = paddle.trainer.SGD(cost=cost, parameters=p2,
+                            update_equation=paddle.optimizer.SGDOpt(
+                                learning_rate=0.0))
+    t2.train(reader=paddle.batch(lambda: iter(samples), 4), num_passes=1)
+    np.testing.assert_allclose(p2[wkey], marker, rtol=1e-6)
+
+
+def test_sparse_binary_sequence_feeder():
+    from paddle_tpu.data.feeder import DataFeeder, SparseBinarySequence
+    feeder = DataFeeder([SparseBinarySequence(5)], ["s"])
+    out = feeder([([[0, 2], [1]],), ([[4]],)])
+    assert out["s"].shape == (2, 2, 5)
+    assert out["s"][0, 0, 0] == 1.0 and out["s"][0, 0, 2] == 1.0
+    assert out["s"][1, 0, 4] == 1.0
+    assert out["s_mask"].tolist() == [[True, True], [True, False]]
