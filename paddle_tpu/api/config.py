@@ -29,6 +29,16 @@ from paddle_tpu.core.errors import enforce
 # config_args of the module currently executing (get_config_arg reads it).
 _current_config_args: Dict[str, str] = {}
 
+# Side effects recorded while a config file executes — the v1 DSL's
+# module-global declarations: settings(), outputs(...), and
+# define_py_data_sources2(...).  ``synthesize`` turns them into the CLI
+# contract so a v1-style config runs unchanged.
+_recorded: Dict[str, Any] = {}
+
+
+def _record(key: str, value: Any) -> None:
+    _recorded[key] = value
+
 
 def parse_kv(config_args: str) -> Dict[str, str]:
     """Parse the ``k=v,k=v`` --config_args string."""
@@ -69,11 +79,19 @@ def load_config_module(path: str, config_args: str = ""):
     module = importlib.util.module_from_spec(spec)
     kv = parse_kv(config_args)
     prev = _current_config_args
+    prev_recorded = dict(_recorded)
     _current_config_args = kv
+    _recorded.clear()
     try:
         spec.loader.exec_module(module)
+        # This module's DSL side effects ride on the module itself, so
+        # nested config loads (and the restore below) cannot clobber them
+        # before synthesize() runs.
+        module.__recorded__ = dict(_recorded)
     finally:
         _current_config_args = prev
+        _recorded.clear()
+        _recorded.update(prev_recorded)
     if kv and hasattr(module, "config_args"):
         module.config_args(kv)
     return module
@@ -124,11 +142,148 @@ def parse_config(config: Union[str, Any],
 def settings(**kwargs) -> OptimizationConfig:
     """The ``settings(...)`` helper of trainer_config_helpers
     (``optimizers.py:358``): keyword args onto an OptimizationConfig, with
-    the reference's argument-name aliases."""
+    the reference's argument-name aliases.  ``learning_method`` may be a
+    method-name string or an ``api.optimizer`` object (the reference's
+    ``MomentumOptimizer(...)`` style) — object settings merge under the
+    explicit kwargs.  The result is recorded so a config file calling
+    ``settings(...)`` at top level (v1 style) configures the CLI run."""
+    import dataclasses as _dc
     aliases = {"learning_method_name": "learning_method",
                "regularization_l1": "l1_rate",
                "regularization_l2": "l2_rate"}
     mapped = {aliases.get(k, k): v for k, v in kwargs.items()}
-    # The reference accepted an optimizer object for learning_method too;
-    # here it is always the method name string.
-    return OptimizationConfig(**mapped)
+    lm = mapped.get("learning_method")
+    if lm is not None and not isinstance(lm, str):
+        base_cfg = getattr(lm, "config", None)
+        enforce(base_cfg is not None,
+                "settings: learning_method must be a method name or an "
+                "api.optimizer object, got %r", type(lm).__name__)
+        base = _dc.asdict(base_cfg)
+        base.update({k: v for k, v in mapped.items()
+                     if k != "learning_method"})
+        mapped = base
+    cfg = OptimizationConfig(**mapped)
+    _record("settings", cfg)
+    return cfg
+
+
+def define_py_data_sources2(train_list, test_list, module, obj,
+                            args: Optional[Dict[str, Any]] = None) -> None:
+    """v1 config data declaration (``config_parser.py``
+    define_py_data_sources2): binds a ``@provider`` function from
+    ``module``.``obj`` over list files.  Recorded; the CLI synthesizes
+    train/test readers from it (batch size from ``settings``)."""
+    if isinstance(obj, (list, tuple)):
+        train_obj, test_obj = obj
+    else:
+        train_obj = test_obj = obj
+    _record("data_sources", {
+        "train_list": train_list, "test_list": test_list,
+        "module": module, "train_obj": train_obj, "test_obj": test_obj,
+        "args": dict(args or {})})
+
+
+def _resolve_list(path: str):
+    """A v1 ``*.list`` file holds one data path per line; a plain data
+    file stands for itself.  A declared-but-missing ``.list`` is a loud
+    error (a silent fallback would hand the provider the list path as a
+    data file and fail far from the real mistake — usually a wrong cwd)."""
+    import os
+    if path.endswith(".list"):
+        enforce(os.path.isfile(path),
+                "data list file %r not found (cwd %s) — run from the "
+                "config's directory or use an absolute path", path,
+                os.getcwd())
+        with open(path) as f:
+            return [line.strip() for line in f if line.strip()]
+    return [path]
+
+
+def _check_data_declarations(cost, rec: Dict[str, Any]) -> None:
+    """``data_layer`` infers sequence-ness/dtype from the provider
+    declaration AT CALL TIME, so a config that calls
+    define_py_data_sources2 after building its layers gets silently wrong
+    input nodes.  Cross-check post-exec and fail loudly with the real
+    cause."""
+    ds = rec.get("data_sources")
+    if ds is None:
+        return
+    import importlib
+    try:
+        mod = (ds["module"] if not isinstance(ds["module"], str)
+               else importlib.import_module(ds["module"]))
+        types = getattr(getattr(mod, ds["train_obj"]), "input_types",
+                        None) or {}
+    except (ImportError, AttributeError):
+        return
+    if not isinstance(types, dict):
+        return
+    from paddle_tpu.api.graph import _walk
+    data_names = {n.name for n in _walk([cost]) if n.kind == "data"}
+    for name, spec in types.items():
+        is_seq = "Sequence" in spec.__class__.__name__
+        if is_seq and name in data_names and f"{name}_mask" not in data_names:
+            enforce(False,
+                    "data_layer(%r) was built as a non-sequence input but "
+                    "the provider declares a sequence type — call "
+                    "define_py_data_sources2 BEFORE the layer "
+                    "declarations so data_layer can see the types", name)
+
+
+def synthesize(module) -> None:
+    """Fill the CLI config contract (``model_fn`` / ``optimizer`` /
+    ``train_reader`` / ``test_reader``) from the v1-DSL side effects
+    recorded while the config executed, so a reference-style config file
+    (layers + outputs + settings + define_py_data_sources2) runs
+    unchanged under ``python -m paddle_tpu train``."""
+    rec = getattr(module, "__recorded__", None)
+    if rec is None:
+        rec = dict(_recorded)
+    if not hasattr(module, "model_fn"):
+        cost = getattr(module, "cost", None)
+        if cost is None:
+            cost = rec.get("outputs")
+        if isinstance(cost, (list, tuple)):
+            costs = [c for c in cost if c is not None]
+            if not costs:
+                cost = None
+            elif len(costs) == 1:
+                cost = costs[0]
+            else:
+                # Multi-task configs: the reference summed every declared
+                # cost layer; mirror that with a synthetic sum node.
+                from paddle_tpu.api.layer import _node, _val
+                cost = _node("outputs_sum",
+                             lambda ctx, *xs: sum(_val(x) for x in xs),
+                             costs)
+        if cost is not None:
+            from paddle_tpu.api.graph import LayerOutput, compile_model
+            enforce(isinstance(cost, LayerOutput),
+                    "config cost/outputs must be an api.layer node")
+            module.model_fn = compile_model(cost)
+            _check_data_declarations(cost, rec)
+    st = rec.get("settings")
+    if st is not None and not hasattr(module, "optimizer"):
+        from paddle_tpu import optim
+        module.optimizer = optim.from_config(st)
+    ds = rec.get("data_sources")
+    if ds is not None:
+        import importlib
+        from paddle_tpu.data import reader as rd
+        batch_size = st.batch_size if st is not None else 32
+        mod = (ds["module"] if not isinstance(ds["module"], str)
+               else importlib.import_module(ds["module"]))
+
+        def make_reader(list_path, obj_name):
+            factory = getattr(mod, obj_name)
+            dp = factory(_resolve_list(list_path), **ds["args"])
+            feeder = dp.feeder()
+            base = rd.batch(dp, batch_size, drop_last=False)
+            return lambda: (feeder(b) for b in base())
+
+        if ds["train_list"] and not hasattr(module, "train_reader"):
+            module.train_reader = make_reader(ds["train_list"],
+                                              ds["train_obj"])
+        if ds["test_list"] and not hasattr(module, "test_reader"):
+            module.test_reader = make_reader(ds["test_list"],
+                                             ds["test_obj"])

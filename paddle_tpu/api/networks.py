@@ -255,7 +255,10 @@ def inputs(*layers):
 
 
 def outputs(*layers):
-    """v1 ``outputs(...)`` marker: returns the output node(s) — hand the
-    cost node to ``compile_model``/``SGD`` as usual."""
+    """v1 ``outputs(...)`` marker: records the network output(s) so a
+    v1-style config file runs under the CLI (``api.config.synthesize``),
+    and returns the node(s) for direct use with ``compile_model``/SGD."""
+    from paddle_tpu.api import config as config_mod
+    config_mod._record("outputs", list(layers))
     return list(layers) if len(layers) > 1 else (layers[0] if layers
                                                  else None)

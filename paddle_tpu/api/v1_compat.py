@@ -82,7 +82,38 @@ class BeamInput:
 
 # ---- v1 name → DSL callable ------------------------------------------------
 
-data_layer = _L.data
+def data_layer(name, size=None, type=None, dtype: str = "float32",
+               sequence: bool = False, **kw):
+    """v1 ``data_layer(name=..., size=...)`` twin.  ``size`` is metadata
+    (shapes come from the data here).  Sequence-ness and int dtype are
+    inferred from the config's ``define_py_data_sources2`` provider
+    declaration when present, so a v1 config file needs no changes; a
+    v2-style ``type=`` spec also works."""
+    if type is not None:                       # v2-style spec
+        from paddle_tpu.v2 import _DataType
+        if isinstance(type, _DataType):
+            sequence = type.sequence
+            if "int" in type.feed_type.__class__.__name__.lower():
+                dtype = "int32"
+    else:
+        from paddle_tpu.api import config as _cfg
+        ds = _cfg._recorded.get("data_sources")
+        if ds is not None:
+            import importlib
+            try:
+                mod = (ds["module"] if not isinstance(ds["module"], str)
+                       else importlib.import_module(ds["module"]))
+                types = getattr(getattr(mod, ds["train_obj"]),
+                                "input_types", None) or {}
+            except ImportError:
+                types = {}
+            spec = types.get(name) if isinstance(types, dict) else None
+            if spec is not None:
+                kind = spec.__class__.__name__
+                sequence = "Sequence" in kind
+                if "Int" in kind:
+                    dtype = "int32"
+    return _L.data(name, dtype=dtype, sequence=sequence)
 fc_layer = _L.fc
 embedding_layer = _L.embedding
 img_conv_layer = _L.conv2d
@@ -307,7 +338,9 @@ ExtraAttr = ExtraLayerAttribute
 
 # optimizers.py: *Optimizer class names over our api.optimizer classes.
 from paddle_tpu.api import optimizer as _opt                 # noqa: E402
-from paddle_tpu.api.config import settings                   # noqa: E402,F401
+from paddle_tpu.api.config import (settings,                 # noqa: E402,F401
+                                   define_py_data_sources2,
+                                   get_config_arg)
 
 Optimizer = _opt._Base
 BaseSGDOptimizer = _opt._Base

@@ -35,10 +35,15 @@ __version__ = "0.1.0"
 
 
 def _load_config(path: str, config_args: str):
-    from paddle_tpu.api.config import load_config_module
+    from paddle_tpu.api.config import load_config_module, synthesize
     module = load_config_module(path, config_args)
+    # v1-style configs (layers + outputs + settings +
+    # define_py_data_sources2) synthesize the contract from recorded
+    # DSL side effects.
+    synthesize(module)
     if not hasattr(module, "model_fn"):
-        raise SystemExit(f"{path}: config must define model_fn(batch)")
+        raise SystemExit(f"{path}: config must define model_fn(batch) or "
+                         "a declarative cost/outputs(...) network")
     return module
 
 

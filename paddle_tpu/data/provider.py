@@ -206,6 +206,9 @@ def provider(input_types: Union[Dict[str, Any], Sequence[Any], None] = None,
 
         factory.__name__ = getattr(process, "__name__", "provider")
         factory.origin = process
+        # Declared types, introspectable without constructing a provider
+        # (v1 data_layer uses this to infer sequence-ness by slot name).
+        factory.input_types = input_types
         return factory
 
     return wrap
