@@ -1,0 +1,56 @@
+"""The classic v2 MNIST script, ported by changing ONE import line
+(``import paddle.v2 as paddle`` -> ``import paddle_tpu.v2 as paddle``).
+
+    python examples/mnist_v2_script.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu.v2 as paddle  # noqa: E402
+
+
+def main():
+    paddle.init(use_gpu=False, trainer_count=1)
+
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(10))
+    h1 = paddle.layer.fc(images, size=128, act=paddle.activation.Relu())
+    h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndPass):
+            print(f"pass {event.pass_id} done")
+
+    def train_samples():
+        for img, lab in paddle.dataset.mnist.train(4096)():
+            yield (np.asarray(img, np.float32).reshape(-1), int(lab))
+
+    trainer.train(reader=paddle.batch(train_samples, 128),
+                  num_passes=3, event_handler=event_handler)
+
+    import itertools
+    test = list(itertools.islice(train_samples(), 512))
+    ids = paddle.infer(output_layer=pred, parameters=parameters,
+                       input=[(s[0],) for s in test], field="id")
+    acc = float(np.mean(ids == np.array([s[1] for s in test])))
+    print(f"train-subset accuracy: {acc:.3f}")
+    assert acc > 0.7
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""A literal v1-STYLE CONFIG FILE (quick_start demo shape): module-level
+DSL side effects only — no model_fn, no reader attribute.  Run from this
+directory:
+
+    python -m paddle_tpu train --config quick_start_v1_conf.py \
+        --num-passes 3
+
+The CLI synthesizes the contract from the recorded declarations
+(``api/config.py synthesize``): cost graph -> model_fn, settings() ->
+optimizer, define_py_data_sources2 -> readers (batch size from
+settings).  Mirrors ``v1_api_demo/quick_start``'s sparse text classifier
+shape on synthetic data.
+"""
+
+from paddle_tpu.api.v1_compat import *  # noqa: F401,F403
+from paddle_tpu.api.v1_compat import (MomentumOptimizer, SoftmaxActivation,
+                                      classification_cost, data_layer,
+                                      define_py_data_sources2,
+                                      embedding_layer, fc_layer,
+                                      get_config_arg, outputs,
+                                      pooling_layer, settings)
+
+dict_dim = get_config_arg("dict_dim", int, 1000)
+
+define_py_data_sources2(train_list="quick_start_v1_provider_data.list",
+                        test_list=None,
+                        module="quick_start_v1_provider", obj="process",
+                        args={"dict_dim": dict_dim})
+
+settings(batch_size=32, learning_rate=0.1,
+         learning_method=MomentumOptimizer(momentum=0.9))
+
+word = data_layer(name="word", size=dict_dim)
+label = data_layer(name="label", size=2)
+emb = embedding_layer(word, size=32, vocab_size=dict_dim)
+pooled = pooling_layer(emb)
+pred = fc_layer(pooled, size=2, act=SoftmaxActivation())
+outputs(classification_cost(input=pred, label=label))

@@ -1,0 +1,29 @@
+"""The @provider module for quick_start_v1_conf.py (PyDataProvider2
+protocol twin): synthetic two-class token sequences whose first token
+determines the label.  ``dict_dim`` arrives through
+define_py_data_sources2's ``args`` via the init_hook, like the
+reference's hook-configured providers."""
+
+import zlib
+
+import numpy as np
+
+from paddle_tpu.data.provider import (integer_value,
+                                      integer_value_sequence, provider)
+
+
+def _init(settings, files, dict_dim=1000, **kwargs):
+    settings.input_types = {"word": integer_value_sequence(dict_dim),
+                            "label": integer_value(2)}
+    settings.dict_dim = dict_dim
+
+
+@provider(input_types={"word": integer_value_sequence(1000),
+                       "label": integer_value(2)},
+          init_hook=_init, should_shuffle=True, pool_size=256)
+def process(settings, filename):
+    rs = np.random.RandomState(zlib.crc32(filename.encode()) % (2 ** 31))
+    for _ in range(512):
+        n = int(rs.randint(4, 24))
+        seq = rs.randint(0, settings.dict_dim, n).tolist()
+        yield {"word": seq, "label": int(seq[0] % 2)}

@@ -82,12 +82,21 @@ def load_config_module(path: str, config_args: str = ""):
     prev_recorded = dict(_recorded)
     _current_config_args = kv
     _recorded.clear()
+    # The config's directory joins sys.path (the reference ran configs
+    # with their directory importable), so provider modules next to the
+    # config resolve no matter the caller's cwd.
+    import os
+    import sys
+    cfg_dir = os.path.dirname(os.path.abspath(path))
+    if cfg_dir not in sys.path:
+        sys.path.insert(0, cfg_dir)
     try:
         spec.loader.exec_module(module)
         # This module's DSL side effects ride on the module itself, so
         # nested config loads (and the restore below) cannot clobber them
         # before synthesize() runs.
         module.__recorded__ = dict(_recorded)
+        module.__config_dir__ = cfg_dir
     finally:
         _current_config_args = prev
         _recorded.clear()
@@ -183,20 +192,26 @@ def define_py_data_sources2(train_list, test_list, module, obj,
         "args": dict(args or {})})
 
 
-def _resolve_list(path: str):
+def _resolve_list(path: str, base_dir: Optional[str] = None):
     """A v1 ``*.list`` file holds one data path per line; a plain data
-    file stands for itself.  A declared-but-missing ``.list`` is a loud
-    error (a silent fallback would hand the provider the list path as a
-    data file and fail far from the real mistake — usually a wrong cwd)."""
+    file stands for itself.  Relative paths resolve against the config's
+    directory first, then the cwd; a declared-but-missing ``.list`` is a
+    loud error (a silent fallback would hand the provider the list path
+    as a data file and fail far from the real mistake)."""
     import os
-    if path.endswith(".list"):
-        enforce(os.path.isfile(path),
-                "data list file %r not found (cwd %s) — run from the "
-                "config's directory or use an absolute path", path,
+    cand = path
+    if base_dir and not os.path.isabs(path) and not os.path.isfile(path):
+        in_base = os.path.join(base_dir, path)
+        if os.path.isfile(in_base):
+            cand = in_base
+    if cand.endswith(".list"):
+        enforce(os.path.isfile(cand),
+                "data list file %r not found (cwd %s) — use a path "
+                "relative to the config file or an absolute one", path,
                 os.getcwd())
-        with open(path) as f:
+        with open(cand) as f:
             return [line.strip() for line in f if line.strip()]
-    return [path]
+    return [cand]
 
 
 def _check_data_declarations(cost, rec: Dict[str, Any]) -> None:
@@ -274,9 +289,11 @@ def synthesize(module) -> None:
         mod = (ds["module"] if not isinstance(ds["module"], str)
                else importlib.import_module(ds["module"]))
 
+        cfg_dir = getattr(module, "__config_dir__", None)
+
         def make_reader(list_path, obj_name):
             factory = getattr(mod, obj_name)
-            dp = factory(_resolve_list(list_path), **ds["args"])
+            dp = factory(_resolve_list(list_path, cfg_dir), **ds["args"])
             feeder = dp.feeder()
             base = rd.batch(dp, batch_size, drop_last=False)
             return lambda: (feeder(b) for b in base())

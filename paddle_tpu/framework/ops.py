@@ -160,7 +160,10 @@ register_op("gather", lambda x, ids: jnp.take(x, ids, axis=0),
             ["X", "Index"], no_grad_slots=["Index"])
 register_op("scatter", lambda ref, ids, upd: ref.at[ids].add(upd),
             ["Ref", "Index", "Updates"], no_grad_slots=["Index"])
-register_op("lookup_table", lambda w, ids: jnp.take(w, ids, axis=0),
+# mode="clip": OOV ids clamp (XLA gather semantics) — matches
+# nn.Embedding; the default NaN fill silently poisons the forward pass.
+register_op("lookup_table",
+            lambda w, ids: jnp.take(w, ids, axis=0, mode="clip"),
             ["W", "Ids"], no_grad_slots=["Ids"])
 register_op("multiplex",
             lambda ids, xs: jnp.stack(xs, 1)[jnp.arange(len(ids)), ids],

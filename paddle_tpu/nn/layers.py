@@ -81,7 +81,10 @@ class Embedding(Module):
         policy = get_policy()
         table = param("w", (self.vocab_size, self.dim), policy.param_dtype,
                       self.w_init)
-        return jnp.take(table, ids, axis=0)
+        # mode="clip": out-of-vocab ids clamp to the last row (XLA's
+        # native gather semantics) instead of jnp.take's default NaN
+        # fill, which silently poisons the whole forward pass.
+        return jnp.take(table, ids, axis=0, mode="clip")
 
 
 class Conv2D(Module):

@@ -513,7 +513,7 @@ class TableProjection(Module):
         policy = get_policy()
         table = param("w", (self.vocab_size, self.size), policy.param_dtype,
                       init.paddle_default())
-        return jnp.take(table, ids.astype(jnp.int32), axis=0)
+        return jnp.take(table, ids.astype(jnp.int32), axis=0, mode="clip")
 
 
 class SliceProjection(Module):

@@ -46,6 +46,10 @@ def sharded_lookup(table: jax.Array, ids: jax.Array, mesh: Mesh,
     rows_per = vocab // n_shards
 
     def local(table_shard, ids_):
+        # Globally-OOV ids clamp to the last row first — the same
+        # contract as dense nn.Embedding (mode="clip"), so swapping a
+        # model to the sharded table cannot change OOV semantics.
+        ids_ = jnp.clip(ids_, 0, vocab - 1)
         # Which of my rows does each id hit?  Foreign ids gather row 0 of
         # my shard and are masked to zero; the psum sums one real
         # contribution per id.

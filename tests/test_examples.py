@@ -36,3 +36,30 @@ def test_example_trains_one_pass(config, args, tmp_path):
     assert "loss" in metrics and metrics["loss"] == metrics["loss"]
     # a checkpoint pass dir was written
     assert (tmp_path / "pass-00000" / "arrays.npz").exists()
+
+
+def test_v1_conf_example_trains(tmp_path):
+    """The v1-style config example (no model_fn in the file) trains via
+    the synthesized contract; must run from the examples directory."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.path.join(REPO, "examples") + ":" + REPO + \
+        ":" + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "train",
+         "--config", "quick_start_v1_conf.py",
+         "--config-args", "dict_dim=200", "--num-passes", "1"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.join(REPO, "examples"), timeout=900)
+    assert out.returncode == 0, out.stderr[-3000:]
+    metrics = json.loads(out.stdout.strip().splitlines()[-1])
+    assert metrics["loss"] == metrics["loss"]
+
+
+def test_v2_script_example_runs():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "mnist_v2_script.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "accuracy" in out.stdout

@@ -129,3 +129,19 @@ def test_jit_apply():
     out = fast(params, x)
     ref, _ = model.apply(params, {}, None, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_embedding_out_of_vocab_clips_not_nan():
+    """Out-of-vocab ids clamp (XLA gather semantics) instead of jnp.take's
+    NaN fill — an id bug must not silently poison the forward pass."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu.nn as nn
+    t = nn.transform(lambda ids: nn.Embedding(10, 4, name="e")(ids))
+    ids = jnp.asarray([0, 9, 10, 9999], jnp.int32)
+    params, _ = t.init(jax.random.key(0), ids)
+    out, _ = t.apply(params, {}, None, ids)
+    out = np.asarray(out)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[2], out[1], rtol=1e-6)   # clamped to last
