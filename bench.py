@@ -59,15 +59,15 @@ def main():
         # over K stacked batches, the C++ batch-loop twin): one dispatch
         # per K batches, so the tunnel's per-dispatch overhead does not
         # masquerade as step time.
-        K = 8
+        K = 16
         stack = {k: jnp.stack([v] * K) for k, v in batch.items()}
         step_fn = lambda: trainer.train_batches(stack)[-1]
         # burn-in (compile + warm transport), TrainerBenchmark.cpp style
-        timed_run(step_fn, 4)
+        timed_run(step_fn, 3)
 
         # repeats beyond the default: the paired-difference median is
         # what rejects transport jitter on tunneled attachments
-        ms_per_call = marginal_ms_per_batch(step_fn, n=8, repeats=7)
+        ms_per_call = marginal_ms_per_batch(step_fn, n=4, repeats=7)
         ms_per_batch = ms_per_call / K
 
     baseline_ms = 83.0  # K40m, BASELINE.md RNN table (h=256 bs=64)
