@@ -144,6 +144,12 @@ def cmd_time(args):
         timed_run(step_fn, -(-args.burn_in // K))
         ms = marginal_ms_per_batch(step_fn, n=max(1, n // K)) / K
         protocol = "differential-scan"
+        # MFU from XLA's FLOP count of the compiled scan (per batch —
+        # the loop body is counted trip-count-invariantly).
+        from paddle_tpu.utils import mfu as mfu_mod
+        flops_batch = trainer.train_scan_flops(stack)
+        mfu_val = (mfu_mod.mfu(flops_batch, ms / 1e3)
+                   if flops_batch else None)
     else:
         cycle = itertools.cycle(batches)
 
@@ -156,9 +162,12 @@ def cmd_time(args):
         # --batches N sets the differential scale: arms of N and 4N.
         ms = marginal_ms_per_batch(step_fn, n=n)
         protocol = "differential"
-    print(json.dumps({"ms_per_batch": ms, "batches": args.batches,
-                      "last_cost": float(last["cost"]),
-                      "protocol": protocol}))
+        mfu_val = None
+    out = {"ms_per_batch": ms, "batches": args.batches,
+           "last_cost": float(last["cost"]), "protocol": protocol}
+    if mfu_val is not None:
+        out["mfu"] = round(mfu_val, 4)
+    print(json.dumps(out))
 
 
 def cmd_checkgrad(args):

@@ -223,6 +223,18 @@ class Trainer:
             handler.save_and_exit()
         return losses
 
+    def train_scan_flops(self, batch_stack: Dict[str, Any]):
+        """XLA's FLOP count for ONE batch of the compiled multi-batch
+        loop (the while-loop body is counted once, trip-count-invariant)
+        — the numerator of MFU.  None when the backend reports no cost
+        analysis or no peak is known for the device."""
+        from paddle_tpu.utils import mfu as mfu_mod
+        if mfu_mod.peak_flops() is None:
+            return None          # MFU undefined here; skip the compile
+        return mfu_mod.compiled_flops(
+            self._train_scan, self.params, self.net_state, self.opt_state,
+            self._put(batch_stack), self._step_array())
+
     def _put(self, batch):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self.mesh is not None:

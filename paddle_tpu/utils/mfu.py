@@ -1,0 +1,69 @@
+"""Model-FLOPs-utilization instrumentation (SURVEY.md §7 stage 10).
+
+The reference reported throughput (samples/s via ``--job=time``); the
+TPU-native quality bar is MFU — the fraction of the chip's peak matmul
+throughput the compiled step actually sustains.  FLOP counts come from
+XLA's own cost analysis of the compiled executable, so fusion and
+rematerialization are accounted for exactly as executed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+# Peak dense matmul throughput per chip, by device_kind substring.
+# bf16 numbers (the compute dtype of the mixed policy); f32 on MXU-less
+# paths is not what MFU is about.
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,      # v5e
+    "TPU v5": 459e12,           # v5p (checked after the lite variant)
+    "TPU v6 lite": 918e12,      # v6e / Trillium
+}
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOP/s for ``device`` (default: first local device), or
+    None when the device kind is unknown (CPU, new TPU generations)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    # longest match wins so "TPU v5 lite" beats "TPU v5"
+    best = None
+    for name, flops in _PEAK_FLOPS.items():
+        if name in kind and (best is None or len(name) > len(best[0])):
+            best = (name, flops)
+    return best[1] if best else None
+
+
+def compiled_flops(fn: Callable, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one execution of ``jit(fn)(*args)`` per XLA's cost
+    analysis of the compiled executable; None if the backend does not
+    report it."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    try:
+        analyses = compiled.cost_analysis()
+    except Exception:
+        return None
+    if analyses is None:
+        return None
+    # cost_analysis returns one dict (or a per-device list on older jax)
+    if isinstance(analyses, (list, tuple)):
+        analyses = analyses[0] if analyses else {}
+    flops = analyses.get("flops")
+    return float(flops) if flops else None
+
+
+def mfu(flops_per_step: float, seconds_per_step: float,
+        device=None) -> Optional[float]:
+    """Achieved fraction of peak: (FLOPs/step) / (s/step) / peak."""
+    peak = peak_flops(device)
+    if not peak or seconds_per_step <= 0:
+        return None
+    return flops_per_step / seconds_per_step / peak
+
+

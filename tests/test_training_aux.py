@@ -114,3 +114,29 @@ def test_cli_checkgrad_and_train(tmp_path):
     assert out2.returncode == 0, out2.stderr
     assert "h/w" in out2.stdout  # stats table printed
     assert "loss" in json.loads(out2.stdout.strip().splitlines()[-1])
+
+
+def test_mfu_instrumentation():
+    """XLA cost-analysis FLOPs ≈ analytic for a plain matmul, and the
+    mfu() ratio math holds against a stub device."""
+    import types
+    import jax.numpy as jnp
+    from paddle_tpu.utils import mfu as mfu_mod
+
+    m, k, n = 128, 256, 512
+    a = jnp.zeros((m, k)); b = jnp.zeros((k, n))
+    flops = mfu_mod.compiled_flops(lambda x, y: x @ y, a, b)
+    if flops is None:
+        import pytest
+        pytest.skip("backend reports no cost analysis")
+    assert abs(flops - 2 * m * k * n) / (2 * m * k * n) < 0.1, flops
+    # ratio math against a stub v5e: peak FLOPs in 1s -> MFU exactly 1
+    dev = types.SimpleNamespace(device_kind="TPU v5 lite0")
+    peak = mfu_mod.peak_flops(dev)
+    assert peak == 197e12
+    assert abs(mfu_mod.mfu(peak, 1.0, dev) - 1.0) < 1e-9
+    assert abs(mfu_mod.mfu(peak / 2, 1.0, dev) - 0.5) < 1e-9
+    # unknown device kind -> undefined MFU
+    cpu = types.SimpleNamespace(device_kind="cpu")
+    assert mfu_mod.peak_flops(cpu) is None
+    assert mfu_mod.mfu(1e12, 1.0, cpu) is None
