@@ -99,7 +99,7 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
         if check_alignment:
             for items in itertools.zip_longest(*rs, fillvalue=_SENTINEL):
                 if any(i is _SENTINEL for i in items):
-                    raise RuntimeError(
+                    raise ComposeNotAligned(
                         "composed readers have different lengths")
                 yield sum((make_tuple(i) for i in items), ())
         else:
@@ -242,4 +242,30 @@ def batch(reader_creator: Reader, batch_size: int,
                 buf = []
         if buf and not drop_last:
             yield buf
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by :func:`compose` when readers of different lengths are
+    zipped with ``check_alignment`` (decorator.py ComposeNotAligned twin)."""
+
+
+def cloud_reader(master_address, trainer: int = 0,
+                 poll_interval: float = 0.2):
+    """Stream records dispatched by the task master (creator.py
+    ``cloud_reader`` twin — the reference pulled records through the Go
+    master's etcd-discovered client; here the native master serves
+    recordio shard descriptors over TCP, ``distributed/master.py``).
+
+    Yields raw record bytes via :func:`distributed.master.task_reader`
+    (which owns the pull/ack/nack + PASS_WAIT loop, so shards of a dead
+    trainer really do get re-dispatched and re-read).
+    """
+    def reader():
+        from paddle_tpu.distributed.master import MasterClient, task_reader
+        client = MasterClient(master_address, trainer=trainer)
+        try:
+            yield from task_reader(client, poll_interval=poll_interval)()
+        finally:
+            client.close()
     return reader

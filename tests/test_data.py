@@ -34,7 +34,7 @@ def test_compose():
     r = rd.compose(_range_reader(3), _range_reader(3))
     assert list(r()) == [(0, 0), (1, 1), (2, 2)]
     bad = rd.compose(_range_reader(2), _range_reader(3))
-    with pytest.raises(RuntimeError, match="different lengths"):
+    with pytest.raises(rd.ComposeNotAligned, match="different lengths"):
         list(bad())
 
 

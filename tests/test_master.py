@@ -140,3 +140,37 @@ def test_two_clients_split_the_work(tmp_path):
     finally:
         srv.close()
         m.close()
+
+
+def test_cloud_reader_streams_recordio_via_master(tmp_path):
+    """creator.cloud_reader twin: master dispatches recordio shards,
+    the reader streams and acks them (v2 cloud data path)."""
+    from paddle_tpu.data.reader import cloud_reader
+    from paddle_tpu.distributed.master import recordio_tasks
+    from paddle_tpu.io import recordio
+
+    path = str(tmp_path / "data.recordio")
+    w = recordio.Writer(path)
+    records = [f"rec-{i}".encode() for i in range(37)]
+    for r in records:
+        w.write(r)
+    w.close()
+
+    m = Master(timeout_s=60, max_failures=3)
+    m.set_tasks(recordio_tasks([path], records_per_task=10))
+    srv = MasterServer(m, port=0)
+    try:
+        got = list(cloud_reader(srv.address)())
+        assert sorted(got) == sorted(records)
+        assert m.counts()["done"] == 4       # 37 records -> 4 shards
+    finally:
+        srv.close()
+        m.close()
+
+
+def test_compose_not_aligned_error():
+    from paddle_tpu.data import reader as rd
+    r1 = lambda: iter([1, 2, 3])
+    r2 = lambda: iter([4, 5])
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(r1, r2)())
