@@ -259,13 +259,18 @@ def cloud_reader(master_address, trainer: int = 0,
 
     Yields raw record bytes via :func:`distributed.master.task_reader`
     (which owns the pull/ack/nack + PASS_WAIT loop, so shards of a dead
-    trainer really do get re-dispatched and re-read).
+    trainer really do get re-dispatched and re-read).  Each ``reader()``
+    invocation consumes one pass and then asks the master to recycle for
+    the next (first trainer to ask wins; the master rejects recycling
+    while tasks are outstanding), so multi-pass training works like any
+    other reader.
     """
     def reader():
         from paddle_tpu.distributed.master import MasterClient, task_reader
         client = MasterClient(master_address, trainer=trainer)
         try:
             yield from task_reader(client, poll_interval=poll_interval)()
+            client.start_next_pass()
         finally:
             client.close()
     return reader

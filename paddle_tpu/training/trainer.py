@@ -223,6 +223,50 @@ class Trainer:
             handler.save_and_exit()
         return losses
 
+    _FAST_CHUNK = 16
+
+    def _train_pass_fast(self, reader) -> List[float]:
+        """One pass through the device-side loop: buffer same-shape
+        batches into chunks of up to ``_FAST_CHUNK``, run each chunk as
+        one ``train_batches`` scan, and transfer all losses at pass end.
+        A shape change (e.g. a last partial batch) flushes the buffer and
+        starts a new chunk."""
+        device_losses = []
+        buf: List[Dict[str, Any]] = []
+        buf_key = None
+
+        def flush():
+            nonlocal buf, buf_key
+            if not buf:
+                return
+            if len(buf) == 1:
+                loss, _ = self.train_batch(buf[0])
+                device_losses.append(jnp.reshape(loss, (1,)))
+            else:
+                stack = {k: jnp.stack([b[k] for b in buf])
+                         for k in buf[0]}
+                device_losses.append(self.train_batches(stack))
+            buf, buf_key = [], None
+
+        def batch_key(batch):
+            # shape AND dtype: same-shape batches of different dtypes
+            # must not stack (jnp.stack would silently promote, diverging
+            # from the per-batch path).  Attribute reads only — no
+            # materializing copies of device-resident values.
+            return {k: (np.shape(v), getattr(v, "dtype", None))
+                    for k, v in batch.items()}
+
+        for batch in reader():
+            key = batch_key(batch)
+            if buf and (key != buf_key or len(buf) >= self._FAST_CHUNK):
+                flush()
+            if not buf:
+                buf_key = key
+            buf.append(batch)
+        flush()
+        return [float(v) for chunk in device_losses
+                for v in np.asarray(chunk)]
+
     def train_scan_flops(self, batch_stack: Dict[str, Any]):
         """XLA's FLOP count for ONE batch of the compiled multi-batch
         loop (the while-loop body is counted once, trip-count-invariant)
@@ -255,29 +299,43 @@ class Trainer:
         evaluator's result (and ``test_*`` metrics when a test_reader is
         given)."""
         handler = event_handler or (lambda e: None)
+        # With no per-batch host consumer (events, evaluators, printing),
+        # run each pass through the device-side scan loop: batches chunk
+        # into stacks and dispatch as ONE lax.scan call each, and the
+        # per-batch float(loss) host sync defers to pass end — the two
+        # costs that dominate a tight training loop on remote
+        # attachments.
+        fast = (event_handler is None and not evaluators
+                and log_period == 0 and stats_period == 0
+                and self.mesh is None and not self.average_window)
         results: Dict[str, Any] = {}
         for pass_id in range(num_passes):
             self.current_pass = pass_id
             handler(ev.BeginPass(pass_id))
             for e in evaluators:
                 e.start()
-            costs = []
-            for batch_id, batch in enumerate(reader()):
-                handler(ev.BeginIteration(pass_id, batch_id))
-                loss, outputs = self.train_batch(batch)
-                for e in evaluators:
-                    e.update({**outputs, **{k: batch[k] for k in batch}})
-                cost = float(loss)
-                costs.append(cost)
-                if log_period and (batch_id + 1) % log_period == 0:
-                    print(f"pass {pass_id} batch {batch_id + 1} "
-                          f"cost {cost:.6f}", flush=True)
-                if stats_period and (batch_id + 1) % stats_period == 0:
-                    # --show_parameter_stats_period twin
-                    from paddle_tpu.training import aux as aux_lib
-                    print(aux_lib.format_parameter_stats(
-                        aux_lib.parameter_stats(self.params)), flush=True)
-                handler(ev.EndIteration(pass_id, batch_id, cost))
+            if fast:
+                costs = self._train_pass_fast(reader)
+            else:
+                costs = []
+                for batch_id, batch in enumerate(reader()):
+                    handler(ev.BeginIteration(pass_id, batch_id))
+                    loss, outputs = self.train_batch(batch)
+                    for e in evaluators:
+                        e.update({**outputs,
+                                  **{k: batch[k] for k in batch}})
+                    cost = float(loss)
+                    costs.append(cost)
+                    if log_period and (batch_id + 1) % log_period == 0:
+                        print(f"pass {pass_id} batch {batch_id + 1} "
+                              f"cost {cost:.6f}", flush=True)
+                    if stats_period and (batch_id + 1) % stats_period == 0:
+                        # --show_parameter_stats_period twin
+                        from paddle_tpu.training import aux as aux_lib
+                        print(aux_lib.format_parameter_stats(
+                            aux_lib.parameter_stats(self.params)),
+                            flush=True)
+                    handler(ev.EndIteration(pass_id, batch_id, cost))
             results = {e.name: e.finish() for e in evaluators}
             results["loss"] = float(np.mean(costs)) if costs else 0.0
             if test_reader is not None:

@@ -162,7 +162,9 @@ def test_cloud_reader_streams_recordio_via_master(tmp_path):
     try:
         got = list(cloud_reader(srv.address)())
         assert sorted(got) == sorted(records)
-        assert m.counts()["done"] == 4       # 37 records -> 4 shards
+        # pass consumed (4 shards) then recycled for the next pass
+        c = m.counts()
+        assert c["todo"] == 4 and c["done"] == 0 and c["pass"] == 1
     finally:
         srv.close()
         m.close()
@@ -174,3 +176,29 @@ def test_compose_not_aligned_error():
     r2 = lambda: iter([4, 5])
     with pytest.raises(rd.ComposeNotAligned):
         list(rd.compose(r1, r2)())
+
+
+def test_cloud_reader_multi_pass(tmp_path):
+    """Each reader() invocation serves one full pass; the master
+    recycles so pass 2 sees all records again."""
+    from paddle_tpu.data.reader import cloud_reader
+    from paddle_tpu.distributed.master import recordio_tasks
+    from paddle_tpu.io import recordio
+
+    path = str(tmp_path / "data.recordio")
+    w = recordio.Writer(path)
+    records = [f"r{i}".encode() for i in range(12)]
+    for r in records:
+        w.write(r)
+    w.close()
+
+    m = Master(timeout_s=60, max_failures=3)
+    m.set_tasks(recordio_tasks([path], records_per_task=5))
+    srv = MasterServer(m, port=0)
+    try:
+        rdr = cloud_reader(srv.address)
+        for _ in range(3):                      # three passes
+            assert sorted(list(rdr())) == sorted(records)
+    finally:
+        srv.close()
+        m.close()

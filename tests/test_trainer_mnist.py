@@ -143,3 +143,31 @@ def test_train_batches_then_train_batch_continues():
     assert t.step == 3
     loss, _ = t.train_batch({n: v[0] for n, v in stack.items()})
     assert np.isfinite(float(loss)) and t.step == 4
+
+
+def test_fast_pass_matches_eventful_pass():
+    """train() without per-batch host consumers silently takes the
+    device-scan fast path; it must produce the same params and mean loss
+    as the eventful per-batch path, including ragged last batches."""
+    rs = np.random.RandomState(5)
+    batches = [{"image": rs.randn(16, 784).astype(np.float32),
+                "label": rs.randint(0, 10, 16).astype(np.int32)}
+               for _ in range(9)]
+    batches.append({"image": rs.randn(7, 784).astype(np.float32),
+                    "label": rs.randint(0, 10, 7).astype(np.int32)})
+    reader = lambda: iter(batches)
+
+    t_slow = _make_trainer()
+    r_slow = t_slow.train(reader, num_passes=2,
+                          event_handler=lambda e: None)
+    t_fast = _make_trainer()
+    r_fast = t_fast.train(reader, num_passes=2)
+
+    np.testing.assert_allclose(r_fast["loss"], r_slow["loss"],
+                               rtol=1e-5, atol=1e-6)
+    from paddle_tpu.nn import flatten_names
+    f1 = flatten_names(t_slow.params)
+    f2 = flatten_names(t_fast.params)
+    for k in f1:
+        np.testing.assert_allclose(np.asarray(f2[k]), np.asarray(f1[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
