@@ -346,21 +346,33 @@ class Trainer:
         return results
 
     def test(self, reader, evaluators: Sequence[Evaluator] = ()):
-        """One evaluation pass (Tester::testOnePeriod twin)."""
+        """One evaluation pass (Tester::testOnePeriod twin).
+
+        Without evaluators (nothing consumes per-batch outputs on the
+        host) the per-batch ``float(loss)`` syncs defer to the end of
+        the pass — losses accumulate as device values and transfer once.
+        """
         for e in evaluators:
             e.start()
         losses = []
-        n = 0
         for batch in reader():
             batch = self._put(batch)
             loss, outputs = self._eval_step(self.params, self.net_state,
                                             batch)
-            losses.append(float(loss))
-            for e in evaluators:
-                e.update({**outputs, **{k: batch[k] for k in batch}})
-            n += 1
+            if evaluators:
+                losses.append(float(loss))
+                for e in evaluators:
+                    e.update({**outputs, **{k: batch[k] for k in batch}})
+            else:
+                losses.append(loss)          # device value; sync below
+        has_losses = bool(losses)
+        if has_losses and not evaluators:
+            losses = np.asarray(jnp.stack(losses))   # ONE host transfer
         results = {f"test_{e.name}": e.finish() for e in evaluators}
-        results["test_cost"] = float(np.mean(losses)) if losses else 0.0
+        # float64 mean on both paths (the evaluator path averages Python
+        # floats, which numpy accumulates in float64)
+        results["test_cost"] = (float(np.mean(losses, dtype=np.float64))
+                                if has_losses else 0.0)
         return results
 
     # ---- persistence (ParamUtil twin) ----

@@ -171,3 +171,18 @@ def test_fast_pass_matches_eventful_pass():
     for k in f1:
         np.testing.assert_allclose(np.asarray(f2[k]), np.asarray(f1[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_eval_pass_deferred_sync_matches():
+    """test() without evaluators defers loss syncs; the mean must match
+    the evaluator-path mean."""
+    rs = np.random.RandomState(2)
+    batches = [{"image": rs.randn(8, 784).astype(np.float32),
+                "label": rs.randint(0, 10, 8).astype(np.int32)}
+               for _ in range(5)]
+    t = _make_trainer()
+    t.init(batches[0])
+    r_plain = t.test(lambda: iter(batches))
+    r_eval = t.test(lambda: iter(batches), [ClassificationError()])
+    np.testing.assert_allclose(r_plain["test_cost"], r_eval["test_cost"],
+                               rtol=1e-6)
