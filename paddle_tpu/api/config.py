@@ -20,11 +20,12 @@ function has no topology proto).
 
 from __future__ import annotations
 
+import contextlib
 import importlib.util
 from typing import Any, Dict, Optional, Union
 
 from paddle_tpu.core.config import OptimizationConfig
-from paddle_tpu.core.errors import enforce
+from paddle_tpu.core.errors import ConfigError, enforce
 
 # config_args of the module currently executing (get_config_arg reads it).
 _current_config_args: Dict[str, str] = {}
@@ -67,6 +68,21 @@ def get_config_arg(name: str, type_=str, default=None):
     return default
 
 
+@contextlib.contextmanager
+def _dir_on_sys_path(d):
+    """Temporarily prepend ``d`` to sys.path (no-op if absent or already
+    there)."""
+    import sys
+    inserted = bool(d) and d not in sys.path
+    if inserted:
+        sys.path.insert(0, d)
+    try:
+        yield
+    finally:
+        if inserted and d in sys.path:
+            sys.path.remove(d)
+
+
 def load_config_module(path: str, config_args: str = ""):
     """Execute a config file with config_args available via
     :func:`get_config_arg` during execution, plus the post-exec
@@ -82,16 +98,16 @@ def load_config_module(path: str, config_args: str = ""):
     prev_recorded = dict(_recorded)
     _current_config_args = kv
     _recorded.clear()
-    # The config's directory joins sys.path (the reference ran configs
-    # with their directory importable), so provider modules next to the
-    # config resolve no matter the caller's cwd.
+    # The config's directory joins sys.path for the exec window (the
+    # reference ran configs with their directory importable), so provider
+    # modules next to the config resolve no matter the caller's cwd —
+    # but scoped, so they can't shadow installed packages (or a later
+    # config's same-named provider) for the rest of the process.
     import os
-    import sys
     cfg_dir = os.path.dirname(os.path.abspath(path))
-    if cfg_dir not in sys.path:
-        sys.path.insert(0, cfg_dir)
     try:
-        spec.loader.exec_module(module)
+        with _dir_on_sys_path(cfg_dir):
+            spec.loader.exec_module(module)
         # This module's DSL side effects ride on the module itself, so
         # nested config loads (and the restore below) cannot clobber them
         # before synthesize() runs.
@@ -214,7 +230,8 @@ def _resolve_list(path: str, base_dir: Optional[str] = None):
     return [cand]
 
 
-def _check_data_declarations(cost, rec: Dict[str, Any]) -> None:
+def _check_data_declarations(cost, rec: Dict[str, Any],
+                             cfg_dir: Optional[str] = None) -> None:
     """``data_layer`` infers sequence-ness/dtype from the provider
     declaration AT CALL TIME, so a config that calls
     define_py_data_sources2 after building its layers gets silently wrong
@@ -225,12 +242,23 @@ def _check_data_declarations(cost, rec: Dict[str, Any]) -> None:
         return
     import importlib
     try:
-        mod = (ds["module"] if not isinstance(ds["module"], str)
-               else importlib.import_module(ds["module"]))
+        with _dir_on_sys_path(cfg_dir):
+            mod = (ds["module"] if not isinstance(ds["module"], str)
+                   else importlib.import_module(ds["module"]))
+    except ImportError:
+        # Provider module not importable here (e.g. env-specific deps);
+        # the declaration cross-check is best-effort.
+        return
+    try:
         types = getattr(getattr(mod, ds["train_obj"]), "input_types",
                         None) or {}
-    except (ImportError, AttributeError):
-        return
+    except AttributeError:
+        # The module imported but the named provider object is absent —
+        # a misspelled obj= in define_py_data_sources2.  Report it
+        # against the data source, where the mistake was made.
+        raise ConfigError(
+            f"define_py_data_sources2: module {ds['module']!r} has no "
+            f"object {ds['train_obj']!r} (misspelled obj= name?)")
     if not isinstance(types, dict):
         return
     from paddle_tpu.api.graph import _walk
@@ -276,7 +304,8 @@ def synthesize(module) -> None:
             enforce(isinstance(cost, LayerOutput),
                     "config cost/outputs must be an api.layer node")
             module.model_fn = compile_model(cost)
-            _check_data_declarations(cost, rec)
+            _check_data_declarations(
+                cost, rec, getattr(module, "__config_dir__", None))
     st = rec.get("settings")
     if st is not None and not hasattr(module, "optimizer"):
         from paddle_tpu import optim
@@ -286,10 +315,10 @@ def synthesize(module) -> None:
         import importlib
         from paddle_tpu.data import reader as rd
         batch_size = st.batch_size if st is not None else 32
-        mod = (ds["module"] if not isinstance(ds["module"], str)
-               else importlib.import_module(ds["module"]))
-
         cfg_dir = getattr(module, "__config_dir__", None)
+        with _dir_on_sys_path(cfg_dir):
+            mod = (ds["module"] if not isinstance(ds["module"], str)
+                   else importlib.import_module(ds["module"]))
 
         def make_reader(list_path, obj_name):
             factory = getattr(mod, obj_name)

@@ -171,17 +171,33 @@ def grumemory(input, size: int, reverse: bool = False,
                  reverse=reverse, _name=n)
 
 
-def seq_pool(input, pool_type: str = "avg", name: Optional[str] = None):
+def seq_pool(input, pool_type: str = "avg", name: Optional[str] = None,
+             agg_level: Optional[str] = None):
     """Sequence pooling (pooling_layer twin).  Flat sequences pool to a
     fixed vector; NESTED sequences ([b,o,i,...], [b,o,i] mask) pool each
     sub-sequence, yielding a flat sequence — the reference's pooling at
-    ``AggregateLevel.EACH_SEQUENCE``."""
+    ``AggregateLevel.EACH_SEQUENCE``.
+
+    The level is implied by the input's nesting; an explicit ``agg_level``
+    ("seq" / "non-seq") is validated against it so a config expecting the
+    OTHER semantics errors instead of silently training differently."""
     def run(ctx, x, **a):
         enforce(_is_seq(x), "seq_pool needs a sequence input")
-        if x[1].ndim == 3:
+        nested = x[1].ndim == 3
+        lvl = a["agg_level"]
+        if lvl is not None:
+            want_nested = lvl in ("seq", "each-sequence")
+            enforce(want_nested == nested,
+                    "seq_pool: agg_level=%r but the input is a %s "
+                    "sequence — here the aggregation level follows the "
+                    "input's nesting (flat pools to a vector, nested "
+                    "pools each sub-sequence)",
+                    lvl, "nested" if nested else "flat")
+        if nested:
             return nested_ops.nested_pool(x[0], x[1], a["pool_type"])
         return seq_ops.sequence_pool(x[0], x[1], a["pool_type"])
-    return _node("seq_pool", run, [input], name=name, pool_type=pool_type)
+    return _node("seq_pool", run, [input], name=name, pool_type=pool_type,
+                 agg_level=agg_level)
 
 
 def seq_reshape(input, inner: Optional[int] = None,

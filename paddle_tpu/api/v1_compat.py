@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from paddle_tpu.api import layer as _L
 from paddle_tpu.api.graph import LayerOutput                        # noqa: F401
+from paddle_tpu.core.errors import ConfigError
 from paddle_tpu.api.recurrent import (GeneratedInput, StaticInput,  # noqa: F401
                                       beam_search, memory,
                                       recurrent_group)
@@ -105,7 +106,11 @@ def data_layer(name, size=None, type=None, dtype: str = "float32",
                        else importlib.import_module(ds["module"]))
                 types = getattr(getattr(mod, ds["train_obj"]),
                                 "input_types", None) or {}
-            except ImportError:
+            except (ImportError, AttributeError):
+                # AttributeError too: a misspelled obj name in
+                # define_py_data_sources2 should surface in
+                # _check_data_declarations (which reports it against the
+                # data source), not as a crash inside data_layer.
                 types = {}
             spec = types.get(name) if isinstance(types, dict) else None
             if spec is not None:
@@ -133,27 +138,71 @@ gru_step_naive_layer = _L.gru_step_naive
 get_output_layer = _L.get_output
 
 
-class _PoolingType:
+class BasePoolingType:
+    """v1 pooling-type object (``trainer_config_helpers/poolings.py:23``)."""
+
     def __init__(self, kind):
         self.kind = kind
 
 
-MaxPooling = lambda: _PoolingType("max")       # noqa: E731
-AvgPooling = lambda: _PoolingType("avg")       # noqa: E731
-SumPooling = lambda: _PoolingType("sum")       # noqa: E731
+class MaxPooling(BasePoolingType):
+    def __init__(self, output_max_index=None):
+        if output_max_index:
+            raise ConfigError(
+                "MaxPooling(output_max_index=True) is not supported: the "
+                "TPU build pools values, not argmax indices")
+        super().__init__("max")
 
 
-def pooling_layer(input, pooling_type=None, name=None, **kwargs):
+class AvgPooling(BasePoolingType):
+    STRATEGY_AVG = "average"
+    STRATEGY_SUM = "sum"
+    STRATEGY_SQROOTN = "squarerootn"
+
+    def __init__(self, strategy=STRATEGY_AVG):
+        kinds = {self.STRATEGY_AVG: "avg", self.STRATEGY_SUM: "sum",
+                 self.STRATEGY_SQROOTN: "sqrt"}
+        if strategy not in kinds:
+            raise ConfigError(
+                f"AvgPooling strategy {strategy!r} unknown "
+                f"(valid: {sorted(kinds)})")
+        super().__init__(kinds[strategy])
+
+
+class SumPooling(AvgPooling):
+    def __init__(self):
+        super().__init__(AvgPooling.STRATEGY_SUM)
+
+
+class SquareRootNPooling(AvgPooling):
+    def __init__(self):
+        super().__init__(AvgPooling.STRATEGY_SQROOTN)
+
+
+def pooling_layer(input, pooling_type=None, name=None, agg_level=None,
+                  stride=-1, **kwargs):
     """Sequence pooling with the v1 default (MaxPooling when
     ``pooling_type`` is omitted — ``layers.py:1376``); accepts the v1
-    pooling-type objects or plain strings."""
+    pooling-type objects or plain strings.
+
+    ``agg_level`` is decided by the input's nesting here (flat sequences
+    pool to a vector, nested ones pool each sub-sequence), so an explicit
+    level is validated against the input at run time; sliding-window
+    pooling (``stride > 0``, reference ``layers.py:1353``) has no twin and
+    errors rather than silently training different semantics."""
+    if stride is not None and stride > 0:
+        raise ConfigError(
+            "pooling_layer(stride>0) sliding-window pooling is not "
+            "supported in the TPU build (only whole-/sub-sequence "
+            "aggregation); got stride=%r" % (stride,))
     if pooling_type is None:
         kind = "max"
     elif isinstance(pooling_type, str):
         kind = pooling_type
     else:
         kind = pooling_type.kind
-    return _L.seq_pool(input, pool_type=kind, name=name)
+    return _L.seq_pool(input, pool_type=kind, name=name,
+                       agg_level=agg_level)
 seq_reshape_layer = _L.seq_reshape
 seq_concat_layer = _L.seq_concat
 seq_slice_layer = _L.seq_slice
@@ -284,9 +333,7 @@ LogActivation = _act("log")
 SqrtActivation = _act("sqrt")
 ReciprocalActivation = _act("reciprocal")
 
-# poolings.py (MaxPooling/AvgPooling/SumPooling defined above)
-BasePoolingType = _PoolingType
-SquareRootNPooling = lambda: _PoolingType("sqrt")   # noqa: E731
+# poolings.py (pooling-type classes defined above)
 CudnnMaxPooling = MaxPooling        # vendor-specific impls collapse on TPU
 CudnnAvgPooling = AvgPooling
 

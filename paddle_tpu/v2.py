@@ -32,6 +32,7 @@ signature (``python/paddle/v2/trainer.py:50``).
 from __future__ import annotations
 
 import io
+import struct
 import tarfile
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -218,6 +219,49 @@ optimizer = _OptimizerNS()
 # param tree with tar serialization.
 # ---------------------------------------------------------------------------
 
+def _parameter_config_dims(buf: bytes) -> List[int]:
+    """Extract ``dims`` (field 9, repeated uint64) from a serialized
+    ParameterConfig message (``proto/ParameterConfig.proto:34-46``) with a
+    minimal protobuf wire-format walk — no protobuf dependency."""
+    def varint(i):
+        v = s = 0
+        while i < len(buf):
+            b = buf[i]
+            v |= (b & 0x7F) << s
+            s += 7
+            i += 1
+            if not b & 0x80:
+                return v, i
+        raise ValueError("ParameterConfig: truncated varint")
+
+    dims: List[int] = []
+    i = 0
+    while i < len(buf):
+        key, i = varint(i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:                      # varint
+            v, i = varint(i)
+            if field == 9:
+                dims.append(v)
+        elif wire == 1:                    # 64-bit
+            i += 8
+        elif wire == 2:                    # length-delimited
+            n, i = varint(i)
+            if field == 9:                 # packed repeated uint64
+                end = i + n
+                while i < end:
+                    v, i = varint(i)
+                    dims.append(v)
+            else:
+                i += n
+        elif wire == 5:                    # 32-bit
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire} in "
+                             "ParameterConfig")
+    return dims
+
+
 class Parameters:
     def __init__(self):
         self._trainer = None       # bound by trainer.SGD
@@ -304,9 +348,32 @@ class Parameters:
 
     @staticmethod
     def from_tar(fobj) -> "Parameters":
+        """Load a parameters tar — either this framework's ``.npy``-member
+        layout (``to_tar`` above) or the reference's
+        (``v2/parameters.py:323-341``: per-param member of 16-byte
+        ``struct IIQ`` header + raw float32 bytes, plus a
+        ``<name>.protobuf`` ParameterConfig member carrying the dims) —
+        so models trained with the reference deploy here unchanged."""
         params = Parameters()
         with tarfile.open(fileobj=fobj, mode="r") as tar:
-            for member in tar.getmembers():
+            members = tar.getmembers()
+            proto_members = {m.name[:-len(".protobuf")]: m for m in members
+                             if m.name.endswith(".protobuf")}
+            if proto_members:
+                for name, pm in proto_members.items():
+                    dims = _parameter_config_dims(
+                        tar.extractfile(pm).read())
+                    raw = tar.extractfile(name).read()
+                    _ver, vsize, count = struct.unpack("<IIQ", raw[:16])
+                    enforce(vsize == 4,
+                            "reference tar %r: unsupported value size %d "
+                            "(only float32 tars exist)", name, vsize)
+                    arr = np.frombuffer(
+                        raw[16:16 + 4 * count], dtype="<f4").copy()
+                    params._pending[name] = (
+                        arr.reshape(dims) if dims else arr)
+                return params
+            for member in members:
                 name = member.name
                 if name.endswith(".npy"):
                     name = name[:-4]

@@ -95,3 +95,125 @@ def test_poly_schedule_has_no_power_param():
     from paddle_tpu.optim import schedules
     with pytest.raises(TypeError):
         schedules.poly(0.1, 0.01, 0.5, power=-0.5)
+
+
+def test_v1_pooling_types_accept_reference_kwargs():
+    """Reference poolings.py classes take kwargs (MaxPooling(
+    output_max_index=...), AvgPooling(strategy=...)); the compat twins
+    must accept them, and unsupported semantics must error, not silently
+    train differently."""
+    from paddle_tpu.api import v1_compat as v1
+    from paddle_tpu.core.errors import ConfigError
+
+    assert v1.MaxPooling(output_max_index=None).kind == "max"
+    assert v1.AvgPooling().kind == "avg"
+    assert v1.AvgPooling(strategy=v1.AvgPooling.STRATEGY_SUM).kind == "sum"
+    assert v1.SumPooling().kind == "sum"
+    assert v1.SquareRootNPooling().kind == "sqrt"
+    assert v1.CudnnAvgPooling().kind == "avg"
+    with pytest.raises(ConfigError):
+        v1.MaxPooling(output_max_index=True)
+    with pytest.raises(ConfigError):
+        v1.AvgPooling(strategy="nope")
+    with pytest.raises(ConfigError):
+        v1.pooling_layer(None, stride=5)
+
+
+def test_load_config_module_scopes_sys_path():
+    import sys
+    from paddle_tpu.api.config import load_config_module
+
+    cfg = tmp = None
+    import tempfile, os, textwrap
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = os.path.join(tmp, "cfg.py")
+        with open(cfg, "w") as f:
+            f.write(textwrap.dedent("""
+                import sys, os
+                assert os.path.dirname(os.path.abspath(__file__)) in sys.path
+                x = 1
+            """))
+        mod = load_config_module(cfg)
+        assert mod.x == 1
+        assert tmp not in sys.path
+
+
+def test_seq_pool_validates_explicit_agg_level():
+    """pooling_layer(agg_level=...) must error when the requested level
+    conflicts with the input's nesting (reference pools nested input to
+    ONE vector at TO_NO_SEQUENCE; here nesting decides, so silence would
+    mean different semantics)."""
+    import numpy as np
+    from paddle_tpu.api import layer as L
+    from paddle_tpu.api import v1_compat as v1
+    from paddle_tpu.api.graph import _Ctx, _evaluate
+    from paddle_tpu.core.errors import EnforceError
+    from paddle_tpu.api.graph import reset_names
+
+    def run(node, feed):
+        return _evaluate(node, _Ctx(feed, False))
+
+    reset_names()
+    d = L.data("x", sequence=True)
+    ok = v1.pooling_layer(d, v1.AvgPooling(),
+                          agg_level=v1.AggregateLevel.TO_NO_SEQUENCE)
+    bad = v1.pooling_layer(d, v1.AvgPooling(),
+                           agg_level=v1.AggregateLevel.TO_SEQUENCE)
+    feed = {"x": np.ones((2, 3, 4), np.float32),
+            "x_mask": np.ones((2, 3), bool)}
+    assert np.asarray(run(ok, feed)).shape == (2, 4)
+    with pytest.raises(EnforceError):
+        run(bad, feed)
+
+
+def test_reference_tar_multibyte_dims_and_writable():
+    """Varint dims >= 128 decode correctly (multi-byte shift) and loaded
+    arrays are writable (frombuffer alone aliases read-only bytes)."""
+    import io
+    import struct
+    import tarfile
+
+    import numpy as np
+    import paddle_tpu.v2 as paddle
+
+    def varint(v):
+        out = b""
+        while True:
+            b7, v = v & 0x7F, v >> 7
+            out += bytes([b7 | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    val = np.arange(300 * 2, dtype=np.float32).reshape(300, 2)
+    pb = (bytes([0x0A]) + varint(1) + b"w"
+          + bytes([0x10]) + varint(val.size)
+          + bytes([0x48]) + varint(300) + bytes([0x48]) + varint(2))
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        raw = struct.pack("<IIQ", 0, 4, val.size) + val.tobytes()
+        i = tarfile.TarInfo("w")
+        i.size = len(raw)
+        tar.addfile(i, io.BytesIO(raw))
+        i = tarfile.TarInfo("w.protobuf")
+        i.size = len(pb)
+        tar.addfile(i, io.BytesIO(pb))
+    buf.seek(0)
+    p = paddle.Parameters.from_tar(buf)
+    got = p["w"]
+    assert got.shape == (300, 2)
+    np.testing.assert_array_equal(got, val)
+    p._pending["w"][:] = 0            # must be writable, not a bytes alias
+    assert not p._pending["w"].any()
+
+
+def test_misspelled_provider_obj_reports_config_error():
+    from paddle_tpu.api import config as cfg_mod
+    from paddle_tpu.api.config import _check_data_declarations
+    from paddle_tpu.core.errors import ConfigError
+
+    rec = {"data_sources": {
+        "module": "os", "train_obj": "no_such_process_fn",
+        "test_obj": "no_such_process_fn", "args": {},
+        "train_list": "x", "test_list": None}}
+    with pytest.raises(ConfigError, match="no_such_process_fn"):
+        _check_data_declarations(None, rec)

@@ -237,3 +237,55 @@ def test_sparse_binary_sequence_feeder():
     assert out["s"][0, 0, 0] == 1.0 and out["s"][0, 0, 2] == 1.0
     assert out["s"][1, 0, 4] == 1.0
     assert out["s_mask"].tolist() == [[True, True], [True, False]]
+
+
+def test_from_tar_reads_reference_layout():
+    """The reference's Parameters.to_tar (v2/parameters.py:323-341) writes
+    per-param members of 16-byte IIQ header + raw f32 bytes plus a
+    <name>.protobuf ParameterConfig; a tar in that exact layout must load
+    (the canonical deploy path for a reference-trained model)."""
+    import struct
+    import tarfile
+
+    def proto_bytes(name, size, dims, packed=False):
+        # hand-encoded ParameterConfig: name (field 1, bytes), size
+        # (field 2, varint), dims (field 9, repeated uint64)
+        def varint(v):
+            out = b""
+            while True:
+                b7, v = v & 0x7F, v >> 7
+                out += bytes([b7 | (0x80 if v else 0)])
+                if not v:
+                    return out
+        msg = bytes([0x0A]) + varint(len(name)) + name.encode()
+        msg += bytes([0x10]) + varint(size)
+        if packed:
+            payload = b"".join(varint(d) for d in dims)
+            msg += bytes([0x4A]) + varint(len(payload)) + payload
+        else:
+            for d in dims:
+                msg += bytes([0x48]) + varint(d)
+        return msg
+
+    rs = np.random.RandomState(7)
+    values = {"___fc_layer_0__.w0": rs.randn(32, 4).astype(np.float32),
+              "___fc_layer_0__.wbias": rs.randn(1, 4).astype(np.float32)}
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for i, (name, val) in enumerate(sorted(values.items())):
+            raw = struct.pack("IIQ", 0, 4, val.size) + val.tobytes()
+            info = tarfile.TarInfo(name=name)
+            info.size = len(raw)
+            tar.addfile(info, io.BytesIO(raw))
+            pb = proto_bytes(name, val.size, val.shape, packed=bool(i % 2))
+            info = tarfile.TarInfo(name=name + ".protobuf")
+            info.size = len(pb)
+            tar.addfile(info, io.BytesIO(pb))
+    buf.seek(0)
+
+    loaded = paddle.Parameters.from_tar(buf)
+    assert sorted(loaded.names()) == sorted(values)
+    for name, val in values.items():
+        got = loaded[name]
+        assert got.shape == val.shape and got.dtype == np.float32
+        np.testing.assert_array_equal(got, val)
