@@ -325,7 +325,17 @@ def synthesize(module) -> None:
             dp = factory(_resolve_list(list_path, cfg_dir), **ds["args"])
             feeder = dp.feeder()
             base = rd.batch(dp, batch_size, drop_last=False)
-            return lambda: (feeder(b) for b in base())
+
+            def reader():
+                # Provider generators may lazy-import sibling modules
+                # inside their bodies (common in reference demo
+                # providers), so the config dir must be importable for
+                # the whole iteration, not just the synthesize window.
+                with _dir_on_sys_path(cfg_dir):
+                    for b in base():
+                        yield feeder(b)
+
+            return reader
 
         if ds["train_list"] and not hasattr(module, "train_reader"):
             module.train_reader = make_reader(ds["train_list"],

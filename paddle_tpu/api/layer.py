@@ -186,7 +186,10 @@ def seq_pool(input, pool_type: str = "avg", name: Optional[str] = None,
         nested = x[1].ndim == 3
         lvl = a["agg_level"]
         if lvl is not None:
-            want_nested = lvl in ("seq", "each-sequence")
+            enforce(lvl in ("seq", "non-seq"),
+                    "seq_pool: unknown agg_level %r (valid: 'seq', "
+                    "'non-seq' — the AggregateLevel constants)", lvl)
+            want_nested = lvl == "seq"
             enforce(want_nested == nested,
                     "seq_pool: agg_level=%r but the input is a %s "
                     "sequence — here the aggregation level follows the "

@@ -179,17 +179,21 @@ class SquareRootNPooling(AvgPooling):
         super().__init__(AvgPooling.STRATEGY_SQROOTN)
 
 
-def pooling_layer(input, pooling_type=None, name=None, agg_level=None,
+def pooling_layer(input, pooling_type=None, name=None,
+                  agg_level=AggregateLevel.TO_NO_SEQUENCE,
                   stride=-1, **kwargs):
     """Sequence pooling with the v1 default (MaxPooling when
     ``pooling_type`` is omitted — ``layers.py:1376``); accepts the v1
     pooling-type objects or plain strings.
 
-    ``agg_level`` is decided by the input's nesting here (flat sequences
-    pool to a vector, nested ones pool each sub-sequence), so an explicit
-    level is validated against the input at run time; sliding-window
-    pooling (``stride > 0``, reference ``layers.py:1353``) has no twin and
-    errors rather than silently training different semantics."""
+    ``agg_level`` defaults to the reference's TO_NO_SEQUENCE
+    (``layers.py:1347``) and is validated against the input's nesting at
+    run time — a nested input with the default level pools differently in
+    the reference (one vector for the whole nested sequence) than this
+    build's nesting-follows-input rule, so it errors instead of silently
+    training different semantics (pass EACH_SEQUENCE for per-sub-sequence
+    pooling).  Sliding-window pooling (``stride > 0``, reference
+    ``layers.py:1353``) has no twin and errors likewise."""
     if stride is not None and stride > 0:
         raise ConfigError(
             "pooling_layer(stride>0) sliding-window pooling is not "

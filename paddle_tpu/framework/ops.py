@@ -232,17 +232,32 @@ def _conv2d(x, w, stride=1, padding=0, groups=1):
 register_op("conv2d", _conv2d, ["Input", "Filter"])
 
 
-def _pool2d(x, ksize=2, stride=2, padding=0, pooling_type="max"):
-    k = (ksize, ksize) if isinstance(ksize, int) else tuple(ksize)
-    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    p = ((0, 0), (0, 0), (padding, padding), (padding, padding)) \
-        if isinstance(padding, int) else ((0, 0), (0, 0)) + tuple(padding)
+def _pool_window(ksize, stride, padding, nsp):
+    """Normalize pool attrs to n-spatial-dim window/stride/padding tuples
+    (batch and channel leading)."""
+    k = (ksize,) * nsp if isinstance(ksize, int) else tuple(ksize)
+    s = (stride,) * nsp if isinstance(stride, int) else tuple(stride)
+    p = (((padding, padding),) * nsp if isinstance(padding, int)
+         else tuple(padding))
+    return k, s, p
+
+
+def _pool_nd(x, ksize, stride, padding, pooling_type, nsp):
+    """Shared max/avg window pooling (pool_op.cc kernels; NC + nsp spatial
+    dims).  Average pooling excludes padding (count = valid cells)."""
+    k, s, p = _pool_window(ksize, stride, padding, nsp)
     dims, strides = (1, 1) + k, (1, 1) + s
+    pads = ((0, 0), (0, 0)) + p
     if pooling_type == "max":
-        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, p)
-    total = lax.reduce_window(x, 0.0, lax.add, dims, strides, p)
-    ones = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, p)
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+    total = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    ones = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides,
+                             pads)
     return total / ones
+
+
+def _pool2d(x, ksize=2, stride=2, padding=0, pooling_type="max"):
+    return _pool_nd(x, ksize, stride, padding, pooling_type, 2)
 
 
 register_op("pool2d", _pool2d, ["X"])
@@ -434,3 +449,145 @@ register_op("decayed_adagrad",
              (decay * mom + (1 - decay) * jnp.square(g))),
             ["Param", "Grad", "Moment", "LearningRate"],
             out_slots=("ParamOut", "MomentOut"))
+
+
+# ---------------------------------------------------------------------------
+# op-zoo tail (round 2): the remaining REGISTER_OP names from
+# paddle/operators/ — prelu_op.cc, cos_sim_op.cc, conv_shift_op.cc,
+# modified_huber_loss_op.cc, interp_op.cc, pool_op.cc (pool3d),
+# pool_with_index_op.cc, activation_op.cc (hard_sigmoid/thresholded_relu),
+# feed_op.cc / fetch_op.cc / identity_op.cc / conv_cudnn_op.cc.
+# ---------------------------------------------------------------------------
+register_op("prelu", lambda x, alpha: jnp.where(x > 0, x, alpha * x),
+            ["X", "Alpha"])
+register_op("hard_sigmoid", lambda x, slope=0.2, offset=0.5:
+            jnp.clip(slope * x + offset, 0.0, 1.0), ["X"])
+register_op("thresholded_relu", lambda x, threshold=1.0:
+            jnp.where(x > threshold, x, 0.0), ["X"])
+# identity_op.cc routes through scale with scale=1; keep the literal name.
+register_op("identity", lambda x: x, ["X"])
+# conv_cudnn is the vendor-kernel alias of conv2d; on TPU both are XLA's
+# native conv lowering.
+register_op("conv_cudnn", _conv2d, ["Input", "Filter"])
+
+
+def _cos_sim(x, y, epsilon=1e-12):
+    """cos_sim_op.cc: per-row cosine similarity; Y broadcasts when its
+    batch is 1.  [b, d], [b|1, d] -> [b, 1]."""
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    dot = jnp.sum(x * y, -1, keepdims=True)
+    return dot / jnp.maximum(xn * yn, epsilon)
+
+
+register_op("cos_sim", _cos_sim, ["X", "Y"])
+
+
+def _conv_shift(x, y):
+    """conv_shift_op.cc (NTM circular convolution):
+    Out[b, i] = sum_{j=-(N-1)/2}^{(N-1)/2} X[b, (i+j) mod M] * Y[b, j mod N].
+    N is odd and small (a shift window), so unrolling at trace time keeps
+    this a handful of fused rolls instead of a gather."""
+    n = y.shape[1]
+    half = (n - 1) // 2
+    out = jnp.zeros_like(x)
+    for j in range(-half, half + 1):
+        out = out + jnp.roll(x, -j, axis=1) * y[:, j % n][:, None]
+    return out
+
+
+register_op("conv_shift", _conv_shift, ["X", "Y"])
+
+
+def _modified_huber_loss(x, y):
+    """modified_huber_loss_op.cc: y in {0,1}; z = x * (2y-1);
+    loss = max(0, 1-z)^2 for z >= -1, else -4z."""
+    z = x.reshape(x.shape[0]) * (2.0 * y.reshape(y.shape[0]) - 1.0)
+    sq = jnp.square(jnp.maximum(0.0, 1.0 - z))
+    return jnp.where(z >= -1.0, sq, -4.0 * z).reshape(x.shape[0], 1)
+
+
+register_op("modified_huber_loss", _modified_huber_loss, ["X", "Y"])
+
+
+def _interp(x, y, w):
+    """interp_op.cc: Out.row[i] = X.row[i] * W[i] + Y.row[i] * (1 - W[i])."""
+    w = w.reshape(-1, *([1] * (x.ndim - 1)))
+    return x * w + y * (1.0 - w)
+
+
+register_op("interp", _interp, ["X", "Y", "W"])
+
+
+def _pool3d(x, ksize=2, stride=2, padding=0, pooling_type="max"):
+    """pool_op.cc pool3d kernel: NCDHW, max/avg over d×h×w windows."""
+    return _pool_nd(x, ksize, stride, padding, pooling_type, 3)
+
+
+register_op("pool3d", _pool3d, ["X"])
+
+
+def _max_pool_with_index(x, ksize, stride, padding, nsp):
+    """Shared max_pool{2,3}d_with_index kernel (pool_with_index_op.cc):
+    returns (Out, Mask) where Mask is the argmax's flat offset within each
+    input's spatial plane — exactly the reference's mask convention
+    (math/pooling.cc:545).  Patches come from XLA's native patch
+    extraction, so the argmax runs as one fused reduce."""
+    b, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    k, s, p = _pool_window(ksize, stride, padding, nsp)
+    if any(lo or hi for lo, hi in p):
+        # conv_general_dilated_patches zero-pads; max pooling must never
+        # select a padded cell (all-negative borders would pool to 0.0
+        # with an out-of-plane mask index).  Pad with the dtype's finite
+        # minimum — NOT -inf: patch extraction runs as a one-hot
+        # convolution, and 0 * -inf = NaN — and extract patches unpadded;
+        # coordinates below subtract p[d][0].
+        x = jnp.pad(x, ((0, 0), (0, 0)) + p,
+                    constant_values=jnp.finfo(x.dtype).min)
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=((0, 0),) * nsp)
+    out_sp = patches.shape[2:]
+    kprod = int(math.prod(k))
+    # conv_general_dilated_patches yields [b, c*prod(k), *out_sp] with the
+    # channel-major ordering (c outer, window offsets inner).
+    patches = patches.reshape(b, c, kprod, *out_sp)
+    idx = jnp.argmax(patches, axis=2)
+    out = jnp.max(patches, axis=2)
+    # window-offset index -> input-plane flat index
+    koff = jnp.unravel_index(idx, k)
+    grids = jnp.meshgrid(*[jnp.arange(o) for o in out_sp], indexing="ij")
+    flat = jnp.zeros_like(idx)
+    for d in range(nsp):
+        in_coord = grids[d] * s[d] - p[d][0] + koff[d]
+        flat = flat * spatial[d] + in_coord
+    return out, flat
+
+
+register_op(
+    "max_pool2d_with_index",
+    lambda x, ksize=2, stride=2, padding=0:
+    _max_pool_with_index(x, ksize, stride, padding, 2),
+    ["X"], out_slots=("Out", "Mask"))
+register_op(
+    "max_pool3d_with_index",
+    lambda x, ksize=2, stride=2, padding=0:
+    _max_pool_with_index(x, ksize, stride, padding, 3),
+    ["X"], out_slots=("Out", "Mask"))
+
+
+def _feed(x, col=0):
+    """feed_op.cc: copy a feed-list entry into the target variable.  The
+    executor materializes feeds directly into the scope, so the op itself
+    is data movement only."""
+    return x
+
+
+def _fetch(x, col=0):
+    """fetch_op.cc twin; see _feed."""
+    return x
+
+
+register_op("feed", _feed, ["X"])
+register_op("fetch", _fetch, ["X"])

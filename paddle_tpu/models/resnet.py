@@ -78,14 +78,28 @@ _CONFIGS = {
 
 
 class ResNet(nn.Module):
-    def __init__(self, depth: int = 50, num_classes: int = 1000, name=None):
+    def __init__(self, depth: int = 50, num_classes: int = 1000,
+                 stem: str = "conv7", name=None):
+        """``stem``: "conv7" (the reference's 7x7/2 conv) or "s2d" —
+        space-to-depth the image 2x2 -> [h/2, w/2, 12] and run a 4x4/1
+        conv (the MLPerf-TPU stem transform: same downsampling, an 8x8
+        receptive field superset of 7x7, and a 192-wide contraction the
+        MXU tiles far better than 7x7x3=147 over a 3-channel input)."""
         super().__init__(name)
         self.block_cls, self.stages = _CONFIGS[depth]
         self.num_classes = num_classes
+        self.stem = stem
 
     def forward(self, images):
         """images: [b, h, w, 3] NHWC."""
-        x = nn.Conv2D(64, 7, stride=2, bias=False, name="conv0")(images)
+        if self.stem == "s2d":
+            b, h, w, c = images.shape
+            x = images.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                      4 * c)
+            x = nn.Conv2D(64, 4, stride=1, bias=False, name="conv0")(x)
+        else:
+            x = nn.Conv2D(64, 7, stride=2, bias=False, name="conv0")(images)
         x = nn.BatchNorm(act="relu", name="bn0")(x)
         x = nn.Pool2D(3, stride=2, padding=(1, 1), name="pool0")(x)
         filters = 64
@@ -99,9 +113,11 @@ class ResNet(nn.Module):
         return nn.Linear(self.num_classes, name="fc")(x)
 
 
-def model_fn_builder(depth: int = 50, num_classes: int = 1000):
+def model_fn_builder(depth: int = 50, num_classes: int = 1000,
+                     stem: str = "conv7"):
     def model_fn(batch):
-        logits = ResNet(depth, num_classes, name="resnet")(batch["image"])
+        logits = ResNet(depth, num_classes, stem=stem,
+                        name="resnet")(batch["image"])
         loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
         return loss, {"logits": logits, "label": batch["label"]}
     return model_fn

@@ -70,7 +70,7 @@ class Conv2DTranspose(Module):
         y = policy.cast_to_output(y)
         if self.bias:
             b = param("b", (self.channels,), policy.param_dtype, init.zeros)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.act(y)
 
 
@@ -106,7 +106,7 @@ class Conv3D(Module):
         y = policy.cast_to_output(y)
         if self.bias:
             b = param("b", (self.channels,), policy.param_dtype, init.zeros)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.act(y)
 
 
@@ -340,7 +340,7 @@ class SelectiveFC(Module):
             y = policy.cast_to_output(
                 policy.cast_to_compute(x) @ policy.cast_to_compute(w))
             if b is not None:
-                y = y + b
+                y = y + b.astype(y.dtype)
             return self.act(y)
         w_sel = jnp.take(w, sel, axis=1)             # [in, batch, k]
         w_sel = jnp.moveaxis(w_sel, 1, 0)            # [batch, in, k]
@@ -424,7 +424,7 @@ class Addto(Module):
             y = y + v
         if self.bias:
             b = param("b", (y.shape[-1],), policy.param_dtype, init.zeros)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.act(y)
 
 
@@ -570,7 +570,7 @@ class Mixed(Module):
             y = out if y is None else y + out
         if self.bias:
             b = param("b", (y.shape[-1],), policy.param_dtype, init.zeros)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.act(y)
 
 
@@ -617,7 +617,7 @@ class TensorLayer(Module):
         y = policy.cast_to_output(y)
         if self.bias:
             b = param("b", (self.size,), policy.param_dtype, init.zeros)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return self.act(y)
 
 

@@ -88,7 +88,7 @@ class LSTM(Module):
         # One big MXU matmul for all timesteps; only the h-recurrence scans.
         xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
                         policy.cast_to_compute(w_x))
-        xw = policy.cast_to_output(xw) + bias
+        xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
 
         if initial_state is None:
             h0 = jnp.zeros((b, h), x.dtype)
@@ -173,7 +173,7 @@ class GRU(Module):
 
         xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
                         policy.cast_to_compute(w_x))
-        xw = policy.cast_to_output(xw) + bias
+        xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
 
         h0 = jnp.zeros((b, h), x.dtype) if initial_state is None else initial_state
         if mask is None:
@@ -238,11 +238,11 @@ class SimpleRNN(Module):
                         init.paddle_default())
             xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
                             policy.cast_to_compute(w_x))
-            xw = policy.cast_to_output(xw) + bias
+            xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
         else:
             enforce(d == h, "SimpleRNN(project_input=False): input width "
                     "%d must equal hidden %d", d, h)
-            xw = x + bias
+            xw = x + bias.astype(x.dtype)
         h0 = jnp.zeros((b, h), x.dtype) if initial_state is None else initial_state
         if mask is None:
             mask = jnp.ones((b, t), bool)

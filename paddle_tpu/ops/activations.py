@@ -68,6 +68,10 @@ def log_(x):
 
 
 def softmax(x, axis: int = -1):
+    # f32 island under the bf16 activation policy: the exp/sum chain on
+    # bf16 loses mass for wide distributions; result returns in x.dtype.
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
     return jax.nn.softmax(x, axis=axis)
 
 

@@ -150,4 +150,5 @@ class MultiHeadAttention(Module):
         out = jnp.matmul(policy.cast_to_compute(out),
                          policy.cast_to_compute(w_o))
         b_o = param("b_o", (dim,), policy.param_dtype, init.zeros)
-        return policy.cast_to_output(out) + b_o
+        out = policy.cast_to_output(out)
+        return out + b_o.astype(out.dtype)

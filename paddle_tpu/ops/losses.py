@@ -15,18 +15,38 @@ numerically-stable fused form the reference hand-wrote in CUDA.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.errors import enforce
 
 
+def _f32_island(fn):
+    """Losses are an f32 island under the bf16 activation policy: log/exp/
+    sum chains on bf16 logits lose precision the MXU never gave us back,
+    and per-example loss vectors are tiny — upcast every floating input."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        def up(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact):
+                return x.astype(jnp.float32)
+            return x
+        args = tuple(up(a) for a in args)
+        kwargs = {k: up(v) for k, v in kwargs.items()}
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+@_f32_island
 def square_error(pred, label):
     """0.5 * sum((pred-label)^2) per example (SumOfSquaresCostLayer)."""
     d = (pred - label).reshape(pred.shape[0], -1)
     return 0.5 * jnp.sum(jnp.square(d), axis=-1)
 
 
+@_f32_island
 def softmax_cross_entropy(logits, labels):
     """Fused softmax+CE from integer labels.  [b, n], [b] -> [b]."""
     lse = jax.nn.logsumexp(logits, axis=-1)
@@ -34,18 +54,21 @@ def softmax_cross_entropy(logits, labels):
     return lse - picked
 
 
+@_f32_island
 def softmax_cross_entropy_soft(logits, label_probs):
     """CE against a full label distribution (soft-label multi-class CE)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.sum(label_probs * logp, axis=-1)
 
 
+@_f32_island
 def cross_entropy(probs, labels, eps: float = 1e-8):
     """CE from probabilities (CrossEntropy over an upstream softmax layer)."""
     picked = jnp.take_along_axis(probs, labels[..., None], axis=-1)[..., 0]
     return -jnp.log(picked + eps)
 
 
+@_f32_island
 def sigmoid_cross_entropy(logits, targets):
     """Per-element binary CE from logits, summed over features
     (MultiBinaryLabelCrossEntropy / sigmoid_cross_entropy_with_logits op)."""
@@ -55,6 +78,7 @@ def sigmoid_cross_entropy(logits, targets):
     return per.reshape(per.shape[0], -1).sum(axis=-1)
 
 
+@_f32_island
 def huber_regression(pred, label, delta: float = 1.0):
     """Huber regression cost (HuberRegressionLoss)."""
     a = jnp.abs(pred - label)
@@ -63,6 +87,7 @@ def huber_regression(pred, label, delta: float = 1.0):
     return per.reshape(per.shape[0], -1).sum(axis=-1)
 
 
+@_f32_island
 def huber_classification(pred, label):
     """Huber two-class cost (HuberTwoClassification): label in {0,1}."""
     y = 2.0 * label - 1.0
@@ -71,6 +96,7 @@ def huber_classification(pred, label):
                      jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
 
 
+@_f32_island
 def smooth_l1(pred, label, sigma: float = 1.0):
     """Smooth-L1 (SmoothL1CostLayer / smooth_l1 op)."""
     s2 = sigma * sigma
@@ -79,6 +105,7 @@ def smooth_l1(pred, label, sigma: float = 1.0):
     return per.reshape(per.shape[0], -1).sum(axis=-1)
 
 
+@_f32_island
 def rank_cost(left, right, label):
     """Pairwise ranking cost (RankingCost, ``CostLayer.cpp``):
     -o*log(sigmoid(l-r)) - (1-o)*log(1-sigmoid(l-r)) from rating pair."""
@@ -87,6 +114,7 @@ def rank_cost(left, right, label):
         jnp.exp(-jnp.abs(diff)))
 
 
+@_f32_island
 def lambda_rank(scores, relevance, mask, ndcg_num: int = 5):
     """LambdaRank gradient-as-loss (LambdaCost.cpp), listwise per sequence.
 
@@ -115,6 +143,7 @@ def lambda_rank(scores, relevance, mask, ndcg_num: int = 5):
     return per.sum(axis=(1, 2))
 
 
+@_f32_island
 def nce_loss(embeddings, weights, bias, labels, noise_ids,
              label_logq, noise_logq):
     """Noise-contrastive estimation (NCELayer.cpp).
@@ -140,6 +169,7 @@ def nce_loss(embeddings, weights, bias, labels, noise_ids,
     return pos + neg.sum(axis=-1)
 
 
+@_f32_island
 def hierarchical_sigmoid(x, weights, bias, codes, code_signs, code_mask):
     """Hierarchical sigmoid cost (HierarchicalSigmoidLayer.cpp).
 

@@ -1,0 +1,130 @@
+"""Worker for the real multi-process distributed test.
+
+Launched (2 OS processes) by ``tests/test_multiprocess.py`` via
+``distributed.launch.launch_local`` — the twin of the reference's
+in-process distributed tests that actually serve traffic
+(``paddle/pserver/test/test_ParameterServer2.cpp:539``,
+``paddle/trainer/tests/test_TrainerOnePass.cpp:80`` cpu/gpu x {1,2,4}).
+
+Each process:
+  1. provisions a 2-device virtual CPU platform (4 global devices),
+  2. joins the JAX coordination service via ``runtime.initialize()``
+     (env contract from launch_local),
+  3. builds a global dp-mesh over all processes' devices,
+  4. runs jitted SGD train steps whose gradients psum over ``dp`` with
+     each process feeding only ITS shard of the global batch,
+  5. asserts every process converged to bit-identical parameters,
+  6. phase "train": saves a sharded checkpoint and exits;
+     phase "resume": restores the checkpoint into a fresh generation of
+     processes (a real preemption/resume cycle) and verifies the restored
+     params match what another two steps from scratch would give.
+"""
+
+import os
+import sys
+
+
+def _provision_cpu(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+
+
+def main() -> None:
+    phase = sys.argv[1]
+    ckpt_dir = sys.argv[2]
+    _provision_cpu(2)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import runtime
+
+    runtime.initialize()
+    assert runtime.process_count() == 2, runtime.process_count()
+    devices = jax.devices()
+    assert len(devices) == 4, devices
+    rank = runtime.process_index()
+
+    from paddle_tpu.parallel import make_mesh
+
+    mesh = make_mesh((4,), ("dp",), devices)
+
+    # Tiny linear-softmax model; deterministic data so every generation
+    # sees the same stream.
+    rs = np.random.RandomState(0)
+    w0 = rs.randn(8, 4).astype(np.float32) * 0.1
+    global_batch = 16
+
+    def make_global(step: int):
+        rs_b = np.random.RandomState(100 + step)
+        x = rs_b.randn(global_batch, 8).astype(np.float32)
+        y = rs_b.randint(0, 4, global_batch).astype(np.int32)
+        start, size = runtime.local_data_shard(global_batch)
+        shard = {"x": x[start:start + size], "y": y[start:start + size]}
+        sharding = NamedSharding(mesh, P("dp"))
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in shard.items()}
+
+    rep = NamedSharding(mesh, P())
+    w = jax.device_put(jnp.asarray(w0), rep)
+
+    @jax.jit
+    def step_fn(w, batch):
+        def loss_fn(w):
+            logits = batch["x"] @ w
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logits, batch["y"][:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked)
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    from paddle_tpu.training import checkpoint_sharded as cs
+
+    if phase == "train":
+        for i in range(2):
+            w, loss = step_fn(w, make_global(i))
+        cs.save_sharded(ckpt_dir, 0, {"w": {"w": w}},
+                        metadata={"step": 2})
+        steps_done = 2
+    else:  # resume: fresh process generation restores the checkpoint
+        like = {"w": {"w": jax.device_put(jnp.zeros_like(w), rep)}}
+        trees, meta = cs.load_sharded(ckpt_dir, like)
+        assert meta["metadata"]["step"] == 2, meta
+        w = trees["w"]["w"]
+        steps_done = meta["metadata"]["step"]
+
+    for i in range(steps_done, steps_done + 2):
+        w, loss = step_fn(w, make_global(i))
+
+    # Every process must hold bit-identical replicated params.
+    from jax.experimental import multihost_utils
+
+    w_local = np.asarray(w.addressable_data(0))
+    gathered = multihost_utils.process_allgather(w_local)
+    np.testing.assert_array_equal(np.asarray(gathered[0]),
+                                  np.asarray(gathered[1]))
+
+    # The final params must be a pure function of the data stream: write
+    # them so the test can compare train-4-steps vs train-2+resume-2.
+    if rank == 0:
+        np.save(os.path.join(ckpt_dir, f"final_{phase}.npy"), w_local)
+    multihost_utils.sync_global_devices("done")
+    print(f"rank {rank} phase {phase} OK loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -285,3 +285,111 @@ def test_mnist_style_mlp_trains(rng):
         losses.append(float(out[0][0]))
         params = dict(zip(params, out[1:]))
     assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_op_zoo_tail_outputs(rng):
+    """Round-2 tail: the last REGISTER_OP names from paddle/operators/
+    (prelu_op.cc, cos_sim_op.cc, conv_shift_op.cc, interp_op.cc,
+    modified_huber_loss_op.cc, activation_op.cc, pool_with_index_op.cc,
+    pool_op.cc pool3d)."""
+    x = rng.randn(3, 5).astype(np.float32)
+    alpha = np.float32(0.25)
+    check_output("prelu", {"X": x, "Alpha": alpha},
+                 [np.where(x > 0, x, 0.25 * x)])
+    check_output("hard_sigmoid", {"X": x},
+                 [np.clip(0.2 * x + 0.5, 0, 1)])
+    check_output("thresholded_relu", {"X": x},
+                 [np.where(x > 1.0, x, 0.0)])
+    check_output("identity", {"X": x}, [x])
+    check_output("feed", {"X": x}, [x])
+    check_output("fetch", {"X": x}, [x])
+
+    y = rng.randn(3, 5).astype(np.float32)
+    xn = np.sqrt((x * x).sum(-1, keepdims=True))
+    yn = np.sqrt((y * y).sum(-1, keepdims=True))
+    check_output("cos_sim", {"X": x, "Y": y},
+                 [(x * y).sum(-1, keepdims=True) / (xn * yn)])
+
+    # conv_shift vs an explicit modular-index loop
+    xs = rng.randn(2, 7).astype(np.float32)
+    ys = rng.randn(2, 3).astype(np.float32)
+    want = np.zeros_like(xs)
+    for b in range(2):
+        for i in range(7):
+            for j in range(-1, 2):
+                want[b, i] += xs[b, (i + j) % 7] * ys[b, j % 3]
+    check_output("conv_shift", {"X": xs, "Y": ys}, [want])
+
+    w = rng.rand(3).astype(np.float32)
+    check_output("interp", {"X": x, "Y": y, "W": w},
+                 [x * w[:, None] + y * (1 - w[:, None])])
+
+    pred = rng.randn(4, 1).astype(np.float32)
+    lab = np.array([[0.0], [1.0], [1.0], [0.0]], np.float32)
+    z = pred[:, 0] * (2 * lab[:, 0] - 1)
+    want = np.where(z >= -1, np.maximum(0, 1 - z) ** 2, -4 * z)[:, None]
+    check_output("modified_huber_loss", {"X": pred, "Y": lab}, [want])
+
+    # pool3d avg vs manual
+    vol = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+    got_want = vol.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, 8).mean(-1)
+    check_output("pool3d", {"X": vol}, [got_want],
+                 attrs={"ksize": 2, "stride": 2, "pooling_type": "avg"})
+
+    # max_pool2d_with_index: out + reference mask convention (flat offset
+    # in the input plane, math/pooling.cc:545)
+    img = rng.randn(1, 1, 4, 4).astype(np.float32)
+    from paddle_tpu.framework import Executor, Program, Scope
+    prog = Program()
+    block = prog.global_block()
+    block.append_op("max_pool2d_with_index", {"X": "x"},
+                    {"Out": "o", "Mask": "m"}, {"ksize": 2, "stride": 2})
+    out, mask = Executor().run(prog, Scope(), {"x": img}, ["o", "m"])
+    p = img[0, 0]
+    for oh in range(2):
+        for ow in range(2):
+            win = p[oh*2:oh*2+2, ow*2:ow*2+2]
+            assert np.asarray(out)[0, 0, oh, ow] == win.max()
+            kh, kw = np.unravel_index(win.argmax(), (2, 2))
+            assert np.asarray(mask)[0, 0, oh, ow] == (oh*2+kh)*4 + (ow*2+kw)
+
+
+def test_op_zoo_tail_grads(rng):
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    check_grad("prelu", {"X": x, "Alpha": np.float32(0.25)}, ["x", "alpha"])
+    check_grad("cos_sim", {"X": x, "Y": y}, ["x", "y"])
+    check_grad("interp", {"X": x, "Y": y, "W": rng.rand(3).astype(np.float32)},
+               ["x", "y", "w"])
+    check_grad("conv_shift", {"X": rng.randn(2, 7).astype(np.float32),
+                              "Y": rng.randn(2, 3).astype(np.float32)},
+               ["x", "y"])
+    check_grad("hard_sigmoid", {"X": x + 3.0}, ["x"])   # away from clip kinks
+    check_grad("thresholded_relu", {"X": x * 3 + 0.05}, ["x"])
+    check_grad("modified_huber_loss",
+               {"X": rng.randn(4, 1).astype(np.float32) * 0.3,
+                "Y": np.array([[0.], [1.], [1.], [0.]], np.float32)}, ["x"])
+    vol = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+    check_grad("pool3d", {"X": vol}, ["x"],
+               attrs={"ksize": 2, "stride": 2, "pooling_type": "avg"})
+
+
+def test_max_pool_with_index_padding_excludes_pad_cells(rng):
+    """With padding>0 and all-negative borders the max must come from the
+    input (never a zero-padded cell) and Mask must stay in-plane."""
+    from paddle_tpu.framework import Executor, Program, Scope
+    img = -np.abs(rng.randn(1, 1, 4, 4)).astype(np.float32) - 1.0
+    prog = Program()
+    prog.global_block().append_op(
+        "max_pool2d_with_index", {"X": "x"}, {"Out": "o", "Mask": "m"},
+        {"ksize": 3, "stride": 2, "padding": 1})
+    out, mask = Executor().run(prog, Scope(), {"x": img}, ["o", "m"])
+    out, mask = np.asarray(out), np.asarray(mask)
+    assert (out < 0).all(), out          # padded zeros never win
+    assert ((mask >= 0) & (mask < 16)).all(), mask
+    p = np.pad(img[0, 0], 1, constant_values=np.finfo(np.float32).min)
+    for oh in range(2):
+        for ow in range(2):
+            win = p[oh*2:oh*2+3, ow*2:ow*2+3]
+            assert out[0, 0, oh, ow] == win.max()

@@ -1,0 +1,62 @@
+"""Real multi-OS-process distributed execution test.
+
+The reference proves its distributed path by actually serving traffic in
+tests (``test_ParameterServer2.cpp:539-556`` spawns a pserver and pushes
+gradients; ``test_TrainerOnePass.cpp:80-116`` runs trainers at
+cpu/gpu x {1,2,4}).  This is the TPU-native equivalent: 2 OS processes
+join ``jax.distributed`` (CPU backend, 2 virtual devices each), build one
+global 4-device dp-mesh, train with gradient psum where each process
+feeds only its shard of the global batch, assert bit-identical params on
+every process, then run a REAL preemption/resume cycle: a fresh process
+generation restores the orbax sharded checkpoint and must land on
+exactly the params a never-preempted run reaches.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_generation(phase: str, ckpt: str, port: int) -> None:
+    from paddle_tpu.distributed.launch import launch_local
+
+    env = {k: v for k, v in os.environ.items()}
+    # The children provision their own 2-device virtual CPU platform;
+    # scrub this pytest process's 8-device setting so they control it.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else repo_root)
+    rc = launch_local(
+        2, [sys.executable, WORKER, phase, ckpt],
+        coordinator=f"127.0.0.1:{port}",
+        extra_env=env)
+    assert rc == 0, f"phase {phase} failed rc={rc}"
+
+
+@pytest.mark.slow
+def test_two_process_psum_training_and_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    _run_generation("train", ckpt, _free_port())
+    _run_generation("resume", ckpt, _free_port())
+
+    final_train = np.load(os.path.join(ckpt, "final_train.npy"))
+    final_resume = np.load(os.path.join(ckpt, "final_resume.npy"))
+    # train ran steps 0..3 with a checkpoint at 2; resume restored at 2 and
+    # ran 2..3 — identical data stream, so identical final params.
+    np.testing.assert_array_equal(final_train, final_resume)
