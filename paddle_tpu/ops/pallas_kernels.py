@@ -56,7 +56,8 @@ def pallas_supported(b: int, h: int) -> bool:
     The backward kernel holds w_h [h,4h], the dW_h accumulator [h,4h], the
     per-step gate blocks [b,4h]×3 and several [b,h] state blocks in VMEM at
     once; past ~h=512 the weights alone blow the 16MB/core budget and the
-    XLA scan (which streams w_h from HBM) is the right schedule.
+    TILED kernels below (weight columns streamed per grid step) take over,
+    with the XLA scan as the final fallback.
     """
     if h % 128 != 0 or b < 8 or b % 8 != 0:
         return False
@@ -366,9 +367,32 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
     """
     t, b, four_h = xw_t.shape
     h = four_h // 4
+    tiled = False
     if use_pallas is None:
         use_pallas = should_fuse(b, h)
+        if not use_pallas and should_fuse(b, h, lstm_tiled_supported):
+            use_pallas = tiled = True
+    elif use_pallas and not pallas_supported(b, h):
+        tiled = _tile_plan(b, h) is not None
     mask_f = mask_t.astype(jnp.float32)
+    if use_pallas and tiled:
+        splits, cn = _tile_plan(b, h)
+        interp = not _on_tpu()
+        if splits == 1:
+            return fused_lstm_scan_tiled(xw_t, w_h, h0, c0, mask_f, cn,
+                                         interp)
+        # Batch halves/quarters run as independent kernel calls (the
+        # recurrence is batch-parallel); each re-streams the weight tiles,
+        # exactly as the XLA scan would per step anyway.
+        bs = b // splits
+        parts = [fused_lstm_scan_tiled(
+            xw_t[:, i * bs:(i + 1) * bs], w_h,
+            h0[i * bs:(i + 1) * bs], c0[i * bs:(i + 1) * bs],
+            mask_f[:, i * bs:(i + 1) * bs], cn, interp)
+            for i in range(splits)]
+        return (jnp.concatenate([p[0] for p in parts], axis=1),
+                jnp.concatenate([p[1] for p in parts], axis=0),
+                jnp.concatenate([p[2] for p in parts], axis=0))
     if use_pallas:
         return fused_lstm_scan(xw_t, w_h, h0, c0, mask_f,
                                not _on_tpu())
@@ -390,6 +414,380 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
 
     (h_last, c_last), hs = lax.scan(step, (h0, c0), (xw_t, mask_f))
     return hs, h_last, c_last
+
+
+# ---------------------------------------------------------------------------
+# Tiled-weight LSTM kernels: h=512/1280-class shapes where w_h no longer
+# fits VMEM-resident.  The grid becomes (time, J): the hidden-COLUMN axis
+# is cut into J chunks of ``cn`` columns, each carrying all four gates
+# (the LSTM cell update is column-local — only the recurrent matmul needs
+# the full h_prev row, which stays in VMEM scratch).  Pallas's pipeline
+# streams the [4, h, cn] weight tile for chunk j from HBM while chunk j-1
+# computes — the same schedule the reference's fused large-h kernels get
+# from shared-memory staging (``hl_cuda_lstm.cu``).  Layouts are
+# gate-MAJOR ([4, t, b, h] activations, [4, h, h] weights) so every
+# streamed block's minor two dims are MXU/VPU-tile aligned.
+# ---------------------------------------------------------------------------
+
+_LANE = 128
+
+
+def lstm_tiled_supported(b: int, h: int) -> bool:
+    """Auto-selection gate for the tiled-weight LSTM kernels: the shapes
+    the resident kernel rejects for VMEM but a column chunking fits at the
+    FULL batch.  Batch-split plans exist (``_tile_plan``) and are
+    reachable with an explicit ``use_pallas=True``, but measured on v5e
+    the re-streamed weight tiles make a 2-way split slower than the XLA
+    scan (h=1280 b=256: 42.1 vs 39.2 ms/batch), so they are not chosen
+    automatically."""
+    plan = _tile_plan(b, h)
+    return plan is not None and plan[0] == 1
+
+
+def lstm_tile_cols(b: int, h: int,
+                   budget: int = _VMEM_BUDGET) -> Optional[int]:
+    """Column-chunk width for the tiled kernels at batch ``b``, or None
+    when even the smallest chunk blows VMEM.  Counts the BACKWARD kernel's
+    resident set (the larger of the two): double-buffered weight/xw/dxw
+    tiles, the streamed full-width h_prev row, per-chunk dh/dc state (4
+    full-width equivalents), and the full-width dh0/dc0 output blocks."""
+    if h % _LANE != 0 or b < 8 or b % 8 != 0:
+        return None
+    for cn in (512, 256, 128):
+        if cn > h or h % cn != 0:
+            continue
+        words = (2 * 4 * h * cn        # w tiles (double-buffered)
+                 + 4 * 4 * b * cn      # xw + dxw tiles
+                 + 2 * b * h           # h_prev_seq row stream
+                 + 8 * b * cn          # cprev/dhs/dh_last/dc_last blocks
+                 + 4 * b * h           # dh/dc chunk state + accumulators
+                 + 2 * b * h)          # dh0/dc0 output blocks
+        if words * 4 <= budget:
+            return cn
+    return None
+
+
+def _tile_plan(b: int, h: int) -> Optional[Tuple[int, int]]:
+    """(batch_splits, cn) for the tiled path: try the full batch, then
+    power-of-two batch splits (each split is an independent kernel call —
+    LSTM steps are batch-parallel, so splitting only re-streams weights)."""
+    splits = 1
+    while splits <= 8:
+        if b % splits == 0:
+            cn = lstm_tile_cols(b // splits, h)
+            if cn is not None:
+                return splits, cn
+        splits *= 2
+    return None
+
+
+def _make_tiled_fwd_kernel(with_cs: bool):
+    """``with_cs`` adds the cell-state-sequence output, needed only as a
+    VJP residual (inference skips the dead [t,b,h] HBM write, as in the
+    resident kernel)."""
+
+    def kernel(xw_ref, w_ref, h0_ref, c0_ref, mask_ref, *rest):
+        if with_cs:
+            (hs_ref, cs_ref, c_last_ref,
+             h_full_s, h_new_s, c_parts_s) = rest
+        else:
+            hs_ref, c_last_ref, h_full_s, h_new_s, c_parts_s = rest
+        ti = pl.program_id(0)
+        j = pl.program_id(1)
+        t = pl.num_programs(0)
+        jn = pl.num_programs(1)
+        b, cn = hs_ref.shape[1], hs_ref.shape[2]
+        J = h_new_s.shape[0]
+
+        @pl.when((ti == 0) & (j == 0))
+        def _():
+            h_full_s[:] = h0_ref[:]
+
+        c_prev = jnp.where((ti == 0), c0_ref[:], c_parts_s[j])
+        h_full = h_full_s[:]
+        # Four [b,h] @ [h,cn] MXU calls — one per gate — for this column
+        # chunk.  Weight tiles and xw stream from HBM as bf16 (half the
+        # traffic of the dominant stream); the dot runs native
+        # bf16 x bf16 -> f32 on the MXU and all gate/state math stays f32
+        # in VMEM.
+        hb = h_full.astype(jnp.bfloat16)
+        g_i = jnp.dot(hb, w_ref[0], preferred_element_type=jnp.float32)
+        g_f = jnp.dot(hb, w_ref[1], preferred_element_type=jnp.float32)
+        g_g = jnp.dot(hb, w_ref[2], preferred_element_type=jnp.float32)
+        g_o = jnp.dot(hb, w_ref[3], preferred_element_type=jnp.float32)
+        i_g = _sigmoid(xw_ref[0, 0].astype(jnp.float32) + g_i)
+        f_g = _sigmoid(xw_ref[1, 0].astype(jnp.float32) + g_f)
+        gg_g = jnp.tanh(xw_ref[2, 0].astype(jnp.float32) + g_g)
+        o_g = _sigmoid(xw_ref[3, 0].astype(jnp.float32) + g_o)
+
+        # h_prev chunk j for the mask carry: static unrolled select (J is
+        # a trace-time constant; lane slicing of h_full stays static).
+        h_prev_j = jnp.zeros((b, cn), jnp.float32)
+        for k in range(J):
+            h_prev_j = jnp.where(j == k, h_full[:, k * cn:(k + 1) * cn],
+                                 h_prev_j)
+
+        c_new = f_g * c_prev + i_g * gg_g
+        h_new = o_g * jnp.tanh(c_new)
+        m = mask_ref[0]
+        c_t = m * c_new + (1.0 - m) * c_prev
+        h_t = m * h_new + (1.0 - m) * h_prev_j
+
+        hs_ref[0] = h_t
+        if with_cs:
+            cs_ref[0] = c_t
+        c_parts_s[j] = c_t
+        h_new_s[j] = h_t
+
+        @pl.when(j == jn - 1)
+        def _():
+            h_full_s[:] = jnp.concatenate(
+                [h_new_s[k] for k in range(J)], axis=-1)
+
+        # c_last: full-width constant-index output assembled on the final
+        # fold (the API needs it even without the cs sequence).
+        @pl.when((ti == t - 1) & (j == jn - 1))
+        def _():
+            c_last_ref[:] = jnp.concatenate(
+                [c_parts_s[k] for k in range(J)], axis=-1)
+
+    return kernel
+
+
+def _lstm_tiled_fwd_pallas(xw4, w4, h0, c0, mask_t, cn: int,
+                           interpret: bool, with_cs: bool):
+    four, t, b, h = xw4.shape
+    assert four == 4
+    J = h // cn
+    xw4 = xw4.astype(jnp.bfloat16)
+    w4 = w4.astype(jnp.bfloat16)
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"))
+    seq_spec = pl.BlockSpec((1, b, cn), lambda ti, j: (ti, 0, j))
+    seq_shape = jax.ShapeDtypeStruct((t, b, h), jnp.float32)
+    return pl.pallas_call(
+        _make_tiled_fwd_kernel(with_cs),
+        grid=(t, J),
+        in_specs=[
+            pl.BlockSpec((4, 1, b, cn), lambda ti, j: (0, ti, 0, j)),
+            pl.BlockSpec((4, h, cn), lambda ti, j: (0, 0, j)),
+            pl.BlockSpec((b, h), lambda ti, j: (0, 0)),
+            pl.BlockSpec((b, cn), lambda ti, j: (0, j)),
+            pl.BlockSpec((1, b, 1), lambda ti, j: (ti, 0, 0)),
+        ],
+        out_specs=[seq_spec] * (2 if with_cs else 1) + [
+            pl.BlockSpec((b, h), lambda ti, j: (0, 0)),
+        ],
+        out_shape=[seq_shape] * (2 if with_cs else 1) + [
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((J, b, cn), jnp.float32),
+            pltpu.VMEM((J, b, cn), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw4, w4, h0, c0, mask_t[:, :, None])
+
+
+def _lstm_tiled_bwd_kernel(xw_ref, w_ref, hprev_ref, cprev_ref, mask_ref,
+                           dhs_ref, dh_last_ref, dc_last_ref,
+                           dxw_ref, dh0_ref, dc0_ref,
+                           dh_parts_s, dc_parts_s, dh_acc_s, dh_extra_s):
+    ti = pl.program_id(0)
+    j = pl.program_id(1)
+    t = pl.num_programs(0)
+    jn = pl.num_programs(1)
+    J, b, cn = dh_parts_s.shape
+
+    @pl.when((ti == 0) & (j == 0))
+    def _():
+        dh_acc_s[:] = jnp.zeros_like(dh_acc_s)
+
+    # Incoming per-chunk gradients (time runs in reverse via the index
+    # maps; ti == 0 is the LAST timestep).
+    dh_j = jnp.where(
+        ti == 0,
+        dh_last_ref[:],
+        dh_parts_s[j]) + dhs_ref[0]
+    dc_j = jnp.where(ti == 0, dc_last_ref[:], dc_parts_s[j])
+
+    # h_prev streams bf16 (it is the bf16-rounded remat input, so the
+    # recomputed gates differ from the forward's by bf16 rounding — the
+    # usual remat-with-reduced-precision trade); math stays f32.
+    h_prev_b = hprev_ref[0]
+    c_prev = cprev_ref[0, 0]
+    m = mask_ref[0]
+
+    # Recompute this chunk's gates (remat, as in the resident kernel).
+    i_g = _sigmoid(xw_ref[0, 0].astype(jnp.float32) + jnp.dot(
+        h_prev_b, w_ref[0], preferred_element_type=jnp.float32))
+    f_g = _sigmoid(xw_ref[1, 0].astype(jnp.float32) + jnp.dot(
+        h_prev_b, w_ref[1], preferred_element_type=jnp.float32))
+    g_g = jnp.tanh(xw_ref[2, 0].astype(jnp.float32) + jnp.dot(
+        h_prev_b, w_ref[2], preferred_element_type=jnp.float32))
+    o_g = _sigmoid(xw_ref[3, 0].astype(jnp.float32) + jnp.dot(
+        h_prev_b, w_ref[3], preferred_element_type=jnp.float32))
+    c_new = f_g * c_prev + i_g * g_g
+    tanh_c = jnp.tanh(c_new)
+
+    do = dh_j * tanh_c * m
+    dc_new = dh_j * o_g * (1.0 - tanh_c * tanh_c) * m + dc_j * m
+    di = dc_new * g_g
+    df = dc_new * c_prev
+    dg = dc_new * i_g
+
+    dgi = di * i_g * (1.0 - i_g)
+    dgf = df * f_g * (1.0 - f_g)
+    dgg = dg * (1.0 - g_g * g_g)
+    dgo = do * o_g * (1.0 - o_g)
+
+    dxw_ref[0, 0] = dgi
+    dxw_ref[1, 0] = dgf
+    dxw_ref[2, 0] = dgg
+    dxw_ref[3, 0] = dgo
+
+    # dh_prev (full width) += sum over gates of dgate_j @ w_tile^T
+    # (bf16 operands on the MXU, f32 accumulation in scratch).
+    acc = dh_acc_s[:]
+    for dgate, wg in ((dgi, 0), (dgf, 1), (dgg, 2), (dgo, 3)):
+        acc += lax.dot_general(
+            dgate.astype(jnp.bfloat16), w_ref[wg],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    dh_acc_s[:] = acc
+
+    # Column-local pieces of the next-step gradients.
+    dh_extra_s[j] = (1.0 - m) * dh_j
+    dc_parts_s[j] = dc_new * f_g + (1.0 - m) * dc_j
+
+    @pl.when(j == jn - 1)
+    def _():
+        # Fold the full-width dot accumulation back into per-chunk dh
+        # state (static lane slices — the loop over J unrolls at trace
+        # time) and reset the accumulator for the next timestep.
+        for k in range(J):
+            dh_parts_s[k] = (dh_acc_s[:, k * cn:(k + 1) * cn]
+                             + dh_extra_s[k])
+        dh_acc_s[:] = jnp.zeros_like(dh_acc_s)
+
+    # dh0/dc0 are full-width outputs with constant index maps (always the
+    # same block — the one revisit pattern Pallas allows), assembled from
+    # the per-chunk state after the final timestep's fold (ti == t-1 is
+    # time 0 in the reversed index maps).
+    @pl.when((ti == t - 1) & (j == jn - 1))
+    def _():
+        dh0_ref[:] = jnp.concatenate(
+            [dh_parts_s[k] for k in range(J)], axis=-1)
+        dc0_ref[:] = jnp.concatenate(
+            [dc_parts_s[k] for k in range(J)], axis=-1)
+
+
+
+def _lstm_tiled_bwd_pallas(xw4, w4, h_prev_seq, c_prev_seq, mask_t,
+                           dhs, dh_last, dc_last, cn: int,
+                           interpret: bool):
+    four, t, b, h = xw4.shape
+    J = h // cn
+    xw4 = xw4.astype(jnp.bfloat16)
+    w4 = w4.astype(jnp.bfloat16)
+    h_prev_seq = h_prev_seq.astype(jnp.bfloat16)
+    rev3 = lambda ti, j: (t - 1 - ti, 0, j)      # noqa: E731
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"))
+    dxw4, dh0, dc0 = pl.pallas_call(
+        _lstm_tiled_bwd_kernel,
+        grid=(t, J),
+        in_specs=[
+            pl.BlockSpec((4, 1, b, cn), lambda ti, j: (0, t - 1 - ti, 0, j)),
+            pl.BlockSpec((4, h, cn), lambda ti, j: (0, 0, j)),
+            pl.BlockSpec((1, b, h), lambda ti, j: (t - 1 - ti, 0, 0)),
+            pl.BlockSpec((1, 1, b, cn),
+                         lambda ti, j: (t - 1 - ti, 0, 0, j)),
+            pl.BlockSpec((1, b, 1), lambda ti, j: (t - 1 - ti, 0, 0)),
+            pl.BlockSpec((1, b, cn), rev3),
+            pl.BlockSpec((b, cn), lambda ti, j: (0, j)),
+            pl.BlockSpec((b, cn), lambda ti, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((4, 1, b, cn), lambda ti, j: (0, t - 1 - ti, 0, j)),
+            pl.BlockSpec((b, h), lambda ti, j: (0, 0)),
+            pl.BlockSpec((b, h), lambda ti, j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((4, t, b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((J, b, cn), jnp.float32),
+            pltpu.VMEM((J, b, cn), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((J, b, cn), jnp.float32),
+        ] if pltpu is not None else [],
+        interpret=interpret,
+        **kwargs,
+    )(xw4, w4, h_prev_seq, c_prev_seq[:, None], mask_t[:, :, None],
+      dhs, dh_last, dc_last)
+    return dxw4, dh0, dc0
+
+
+def _tiled_gate_layouts(xw_t, w_h):
+    """[t,b,4h]/[h,4h] -> the gate-major [4,t,b,h]/[4,h,h] kernel
+    layouts (minor dims stay MXU/VPU-tile aligned)."""
+    t, b, four_h = xw_t.shape
+    h = four_h // 4
+    return (jnp.moveaxis(xw_t.reshape(t, b, 4, h), 2, 0),
+            jnp.moveaxis(w_h.reshape(h, 4, h), 1, 0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_lstm_scan_tiled(xw_t, w_h, h0, c0, mask_t, cn: int,
+                          interpret: bool = False):
+    """Tiled-weight fused LSTM scan — same contract as
+    :func:`fused_lstm_scan` but for shapes whose ``w_h`` cannot stay
+    VMEM-resident.  Returns (hs, h_last, c_last)."""
+    xw4, w4 = _tiled_gate_layouts(xw_t, w_h)
+    hs, c_last = _lstm_tiled_fwd_pallas(
+        xw4, w4, h0, c0, mask_t, cn, interpret, with_cs=False)
+    return hs, hs[-1], c_last
+
+
+def _tiled_fwd(xw_t, w_h, h0, c0, mask_t, cn, interpret):
+    xw4, w4 = _tiled_gate_layouts(xw_t, w_h)
+    hs, cs, c_last = _lstm_tiled_fwd_pallas(
+        xw4, w4, h0, c0, mask_t, cn, interpret, with_cs=True)
+    return (hs, hs[-1], c_last), (xw4, w4, h0, c0, mask_t, hs, cs)
+
+
+def _tiled_bwd(cn, interpret, res, grads):
+    xw4, w4, h0, c0, mask_t, hs, cs = res
+    dhs, dh_last, dc_last = grads
+    # The primal returns hs[-1]/cs[-1] as h_last/c_last, so their
+    # cotangents fold into the sequence gradient's last step.
+    dhs = dhs.at[-1].add(dh_last)
+    four, t, b, h = xw4.shape
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    dxw4, dh0, dc0 = _lstm_tiled_bwd_pallas(
+        xw4, w4, h_prev_seq, c_prev_seq, mask_t,
+        dhs, jnp.zeros_like(dh_last), dc_last, cn, interpret)
+    # dW_h outside the kernel: one MXU einsum over (t, b) — streaming the
+    # dW accumulator through the time grid would break Pallas's
+    # consecutive-revisit rule for output blocks.
+    dwh4 = jnp.einsum("tbh,gtbc->hgc", h_prev_seq, dxw4,
+                      preferred_element_type=jnp.float32)
+    dwh = dwh4.reshape(h, 4 * h)
+    dxw = jnp.moveaxis(dxw4, 0, 2).reshape(t, b, 4 * h)
+    return dxw, dwh, dh0, dc0, None
+
+
+fused_lstm_scan_tiled.defvjp(_tiled_fwd, _tiled_bwd)
 
 
 # ---------------------------------------------------------------------------

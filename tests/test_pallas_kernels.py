@@ -169,3 +169,86 @@ def test_gru_layer_fused_matches_scan(rng):
     hs_p, hl_p = run(True)
     np.testing.assert_allclose(hs_p, hs_s, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(hl_p, hl_s, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Tiled-weight LSTM kernels (h=512/1280-class shapes): same twin-kernel
+# cross-check, exercised at a small shape with forced chunking (cn < h)
+# so interpret mode stays fast while covering J=2 and J=4 tilings.
+# ---------------------------------------------------------------------------
+
+# The tiled kernels stream weights/xw/h_prev as bf16 (the design's HBM
+# halving), so cross-checks against the f32 scan carry bf16-tier
+# tolerances: abs error ~5e-4 at these magnitudes, measured.
+
+@pytest.mark.parametrize("cn", [128, 64])
+def test_tiled_lstm_forward_matches_scan(rng, cn):
+    xw, wh, h0, c0, mask = _inputs(rng, t=5, b=8, h=256)
+    ref = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=False)
+    pal = pk.fused_lstm_scan_tiled(xw, wh, h0, c0, mask, cn,
+                                   interpret=True)
+    for r, p in zip(ref, pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_tiled_lstm_grad_matches_scan(rng):
+    xw, wh, h0, c0, mask = _inputs(rng, t=4, b=8, h=256)
+
+    def loss(fn):
+        def f(xw, wh, h0, c0):
+            hs, hl, cl = fn(xw, wh, h0, c0)
+            return jnp.sum(jnp.sin(hs)) + jnp.sum(hl * cl)
+        return f
+
+    ref_fn = lambda *a: pk.lstm_scan(*a, mask, use_pallas=False)  # noqa: E731
+    pal_fn = lambda *a: pk.fused_lstm_scan_tiled(                  # noqa: E731
+        *a, mask, 128, interpret=True)
+    g_ref = jax.grad(loss(ref_fn), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    g_pal = jax.grad(loss(pal_fn), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    for r, p in zip(g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=5e-2, atol=1e-2)
+
+
+def test_tiled_lstm_mask_carries_state(rng):
+    xw, wh, h0, c0, _ = _inputs(rng, t=6, b=8, h=256)
+    mask = np.ones((6, 8), np.float32)
+    mask[3:] = 0.0
+    hs, h_last, c_last = pk.fused_lstm_scan_tiled(
+        xw, wh, h0, c0, jnp.asarray(mask), 128, interpret=True)
+    np.testing.assert_allclose(np.asarray(hs[3]), np.asarray(hs[5]),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(h_last), np.asarray(hs[2]),
+                               rtol=0, atol=0)
+
+
+def test_tile_cols_selection():
+    # Big-shape gates: the bench rows the resident kernel rejects must be
+    # tiled-eligible (directly or through a batch split).
+    assert not pk.pallas_supported(128, 512)
+    assert not pk.pallas_supported(256, 1280)
+    assert pk.lstm_tiled_supported(128, 512)
+    assert pk.lstm_tiled_supported(128, 1280)
+    # b=256 h=1280 only fits via a batch split, which auto-selection
+    # rejects (measured slower than the XLA scan) but explicit
+    # use_pallas=True may still take.
+    assert not pk.lstm_tiled_supported(256, 1280)
+    splits, cn = pk._tile_plan(256, 1280)
+    assert splits == 2 and cn % 128 == 0
+    # Misaligned shapes stay out.
+    assert not pk.lstm_tiled_supported(7, 512)
+    assert not pk.lstm_tiled_supported(128, 500)
+
+
+def test_tiled_lstm_batch_split_path(rng):
+    # Force the split path by shrinking the budget so b=16 needs halving.
+    xw, wh, h0, c0, mask = _inputs(rng, t=4, b=16, h=256)
+    ref = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=False)
+    import unittest.mock as um
+    with um.patch.object(pk, "_tile_plan", lambda b, h: (2, 128)), \
+            um.patch.object(pk, "pallas_supported", lambda b, h: False):
+        pal = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=True)
+    for r, p in zip(ref, pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=2e-2, atol=2e-3)

@@ -1,0 +1,89 @@
+"""Real-TPU compile smoke for the Pallas kernels.
+
+The CPU test suite exercises the kernels in interpret mode only; this
+script ``.lower().compile()``s the fused LSTM (resident + tiled) and GRU
+forward+backward on the actual chip, catching Mosaic/layout regressions
+the interpreter cannot.  One JSON line per kernel family; exits nonzero
+on any failure.
+
+    python tpu_smoke.py          # needs a TPU-attached process
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"smoke": "skipped", "reason":
+                          f"backend={jax.default_backend()}"}))
+        return 0
+
+    rs = np.random.RandomState(0)
+    failures = []
+
+    def compile_grad(name, fn, *args):
+        try:
+            jax.jit(jax.value_and_grad(fn, argnums=(0, 1))) \
+                .lower(*args).compile()
+            print(json.dumps({"smoke": name, "ok": True}))
+        except Exception as e:  # noqa: BLE001 — report and continue
+            failures.append(name)
+            print(json.dumps({"smoke": name, "ok": False,
+                              "error": str(e)[:200]}))
+
+    # Resident LSTM kernel (bench flagship shape family).
+    t, b, h = 100, 64, 256
+    xw = jnp.asarray(rs.randn(t, b, 4 * h), jnp.float32) * 0.1
+    wh = jnp.asarray(rs.randn(h, 4 * h), jnp.float32) * 0.1
+    zeros = jnp.zeros((b, h), jnp.float32)
+    ones = jnp.ones((t, b), jnp.float32)
+    assert pk.pallas_supported(b, h)
+
+    def lstm_loss(xw, wh):
+        hs, hl, cl = pk.lstm_scan(xw, wh, zeros, zeros, ones,
+                                  use_pallas=True)
+        return jnp.sum(hs * hs) + jnp.sum(hl * cl)
+
+    compile_grad("lstm_resident_fwd_bwd", lstm_loss, xw, wh)
+
+    # Tiled LSTM kernel (h=512-class row).
+    t2, b2, h2 = 100, 128, 512
+    assert pk.lstm_tiled_supported(b2, h2)
+    xw2 = jnp.asarray(rs.randn(t2, b2, 4 * h2), jnp.float32) * 0.1
+    wh2 = jnp.asarray(rs.randn(h2, 4 * h2), jnp.float32) * 0.02
+    z2 = jnp.zeros((b2, h2), jnp.float32)
+    o2 = jnp.ones((t2, b2), jnp.float32)
+
+    def lstm_tiled_loss(xw, wh):
+        hs, hl, cl = pk.lstm_scan(xw, wh, z2, z2, o2, use_pallas=True)
+        return jnp.sum(hs * hs) + jnp.sum(hl * cl)
+
+    compile_grad("lstm_tiled_fwd_bwd", lstm_tiled_loss, xw2, wh2)
+
+    # Fused GRU kernel.
+    hg = 256
+    assert pk.gru_supported(b, hg)
+    xwg = jnp.asarray(rs.randn(t, b, 3 * hg), jnp.float32) * 0.1
+    whz = jnp.asarray(rs.randn(hg, 2 * hg), jnp.float32) * 0.1
+    whc = jnp.asarray(rs.randn(hg, hg), jnp.float32) * 0.1
+    zg = jnp.zeros((b, hg), jnp.float32)
+
+    def gru_loss(xwg, whz):
+        hs, hl = pk.gru_scan(xwg, whz, whc, zg, ones, use_pallas=True)
+        return jnp.sum(hs * hs) + jnp.sum(hl * hl)
+
+    compile_grad("gru_fwd_bwd", gru_loss, xwg, whz)
+
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
