@@ -1,0 +1,43 @@
+"""Sparse CTR wide-and-deep benchmark config — BASELINE.json config 5
+("Sparse CTR / wide-and-deep (high-dim sparse pserver path)").
+
+    python -m paddle_tpu time --config benchmark/ctr.py \
+        --config-args batch_size=512 --batches 16 --burn-in 16
+
+Criteo-ish synthetic shapes: 26 categorical fields over high-dim
+vocabularies (1e6-row head fields tail down to 1e4), 8 ids per multi-hot
+field.  On the reference this path exercises the pserver's sparse-row
+prefetch (SparsePrefetchRowCpuMatrix); here the tables live in device
+HBM and the lookup's scatter-add gradient stays row-sparse in XLA.
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.models.wide_deep import model_fn_builder
+
+BATCH = get_config_arg("batch_size", int, 512)
+K = get_config_arg("ids_per_field", int, 8)
+
+# 26 Criteo-style categorical fields: a few huge head vocabularies plus a
+# long tail, ~4.3M rows total.
+FIELD_VOCABS = ([1_000_000] * 2 + [500_000] * 2 + [100_000] * 6
+                + [50_000] * 6 + [10_000] * 10)
+
+mixed_precision = True
+
+model_fn = model_fn_builder(FIELD_VOCABS, embed_dim=16, hidden=(256, 128))
+optimizer = optim.from_config(settings(
+    learning_rate=1e-3, learning_method_name="adagrad"))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {"label": rs.randint(0, 2, BATCH).astype(np.int32)}
+    for i, v in enumerate(FIELD_VOCABS):
+        batch[f"f{i}"] = rs.randint(0, v, (BATCH, K)).astype(np.int32)
+        batch[f"f{i}_mask"] = (rs.rand(BATCH, K) < 0.75)
+        batch[f"f{i}_mask"][:, 0] = True
+    while True:
+        yield batch

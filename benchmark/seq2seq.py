@@ -1,0 +1,46 @@
+"""Seq2seq NMT (attention) benchmark config — BASELINE.json config 4
+("Seq2seq NMT with attention (variable-length RNN path)").  The reference
+promised but never published a seq2seq row (`benchmark/README.md:140`
+"will be added later"); these are our numbers for the slot.
+
+    python -m paddle_tpu time --config benchmark/seq2seq.py \
+        --config-args batch_size=64 --batches 8 --burn-in 8
+
+Synthetic batches at WMT-ish shapes: dict 30k/30k, embed=hidden=512,
+src/tgt length 30 (padded-uniform so the stacked-scan time path engages,
+like the reference's fixed `--test_period` batches).  Beam-search decode
+is timed separately by benchmark/seq2seq_decode.py.
+"""
+
+import numpy as np
+
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu import optim
+from paddle_tpu.models.seq2seq import model_fn_builder
+
+DICT = get_config_arg("dict_size", int, 30000)
+BATCH = get_config_arg("batch_size", int, 64)
+SRC_LEN = get_config_arg("src_len", int, 30)
+TGT_LEN = get_config_arg("tgt_len", int, 30)
+EMBED = get_config_arg("embed_dim", int, 512)
+HIDDEN = get_config_arg("hidden", int, 512)
+
+mixed_precision = True
+
+model_fn = model_fn_builder(DICT, DICT, embed_dim=EMBED, hidden=HIDDEN)
+optimizer = optim.from_config(settings(
+    learning_rate=1e-3, learning_method_name="adam",
+    gradient_clipping_threshold=5.0))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {
+        "src": rs.randint(2, DICT, (BATCH, SRC_LEN)).astype(np.int32),
+        "src_mask": np.ones((BATCH, SRC_LEN), bool),
+        "tgt_in": rs.randint(2, DICT, (BATCH, TGT_LEN)).astype(np.int32),
+        "tgt_out": rs.randint(2, DICT, (BATCH, TGT_LEN)).astype(np.int32),
+        "tgt_mask": np.ones((BATCH, TGT_LEN), np.float32),
+    }
+    while True:
+        yield batch

@@ -1,0 +1,69 @@
+"""Beam-search decode timing for the seq2seq NMT benchmark (the
+inference half of BASELINE.json config 4), timed separately from the
+train step as the reference's SequenceGenerator ran in its own job.
+
+    python benchmark/seq2seq_decode.py            # prints one JSON line
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.models.seq2seq import (generate_fn_builder,
+                                           model_fn_builder)
+
+    # Same precision policy as the paired train benchmark
+    # (benchmark/seq2seq.py sets mixed_precision = True via the CLI).
+    dtypes.set_policy(dtypes.MIXED_BF16)
+    from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
+
+    DICT, BATCH, SRC_LEN = 30000, 64, 30
+    BEAM, MAX_LEN = 5, 50
+    kwargs = dict(embed_dim=512, hidden=512)
+
+    rs = np.random.RandomState(0)
+    batch = {
+        "src": jnp.asarray(rs.randint(2, DICT, (BATCH, SRC_LEN)), jnp.int32),
+        "src_mask": jnp.ones((BATCH, SRC_LEN), bool),
+        "tgt_in": jnp.asarray(rs.randint(2, DICT, (BATCH, 4)), jnp.int32),
+        "tgt_out": jnp.asarray(rs.randint(2, DICT, (BATCH, 4)), jnp.int32),
+        "tgt_mask": jnp.ones((BATCH, 4), jnp.float32),
+    }
+    train = nn.transform(model_fn_builder(DICT, DICT, **kwargs))
+    params, _ = train.init(jax.random.key(0), batch)
+
+    gen = nn.transform(generate_fn_builder(
+        DICT, DICT, beam_size=BEAM, max_len=MAX_LEN, **kwargs))
+
+    @jax.jit
+    def decode(params, src, src_mask):
+        out, _ = gen.apply(params, {}, None, src, src_mask)
+        return out
+
+    def step():
+        out = decode(params, batch["src"], batch["src_mask"])
+        # any scalar works as the host-sync handle for timed_run
+        return out[0].reshape(-1)[0]
+
+    timed_run(step, 3)                       # warm the compile
+    ms = marginal_ms_per_batch(step, n=4)
+    print(json.dumps({
+        "metric": f"seq2seq NMT beam decode b={BATCH} beam={BEAM} "
+                  f"max_len={MAX_LEN} dict=30k h=512",
+        "value": round(ms, 2), "unit": "ms/batch",
+        "sentences_per_s": round(BATCH / (ms / 1e3), 1)}))
+
+
+if __name__ == "__main__":
+    main()

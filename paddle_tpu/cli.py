@@ -104,7 +104,7 @@ def cmd_time(args):
     round trips) cancel; see bench.py's docstring for the rationale."""
     import itertools
     import jax.numpy as jnp
-    from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
+    from paddle_tpu.utils.timing import marginal_ms_with_spread, timed_run
     cfg = _load_config(args.config, args.config_args)
     trainer = _build_trainer(cfg)
 
@@ -142,7 +142,9 @@ def cmd_time(args):
         # ceil-divide so any positive --burn-in warms at least one scan
         # call, while --burn-in 0 still times cold (as in the fallback)
         timed_run(step_fn, -(-args.burn_in // K))
-        ms = marginal_ms_per_batch(step_fn, n=max(1, n // K)) / K
+        ms, spread = marginal_ms_with_spread(
+            step_fn, n=max(1, n // K), repeats=args.repeats)
+        ms, spread = ms / K, spread / K
         protocol = "differential-scan"
         # MFU from XLA's FLOP count of the compiled scan (per batch —
         # the loop body is counted trip-count-invariantly).
@@ -160,11 +162,14 @@ def cmd_time(args):
 
         timed_run(step_fn, args.burn_in)
         # --batches N sets the differential scale: arms of N and 4N.
-        ms = marginal_ms_per_batch(step_fn, n=n)
+        ms, spread = marginal_ms_with_spread(step_fn, n=n,
+                                             repeats=args.repeats)
         protocol = "differential"
         mfu_val = None
     out = {"ms_per_batch": ms, "batches": args.batches,
            "last_cost": float(last["cost"]), "protocol": protocol}
+    if spread is not None:
+        out["spread_ms"] = round(spread, 4)
     if mfu_val is not None:
         out["mfu"] = round(mfu_val, 4)
     print(json.dumps(out))
@@ -295,6 +300,10 @@ def main(argv=None):
                         "4*max(1, n//K) scan calls over the K=n stack); "
                         "otherwise arms run n and 4n per-dispatch batches")
     p.add_argument("--burn-in", type=int, default=10)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="paired-difference repeats for the differential "
+                        "protocol (odd keeps the median an order "
+                        "statistic); raise for noisy CNN rows")
     p.set_defaults(fn=cmd_time)
 
     p = sub.add_parser("checkgrad",

@@ -43,11 +43,13 @@ class BahdanauAttention(Module):
         w_k = param("w_k", (dk, self.dim), policy.param_dtype,
                     init.paddle_default())
         v = param("v", (self.dim,), policy.param_dtype, init.paddle_default())
-        e = jnp.tanh((query @ w_q)[:, None, :] + keys @ w_k)
-        scores = jnp.einsum("btd,d->bt", e, v)
-        scores = jnp.where(key_mask, scores, -1e9)
+        ct = policy.cast_to_compute
+        e = jnp.tanh((ct(query) @ ct(w_q))[:, None, :] + ct(keys) @ ct(w_k))
+        scores = jnp.einsum("btd,d->bt", e, ct(v))
+        # softmax in f32 (policy island), weights applied in compute dtype
+        scores = jnp.where(key_mask, scores.astype(jnp.float32), -1e9)
         weights = jax.nn.softmax(scores, axis=-1)
-        context = jnp.einsum("bt,btd->bd", weights, keys)
+        context = jnp.einsum("bt,btd->bd", weights.astype(keys.dtype), keys)
         return context, weights
 
 
@@ -70,11 +72,14 @@ class GRUCell(Module):
         w_hc = param("w_hc", (h, h), policy.param_dtype,
                      init.paddle_default())
         bias = param("b", (3 * h,), policy.param_dtype, init.zeros)
-        xw = x @ w_x + bias
-        zr = self._gate(xw[:, :2 * h] + h_prev @ w_hz)
-        z, r = jnp.split(zr, 2, axis=-1)
-        cand = jnp.tanh(xw[:, 2 * h:] + (r * h_prev) @ w_hc)
-        return (1.0 - z) * h_prev + z * cand
+        from paddle_tpu.nn.recurrent import gru_cell
+        ct = policy.cast_to_compute
+        xw = policy.cast_to_output(ct(x) @ ct(w_x)) \
+            + bias.astype(policy.output_dtype)
+        out = gru_cell(xw, h_prev, ct(w_hz), ct(w_hc),
+                       jnp.tanh, self._gate, policy)
+        # The carry's dtype must be loop-invariant under lax.scan.
+        return out.astype(h_prev.dtype)
 
     @staticmethod
     def _gate(x):

@@ -43,10 +43,22 @@ def marginal_ms_per_batch(step_fn: Callable[[], object], n: int = 10,
     small arm) stay in the sample so they cancel in the median; only the
     final result is floored.  Odd default ``repeats`` keeps the median a
     real order statistic."""
+    return marginal_ms_with_spread(step_fn, n, repeats)[0]
+
+
+def marginal_ms_with_spread(step_fn: Callable[[], object], n: int = 10,
+                            repeats: int = 3) -> tuple:
+    """(median, half-interquartile-spread) of the paired differences —
+    the spread quantifies measurement noise for the benchmark tables."""
     n = max(n, 1)
     diffs = []
     for _ in range(max(repeats, 1)):
         t_small = timed_run(step_fn, n)
         t_large = timed_run(step_fn, 4 * n)
         diffs.append((t_large - t_small) / (3 * n) * 1000.0)
-    return max(statistics.median(diffs), 1e-9)
+    med = max(statistics.median(diffs), 1e-9)
+    # Half-range for every sample count (scale-consistent across
+    # --repeats values); None when a single repeat measured no spread.
+    spread = ((max(diffs) - min(diffs)) / 2.0
+              if len(diffs) >= 2 else None)
+    return med, spread

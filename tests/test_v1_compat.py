@@ -577,3 +577,103 @@ def test_v1_config_synthesis_in_process(tmp_path):
     finally:
         os.chdir(cwd)
         sys.path.remove(str(tmp_path))
+
+
+@pytest.mark.skipif(
+    not os.path.exists(
+        "/root/reference/python/paddle/trainer/config_parser.py"),
+    reason="reference tree not mounted")
+def test_config_layer_kind_coverage():
+    """Every @config_layer kind the reference's config_parser registers
+    (config_parser.py:159-177 region, 91 kinds) must map to a surface in
+    this framework — a v1_compat helper (the layer synthesizes through
+    api/config.py like any literal config) — or sit on the documented
+    delta list below.  AST-scanned from the reference so new kinds fail
+    loudly."""
+    import re
+
+    ref = open("/root/reference/python/paddle/trainer/config_parser.py",
+               errors="ignore").read()
+    kinds = set(re.findall(r"@config_layer\('([^']+)'\)", ref))
+    assert len(kinds) >= 90, len(kinds)
+
+    from paddle_tpu.api import v1_compat as v1
+
+    # kind -> the v1_compat helper that emits it (the reference's helper
+    # layer maps 1:1 onto these; coverage of the helper IS coverage of
+    # the kind for a config that synthesizes through api/config.py).
+    mapping = {
+        "addto": "addto_layer", "average": "pooling_layer",
+        "batch_norm": "batch_norm_layer",
+        "bilinear_interp": "bilinear_interp_layer",
+        "blockexpand": "block_expand_layer", "clip": "clip_layer",
+        "concat": "concat_layer", "concat2": "concat_layer",
+        "conv": "img_conv_layer", "conv3d": "img_conv3d_layer",
+        "conv_3d": "img_conv3d_layer", "conv_shift": "conv_shift_layer",
+        "convex_comb": "convex_comb_layer", "convt": "img_conv_layer",
+        "cos": "cos_sim", "cos_vm": "cos_sim", "crf": "crf_layer",
+        "crf_decoding": "crf_decoding_layer", "crop": "crop_layer",
+        "cross_entropy_over_beam": "cross_entropy_over_beam",
+        "ctc": "ctc_layer", "cudnn_conv": "img_conv_layer",
+        "data": "data_layer", "deconv3d": "img_conv3d_layer",
+        "detection_output": "detection_output_layer",
+        "eos_id": "eos_layer", "exconv": "img_conv_layer",
+        "exconvt": "img_conv_layer", "expand": "expand_layer",
+        "fc": "fc_layer", "featmap_expand": "repeat_layer",
+        "gated_recurrent": "grumemory", "get_output": "get_output_layer",
+        "gru_step": "gru_step_layer", "hsigmoid": "hsigmoid",
+        "huber_regression": "huber_regression_cost",
+        "interpolation": "interpolation_layer",
+        "kmax_seq_score": "kmax_seq_score_layer",
+        "lambda_cost": "lambda_cost", "lstm_step": "lstm_step_layer",
+        "lstmemory": "lstmemory", "max": "pooling_layer",
+        "maxid": "maxid_layer", "maxout": "maxout_layer",
+        "mixed": "mixed_layer",
+        "multi_class_cross_entropy_with_selfnorm":
+            "cross_entropy_with_selfnorm",
+        "multibox_loss": "multibox_loss_layer",
+        "multiplex": "multiplex_layer", "nce": "nce_layer",
+        "norm": "img_cmrnorm_layer", "out_prod": "out_prod_layer",
+        "pad": "pad_layer", "pool": "img_pool_layer",
+        "pool3d": "img_pool3d_layer", "power": "power_layer",
+        "prelu": "prelu_layer", "print": "print_layer",
+        "priorbox": "priorbox_layer", "recurrent": "recurrent_layer",
+        "recurrent_layer_group": "recurrent_group",
+        "resize": "resize_layer", "rotate": "rotate_layer",
+        "row_conv": "row_conv_layer", "row_l2_norm": "row_l2_norm_layer",
+        "sampling_id": "sampling_id_layer",
+        "scale_shift": "scale_shift_layer", "scaling": "scaling_layer",
+        "selective_fc": "selective_fc_layer",
+        "seq_slice": "seq_slice_layer", "seqconcat": "seq_concat_layer",
+        "seqfirstins": "first_seq", "seqlastins": "last_seq",
+        "seqreshape": "seq_reshape_layer",
+        "slope_intercept": "slope_intercept_layer",
+        "spp": "spp_layer", "sub_nested_seq": "sub_nested_seq_layer",
+        "subseq": "SubsequenceInput",
+        "sum_to_one_norm": "sum_to_one_norm_layer",
+        "switch_order": "switch_order_layer", "tensor": "tensor_layer",
+        "trans": "trans_layer", "warp_ctc": "warp_ctc_layer",
+        # recurrent_group plumbing: these kinds are emitted by the parser
+        # for the group machinery, which api/recurrent.py subsumes with
+        # scan-based memory/StaticInput semantics.
+        "agent": "recurrent_group", "gather_agent": "recurrent_group",
+        "scatter_agent": "recurrent_group", "memory": "memory",
+    }
+    # Documented deltas (docs/design/overview.md "Intentional capability
+    # deltas"): vendor-specific kernel variants collapse onto the XLA
+    # lowering; mdlstm never shipped working GPU kernels in the reference.
+    deltas = {
+        "mkldnn_conv", "mkldnn_fc", "mkldnn_pool",   # CPU-vendor backend
+        "cudnn_convt",                                # vendor transpose-conv
+        "mdlstmemory",                                # multi-dim LSTM
+        "data_norm",                                  # stats-table norm
+    }
+
+    missing = []
+    for kind in sorted(kinds):
+        if kind in deltas:
+            continue
+        helper = mapping.get(kind)
+        if helper is None or not hasattr(v1, helper):
+            missing.append((kind, helper))
+    assert not missing, missing
