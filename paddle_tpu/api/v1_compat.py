@@ -474,9 +474,10 @@ def value_printer_evaluator(input=None, name=None, keys=("logits",), **kw):
     return _ev.ValuePrinter(keys, name=name or "value_printer")
 
 
-def gradient_printer_evaluator(input=None, name=None, keys=("logits",),
-                               **kw):
-    return _ev.ValuePrinter(keys, name=name or "gradient_printer")
+def gradient_printer_evaluator(input=None, name=None, keys=None, **kw):
+    # A true gradient printer (Evaluator.cpp:1029): the Trainer computes
+    # the per-batch gradient tree for it (wants_gradients hook).
+    return _ev.GradientPrinter(keys, name=name or "gradient_printer")
 
 
 def maxid_printer_evaluator(input=None, name=None, keys=("logits",), **kw):

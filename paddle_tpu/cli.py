@@ -144,7 +144,8 @@ def cmd_time(args):
         timed_run(step_fn, -(-args.burn_in // K))
         ms, spread = marginal_ms_with_spread(
             step_fn, n=max(1, n // K), repeats=args.repeats)
-        ms, spread = ms / K, spread / K
+        ms = ms / K
+        spread = spread / K if spread is not None else None
         protocol = "differential-scan"
         # MFU from XLA's FLOP count of the compiled scan (per batch —
         # the loop body is counted trip-count-invariantly).

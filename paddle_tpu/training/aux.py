@@ -44,13 +44,22 @@ def parameter_stats(params, grads=None) -> Dict[str, Dict[str, float]]:
 
 
 def format_parameter_stats(stats: Dict[str, Dict[str, float]]) -> str:
-    """Human-readable table (the log_period print twin)."""
-    lines = [f"{'parameter':<40} {'max_abs':>12} {'avg_abs':>12} "
-             f"{'min':>12} {'max':>12}"]
+    """Human-readable table (the log_period print twin); gradient columns
+    appear when the stats carry them (GradientPrinter path)."""
+    with_grads = any("grad_max_abs" in s for s in stats.values())
+    header = (f"{'parameter':<40} {'max_abs':>12} {'avg_abs':>12} "
+              f"{'min':>12} {'max':>12}")
+    if with_grads:
+        header += f" {'grad_max_abs':>13} {'grad_avg_abs':>13}"
+    lines = [header]
     for name, s in sorted(stats.items()):
-        lines.append(f"{name:<40} {s['max_abs']:>12.6g} "
-                     f"{s['avg_abs']:>12.6g} {s['min']:>12.6g} "
-                     f"{s['max']:>12.6g}")
+        row = (f"{name:<40} {s['max_abs']:>12.6g} "
+               f"{s['avg_abs']:>12.6g} {s['min']:>12.6g} "
+               f"{s['max']:>12.6g}")
+        if with_grads:
+            row += (f" {s.get('grad_max_abs', 0.0):>13.6g}"
+                    f" {s.get('grad_avg_abs', 0.0):>13.6g}")
+        lines.append(row)
     return "\n".join(lines)
 
 

@@ -414,3 +414,104 @@ def iob_chunks(tags, num_chunk_types: int):
     if start is not None:
         chunks.add((start, len(tags), ctype))
     return chunks
+
+
+class GradientPrinter(Evaluator):
+    """Per-parameter gradient statistics printer (twin of GradientPrinter,
+    ``Evaluator.cpp:1029-1046``, config api gradient_printer_evaluator).
+
+    Declares ``wants_gradients``: the Trainer's batch loop computes the
+    gradient tree for each batch (an extra forward+backward — a debug
+    path, exactly as spammy as the reference's) and passes it via
+    ``outputs["__gradients__"]`` with the pre-update params."""
+
+    wants_gradients = True
+
+    def __init__(self, keys=None, log_fn=print, name: str = "grad_printer"):
+        self.keys = list(keys) if keys is not None else None
+        self.log_fn = log_fn
+        self.name = name
+
+    def start(self):
+        self.batches = 0
+
+    def update(self, outputs):
+        from paddle_tpu.training.aux import (format_parameter_stats,
+                                             parameter_stats)
+        grads = outputs.get("__gradients__")
+        params = outputs.get("__params__")
+        if grads is None or params is None:
+            # e.g. an eval pass reusing the evaluator list: only the
+            # train loop supplies gradients; count printed batches only.
+            return
+        self.batches += 1
+        stats = parameter_stats(params, grads)
+        if self.keys is not None:
+            stats = {k: v for k, v in stats.items()
+                     if any(k.startswith(p) for p in self.keys)}
+        self.log_fn(f"[{self.name}] batch {self.batches}\n"
+                    + format_parameter_stats(stats))
+
+    def finish(self):
+        return float(self.batches)
+
+
+class RankAUC(Evaluator):
+    """Per-sequence weighted rank AUC averaged over sequences (twin of
+    RankAucEvaluator, ``Evaluator.cpp:502-580``): scores ranked
+    descending within each sequence; clicks are positives and
+    (pv - click) the negatives, tied scores sharing trapezoid credit.
+
+    update() consumes ``outputs[score_key]`` [b, t], ``click_key`` [b, t]
+    and the sequence mask ``score_key + "_mask"`` (or ``mask_key``);
+    ``pv_key`` defaults to 1 per position like the reference's filled
+    pv vector."""
+
+    def __init__(self, score_key: str = "score", click_key: str = "click",
+                 pv_key: Optional[str] = None,
+                 mask_key: Optional[str] = None, name: str = "rank_auc"):
+        self.score_key = score_key
+        self.click_key = click_key
+        self.pv_key = pv_key
+        self.mask_key = mask_key or score_key + "_mask"
+        self.name = name
+
+    def start(self):
+        self.total = 0.0
+        self.sequences = 0
+
+    @staticmethod
+    def _seq_auc(score, click, pv):
+        order = np.argsort(-score, kind="stable")
+        auc = click_sum = old_click_sum = 0.0
+        no_click = no_click_sum = 0.0
+        last = np.inf
+        for i in order:
+            if score[i] != last:
+                auc += (click_sum + old_click_sum) * no_click / 2.0
+                old_click_sum = click_sum
+                no_click = 0.0
+                last = score[i]
+            no_click += pv[i] - click[i]
+            no_click_sum += no_click
+            click_sum += click[i]
+        auc += (click_sum + old_click_sum) * no_click / 2.0
+        denom = click_sum * no_click_sum
+        return 0.0 if denom == 0.0 else auc / denom
+
+    def update(self, outputs):
+        score = np.asarray(outputs[self.score_key], np.float64)
+        click = np.asarray(outputs[self.click_key], np.float64)
+        mask = np.asarray(outputs.get(self.mask_key,
+                                      np.ones_like(score, bool)), bool)
+        pv = (np.asarray(outputs[self.pv_key], np.float64)
+              if self.pv_key else np.ones_like(score))
+        for b in range(score.shape[0]):
+            m = mask[b]
+            if not m.any():
+                continue
+            self.total += self._seq_auc(score[b][m], click[b][m], pv[b][m])
+            self.sequences += 1
+
+    def finish(self):
+        return self.total / max(self.sequences, 1)

@@ -135,6 +135,20 @@ class Trainer:
                                                  batch, train=False)
             return loss, outputs
 
+        def grads_step(params, net_state, batch, step):
+            # Gradient tree only (GradientPrinter support): the same
+            # loss_fn as train_step, without the optimizer update.
+            rng = jax.random.fold_in(jax.random.key(self.seed), step)
+
+            def loss_fn(p):
+                with fusion_ctx():
+                    (loss, _), new_state = model.apply(
+                        p, net_state, rng, batch, train=True)
+                from paddle_tpu.nn.module import collect_aux_losses
+                return loss + collect_aux_losses(new_state)
+
+            return jax.grad(loss_fn)(params)
+
         def train_scan(params, net_state, opt_state, batch_stack, step0):
             # K train steps in ONE compiled program: the device-side
             # training loop (twin of the reference's C++ batch loop —
@@ -160,8 +174,18 @@ class Trainer:
             self._train_step = jax.jit(train_step, donate_argnums=(0, 2))
             self._train_scan = jax.jit(train_scan, donate_argnums=(0, 2))
         self._eval_step = jax.jit(eval_step)
+        self._grads_step = jax.jit(grads_step)
 
     # ---- training ----
+
+    def gradients(self, batch: Dict[str, Any]):
+        """Per-parameter gradient tree for ``batch`` at the CURRENT params
+        (pre-update) — the GradientPrinter/debug hook.  Costs an extra
+        forward+backward; a diagnostics path, not the training path."""
+        if self.params is None:
+            self.init(batch)
+        return self._grads_step(self.params, self.net_state,
+                                self._put(batch), self._step_array())
 
     def train_batch(self, batch: Dict[str, Any]):
         if self.params is None:
@@ -318,9 +342,22 @@ class Trainer:
                 costs = self._train_pass_fast(reader)
             else:
                 costs = []
+                wants_grads = any(getattr(e, "wants_gradients", False)
+                                  for e in evaluators)
                 for batch_id, batch in enumerate(reader()):
                     handler(ev.BeginIteration(pass_id, batch_id))
+                    if wants_grads:
+                        if self.params is None:
+                            self.init(batch)
+                        # Host snapshot: train_batch donates the param
+                        # buffers, which would delete a device alias.
+                        params_before = jax.tree_util.tree_map(
+                            np.asarray, self.params)
+                        grads = self.gradients(batch)
                     loss, outputs = self.train_batch(batch)
+                    if wants_grads:
+                        outputs = {**outputs, "__gradients__": grads,
+                                   "__params__": params_before}
                     for e in evaluators:
                         e.update({**outputs,
                                   **{k: batch[k] for k in batch}})

@@ -48,8 +48,9 @@ def marginal_ms_per_batch(step_fn: Callable[[], object], n: int = 10,
 
 def marginal_ms_with_spread(step_fn: Callable[[], object], n: int = 10,
                             repeats: int = 3) -> tuple:
-    """(median, half-interquartile-spread) of the paired differences —
-    the spread quantifies measurement noise for the benchmark tables."""
+    """(median, half-RANGE) of the paired differences — a conservative
+    noise quote for the benchmark tables ((max-min)/2 over the repeats;
+    None with a single repeat, where no spread was measured)."""
     n = max(n, 1)
     diffs = []
     for _ in range(max(repeats, 1)):

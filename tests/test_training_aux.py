@@ -140,3 +140,64 @@ def test_mfu_instrumentation():
     cpu = types.SimpleNamespace(device_kind="cpu")
     assert mfu_mod.peak_flops(cpu) is None
     assert mfu_mod.mfu(1e12, 1.0, cpu) is None
+
+
+def test_gradient_printer_receives_gradient_tree():
+    """GradientPrinter's wants_gradients hook: the train loop must hand it
+    the per-batch gradient tree with pre-update params (the reference's
+    gradient_printer_evaluator actually printed grads, Evaluator.cpp:1029)."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optim
+    from paddle_tpu.ops import losses
+    from paddle_tpu.training import Trainer
+    from paddle_tpu.training.evaluators import GradientPrinter
+
+    def model_fn(batch):
+        logits = nn.Linear(3, name="fc")(batch["x"])
+        return losses.softmax_cross_entropy(logits, batch["y"]).mean(), {}
+
+    rs = np.random.RandomState(0)
+    def reader():
+        for _ in range(3):
+            yield {"x": rs.randn(8, 4).astype(np.float32),
+                   "y": rs.randint(0, 3, 8).astype(np.int32)}
+
+    lines = []
+    gp = GradientPrinter(log_fn=lines.append)
+    tr = Trainer(model_fn, optim.sgd(0.1))
+    tr.train(reader, num_passes=1, evaluators=[gp])
+    assert len(lines) == 3
+    assert "grad_max_abs" in lines[0] and "fc" in lines[0]
+
+
+def test_rank_auc_matches_pairwise_definition():
+    from paddle_tpu.training.evaluators import RankAUC
+
+    rs = np.random.RandomState(1)
+    b, t = 4, 12
+    score = rs.rand(b, t).astype(np.float32)
+    click = (rs.rand(b, t) < 0.3).astype(np.float32)
+    mask = rs.rand(b, t) < 0.8
+    mask[:, 0] = True
+    # ensure each sequence has at least one click and one non-click
+    click[:, 0] = 1.0
+    click[:, 1] = 0.0
+    mask[:, 1] = True
+
+    ev = RankAUC(score_key="s", click_key="c", mask_key="m")
+    ev.start()
+    ev.update({"s": score, "c": click, "m": mask})
+    got = ev.finish()
+
+    # brute-force pairwise AUC per sequence (ties = 0.5 credit)
+    aucs = []
+    for i in range(b):
+        s, c = score[i][mask[i]], click[i][mask[i]]
+        pos = s[c == 1]
+        neg = s[c == 0]
+        if len(pos) == 0 or len(neg) == 0:
+            continue
+        wins = sum((p > n) + 0.5 * (p == n) for p in pos for n in neg)
+        aucs.append(wins / (len(pos) * len(neg)))
+    np.testing.assert_allclose(got, np.mean(aucs), rtol=1e-9)
