@@ -1,0 +1,258 @@
+"""Fused backward for the ``1x1-conv -> BatchNorm -> relu`` unit.
+
+**STATUS: measured experiment, NOT wired into the model zoo.**  On a
+v5e the fused backward benched ~2x SLOWER than XLA's chain at the hot
+ResNet shapes (n=401k rows: 256->64 3.18 vs 1.55 ms, 64->256 6.04 vs
+3.50 ms; n=100k 512->128 1.63 vs 1.34 ms).  The structural byte saving
+the design targets exists only in the full-graph context (where XLA's
+fusions re-read tensors across consumer fusions); in isolation XLA's
+conv emitters out-tile Mosaic's dot_general enough to erase the margin.
+Kept as a tested, documented negative result for the round-3 record —
+see docs/design/kernels.md.
+
+The round-2 roofline analysis (docs/design/kernels.md) showed XLA
+executing the ResNet backward within ~5% of the HBM floor of its OWN
+fusion structure — but that structure reads the big tensors 2-3 times:
+the BN-stat reduces read (dy, s), the dx fusion re-reads them plus w,
+and the dw fusion reads (x, dy) again.  This module restructures the
+chain into two Pallas passes over row tiles:
+
+  pass 1 (reduce):  read (dy, s)        -> dbeta, dgamma partials
+  pass 2 (apply):   read (dy, s, x)     -> dx tile, dw += , done
+
+so every big tensor is read at most twice total (dy, s) or once (x),
+instead of 2-3 times.  dw/dgamma/dbeta accumulate in constant-index
+output blocks (small, so Pallas's consecutive-revisit rule allows them —
+unlike the LSTM dW case, which had to move outside the kernel).
+
+Math (N = b*h*w rows, Co channels; eps inside istd):
+  forward:   s = x @ w;  x_hat = (s - mean) * istd
+             y = relu(gamma * x_hat + beta)
+  backward:  dz     = dy * (y > 0)
+             dbeta  = sum dz;      dgamma = sum dz * x_hat
+             ds     = gamma * istd * (dz - dbeta/N - x_hat * dgamma/N)
+             dx     = ds @ w^T;    dw = x^T @ ds
+
+Exposed through :func:`conv1x1_bn_relu`, a ``custom_vjp`` whole-unit op
+returning (y, mean, var) — batch statistics come out as plain outputs so
+the module layer can thread running averages through the state system
+OUTSIDE the pure vjp function.
+
+Reference twin: the hand-fused building blocks in
+``paddle/cuda/src/hl_batch_norm.cu`` + ``hl_cuda_cnn.cu`` — the same
+"one kernel owns the chain" discipline, re-targeted at HBM traffic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+_LANE = 128
+
+
+def block_supported(n: int, cin: int, cout: int) -> bool:
+    """Row-tiled kernels need lane-aligned channel counts and enough rows
+    for at least one (8-aligned) tile."""
+    return (cin % _LANE == 0 and cout % _LANE == 0
+            and n % 8 == 0 and n >= 8)
+
+
+def _row_tile(n: int, cin: int, cout: int) -> int:
+    """Row-tile height: big enough to keep the MXU busy, small enough
+    that (x, dy, s, dx) tiles + w + accumulators stay under VMEM."""
+    for tn in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if n % tn:
+            continue
+        words = (tn * cin * 2      # x, dx tiles
+                 + tn * cout * 3   # dy, s, dz tiles
+                 + 2 * cin * cout  # w + dw accumulator
+                 + 4 * cout)
+        if words * 4 <= 10 * 1024 * 1024:
+            return tn
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# pass 1: dbeta/dgamma reduction over row tiles
+# ---------------------------------------------------------------------------
+
+def _reduce_kernel(dy_ref, s_ref, mask_ref, mean_ref, istd_ref,
+                   dbeta_ref, dgamma_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dbeta_ref[:] = jnp.zeros_like(dbeta_ref)
+        dgamma_ref[:] = jnp.zeros_like(dgamma_ref)
+
+    s = s_ref[:].astype(jnp.float32)
+    x_hat = (s - mean_ref[:]) * istd_ref[:]
+    # mask is the exact forward relu sign (recomputing y from bf16 s
+    # flips boundary elements).
+    dz = dy_ref[:].astype(jnp.float32) * mask_ref[:].astype(jnp.float32)
+    dbeta_ref[:] += jnp.sum(dz, axis=0, keepdims=True)
+    dgamma_ref[:] += jnp.sum(dz * x_hat, axis=0, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# pass 2: dx tiles + dw accumulation
+# ---------------------------------------------------------------------------
+
+def _apply_kernel(x_ref, dy_ref, s_ref, mask_ref, w_ref, mean_ref,
+                  istd_ref, gamma_ref, sums_ref, dx_ref, dw_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    s = s_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    istd = istd_ref[:]
+    x_hat = (s - mean) * istd
+    gamma = gamma_ref[:]
+    dz = dy_ref[:].astype(jnp.float32) * mask_ref[:].astype(jnp.float32)
+    # sums_ref rows: 0 = dbeta/N, 1 = dgamma/N (pre-divided by caller)
+    ds = gamma * istd * (dz - sums_ref[0] - x_hat * sums_ref[1])
+    dsb = ds.astype(jnp.bfloat16)
+    dx_ref[:] = lax.dot_general(
+        dsb, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dx_ref.dtype)
+    dw_ref[:] += lax.dot_general(
+        x_ref[:], dsb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _block_bwd_pallas(x, dy, s, mask, w, mean, istd, gamma, tn: int,
+                      interpret: bool):
+    n, cin = x.shape
+    cout = w.shape[1]
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    grid = (n // tn,)
+
+    dbeta, dgamma = pl.pallas_call(
+        _reduce_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+            jax.ShapeDtypeStruct((1, cout), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(dy, s, mask, mean[None], istd[None])
+
+    sums = jnp.concatenate([dbeta, dgamma], axis=0) / float(n)
+    dx, dw = pl.pallas_call(
+        _apply_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tn, cin), lambda i: (i, 0)),
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((tn, cout), lambda i: (i, 0)),
+            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((2, cout), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tn, cin), lambda i: (i, 0)),
+            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, cin), x.dtype),
+            jax.ShapeDtypeStruct((cin, cout), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(x, dy, s, mask, w.astype(jnp.bfloat16), mean[None], istd[None],
+      gamma.astype(jnp.float32)[None], sums)
+    return dx, dw, dgamma[0], dbeta[0]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp unit
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def conv1x1_bn_relu(x, w, gamma, beta, eps: float = 1e-5,
+                    interpret: bool = False):
+    """y = relu(BN_train(x @ w)) over rows; returns (y, mean, var).
+
+    x: [n, cin] (bf16 or f32 rows — callers flatten NHWC spatial dims),
+    w: [cin, cout]; gamma/beta: [cout] f32.  Batch statistics return as
+    outputs so module wrappers can update running averages outside this
+    pure function.
+    """
+    y, mean, var, _, _, _ = _unit_fwd_math(x, w, gamma, beta, eps)
+    return y, lax.stop_gradient(mean), lax.stop_gradient(var)
+
+
+def _unit_fwd_math(x, w, gamma, beta, eps):
+    s = jnp.dot(x, w.astype(x.dtype),
+                preferred_element_type=jnp.float32)
+    mean = jnp.mean(s, axis=0)
+    var = jnp.maximum(jnp.mean(jnp.square(s), axis=0)
+                      - jnp.square(mean), 0.0)
+    istd = lax.rsqrt(var + eps)
+    x_hat = (s - mean) * istd
+    z = gamma * x_hat + beta
+    y = jnp.maximum(z, 0.0).astype(x.dtype)
+    return y, mean, var, istd, s.astype(jnp.bfloat16), (z > 0.0)
+
+
+def _unit_fwd(x, w, gamma, beta, eps, interpret):
+    y, mean, var, istd, s, mask = _unit_fwd_math(x, w, gamma, beta, eps)
+    return ((y, lax.stop_gradient(mean), lax.stop_gradient(var)),
+            (x, w, gamma, mean, istd, s, mask))
+
+
+def _unit_bwd(eps, interpret, res, grads):
+    x, w, gamma, mean, istd, s, mask = res
+    dy, dmean, dvar = grads
+    # mean/var are emitted through stop_gradient in the primal (they feed
+    # running averages, not the loss), so their cotangents are zero.
+    del dmean, dvar
+    n, cin = x.shape
+    cout = w.shape[1]
+    from paddle_tpu.core.errors import enforce
+    enforce(block_supported(n, cin, cout),
+            "conv1x1_bn_relu backward needs lane-aligned channels and "
+            "8-aligned rows; got n=%d cin=%d cout=%d", n, cin, cout)
+    tn = _row_tile(n, cin, cout)
+    enforce(tn > 0, "conv1x1_bn_relu: no row tile fits VMEM for "
+            "n=%d cin=%d cout=%d", n, cin, cout)
+    dx, dw, dgamma, dbeta = _block_bwd_pallas(
+        x.astype(jnp.bfloat16), dy.astype(jnp.bfloat16), s, mask,
+        w, mean, istd, gamma, tn, interpret)
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+
+
+conv1x1_bn_relu.defvjp(_unit_fwd, _unit_bwd)

@@ -1,0 +1,64 @@
+"""Interpret-mode cross-check of the fused conv1x1+BN+relu unit against
+the plain-jnp chain (twin-kernel test pattern; bf16-tier tolerances —
+the backward streams bf16 tiles by design)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import pallas_conv_block as pcb
+
+
+def _ref_unit(x, w, gamma, beta, eps=1e-5):
+    s = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    mean = jnp.mean(s, axis=0)
+    var = jnp.maximum(jnp.mean(jnp.square(s), axis=0)
+                      - jnp.square(mean), 0.0)
+    x_hat = (s - mean) * jax.lax.rsqrt(var + eps)
+    return jnp.maximum(gamma * x_hat + beta, 0.0)
+
+
+@pytest.mark.parametrize("n,cin,cout", [(256, 128, 128), (512, 256, 128)])
+def test_unit_forward_matches_reference(rng, n, cin, cout):
+    x = jnp.asarray(rng.randn(n, cin), jnp.float32) * 0.1
+    w = jnp.asarray(rng.randn(cin, cout), jnp.float32) * 0.05
+    gamma = jnp.asarray(rng.rand(cout) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(cout) * 0.1, jnp.float32)
+    y, mean, var = pcb.conv1x1_bn_relu(x, w, gamma, beta, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(_ref_unit(x, w, gamma, beta)),
+                               rtol=2e-2, atol=2e-3)
+    s = np.asarray(x) @ np.asarray(w)
+    np.testing.assert_allclose(np.asarray(mean), s.mean(0), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unit_grads_match_reference(rng):
+    n, cin, cout = 256, 128, 128
+    x = jnp.asarray(rng.randn(n, cin), jnp.float32) * 0.1
+    w = jnp.asarray(rng.randn(cin, cout), jnp.float32) * 0.05
+    gamma = jnp.asarray(rng.rand(cout) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(cout) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.randn(n, cout), jnp.float32)
+
+    def loss_fused(x, w, gamma, beta):
+        y, _, _ = pcb.conv1x1_bn_relu(x, w, gamma, beta, 1e-5, True)
+        return jnp.sum(y * t)
+
+    def loss_ref(x, w, gamma, beta):
+        return jnp.sum(_ref_unit(x, w, gamma, beta) * t)
+
+    g_f = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    for a, b, name in zip(g_f, g_r, ("dx", "dw", "dgamma", "dbeta")):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.abs(b).max() + 1e-9
+        np.testing.assert_allclose(a / scale, b / scale, atol=3e-2,
+                                   err_msg=name)
+
+
+def test_row_tile_and_gate():
+    assert pcb.block_supported(128 * 56 * 56, 256, 128)
+    assert not pcb.block_supported(100, 250, 128)
+    assert pcb._row_tile(128 * 56 * 56, 256, 128) >= 256
