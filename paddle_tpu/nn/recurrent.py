@@ -67,10 +67,13 @@ class LSTM(Module):
         self.gate_act = activations.get(gate_act)
         # With the default activations (tanh/sigmoid — the reference's
         # hl_lstm_ops.cuh config) the recurrence routes through
-        # ops/pallas_kernels.lstm_scan and is always carried in f32 (cell
-        # state precision), on every backend — so numerics never depend on
-        # batch size or backend.  Custom activations use the policy-dtype
-        # scan below.  ``use_pallas`` forces the kernel choice (tests).
+        # ops/pallas_kernels.lstm_scan; the LIVE (h, c) carry and gate
+        # math are f32 on every backend, while the fused kernels' xw/hs
+        # HBM streams follow the policy dtype (bf16 under mixed
+        # precision) — so mixed-policy results are bf16-tier and can
+        # differ from the always-f32 lax.scan fallback.  Custom
+        # activations use the policy-dtype scan below.  ``use_pallas``
+        # forces the kernel choice (tests).
         self._fusable = act == "tanh" and gate_act == "sigmoid"
         self.use_pallas = use_pallas
         self.reverse = reverse
@@ -107,8 +110,12 @@ class LSTM(Module):
 
         if self._fusable:
             out_dtype = xw_t.dtype
+            # xw streams to the kernel in the policy dtype (bf16 under
+            # mixed precision — half the HBM traffic of the dominant
+            # stream and no boundary casts); the kernel's live (h, c)
+            # carry and gate math stay f32 regardless.
             hs, h_last, c_last = pallas_kernels.lstm_scan(
-                xw_t.astype(jnp.float32), w_h.astype(jnp.float32),
+                xw_t, w_h.astype(jnp.float32),
                 h0.astype(jnp.float32), c0.astype(jnp.float32), mask_t,
                 use_pallas=self.use_pallas)
             hs = hs.astype(out_dtype)

@@ -109,10 +109,22 @@ def _sigmoid(x):
 # Forward kernel: grid over time, (h, c) carried in VMEM scratch.
 # ---------------------------------------------------------------------------
 
-def _make_fwd_kernel(with_cs: bool):
+def _lstm_unroll(t: int) -> int:
+    """Timesteps per grid step: each sequential grid step costs ~1-2us of
+    fixed overhead, which DOMINATES the ~0.2us of per-step MXU work at
+    bench shapes — unrolling U steps into one grid step divides that
+    overhead by U.  U must divide t."""
+    for u in (4, 2):
+        if t % u == 0:
+            return u
+    return 1
+
+
+def _make_fwd_kernel(with_cs: bool, unroll: int):
     """Build the forward kernel; ``with_cs`` adds the cell-state-sequence
     output needed only as a VJP residual (the inference/primal call skips it
-    to avoid a dead [t,b,h] HBM write)."""
+    to avoid a dead [t,b,h] HBM write).  ``unroll`` timesteps run inside
+    each grid step (statically unrolled)."""
 
     def kernel(xw_ref, w_h_ref, h0_ref, c0_ref, mask_ref, *rest):
         if with_cs:
@@ -120,7 +132,7 @@ def _make_fwd_kernel(with_cs: bool):
         else:
             hs_ref, h_last_ref, c_last_ref, h_s, c_s = rest
         i = pl.program_id(0)
-        t = pl.num_programs(0)
+        g = pl.num_programs(0)
         h = h0_ref.shape[1]
 
         @pl.when(i == 0)
@@ -128,28 +140,36 @@ def _make_fwd_kernel(with_cs: bool):
             h_s[:] = h0_ref[:]
             c_s[:] = c0_ref[:]
 
-        h_prev = h_s[:]
-        c_prev = c_s[:]
-        gates = xw_ref[0] + jnp.dot(h_prev, w_h_ref[:],
-                                    preferred_element_type=jnp.float32)
-        i_g = _sigmoid(gates[:, :h])
-        f_g = _sigmoid(gates[:, h:2 * h])
-        g_g = jnp.tanh(gates[:, 2 * h:3 * h])
-        o_g = _sigmoid(gates[:, 3 * h:])
-        c_new = f_g * c_prev + i_g * g_g
-        h_new = o_g * jnp.tanh(c_new)
+        h_t = h_s[:]
+        c_t = c_s[:]
+        # Match the dot operands to the stream dtype: bf16 x bf16 hits the
+        # MXU's native tier under the mixed policy; mixed-dtype dots would
+        # silently promote to the (8x slower) f32 path.  f32 inputs keep
+        # the exact-f32 behavior the CPU tests pin.
+        cdt = xw_ref.dtype
+        w = w_h_ref[:]
+        for u in range(unroll):
+            h_prev, c_prev = h_t, c_t
+            gates = xw_ref[u].astype(jnp.float32) + jnp.dot(
+                h_prev.astype(cdt), w, preferred_element_type=jnp.float32)
+            i_g = _sigmoid(gates[:, :h])
+            f_g = _sigmoid(gates[:, h:2 * h])
+            g_g = jnp.tanh(gates[:, 2 * h:3 * h])
+            o_g = _sigmoid(gates[:, 3 * h:])
+            c_new = f_g * c_prev + i_g * g_g
+            h_new = o_g * jnp.tanh(c_new)
 
-        m = mask_ref[0]
-        c_t = m * c_new + (1.0 - m) * c_prev
-        h_t = m * h_new + (1.0 - m) * h_prev
+            m = mask_ref[u]
+            c_t = m * c_new + (1.0 - m) * c_prev
+            h_t = m * h_new + (1.0 - m) * h_prev
 
-        hs_ref[0] = h_t
-        if with_cs:
-            cs_ref[0] = c_t
+            hs_ref[u] = h_t.astype(hs_ref.dtype)
+            if with_cs:
+                cs_ref[u] = c_t.astype(cs_ref.dtype)
         h_s[:] = h_t
         c_s[:] = c_t
 
-        @pl.when(i == t - 1)
+        @pl.when(i == g - 1)
         def _():
             h_last_ref[:] = h_t
             c_last_ref[:] = c_t
@@ -165,20 +185,24 @@ def _lstm_fwd_pallas(xw_t, w_h, h0, c0, mask_t, interpret: bool,
     if not interpret and pltpu is not None:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("arbitrary",))
-    seq_out = [pl.BlockSpec((1, b, h), lambda i: (i, 0, 0))]
-    seq_shape = [jax.ShapeDtypeStruct((t, b, h), jnp.float32)]
+    u = _lstm_unroll(t)
+    seq_out = [pl.BlockSpec((u, b, h), lambda i: (i, 0, 0))]
+    # Sequence outputs stream in the INPUT's dtype: under the bf16 policy
+    # that halves the hs/cs HBM traffic and removes the boundary casts;
+    # the live (h, c) carry stays f32 in scratch either way.
+    seq_shape = [jax.ShapeDtypeStruct((t, b, h), xw_t.dtype)]
     if with_cs:
         seq_out = seq_out * 2
         seq_shape = seq_shape * 2
     return pl.pallas_call(
-        _make_fwd_kernel(with_cs),
-        grid=(t,),
+        _make_fwd_kernel(with_cs, u),
+        grid=(t // u,),
         in_specs=[
-            pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((u, b, four_h), lambda i: (i, 0, 0)),
             pl.BlockSpec((h, four_h), lambda i: (0, 0)),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
-            pl.BlockSpec((1, b, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((u, b, 1), lambda i: (i, 0, 0)),
         ],
         out_specs=seq_out + [
             pl.BlockSpec((b, h), lambda i: (0, 0)),
@@ -194,7 +218,7 @@ def _lstm_fwd_pallas(xw_t, w_h, h0, c0, mask_t, interpret: bool,
         ] if pltpu is not None else [],
         interpret=interpret,
         **kwargs,
-    )(xw_t, w_h, h0, c0, mask_t[:, :, None])
+    )(xw_t, w_h.astype(xw_t.dtype), h0, c0, mask_t[:, :, None])
 
 
 # ---------------------------------------------------------------------------
@@ -202,101 +226,118 @@ def _lstm_fwd_pallas(xw_t, w_h, h0, c0, mask_t, interpret: bool,
 # in VMEM scratch.
 # ---------------------------------------------------------------------------
 
-def _lstm_bwd_kernel(xw_ref, w_h_ref, h_prev_ref, c_prev_ref, mask_ref,
-                     dhs_ref, dh_last_ref, dc_last_ref,
-                     dxw_ref, dwh_ref, dh0_ref, dc0_ref,
-                     dh_s, dc_s, dwh_s):
-    i = pl.program_id(0)
-    t = pl.num_programs(0)
-    h = h_prev_ref.shape[2]
+def _make_lstm_bwd_kernel(unroll: int):
+    """Reverse-time backward with ``unroll`` timesteps per grid step
+    (processed newest-to-oldest inside the block)."""
 
-    @pl.when(i == 0)
-    def _():
-        dh_s[:] = dh_last_ref[:]
-        dc_s[:] = dc_last_ref[:]
-        dwh_s[:] = jnp.zeros_like(dwh_s)
+    def kernel(xw_ref, w_h_ref, h_prev_ref, c_prev_ref, mask_ref,
+               dhs_ref, dh_last_ref, dc_last_ref,
+               dxw_ref, dwh_ref, dh0_ref, dc0_ref,
+               dh_s, dc_s, dwh_s):
+        i = pl.program_id(0)
+        g = pl.num_programs(0)
+        h = h_prev_ref.shape[2]
 
-    h_prev = h_prev_ref[0]
-    c_prev = c_prev_ref[0]
-    m = mask_ref[0]
+        @pl.when(i == 0)
+        def _():
+            dh_s[:] = dh_last_ref[:]
+            dc_s[:] = dc_last_ref[:]
+            dwh_s[:] = jnp.zeros_like(dwh_s)
 
-    # Recompute this step's gates (remat: one extra MXU matmul instead of
-    # storing i/f/g/o activations for every step).
-    gates = xw_ref[0] + jnp.dot(h_prev, w_h_ref[:],
-                                preferred_element_type=jnp.float32)
-    i_g = _sigmoid(gates[:, :h])
-    f_g = _sigmoid(gates[:, h:2 * h])
-    g_g = jnp.tanh(gates[:, 2 * h:3 * h])
-    o_g = _sigmoid(gates[:, 3 * h:])
-    c_new = f_g * c_prev + i_g * g_g
-    tanh_c = jnp.tanh(c_new)
+        cdt = xw_ref.dtype
+        w = w_h_ref[:]
+        dh_carry = dh_s[:]
+        dc_carry = dc_s[:]
+        dwh_acc = dwh_s[:]
+        for u in range(unroll - 1, -1, -1):
+            h_prev = h_prev_ref[u].astype(jnp.float32)
+            c_prev = c_prev_ref[u].astype(jnp.float32)
+            m = mask_ref[u]
 
-    dh = dh_s[:] + dhs_ref[0]
-    dc = dc_s[:]
+            # Recompute this step's gates (remat: one extra MXU matmul
+            # instead of storing i/f/g/o activations for every step).
+            gates = xw_ref[u].astype(jnp.float32) + jnp.dot(
+                h_prev_ref[u].astype(cdt), w,
+                preferred_element_type=jnp.float32)
+            i_g = _sigmoid(gates[:, :h])
+            f_g = _sigmoid(gates[:, h:2 * h])
+            g_g = jnp.tanh(gates[:, 2 * h:3 * h])
+            o_g = _sigmoid(gates[:, 3 * h:])
+            c_new = f_g * c_prev + i_g * g_g
+            tanh_c = jnp.tanh(c_new)
 
-    do = dh * tanh_c * m
-    dc_new = dh * o_g * (1.0 - tanh_c * tanh_c) * m + dc * m
-    di = dc_new * g_g
-    df = dc_new * c_prev
-    dg = dc_new * i_g
+            dh = dh_carry + dhs_ref[u].astype(jnp.float32)
+            dc = dc_carry
 
-    dgi = di * i_g * (1.0 - i_g)
-    dgf = df * f_g * (1.0 - f_g)
-    dgg = dg * (1.0 - g_g * g_g)
-    dgo = do * o_g * (1.0 - o_g)
-    dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=-1)
+            do = dh * tanh_c * m
+            dc_new = dh * o_g * (1.0 - tanh_c * tanh_c) * m + dc * m
+            di = dc_new * g_g
+            df = dc_new * c_prev
+            dg = dc_new * i_g
 
-    dxw_ref[0] = dgates
-    # dh_prev via W_h^T: contract the 4h axis of both operands.
-    dh_prev = lax.dot_general(
-        dgates, w_h_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) + (1.0 - m) * dh
-    dc_prev = dc_new * f_g + (1.0 - m) * dc
-    # dW_h += h_prev^T @ dgates (contract the batch axis).
-    dwh_s[:] += lax.dot_general(
-        h_prev, dgates, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+            dgi = di * i_g * (1.0 - i_g)
+            dgf = df * f_g * (1.0 - f_g)
+            dgg = dg * (1.0 - g_g * g_g)
+            dgo = do * o_g * (1.0 - o_g)
+            dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=-1)
 
-    dh_s[:] = dh_prev
-    dc_s[:] = dc_prev
+            dxw_ref[u] = dgates.astype(dxw_ref.dtype)
+            dgates_c = dgates.astype(cdt)
+            # dh_prev via W_h^T: contract the 4h axis of both operands.
+            dh_carry = lax.dot_general(
+                dgates_c, w, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) + (1.0 - m) * dh
+            dc_carry = dc_new * f_g + (1.0 - m) * dc
+            # dW_h += h_prev^T @ dgates (contract the batch axis).
+            dwh_acc += lax.dot_general(
+                h_prev.astype(cdt), dgates_c, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
-    @pl.when(i == t - 1)
-    def _():
-        dh0_ref[:] = dh_prev
-        dc0_ref[:] = dc_prev
-        dwh_ref[:] = dwh_s[:]
+        dh_s[:] = dh_carry
+        dc_s[:] = dc_carry
+        dwh_s[:] = dwh_acc
+
+        @pl.when(i == g - 1)
+        def _():
+            dh0_ref[:] = dh_carry
+            dc0_ref[:] = dc_carry
+            dwh_ref[:] = dwh_acc
+
+    return kernel
 
 
 def _lstm_bwd_pallas(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
                      dhs, dh_last, dc_last, interpret: bool):
     t, b, four_h = xw_t.shape
     h = four_h // 4
-    rev = lambda i: (t - 1 - i, 0, 0)  # noqa: E731
+    u = _lstm_unroll(t)
+    g = t // u
+    rev = lambda i: (g - 1 - i, 0, 0)  # noqa: E731
     kwargs = {}
     if not interpret and pltpu is not None:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("arbitrary",))
     dxw_r, dwh, dh0, dc0 = pl.pallas_call(
-        _lstm_bwd_kernel,
-        grid=(t,),
+        _make_lstm_bwd_kernel(u),
+        grid=(g,),
         in_specs=[
-            pl.BlockSpec((1, b, four_h), rev),
+            pl.BlockSpec((u, b, four_h), rev),
             pl.BlockSpec((h, four_h), lambda i: (0, 0)),
-            pl.BlockSpec((1, b, h), rev),
-            pl.BlockSpec((1, b, h), rev),
-            pl.BlockSpec((1, b, 1), rev),
-            pl.BlockSpec((1, b, h), rev),
+            pl.BlockSpec((u, b, h), rev),
+            pl.BlockSpec((u, b, h), rev),
+            pl.BlockSpec((u, b, 1), rev),
+            pl.BlockSpec((u, b, h), rev),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, b, four_h), rev),
+            pl.BlockSpec((u, b, four_h), rev),
             pl.BlockSpec((h, four_h), lambda i: (0, 0)),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
             pl.BlockSpec((b, h), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((t, b, four_h), jnp.float32),
+            jax.ShapeDtypeStruct((t, b, four_h), xw_t.dtype),
             jax.ShapeDtypeStruct((h, four_h), jnp.float32),
             jax.ShapeDtypeStruct((b, h), jnp.float32),
             jax.ShapeDtypeStruct((b, h), jnp.float32),
@@ -308,8 +349,8 @@ def _lstm_bwd_pallas(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
         ] if pltpu is not None else [],
         interpret=interpret,
         **kwargs,
-    )(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t[:, :, None], dhs,
-      dh_last, dc_last)
+    )(xw_t, w_h.astype(xw_t.dtype), h_prev_seq, c_prev_seq,
+      mask_t[:, :, None], dhs, dh_last, dc_last)
     return dxw_r, dwh, dh0, dc0
 
 
@@ -322,9 +363,12 @@ def fused_lstm_scan(xw_t, w_h, h0, c0, mask_t, interpret: bool = False):
     """Fused LSTM recurrence over precomputed input projections.
 
     Args:
-      xw_t:   [time, batch, 4*hidden] f32 — x @ W_x + bias per step,
-              gate order (input, forget, cell, output) as in the reference
-              (``hl_lstm_ops.cuh`` active/state layout).
+      xw_t:   [time, batch, 4*hidden] f32 OR bf16 — x @ W_x + bias per
+              step, gate order (input, forget, cell, output) as in the
+              reference (``hl_lstm_ops.cuh`` active/state layout).  The
+              xw/hs/cs HBM streams and the recurrent dots run in this
+              dtype; gate math and the live (h, c) carry are f32 either
+              way, so bf16 trades stream width for bf16-tier matmuls.
       w_h:    [hidden, 4*hidden] f32 recurrent weights.
       h0/c0:  [batch, hidden] f32 initial state.
       mask_t: [time, batch] f32 validity mask (padding steps carry state).
@@ -346,8 +390,12 @@ def _fused_fwd(xw_t, w_h, h0, c0, mask_t, interpret):
 def _fused_bwd(interpret, res, grads):
     xw_t, w_h, h0, c0, mask_t, hs, cs = res
     dhs, dh_last, dc_last = grads
-    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
-    c_prev_seq = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    # Keep the residual streams in hs/cs's dtype: concatenating f32
+    # h0/c0 in would promote both [t,b,h] streams back to f32.
+    h_prev_seq = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]],
+                                 axis=0)
+    c_prev_seq = jnp.concatenate([c0[None].astype(cs.dtype), cs[:-1]],
+                                 axis=0)
     dxw, dwh, dh0, dc0 = _lstm_bwd_pallas(
         xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
         dhs, dh_last, dc_last, interpret)
@@ -362,7 +410,8 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """LSTM recurrence: Pallas-fused on TPU, ``lax.scan`` elsewhere.
 
-    All inputs/outputs f32 (the dtype policy casts around this op).
+    ``xw_t`` may be f32 or bf16 (see :func:`fused_lstm_scan`); w_h/h0/c0
+    are f32; the ``lax.scan`` fallback always computes in f32.
     ``mask_t`` may be bool or float.
     """
     t, b, four_h = xw_t.shape
@@ -376,6 +425,10 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
         tiled = _tile_plan(b, h) is not None
     mask_f = mask_t.astype(jnp.float32)
     if use_pallas and tiled:
+        # The tiled custom_vjp's boundary is f32 (its HBM streams are
+        # bf16 internally either way); bf16 callers cast here so the
+        # cotangent dtypes line up.
+        xw_t = xw_t.astype(jnp.float32)
         splits, cn = _tile_plan(b, h)
         interp = not _on_tpu()
         if splits == 1:
@@ -396,6 +449,8 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
     if use_pallas:
         return fused_lstm_scan(xw_t, w_h, h0, c0, mask_f,
                                not _on_tpu())
+
+    xw_t = xw_t.astype(jnp.float32)   # the lax.scan path stays f32
 
     def step(carry, inp):
         h_prev, c_prev = carry

@@ -252,3 +252,73 @@ def test_tiled_lstm_batch_split_path(rng):
     for r, p in zip(ref, pal):
         np.testing.assert_allclose(np.asarray(r), np.asarray(p),
                                    rtol=2e-2, atol=2e-3)
+
+
+def test_fused_lstm_unrolled_grid_matches_scan(rng):
+    # t=8 -> 4 timesteps per grid step (t=5/6 above cover U=1/U=2).
+    xw, wh, h0, c0, mask = _inputs(rng, t=8, b=8, h=128)
+    assert pk._lstm_unroll(8) == 4
+    ref = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=False)
+    pal = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=True)
+    for r, p in zip(ref, pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=1e-5, atol=1e-5)
+
+    def loss(use_pallas):
+        def f(xw, wh, h0, c0):
+            hs, hl, cl = pk.lstm_scan(xw, wh, h0, c0, mask,
+                                      use_pallas=use_pallas)
+            return jnp.sum(jnp.sin(hs)) + jnp.sum(hl * cl)
+        return f
+
+    g_ref = jax.grad(loss(False), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    g_pal = jax.grad(loss(True), argnums=(0, 1, 2, 3))(xw, wh, h0, c0)
+    for r, p in zip(g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(p),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layer_fused_mixed_policy_close_to_scan(rng):
+    """Under MIXED_BF16 the fused kernel streams xw/hs in bf16 (the scan
+    fallback stays f32 internally); outputs must agree at bf16 tier."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.nn.recurrent import LSTM
+
+    x = jnp.asarray(rng.randn(8, 8, 32), jnp.float32)
+    mask = jnp.asarray(np.ones((8, 8), bool))
+    prev = dtypes.get_policy()
+    dtypes.set_policy(dtypes.MIXED_BF16)
+    try:
+        def run(use_pallas):
+            m = nn.transform(lambda xx, mk: LSTM(
+                128, name="l", use_pallas=use_pallas)(xx, mk))
+            params, _ = m.init(jax.random.key(0), x, mask)
+            (hs, (hl, cl)), _ = m.apply(params, {}, None, x, mask)
+            return np.asarray(hs, np.float32), np.asarray(hl, np.float32)
+
+        hs_s, hl_s = run(False)
+        hs_p, hl_p = run(True)
+    finally:
+        dtypes.set_policy(prev)
+    np.testing.assert_allclose(hs_p, hs_s, rtol=5e-2, atol=1e-2)
+    np.testing.assert_allclose(hl_p, hl_s, rtol=5e-2, atol=1e-2)
+
+
+def test_tiled_path_accepts_bf16_xw(rng):
+    """Mixed-policy layers hand lstm_scan bf16 xw; the tiled branch casts
+    at its f32 custom_vjp boundary — jax.grad must not crash."""
+    import unittest.mock as um
+    xw, wh, h0, c0, mask = _inputs(rng, t=4, b=8, h=256)
+    xwb = xw.astype(jnp.bfloat16)
+
+    def loss(xwb, wh):
+        with um.patch.object(pk, "pallas_supported", lambda b, h: False), \
+                um.patch.object(pk, "_tile_plan", lambda b, h: (1, 128)):
+            hs, hl, cl = pk.lstm_scan(xwb, wh, h0, c0, mask,
+                                      use_pallas=True)
+        return jnp.sum(hs.astype(jnp.float32) ** 2) + jnp.sum(hl * cl)
+
+    loss_v, grads = jax.value_and_grad(loss, argnums=(0, 1))(xwb, wh)
+    assert grads[0].dtype == jnp.bfloat16
+    assert np.isfinite(float(loss_v))
