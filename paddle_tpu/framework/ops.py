@@ -484,15 +484,16 @@ register_op("cos_sim", _cos_sim, ["X", "Y"])
 
 
 def _conv_shift(x, y):
-    """conv_shift_op.cc (NTM circular convolution):
-    Out[b, i] = sum_{j=-(N-1)/2}^{(N-1)/2} X[b, (i+j) mod M] * Y[b, j mod N].
+    """conv_shift_op.cc ConvShiftKernel (NTM circular convolution):
+    Out[b, i] = sum_{j=0}^{N-1} X[b, (i + j - (N-1)/2) mod M] * Y[b, j],
+    i.e. for offset o in [-half, half] the filter tap is Y[b, o + half].
     N is odd and small (a shift window), so unrolling at trace time keeps
     this a handful of fused rolls instead of a gather."""
     n = y.shape[1]
     half = (n - 1) // 2
     out = jnp.zeros_like(x)
-    for j in range(-half, half + 1):
-        out = out + jnp.roll(x, -j, axis=1) * y[:, j % n][:, None]
+    for o in range(-half, half + 1):
+        out = out + jnp.roll(x, -o, axis=1) * y[:, o + half][:, None]
     return out
 
 

@@ -208,28 +208,32 @@ class BatchNorm(Module):
                        lambda s, d: jnp.zeros(s, d))
         var_s = state("moving_var", (dim,), jnp.float32,
                       lambda s, d: jnp.ones(s, d))
+        shape = [1] * x.ndim
+        shape[self.axis % x.ndim] = dim
         if is_training():
             xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=reduce_axes)
-            # Single-pass variance: E[x^2]-E[x]^2 with f32 accumulators,
-            # so XLA fuses BOTH statistics into ONE read of the conv
-            # output (with jnp.var the mean-centered pass forces a second
-            # full HBM read of every activation — measured ~8% of the
-            # ResNet-50 step).  Cancellation for large-mean/small-spread
-            # channels can go slightly negative in f32; clamping at 0
-            # keeps rsqrt finite (the epsilon then dominates), instead of
-            # persisting NaN into moving_var.
+            # Single-pass SHIFTED variance: both statistics come from ONE
+            # read of the conv output (with jnp.var the mean-centered pass
+            # forces a second full HBM read of every activation — measured
+            # ~8% of the ResNet-50 step).  Shifting by the running mean
+            # first (a constant, so still one fused pass) keeps the
+            # E[d^2]-E[d]^2 cancellation benign even for large-mean /
+            # small-spread channels, where the unshifted form loses all
+            # precision in f32; the clamp then only absorbs last-ulp
+            # negatives and epsilon dominates harmlessly.
+            shift = lax.stop_gradient(mean_s).reshape(shape)
+            d = xf - shift
+            dmean = jnp.mean(d, axis=reduce_axes)
+            mean = dmean + mean_s
             var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=reduce_axes)
-                - jnp.square(mean), 0.0)
+                jnp.mean(jnp.square(d), axis=reduce_axes)
+                - jnp.square(dmean), 0.0)
             from paddle_tpu.nn.module import set_state
             m = self.momentum
             set_state("moving_mean", m * mean_s + (1 - m) * mean)
             set_state("moving_var", m * var_s + (1 - m) * var)
         else:
             mean, var = mean_s, var_s
-        shape = [1] * x.ndim
-        shape[self.axis % x.ndim] = dim
         # Statistics stay f32; the normalization itself applies in the
         # activation dtype — under bf16 compute an f32 apply would double
         # the VPU + HBM cost of the hottest elementwise op in conv nets

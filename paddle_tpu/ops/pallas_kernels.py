@@ -48,23 +48,53 @@ def _on_tpu() -> bool:
 
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16MB/core VMEM
 
+# Budget for the RESIDENT kernel's unroll-aware estimate below.  Anchored
+# on v5e compile probes (t=100-102 class sequences, jax 0.9); estimates
+# are _resident_vmem_bytes at that point:
+#   b=64 h=256 u=4 bf16/f32   -> compiles (est 11.4 /  9.0 MB)
+#   b=64 h=512 u=1 bf16/f32   -> compiles (est 12.4 / 13.0 MB)
+#   b=64 h=512 u=2 bf16       -> VMEM OOM (est 16.5 MB)
+#   b=64 h=512 u=2 f32        -> compiles (est 15.75 MB) but left OFF:
+#     accepting it needs a budget above the physical 16MB/core, which
+#     would also re-admit the OOMing u=2 bf16 point; u=1 loses little.
+#   b=64 h=512 u=4 bf16/f32   -> VMEM OOM (est 24.7 / 21.3 MB)
+_RESIDENT_BUDGET = 14 * 1024 * 1024 + 512 * 1024
 
-def pallas_supported(b: int, h: int) -> bool:
+
+def _resident_vmem_bytes(b: int, h: int, u: int, stream_dtype) -> int:
+    """Estimated VMEM residency of the resident BACKWARD kernel (the larger
+    of the pair) at time-unroll ``u`` with HBM streams in ``stream_dtype``.
+
+    Streamed [u,b,*] blocks (xw, dxw, h_prev, c_prev, dhs) are
+    double-buffered by the Pallas pipeline.  bf16 streams are charged MORE
+    VMEM than f32 (6 vs 4 bytes/elt), not less: Mosaic stages (2,1)-packed
+    bf16 tiles through unpacked copies, so narrow streams halve HBM traffic
+    but grow residency — empirically u=2 bf16 at b=64 h=512 OOMs where
+    u=2 f32 compiles (see budget anchors above).
+    """
+    sb = 2 if stream_dtype == jnp.bfloat16 else 4
+    per_elt = 6 if sb == 2 else 4
+    streamed = 2 * u * b * 11 * h * per_elt   # xw+dxw (2*4h) + hprev/cprev/dhs (3h)
+    consts = h * 4 * h * (sb + 4)             # w_h stream + dW_h accumulator (f32)
+    state = 18 * b * h * 4                    # carries, last/out blocks, gate temps
+    return streamed + consts + state
+
+
+def pallas_supported(b: int, h: int, stream_dtype=jnp.float32) -> bool:
     """Fused kernels need MXU/VPU-friendly shapes and a VMEM-resident
     working set.
 
     The backward kernel holds w_h [h,4h], the dW_h accumulator [h,4h], the
-    per-step gate blocks [b,4h]×3 and several [b,h] state blocks in VMEM at
-    once; past ~h=512 the weights alone blow the 16MB/core budget and the
-    TILED kernels below (weight columns streamed per grid step) take over,
-    with the XLA scan as the final fallback.
+    double-buffered per-step stream blocks and several [b,h] state blocks
+    in VMEM at once; past ~h=512 the weights alone blow the 16MB/core
+    budget and the TILED kernels below (weight columns streamed per grid
+    step) take over, with the XLA scan as the final fallback.  Supported
+    means the u=1 working set fits; the actual unroll is chosen per-shape
+    by :func:`_lstm_unroll`.
     """
     if h % 128 != 0 or b < 8 or b % 8 != 0:
         return False
-    working_set = (2 * h * 4 * h      # w_h + dW_h accumulator
-                   + 5 * b * 4 * h    # gate blocks (xw, dxw, dgates, ...)
-                   + 10 * b * h) * 4  # h/c state blocks + scratch
-    return working_set <= _VMEM_BUDGET
+    return _resident_vmem_bytes(b, h, 1, stream_dtype) <= _RESIDENT_BUDGET
 
 
 _fusion_enabled = threading.local()
@@ -109,13 +139,16 @@ def _sigmoid(x):
 # Forward kernel: grid over time, (h, c) carried in VMEM scratch.
 # ---------------------------------------------------------------------------
 
-def _lstm_unroll(t: int) -> int:
+def _lstm_unroll(t: int, b: int, h: int, stream_dtype=jnp.float32) -> int:
     """Timesteps per grid step: each sequential grid step costs ~1-2us of
     fixed overhead, which DOMINATES the ~0.2us of per-step MXU work at
     bench shapes — unrolling U steps into one grid step divides that
-    overhead by U.  U must divide t."""
+    overhead by U.  U must divide t, and the u-scaled double-buffered
+    stream blocks must still fit the VMEM budget (at h=512 the model
+    keeps u=1 — see the probe table at :data:`_RESIDENT_BUDGET`)."""
     for u in (4, 2):
-        if t % u == 0:
+        if t % u == 0 and (_resident_vmem_bytes(b, h, u, stream_dtype)
+                           <= _RESIDENT_BUDGET):
             return u
     return 1
 
@@ -185,7 +218,7 @@ def _lstm_fwd_pallas(xw_t, w_h, h0, c0, mask_t, interpret: bool,
     if not interpret and pltpu is not None:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("arbitrary",))
-    u = _lstm_unroll(t)
+    u = _lstm_unroll(t, b, h, xw_t.dtype)
     seq_out = [pl.BlockSpec((u, b, h), lambda i: (i, 0, 0))]
     # Sequence outputs stream in the INPUT's dtype: under the bf16 policy
     # that halves the hs/cs HBM traffic and removes the boundary casts;
@@ -310,7 +343,7 @@ def _lstm_bwd_pallas(xw_t, w_h, h_prev_seq, c_prev_seq, mask_t,
                      dhs, dh_last, dc_last, interpret: bool):
     t, b, four_h = xw_t.shape
     h = four_h // 4
-    u = _lstm_unroll(t)
+    u = _lstm_unroll(t, b, h, xw_t.dtype)
     g = t // u
     rev = lambda i: (g - 1 - i, 0, 0)  # noqa: E731
     kwargs = {}
@@ -418,10 +451,18 @@ def lstm_scan(xw_t, w_h, h0, c0, mask_t,
     h = four_h // 4
     tiled = False
     if use_pallas is None:
-        use_pallas = should_fuse(b, h)
-        if not use_pallas and should_fuse(b, h, lstm_tiled_supported):
+        resident_ok = functools.partial(pallas_supported,
+                                        stream_dtype=xw_t.dtype)
+        use_pallas = should_fuse(b, h, resident_ok)
+        # The tiled kernels' HBM streams are bf16 internally, so their
+        # numerics are bf16-tier regardless of input dtype.  Auto-select
+        # them only when the caller is ALREADY on the bf16 policy; a
+        # FLOAT32-policy user keeps exact f32 via the XLA scan (explicit
+        # use_pallas=True still opts in to the bf16-stream tiled path).
+        if (not use_pallas and xw_t.dtype == jnp.bfloat16
+                and should_fuse(b, h, lstm_tiled_supported)):
             use_pallas = tiled = True
-    elif use_pallas and not pallas_supported(b, h):
+    elif use_pallas and not pallas_supported(b, h, xw_t.dtype):
         tiled = _tile_plan(b, h) is not None
     mask_f = mask_t.astype(jnp.float32)
     if use_pallas and tiled:

@@ -310,14 +310,15 @@ def test_op_zoo_tail_outputs(rng):
     check_output("cos_sim", {"X": x, "Y": y},
                  [(x * y).sum(-1, keepdims=True) / (xn * yn)])
 
-    # conv_shift vs an explicit modular-index loop
+    # conv_shift vs the reference ConvShiftKernel loop verbatim
+    # (conv_shift_op.cc:132-138: out[i] += x[(i+j-half) mod M] * y[j])
     xs = rng.randn(2, 7).astype(np.float32)
     ys = rng.randn(2, 3).astype(np.float32)
     want = np.zeros_like(xs)
     for b in range(2):
         for i in range(7):
-            for j in range(-1, 2):
-                want[b, i] += xs[b, (i + j) % 7] * ys[b, j % 3]
+            for j in range(3):
+                want[b, i] += xs[b, (i + j - 1) % 7] * ys[b, j]
     check_output("conv_shift", {"X": xs, "Y": ys}, [want])
 
     w = rng.rand(3).astype(np.float32)

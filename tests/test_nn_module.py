@@ -69,6 +69,27 @@ def test_batchnorm_state_updates():
                                np.asarray(new_state["bn"]["moving_mean"]))
 
 
+def test_batchnorm_large_mean_small_spread():
+    """f32 E[x^2]-E[x]^2 loses ALL precision at mean ~1e4, std ~0.1 (the
+    error term is ~8 vs a true var of 0.01); the running-mean-shifted
+    single-pass form must recover the true statistics once moving_mean is
+    warm."""
+    model = nn.transform(lambda x: nn.BatchNorm(name="bn", momentum=0.0)(x))
+    rs = np.random.RandomState(1)
+    x = jnp.array(rs.randn(512, 4), jnp.float32) * 0.1 + 1e4
+    params, state = model.init(jax.random.key(0), x)
+    # Warm-up pass: momentum=0 copies the batch mean straight into
+    # moving_mean (the shift is 0 on this pass, as at any cold start).
+    _, warm = model.apply(params, state, None, x, train=True)
+    out, new_state = model.apply(params, warm, None, x, train=True)
+    true_var = np.asarray(x, np.float64).var(axis=0)
+    got_var = np.asarray(new_state["bn"]["moving_var"])
+    np.testing.assert_allclose(got_var, true_var, rtol=1e-3)
+    # The normalized output must have unit std, not the ~1/sqrt(eps)
+    # blow-up of a collapsed variance estimate.
+    assert abs(float(np.asarray(out).std()) - 1.0) < 1e-2
+
+
 def test_dropout_train_vs_eval():
     model = nn.transform(lambda x: nn.Dropout(0.5)(x))
     x = jnp.ones((100, 100))

@@ -68,6 +68,13 @@ def test_pallas_supported_gate():
     assert pk.pallas_supported(8, 128)
     assert not pk.pallas_supported(8, 100)
     assert not pk.pallas_supported(3, 128)
+    # h=512 b=64 fits only at u=1 (both stream dtypes); the u-scaled
+    # working set must keep the unroll at 1 (v5e-compile-anchored).
+    assert pk.pallas_supported(64, 512, jnp.bfloat16)
+    assert pk.pallas_supported(64, 512, jnp.float32)
+    assert pk._lstm_unroll(100, 64, 512, jnp.bfloat16) == 1
+    assert pk._lstm_unroll(100, 64, 512, jnp.float32) == 1
+    assert pk._lstm_unroll(100, 64, 256, jnp.bfloat16) == 4
 
 
 def test_lstm_layer_fused_matches_scan(rng):
@@ -247,7 +254,8 @@ def test_tiled_lstm_batch_split_path(rng):
     ref = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=False)
     import unittest.mock as um
     with um.patch.object(pk, "_tile_plan", lambda b, h: (2, 128)), \
-            um.patch.object(pk, "pallas_supported", lambda b, h: False):
+            um.patch.object(pk, "pallas_supported",
+                            lambda b, h, stream_dtype=None: False):
         pal = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=True)
     for r, p in zip(ref, pal):
         np.testing.assert_allclose(np.asarray(r), np.asarray(p),
@@ -257,7 +265,7 @@ def test_tiled_lstm_batch_split_path(rng):
 def test_fused_lstm_unrolled_grid_matches_scan(rng):
     # t=8 -> 4 timesteps per grid step (t=5/6 above cover U=1/U=2).
     xw, wh, h0, c0, mask = _inputs(rng, t=8, b=8, h=128)
-    assert pk._lstm_unroll(8) == 4
+    assert pk._lstm_unroll(8, 8, 128, jnp.float32) == 4
     ref = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=False)
     pal = pk.lstm_scan(xw, wh, h0, c0, mask, use_pallas=True)
     for r, p in zip(ref, pal):
@@ -313,7 +321,8 @@ def test_tiled_path_accepts_bf16_xw(rng):
     xwb = xw.astype(jnp.bfloat16)
 
     def loss(xwb, wh):
-        with um.patch.object(pk, "pallas_supported", lambda b, h: False), \
+        with um.patch.object(pk, "pallas_supported",
+                            lambda b, h, stream_dtype=None: False), \
                 um.patch.object(pk, "_tile_plan", lambda b, h: (1, 128)):
             hs, hl, cl = pk.lstm_scan(xwb, wh, h0, c0, mask,
                                       use_pallas=True)
