@@ -69,8 +69,18 @@ def cmd_train(args):
         from paddle_tpu.training.aux import enable_fp_checks
         enable_fp_checks()
     trainer = _build_trainer(cfg)
-    if args.checkpoint_dir and args.resume:
+    from paddle_tpu.training import checkpoint as _ckpt
+    if (args.checkpoint_dir and args.resume
+            and _ckpt.latest_pass(args.checkpoint_dir) is not None):
         trainer.restore(args.checkpoint_dir)
+    elif getattr(args, "init_model_path", None):
+        # tryLoadParametersFromConfig order (ParamUtil.h:101-111): a
+        # resumable checkpoint wins; otherwise (including the FIRST
+        # launch of a preemptible job, when --resume finds nothing yet)
+        # init values come from the v1 pass dir (shapes come from the
+        # config via a sample batch).
+        trainer.init(next(iter(cfg.train_reader())))
+        trainer.load_v1_params(args.init_model_path)
     if args.checkpoint_dir:
         from paddle_tpu.training.aux import PreemptionHandler
         PreemptionHandler(trainer, args.checkpoint_dir).install()
@@ -93,6 +103,8 @@ def cmd_test(args):
     trainer.init(sample)
     if args.checkpoint_dir:
         trainer.restore(args.checkpoint_dir)
+    elif getattr(args, "init_model_path", None):
+        trainer.load_v1_params(args.init_model_path)
     results = trainer.test(reader, list(getattr(cfg, "evaluators", [])))
     print(json.dumps(results))
 
@@ -117,6 +129,10 @@ def cmd_time(args):
     # transfer is excluded the same way (it would dominate on remote
     # attachments with slow links).
     trainer.init(batches[0])
+    if getattr(args, "init_model_path", None):
+        # the reference --job=time honors init_model_path: time (and
+        # numerically exercise) the TRAINED model, not a random init
+        trainer.load_v1_params(args.init_model_path)
     batches = [{k: jnp.asarray(v) for k, v in b.items()} for b in batches]
     last = {}
 
@@ -195,6 +211,11 @@ def cmd_checkgrad(args):
     batch = {k: jnp.asarray(v) for k, v in sample.items()}
     model = nn.transform(lambda b: cfg.model_fn(b))
     params, state = model.init(jax.random.key(0), batch)
+    if getattr(args, "init_model_path", None):
+        # check gradients AT the trained point, as the reference job does
+        from paddle_tpu.training import checkpoint as ckpt_lib
+        params = ckpt_lib.apply_v1_params(
+            params, ckpt_lib.load_v1_pass_dir(args.init_model_path))
 
     def loss_fn(p):
         (loss, _), _ = model.apply(p, state, None, batch)
@@ -275,6 +296,10 @@ def main(argv=None):
             p.add_argument("--config-args", default="",
                            help="k=v,k=v passed to config_args() hook")
         p.add_argument("--checkpoint-dir", default=None)
+        p.add_argument("--init-model-path", default=None,
+                       help="reference v1 pass-%%05d dir of per-parameter "
+                            "binary files to initialize from "
+                            "(--init_model_path twin, ParamUtil.h:96-111)")
 
     p = sub.add_parser("train", help="train a model")
     common(p)

@@ -1,6 +1,7 @@
 from paddle_tpu.nn.module import (Module, Transformed, transform, param, state,
                                   set_state, is_training, next_rng_key,
-                                  flatten_names, unflatten_names, remat)
+                                  flatten_names, unflatten_names, remat,
+                                  escape_name, unescape_name)
 from paddle_tpu.nn import initializers
 from paddle_tpu.nn.layers import (Linear, Embedding, Conv2D, Pool2D,
                                   GlobalPool2D, BatchNorm, LayerNorm, Dropout,
@@ -17,6 +18,7 @@ from paddle_tpu.nn.layers_extra import (
 __all__ = [
     "Module", "Transformed", "transform", "param", "state", "set_state",
     "is_training", "next_rng_key", "flatten_names", "unflatten_names",
+    "escape_name", "unescape_name",
     "remat", "initializers", "Linear", "Embedding", "Conv2D", "Pool2D",
     "GlobalPool2D", "BatchNorm", "LayerNorm", "Dropout", "Maxout",
     "CrossChannelNorm", "Sequential",

@@ -336,3 +336,15 @@ def unflatten_names(flat: Dict[str, jax.Array]) -> Params:
     for k, v in flat.items():
         _tree_set(tree, k.split("/"), v)
     return tree
+
+
+def escape_name(name: str) -> str:
+    """Parameter path -> file/tar-member-safe name.  Our names are module
+    paths ('fc_0/w'); '/' cannot appear in a file name, so artifact
+    writers (Parameters.to_tar, v1 pass dirs) escape with this shared
+    convention and loaders invert with :func:`unescape_name`."""
+    return name.replace("/", "%2F")
+
+
+def unescape_name(name: str) -> str:
+    return name.replace("%2F", "/")

@@ -20,12 +20,15 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import struct
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from paddle_tpu.nn.module import flatten_names, unflatten_names
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn.module import (flatten_names, unescape_name,
+                                  unflatten_names)
 
 
 def _flatten_trees(trees: Dict[str, Any]) -> Dict[str, np.ndarray]:
@@ -98,6 +101,89 @@ def save(directory: str, pass_id: int, trees: Dict[str, Any],
     with open(os.path.join(directory, "latest"), "w") as f:
         f.write(f"pass-{pass_id:05d}")
     return pass_dir
+
+
+# Reference v1 trained-model artifact: ``pass-%05d/`` holding one binary
+# file PER PARAMETER, named by parameter name, each = 16-byte header
+# (``Parameter.h:263-267``: int32 format, uint32 valueSize, uint64 size,
+# little-endian) + raw float32 payload (``Parameter.cpp:286-313``), next
+# to a ``done`` marker and a saved config (``ParamUtil.cpp:84-112``).
+# Dims are NOT in the file — they come from the model config, so the
+# caller reshapes each vector against its own parameter tree.
+_V1_HEADER = struct.Struct("<iIQ")
+_V1_FORMAT_ORIGINAL = 0
+_V1_FORMAT_MKLDNN_OI = 1  # OI-major weight layout — rejected, see below
+
+
+def load_v1_pass_dir(directory: str) -> Dict[str, np.ndarray]:
+    """Read every parameter file of a reference ``pass-%05d/`` dir into a
+    flat ``name -> 1-D float32 array`` dict.
+
+    Non-parameter files (the ``done`` marker, the saved config copy) are
+    recognized and skipped by header validation: a parameter file's
+    declared payload size must exactly account for the bytes after the
+    header (``Parameter.cpp:343-357`` checks the same invariants on
+    load)."""
+    enforce(os.path.isdir(directory),
+            "load_v1_pass_dir: %s is not a directory", directory)
+    out: Dict[str, np.ndarray] = {}
+    for fn in sorted(os.listdir(directory)):
+        path = os.path.join(directory, fn)
+        if not os.path.isfile(path):
+            continue
+        size = os.path.getsize(path)
+        if size < _V1_HEADER.size:
+            continue
+        with open(path, "rb") as f:
+            fmt, value_size, count = _V1_HEADER.unpack(
+                f.read(_V1_HEADER.size))
+            if (fmt not in (_V1_FORMAT_ORIGINAL, _V1_FORMAT_MKLDNN_OI)
+                    or value_size != 4
+                    or _V1_HEADER.size + 4 * count != size):
+                continue  # done marker / config copy / foreign file
+            # MKLDNN_OI stores fc weights output-major; loading the raw
+            # vector would silently transpose every matrix.  The MKLDNN
+            # backend is a documented drop (PARITY.md) — fail loudly.
+            enforce(fmt == _V1_FORMAT_ORIGINAL,
+                    "v1 parameter %r uses PARAM_FORMAT_MKLDNN_OI; "
+                    "re-save it from a non-MKLDNN build (OI layout is "
+                    "not converted here)", fn)
+            # Our parameter names are module paths ("fc_0/w"); "/" cannot
+            # appear in a file name, so dirs we write escape it the same
+            # way ``Parameters.to_tar`` does.  Reference-written dirs have
+            # flat names ("_hidden1.w0") and pass through untouched.
+            out[unescape_name(fn)] = np.frombuffer(
+                f.read(4 * count), "<f4").copy()
+    enforce(out, "load_v1_pass_dir: no reference-format parameter files "
+            "in %s", directory)
+    return out
+
+
+def apply_v1_params(params, loaded: Dict[str, np.ndarray],
+                    name_map: Optional[Dict[str, str]] = None):
+    """Reshape ``load_v1_pass_dir`` vectors into a parameter pytree.
+
+    Iterates the MODEL's parameters (as ``Parameter::load`` does — files
+    the config doesn't mention are ignored, a parameter without a file is
+    an error, a size mismatch is an error with both sizes named).
+    ``name_map`` translates OUR parameter name -> the artifact's file
+    name, for importing models whose reference layer names don't line up
+    with this framework's module paths."""
+    name_map = name_map or {}
+    flat = flatten_names(params)
+    for name, leaf in flat.items():
+        key = name_map.get(name, name)
+        enforce(key in loaded,
+                "v1 pass dir is missing parameter %r (reference "
+                "load_missing_parameter_strategy=fail; have %s)",
+                key, sorted(loaded)[:10])
+        leaf_arr = np.asarray(leaf)
+        vec = loaded[key]
+        enforce(vec.size == leaf_arr.size,
+                "v1 parameter %r: file has %d values, model needs %d",
+                name, vec.size, leaf_arr.size)
+        flat[name] = vec.reshape(leaf_arr.shape).astype(leaf_arr.dtype)
+    return unflatten_names(flat)
 
 
 def latest_pass(directory: str) -> Optional[int]:

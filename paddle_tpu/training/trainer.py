@@ -447,6 +447,26 @@ class Trainer:
             self._build_steps()
         return meta["pass_id"]
 
+    def load_v1_params(self, directory: str, name_map=None) -> None:
+        """Initialize parameter VALUES from a reference ``pass-%05d/`` dir
+        (the v1 trainer's ``--init_model_path`` / ``--start_pass`` artifact,
+        ``ParamUtil.h:96-111``).  The trainer must already be ``init``-ed —
+        dims live in the config, not the files, so the parameter tree
+        supplies the shapes.  Optimizer state is NOT in a v1 pass dir and
+        keeps its fresh init.  ``name_map`` (our name -> file name) covers
+        artifacts whose reference layer names differ from ours."""
+        enforce(self.params is not None,
+                "load_v1_params: trainer not initialized — call init() "
+                "with a sample batch first (shapes come from the config)")
+        loaded = ckpt_lib.load_v1_pass_dir(directory)
+        params = ckpt_lib.apply_v1_params(self.params, loaded, name_map)
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.mesh is not None:
+            from paddle_tpu.parallel import sharding as sharding_lib
+            params = sharding_lib.apply_rules(params, self.mesh,
+                                              self.param_rules)
+        self.params = params
+
     def averaged_params(self):
         if self.avg_state is None:
             return self.params

@@ -45,6 +45,7 @@ from paddle_tpu.api import v1_compat as _v1
 from paddle_tpu.api.graph import LayerOutput
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.data import feeder as _feeder
+from paddle_tpu.nn import module as nn_module
 from paddle_tpu.data import provider as _provider
 from paddle_tpu.data import datasets as dataset            # noqa: F401
 from paddle_tpu.data import image, reader                  # noqa: F401
@@ -279,7 +280,15 @@ class Parameters:
         for k, v in self._pending.items():
             enforce(k in flat, "Parameters.from_tar: unknown parameter %s "
                     "(have %s)", k, sorted(flat)[:10])
-            flat[k] = np.asarray(v, np.asarray(flat[k]).dtype)
+            have = np.asarray(flat[k])
+            v = np.asarray(v, have.dtype)
+            enforce(v.size == have.size,
+                    "parameter %s: loaded %d values, model needs %d",
+                    k, v.size, have.size)
+            # v1 pass-dir files carry bare vectors (dims live in the
+            # config); tar members are already shaped.  Reshape covers
+            # both.
+            flat[k] = v.reshape(have.shape)
         self._trainer.params = nn.unflatten_names(flat)
         self._pending.clear()
 
@@ -341,7 +350,7 @@ class Parameters:
                 buf = io.BytesIO()
                 np.save(buf, value)
                 data = buf.getvalue()
-                info = tarfile.TarInfo(name=name.replace("/", "%2F")
+                info = tarfile.TarInfo(name=nn_module.escape_name(name)
                                        + ".npy")
                 info.size = len(data)
                 tar.addfile(info, io.BytesIO(data))
@@ -377,7 +386,7 @@ class Parameters:
                 name = member.name
                 if name.endswith(".npy"):
                     name = name[:-4]
-                name = name.replace("%2F", "/")
+                name = nn_module.unescape_name(name)
                 data = tar.extractfile(member).read()
                 params._pending[name] = np.load(io.BytesIO(data))
         return params
@@ -387,6 +396,17 @@ class Parameters:
         self._pending.update(other._pending)
         if self._trainer is not None and self._trainer.params is not None:
             self._apply_pending()
+
+    @staticmethod
+    def from_v1_pass_dir(directory: str) -> "Parameters":
+        """Load a reference v1 ``pass-%05d/`` model dir (per-parameter
+        16-byte-header binary files, ``Parameter.cpp:286-313``); values
+        bind and reshape when a trainer attaches (dims live in the
+        config)."""
+        from paddle_tpu.training import checkpoint as ckpt_lib
+        params = Parameters()
+        params._pending.update(ckpt_lib.load_v1_pass_dir(directory))
+        return params
 
 
 class _ParametersNS:

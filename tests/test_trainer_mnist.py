@@ -64,6 +64,77 @@ def test_mnist_one_pass_learns(tmp_path):
     assert res["test_classification_error"] < 0.5
 
 
+def _write_v1_pass_dir(directory, flat_params):
+    """Synthesize a reference pass-%05d dir in the EXACT byte layout of
+    Parameter::save (Parameter.cpp:286-313): <iIQ header + raw <f4
+    payload, plus the done marker and config copy ParamUtil.cpp:106-112
+    drops next to the parameters."""
+    import struct
+    os.makedirs(directory, exist_ok=True)
+    import paddle_tpu.nn as nn
+    for name, value in flat_params.items():
+        vec = np.asarray(value, "<f4").ravel()
+        with open(os.path.join(directory, nn.escape_name(name)),
+                  "wb") as f:
+            f.write(struct.pack("<iIQ", 0, 4, vec.size))
+            f.write(vec.tobytes())
+    with open(os.path.join(directory, "done"), "w") as f:
+        f.write("PaddlePaddle\n")
+    with open(os.path.join(directory, "trainer_config.conf"), "w") as f:
+        f.write("# saved config copy\n")
+
+
+def test_v1_pass_dir_import_round_trip(tmp_path):
+    """A reference-layout pass dir (ParamUtil.h:96-111 artifact) must load
+    into the trainer bit-exactly, skipping the done/config files."""
+    import paddle_tpu.nn as nn
+    reader = _batched_reader(n=128)
+    t1 = _make_trainer()
+    t1.init(next(iter(reader())))
+    t1.train(reader, num_passes=1)
+
+    flat = {k: np.asarray(v)
+            for k, v in nn.flatten_names(t1.params).items()}
+    pass_dir = tmp_path / "pass-00000"
+    _write_v1_pass_dir(str(pass_dir), flat)
+
+    t2 = _make_trainer()
+    t2.init(next(iter(reader())))
+    for k, v in nn.flatten_names(t2.params).items():
+        if np.asarray(v).size:  # fresh init must differ from trained
+            assert not np.array_equal(np.asarray(v), flat[k]) or \
+                not np.asarray(v).any()
+    t2.load_v1_params(str(pass_dir))
+    for k, v in nn.flatten_names(t2.params).items():
+        np.testing.assert_array_equal(np.asarray(v), flat[k], err_msg=k)
+        assert np.asarray(v).shape == flat[k].shape
+
+    # v2 API surface: Parameters.from_v1_pass_dir carries the same values
+    import paddle_tpu.v2 as paddle
+    p = paddle.Parameters.from_v1_pass_dir(str(pass_dir))
+    some = sorted(flat)[0]
+    np.testing.assert_array_equal(p[some].ravel(), flat[some].ravel())
+
+    # an MKLDNN_OI-format file fails loudly instead of silently loading
+    # a transposed weight matrix
+    import struct
+    vec = flat[some].ravel().astype("<f4")
+    with open(str(pass_dir / "mkldnn_param"), "wb") as f:
+        f.write(struct.pack("<iIQ", 1, 4, vec.size) + vec.tobytes())
+    from paddle_tpu.core.errors import EnforceError
+    from paddle_tpu.training import checkpoint as ckpt_lib
+    with pytest.raises(EnforceError, match="MKLDNN"):
+        ckpt_lib.load_v1_pass_dir(str(pass_dir))
+    os.remove(str(pass_dir / "mkldnn_param"))
+
+    # a missing parameter file is an error naming the parameter
+    os.remove(str(pass_dir / nn.escape_name(some)))
+    t3 = _make_trainer()
+    t3.init(next(iter(reader())))
+    with pytest.raises(EnforceError, match="missing parameter"):
+        t3.load_v1_params(str(pass_dir))
+
+
 def test_checkpoint_restore_resumes(tmp_path):
     reader = _batched_reader(n=128)
     t1 = _make_trainer()
