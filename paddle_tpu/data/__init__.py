@@ -1,7 +1,7 @@
-from paddle_tpu.data import reader, datasets, provider
+from paddle_tpu.data import reader, datasets, proto_shards, provider
 from paddle_tpu.data.feeder import (DataFeeder, Dense, Integer, IntSequence,
                                     DenseSequence, SparseBinary, SparseFloat)
 
-__all__ = ["reader", "datasets", "provider", "DataFeeder", "Dense",
-           "Integer", "IntSequence", "DenseSequence", "SparseBinary",
-           "SparseFloat"]
+__all__ = ["reader", "datasets", "proto_shards", "provider", "DataFeeder",
+           "Dense", "Integer", "IntSequence", "DenseSequence",
+           "SparseBinary", "SparseFloat"]

@@ -186,6 +186,45 @@ def apply_v1_params(params, loaded: Dict[str, np.ndarray],
     return unflatten_names(flat)
 
 
+def apply_v1_state(net_state, loaded: Dict[str, np.ndarray],
+                   name_map: Optional[Dict[str, str]] = None):
+    """Fill network STATE leaves (BatchNorm moving mean/var) from a v1
+    pass dir.  In the reference these statistics are static parameters
+    saved like any other (BatchNormBaseLayer's .w1/.w2); here they live
+    in the state collection, so they import by name match — strictness
+    differs from :func:`apply_v1_params`: a state leaf with no file
+    keeps its fresh init (with a warning), since our state names never
+    coincide with reference file names without a ``name_map``.
+
+    Returns (new_state, matched_count)."""
+    import warnings
+    name_map = name_map or {}
+    flat = flatten_names(net_state) if net_state else {}
+    matched = 0
+    missing = []
+    for name, leaf in flat.items():
+        key = name_map.get(name, name)
+        if key not in loaded:
+            missing.append(name)
+            continue
+        leaf_arr = np.asarray(leaf)
+        vec = loaded[key]
+        enforce(vec.size == leaf_arr.size,
+                "v1 state %r: file has %d values, model needs %d",
+                key, vec.size, leaf_arr.size)
+        flat[name] = vec.reshape(leaf_arr.shape).astype(leaf_arr.dtype)
+        matched += 1
+    if missing:
+        # Silently-fresh moving statistics produce wrong eval numbers —
+        # say so.  Reference BN artifacts name these files .w1/.w2 under
+        # the layer name; pass name_map to wire them up.
+        warnings.warn(
+            f"v1 pass dir: no files for state leaves {missing[:5]} — "
+            "moving statistics keep fresh init (map reference BN .w1/.w2 "
+            "files with name_map)", stacklevel=2)
+    return (unflatten_names(flat) if flat else net_state), matched
+
+
 def latest_pass(directory: str) -> Optional[int]:
     marker = os.path.join(directory, "latest")
     if not os.path.exists(marker):

@@ -454,12 +454,24 @@ class Trainer:
         dims live in the config, not the files, so the parameter tree
         supplies the shapes.  Optimizer state is NOT in a v1 pass dir and
         keeps its fresh init.  ``name_map`` (our name -> file name) covers
-        artifacts whose reference layer names differ from ours."""
+        artifacts whose reference layer names differ from ours.
+
+        BatchNorm moving statistics — static PARAMETERS in a reference
+        pass dir (BatchNormBaseLayer .w1/.w2) but state leaves here —
+        import by name match against the same dir; unmatched state warns
+        and keeps fresh init (see ``checkpoint.apply_v1_state``)."""
         enforce(self.params is not None,
                 "load_v1_params: trainer not initialized — call init() "
                 "with a sample batch first (shapes come from the config)")
         loaded = ckpt_lib.load_v1_pass_dir(directory)
         params = ckpt_lib.apply_v1_params(self.params, loaded, name_map)
+        new_state, matched = ckpt_lib.apply_v1_state(
+            self.net_state, loaded, name_map)
+        if matched:
+            self.net_state = jax.tree_util.tree_map(jnp.asarray, new_state)
+            if self.mesh is not None:
+                self.net_state = mesh_lib.replicate(self.net_state,
+                                                    self.mesh)
         params = jax.tree_util.tree_map(jnp.asarray, params)
         if self.mesh is not None:
             from paddle_tpu.parallel import sharding as sharding_lib

@@ -267,6 +267,10 @@ class Parameters:
     def __init__(self):
         self._trainer = None       # bound by trainer.SGD
         self._pending: Dict[str, np.ndarray] = {}
+        # pass-dir loads tolerate files the model doesn't declare
+        # (Parameter::load iterates parameters, not files); tar loads
+        # stay strict — a tar member is always a model parameter.
+        self._pending_lenient = False
 
     # -- binding ----------------------------------------------------------
     def _attach(self, trainer) -> None:
@@ -278,6 +282,11 @@ class Parameters:
         import paddle_tpu.nn as nn
         flat = nn.flatten_names(self._trainer.params)
         for k, v in self._pending.items():
+            if k not in flat and self._pending_lenient:
+                # pass dirs carry files the model may not declare (BN
+                # moving-stat parameters, layers absent from this
+                # config) — Parameter::load ignores them; so do we.
+                continue
             enforce(k in flat, "Parameters.from_tar: unknown parameter %s "
                     "(have %s)", k, sorted(flat)[:10])
             have = np.asarray(flat[k])
@@ -406,6 +415,7 @@ class Parameters:
         from paddle_tpu.training import checkpoint as ckpt_lib
         params = Parameters()
         params._pending.update(ckpt_lib.load_v1_pass_dir(directory))
+        params._pending_lenient = True
         return params
 
 

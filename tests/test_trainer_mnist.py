@@ -135,6 +135,65 @@ def test_v1_pass_dir_import_round_trip(tmp_path):
         t3.load_v1_params(str(pass_dir))
 
 
+def test_v1_pass_dir_imports_bn_state_and_ignores_extras(tmp_path):
+    """BatchNorm moving statistics are static PARAMETERS in a reference
+    pass dir but state leaves here: they must import by name match, and
+    files the model doesn't declare must be ignored (Parameter::load
+    iterates parameters, not files)."""
+    import warnings
+
+    import jax
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optim
+    from paddle_tpu.training import Trainer
+
+    def bn_model(batch):
+        x = nn.BatchNorm(name="bn")(batch["x"])
+        loss = ((x - batch["y"]) ** 2).mean()
+        return loss, x
+
+    rs = np.random.RandomState(0)
+    batch = {"x": rs.randn(32, 4).astype(np.float32) * 3 + 5,
+             "y": rs.randn(32, 4).astype(np.float32)}
+    t1 = Trainer(bn_model, optim.sgd(0.01))
+    t1.init(batch)
+    t1.train_batch(batch)
+    flat = {k: np.asarray(v)
+            for k, v in nn.flatten_names(t1.params).items()}
+    flat_state = {k: np.asarray(v)
+                  for k, v in nn.flatten_names(t1.net_state).items()}
+    assert any("moving_mean" in k for k in flat_state)
+
+    pass_dir = str(tmp_path / "pass-00000")
+    _write_v1_pass_dir(pass_dir, {**flat, **flat_state,
+                                  "stray_param": np.zeros(7, np.float32)})
+
+    t2 = Trainer(bn_model, optim.sgd(0.01))
+    t2.init(batch)
+    t2.load_v1_params(pass_dir)  # stray file ignored, state imported
+    for k, v in nn.flatten_names(t2.net_state).items():
+        np.testing.assert_allclose(np.asarray(v), flat_state[k],
+                                   err_msg=k, rtol=1e-6)
+
+    # without state files: a warning fires and stats keep fresh init
+    pass2 = str(tmp_path / "pass-00001")
+    _write_v1_pass_dir(pass2, flat)
+    t3 = Trainer(bn_model, optim.sgd(0.01))
+    t3.init(batch)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t3.load_v1_params(pass2)
+    assert any("moving statistics" in str(x.message) for x in w)
+
+    # v2 surface: lenient pending — extra files must not crash attach
+    import paddle_tpu.v2 as paddle
+    p = paddle.Parameters.from_v1_pass_dir(pass_dir)
+    assert "stray_param" in p._pending
+    p._trainer = t2
+    p._apply_pending()  # must not raise on stray_param
+    jax.tree_util.tree_map(lambda a: None, t2.params)
+
+
 def test_checkpoint_restore_resumes(tmp_path):
     reader = _batched_reader(n=128)
     t1 = _make_trainer()
