@@ -137,11 +137,13 @@ def cmd_time(args):
     last = {}
 
     # Same protocol as bench.py (shared helper + shared step path, so the
-    # two cannot drift): when the batches stack (uniform shapes, no mesh),
-    # time the compiled multi-batch loop — one dispatch per K batches —
-    # and divide; otherwise fall back to per-dispatch train_batch.
+    # two cannot drift): when the batches stack (uniform shapes), time
+    # the compiled multi-batch loop — one dispatch per K batches — and
+    # divide; otherwise fall back to per-dispatch train_batch.  Under a
+    # mesh the stack shards P(None, dp): the scan axis stays whole, each
+    # scanned batch is dp-sharded.
     shapes = {k: v.shape for k, v in batches[0].items()}
-    stackable = (trainer.mesh is None and not trainer.average_window
+    stackable = (not trainer.average_window
                  and all({k: v.shape for k, v in b.items()} == shapes
                          for b in batches))
     n = max(args.batches, 1)

@@ -61,6 +61,16 @@ def shard_batch(batch, mesh: Mesh, axis: str = DP):
         lambda x: jax.device_put(x, sharding), batch)
 
 
+def shard_batch_stack(batch_stack, mesh: Mesh, axis: str = DP):
+    """Device-put a STACKED batch pytree ([k, batch, ...] leaves): the
+    scan axis stays whole on every device, the per-batch axis shards over
+    ``axis`` — so a ``lax.scan`` over the stack steps through dp-sharded
+    batches exactly as the per-dispatch path would see them."""
+    sharding = NamedSharding(mesh, P(None, axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch_stack)
+
+
 def replicate(tree, mesh: Mesh):
     sharding = replicated(mesh)
     return jax.tree_util.tree_map(

@@ -221,15 +221,12 @@ class Trainer:
         ``--job=time`` twin); single-batch ``train_batch`` remains the
         step-by-step path for event hooks and evaluators.
         """
-        enforce(self.mesh is None,
-                "train_batches: use train_batch under a mesh (batch "
-                "sharding expects an unstacked leading axis)")
         enforce(not self.average_window,
                 "train_batches: per-step model averaging needs the "
                 "step-by-step train_batch path")
         if self.params is None:
             self.init(jax.tree_util.tree_map(lambda x: x[0], batch_stack))
-        batch_stack = self._put(batch_stack)
+        batch_stack = self._put(batch_stack, stacked=True)
         k = jax.tree_util.tree_leaves(batch_stack)[0].shape[0]
         step_arr = self._step_array()
         self._in_step = True
@@ -301,12 +298,14 @@ class Trainer:
             return None          # MFU undefined here; skip the compile
         return mfu_mod.compiled_flops(
             self._train_scan, self.params, self.net_state, self.opt_state,
-            self._put(batch_stack), self._step_array())
+            self._put(batch_stack, stacked=True), self._step_array())
 
-    def _put(self, batch):
+    def _put(self, batch, stacked: bool = False):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self.mesh is not None:
-            batch = mesh_lib.shard_batch(batch, self.mesh)
+            shard = (mesh_lib.shard_batch_stack if stacked
+                     else mesh_lib.shard_batch)
+            batch = shard(batch, self.mesh)
         return batch
 
     def train(self, reader: Callable[[], Iterable[Dict[str, Any]]],
@@ -331,7 +330,7 @@ class Trainer:
         # attachments.
         fast = (event_handler is None and not evaluators
                 and log_period == 0 and stats_period == 0
-                and self.mesh is None and not self.average_window)
+                and not self.average_window)
         results: Dict[str, Any] = {}
         for pass_id in range(num_passes):
             self.current_pass = pass_id

@@ -263,6 +263,61 @@ def test_train_batches_matches_sequential_steps():
                                    err_msg=p)
 
 
+def test_train_batches_under_mesh_matches_sequential():
+    """VERDICT round-2 item: the compiled multi-batch scan must exist on
+    the multi-chip path too — train_batches under a dp mesh (stack
+    sharded P(None, dp)) must match sequential train_batch on the same
+    mesh, and the single-device trajectory."""
+    rs = np.random.RandomState(11)
+    k, b = 4, 16  # b=16 divides the 8-device dp axis
+    stack = {"image": rs.randn(k, b, 784).astype(np.float32),
+             "label": rs.randint(0, 10, (k, b)).astype(np.int32)}
+
+    mesh = make_mesh()
+    assert mesh.devices.size == 8  # conftest's virtual CPU platform
+    t_seq = _make_trainer(mesh=make_mesh())
+    seq_losses = [float(t_seq.train_batch(
+        {n: v[i] for n, v in stack.items()})[0]) for i in range(k)]
+
+    t_scan = _make_trainer(mesh=mesh)
+    scan_losses = np.asarray(t_scan.train_batches(stack))
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=2e-3,
+                               atol=1e-5)
+    assert t_scan.step == k
+
+    t_single = _make_trainer()
+    single_losses = [float(t_single.train_batch(
+        {n: v[i] for n, v in stack.items()})[0]) for i in range(k)]
+    np.testing.assert_allclose(scan_losses, single_losses, rtol=2e-3,
+                               atol=1e-5)
+
+    from paddle_tpu.nn import flatten_names
+    f1 = {p: np.asarray(v) for p, v in flatten_names(t_seq.params).items()}
+    f2 = {p: np.asarray(v)
+          for p, v in flatten_names(t_scan.params).items()}
+    for p in f1:
+        np.testing.assert_allclose(f2[p], f1[p], rtol=2e-3, atol=1e-5,
+                                   err_msg=p)
+
+
+def test_mesh_fast_pass_matches_eventful():
+    """train()'s device-scan fast path now engages under a mesh; it must
+    match the eventful per-batch path there."""
+    rs = np.random.RandomState(3)
+    batches = [{"image": rs.randn(16, 784).astype(np.float32),
+                "label": rs.randint(0, 10, 16).astype(np.int32)}
+               for _ in range(6)]
+    reader = lambda: iter(batches)
+
+    t_slow = _make_trainer(mesh=make_mesh())
+    r_slow = t_slow.train(reader, num_passes=1,
+                          event_handler=lambda e: None)
+    t_fast = _make_trainer(mesh=make_mesh())
+    r_fast = t_fast.train(reader, num_passes=1)
+    np.testing.assert_allclose(r_fast["loss"], r_slow["loss"],
+                               rtol=2e-3, atol=1e-5)
+
+
 def test_train_batches_then_train_batch_continues():
     """Step counter and states stay consistent across the two paths."""
     rs = np.random.RandomState(1)
