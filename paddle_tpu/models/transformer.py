@@ -126,6 +126,17 @@ class TransformerLM(Module):
         return policy.cast_to_output(logits)
 
 
+def _next_token_loss(logits, ids, mask):
+    targets = jnp.concatenate(
+        [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
+    per_tok = losses.softmax_cross_entropy(logits, targets)
+    if mask is not None:
+        valid = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+        return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1)
+    return per_tok[:, :-1].mean()
+
+
 def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
     """Next-token LM loss over ``batch = {"ids", "ids_mask"}``."""
 
@@ -133,14 +144,83 @@ def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
         ids, mask = batch["ids"], batch.get("ids_mask")
         net = TransformerLM(cfg, attn_fn=attn_fn, name="lm")
         logits = net(ids, mask)
-        targets = jnp.concatenate(
-            [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
-        per_tok = losses.softmax_cross_entropy(logits, targets)
-        if mask is not None:
-            valid = jnp.concatenate(
-                [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
-            loss = jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1)
+        return _next_token_loss(logits, ids, mask), {"logits": logits}
+    return model_fn
+
+
+def _mlp_stage(p, x, epsilon: float = 1e-6):
+    """One pipeline stage of the MLP trunk: pre-LN -> FFN -> residual.
+    Hand-rolled LN/FFN math over a per-stage param SLICE, so the stage
+    params can carry a leading [S] axis sharded over ``pp``."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    h = (x - mu) * jax.lax.rsqrt(var + epsilon) * p["ln_g"] + p["ln_b"]
+    h = jax.nn.gelu(h @ p["w_in"] + p["b_in"])
+    return x + h @ p["w_out"] + p["b_out"]
+
+
+def pipelined_mlp_lm_builder(cfg: TransformerConfig, mesh=None,
+                             microbatches: int = 2, axis: str = "pp"):
+    """LM whose MLP trunk is partitioned into ``cfg.num_layers`` PIPELINE
+    stages (the Trainer pipeline mode): embedding/readout replicate, the
+    trunk's stage params carry a leading ``[S, ...]`` axis sharded
+    ``P(pp)`` (``parallel.sharding.pipeline_pp_rules``), and the forward
+    drains ``microbatches`` microbatches through the ``ppermute`` stage
+    ring of :func:`paddle_tpu.parallel.pipeline_apply`.  Reverse-mode AD
+    through that schedule yields the backward pipeline, so the ordinary
+    ``Trainer``/``optim`` path trains it unchanged.
+
+    ``mesh=None`` applies the stages sequentially — the SAME parameter
+    structure and math, single-device — which is the equivalence
+    reference for the pipelined run (and the CPU-test twin).
+
+    ``cfg.num_layers`` must equal the ``pp`` axis size under a mesh;
+    the batch size must divide by ``microbatches``.
+    """
+    S, d, hdim = cfg.num_layers, cfg.dim, cfg.dim * cfg.ffn_mult
+
+    def model_fn(batch):
+        ids, mask = batch["ids"], batch.get("ids_mask")
+        policy = get_policy()
+        b, t = ids.shape
+        x = nn.Embedding(cfg.vocab_size, d, name="embed")(ids)
+        pos = param("pos_embed", (cfg.max_len, d), policy.param_dtype,
+                    init.normal(0.02))
+        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, axis=0)[None]
+        x = x.astype(jnp.float32)
+
+        stages = {
+            "ln_g": param("stage_ln_g", (S, d), jnp.float32, init.ones),
+            "ln_b": param("stage_ln_b", (S, d), jnp.float32, init.zeros),
+            "w_in": param("stage_w_in", (S, d, hdim), jnp.float32,
+                          init.xavier_uniform()),
+            "b_in": param("stage_b_in", (S, hdim), jnp.float32, init.zeros),
+            "w_out": param("stage_w_out", (S, hdim, d), jnp.float32,
+                           init.xavier_uniform()),
+            "b_out": param("stage_b_out", (S, d), jnp.float32, init.zeros),
+        }
+        if mesh is None:
+            for s in range(S):
+                x = _mlp_stage(jax.tree_util.tree_map(lambda a: a[s],
+                                                      stages), x)
         else:
-            loss = per_tok[:, :-1].mean()
-        return loss, {"logits": logits}
+            from paddle_tpu.core.errors import enforce
+            from paddle_tpu.parallel import pipeline_apply
+            enforce(b % microbatches == 0,
+                    "pipeline: batch %d must divide into %d microbatches",
+                    b, microbatches)
+            xs = x.reshape(microbatches, b // microbatches, t, d)
+            run = pipeline_apply(_mlp_stage, mesh, axis)
+            x = run(stages, xs).reshape(b, t, d)
+
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        x = (x - mu) * jax.lax.rsqrt(var + 1e-6)
+        w_out = param("w_out", (d, cfg.vocab_size), policy.param_dtype,
+                      init.xavier_uniform())
+        logits = jnp.matmul(policy.cast_to_compute(x),
+                            policy.cast_to_compute(w_out))
+        logits = policy.cast_to_output(logits)
+        return _next_token_loss(logits, ids, mask), {"logits": logits}
+
     return model_fn

@@ -54,21 +54,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = DP):
-    """Device-put a pytree of host arrays with batch-dim sharding."""
-    sharding = batch_sharding(mesh, axis)
+def shard_batch(batch, mesh: Mesh, axis: str = DP, spec: Optional[P] = None,
+                stacked: bool = False):
+    """Device-put a pytree of host arrays with batch sharding.
+
+    Default: leading (batch) dim over ``axis``.  ``spec`` overrides with
+    an arbitrary PartitionSpec (e.g. ``P(None, "sp")`` for
+    sequence-sharded ring-attention batches).  ``stacked=True`` prepends
+    an unsharded leading dim for a ``[k, batch, ...]`` batch STACK — the
+    scan axis stays whole on every device while each scanned batch keeps
+    the same layout the per-dispatch path would see."""
+    if spec is None:
+        spec = P(axis)
+    if stacked:
+        spec = P(None, *spec)
+    sharding = NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)
-
-
-def shard_batch_stack(batch_stack, mesh: Mesh, axis: str = DP):
-    """Device-put a STACKED batch pytree ([k, batch, ...] leaves): the
-    scan axis stays whole on every device, the per-batch axis shards over
-    ``axis`` — so a ``lax.scan`` over the stack steps through dp-sharded
-    batches exactly as the per-dispatch path would see them."""
-    sharding = NamedSharding(mesh, P(None, axis))
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch_stack)
 
 
 def replicate(tree, mesh: Mesh):

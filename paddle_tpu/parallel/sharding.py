@@ -74,6 +74,13 @@ def mlp_tp_rules(axis: str = "mp") -> Rules:
     )
 
 
+def pipeline_pp_rules(axis: str = "pp") -> Rules:
+    """Stage-stacked trunk params ([S, ...] leading axis) shard one stage
+    per ``pp`` device; everything else (embedding, readout) replicates.
+    Pairs with ``models.transformer.pipelined_mlp_lm_builder``."""
+    return ((r"stage_", P(axis)),)
+
+
 def transformer_tp_rules(axis: str = "mp") -> Rules:
     """Megatron layout for TransformerLM: q/k/v column-split (heads shard),
     attention output row-split; FFN in column-split, out row-split; embedding

@@ -41,13 +41,20 @@ class Trainer:
                  mesh=None,
                  param_rules=None,
                  average_window: int = 0,
-                 zero_axis: Optional[str] = None):
+                 zero_axis: Optional[str] = None,
+                 batch_spec=None):
+        """``batch_spec`` — PartitionSpec for batch leaves under a mesh
+        (default: leading axis over ``dp``).  Non-dp-first topologies set
+        it explicitly: ``P(None, "sp")`` shards sequence for a
+        ring-attention trainer on an (sp, ep) mesh; ``P()`` replicates
+        (pipeline trainers split microbatches internally)."""
         self.model = transform(model_fn)
         self.optimizer = optimizer
         self.seed = seed
         self.mesh = mesh
         self.param_rules = param_rules
         self.zero_axis = zero_axis
+        self.batch_spec = batch_spec
         self.average_window = average_window
         self.params = None
         self.net_state = None
@@ -303,9 +310,9 @@ class Trainer:
     def _put(self, batch, stacked: bool = False):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self.mesh is not None:
-            shard = (mesh_lib.shard_batch_stack if stacked
-                     else mesh_lib.shard_batch)
-            batch = shard(batch, self.mesh)
+            batch = mesh_lib.shard_batch(batch, self.mesh,
+                                         spec=self.batch_spec,
+                                         stacked=stacked)
         return batch
 
     def train(self, reader: Callable[[], Iterable[Dict[str, Any]]],

@@ -253,3 +253,69 @@ def test_transformer_ring_attention_equivalence(rng):
     out, _ = jax.jit(lambda p, i: ringy.apply(p, {}, None, i))(params, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=1e-3)
+
+
+def test_pipelined_trainer_matches_single_device(rng):
+    """Trainer pipeline mode (VERDICT r2 #3): the transformer MLP trunk
+    partitioned into pp=4 stages and trained through Trainer + optim must
+    follow the SAME trajectory as the identical model applied
+    sequentially on a single device — and the microbatch knob must not
+    change the math."""
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               pipelined_mlp_lm_builder)
+    from paddle_tpu.parallel.sharding import pipeline_pp_rules
+    from paddle_tpu.training import Trainer
+
+    cfg = TransformerConfig(vocab_size=40, dim=8, num_layers=4, ffn_mult=2,
+                            max_len=16)
+    batch = {"ids": rng.randint(0, 40, (8, 10)).astype(np.int32),
+             "ids_mask": np.ones((8, 10), bool)}
+
+    t_ref = Trainer(pipelined_mlp_lm_builder(cfg, mesh=None),
+                    optim.sgd(0.05))
+    ref_losses = [float(t_ref.train_batch(batch)[0]) for _ in range(3)]
+
+    for mb in (2, 4):
+        mesh = make_mesh((4,), ("pp",), jax.devices()[:4])
+        t_pp = Trainer(
+            pipelined_mlp_lm_builder(cfg, mesh, microbatches=mb),
+            optim.sgd(0.05), mesh=mesh,
+            param_rules=pipeline_pp_rules("pp"),
+            batch_spec=jax.sharding.PartitionSpec())
+        pp_losses = [float(t_pp.train_batch(batch)[0]) for _ in range(3)]
+        np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4,
+                                   atol=1e-5, err_msg=f"microbatches={mb}")
+
+    from paddle_tpu.nn import flatten_names
+    f_ref = {k: np.asarray(v)
+             for k, v in flatten_names(t_ref.params).items()}
+    f_pp = {k: np.asarray(v) for k, v in flatten_names(t_pp.params).items()}
+    for k in f_ref:
+        np.testing.assert_allclose(f_pp[k], f_ref[k], rtol=2e-3, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_moe_trainer_on_sp_ep_mesh(rng):
+    """MoE + ring attention through the product Trainer path (sp x ep
+    mesh, sequence-sharded batches via batch_spec) learns."""
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.parallel.expert import moe_ep_rules
+    from paddle_tpu.training import Trainer
+
+    mesh = make_mesh((4, 2), ("sp", "ep"))
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                            num_layers=2, max_len=32, moe_experts=2,
+                            moe_top_k=2)
+    batch = {"ids": rng.randint(0, 32, (4, 16)).astype(np.int32),
+             "ids_mask": np.ones((4, 16), bool)}
+    tr = Trainer(lm_model_fn_builder(
+        cfg, attn_fn=ring_attention(mesh, "sp")),
+        optim.from_config(optim.OptimizationConfig(
+            learning_rate=0.02, learning_method="adam")),
+        mesh=mesh, param_rules=moe_ep_rules("ep"),
+        batch_spec=jax.sharding.PartitionSpec(None, "sp"))
+    losses = [float(tr.train_batch(batch)[0]) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
