@@ -9,10 +9,13 @@
  *
  * The implementation (capi.cc) embeds CPython and drives the JAX inference
  * machine through paddle_tpu/capi_bridge.py; callers need no Python.
- * All calls are thread-safe (serialized on the GIL), and shared-param
- * clones may be used concurrently from many threads, matching
+ * All calls are thread-safe: argument marshalling serializes on the GIL,
+ * but the device execution inside forward overlaps across threads (jaxlib
+ * releases the GIL around XLA execute + the result await).  Shared-param
+ * clones served from N threads therefore scale past single-thread QPS
+ * (>1.5x at 4 threads in the test suite), matching
  * paddle_gradient_machine_create_shared_param semantics
- * (capi/gradient_machine.h:87-91).
+ * (capi/gradient_machine.h:87-91, examples/model_inference/multi_thread).
  */
 #ifndef PADDLE_TPU_CAPI_H
 #define PADDLE_TPU_CAPI_H

@@ -148,13 +148,21 @@ def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
     return model_fn
 
 
-def _mlp_stage(p, x, epsilon: float = 1e-6):
-    """One pipeline stage of the MLP trunk: pre-LN -> FFN -> residual.
-    Hand-rolled LN/FFN math over a per-stage param SLICE, so the stage
-    params can carry a leading [S] axis sharded over ``pp``."""
+def _ln(x, g=None, b=None, eps: float = 1e-6):
+    """Hand-rolled LayerNorm over the last axis (stage params carry a
+    leading [S] axis, so the Module-based nn.LayerNorm doesn't apply)."""
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-    h = (x - mu) * jax.lax.rsqrt(var + epsilon) * p["ln_g"] + p["ln_b"]
+    h = (x - mu) * jax.lax.rsqrt(var + eps)
+    if g is not None:
+        h = h * g + b
+    return h
+
+
+def _mlp_stage(p, x):
+    """One pipeline stage of the MLP trunk: pre-LN -> FFN -> residual,
+    over a per-stage param SLICE."""
+    h = _ln(x, p["ln_g"], p["ln_b"])
     h = jax.nn.gelu(h @ p["w_in"] + p["b_in"])
     return x + h @ p["w_out"] + p["b_out"]
 
@@ -213,9 +221,7 @@ def pipelined_mlp_lm_builder(cfg: TransformerConfig, mesh=None,
             run = pipeline_apply(_mlp_stage, mesh, axis)
             x = run(stages, xs).reshape(b, t, d)
 
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-        x = (x - mu) * jax.lax.rsqrt(var + 1e-6)
+        x = _ln(x)
         w_out = param("w_out", (d, cfg.vocab_size), policy.param_dtype,
                       init.xavier_uniform())
         logits = jnp.matmul(policy.cast_to_compute(x),

@@ -78,7 +78,7 @@ def pipeline_pp_rules(axis: str = "pp") -> Rules:
     """Stage-stacked trunk params ([S, ...] leading axis) shard one stage
     per ``pp`` device; everything else (embedding, readout) replicates.
     Pairs with ``models.transformer.pipelined_mlp_lm_builder``."""
-    return ((r"stage_", P(axis)),)
+    return ((r"(^|/)stage_", P(axis)),)
 
 
 def transformer_tp_rules(axis: str = "mp") -> Rules:

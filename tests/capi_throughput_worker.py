@@ -1,0 +1,108 @@
+"""Subprocess worker for the C-API multithread throughput test.
+
+Runs OUTSIDE the suite's 8-virtual-device CPU platform: with
+``xla_force_host_platform_device_count``, XLA CPU serializes concurrent
+executions (measured ratio 1.0x), so the GIL-overlap property this
+measures is only observable on a plain 1-device backend — the shape a
+real serving process has.  Prints one JSON line {single_qps, multi_qps}.
+"""
+
+import ctypes
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def main():
+    # The session sitecustomize may have booted the axon TPU plugin before
+    # this module runs; env vars alone don't undo that (see
+    # tests/conftest.py) — reset the backend registry to plain 1-device
+    # CPU before any jax work.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    assert jax.devices()[0].platform == "cpu", jax.devices()
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference
+    from paddle_tpu.models.lenet import inference_fn_builder
+    from paddle_tpu.utils.native import load_library
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = load_library("capi.cc",
+                       os.path.join(root, "paddle_tpu",
+                                    "libpaddle_capi.so"),
+                       embed_python=True)
+    lib.paddle_last_error.restype = ctypes.c_char_p
+    assert lib.paddle_init(0, None) == 0
+
+    d = tempfile.mkdtemp()
+    model = nn.transform(inference_fn_builder(10))
+    x = np.zeros((64, 784), np.float32)
+    params, _ = model.init(jax.random.key(0), {"image": x})
+    inference.export_model(
+        d, params,
+        config={"model_ref": "paddle_tpu.models.lenet:inference_fn_builder",
+                "model_kwargs": {"num_classes": 10},
+                "input_names": ["image"], "output_names": ["prob"]})
+
+    gm = ctypes.c_void_p()
+    assert lib.paddle_gradient_machine_create_for_inference_with_parameters(
+        ctypes.byref(gm), d.encode()) == 0, lib.paddle_last_error()
+    batch = np.random.RandomState(0).rand(64, 784).astype(np.float32)
+
+    def forward(machine):
+        mat = ctypes.c_void_p()
+        assert lib.paddle_matrix_create(ctypes.byref(mat), batch.shape[0],
+                                        batch.shape[1]) == 0
+        flat = np.ascontiguousarray(batch)
+        assert lib.paddle_matrix_set_data(
+            mat, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))) == 0
+        ia, oa = ctypes.c_void_p(), ctypes.c_void_p()
+        lib.paddle_arguments_create_none(ctypes.byref(ia))
+        lib.paddle_arguments_create_none(ctypes.byref(oa))
+        lib.paddle_arguments_resize(ia, 1)
+        lib.paddle_arguments_set_value(ia, 0, mat)
+        rc = lib.paddle_gradient_machine_forward(gm if machine is None
+                                                 else machine, ia, oa, 0)
+        assert rc == 0, lib.paddle_last_error()
+        lib.paddle_matrix_destroy(mat)
+        lib.paddle_arguments_destroy(ia)
+        lib.paddle_arguments_destroy(oa)
+
+    forward(None)  # warm the jit cache
+    n_total, nt = 24, 4
+
+    t0 = time.perf_counter()
+    for _ in range(n_total):
+        forward(None)
+    single_qps = n_total / (time.perf_counter() - t0)
+
+    clones = []
+    for _ in range(nt):
+        c = ctypes.c_void_p()
+        assert lib.paddle_gradient_machine_create_shared_param(
+            gm, ctypes.byref(c)) == 0
+        clones.append(c)
+    threads = [threading.Thread(
+        target=lambda c=c: [forward(c) for _ in range(n_total // nt)])
+        for c in clones]
+    t0 = time.perf_counter()
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    multi_qps = n_total / (time.perf_counter() - t0)
+
+    print(json.dumps({"single_qps": single_qps, "multi_qps": multi_qps}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
