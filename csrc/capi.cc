@@ -10,7 +10,9 @@
  * reference's multi_thread example overlapped device kernels
  * (capi/gradient_machine.h:87-91).  Measured in
  * tests/test_capi.py::test_multithread_throughput_scales: >1.5x
- * single-thread QPS at 4 threads on a conv model.  If this process
+ * single-thread QPS at 4 threads (3.2x measured) on a wait-dominated
+ * probe model; raw-compute overlap additionally depends on how many
+ * cores/chips the backend has.  If this process
  * already hosts a Python interpreter (e.g. the test suite loading us via
  * ctypes), we attach to it instead of initializing.
  */
