@@ -41,6 +41,10 @@ def _provision_cpu(n: int) -> None:
 def main() -> None:
     phase = sys.argv[1]
     ckpt_dir = sys.argv[2]
+    if phase == "train4":
+        return main_train4(ckpt_dir)
+    if phase == "master":
+        return main_master(ckpt_dir, sys.argv[3])
     _provision_cpu(2)
 
     import jax
@@ -124,6 +128,181 @@ def main() -> None:
         np.save(os.path.join(ckpt_dir, f"final_{phase}.npy"), w_local)
     multihost_utils.sync_global_devices("done")
     print(f"rank {rank} phase {phase} OK loss={float(loss):.4f}")
+
+
+def main_train4(ckpt_dir: str) -> None:
+    """4 OS processes forming a dp2 x mp2 GLOBAL mesh: model parallelism
+    crosses process boundaries (w1 column-split / w2 row-split over
+    ``mp``), the batch shards over ``dp``, and one jitted step carries
+    both the tensor-parallel collectives and the gradient psum over DCN.
+    The launcher's test compares the result against a single-device
+    recompute of the same math."""
+    _provision_cpu(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import runtime
+    from paddle_tpu.parallel import make_mesh
+
+    runtime.initialize()
+    assert runtime.process_count() == 4, runtime.process_count()
+    rank = runtime.process_index()
+    devices = jax.devices()
+    assert len(devices) == 4, devices
+    mesh = make_mesh((2, 2), ("dp", "mp"), devices)
+
+    rs = np.random.RandomState(3)
+    w1_0 = (rs.randn(8, 16) * 0.2).astype(np.float32)
+    w2_0 = (rs.randn(16, 4) * 0.2).astype(np.float32)
+    w1 = jax.device_put(jnp.asarray(w1_0),
+                        NamedSharding(mesh, P(None, "mp")))
+    w2 = jax.device_put(jnp.asarray(w2_0),
+                        NamedSharding(mesh, P("mp", None)))
+
+    global_batch = 16
+
+    def make_global(step: int):
+        rs_b = np.random.RandomState(100 + step)
+        x = rs_b.randn(global_batch, 8).astype(np.float32)
+        y = rs_b.randint(0, 4, global_batch).astype(np.int32)
+        # This process owns ONE device at mesh position
+        # (rank // 2, rank % 2): its dp row of the batch (replicated
+        # across its mp column).
+        dp_idx = rank // 2
+        half = global_batch // 2
+        sl = slice(dp_idx * half, (dp_idx + 1) * half)
+        shard = NamedSharding(mesh, P("dp"))
+        return {
+            "x": jax.make_array_from_process_local_data(shard, x[sl]),
+            "y": jax.make_array_from_process_local_data(shard, y[sl]),
+        }
+
+    @jax.jit
+    def step_fn(w1, w2, batch):
+        def loss_fn(ws):
+            w1, w2 = ws
+            h = jax.nn.relu(batch["x"] @ w1)
+            logits = h @ w2
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logits, batch["y"][:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked)
+
+        loss, (g1, g2) = jax.value_and_grad(loss_fn)((w1, w2))
+        return w1 - 0.1 * g1, w2 - 0.1 * g2, loss
+
+    for i in range(3):
+        w1, w2, loss = step_fn(w1, w2, make_global(i))
+
+    # Pull full (replicated) copies and assert every process agrees.
+    rep = NamedSharding(mesh, P())
+    full = jax.jit(lambda a, b: (a, b), out_shardings=(rep, rep))(w1, w2)
+    from jax.experimental import multihost_utils
+
+    w1_local = np.asarray(full[0].addressable_data(0))
+    w2_local = np.asarray(full[1].addressable_data(0))
+    g1 = multihost_utils.process_allgather(w1_local)
+    g2 = multihost_utils.process_allgather(w2_local)
+    for p in range(1, 4):
+        np.testing.assert_array_equal(g1[0], g1[p])
+        np.testing.assert_array_equal(g2[0], g2[p])
+    if rank == 0:
+        np.save(os.path.join(ckpt_dir, "final4_w1.npy"), w1_local)
+        np.save(os.path.join(ckpt_dir, "final4_w2.npy"), w2_local)
+    multihost_utils.sync_global_devices("train4-done")
+    print(f"rank {rank} train4 OK loss={float(loss):.4f}")
+
+
+def main_master(ckpt_dir: str, master_addr: str) -> None:
+    """Master-fed training: each trainer process pulls its OWN work
+    stream from the csrc/master.cc service (cloud_reader protocol) while
+    training — the Go master + N trainers topology in miniature.  Task
+    split is dynamic, so processes train decoupled during the pass and
+    sync parameters by averaging at the pass boundary (the
+    checkpoint-elastic pattern; reference go/master/client.go:119-239)."""
+    _provision_cpu(2)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.distributed import runtime
+    from paddle_tpu.distributed.master import MasterClient, task_reader
+
+    runtime.initialize()
+    assert runtime.process_count() == 2
+    rank = runtime.process_index()
+
+    host, port = master_addr.rsplit(":", 1)
+    client = MasterClient((host, int(port)), trainer=rank)
+
+    def decode(rec: bytes):
+        x = np.frombuffer(rec[:32], "<f4")
+        y = int(np.frombuffer(rec[32:36], "<i4")[0])
+        return x, y
+
+    w = jnp.asarray(np.random.RandomState(5).randn(8, 4) * 0.1,
+                    jnp.float32)
+
+    @jax.jit
+    def step_fn(w, x, y):
+        def loss_fn(w):
+            logits = x @ w
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked)
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    # Warm the compile BEFORE racing for tasks, then line both trainers
+    # up on a barrier — so the first-come-first-served task split isn't
+    # skewed by one process compiling while the other drains the queue.
+    from jax.experimental import multihost_utils
+
+    step_fn(w, jnp.zeros((4, 8), jnp.float32),
+            jnp.zeros((4,), jnp.int32))[1].block_until_ready()
+    multihost_utils.sync_global_devices("master-start")
+
+    n_seen, buf, losses = 0, [], []
+
+    def flush():
+        nonlocal w, buf, n_seen
+        if not buf:
+            return
+        x = jnp.asarray(np.stack([b[0] for b in buf]))
+        y = jnp.asarray(np.asarray([b[1] for b in buf], np.int32))
+        w, loss = step_fn(w, x, y)
+        losses.append(float(loss))
+        n_seen += len(buf)
+        buf = []
+
+    # Drain THIS trainer's dynamic share of the pass, stepping once per
+    # 4 pulled samples (ragged tails train too).
+    for rec in task_reader(client)():
+        buf.append(decode(rec))
+        if len(buf) == 4:
+            flush()
+    flush()
+    client.close()
+    assert all(np.isfinite(losses)), losses
+
+    # Pass-boundary parameter sync: average across trainers.
+    gathered = multihost_utils.process_allgather(np.asarray(w))
+    w_avg = np.mean(np.asarray(gathered), axis=0)
+    counts = multihost_utils.process_allgather(
+        np.asarray([n_seen], np.int64))
+    total = int(np.sum(np.asarray(counts)))
+    assert total == 32, (total, counts)  # every record consumed once
+    if rank == 0:
+        np.save(os.path.join(ckpt_dir, "master_w_avg.npy"), w_avg)
+        np.save(os.path.join(ckpt_dir, "master_counts.npy"),
+                np.asarray(counts).ravel())
+    multihost_utils.sync_global_devices("master-done")
+    print(f"rank {rank} master OK saw {n_seen} records")
 
 
 if __name__ == "__main__":

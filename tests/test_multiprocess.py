@@ -29,12 +29,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_generation(phase: str, ckpt: str, port: int) -> None:
+def _run_generation(phase: str, ckpt: str, port: int, nproc: int = 2,
+                    extra_args=()) -> None:
     from paddle_tpu.distributed.launch import launch_local
 
     env = {k: v for k, v in os.environ.items()}
-    # The children provision their own 2-device virtual CPU platform;
-    # scrub this pytest process's 8-device setting so they control it.
+    # The children provision their own virtual CPU platform; scrub this
+    # pytest process's 8-device setting so they control it.
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f)
@@ -42,7 +43,7 @@ def _run_generation(phase: str, ckpt: str, port: int) -> None:
     env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
                          if env.get("PYTHONPATH") else repo_root)
     rc = launch_local(
-        2, [sys.executable, WORKER, phase, ckpt],
+        nproc, [sys.executable, WORKER, phase, ckpt, *extra_args],
         coordinator=f"127.0.0.1:{port}",
         extra_env=env)
     assert rc == 0, f"phase {phase} failed rc={rc}"
@@ -60,3 +61,86 @@ def test_two_process_psum_training_and_resume(tmp_path):
     # train ran steps 0..3 with a checkpoint at 2; resume restored at 2 and
     # ran 2..3 — identical data stream, so identical final params.
     np.testing.assert_array_equal(final_train, final_resume)
+
+
+@pytest.mark.slow
+def test_four_process_dp2_mp2_matches_single_device(tmp_path):
+    """VERDICT r2 #8a: 4 OS processes forming a dp2 x mp2 GLOBAL mesh —
+    tensor parallelism crossing process boundaries — must reproduce the
+    single-device trajectory of the same MLP."""
+    ckpt = str(tmp_path / "ckpt4")
+    os.makedirs(ckpt, exist_ok=True)
+    _run_generation("train4", ckpt, _free_port(), nproc=4)
+
+    w1 = np.load(os.path.join(ckpt, "final4_w1.npy"))
+    w2 = np.load(os.path.join(ckpt, "final4_w2.npy"))
+
+    # Single-device recompute of the exact same math (this process's
+    # 8-device CPU platform, no sharding).
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(3)
+    ref1 = jnp.asarray((rs.randn(8, 16) * 0.2).astype(np.float32))
+    ref2 = jnp.asarray((rs.randn(16, 4) * 0.2).astype(np.float32))
+
+    @jax.jit
+    def step(w1, w2, x, y):
+        def loss_fn(ws):
+            w1, w2 = ws
+            h = jax.nn.relu(x @ w1)
+            logits = h @ w2
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - picked)
+
+        _, (g1, g2) = jax.value_and_grad(loss_fn)((w1, w2))
+        return w1 - 0.1 * g1, w2 - 0.1 * g2
+
+    for i in range(3):
+        rs_b = np.random.RandomState(100 + i)
+        x = jnp.asarray(rs_b.randn(16, 8).astype(np.float32))
+        y = jnp.asarray(rs_b.randint(0, 4, 16).astype(np.int32))
+        ref1, ref2 = step(ref1, ref2, x, y)
+
+    np.testing.assert_allclose(w1, np.asarray(ref1), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(w2, np.asarray(ref2), rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_master_fed_multiprocess_training(tmp_path):
+    """VERDICT r2 #8b: trainers pull work from the csrc/master.cc
+    service (cloud_reader protocol) WHILE training — the Go-path
+    topology in miniature.  Every record must be consumed exactly once
+    across the trainer fleet and both trainers must do work."""
+    from paddle_tpu.distributed.master import (Master, MasterServer,
+                                               recordio_tasks)
+    from paddle_tpu.io import recordio
+
+    data = str(tmp_path / "train.rio")
+    rs = np.random.RandomState(7)
+    with recordio.Writer(data) as w:
+        for _ in range(32):
+            x = rs.randn(8).astype("<f4")
+            y = np.asarray([rs.randint(0, 4)], "<i4")
+            w.write(x.tobytes() + y.tobytes())
+
+    ckpt = str(tmp_path / "ckptm")
+    os.makedirs(ckpt, exist_ok=True)
+    m = Master(timeout_s=60, max_failures=3)
+    m.set_tasks(recordio_tasks([data], records_per_task=8))
+    srv = MasterServer(m, port=0)
+    try:
+        host, port = srv.address
+        _run_generation("master", ckpt, _free_port(), nproc=2,
+                        extra_args=(f"{host}:{port}",))
+        counts = m.counts()
+    finally:
+        srv.close()
+        m.close()
+
+    assert counts["done"] == 4, counts       # all 4 tasks finished
+    w_avg = np.load(os.path.join(ckpt, "master_w_avg.npy"))
+    assert np.isfinite(w_avg).all()
+    per_trainer = np.load(os.path.join(ckpt, "master_counts.npy"))
+    assert per_trainer.sum() == 32 and (per_trainer > 0).all(), per_trainer
