@@ -27,8 +27,8 @@ CLASSES = get_config_arg("classes", int, 1000)
 # more than the bytes it saves), so smallnet defaults to float32.
 # feed_dtype=... overrides either way.
 
-_hw = {"alexnet": 224, "googlenet": 224, "smallnet": 32,
-       "resnet50": 224}[MODEL]
+_hw = (224 if MODEL.startswith("resnet")
+       else {"alexnet": 224, "googlenet": 224, "smallnet": 32}[MODEL])
 FEED_DTYPE = get_config_arg("feed_dtype", str,
                             "float32" if _hw < 64 else "bfloat16")
 
@@ -40,10 +40,12 @@ if MODEL == "alexnet":
 elif MODEL == "googlenet":
     from paddle_tpu.models.googlenet import model_fn_builder
     model_fn = model_fn_builder(CLASSES)
-elif MODEL == "resnet50":
+elif MODEL.startswith("resnet"):
     from paddle_tpu.models.resnet import model_fn_builder
-    model_fn = model_fn_builder(depth=50, num_classes=CLASSES,
-                                stem=get_config_arg("stem", str, "conv7"))
+    model_fn = model_fn_builder(depth=int(MODEL[len("resnet"):]),
+                                num_classes=CLASSES,
+                                stem=get_config_arg("stem", str, "conv7"),
+                                remat=get_config_arg("remat", str, "none"))
 else:  # smallnet_mnist_cifar: conv32-pool-conv64-pool-fc
     import paddle_tpu.nn as nn
     from paddle_tpu.ops import losses

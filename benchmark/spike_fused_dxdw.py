@@ -1,0 +1,124 @@
+"""Round-3 spike (documented NEGATIVE result): a single Pallas kernel
+computing BOTH dx and dw of a 1x1 conv vs XLA's two-fusion pair.
+
+Round-2's unit spike (ops/pallas_conv_block.py) lost 2x; this retry uses
+deliberate MXU tiling (4096-row tiles, f32 constant-index dw
+accumulator, bf16 streams).  Verdict on v5e (jax 0.9, median of 5 under
+a hoist-proof dependency-chained scan): XLA pair 0.73 ms/iter, Pallas
+1.21 ms/iter at the stage-1 shape (N=401k, 256->64).  Mosaic's
+dot_general with a 64-wide contraction runs far enough below XLA's conv
+emitter that the ~60 MB/conv byte saving (~0.07 ms) cannot pay for it -
+the block-level fused backward of docs/design/kernels.md is a dead end
+on current Mosaic codegen.  Standalone micro-timing over the tunnel is
+UNSTABLE (measured 0.28-2.0 ms for the same program); only the chained
+scan protocol below is trustworthy at sub-ms scales.
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 128 * 56 * 56   # 401408
+CIN, COUT = 256, 64
+TN = 4096
+
+rs = np.random.RandomState(0)
+dy = jnp.asarray(rs.randn(N, COUT), jnp.bfloat16)
+x = jnp.asarray(rs.randn(N, CIN), jnp.bfloat16)
+w = jnp.asarray(rs.randn(CIN, COUT), jnp.bfloat16)
+
+
+# ---- XLA reference: the dx / dw pair as XLA compiles it ----
+@jax.jit
+def xla_pair(dy, x, w):
+    dx = lax.dot_general(dy, w, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # [N,CIN]
+    dw = lax.dot_general(x, dy, (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # [CIN,COUT]
+    return dx.astype(jnp.bfloat16), dw
+
+
+# ---- Pallas fused kernel ----
+def kernel(dy_ref, x_ref, w_ref, dx_ref, dw_ref, dw_acc):
+    i = pl.program_id(0)
+    g = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+
+    dy_t = dy_ref[:]
+    dx_ref[:] = lax.dot_general(
+        dy_t, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dx_ref.dtype)
+    dw_acc[:] += lax.dot_general(
+        x_ref[:], dy_t, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(i == g - 1)
+    def _():
+        dw_ref[:] = dw_acc[:]
+
+
+@jax.jit
+def pallas_fused(dy, x, w):
+    grid = (N // TN,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TN, COUT), lambda i: (i, 0)),
+            pl.BlockSpec((TN, CIN), lambda i: (i, 0)),
+            pl.BlockSpec((CIN, COUT), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TN, CIN), lambda i: (i, 0)),
+            pl.BlockSpec((CIN, COUT), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, CIN), jnp.bfloat16),
+            jax.ShapeDtypeStruct((CIN, COUT), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((CIN, COUT), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(dy, x, w)
+
+
+def bench(fn, *args, n=30):
+    out = fn(*args)
+    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())  # sync
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())
+    t1 = time.perf_counter()
+    # differential: subtract one-call arm
+    t2 = time.perf_counter()
+    for _ in range(n // 4):
+        out = fn(*args)
+    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())
+    t3 = time.perf_counter()
+    return ((t1 - t0) - (t3 - t2)) / (n - n // 4) * 1e3
+
+
+ref = xla_pair(dy, x, w)
+got = pallas_fused(dy, x, w)
+np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
+                           rtol=2e-2, atol=2.0)
+np.testing.assert_allclose(
+    np.asarray(got[0]).astype(np.float32),
+    np.asarray(ref[0]).astype(np.float32), rtol=5e-2, atol=2.0)
+print("numerics OK")
+t_xla = bench(xla_pair, dy, x, w)
+t_pal = bench(pallas_fused, dy, x, w)
+bytes_xla = (N*COUT*2)*2 + N*CIN*2 + N*CIN*2 + CIN*COUT*(2+4)  # dy x2, x, dx out
+bytes_pal = N*COUT*2 + N*CIN*2*2 + CIN*COUT*(2+4)              # dy once
+print(f"XLA pair   : {t_xla:.3f} ms  (io floor {bytes_xla/819e9*1e3:.3f} ms)")
+print(f"Pallas fused: {t_pal:.3f} ms  (io floor {bytes_pal/819e9*1e3:.3f} ms)")

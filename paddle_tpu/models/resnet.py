@@ -13,6 +13,7 @@ from typing import Sequence
 import jax.numpy as jnp
 
 import paddle_tpu.nn as nn
+from paddle_tpu.core.errors import enforce_in
 from paddle_tpu.ops import losses
 
 
@@ -79,16 +80,25 @@ _CONFIGS = {
 
 class ResNet(nn.Module):
     def __init__(self, depth: int = 50, num_classes: int = 1000,
-                 stem: str = "conv7", name=None):
+                 stem: str = "conv7", remat: str = "none", name=None):
         """``stem``: "conv7" (the reference's 7x7/2 conv) or "s2d" —
         space-to-depth the image 2x2 -> [h/2, w/2, 12] and run a 4x4/1
         conv (the MLPerf-TPU stem transform: same downsampling, an 8x8
         receptive field superset of 7x7, and a 192-wide contraction the
-        MXU tiles far better than 7x7x3=147 over a 3-channel input)."""
+        MXU tiles far better than 7x7x3=147 over a 3-channel input).
+
+        ``remat``: per-block rematerialization, the HBM-traffic lever —
+        "none"; "conv" (save conv outputs only, recompute the BN/relu
+        elementwise chains in backward — cheap VPU recompute for one
+        fewer HBM read+write of every normalized activation); "block"
+        (save only block boundaries, recompute everything — max HBM
+        savings, +~50% forward FLOPs in backward)."""
         super().__init__(name)
+        enforce_in(remat, ("none", "conv", "block"))
         self.block_cls, self.stages = _CONFIGS[depth]
         self.num_classes = num_classes
         self.stem = stem
+        self.remat = remat
 
     def forward(self, images):
         """images: [b, h, w, 3] NHWC."""
@@ -106,17 +116,24 @@ class ResNet(nn.Module):
         for stage, blocks in enumerate(self.stages):
             for b in range(blocks):
                 stride = 2 if (stage > 0 and b == 0) else 1
-                x = self.block_cls(filters, stride=stride, project=(b == 0),
-                                   name=f"stage{stage}_block{b}")(x)
+                block = self.block_cls(filters, stride=stride,
+                                       project=(b == 0),
+                                       name=f"stage{stage}_block{b}")
+                if self.remat == "none":
+                    x = block(x)
+                elif self.remat == "conv":
+                    x = nn.remat(block, x, policy="conv_out")
+                else:  # "block": save boundaries only
+                    x = nn.remat(block, x)
             filters *= 2
         x = nn.GlobalPool2D("avg", name="gap")(x)
         return nn.Linear(self.num_classes, name="fc")(x)
 
 
 def model_fn_builder(depth: int = 50, num_classes: int = 1000,
-                     stem: str = "conv7"):
+                     stem: str = "conv7", remat: str = "none"):
     def model_fn(batch):
-        logits = ResNet(depth, num_classes, stem=stem,
+        logits = ResNet(depth, num_classes, stem=stem, remat=remat,
                         name="resnet")(batch["image"])
         loss = losses.softmax_cross_entropy(logits, batch["label"]).mean()
         return loss, {"logits": logits, "label": batch["label"]}

@@ -22,6 +22,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.core.errors import enforce, enforce_in
@@ -126,6 +127,10 @@ class Conv2D(Module):
             window_strides=self.stride, padding=self.padding,
             rhs_dilation=self.dilation, feature_group_count=self.groups,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # Tag for remat policies: "conv_out" saves exactly these tensors
+        # and recomputes the cheap elementwise chains in backward (a
+        # no-op unless the model runs under nn.remat with that policy).
+        y = checkpoint_name(y, "conv_out")
         y = policy.cast_to_output(y)
         if self.bias:
             b = param("b", (self.channels,), policy.param_dtype, init.zeros)

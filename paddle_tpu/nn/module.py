@@ -181,7 +181,22 @@ def _clone_dicts(tree: Dict[str, Any]) -> Dict[str, Any]:
             for k, v in tree.items()}
 
 
-def remat(fn: Callable, *args):
+def _resolve_remat_policy(policy):
+    """String shorthands for common jax.checkpoint policies; None means
+    full recompute (save only the boundary), jax's default."""
+    if policy is None or not isinstance(policy, str):
+        return policy
+    import jax.ad_checkpoint as adck
+    if policy == "nothing":
+        return adck.checkpoint_policies.nothing_saveable
+    if policy == "dots":
+        return adck.checkpoint_policies.dots_saveable
+    if policy == "conv_out":
+        return adck.checkpoint_policies.save_only_these_names("conv_out")
+    raise ValueError(f"unknown remat policy {policy!r}")
+
+
+def remat(fn: Callable, *args, policy=None):
     """``jax.checkpoint`` for stateful module calls.
 
     Plain ``jax.checkpoint`` cannot wrap a module call directly: ``param()``
@@ -198,9 +213,16 @@ def remat(fn: Callable, *args):
     recomputed during backward instead of stored.
 
     Usage: ``x = nn.remat(block, x, mask)`` instead of ``x = block(x, mask)``.
+
+    ``policy`` is a ``jax.checkpoint`` rematerialization policy (e.g.
+    ``jax.checkpoint_policies.save_only_these_names("conv_out")`` to keep
+    conv outputs and recompute the cheap elementwise chains in backward —
+    the HBM-traffic shape ResNet wants) or one of the string shorthands
+    "nothing" / "dots" / "conv_out".
     """
+    policy = _resolve_remat_policy(policy)
     if not in_transform():
-        return jax.checkpoint(fn)(*args)
+        return jax.checkpoint(fn, policy=policy)(*args)
     frame = current_frame()
     if frame.mode == "init":
         # Params are being created; no gradient pass happens at init.
@@ -234,7 +256,7 @@ def remat(fn: Callable, *args):
     # cloned so the merge cannot mutate the caller's state tree.
     merged_state = _clone_dicts(frame.state)
     _deep_merge(merged_state, _clone_dicts(frame.new_state))
-    out, new_state = jax.checkpoint(pure)(
+    out, new_state = jax.checkpoint(pure, policy=policy)(
         frame.params, merged_state, rng_key, *args)
     if captured:
         frame.counters = captured["counters"]

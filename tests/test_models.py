@@ -48,6 +48,34 @@ def test_resnet50_forward_shape():
     assert 24e6 < n_params < 27e6, n_params
 
 
+def test_resnet_remat_and_stem_variants_match():
+    """remat policies must not change the math (they only change what
+    backward recomputes), and the s2d stem must build/step."""
+    batch = {"image": RS.randn(2, 32, 32, 3).astype(np.float32),
+             "label": RS.randint(0, 10, 2)}
+    ref = None
+    for mode in ("none", "conv", "block"):
+        m = nn.transform(resnet.model_fn_builder(18, 10, remat=mode))
+        params, state = m.init(jax.random.key(0),
+                               {k: jnp.asarray(v)
+                                for k, v in batch.items()})
+
+        def loss_fn(p):
+            (loss, _), _ = m.apply(p, state, None, batch, train=True)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        flat = np.concatenate([np.asarray(g).ravel() for g in
+                               jax.tree_util.tree_leaves(grads)])
+        if ref is None:
+            ref = (float(loss), flat)
+        else:
+            assert abs(float(loss) - ref[0]) < 1e-5
+            np.testing.assert_allclose(flat, ref[1], rtol=1e-4, atol=1e-5)
+
+    _one_step(resnet.model_fn_builder(18, 10, stem="s2d"), batch)
+
+
 def test_alexnet_forward():
     model = nn.transform(
         lambda x: alexnet.AlexNet(1000, name="a")(x))
