@@ -41,8 +41,13 @@ elif MODEL == "googlenet":
     from paddle_tpu.models.googlenet import model_fn_builder
     model_fn = model_fn_builder(CLASSES)
 elif MODEL.startswith("resnet"):
-    from paddle_tpu.models.resnet import model_fn_builder
-    model_fn = model_fn_builder(depth=int(MODEL[len("resnet"):]),
+    from paddle_tpu.models.resnet import _CONFIGS, model_fn_builder
+    from paddle_tpu.core.errors import enforce
+    _depth = MODEL[len("resnet"):]
+    enforce(_depth.isdigit() and int(_depth) in _CONFIGS,
+            "unknown model %r (resnet depths: %s)", MODEL,
+            sorted(_CONFIGS))
+    model_fn = model_fn_builder(depth=int(_depth),
                                 num_classes=CLASSES,
                                 stem=get_config_arg("stem", str, "conv7"),
                                 remat=get_config_arg("remat", str, "none"))

@@ -1,0 +1,45 @@
+"""Sequence-tagging CRF benchmark config — BASELINE.json's 3rd workload
+(the reference's ``demo/sequence_tagging`` linear_crf / rnn_crf configs,
+``paddle/gserver/layers/LinearChainCRF.cpp`` forward-backward).
+
+    python -m paddle_tpu time --config benchmark/sequence_tagging.py \
+        --config-args mode=rnn,batch_size=32,seq_len=48 --batches 16
+
+Synthetic fixed-length batches (like the other bench configs): uniform
+shapes so the time job runs the compiled multi-batch scan, and the
+number isolates the train step — dominated by the CRF forward-backward
+``lax.scan`` over time (the loss whose recurrence structure is most at
+risk of being slow on TPU, SURVEY §7's named Pallas candidate).
+"""
+
+import numpy as np
+
+from paddle_tpu import optim
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu.core.errors import enforce_in
+from paddle_tpu.models.sequence_tagging import model_fn_builder
+
+MODE = get_config_arg("mode", str, "rnn")       # "rnn" | "linear"
+enforce_in(MODE, ("rnn", "linear"))
+BATCH = get_config_arg("batch_size", int, 32)
+SEQ = get_config_arg("seq_len", int, 48)
+VOCAB = get_config_arg("vocab", int, 44068)     # conll05 word dict size
+TAGS = get_config_arg("tags", int, 106)         # conll05 label dict size
+
+mixed_precision = True
+
+model_fn = model_fn_builder(VOCAB, TAGS, mode=MODE,
+                            embed_dim=64, hidden=64)
+optimizer = optim.from_config(settings(
+    learning_rate=2e-3, learning_method_name="adam"))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {
+        "ids": rs.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32),
+        "ids_mask": np.ones((BATCH, SEQ), bool),
+        "tags": rs.randint(0, TAGS, (BATCH, SEQ)).astype(np.int32),
+    }
+    while True:
+        yield batch

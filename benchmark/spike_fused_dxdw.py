@@ -91,21 +91,37 @@ def pallas_fused(dy, x, w):
     )(dy, x, w)
 
 
-def bench(fn, *args, n=30):
-    out = fn(*args)
-    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())  # sync
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())
-    t1 = time.perf_counter()
-    # differential: subtract one-call arm
-    t2 = time.perf_counter()
-    for _ in range(n // 4):
-        out = fn(*args)
-    _ = float(jnp.asarray(out[1]).astype(jnp.float32).sum())
-    t3 = time.perf_counter()
-    return ((t1 - t0) - (t3 - t2)) / (n - n // 4) * 1e3
+def make_loop(pair):
+    """Hoist-proof chained scan: BOTH operands depend on the carry, so
+    XLA cannot move either GEMM out of the loop (it hoisted the
+    loop-invariant dx GEMM in a naive scan, reading 0.59 "ms/iter" for
+    half the work)."""
+    @functools.partial(jax.jit, static_argnums=3)
+    def loop(dy, x, w, k):
+        def body(carry, _):
+            dyc, xc = carry
+            dx, dw = pair(dyc, xc, w)
+            dy_new = dyc + (dw[0:1, :COUT] * 1e-30).astype(dyc.dtype)
+            return (dy_new, dx.astype(xc.dtype)), dw.sum()
+        _, s = lax.scan(body, (dy, x), None, length=k)
+        return s.sum()
+    return loop
+
+
+def measure(pair, name):
+    loop = make_loop(pair)
+    for k in (8, 32):
+        float(loop(dy, x, w, k))  # warm both trip counts
+
+    def arm(k):
+        t0 = time.perf_counter()
+        float(loop(dy, x, w, k))   # host transfer = the only real sync
+        return time.perf_counter() - t0
+
+    diffs = sorted((arm(32) - arm(8)) / 24 * 1e3 for _ in range(5))
+    print(f"{name}: {diffs[2]:.3f} ms/iter "
+          f"(runs: {['%.3f' % d for d in diffs]})")
+    return diffs[2]
 
 
 ref = xla_pair(dy, x, w)
@@ -116,9 +132,10 @@ np.testing.assert_allclose(
     np.asarray(got[0]).astype(np.float32),
     np.asarray(ref[0]).astype(np.float32), rtol=5e-2, atol=2.0)
 print("numerics OK")
-t_xla = bench(xla_pair, dy, x, w)
-t_pal = bench(pallas_fused, dy, x, w)
-bytes_xla = (N*COUT*2)*2 + N*CIN*2 + N*CIN*2 + CIN*COUT*(2+4)  # dy x2, x, dx out
+t_xla = measure(xla_pair, "xla pair    ")
+t_pal = measure(pallas_fused, "pallas fused")
+bytes_xla = (N*COUT*2)*2 + N*CIN*2 + N*CIN*2 + CIN*COUT*(2+4)  # dy x2, x, dx
 bytes_pal = N*COUT*2 + N*CIN*2*2 + CIN*COUT*(2+4)              # dy once
-print(f"XLA pair   : {t_xla:.3f} ms  (io floor {bytes_xla/819e9*1e3:.3f} ms)")
-print(f"Pallas fused: {t_pal:.3f} ms  (io floor {bytes_pal/819e9*1e3:.3f} ms)")
+print(f"io floors: xla {bytes_xla/819e9*1e3:.3f} ms, "
+      f"pallas {bytes_pal/819e9*1e3:.3f} ms "
+      f"(chain epsilon-add adds ~0.25 ms to both)")
