@@ -19,14 +19,21 @@ echo "== lint: syntax + bytecode compile =="
 python -m compileall -q paddle_tpu tests benchmark examples bench.py \
     __graft_entry__.py tpu_smoke.py
 python - <<'EOF'
-# import-surface check: the public package must import clean
+# import-surface check: the public package must import clean.  A TPU
+# sitecustomize may have booted the axon plugin already; env vars alone
+# don't undo that (tests/conftest.py pitfall) - reset to CPU so lint
+# never touches (or hangs on) the chip.
 import os
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge
+xla_bridge._clear_backends()
 import paddle_tpu
 import paddle_tpu.v2
 import paddle_tpu.nn
 import paddle_tpu.framework
-print("import surface OK")
+print("import surface OK on", jax.default_backend())
 EOF
 
 echo "== native libs =="

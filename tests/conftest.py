@@ -44,8 +44,6 @@ def rng():
 # Everything else gets `fast` automatically.
 # ---------------------------------------------------------------------------
 
-import pytest as _pytest
-
 _SLOW_FILES = {
     "test_examples.py",        # subprocess CLI training runs (~13 min)
     "test_gradcheck.py",       # finite-difference sweeps
@@ -75,6 +73,6 @@ def pytest_collection_modifyitems(config, items):
         fname = os.path.basename(str(item.fspath))
         if (fname in _SLOW_FILES or item.name.split("[")[0] in _SLOW_TESTS
                 or item.get_closest_marker("slow") is not None):
-            item.add_marker(_pytest.mark.slow)
+            item.add_marker(pytest.mark.slow)
         else:
-            item.add_marker(_pytest.mark.fast)
+            item.add_marker(pytest.mark.fast)
