@@ -1,3 +1,3 @@
-from paddle_tpu.io import recordio
+from paddle_tpu.io import gob, pserver_checkpoint, recordio
 
-__all__ = ["recordio"]
+__all__ = ["gob", "pserver_checkpoint", "recordio"]

@@ -1,0 +1,103 @@
+"""Reader for the reference Go pserver's checkpoint shard files.
+
+The third of the reference's three trained-model artifact formats
+(SURVEY hard-part #5; the other two — v1 ``pass-%05d/`` dirs and v2
+parameter tars — load via ``training/checkpoint.py`` and ``v2.py``): a
+pserver shard persists as a gob-encoded ``[]parameterCheckpoint``
+(``go/pserver/service.go:272-305``) with an md5 recorded in etcd
+metadata (``checkpointMeta``) — one file per pserver index, each
+holding the slice of parameters that shard owned.
+
+``load_shards`` merges any number of shard files back into one
+``name -> array`` dict, with optional md5 verification against the
+saved meta JSON (the etcd values, if the operator exported them).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.io.gob import GobDecoder
+
+# go/pserver/service.go:52-60 — ElementType iota order.
+ELEMENT_DTYPES = {
+    0: np.int32, 1: np.uint32, 2: np.int64, 3: np.uint64,
+    4: np.float32, 5: np.float64,
+}
+
+
+def load_shard(path: str, expect_md5: Optional[str] = None
+               ) -> List[Dict[str, Any]]:
+    """Decode one shard file into its parameterCheckpoint records
+    (``Param`` name/dtype/array, raw ``Config``/``State`` blobs)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if expect_md5 is not None:
+        got = hashlib.md5(raw).hexdigest()
+        enforce(got == expect_md5,
+                "pserver shard %s: md5 %s != recorded %s (WrongChecksum)",
+                path, got, expect_md5)
+    values = GobDecoder(raw).decode()
+    enforce(len(values) == 1 and isinstance(values[0], list),
+            "pserver shard %s: expected one []parameterCheckpoint, got %d "
+            "top-level values", path, len(values))
+    out = []
+    for rec in values[0]:
+        # parameterCheckpoint embeds ParameterWithConfig; gob transmits
+        # the embedded struct as a field named by its type.
+        pwc = rec.get("ParameterWithConfig", rec)
+        param = pwc.get("Param", {})
+        # gob omits zero-valued fields: an absent ElementType IS the Go
+        # zero value Int32 (iota 0), not a "default" of our choosing.
+        etype = param.get("ElementType", 0)
+        dtype = ELEMENT_DTYPES.get(etype)
+        enforce(dtype is not None,
+                "pserver shard %s: unknown ElementType %d", path, etype)
+        content = param.get("Content", b"")
+        out.append({
+            "name": param.get("Name", ""),
+            "dtype": np.dtype(dtype),
+            "value": np.frombuffer(content, dtype=dtype).copy(),
+            "config": pwc.get("Config", b""),
+            "state": rec.get("State", b""),
+        })
+    return out
+
+
+def load_shards(paths: Iterable[str],
+                meta_dir: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Merge pserver shard files into one flat ``name -> vector`` dict
+    (the model the trainer fleet sharded across pservers).  Vectors are
+    1-D — dims live in the model config, exactly like v1 pass-dir files;
+    feed the result to ``training.checkpoint.apply_v1_params``.
+
+    ``meta_dir``: optional directory of ``<shard-file-name>.meta.json``
+    files carrying the etcd ``checkpointMeta`` (uuid/path/md5); when
+    present, each shard's md5 is verified (the reference's
+    ``WrongChecksum`` guard)."""
+    merged: Dict[str, np.ndarray] = {}
+    for path in paths:
+        md5 = None
+        if meta_dir is not None:
+            # The caller asked for verification: a missing meta file
+            # must fail, not silently skip the WrongChecksum guard.
+            mp = os.path.join(meta_dir,
+                              os.path.basename(path) + ".meta.json")
+            enforce(os.path.exists(mp),
+                    "pserver shards: meta_dir given but %s is missing",
+                    mp)
+            with open(mp) as f:
+                md5 = json.load(f).get("md5")
+        for rec in load_shard(path, expect_md5=md5):
+            enforce(rec["name"] not in merged,
+                    "pserver shards: parameter %r in two shards",
+                    rec["name"])
+            merged[rec["name"]] = rec["value"]
+    enforce(merged, "pserver shards: no parameters found")
+    return merged

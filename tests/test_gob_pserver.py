@@ -1,0 +1,262 @@
+"""Go gob codec + pserver checkpoint shard reader.
+
+The decoder is spec-derived (no Go toolchain in this environment), so
+the anchor tests pin the BYTE-LEVEL examples published in the gob
+documentation — the ``Point{22, 33}`` stream — before the round-trip
+and end-to-end tests build on the Python encoder.
+"""
+
+import hashlib
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import gob
+from paddle_tpu.io.gob import (BYTES, INT, STRING, FieldT, GobDecoder,
+                               GobEncoder, TypeT, decode_int, decode_uint,
+                               encode_int, encode_uint)
+from paddle_tpu.io import pserver_checkpoint as psck
+
+
+def test_scalar_encodings_match_spec():
+    # uint: <128 one byte; else negated-count byte + big-endian bytes
+    assert encode_uint(7) == b"\x07"
+    assert encode_uint(127) == b"\x7f"
+    assert encode_uint(256) == b"\xfe\x01\x00"
+    assert encode_uint(130) == b"\xff\x82"
+    # signed: value<<1, complement for negatives (spec examples)
+    assert encode_int(22) == b"\x2c"
+    assert encode_int(33) == b"\x42"
+    assert encode_int(65) == b"\xff\x82"
+    assert encode_int(-65) == b"\xff\x81"
+    for v in (0, 1, -1, 64, -64, 65, -65, 1 << 40, -(1 << 40)):
+        buf = memoryview(encode_int(v))
+        got, end = decode_int(buf, 0)
+        assert got == v and end == len(buf)
+    for v in (0, 127, 128, 255, 256, 1 << 56):
+        buf = memoryview(encode_uint(v))
+        got, end = decode_uint(buf, 0)
+        assert got == v and end == len(buf)
+
+
+# The documented example stream for
+#     type Point struct { X, Y int };  Point{22, 33}
+# (Go docs / "Gobs of data"): one type-descriptor message + one value
+# message.  This is the external cross-implementation anchor.
+_POINT_STREAM = bytes.fromhex(
+    "1f"                    # descriptor message, 31 bytes
+    "ff81"                  # type id -65
+    "03"                    # wireType field 2 (StructT)
+    "01"                    # structType field 0 (CommonType)
+    "01" "05" "506f696e74"  # Name "Point"
+    "01" "ff82"             # Id 65
+    "00"                    # end CommonType
+    "01"                    # structType field 1 (Field []fieldType)
+    "02"                    # 2 fields
+    "01" "01" "58" "01" "04" "00"   # {"X", int}
+    "01" "01" "59" "01" "04" "00"   # {"Y", int}
+    "00"                    # end structType
+    "00"                    # end wireType
+    "07"                    # value message, 7 bytes
+    "ff82"                  # type id 65
+    "01" "2c"               # X = 22
+    "01" "42"               # Y = 33
+    "00")                   # end struct
+
+
+def test_documented_point_stream_decodes():
+    (value,) = GobDecoder(_POINT_STREAM).decode()
+    assert value == {"X": 22, "Y": 33}
+
+
+def test_documented_point_stream_encodes():
+    """The encoder must reproduce the documented bytes exactly."""
+    enc = GobEncoder()
+    tid = enc.define_struct("Point", [("X", INT), ("Y", INT)])
+    assert tid == 65
+    enc.top_level(tid, GobEncoder.struct_value(
+        [(0, encode_int(22)), (1, encode_int(33))]), is_struct=True)
+    assert enc.getvalue() == _POINT_STREAM
+
+
+def _pserver_shard_bytes(params):
+    """Encode [(name, np_array, etype)] in the reference's exact schema:
+    []parameterCheckpoint with embedded ParameterWithConfig
+    (go/pserver/service.go:62-105)."""
+    enc = GobEncoder()
+    t_param = enc.define_struct("Parameter", [
+        ("Name", STRING), ("ElementType", INT), ("Content", BYTES)])
+    t_pwc = enc.define_struct("ParameterWithConfig", [
+        ("Param", t_param), ("Config", BYTES)])
+    t_ck = enc.define_struct("parameterCheckpoint", [
+        ("ParameterWithConfig", t_pwc), ("State", BYTES)])
+    t_slice = enc.define_slice("", t_ck)
+
+    records = b""
+    for name, arr, etype in params:
+        p_val = GobEncoder.struct_value([
+            (0, GobEncoder.bytes_value(name.encode())),
+            (1, encode_int(etype)),
+            (2, GobEncoder.bytes_value(arr.tobytes())),
+        ])
+        pwc_val = GobEncoder.struct_value([
+            (0, p_val),
+            (1, GobEncoder.bytes_value(b"\x08\x01")),   # config blob
+        ])
+        ck_val = GobEncoder.struct_value([
+            (0, pwc_val),
+            (1, GobEncoder.bytes_value(b"optstate")),
+        ])
+        records += ck_val
+    enc.top_level(t_slice,
+                  encode_uint(len(params)) + records, is_struct=False)
+    return enc.getvalue()
+
+
+def test_pserver_shard_round_trip(tmp_path):
+    w1 = np.arange(12, dtype=np.float32) * 0.5
+    w2 = np.arange(6, dtype=np.float64) - 3
+    raw = _pserver_shard_bytes([("fc_0.w", w1, 4), ("fc_0.b", w2, 5)])
+    p = str(tmp_path / "checkpoint-0")
+    with open(p, "wb") as f:
+        f.write(raw)
+
+    recs = psck.load_shard(p)
+    assert [r["name"] for r in recs] == ["fc_0.w", "fc_0.b"]
+    np.testing.assert_array_equal(recs[0]["value"], w1)
+    np.testing.assert_array_equal(recs[1]["value"], w2)
+    assert recs[0]["state"] == b"optstate"
+    assert recs[0]["config"] == b"\x08\x01"
+
+
+def test_int32_with_omitted_element_type(tmp_path):
+    """gob omits zero-valued fields: an Int32 parameter (ElementType=0)
+    arrives WITHOUT the field and must decode as int32, not float32 —
+    same itemsize, so a wrong default silently corrupts."""
+    arr = np.array([1, -2, 300000, 4], np.int32)
+    enc = GobEncoder()
+    t_param = enc.define_struct("Parameter", [
+        ("Name", STRING), ("ElementType", INT), ("Content", BYTES)])
+    t_pwc = enc.define_struct("ParameterWithConfig", [
+        ("Param", t_param), ("Config", BYTES)])
+    t_ck = enc.define_struct("parameterCheckpoint", [
+        ("ParameterWithConfig", t_pwc), ("State", BYTES)])
+    t_slice = enc.define_slice("", t_ck)
+    p_val = GobEncoder.struct_value([
+        (0, GobEncoder.bytes_value(b"ids")),
+        # field 1 (ElementType=0) omitted, as gob does for zero values
+        (2, GobEncoder.bytes_value(arr.tobytes())),
+    ])
+    ck = GobEncoder.struct_value([
+        (0, GobEncoder.struct_value([(0, p_val)])),
+    ])
+    enc.top_level(t_slice, encode_uint(1) + ck, is_struct=False)
+    p = str(tmp_path / "checkpoint-0")
+    with open(p, "wb") as f:
+        f.write(enc.getvalue())
+    (rec,) = psck.load_shard(p)
+    assert rec["dtype"] == np.int32
+    np.testing.assert_array_equal(rec["value"], arr)
+
+
+def test_missing_meta_fails_when_verification_requested(tmp_path):
+    raw = _pserver_shard_bytes([("w", np.ones(2, np.float32), 4)])
+    p = str(tmp_path / "checkpoint-0")
+    with open(p, "wb") as f:
+        f.write(raw)
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError, match="meta"):
+        psck.load_shards([p], meta_dir=str(tmp_path))
+
+
+def test_pserver_shards_merge_verify_md5(tmp_path):
+    a = np.ones(4, np.float32)
+    b = np.full(2, 7.0, np.float32)
+    paths = []
+    for i, params in enumerate([[("w/a", a, 4)], [("w/b", b, 4)]]):
+        p = str(tmp_path / f"checkpoint-{i}")
+        raw = _pserver_shard_bytes(params)
+        with open(p, "wb") as f:
+            f.write(raw)
+        with open(p + ".meta.json", "w") as f:
+            json.dump({"uuid": f"u{i}", "path": p,
+                       "md5": hashlib.md5(raw).hexdigest(),
+                       "timestamp": 0}, f)
+        paths.append(p)
+
+    merged = psck.load_shards(paths, meta_dir=str(tmp_path))
+    np.testing.assert_array_equal(merged["w/a"], a)
+    np.testing.assert_array_equal(merged["w/b"], b)
+
+    # corrupted shard trips the WrongChecksum guard
+    with open(paths[0], "ab") as f:
+        f.write(b"x")
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError, match="md5"):
+        psck.load_shards(paths, meta_dir=str(tmp_path))
+
+
+def test_pserver_checkpoint_into_trainer(tmp_path):
+    """End to end: merged pserver shards initialize a Trainer via the
+    same apply_v1_params path the pass-dir importer uses."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optim
+    from paddle_tpu.models.lenet import model_fn
+    from paddle_tpu.training import Trainer
+    from paddle_tpu.training import checkpoint as ckpt_lib
+
+    rs = np.random.RandomState(0)
+    batch = {"image": rs.randn(8, 784).astype(np.float32),
+             "label": rs.randint(0, 10, 8).astype(np.int32)}
+    t1 = Trainer(model_fn, optim.sgd(0.1))
+    t1.init(batch)
+    t1.train_batch(batch)
+    flat = {k: np.asarray(v)
+            for k, v in nn.flatten_names(t1.params).items()}
+
+    # split parameters across two "pserver" shards, reference-style
+    names = sorted(flat)
+    shards = [names[::2], names[1::2]]
+    paths = []
+    for i, shard_names in enumerate(shards):
+        raw = _pserver_shard_bytes(
+            [(n, flat[n].ravel().astype(np.float32), 4)
+             for n in shard_names])
+        p = str(tmp_path / f"checkpoint-{i}")
+        with open(p, "wb") as f:
+            f.write(raw)
+        paths.append(p)
+
+    merged = psck.load_shards(paths)
+    t2 = Trainer(model_fn, optim.sgd(0.1))
+    t2.init(batch)
+    t2.params = ckpt_lib.apply_v1_params(t2.params, merged)
+    for k, v in nn.flatten_names(t2.params).items():
+        np.testing.assert_allclose(np.asarray(v), flat[k], err_msg=k,
+                                   rtol=1e-6)
+
+
+def test_gob_generic_values():
+    """The decoder is schema-generic: maps, nested slices, floats, bools
+    decode from encoder-built streams."""
+    enc = GobEncoder()
+    t_inner = enc.define_struct("Inner", [("S", STRING), ("N", INT)])
+    t_slice = enc.define_slice("", t_inner)
+    inner = GobEncoder.struct_value(
+        [(0, GobEncoder.bytes_value(b"hi")), (1, encode_int(-7))])
+    enc.top_level(t_slice, encode_uint(2) + inner + inner,
+                  is_struct=False)
+    (val,) = GobDecoder(enc.getvalue()).decode()
+    assert val == [{"S": "hi", "N": -7}] * 2
+
+    # float bit-reversal (value chosen with a non-symmetric pattern)
+    bits = struct.unpack("<Q", struct.pack("<d", -1.25))[0]
+    u = int.from_bytes(bits.to_bytes(8, "little"), "big")
+    stream = (encode_uint(len(encode_int(gob.FLOAT))
+                          + 1 + len(encode_uint(u)))
+              + encode_int(gob.FLOAT) + b"\x00" + encode_uint(u))
+    (f,) = GobDecoder(stream).decode()
+    assert f == -1.25
