@@ -281,9 +281,16 @@ def cmd_merge_model(args):
     from paddle_tpu.training import checkpoint as ckpt_lib
     cfg = _load_config(args.config, args.config_args)
     trees, meta = ckpt_lib.load(args.checkpoint_dir)
-    path = inference.export_model(
-        args.output, trees["params"], trees.get("net_state"),
-        config={"source_checkpoint": args.checkpoint_dir, "meta": meta})
+    if args.format == "v1pass":
+        # export back to the reference's pass-dir layout (the other
+        # direction of --init-model-path)
+        path = ckpt_lib.save_v1_pass_dir(
+            args.output, trees["params"], trees.get("net_state"))
+    else:
+        path = inference.export_model(
+            args.output, trees["params"], trees.get("net_state"),
+            config={"source_checkpoint": args.checkpoint_dir,
+                    "meta": meta})
     print(json.dumps({"exported": path}))
 
 
@@ -356,6 +363,11 @@ def main(argv=None):
     p = sub.add_parser("merge_model", help="export checkpoint for serving")
     common(p)
     p.add_argument("-o", "--output", required=True)
+    p.add_argument("--format", choices=("merged", "v1pass"),
+                   default="merged",
+                   help="'merged' = serving dir (default); 'v1pass' = "
+                        "reference pass-%%05d layout (deploy back onto "
+                        "a reference install)")
     p.set_defaults(fn=cmd_merge_model)
 
     p = sub.add_parser("version")

@@ -27,8 +27,8 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from paddle_tpu.core.errors import enforce
-from paddle_tpu.nn.module import (flatten_names, unescape_name,
-                                  unflatten_names)
+from paddle_tpu.nn.module import (escape_name, flatten_names,
+                                  unescape_name, unflatten_names)
 
 
 def _flatten_trees(trees: Dict[str, Any]) -> Dict[str, np.ndarray]:
@@ -184,6 +184,53 @@ def apply_v1_params(params, loaded: Dict[str, np.ndarray],
                 name, vec.size, leaf_arr.size)
         flat[name] = vec.reshape(leaf_arr.shape).astype(leaf_arr.dtype)
     return unflatten_names(flat)
+
+
+def save_v1_pass_dir(directory: str, params, net_state=None,
+                     name_map: Optional[Dict[str, str]] = None) -> str:
+    """Write parameters (and BN-style state leaves) as a reference
+    ``pass-%05d/``-layout dir — the EXPORT converter of hard-part #5, so
+    models trained here deploy back onto a reference install.  Byte
+    layout per ``Parameter::save`` (16-byte header + raw ``<f4``).
+
+    ``name_map`` (our name -> file name) mirrors the import direction:
+    a reference install looks parameters up by ITS config's names
+    (``_hidden1.w0``, BN stats ``.w1``/``.w2``), so deploying to one
+    requires the mapping; without it, file names are our escaped module
+    paths, which only this framework's importer reads back.
+
+    The target directory must be empty (a re-export over stale files
+    would leave obsolete parameters next to a fresh ``done`` marker,
+    which every reader accepts silently).  Only float leaves export —
+    f32/bf16/f16 convert exactly-or-widening to the format's f32;
+    f64/integer leaves fail loudly rather than silently narrowing.
+    Writes the ``done`` marker last, as ``ParamUtil.cpp:106-112``
+    does."""
+    name_map = name_map or {}
+    if os.path.isdir(directory):
+        enforce(not os.listdir(directory),
+                "save_v1_pass_dir: %s is not empty (stale parameter "
+                "files would survive next to a fresh done marker)",
+                directory)
+    os.makedirs(directory, exist_ok=True)
+    flat = flatten_names(params)
+    if net_state:
+        flat.update(flatten_names(net_state))
+    for name, value in flat.items():
+        arr = np.asarray(value)
+        enforce(arr.dtype.kind == "f" and arr.dtype.itemsize <= 4,
+                "save_v1_pass_dir: leaf %r has dtype %s — the reference "
+                "format is float32-only and narrowing would be silent",
+                name, arr.dtype)
+        vec = arr.astype("<f4").ravel()
+        path = os.path.join(directory,
+                            escape_name(name_map.get(name, name)))
+        with open(path, "wb") as f:
+            f.write(_V1_HEADER.pack(_V1_FORMAT_ORIGINAL, 4, vec.size))
+            f.write(vec.tobytes())
+    with open(os.path.join(directory, "done"), "w") as f:
+        f.write("PaddlePaddle\n")
+    return directory
 
 
 def apply_v1_state(net_state, loaded: Dict[str, np.ndarray],
