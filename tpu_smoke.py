@@ -54,6 +54,28 @@ def main() -> int:
 
     compile_grad("lstm_resident_fwd_bwd", lstm_loss, xw, wh)
 
+    # Resident kernel at the VMEM BOUNDARY shape, both stream dtypes.
+    # Round 2's 4-step unroll silently broke exactly this compile (the
+    # interpret-mode suite cannot see VMEM), so every auto-selected
+    # (shape, dtype, unroll) combination the gate admits at the boundary
+    # must prove itself on real hardware here.
+    hb = 512
+    for dt, tag in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        assert pk.pallas_supported(b, hb, dt)
+        xwb = jnp.asarray(rs.randn(t, b, 4 * hb), dt) * 0.1
+        whb = jnp.asarray(rs.randn(hb, 4 * hb), jnp.float32) * 0.05
+        zb = jnp.zeros((b, hb), jnp.float32)
+
+        def lstm_boundary_loss(xw, wh, _zb=zb):
+            hs, hl, cl = pk.lstm_scan(xw, wh, _zb, _zb, ones,
+                                      use_pallas=True)
+            return (jnp.sum(hs.astype(jnp.float32) ** 2)
+                    + jnp.sum(hl * cl))
+
+        compile_grad(f"lstm_resident_h512_{tag}_u"
+                     f"{pk._lstm_unroll(t, b, hb, dt)}",
+                     lstm_boundary_loss, xwb, whb)
+
     # Tiled LSTM kernel (h=512-class row).
     t2, b2, h2 = 100, 128, 512
     assert pk.lstm_tiled_supported(b2, h2)
