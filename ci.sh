@@ -47,6 +47,21 @@ else
     python -m pytest tests/ -m fast -q
 fi
 
+echo "== multichip dryrun under induced CPU load =="
+# The driver's only multichip signal is dryrun_multichip; round 3 proved it
+# can flake when 8 virtual CPU devices share a loaded host (XLA CPU
+# collective rendezvous timeout).  Gate on the hostile case: run the dryrun
+# WHILE a 4-way busy-loop hog saturates the cores.  Per-stage subprocess
+# isolation + retry inside __graft_entry__.py must absorb the contention.
+HOG_PIDS=()
+for _ in 1 2 3 4; do
+    python -c 'while True: pass' & HOG_PIDS+=($!)
+done
+trap 'kill "${HOG_PIDS[@]}" 2>/dev/null || true' EXIT
+python __graft_entry__.py
+kill "${HOG_PIDS[@]}" 2>/dev/null || true
+trap - EXIT
+
 # Real-TPU compile smoke, only when a chip is attached.
 if python - <<'EOF'
 import sys
