@@ -441,6 +441,27 @@ def sum_to_one_norm(input, name: Optional[str] = None):
     return _node("sum_to_one_norm", run, [input], name=name)
 
 
+def data_norm(input, data_norm_strategy: str = "z-score",
+              name: Optional[str] = None):
+    """Stats-table input normalization (``data_norm`` config-kind twin,
+    ref ``gserver/layers/DataNormLayer.cpp:21``,
+    ``config_parser.py:2014``).  The 5×size static table
+    ``[min; 1/(max-min); mean; 1/std; 1/10^j]`` is a non-trainable
+    STATE buffer at ``<name>/stats`` (the reference enforces a static
+    parameter; a state buffer is the form optimizers and weight decay
+    cannot touch) — build it with ``nn.DataNormTable.compute_table`` in
+    preprocessing or import it from a reference checkpoint via
+    ``checkpoint.apply_v1_state`` with a ``name_map``."""
+    def run(ctx, x, **a):
+        m = _mask(x)
+        y = nn.DataNormTable(strategy=a["strategy"],
+                             name=a["_name"])(_val(x))
+        return (y, m) if m is not None else y
+    n = auto_name("data_norm", name)
+    return _node("data_norm", run, [input], name=n,
+                 strategy=data_norm_strategy, _name=n)
+
+
 def power(input, exponent, name: Optional[str] = None):
     """Per-sample elementwise power: out = x ** e (power_layer twin)."""
     def run(ctx, x, e):

@@ -9,7 +9,8 @@ from paddle_tpu.nn.layers import (Linear, Embedding, Conv2D, Pool2D,
 from paddle_tpu.nn.layers_extra import (
     Conv2DTranspose, Conv3D, Pool3D, SpatialPyramidPool, RowConv, BlockExpand,
     BilinearInterp, Interpolation, Crop, Pad, Rotate, SwitchOrder,
-    FeatureMapExpand, Multiplex, SelectiveFC, DataNorm, SumToOneNorm, Scaling,
+    FeatureMapExpand, Multiplex, SelectiveFC, DataNorm, DataNormTable,
+    SumToOneNorm, Scaling,
     SlopeIntercept, Addto, DotMulProjection, ScalingProjection,
     IdentityProjection, TransposedFullMatrixProjection, Mixed,
     FullMatrixProjection, TableProjection, SliceProjection, ConvProjection,
@@ -25,6 +26,7 @@ __all__ = [
     "Conv2DTranspose", "Conv3D", "Pool3D", "SpatialPyramidPool", "RowConv",
     "BlockExpand", "BilinearInterp", "Interpolation", "Crop", "Pad", "Rotate",
     "SwitchOrder", "FeatureMapExpand", "Multiplex", "SelectiveFC", "DataNorm",
+    "DataNormTable",
     "SumToOneNorm", "Scaling", "SlopeIntercept", "Addto", "DotMulProjection",
     "ScalingProjection", "IdentityProjection",
     "TransposedFullMatrixProjection", "Mixed",

@@ -379,6 +379,64 @@ class DataNorm(Module):
         return x / jnp.power(10.0, digits)
 
 
+class DataNormTable(Module):
+    """Stats-table data normalization — the loadable form of
+    :class:`DataNorm` (twin of ``gserver/layers/DataNormLayer.cpp:84-112``
+    with the 5×size static input parameter of ``config_parser.py``'s
+    ``DataNormLayer``, ref ``:2014``).
+
+    The ``stats`` rows are ``[min, 1/(max-min), mean, 1/std, 1/10^j]``
+    — computed in preprocessing (:meth:`compute_table`) or imported from
+    a reference checkpoint; the default init is the identity transform.
+    The table is *static* (the reference enforces ``isStatic()``), so it
+    lives in the non-trainable STATE collection like BatchNorm's moving
+    statistics — out of reach of optimizers AND the L1/L2 decay
+    transforms, which would silently shrink a stop-gradient parameter
+    every step.  Import from a reference artifact goes through
+    ``checkpoint.apply_v1_state`` with a ``name_map`` (the BN ``.w1``/
+    ``.w2`` route).  The input gradient is the same column scale the
+    reference's ``backward`` applies (``addColScale`` by the reciprocal
+    row).
+    """
+
+    def __init__(self, strategy: str = "z-score",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        enforce_in(strategy, ("z-score", "min-max", "decimal-scaling"))
+        self.strategy = strategy
+
+    def forward(self, x):
+        from paddle_tpu.nn.module import state
+
+        size = x.shape[-1]
+
+        def identity_init(shape, dtype):
+            # reciprocal rows (1/range, 1/std, 1/10^j) default to 1,
+            # offset rows (min, mean) to 0 -> identity transform.
+            return jnp.zeros(shape, dtype).at[jnp.array([1, 3, 4])].set(1.0)
+
+        table = lax.stop_gradient(
+            state("stats", (5, size), jnp.float32, identity_init))
+        if self.strategy == "z-score":
+            return (x - table[2]) * table[3]
+        if self.strategy == "min-max":
+            return (x - table[0]) * table[1]
+        return x * table[4]
+
+    @staticmethod
+    def compute_table(data, eps: float = 1e-8):
+        """Build the 5×size stats table from a [n, size] dataset array —
+        the preprocessing stage the reference delegates to external tools
+        (its config docstring: "calculated in the preprocessing stage,
+        initialized by --init_model_path")."""
+        data = jnp.asarray(data, jnp.float32)
+        mn, mx = data.min(axis=0), data.max(axis=0)
+        mean, std = data.mean(axis=0), data.std(axis=0)
+        j = jnp.ceil(jnp.log10(jnp.maximum(jnp.abs(data).max(axis=0), eps)))
+        return jnp.stack([mn, 1.0 / (mx - mn + eps), mean,
+                          1.0 / (std + eps), jnp.power(10.0, -j)])
+
+
 class SumToOneNorm(Module):
     """Row-normalize to sum 1 (twin of SumToOneNormLayer.cpp)."""
 

@@ -136,6 +136,102 @@ def test_data_norm_and_sum_to_one(rng):
                                rtol=1e-5)
 
 
+def test_data_norm_table_strategies(rng):
+    """DataNormTable: the three reference strategies applied through the
+    loadable 5×size stats table (DataNormLayer.cpp:94-108)."""
+    data = np.asarray(rng.randn(64, 3) * np.array([4.0, 0.5, 40.0]) + 2.0,
+                      np.float32)
+    table = nn.DataNormTable.compute_table(data)
+    assert table.shape == (5, 3)
+    x = jnp.asarray(data[:6])
+
+    def apply(strategy):
+        m = nn.transform(
+            lambda a: nn.DataNormTable(strategy, name="dn")(a))
+        params, st = m.init(jax.random.key(0), x)
+        # static table -> STATE collection, not a parameter
+        assert not params and st["dn"]["stats"].shape == (5, 3)
+        st = {"dn": {"stats": table}}
+        out, _ = m.apply(params, st, None, x)
+        return np.asarray(out), st, m
+
+    y, _, _ = apply("z-score")
+    np.testing.assert_allclose(
+        y, (data[:6] - data.mean(0)) / (data.std(0) + 1e-8), rtol=1e-4)
+    y, _, _ = apply("min-max")
+    np.testing.assert_allclose(
+        y, (data[:6] - data.min(0)) / (data.max(0) - data.min(0) + 1e-8),
+        rtol=1e-4)
+    y, _, _ = apply("decimal-scaling")
+    assert np.abs(y).max() <= 1.0 + 1e-6
+
+    # Input gradient is the same column scale the reference backward
+    # applies (addColScale by 1/std); the table itself, being state,
+    # is not a grad target at all.
+    _, st, m = apply("z-score")
+    g = jax.grad(lambda a: jnp.sum(m.apply({}, st, None, a)[0]))(x)
+    np.testing.assert_allclose(
+        np.asarray(g), np.broadcast_to(np.asarray(table[3]), (6, 3)),
+        rtol=1e-5)
+
+
+def test_data_norm_table_immune_to_weight_decay(rng):
+    """Regression (round-4 review): the static table must survive
+    training under L1/L2 regularization — a stop-gradient PARAMETER
+    would be decayed by rate*p every step regardless of its zero
+    gradient (the reference enforces isStatic() for exactly this)."""
+    from paddle_tpu import optim
+    from paddle_tpu.training import Trainer
+
+    def model_fn(batch):
+        h = nn.DataNormTable("z-score", name="dn")(batch["x"])
+        logits = nn.Linear(2, name="fc")(h)
+        loss = jnp.mean((logits - batch["y"]) ** 2)
+        return loss, {}
+
+    tr = Trainer(model_fn, optim.from_config(optim.OptimizationConfig(
+        learning_rate=0.1, learning_method="momentum", momentum=0.9,
+        l2_rate=0.01)))
+    batch = {"x": np.asarray(rng.randn(8, 3), np.float32),
+             "y": np.asarray(rng.randn(8, 2), np.float32)}
+    tr.init(batch)
+    table = nn.DataNormTable.compute_table(
+        np.asarray(rng.randn(32, 3), np.float32))
+    tr.net_state = {**tr.net_state, "dn": {"stats": jnp.asarray(table)}}
+    before = np.asarray(table).copy()
+    for _ in range(5):
+        tr.train_batch(batch)
+    np.testing.assert_array_equal(
+        np.asarray(tr.net_state["dn"]["stats"]), before)
+
+
+def test_data_norm_table_default_is_identity(rng):
+    x = jnp.asarray(rng.randn(4, 5), jnp.float32)
+    for strategy in ("z-score", "min-max", "decimal-scaling"):
+        m = nn.transform(lambda a: nn.DataNormTable(strategy,
+                                                    name="dn")(a))
+        params, st = m.init(jax.random.key(0), x)
+        out, _ = m.apply(params, st, None, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_data_norm_api_layer(rng):
+    """api.layer.data_norm compiles through the graph path and reads
+    batch input like any v1 config kind."""
+    from paddle_tpu.api import layer as L
+    from paddle_tpu.api.graph import compile_model
+
+    node = L.data_norm(L.data("x"), data_norm_strategy="z-score",
+                       name="dn")
+    model_fn = compile_model(node)
+    x = np.asarray(rng.randn(4, 3), np.float32)
+    m = nn.transform(lambda b: model_fn(b))
+    params, st = m.init(jax.random.key(0), {"x": x})
+    assert st["dn"]["stats"].shape == (5, 3)
+    (out, _), _ = m.apply(params, st, None, {"x": x})
+    np.testing.assert_allclose(np.asarray(out), x)  # identity default
+
+
 def test_mixed_projections_gradcheck(rng):
     x1 = jnp.asarray(rng.randn(3, 6), jnp.float32)
     x2 = jnp.asarray(rng.randn(3, 6), jnp.float32)

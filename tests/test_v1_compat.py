@@ -615,7 +615,8 @@ def test_config_layer_kind_coverage():
         "crf_decoding": "crf_decoding_layer", "crop": "crop_layer",
         "cross_entropy_over_beam": "cross_entropy_over_beam",
         "ctc": "ctc_layer", "cudnn_conv": "img_conv_layer",
-        "data": "data_layer", "deconv3d": "img_conv3d_layer",
+        "data": "data_layer", "data_norm": "data_norm_layer",
+        "deconv3d": "img_conv3d_layer",
         "detection_output": "detection_output_layer",
         "eos_id": "eos_layer", "exconv": "img_conv_layer",
         "exconvt": "img_conv_layer", "expand": "expand_layer",
@@ -666,7 +667,6 @@ def test_config_layer_kind_coverage():
         "mkldnn_conv", "mkldnn_fc", "mkldnn_pool",   # CPU-vendor backend
         "cudnn_convt",                                # vendor transpose-conv
         "mdlstmemory",                                # multi-dim LSTM
-        "data_norm",                                  # stats-table norm
     }
 
     missing = []
