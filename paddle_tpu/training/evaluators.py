@@ -5,7 +5,8 @@ start/evalImp/updateSamplesNum/finish, ``Evaluator.h:42``; zoo at
 ``Evaluator.cpp:172-1346``): an evaluator accumulates sufficient statistics
 over batches and reports at pass end.  The ``distributeEval`` merge of the
 reference maps to summing the statistic pytrees across hosts (they are all
-sums, so a psum/allreduce merges them — done by the caller when needed).
+sums, so one all-gather + sum merges them — ``distribute_eval`` below,
+wired into ``Trainer.test(distributed=True)``).
 
 Evaluators consume a dict of batch outputs (device arrays ok) — keys are
 chosen by the model ("logits", "label", "weight", ...).
@@ -13,13 +14,23 @@ chosen by the model ("logits", "label", "weight", ...).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
+
+from paddle_tpu.core.errors import enforce
 
 
 class Evaluator:
     name = "evaluator"
+
+    #: Names of the instance attributes holding the sufficient
+    #: statistics between ``start()`` and ``finish()``.  Every declared
+    #: statistic is a SUM over samples, so summing them across workers
+    #: is the cross-trainer merge (``distributeEval``, Evaluator.h:42).
+    #: Evaluators whose state is not a sum (printers, detection mAP's
+    #: per-image match lists) leave this empty and stay local.
+    STATS: Tuple[str, ...] = ()
 
     def start(self) -> None:
         raise NotImplementedError
@@ -30,9 +41,71 @@ class Evaluator:
     def finish(self) -> float:
         raise NotImplementedError
 
+    def partials(self) -> Dict[str, np.ndarray]:
+        """The ``STATS`` attributes as float64 arrays — the unit
+        ``distribute_eval`` sums across processes."""
+        out = {}
+        for k in self.STATS:
+            v = getattr(self, k)
+            enforce(v is not None,
+                    "evaluator %s: statistic %r is unset — distributed "
+                    "merge needs at least one update() on every "
+                    "process", self.name, k)
+            out[k] = np.asarray(v, np.float64)
+        return out
+
+    def set_partials(self, merged: Dict[str, np.ndarray]) -> None:
+        for k in self.STATS:
+            v = merged[k]
+            setattr(self, k,
+                    float(v) if np.ndim(v) == 0 else np.asarray(v))
+
+
+def allgather_sum_f64(tree):
+    """Sum a pytree of float64 arrays across all JAX processes without
+    precision loss: x32-mode JAX downcasts float64 transfers to float32,
+    so values travel as uint32 bit-pattern views and are reassembled
+    before the float64 sum."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    wire = [np.ascontiguousarray(
+        np.atleast_1d(np.asarray(leaf, np.float64))).view(np.uint32)
+        for leaf in leaves]
+    gathered = multihost_utils.process_allgather(wire)
+    out = []
+    for leaf, g in zip(leaves, gathered):
+        f = np.ascontiguousarray(np.asarray(g, np.uint32)).view(np.float64)
+        s = f.sum(axis=0)                    # (nproc, n) -> (n,)
+        out.append(float(s[0]) if np.ndim(leaf) == 0 else s)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def distribute_eval(evaluators: Sequence["Evaluator"]) -> None:
+    """Merge evaluator statistics across all JAX processes — the twin of
+    the reference's ``distributeEval`` (``Evaluator.h:42``, merged
+    through ParameterClient2 in ``Evaluator.cpp:172``); here the stats
+    are sums, so ONE host all-gather + sum replaces the pserver
+    round-trip.  Collective: every process must call it with the same
+    evaluator list, after its update() loop and before finish().
+    Evaluators with empty ``STATS`` are left local."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    mergeable = [e for e in evaluators if e.STATS]
+    if not mergeable:
+        return
+    merged = allgather_sum_f64([e.partials() for e in mergeable])
+    for e, g in zip(mergeable, merged):
+        e.set_partials(g)
+
 
 class ClassificationError(Evaluator):
     """Twin of ClassificationErrorEvaluator (Evaluator.cpp:172)."""
+
+    STATS = ("wrong", "total")
 
     def __init__(self, logits_key: str = "logits", label_key: str = "label",
                  name: str = "classification_error"):
@@ -65,6 +138,8 @@ class ClassificationError(Evaluator):
 class ValueSum(Evaluator):
     """Twin of SumEvaluator / column_sum (Evaluator.cpp:225-330)."""
 
+    STATS = ("total", "count")
+
     def __init__(self, key: str, name: Optional[str] = None,
                  average: bool = False):
         self.key = key
@@ -87,6 +162,8 @@ class ValueSum(Evaluator):
 class PrecisionRecall(Evaluator):
     """Binary/multiclass positive-class P/R/F1
     (twin of PrecisionRecallEvaluator, Evaluator.cpp:580)."""
+
+    STATS = ("tp", "fp", "fn")
 
     def __init__(self, logits_key: str = "logits", label_key: str = "label",
                  positive_class: int = 1, name: str = "precision_recall"):
@@ -119,6 +196,8 @@ class PrecisionRecall(Evaluator):
 class AUC(Evaluator):
     """Streaming ROC-AUC via score histogram
     (twin of RankAucEvaluator / AucEvaluator, Evaluator.cpp:334-570)."""
+
+    STATS = ("pos", "neg")
 
     def __init__(self, score_key: str = "prob", label_key: str = "label",
                  num_bins: int = 4096, name: str = "auc"):
@@ -158,6 +237,8 @@ class ChunkEvaluator(Evaluator):
     nonzero... configurable) — to stay scheme-agnostic, callers pass a
     ``decode_chunks(tags) -> set[(start, end, type)]`` function.
     """
+
+    STATS = ("correct", "n_pred", "n_label")
 
     def __init__(self, pred_key: str, label_key: str, decode_chunks,
                  mask_key: Optional[str] = None, name: str = "chunk_f1"):
@@ -223,14 +304,24 @@ def iob_decode(tags):
 
 class ColumnSum(Evaluator):
     """Per-column sums of an output matrix (twin of ColumnSumEvaluator,
-    ``Evaluator.cpp:225``)."""
+    ``Evaluator.cpp:225``).
 
-    def __init__(self, key: str, name: Optional[str] = None):
+    The column count is lazy (first update) by default, which means an
+    EMPTY data shard has no stats to contribute — so the evaluator only
+    participates in the distributed merge when ``size`` is given (then a
+    zero-batch process contributes zeros instead of desynchronizing the
+    collective)."""
+
+    def __init__(self, key: str, name: Optional[str] = None,
+                 size: Optional[int] = None):
         self.key = key
         self.name = name or f"column_sum({key})"
+        self.size = size
+        self.STATS = ("total",) if size is not None else ()
 
     def start(self):
-        self.total = None
+        self.total = (np.zeros(self.size, np.float64)
+                      if self.size is not None else None)
 
     def update(self, outputs):
         v = np.asarray(outputs[self.key], np.float64)
@@ -246,6 +337,8 @@ class CTCError(Evaluator):
     """Sequence edit-distance rate (twin of CTCErrorEvaluator.cpp):
     sum(editdist(pred, label)) / sum(len(label)) over greedy-decoded,
     blank/dup-collapsed predictions."""
+
+    STATS = ("dist", "len")
 
     def __init__(self, pred_key: str = "decoded", label_key: str = "label",
                  pred_len_key: Optional[str] = None,
@@ -466,6 +559,8 @@ class RankAUC(Evaluator):
     and the sequence mask ``score_key + "_mask"`` (or ``mask_key``);
     ``pv_key`` defaults to 1 per position like the reference's filled
     pv vector."""
+
+    STATS = ("total", "sequences")
 
     def __init__(self, score_key: str = "score", click_key: str = "click",
                  pv_key: Optional[str] = None,

@@ -388,12 +388,20 @@ class Trainer:
             handler(ev.EndPass(pass_id, results))
         return results
 
-    def test(self, reader, evaluators: Sequence[Evaluator] = ()):
+    def test(self, reader, evaluators: Sequence[Evaluator] = (),
+             distributed: bool = False):
         """One evaluation pass (Tester::testOnePeriod twin).
 
         Without evaluators (nothing consumes per-batch outputs on the
         host) the per-batch ``float(loss)`` syncs defer to the end of
         the pass — losses accumulate as device values and transfer once.
+
+        ``distributed=True`` merges each evaluator's statistics AND the
+        test cost across all JAX processes before ``finish()`` — the
+        reference's ``distributeEval`` (``Evaluator.h:42``) without the
+        pserver round-trip.  It is collective: every process must call
+        ``test`` with the same evaluator list, each feeding its own
+        shard of the eval data.
         """
         for e in evaluators:
             e.start()
@@ -411,6 +419,16 @@ class Trainer:
         has_losses = bool(losses)
         if has_losses and not evaluators:
             losses = np.asarray(jnp.stack(losses))   # ONE host transfer
+        if distributed and jax.process_count() > 1:
+            from paddle_tpu.training.evaluators import (allgather_sum_f64,
+                                                        distribute_eval)
+            distribute_eval(evaluators)
+            total, count = allgather_sum_f64(np.asarray(
+                [float(np.sum(np.asarray(losses, np.float64)))
+                 if has_losses else 0.0, float(len(losses))], np.float64))
+            results = {f"test_{e.name}": e.finish() for e in evaluators}
+            results["test_cost"] = (float(total / count) if count else 0.0)
+            return results
         results = {f"test_{e.name}": e.finish() for e in evaluators}
         # float64 mean on both paths (the evaluator path averages Python
         # floats, which numpy accumulates in float64)

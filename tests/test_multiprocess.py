@@ -144,3 +144,12 @@ def test_master_fed_multiprocess_training(tmp_path):
     assert np.isfinite(w_avg).all()
     per_trainer = np.load(os.path.join(ckpt, "master_counts.npy"))
     assert per_trainer.sum() == 32 and (per_trainer > 0).all(), per_trainer
+
+
+@pytest.mark.slow
+def test_two_process_distributed_evaluator_merge(tmp_path):
+    """Trainer.test(distributed=True): merged evaluator metrics across 2
+    OS processes (each evaluating its shard) must equal the
+    single-process metrics over the full stream — the distributeEval
+    contract (ref Evaluator.h:42).  Assertions live in the worker."""
+    _run_generation("disteval", str(tmp_path), _free_port())
