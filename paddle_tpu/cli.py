@@ -79,7 +79,13 @@ def cmd_train(args):
         # launch of a preemptible job, when --resume finds nothing yet)
         # init values come from the v1 pass dir (shapes come from the
         # config via a sample batch).
-        trainer.init(next(iter(cfg.train_reader())))
+        from paddle_tpu.core.errors import enforce
+        first = next(iter(cfg.train_reader()), None)
+        enforce(first is not None,
+                "--init-model-path needs one batch from the config's "
+                "train_reader to shape-init the model, but it yielded "
+                "none (empty train data source?)")
+        trainer.init(first)
         trainer.load_v1_params(args.init_model_path)
     if args.checkpoint_dir:
         from paddle_tpu.training.aux import PreemptionHandler
@@ -241,7 +247,8 @@ def cmd_master(args):
     restored = bool(args.snapshot and os.path.exists(args.snapshot))
     master = Master(timeout_s=args.task_timeout,
                     max_failures=args.max_failures,
-                    snapshot_path=args.snapshot)
+                    snapshot_path=args.snapshot,
+                    snapshot_every=args.snapshot_every)
     if restored:
         print(json.dumps({"restored": args.snapshot}), flush=True)
     elif args.files:
@@ -357,7 +364,13 @@ def main(argv=None):
     p.add_argument("--task-timeout", type=float, default=60.0)
     p.add_argument("--max-failures", type=int, default=3)
     p.add_argument("--snapshot", default=None,
-                   help="snapshot file for crash recovery")
+                   help="snapshot file for crash recovery (put it on a "
+                        "shared filesystem so a restarted master on "
+                        "another host recovers, like the reference's "
+                        "etcd store)")
+    p.add_argument("--snapshot-every", type=int, default=32,
+                   help="snapshot after this many task acks (1 = per ack, "
+                        "the reference's per-state-change etcd cadence)")
     p.set_defaults(fn=cmd_master)
 
     p = sub.add_parser("merge_model", help="export checkpoint for serving")

@@ -184,15 +184,22 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.flush()
 
 
+class _ReuseAddrTCPServer(socketserver.ThreadingTCPServer):
+    # SO_REUSEADDR: a crashed master must be restartable on its
+    # advertised port immediately (clients reconnect by address), not
+    # after the kernel's TIME_WAIT on the old connections drains.
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class MasterServer:
     """TCP JSON-lines service around a :class:`Master` (coordinator side)."""
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
                  port: int = 0):
         self.master = master
-        self._srv = socketserver.ThreadingTCPServer(
+        self._srv = _ReuseAddrTCPServer(
             (host, port), _Handler, bind_and_activate=True)
-        self._srv.daemon_threads = True
         self._srv.master = master  # type: ignore[attr-defined]
         self.address = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,
