@@ -270,7 +270,9 @@ def _slot_value(slot: SlotDef, slot_idx: int, num_vec: int,
     if slot.type == VAR_MDIM_INDEX:
         return sample["var_id_slots"][slot_idx - num_vec]["ids"]
     if slot.type == STRING:
-        return sample["vector_slots"][slot_idx]["strs"][0]
+        vs = sample["vector_slots"][slot_idx]
+        enforce(vs["strs"], "string slot %d: sample has no strs", slot_idx)
+        return vs["strs"][0]
     raise ValueError(f"unsupported slot type {slot.type}")
 
 

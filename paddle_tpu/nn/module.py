@@ -364,9 +364,11 @@ def escape_name(name: str) -> str:
     """Parameter path -> file/tar-member-safe name.  Our names are module
     paths ('fc_0/w'); '/' cannot appear in a file name, so artifact
     writers (Parameters.to_tar, v1 pass dirs) escape with this shared
-    convention and loaders invert with :func:`unescape_name`."""
-    return name.replace("/", "%2F")
+    convention and loaders invert with :func:`unescape_name`.  '%' is
+    escaped first so the mapping is injective: a name containing a
+    literal '%2F' round-trips instead of unescaping to a bogus '/'."""
+    return name.replace("%", "%25").replace("/", "%2F")
 
 
 def unescape_name(name: str) -> str:
-    return name.replace("%2F", "/")
+    return name.replace("%2F", "/").replace("%25", "%")

@@ -115,7 +115,16 @@ _V1_FORMAT_ORIGINAL = 0
 _V1_FORMAT_MKLDNN_OI = 1  # OI-major weight layout — rejected, see below
 
 
-def load_v1_pass_dir(directory: str) -> Dict[str, np.ndarray]:
+class V1PassDir(dict):
+    """``name -> flat <f4 vector`` mapping read from a pass dir, plus the
+    set of file names header validation rejected (``skipped``).  The
+    appliers consult ``skipped`` so a truncated/corrupted parameter file
+    is reported as corruption, not as an absent parameter."""
+
+    skipped: frozenset = frozenset()
+
+
+def load_v1_pass_dir(directory: str) -> "V1PassDir":
     """Read every parameter file of a reference ``pass-%05d/`` dir into a
     flat ``name -> 1-D float32 array`` dict.
 
@@ -123,16 +132,20 @@ def load_v1_pass_dir(directory: str) -> Dict[str, np.ndarray]:
     recognized and skipped by header validation: a parameter file's
     declared payload size must exactly account for the bytes after the
     header (``Parameter.cpp:343-357`` checks the same invariants on
-    load)."""
+    load).  Skipped names are collected on the result's ``skipped`` set —
+    a corrupted parameter file fails validation the same way the markers
+    do, and only the caller knows which names the model expects."""
     enforce(os.path.isdir(directory),
             "load_v1_pass_dir: %s is not a directory", directory)
-    out: Dict[str, np.ndarray] = {}
+    out = V1PassDir()
+    skipped = set()
     for fn in sorted(os.listdir(directory)):
         path = os.path.join(directory, fn)
         if not os.path.isfile(path):
             continue
         size = os.path.getsize(path)
         if size < _V1_HEADER.size:
+            skipped.add(unescape_name(fn))
             continue
         with open(path, "rb") as f:
             fmt, value_size, count = _V1_HEADER.unpack(
@@ -140,6 +153,7 @@ def load_v1_pass_dir(directory: str) -> Dict[str, np.ndarray]:
             if (fmt not in (_V1_FORMAT_ORIGINAL, _V1_FORMAT_MKLDNN_OI)
                     or value_size != 4
                     or _V1_HEADER.size + 4 * count != size):
+                skipped.add(unescape_name(fn))
                 continue  # done marker / config copy / foreign file
             # MKLDNN_OI stores fc weights output-major; loading the raw
             # vector would silently transpose every matrix.  The MKLDNN
@@ -156,6 +170,7 @@ def load_v1_pass_dir(directory: str) -> Dict[str, np.ndarray]:
                 f.read(4 * count), "<f4").copy()
     enforce(out, "load_v1_pass_dir: no reference-format parameter files "
             "in %s", directory)
+    out.skipped = frozenset(skipped)
     return out
 
 
@@ -171,8 +186,13 @@ def apply_v1_params(params, loaded: Dict[str, np.ndarray],
     with this framework's module paths."""
     name_map = name_map or {}
     flat = flatten_names(params)
+    skipped = getattr(loaded, "skipped", frozenset())
     for name, leaf in flat.items():
         key = name_map.get(name, name)
+        enforce(key not in skipped or key in loaded,
+                "v1 parameter file %r exists but failed header "
+                "validation (truncated or corrupted; Parameter.cpp:343 "
+                "invariants)", key)
         enforce(key in loaded,
                 "v1 pass dir is missing parameter %r (reference "
                 "load_missing_parameter_strategy=fail; have %s)",
@@ -249,9 +269,16 @@ def apply_v1_state(net_state, loaded: Dict[str, np.ndarray],
     flat = flatten_names(net_state) if net_state else {}
     matched = 0
     missing = []
+    skipped = getattr(loaded, "skipped", frozenset())
     for name, leaf in flat.items():
         key = name_map.get(name, name)
         if key not in loaded:
+            # A file of this exact name that failed header validation is
+            # corruption, not absence — fresh-initing moving statistics
+            # from it would silently change eval numbers.
+            enforce(key not in skipped,
+                    "v1 state file %r exists but failed header "
+                    "validation (truncated or corrupted)", key)
             missing.append(name)
             continue
         leaf_arr = np.asarray(leaf)

@@ -217,3 +217,70 @@ def test_misspelled_provider_obj_reports_config_error():
         "train_list": "x", "test_list": None}}
     with pytest.raises(ConfigError, match="no_such_process_fn"):
         _check_data_declarations(None, rec)
+
+
+# ---- round-4 advisor findings ---------------------------------------------
+
+def test_escape_name_is_injective():
+    """A name containing a literal '%2F' (or bare '%') must round-trip —
+    the old single-replacement escape collapsed it onto a '/' name."""
+    from paddle_tpu.nn.module import escape_name, unescape_name
+
+    for name in ["fc_0/w", "odd%2Fname", "pct%", "%25", "a%2Fb/c",
+                 "%%2F", "plain"]:
+        esc = escape_name(name)
+        assert "/" not in esc
+        assert unescape_name(esc) == name, (name, esc)
+    # distinct names stay distinct through escaping
+    assert escape_name("a/b") != escape_name("a%2Fb")
+
+
+def test_v1_pass_dir_corruption_reported_as_corruption(tmp_path):
+    """A truncated parameter file fails header validation like the done
+    marker does; the applier must call it corruption, not absence."""
+    import struct
+
+    from paddle_tpu.core.errors import EnforceError
+
+    d = tmp_path / "pass-00000"
+    d.mkdir()
+    good = np.arange(6, dtype="<f4")
+    (d / "ok.w0").write_bytes(
+        struct.pack("<iIQ", 0, 4, 6) + good.tobytes())
+    # truncated: header promises 8 floats, payload holds 2
+    (d / "bad.w0").write_bytes(
+        struct.pack("<iIQ", 0, 4, 8) + good[:2].tobytes())
+    (d / "done").write_bytes(b"")
+    loaded = ckpt.load_v1_pass_dir(str(d))
+    assert set(loaded) == {"ok.w0"}
+    assert "bad.w0" in loaded.skipped and "done" in loaded.skipped
+
+    params = {"ok.w0": np.zeros((2, 3), np.float32),
+              "bad.w0": np.zeros((8,), np.float32)}
+    with pytest.raises(EnforceError, match="corrupt"):
+        ckpt.apply_v1_params(params, loaded)
+    with pytest.raises(EnforceError, match="corrupt"):
+        ckpt.apply_v1_state({"bad.w0": np.zeros(8, np.float32)}, loaded)
+    # genuinely absent stays the missing-parameter error
+    with pytest.raises(EnforceError, match="missing"):
+        ckpt.apply_v1_params({"ghost.w0": np.zeros(3, np.float32)}, loaded)
+
+
+def test_cli_train_init_model_path_empty_reader_message(tmp_path):
+    """--init-model-path with an empty train_reader must explain itself,
+    not raise a bare StopIteration."""
+    from paddle_tpu import cli
+    from paddle_tpu.core.errors import EnforceError
+
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "import jax.numpy as jnp\n"
+        "def model_fn(batch):\n"
+        "    return jnp.asarray(0.0), {}\n"
+        "from paddle_tpu import optim\n"
+        "optimizer = optim.sgd(0.1)\n"
+        "def train_reader():\n"
+        "    return iter(())\n")
+    with pytest.raises(EnforceError, match="train_reader"):
+        cli.main(["train", "--config", str(cfg),
+                  "--init-model-path", str(tmp_path), "--num-passes", "1"])
