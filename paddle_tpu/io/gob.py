@@ -19,9 +19,13 @@ reference build still decode.
 
 Validation: scalar encodings are pinned against the byte examples in
 the gob specification; the full checkpoint path round-trips through the
-encoder here.  (No Go toolchain exists in this build environment, so a
-cross-implementation fixture could not be generated — the codec is
-spec-derived, and the spec's own byte vectors are the external anchor.)
+encoder here.  No Go toolchain exists in this build environment, so in
+addition to the spec's byte vectors the test suite pins a HAND-ASSEMBLED
+stream replicating Go's exact emission for ``[]parameterCheckpoint``
+(outermost-first descriptors, bottom-up type ids, zero-field omission,
+singleton framing — byte provenance documented in
+``tests/test_gob_pserver.py``), plus truncated/corrupt streams that must
+fail with clean errors.
 """
 
 from __future__ import annotations
@@ -56,11 +60,13 @@ def encode_uint(n: int) -> bytes:
 
 
 def decode_uint(buf: memoryview, i: int) -> Tuple[int, int]:
+    enforce(i < len(buf), "gob: truncated stream (uint expected)")
     b = buf[i]
     if b < 128:
         return b, i + 1
     n = 256 - b
     enforce(0 < n <= 8, "gob: bad uint count byte %d", b)
+    enforce(i + 1 + n <= len(buf), "gob: truncated %d-byte uint", n)
     return int.from_bytes(bytes(buf[i + 1:i + 1 + n]), "big"), i + 1 + n
 
 
@@ -122,6 +128,7 @@ class GobDecoder:
             prev += delta
             if prev == 1:       # Name string
                 ln, i = decode_uint(buf, i)
+                enforce(i + ln <= len(buf), "gob: truncated type name")
                 name = bytes(buf[i:i + ln]).decode()
                 i += ln
             elif prev == 2:     # Id int
@@ -230,6 +237,8 @@ class GobDecoder:
                         fprev += fd
                         if fprev == 1:
                             ln, i = decode_uint(buf, i)
+                            enforce(i + ln <= len(buf),
+                                    "gob: truncated field name")
                             fname = bytes(buf[i:i + ln]).decode()
                             i += ln
                         elif fprev == 2:
@@ -257,6 +266,9 @@ class GobDecoder:
             return _s.unpack("<d", u.to_bytes(8, "big"))[0], i
         if tid in (BYTES, STRING):
             n, i = decode_uint(buf, i)
+            enforce(i + n <= len(buf),
+                    "gob: %s length %d overruns its message",
+                    "bytes" if tid == BYTES else "string", n)
             raw = bytes(buf[i:i + n])
             return (raw if tid == BYTES else raw.decode()), i + n
         t = self.types.get(tid)
@@ -333,9 +345,18 @@ class GobEncoder:
         self.out.write(encode_uint(len(payload)) + payload)
 
     def _common(self, name: str, tid: int) -> bytes:
-        return (encode_uint(1) + encode_uint(len(name))
-                + name.encode() + encode_uint(1) + encode_int(tid)
-                + encode_uint(0))
+        """CommonType{Name string, Id typeId}.  Go's gob omits
+        zero-valued fields, so an UNNAMED type (e.g. the top-level
+        ``[]parameterCheckpoint`` slice) skips the Name field and the Id
+        arrives with delta 2 — matching Go's emission byte for byte."""
+        out = b""
+        prev = -1
+        if name:
+            out += (encode_uint(0 - prev) + encode_uint(len(name))
+                    + name.encode())
+            prev = 0
+        out += encode_uint(1 - prev) + encode_int(tid)
+        return out + encode_uint(0)
 
     def define_struct(self, name: str,
                       fields: List[Tuple[str, int]]) -> int:

@@ -260,3 +260,189 @@ def test_gob_generic_values():
               + encode_int(gob.FLOAT) + b"\x00" + encode_uint(u))
     (f,) = GobDecoder(stream).decode()
     assert f == -1.25
+
+
+# ---------------------------------------------------------------------------
+# Round-4 adversarial fixtures: a hand-assembled byte stream replicating
+# EXACTLY what Go's encoding/gob emits for the reference's
+# encoder.Encode(cp) with cp := []parameterCheckpoint{...}
+# (go/pserver/service.go:277-295), plus corrupt/truncated streams that
+# must raise clean errors.
+#
+# Provenance of every byte (Go encoding/gob, encode.go/type.go):
+#  * type-id assignment is bottom-up in newTypeObject: the slice's elem
+#    registers first, structs pre-register before their fields
+#    (recursion support) -> parameterCheckpoint=65, ParameterWithConfig=66,
+#    Parameter=67, []parameterCheckpoint=68.
+#  * descriptor EMISSION is outermost-first: sendActualType writes the
+#    (-id, wireType) message, THEN recurses into component types
+#    -> order on the wire: -68, -65, -66, -67.
+#  * the unnamed slice's CommonType omits the zero Name (gob omits
+#    zero-valued fields), so Id arrives with delta 2.
+#  * `type ElementType int` maps onto the predefined INT id 2 (named
+#    types over predeclared kinds get no descriptor); string=6, []byte=5.
+#  * a non-struct top-level value is framed as a singleton: type id,
+#    then a mandatory 0 delta (decodeSingle errors on non-zero).
+#  * embedded ParameterWithConfig travels as a regular field named by
+#    its type (gob does not flatten embedding).
+#  * signed ints: v<<1 (complement for negatives); 65->"ff82",
+#    66->"ff84", 67->"ff86", 68->"ff88", -65->"ff81", -68->"ff87",
+#    string id 6->"0c", []byte id 5->"0a", int id 2->"04".
+
+def _msg(payload_hex: str) -> bytes:
+    payload = bytes.fromhex(payload_hex.replace(" ", ""))
+    return encode_uint(len(payload)) + payload
+
+
+_GO_CHECKPOINT_STREAM = (
+    # M1: descriptor for the unnamed []parameterCheckpoint, id 68
+    _msg("ff87"            # type id -68
+         "02"              # wireType field 1 (SliceT): delta 2 from -1
+         "01"              #   sliceType field 0 (CommonType)
+         "02" "ff88"       #     Name omitted (zero) -> Id 68 at delta 2
+         "00"              #     end CommonType
+         "01" "ff82"       #   sliceType field 1: Elem = 65
+         "00"              #   end sliceType
+         "00")             # end wireType
+    # M2: descriptor for parameterCheckpoint, id 65
+    + _msg("ff81"          # type id -65
+           "03"            # wireType field 2 (StructT): delta 3
+           "01"            #   structType field 0 (CommonType)
+           "01" "13" + "parameterCheckpoint".encode().hex() +
+           "01" "ff82"     #     Id 65
+           "00"
+           "01"            #   structType field 1: Field []fieldType
+           "02"            #     2 fields
+           "01" "13" + "ParameterWithConfig".encode().hex() +
+           "01" "ff84" "00"  # {"ParameterWithConfig", 66}
+           "01" "05" + "State".encode().hex() +
+           "01" "0a" "00"  # {"State", []byte=5}
+           "00"            #   end structType
+           "00")           # end wireType
+    # M3: descriptor for ParameterWithConfig, id 66
+    + _msg("ff83"
+           "03"
+           "01"
+           "01" "13" + "ParameterWithConfig".encode().hex() +
+           "01" "ff84"
+           "00"
+           "01" "02"
+           "01" "05" + "Param".encode().hex() + "01" "ff86" "00"
+           "01" "06" + "Config".encode().hex() + "01" "0a" "00"
+           "00" "00")
+    # M4: descriptor for Parameter, id 67
+    + _msg("ff85"
+           "03"
+           "01"
+           "01" "09" + "Parameter".encode().hex() + "01" "ff86"
+           "00"
+           "01" "03"
+           "01" "04" + "Name".encode().hex() + "01" "0c" "00"
+           "01" "0b" + "ElementType".encode().hex() + "01" "04" "00"
+           "01" "07" + "Content".encode().hex() + "01" "0a" "00"
+           "00" "00")
+    # M5: the value — []parameterCheckpoint{
+    #   {PWC{Param{"w0", Float32, [1.5,-2.0]}, "cfg"}, State:"st"},
+    #   {PWC{Param{"b0", Int32(zero, omitted), int32[7]}, Config zero},
+    #    State zero}}
+    + _msg("ff88"          # type id 68
+           "00"            # singleton delta (must be 0)
+           "02"            # slice length 2
+           # element 1: parameterCheckpoint struct
+           "01"            #  field 0 ParameterWithConfig
+           "01"            #   field 0 Param
+           "01" "02" + "w0".encode().hex() +      # Name "w0"
+           "01" "08"       #    ElementType = Float32 (4) -> int 4<<1
+           "01" "08" "0000c03f" "000000c0"  # Content = <f4 [1.5, -2.0]
+           "00"            #   end Param
+           "01" "03" + "cfg".encode().hex() +     # Config "cfg"
+           "00"            #  end ParameterWithConfig
+           "01" "02" + "st".encode().hex() +      # State "st"
+           "00"            # end element 1
+           # element 2: zero ElementType/Config/State omitted
+           "01"            #  field 0 ParameterWithConfig
+           "01"            #   field 0 Param
+           "01" "02" + "b0".encode().hex() +      # Name "b0"
+           "02" "04" "07000000"  # Content (delta 2 skips ElementType)
+           "00"            #   end Param
+           "00"            #  end ParameterWithConfig (Config omitted)
+           "00")           # end element 2 (State omitted)
+)
+
+
+def test_go_emission_order_checkpoint_stream_decodes():
+    """The decoder must accept Go's actual emission: outermost-first
+    descriptors (forward references), unnamed slice CommonType, omitted
+    zero fields, singleton 0-delta framing."""
+    (records,) = GobDecoder(_GO_CHECKPOINT_STREAM).decode()
+    assert len(records) == 2
+    r1, r2 = records
+    assert r1["ParameterWithConfig"]["Param"]["Name"] == "w0"
+    assert r1["ParameterWithConfig"]["Param"]["ElementType"] == 4
+    assert r1["ParameterWithConfig"]["Config"] == b"cfg"
+    assert r1["State"] == b"st"
+    assert r2["ParameterWithConfig"]["Param"]["Name"] == "b0"
+    assert "ElementType" not in r2["ParameterWithConfig"]["Param"]
+    assert "Config" not in r2["ParameterWithConfig"]
+    np.testing.assert_allclose(
+        np.frombuffer(r1["ParameterWithConfig"]["Param"]["Content"],
+                      "<f4"), [1.5, -2.0])
+
+
+def test_go_emission_stream_through_shard_reader(tmp_path):
+    """End to end through load_shard: dtypes resolved, zero ElementType
+    defaulting to Int32 exactly as Go's zero value does."""
+    p = tmp_path / "shard-0"
+    p.write_bytes(_GO_CHECKPOINT_STREAM)
+    recs = psck.load_shard(str(p))
+    assert [r["name"] for r in recs] == ["w0", "b0"]
+    assert recs[0]["dtype"] == np.float32
+    np.testing.assert_allclose(recs[0]["value"], [1.5, -2.0])
+    assert recs[1]["dtype"] == np.int32          # omitted -> Go zero
+    np.testing.assert_array_equal(recs[1]["value"], [7])
+    assert recs[0]["config"] == b"cfg" and recs[0]["state"] == b"st"
+    assert recs[1]["config"] == b"" and recs[1]["state"] == b""
+
+
+def test_python_encoder_matches_go_descriptor_bytes():
+    """The test encoder's unnamed-slice descriptor must now match Go's
+    zero-field omission byte for byte (advisor round-3 finding: the
+    encoder used to always emit an empty Name, hiding a shared
+    deviation from the decoder's only cross-check)."""
+    enc = GobEncoder()
+    enc.next_id = 68
+    enc.define_slice("", 65)
+    assert enc.getvalue() == _GO_CHECKPOINT_STREAM[:14]  # M1 is 14 bytes
+
+
+@pytest.mark.parametrize("mutate, match", [
+    # frame length promises more bytes than the file holds
+    (lambda b: b[:25], "truncated message"),
+    # bytes length overruns its message: top-level []byte whose length
+    # byte (127) promises more than the 2 payload bytes present
+    (lambda b: bytes.fromhex("05" "0a" "00" "7f" "6162"), "overruns"),
+    # value references a type id never described
+    (lambda b: b"\x03\xff\x92\x00" + b, "unknown type id"),
+    # non-zero singleton delta (Go: "corrupted data: non-zero delta")
+    (lambda b: b.replace(bytes.fromhex("ff88" "00" "02"),
+                         bytes.fromhex("ff88" "01" "02")),
+     "expected 0"),
+])
+def test_corrupt_streams_raise_clean_errors(mutate, match):
+    from paddle_tpu.core.errors import EnforceError
+
+    bad = mutate(_GO_CHECKPOINT_STREAM)
+    assert bad != _GO_CHECKPOINT_STREAM
+    with pytest.raises((EnforceError, ValueError), match=match):
+        GobDecoder(bad).decode()
+
+
+def test_truncated_scalar_raises_clean_error():
+    """A multi-byte uint cut mid-payload must raise enforce-style, not
+    IndexError."""
+    from paddle_tpu.core.errors import EnforceError
+
+    with pytest.raises(EnforceError, match="truncated"):
+        decode_uint(memoryview(b"\xfe\x01"), 0)
+    with pytest.raises(EnforceError, match="truncated"):
+        GobDecoder(b"\x05\xff\x81\x03\x01\x01").decode()
