@@ -1,0 +1,66 @@
+"""Execute every ``python`` code block in docs/tutorials/*.md.
+
+The reference's docs rotted because nothing ran them; here the tutorial
+layer is part of the test surface (VERDICT r3 #8).  Rules:
+
+* fenced ```python blocks execute IN ORDER within one namespace per
+  file (later blocks build on earlier ones, like a reader follows);
+* a block preceded (within 3 lines) by an HTML comment containing
+  ``no-run`` is skipped (e.g. snippets needing a live cluster);
+* ```bash / ```c blocks never run — they are transcripts.
+"""
+
+import os
+import re
+
+import pytest
+
+DOCS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "tutorials")
+
+_FENCE = re.compile(r"^```(\w*)\s*$")
+
+
+def _blocks(path):
+    """Yield (start_line, code, skipped) for each ```python block."""
+    lines = open(path).read().splitlines()
+    i = 0
+    while i < len(lines):
+        m = _FENCE.match(lines[i])
+        if m and m.group(1) == "python":
+            start = i + 1
+            body = []
+            i += 1
+            while i < len(lines) and not _FENCE.match(lines[i]):
+                body.append(lines[i])
+                i += 1
+            skipped = any("no-run" in lines[j]
+                          for j in range(max(0, start - 4), start - 1))
+            yield start, "\n".join(body), skipped
+        i += 1
+
+
+def _md_files():
+    return sorted(f for f in os.listdir(DOCS_DIR) if f.endswith(".md"))
+
+
+def test_tutorials_exist():
+    files = _md_files()
+    assert "quickstart.md" in files and "index.md" in files, files
+
+
+@pytest.mark.parametrize("fname", _md_files())
+def test_tutorial_blocks_run(fname):
+    path = os.path.join(DOCS_DIR, fname)
+    blocks = list(_blocks(path))
+    if fname != "index.md":
+        assert blocks, f"{fname}: tutorial has no runnable python blocks"
+    ns = {"__name__": f"docs_smoke_{fname.replace('.', '_')}"}
+    for start, code, skipped in blocks:
+        if skipped:
+            continue
+        try:
+            exec(compile(code, f"{fname}:{start}", "exec"), ns)
+        except Exception as e:
+            raise AssertionError(
+                f"{fname} block at line {start} failed: {e}") from e
