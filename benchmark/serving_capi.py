@@ -1,0 +1,138 @@
+"""Real-chip C-API serving throughput (VERDICT r3 #5).
+
+Serves a LeNet classifier through the C ABI (``csrc/capi.cc``) on the
+attached TPU with 1/2/4 threads over shared-parameter clones — the twin
+of the reference's multi-thread serving example
+(``paddle/capi/examples/model_inference/multi_thread``) — and reports
+QPS plus per-request p50/p99 latency.  Unlike the machine-independent
+GIL probe (``tests/capi_throughput_worker.py``, wait-dominated, clean
+CPU subprocess), this measures the REAL serving path: ctypes
+marshalling -> embedded CPython -> jit-cached forward -> device -> copy
+back, per request.
+
+    python benchmark/serving_capi.py --threads 1,2,4 --requests 64
+
+One JSON line per thread count.  Numbers land in
+``docs/design/serving.md``.
+"""
+
+import argparse
+import ctypes
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def serving_model_builder(num_classes: int = 10):
+    from paddle_tpu.models.lenet import inference_fn_builder
+
+    return inference_fn_builder(num_classes)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", default="1,2,4")
+    ap.add_argument("--requests", type=int, default=64,
+                    help="requests per thread count (split across threads)")
+    ap.add_argument("--batch", type=int, default=16,
+                    help="images per request")
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference
+    from paddle_tpu.utils.native import load_library
+
+    backend = jax.default_backend()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = load_library("capi.cc",
+                       os.path.join(root, "paddle_tpu",
+                                    "libpaddle_capi.so"),
+                       embed_python=True)
+    lib.paddle_last_error.restype = ctypes.c_char_p
+    assert lib.paddle_init(0, None) == 0
+
+    d = tempfile.mkdtemp()
+    model = nn.transform(serving_model_builder(10))
+    x = np.zeros((args.batch, 784), np.float32)
+    params, _ = model.init(jax.random.key(0), {"image": x})
+    inference.export_model(
+        d, params,
+        config={"model_ref": "serving_capi:serving_model_builder",
+                "model_kwargs": {"num_classes": 10},
+                "input_names": ["image"], "output_names": ["prob"]})
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    gm = ctypes.c_void_p()
+    assert lib.paddle_gradient_machine_create_for_inference_with_parameters(
+        ctypes.byref(gm), d.encode()) == 0, lib.paddle_last_error()
+    batch = np.random.RandomState(0).rand(args.batch, 784).astype(np.float32)
+
+    def forward(machine):
+        mat = ctypes.c_void_p()
+        assert lib.paddle_matrix_create(ctypes.byref(mat), batch.shape[0],
+                                        batch.shape[1]) == 0
+        flat = np.ascontiguousarray(batch)
+        assert lib.paddle_matrix_set_data(
+            mat, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float))) == 0
+        ia, oa = ctypes.c_void_p(), ctypes.c_void_p()
+        lib.paddle_arguments_create_none(ctypes.byref(ia))
+        lib.paddle_arguments_create_none(ctypes.byref(oa))
+        lib.paddle_arguments_resize(ia, 1)
+        lib.paddle_arguments_set_value(ia, 0, mat)
+        rc = lib.paddle_gradient_machine_forward(machine, ia, oa, 0)
+        assert rc == 0, lib.paddle_last_error()
+        lib.paddle_matrix_destroy(mat)
+        lib.paddle_arguments_destroy(ia)
+        lib.paddle_arguments_destroy(oa)
+
+    forward(gm)  # compile + warm
+
+    for nt in [int(t) for t in args.threads.split(",") if t]:
+        machines = [gm]
+        for _ in range(nt - 1):
+            c = ctypes.c_void_p()
+            assert lib.paddle_gradient_machine_create_shared_param(
+                gm, ctypes.byref(c)) == 0, lib.paddle_last_error()
+            machines.append(c)
+        for m in machines[1:]:
+            forward(m)                      # warm each clone's cache
+        per = max(1, args.requests // nt)
+        lat = [[] for _ in range(nt)]
+
+        def worker(i):
+            m = machines[i]
+            for _ in range(per):
+                t0 = time.perf_counter()
+                forward(m)
+                lat[i].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(nt)]
+        t0 = time.perf_counter()
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        wall = time.perf_counter() - t0
+        alllat = np.sort(np.concatenate(lat)) * 1e3
+        print(json.dumps({
+            "backend": backend, "threads": nt, "batch": args.batch,
+            "requests": per * nt,
+            "qps": round(per * nt / wall, 1),
+            "images_per_s": round(per * nt * args.batch / wall, 1),
+            "p50_ms": round(float(alllat[len(alllat) // 2]), 2),
+            "p99_ms": round(float(alllat[min(len(alllat) - 1,
+                                             int(len(alllat) * 0.99))]), 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Dense transformer-LM benchmark — the single-chip MFU north-star
+workload (BASELINE.json: >=60% of peak bf16 matmul throughput is
+reachable where the model allows it; the matmul-dominated decoder LM at
+d_model >= 1024 is that model, unlike BN-ResNet's fusion-granularity
+ceiling — see docs/design/kernels.md).
+
+    python -m paddle_tpu time --config benchmark/transformer_lm.py \
+        --config-args dim=1024,batch_size=16 --batches 8 --burn-in 8
+
+The reference has no transformer benchmark (2017 config zoo); the
+workload validates this framework's own model family
+(`models/transformer.py`) at compute-bound shapes: GPT-2-medium-class
+decoder, seq 1024, next-token loss, adam, bf16 compute policy.
+"""
+
+import numpy as np
+
+from paddle_tpu import optim
+from paddle_tpu.api.config import get_config_arg, settings
+from paddle_tpu.models.transformer import (TransformerConfig,
+                                           lm_model_fn_builder)
+
+DIM = get_config_arg("dim", int, 1024)
+LAYERS = get_config_arg("layers", int, 12)
+HEADS = get_config_arg("heads", int, DIM // 64)
+BATCH = get_config_arg("batch_size", int, 16)
+SEQ = get_config_arg("seq_len", int, 1024)
+VOCAB = get_config_arg("dict_size", int, 32000)
+FFN_MULT = get_config_arg("ffn_mult", int, 4)
+REMAT = bool(get_config_arg("remat", int, 0))
+
+mixed_precision = True  # bf16 compute (CLI honors this config attr)
+
+model_fn = lm_model_fn_builder(TransformerConfig(
+    vocab_size=VOCAB, dim=DIM, num_heads=HEADS, num_layers=LAYERS,
+    ffn_mult=FFN_MULT, max_len=SEQ, causal=True, remat=REMAT))
+
+optimizer = optim.from_config(settings(
+    learning_rate=3e-4, learning_method_name="adam"))
+
+
+def train_reader():
+    rs = np.random.RandomState(0)
+    batch = {"ids": rs.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32),
+             "ids_mask": np.ones((BATCH, SEQ), bool)}
+    while True:
+        yield batch

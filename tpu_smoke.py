@@ -1,16 +1,34 @@
-"""Real-TPU compile smoke for the Pallas kernels.
+"""Real-TPU compile + PERF smoke for the Pallas kernels.
 
 The CPU test suite exercises the kernels in interpret mode only; this
 script ``.lower().compile()``s the fused LSTM (resident + tiled) and GRU
 forward+backward on the actual chip, catching Mosaic/layout regressions
-the interpreter cannot.  One JSON line per kernel family; exits nonzero
-on any failure.
+the interpreter cannot — then TIMES the auto-selected fused paths
+against the XLA scan at the shapes where auto-selection claims a win,
+failing if the fused path has regressed to a loss (the h=512 row's
+0.84 -> 1.45 ms toolchain regression went unseen by compile-only
+smoke).  One JSON line per check; exits nonzero on any failure.
 
     python tpu_smoke.py          # needs a TPU-attached process
+
+Timing protocol: dependency-chained ``lax.scan`` over fwd+bwd kernel
+invocations (carry feeds h0/c0 AND a gradient-derived epsilon, so
+neither pass can hoist), differential arms (T(k=16)-T(k=4))/12, median
+of 5 — standalone sub-ms timing over a tunneled attachment is unstable
+(benchmark/spike_fused_dxdw.py), chained arms are the trustworthy form.
+Self-test: ``PADDLE_TPU_PERF_PLANT=4`` multiplies the fused arm's work
+by 4 — the gate must then FAIL.  The factor must EXCEED the fused
+path's win ratio (xla/fused, largest measured row ~2.3x), or the
+planted arm stays under the XLA time and the self-test proves nothing;
+4 clears every measured row with margin.
+``PADDLE_TPU_SMOKE_PERF=0`` skips the perf section (compile-only).
 """
 
+import functools
 import json
+import os
 import sys
+import time
 
 import numpy as np
 
@@ -104,7 +122,112 @@ def main() -> int:
 
     compile_grad("gru_fwd_bwd", gru_loss, xwg, whz)
 
+    if os.environ.get("PADDLE_TPU_SMOKE_PERF", "1") != "0":
+        failures += perf_floor(rs)
+
     return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# Perf floor: fused-vs-XLA-scan at the auto-selected shapes.
+# ---------------------------------------------------------------------------
+
+def _make_chained_loop(use_pallas, xw, wh, mask, inner: int):
+    """K chained fwd+bwd LSTM invocations under one jit: the scan carry
+    feeds the next step's (h0, c0) and receives a gradient-derived
+    epsilon, so neither the forward kernel nor its VJP can be hoisted
+    out of the loop."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    h = wh.shape[0]
+    b = xw.shape[1]
+    zeros = jnp.zeros((b, h), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def loop(k, xw, wh):
+        def body(carry, _):
+            h0, c0 = carry
+
+            def loss_fn(xw_, wh_):
+                hl, cl, s = h0, c0, 0.0
+                for _ in range(inner):
+                    hs, hl, cl = pk.lstm_scan(xw_, wh_, hl, cl, mask,
+                                              use_pallas=use_pallas)
+                    s = s + jnp.sum(hs.astype(jnp.float32) ** 2)
+                return s, (hl, cl)
+
+            (loss, (hl, cl)), (gxw, gwh) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(xw, wh)
+            hl = hl + (jnp.sum(gwh[0, :1]) * 1e-30).astype(hl.dtype)
+            del gxw
+            return (hl, cl), loss
+
+        _, losses = lax.scan(body, (zeros, zeros), None, length=k)
+        return losses.sum()
+
+    return loop
+
+
+def _chained_iter_ms(loop, xw, wh, k_small=4, k_big=16, repeats=5):
+    for k in (k_small, k_big):
+        float(loop(k, xw, wh))          # compile + warm both trip counts
+    diffs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(loop(k_small, xw, wh))    # host transfer = the real sync
+        t1 = time.perf_counter()
+        float(loop(k_big, xw, wh))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (k_big - k_small) * 1e3)
+    return sorted(diffs)[len(diffs) // 2]
+
+
+def perf_floor(rs) -> list:
+    """Time auto-selected fused vs XLA scan; a shape where the fused
+    path LOSES while auto-selection still picks it is a failure."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    plant = int(os.environ.get("PADDLE_TPU_PERF_PLANT", "1"))
+    failures = []
+    shapes = [
+        ("resident_h256_b64_f32", 100, 64, 256, jnp.float32),
+        ("resident_h512_b64_f32", 100, 64, 512, jnp.float32),
+        ("tiled_h512_b128_bf16", 100, 128, 512, jnp.bfloat16),
+    ]
+    for name, t, b, h, dt in shapes:
+        xw = jnp.asarray(rs.randn(t, b, 4 * h), dt) * 0.1
+        wh = jnp.asarray(rs.randn(h, 4 * h), jnp.float32) * (0.5 / h ** 0.5)
+        mask = jnp.ones((t, b), jnp.float32)
+        # Confirm auto-selection actually takes the fused path here —
+        # the floor only binds where selection claims a win.
+        resident = functools.partial(pk.pallas_supported, stream_dtype=dt)
+        auto_fused = pk.should_fuse(b, h, resident) or (
+            dt == jnp.bfloat16 and pk.should_fuse(b, h,
+                                                  pk.lstm_tiled_supported))
+        if not auto_fused:
+            print(json.dumps({"perf": name, "skipped":
+                              "auto-selection takes the XLA scan here"}))
+            continue
+        # plant > 1 multiplies the fused arm's work (self-test; see
+        # module docstring — the factor must exceed the fused win ratio)
+        fused_ms = _chained_iter_ms(
+            _make_chained_loop(None, xw, wh, mask, inner=max(1, plant)),
+            xw, wh)
+        xla_ms = _chained_iter_ms(
+            _make_chained_loop(False, xw, wh, mask, inner=1), xw, wh)
+        ok = fused_ms < xla_ms
+        print(json.dumps({"perf": name, "fused_ms": round(fused_ms, 3),
+                          "xla_scan_ms": round(xla_ms, 3),
+                          "ratio": round(fused_ms / xla_ms, 3), "ok": ok}))
+        if not ok:
+            failures.append(f"perf:{name}")
+    return failures
 
 
 if __name__ == "__main__":
