@@ -441,6 +441,22 @@ def sum_to_one_norm(input, name: Optional[str] = None):
     return _node("sum_to_one_norm", run, [input], name=name)
 
 
+def mdlstm(input, size: int, directions=(True, True),
+           name: Optional[str] = None):
+    """2-D multi-dimensional LSTM (``mdlstmemory`` config-kind twin, ref
+    ``gserver/layers/MDLstmLayer.cpp:180``).  The input node must carry
+    a pre-projected grid ``[b, H, W, 5*size]`` (the reference requires
+    its input layer to be ``(3+D)*size`` wide); output is
+    ``[b, H, W, size]``.  ``directions[d]`` False scans dim d in
+    reverse, like the reference's per-dim direction bools."""
+    def run(ctx, x, **a):
+        return nn.MDLstm2D(a["size"], directions=a["dirs"],
+                           name=a["_name"])(_val(x))
+    n = auto_name("mdlstm", name)
+    return _node("mdlstm", run, [input], name=n, size=size,
+                 dirs=tuple(directions), _name=n)
+
+
 def data_norm(input, data_norm_strategy: str = "z-score",
               name: Optional[str] = None):
     """Stats-table input normalization (``data_norm`` config-kind twin,

@@ -231,6 +231,7 @@ scaling_layer = _L.scaling
 slope_intercept_layer = _L.slope_intercept
 sum_to_one_norm_layer = _L.sum_to_one_norm
 data_norm_layer = _L.data_norm
+mdlstm_layer = _L.mdlstm
 row_l2_norm_layer = _L.row_l2_norm
 cross_channel_norm_layer = _L.cross_channel_norm
 clip_layer = _L.clip

@@ -14,7 +14,8 @@ from paddle_tpu.nn.layers_extra import (
     SlopeIntercept, Addto, DotMulProjection, ScalingProjection,
     IdentityProjection, TransposedFullMatrixProjection, Mixed,
     FullMatrixProjection, TableProjection, SliceProjection, ConvProjection,
-    PReLU, TensorLayer, GatedUnit, ConvShift, OutProd, RowL2Norm, ScaleShift)
+    PReLU, TensorLayer, GatedUnit, ConvShift, OutProd, RowL2Norm, ScaleShift,
+    MDLstm2D)
 
 __all__ = [
     "Module", "Transformed", "transform", "param", "state", "set_state",
@@ -32,5 +33,5 @@ __all__ = [
     "TransposedFullMatrixProjection", "Mixed",
     "FullMatrixProjection", "TableProjection", "SliceProjection",
     "ConvProjection", "PReLU", "TensorLayer", "GatedUnit", "ConvShift",
-    "OutProd", "RowL2Norm", "ScaleShift",
+    "OutProd", "RowL2Norm", "ScaleShift", "MDLstm2D",
 ]

@@ -743,3 +743,40 @@ class ScaleShift(Module):
             b = param("b", (1,), policy.param_dtype, init.zeros)
             y = y + b[0]
         return y
+
+
+class MDLstm2D(Module):
+    """2-D multi-dimensional LSTM (twin of ``MDLstmLayer.cpp:180``, the
+    ``mdlstmemory`` kind — which the reference only ever ran on CPU; its
+    GPU path never shipped).  Input is the PRE-PROJECTED grid
+    ``[b, H, W, 5*size]`` (the reference requires the input layer to be
+    ``(3+D)*size`` wide, gate layout ``[inode, ig, fg_h, fg_w, og]``);
+    parameters are the shared recurrent weight ``[size, 5*size]``, the
+    local bias, and the ig/fg/og peepholes — the same shapes the
+    reference packs into its weight + bias parameters.  The recurrence
+    runs as a skewed anti-diagonal wavefront ``lax.scan``
+    (``ops/mdlstm.py``) instead of a per-cell walk."""
+
+    def __init__(self, size: int, directions=(True, True),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = size
+        self.directions = tuple(directions)
+
+    def forward(self, x):
+        from paddle_tpu.ops.mdlstm import mdlstm2d
+
+        n = self.size
+        enforce(x.shape[-1] == 5 * n,
+                "MDLstm2D(size=%d): input must be pre-projected to "
+                "5*size=%d channels, got %d", n, 5 * n, x.shape[-1])
+        policy = get_policy()
+        w_r = param("w", (n, 5 * n), policy.param_dtype,
+                    init.paddle_default(fan_in_axis=0))
+        bias = param("b", (5 * n,), policy.param_dtype, init.zeros)
+        check_ig = param("check_ig", (n,), policy.param_dtype, init.zeros)
+        check_fg = param("check_fg", (2, n), policy.param_dtype, init.zeros)
+        check_og = param("check_og", (n,), policy.param_dtype, init.zeros)
+        out, _ = mdlstm2d(x, w_r, bias, check_ig, check_fg, check_og,
+                          directions=self.directions)
+        return out

@@ -1,0 +1,171 @@
+"""2-D multi-dimensional LSTM (the ``mdlstmemory`` kind).
+
+Reference semantics (``gserver/layers/MDLstmLayer.cpp:156-560``): each
+grid cell (i, j) has D=2 predecessors — up (i-1, j) along dim 0 and
+left (i, j-1) along dim 1 — with
+
+    gates  = x_proj + sum_j out_prev_j @ W          (ONE shared W)
+    ig    += check_ig  * sum_j state_prev_j         (shared peephole)
+    fg_j  += check_fg_j * state_prev_j              (per-dim peephole)
+    state  = act_in(inode) * act_gate(ig)
+             + sum_j act_gate(fg_j) * state_prev_j
+    og    += check_og * state
+    out    = act_state(state) * act_gate(og)
+
+per-position gate layout ``[inode, ig, fg_0, fg_1, og]`` (each n wide),
+recurrent weight ``[n, 5n]`` in the same column layout — matching the
+reference's parameter shapes so artifacts map 1:1.
+
+The reference walks cells one by one (``CoordIterator``); that is a
+scalar loop a TPU cannot pipeline.  Here the grid is SKEWED so that
+anti-diagonal k lands in column k — cell (i, j) moves to column i+j —
+and one ``lax.scan`` over the H+W-1 skewed columns advances the whole
+wavefront at once: both predecessors of every cell in column c live in
+column c-1 (up = previous column one row up, left = previous column
+same row).  All H cells of a diagonal and the batch vectorize onto the
+VPU/MXU; border cells are masked.  ``directions`` flips the scan
+per-dim exactly like the reference's ``directions_`` bools.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.errors import enforce
+
+
+def _skew(x: jax.Array) -> jax.Array:
+    """[b, H, W, f] -> [b, H, H+W-1, f] with row i shifted right by i
+    (cell (i, j) lands in skewed column i+j; the vacated slots read
+    zeros from the padding)."""
+    b, h, w, f = x.shape
+    pad = jnp.pad(x, ((0, 0), (0, 0), (0, h), (0, 0)))   # width w+h
+    rows = jnp.arange(h)[:, None]
+    cols = (jnp.arange(h + w - 1)[None, :] - rows) % (w + h)
+    return pad[:, rows, cols]
+
+
+def _unskew(y: jax.Array, w: int) -> jax.Array:
+    """Inverse of :func:`_skew`: [b, H, H+W-1, f] -> [b, H, W, f]."""
+    h = y.shape[1]
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :] + rows
+    return y[:, rows, cols]
+
+
+def mdlstm2d(x_proj: jax.Array, w_r: jax.Array, bias: jax.Array,
+             check_ig: jax.Array, check_fg: jax.Array, check_og: jax.Array,
+             directions: Tuple[bool, bool] = (True, True),
+             gate_act: Callable = jax.nn.sigmoid,
+             input_act: Callable = jnp.tanh,
+             state_act: Callable = jnp.tanh,
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Run the 2-D LSTM wavefront over a [b, H, W, 5n] projected input.
+
+    Returns (out, state), each [b, H, W, n].  ``directions[d]`` False
+    scans dim d in reverse (the reference's ``directions_`` bools).
+    """
+    enforce(x_proj.ndim == 4, "mdlstm2d: x_proj must be [b, H, W, 5n]")
+    b, H, W, G = x_proj.shape
+    n = G // 5
+    enforce(G == 5 * n and w_r.shape == (n, 5 * n),
+            "mdlstm2d: gate width %d != 5*n for recurrent weight %s",
+            G, w_r.shape)
+    # The recurrence runs in f32 regardless of the input/compute policy
+    # (same stance as the 1-D LSTM/GRU scans): a bf16 carry both breaks
+    # the scan dtype contract against the f32-promoted gates and loses
+    # precision across O(H+W) chained cells.
+    x_proj = x_proj.astype(jnp.float32)
+    w_r = w_r.astype(jnp.float32)
+
+    for d, fwd in enumerate(directions):
+        if not fwd:
+            x_proj = jnp.flip(x_proj, axis=1 + d)
+
+    gates_in = _skew(x_proj + bias)                 # [b, H, C, 5n]
+    C = H + W - 1
+    i_idx = jnp.arange(H)[None, :]                  # [1, H]
+    c_idx = jnp.arange(C)[:, None]                  # [C, 1]
+    j_idx = c_idx - i_idx                           # grid col of (c, i)
+    valid = (j_idx >= 0) & (j_idx < W)              # [C, H]
+    has_left = valid & (j_idx >= 1)
+    has_up = valid & (i_idx >= 1)
+
+    def shift_down(a):                              # row i <- row i-1
+        return jnp.concatenate(
+            [jnp.zeros_like(a[:, :1]), a[:, :-1]], axis=1)
+
+    def step(carry, col):
+        h_prev, s_prev = carry                      # [b, H, n] (col c-1)
+        xg, v, left_m, up_m = col
+        v = v[None, :, None]
+        left_m = left_m[None, :, None]
+        up_m = up_m[None, :, None]
+        h_left, s_left = h_prev * left_m, s_prev * left_m
+        h_up = shift_down(h_prev) * up_m
+        s_up = shift_down(s_prev) * up_m
+        pre = xg + (h_left + h_up) @ w_r
+        inode = input_act(pre[..., :n])
+        ig = gate_act(pre[..., n:2 * n] + check_ig * (s_up + s_left))
+        fg0 = gate_act(pre[..., 2 * n:3 * n] + check_fg[0] * s_up)
+        fg1 = gate_act(pre[..., 3 * n:4 * n] + check_fg[1] * s_left)
+        state = (inode * ig + fg0 * s_up + fg1 * s_left) * v
+        og = gate_act(pre[..., 4 * n:] + check_og * state)
+        out = state_act(state) * og * v
+        return (out, state), (out, state)
+
+    cols = (jnp.moveaxis(gates_in, 2, 0), valid, has_left, has_up)
+    zeros = jnp.zeros((b, H, n), x_proj.dtype)
+    _, (outs, states) = lax.scan(step, (zeros, zeros), cols)
+
+    out = _unskew(jnp.moveaxis(outs, 0, 2), W)
+    state = _unskew(jnp.moveaxis(states, 0, 2), W)
+    for d, fwd in enumerate(directions):
+        if not fwd:
+            out = jnp.flip(out, axis=1 + d)
+            state = jnp.flip(state, axis=1 + d)
+    return out, state
+
+
+def mdlstm2d_reference(x_proj, w_r, bias, check_ig, check_fg, check_og,
+                       directions=(True, True)):
+    """Cell-by-cell numpy twin of the reference's CoordIterator walk —
+    the oracle the wavefront implementation is tested against."""
+    import numpy as np
+
+    x = np.asarray(x_proj, np.float64) + np.asarray(bias, np.float64)
+    b, H, W, G = x.shape
+    n = G // 5
+    wr = np.asarray(w_r, np.float64)
+    cig, cfg, cog = (np.asarray(a, np.float64)
+                     for a in (check_ig, check_fg, check_og))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    out = np.zeros((b, H, W, n))
+    st = np.zeros((b, H, W, n))
+    ii = range(H) if directions[0] else range(H - 1, -1, -1)
+    jj = list(range(W) if directions[1] else range(W - 1, -1, -1))
+    du = 1 if directions[0] else -1
+    dl = 1 if directions[1] else -1
+    for i in ii:
+        for j in jj:
+            up = (i - du, j) if 0 <= i - du < H else None
+            left = (i, j - dl) if 0 <= j - dl < W else None
+            pre = x[:, i, j].copy()
+            for p in (up, left):
+                if p is not None:
+                    pre += out[:, p[0], p[1]] @ wr
+            s_up = st[:, up[0], up[1]] if up else np.zeros((b, n))
+            s_left = st[:, left[0], left[1]] if left else np.zeros((b, n))
+            inode = np.tanh(pre[:, :n])
+            ig = sig(pre[:, n:2 * n] + cig * (s_up + s_left))
+            fg0 = sig(pre[:, 2 * n:3 * n] + cfg[0] * s_up)
+            fg1 = sig(pre[:, 3 * n:4 * n] + cfg[1] * s_left)
+            s = inode * ig + fg0 * s_up + fg1 * s_left
+            og = sig(pre[:, 4 * n:] + cog * s)
+            st[:, i, j] = s
+            out[:, i, j] = np.tanh(s) * og
+    return out, st

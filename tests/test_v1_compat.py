@@ -628,6 +628,7 @@ def test_config_layer_kind_coverage():
         "kmax_seq_score": "kmax_seq_score_layer",
         "lambda_cost": "lambda_cost", "lstm_step": "lstm_step_layer",
         "lstmemory": "lstmemory", "max": "pooling_layer",
+        "mdlstmemory": "mdlstm_layer",
         "maxid": "maxid_layer", "maxout": "maxout_layer",
         "mixed": "mixed_layer",
         "multi_class_cross_entropy_with_selfnorm":
@@ -662,11 +663,10 @@ def test_config_layer_kind_coverage():
     }
     # Documented deltas (docs/design/overview.md "Intentional capability
     # deltas"): vendor-specific kernel variants collapse onto the XLA
-    # lowering; mdlstm never shipped working GPU kernels in the reference.
+    # lowering.
     deltas = {
         "mkldnn_conv", "mkldnn_fc", "mkldnn_pool",   # CPU-vendor backend
         "cudnn_convt",                                # vendor transpose-conv
-        "mdlstmemory",                                # multi-dim LSTM
     }
 
     missing = []
