@@ -8,6 +8,47 @@ fused hot spots, and sharded checkpointing.
 
 __version__ = "0.1.0"
 
-from paddle_tpu import core, nn, ops
+
+def _honor_env_platform(force: bool = False) -> None:
+    """Make ``JAX_PLATFORMS`` authoritative for paddle_tpu entry points.
+
+    A TPU-attachment sitecustomize may pin ``jax_platforms``
+    programmatically at interpreter start, silently overriding the env
+    var — a process asked to run on cpu (tests, CI, air-gapped boxes)
+    would instead attach the chip, and block outright if the attachment
+    is unavailable.  Re-applying the env choice plus a backend-registry
+    reset restores the documented env contract.
+
+    No-op when the env var is unset or already in effect.  When a
+    backend registry already exists, the default is to leave it alone (a
+    reset orphans live clients/arrays); ``force=True`` resets anyway and
+    is for process ENTRY POINTS that own the interpreter (the CLI, test
+    workers) — there any pre-existing client came from an eager
+    sitecustomize init, not user code, and the caller must be
+    single-threaded at this moment.  This is the one home of the
+    version-sensitive ``jax._src.xla_bridge`` reset recipe; test
+    helpers delegate here."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    if (jax.config.jax_platforms or "") == want:
+        return
+    from jax._src import xla_bridge
+
+    with xla_bridge._backend_lock:
+        occupied = bool(xla_bridge._backends)
+    if occupied and not force:
+        return
+    jax.config.update("jax_platforms", want)
+    xla_bridge._clear_backends()       # takes _backend_lock itself
+
+
+_honor_env_platform()
+
+from paddle_tpu import core, nn, ops  # noqa: E402 — after platform fixup
 
 __all__ = ["core", "nn", "ops", "__version__"]

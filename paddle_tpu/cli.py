@@ -302,6 +302,12 @@ def cmd_merge_model(args):
 
 
 def main(argv=None):
+    # JAX_PLATFORMS env is authoritative for the CLI.  force=True: the
+    # CLI owns the process, so any pre-existing backend registry came
+    # from an eager sitecustomize init, not user arrays.
+    import paddle_tpu
+
+    paddle_tpu._honor_env_platform(force=True)
     parser = argparse.ArgumentParser(prog="paddle_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 

@@ -56,12 +56,11 @@ def main():
     # tests/conftest.py) — reset the backend registry to plain 1-device
     # CPU before any jax work.
     os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu
+
+    paddle_tpu._honor_env_platform(force=True)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge
-
-    xla_bridge._clear_backends()
     assert jax.devices()[0].platform == "cpu", jax.devices()
 
     import paddle_tpu.nn as nn

@@ -30,12 +30,10 @@ def _provision_cpu(n: int) -> None:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}").strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    import paddle_tpu
 
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge
-
-    xla_bridge._clear_backends()
+    # the one shared home of the backend-registry reset recipe
+    paddle_tpu._honor_env_platform(force=True)
 
 
 def main() -> None:
