@@ -284,3 +284,44 @@ def test_cli_train_init_model_path_empty_reader_message(tmp_path):
     with pytest.raises(EnforceError, match="train_reader"):
         cli.main(["train", "--config", str(cfg),
                   "--init-model-path", str(tmp_path), "--num-passes", "1"])
+
+
+def test_honor_env_platform_overrides_programmatic_pin():
+    """A sitecustomize-style programmatic platform pin must lose to the
+    JAX_PLATFORMS env contract when paddle_tpu imports."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'bogus')\n"   # the 'pin'
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import paddle_tpu\n"
+        "print(jax.devices()[0].platform)\n")
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == "cpu"
+
+
+def test_honor_env_platform_never_orphans_live_client():
+    """The guarded (import-time) form must refuse to clear a registry
+    that already holds a live client."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import paddle_tpu\n"
+        "import jax, jax.numpy as jnp\n"
+        "x = jnp.ones(3)\n"                    # live client + array
+        "os.environ['JAX_PLATFORMS'] = 'tpu'\n"
+        "paddle_tpu._honor_env_platform()\n"   # guarded: must no-op
+        "assert jax.devices()[0].platform == 'cpu'\n"
+        "print(float(x.sum()))\n")
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == "3.0"
