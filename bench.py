@@ -28,6 +28,20 @@ import numpy as np
 
 
 def main():
+    # paddle_tpu import first: it applies the JAX_PLATFORMS env contract
+    # BEFORE any backend exists (an eager jax.devices() here would pin
+    # the sitecustomize's platform and defeat the env var).
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.utils.watchdog import attach_watchdog
+
+    disarm = attach_watchdog(240.0, {
+        "metric": "stacked-LSTM cls train step, h=256 bs=64 "
+                  "seq=100 dict=30k",
+        "value": 0.0, "unit": "ms/batch", "vs_baseline": 0.0})
+    import jax
+
+    jax.devices()                     # force the attachment eagerly
+    disarm()                          # attached; timing may take longer
     from paddle_tpu import optim
     from paddle_tpu.core.dtypes import mixed_precision
     from paddle_tpu.models.lstm_classifier import model_fn_builder

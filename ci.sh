@@ -62,8 +62,11 @@ python __graft_entry__.py
 kill "${HOG_PIDS[@]}" 2>/dev/null || true
 trap - EXIT
 
-# Real-TPU compile smoke, only when a chip is attached.
-if python - <<'EOF'
+# Real-TPU compile smoke, only when a chip is attached.  The detection
+# runs under a kill-backed timeout: a wedged attachment blocks inside
+# native PJRT client creation where SIGTERM never fires, so only
+# SIGKILL (-k) gets the probe unstuck — treat that as "no usable TPU".
+if timeout -k 5 250 python - <<'EOF'
 import sys
 try:
     import jax

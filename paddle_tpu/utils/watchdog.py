@@ -1,0 +1,43 @@
+"""Fail-fast guard for device attachment at process entry points.
+
+A wedged TPU attachment blocks inside native PJRT client creation,
+where Python signal handlers never run — neither SIGTERM nor a timeout
+context can interrupt it, so a daemon timer + ``os._exit`` is the only
+clean exit.  Standalone scripts (``bench.py``, ``tpu_smoke.py``) arm
+this around their first ``jax.devices()`` call; importing this module
+creates no JAX backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict
+
+
+def attach_watchdog(seconds: float, payload: Dict) -> Callable[[], None]:
+    """Print ``payload`` (plus an ``error`` field) as one JSON line and
+    hard-exit with code 3 unless the returned ``disarm()`` runs within
+    ``seconds``.  The payload should match the caller's normal output
+    schema so downstream parsers see a well-formed failure record."""
+    armed = threading.Event()
+    armed.set()
+
+    def bark():
+        if armed.is_set():
+            print(json.dumps({
+                **payload,
+                "error": f"device attachment did not complete within "
+                         f"{seconds:.0f}s"}), flush=True)
+            os._exit(3)
+
+    timer = threading.Timer(seconds, bark)
+    timer.daemon = True
+    timer.start()
+
+    def disarm() -> None:
+        armed.clear()
+        timer.cancel()
+
+    return disarm

@@ -34,10 +34,17 @@ import numpy as np
 
 
 def main() -> int:
+    from paddle_tpu.utils.watchdog import attach_watchdog
+
+    disarm = attach_watchdog(240.0, {"smoke": "aborted", "ok": False})
+
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.ops import pallas_kernels as pk
+
+    jax.devices()
+    disarm()                          # attached; compiles may take longer
 
     if jax.default_backend() != "tpu":
         print(json.dumps({"smoke": "skipped", "reason":
