@@ -28,12 +28,14 @@ SEQ = get_config_arg("seq_len", int, 1024)
 VOCAB = get_config_arg("dict_size", int, 32000)
 FFN_MULT = get_config_arg("ffn_mult", int, 4)
 REMAT = bool(get_config_arg("remat", int, 0))
+FLASH = bool(get_config_arg("flash", int, 0))
 
 mixed_precision = True  # bf16 compute (CLI honors this config attr)
 
 model_fn = lm_model_fn_builder(TransformerConfig(
     vocab_size=VOCAB, dim=DIM, num_heads=HEADS, num_layers=LAYERS,
-    ffn_mult=FFN_MULT, max_len=SEQ, causal=True, remat=REMAT))
+    ffn_mult=FFN_MULT, max_len=SEQ, causal=True, remat=REMAT,
+    flash=FLASH))
 
 optimizer = optim.from_config(settings(
     learning_rate=3e-4, learning_method_name="adam"))

@@ -48,6 +48,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_every: int = 1            # MoE in every k-th block
     moe_capacity_factor: float = 2.0
+    flash: bool = False           # Pallas flash attention (TPU only)
 
 
 class FeedForward(Module):
@@ -139,6 +140,9 @@ def _next_token_loss(logits, ids, mask):
 
 def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
     """Next-token LM loss over ``batch = {"ids", "ids_mask"}``."""
+    if attn_fn is None and cfg.flash:
+        from paddle_tpu.ops.attention import flash_attention_fn
+        attn_fn = flash_attention_fn
 
     def model_fn(batch):
         ids, mask = batch["ids"], batch.get("ids_mask")

@@ -67,6 +67,45 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def flash_attention_fn(q: jax.Array, k: jax.Array, v: jax.Array,
+                       mask: Optional[jax.Array] = None,
+                       causal: bool = False) -> jax.Array:
+    """Single-device Pallas flash attention as a ``MultiHeadAttention``
+    ``attn_fn`` (``jax.experimental.pallas.ops.tpu.flash_attention``).
+
+    Never materializes the [t, t] score matrix in HBM — the win over
+    the XLA einsum path grows with sequence length (at seq 1024 the
+    bf16 scores are ~2 MB x heads x batch PER LAYER each way).  BTHD in
+    and out (this module's convention) with the kernel's BHTD inside; a
+    key-padding mask maps onto the kernel's SegmentIds (valid tokens
+    segment 1, padded 0 — padded keys are invisible to valid queries,
+    and padded queries' outputs are don't-cares, exactly the masked
+    einsum's semantics).  Off-TPU (tests, CPU fallback) this delegates
+    to :func:`dot_product_attention` — the kernel is Mosaic-only.
+    Opt-in via ``TransformerConfig(flash=True)``; the benchmark decides
+    whether Mosaic codegen pays off at each shape.
+    """
+    b, tq, h, d = q.shape
+    if (jax.default_backend() != "tpu"
+            or tq % 128 or k.shape[1] % 128):
+        # The kernel's default block sizes are 128-grained over BOTH
+        # sequence axes (its _verify_block raises at trace time
+        # otherwise); off-grid shapes take the XLA path instead of
+        # crashing a flash=True model at t=100-style lengths.
+        return dot_product_attention(q, k, v, mask=mask, causal=causal)
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    seg = None
+    if mask is not None:
+        seg = _fa.SegmentIds(q=jnp.ones((b, tq), jnp.int32),
+                             kv=mask.astype(jnp.int32))
+    out = _fa.flash_attention(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), segment_ids=seg, causal=causal,
+        sm_scale=d ** -0.5)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def blockwise_attn_chunk(q, k, v, bias, carry):
     """One flash-attention accumulation step over a KV chunk.
 

@@ -129,6 +129,20 @@ def main() -> int:
 
     compile_grad("gru_fwd_bwd", gru_loss, xwg, whz)
 
+    # Pallas flash attention at the transformer-LM bench shape family
+    # (per-head slice): BTHD, causal, fwd+bwd through the custom VJP.
+    from paddle_tpu.ops.attention import flash_attention_fn
+
+    bq, tq, hq, dq = 4, 1024, 4, 64
+    qkv = [jnp.asarray(rs.randn(bq, tq, hq, dq), jnp.bfloat16) * 0.1
+           for _ in range(3)]
+
+    def flash_loss(q, k):
+        out = flash_attention_fn(q, k, qkv[2], causal=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    compile_grad("flash_attention_fwd_bwd", flash_loss, qkv[0], qkv[1])
+
     if os.environ.get("PADDLE_TPU_SMOKE_PERF", "1") != "0":
         failures += perf_floor(rs)
 
