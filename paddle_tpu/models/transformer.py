@@ -73,11 +73,17 @@ class TransformerBlock(Module):
         self.layer_idx = layer_idx
         self.attn_fn = attn_fn
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, cache=None, position=None):
         cfg = self.cfg
+        new_cache = None
         h = nn.LayerNorm(name="ln_attn")(x)
-        h = MultiHeadAttention(cfg.num_heads, causal=cfg.causal,
-                               attn_fn=self.attn_fn, name="attn")(h, mask=mask)
+        attn = MultiHeadAttention(cfg.num_heads, causal=cfg.causal,
+                                  attn_fn=self.attn_fn, name="attn")
+        if cache is not None:
+            h, new_cache = attn(h, mask=mask, cache=cache,
+                                position=position)
+        else:
+            h = attn(h, mask=mask)
         if cfg.dropout:
             h = nn.Dropout(cfg.dropout, name="drop_attn")(h)
         x = x + h
@@ -93,7 +99,8 @@ class TransformerBlock(Module):
             h = FeedForward(cfg.dim, cfg.dim * cfg.ffn_mult, name="ffn")(h)
         if cfg.dropout:
             h = nn.Dropout(cfg.dropout, name="drop_ffn")(h)
-        return x + h
+        out = x + h
+        return out if new_cache is None else (out, new_cache)
 
 
 class TransformerLM(Module):
@@ -104,18 +111,29 @@ class TransformerLM(Module):
         self.cfg = cfg
         self.attn_fn = attn_fn
 
-    def forward(self, ids, mask=None):
+    def forward(self, ids, mask=None, caches=None, position=None):
+        """``caches`` (per-layer ``(k, v)`` pairs) + ``position`` run
+        the incremental-decoding form: keys/values write into the
+        caches at ``position`` and ``(logits, new_caches)`` returns —
+        prefill passes the whole prompt at position 0, decode passes
+        one token per step.  Static shapes, so one compiled step
+        serves every position."""
         cfg = self.cfg
         policy = get_policy()
         b, t = ids.shape
         x = nn.Embedding(cfg.vocab_size, cfg.dim, name="embed")(ids)
         pos = param("pos_embed", (cfg.max_len, cfg.dim), policy.param_dtype,
                     init.normal(0.02))
-        x = x + jax.lax.dynamic_slice_in_dim(pos, 0, t, axis=0)[None]
+        start = 0 if position is None else position
+        x = x + jax.lax.dynamic_slice_in_dim(pos, start, t, axis=0)[None]
+        new_caches = [] if caches is not None else None
         for i in range(cfg.num_layers):
             block = TransformerBlock(cfg, layer_idx=i, attn_fn=self.attn_fn,
                                      name=f"block_{i}")
-            if cfg.remat:
+            if caches is not None:
+                x, c = block(x, mask, cache=caches[i], position=position)
+                new_caches.append(c)
+            elif cfg.remat:
                 x = nn.remat(block, x, mask)
             else:
                 x = block(x, mask)
@@ -124,7 +142,8 @@ class TransformerLM(Module):
                       init.xavier_uniform())
         logits = jnp.matmul(policy.cast_to_compute(x),
                             policy.cast_to_compute(w_out))
-        return policy.cast_to_output(logits)
+        logits = policy.cast_to_output(logits)
+        return logits if new_caches is None else (logits, new_caches)
 
 
 def _next_token_loss(logits, ids, mask):
@@ -150,6 +169,83 @@ def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
         logits = net(ids, mask)
         return _next_token_loss(logits, ids, mask), {"logits": logits}
     return model_fn
+
+
+def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
+    """KV-cache autoregressive generation for :class:`TransformerLM` —
+    the LM-serving twin of the seq2seq beam decode (``ops/beam_search``).
+
+    Returns ``generate(params, prompt_ids, steps, temperature=0.0,
+    rng=None) -> [b, prompt_len + steps]`` — one jitted program: a
+    batched PREFILL forward fills every layer's [b, max_len, h, hd]
+    key/value cache at position 0, then a ``lax.scan`` emits one token
+    per step through the cached 1-token forward.  Shapes are static
+    (the cache is pre-sized to ``cfg.max_len``), so the whole loop
+    compiles once and each decode step costs O(prefix) attention
+    reads instead of a full-recompute O(prefix²).  ``temperature`` 0 is
+    greedy argmax; > 0 samples ``softmax(logits / temperature)``.
+    """
+    import functools
+
+    if attn_fn is None and cfg.flash:
+        from paddle_tpu.ops.attention import flash_attention_fn
+        attn_fn = flash_attention_fn
+
+    model = nn.transform(
+        lambda ids, caches, position: TransformerLM(
+            cfg, attn_fn=attn_fn, name="lm")(
+                ids, caches=caches, position=position))
+    hd = cfg.dim // cfg.num_heads
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def generate(params, prompt_ids, steps: int, temperature: float = 0.0,
+                 rng=None):
+        b, tp = prompt_ids.shape
+        assert steps >= 1, "generate: steps must be >= 1"
+        assert tp + steps <= cfg.max_len, (
+            f"prompt {tp} + steps {steps} exceeds max_len {cfg.max_len}")
+        policy = get_policy()
+        caches = [
+            (jnp.zeros((b, cfg.max_len, cfg.num_heads, hd),
+                       policy.compute_dtype),
+             jnp.zeros((b, cfg.max_len, cfg.num_heads, hd),
+                       policy.compute_dtype))
+            for _ in range(cfg.num_layers)]
+        rng_key = jax.random.key(0) if rng is None else rng
+        temp = jnp.asarray(temperature, jnp.float32)
+
+        def pick(logits, key):
+            greedy = jnp.argmax(logits, axis=-1)
+            sampled = jax.random.categorical(
+                key, logits.astype(jnp.float32)
+                / jnp.maximum(temp, 1e-6), axis=-1)
+            return jnp.where(temp > 0, sampled, greedy).astype(
+                prompt_ids.dtype)
+
+        (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
+                                          caches, 0)
+        k0, rng_key = jax.random.split(rng_key)
+        tok = pick(logits[:, -1], k0)
+
+        def step(carry, i):
+            caches, tok, key = carry
+            (lg, caches), _ = model.apply(params, {}, None, tok[:, None],
+                                          caches, tp + i)
+            key, sub = jax.random.split(key)
+            nxt = pick(lg[:, -1], sub)
+            return (caches, nxt, key), tok
+
+        # steps - 1 decode forwards: the prefill already produced tok_0,
+        # and each scan step emits its carried token while computing the
+        # next, so `last` is tok_{steps-1} — every forward is used.
+        (_, last, _), toks = jax.lax.scan(
+            step, (caches, tok, rng_key), jnp.arange(steps - 1))
+        gen = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1).astype(prompt_ids.dtype),
+             last[:, None]], axis=1)
+        return jnp.concatenate([prompt_ids, gen], axis=1)
+
+    return generate
 
 
 def _ln(x, g=None, b=None, eps: float = 1e-6):

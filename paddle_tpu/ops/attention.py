@@ -53,13 +53,17 @@ def attn_bias(mask: Optional[jax.Array], causal: bool, q_len: int,
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           causal: bool = False,
-                          scale: Optional[float] = None) -> jax.Array:
-    """Attention over BTHD tensors.  ``mask``: [batch, k_len] key validity."""
+                          scale: Optional[float] = None,
+                          q_offset=0) -> jax.Array:
+    """Attention over BTHD tensors.  ``mask``: [batch, k_len] key
+    validity.  ``q_offset`` shifts the queries' global positions for
+    the causal triangle — incremental decoding passes the write cursor
+    so a 1-token query attends its whole prefix."""
     b, tq, h, d = q.shape
     scale = (d ** -0.5) if scale is None else scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    bias = attn_bias(mask, causal, tq, k.shape[1])
+    bias = attn_bias(mask, causal, tq, k.shape[1], q_offset=q_offset)
     if bias is not None:
         logits = logits + bias
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -87,11 +91,13 @@ def flash_attention_fn(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     b, tq, h, d = q.shape
     if (jax.default_backend() != "tpu"
-            or tq % 128 or k.shape[1] % 128):
+            or tq % 128 or k.shape[1] % 128
+            or (d > 128 and d % 128)):
         # The kernel's default block sizes are 128-grained over BOTH
-        # sequence axes (its _verify_block raises at trace time
-        # otherwise); off-grid shapes take the XLA path instead of
-        # crashing a flash=True model at t=100-style lengths.
+        # sequence axes, and head dims above 128 must be 128-multiples
+        # (its shape checks raise at trace time otherwise); off-grid
+        # shapes take the XLA path instead of crashing a flash=True
+        # model at t=100- or head_dim=192-style shapes.
         return dot_product_attention(q, k, v, mask=mask, causal=causal)
     from jax.experimental.pallas.ops.tpu import flash_attention as _fa
 
@@ -159,7 +165,17 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.attn_fn = attn_fn
 
-    def forward(self, x, kv=None, mask: Optional[jax.Array] = None):
+    def forward(self, x, kv=None, mask: Optional[jax.Array] = None,
+                cache=None, position=None):
+        """``cache=(k_cache, v_cache)`` ([b, max_len, h, hd] each) turns
+        the call into an INCREMENTAL-DECODING step: the new keys/values
+        write into the caches at ``position`` (the global index of
+        ``x``'s first token) and the queries attend the whole written
+        prefix — static shapes throughout, so one compiled step serves
+        every decode position.  Returns ``(out, new_cache)`` then.  The
+        decode path always uses the einsum attention (a 1-token query
+        has no t² matrix to avoid; flash/ring ``attn_fn`` apply to the
+        batched prefill/training forms)."""
         policy = get_policy()
         b, t, dim = x.shape
         h = self.num_heads
@@ -178,7 +194,42 @@ class MultiHeadAttention(Module):
         k = proj("w_k", kv, h * hd).reshape(b, kv.shape[1], h, hd)
         v = proj("w_v", kv, h * hd).reshape(b, kv.shape[1], h, hd)
 
-        if self.attn_fn is not None:
+        new_cache = None
+        if cache is not None:
+            enforce(position is not None,
+                    "MultiHeadAttention cache mode needs position")
+            # Padded prompts are not supported incrementally: the
+            # caller conventions use [b, t] token masks, which do not
+            # line up with the [b, max_len] cache axis — left-align
+            # prompts densely instead (a silent broadcast here would
+            # mis-mask the whole cache).
+            enforce(mask is None,
+                    "cache mode: per-token masks are unsupported; "
+                    "left-align prompts densely for incremental "
+                    "decoding")
+            k_cache, v_cache = cache
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k.astype(k_cache.dtype), position, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v.astype(v_cache.dtype), position, axis=1)
+            new_cache = (k_cache, v_cache)
+            if t > 1 and self.attn_fn is not None:
+                # Batched PREFILL (generate always prefills the whole
+                # prompt at position 0): the fresh k/v cover every key
+                # the queries may see, so the flash/ring attn_fn path
+                # applies — the one place it pays off in decoding.
+                # (Chunked prefill at position > 0 is not supported
+                # with an attn_fn; the einsum path below is general.)
+                out = self.attn_fn(q, k, v, mask=None, causal=self.causal)
+            else:
+                written = (jnp.arange(k_cache.shape[1])[None, :]
+                           < position + t)              # [1, max_len]
+                key_mask = jnp.broadcast_to(written,
+                                            (b, k_cache.shape[1]))
+                out = dot_product_attention(
+                    q, k_cache, v_cache, mask=key_mask,
+                    causal=self.causal, q_offset=position)
+        elif self.attn_fn is not None:
             out = self.attn_fn(q, k, v, mask=mask, causal=self.causal)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
@@ -190,4 +241,5 @@ class MultiHeadAttention(Module):
                          policy.cast_to_compute(w_o))
         b_o = param("b_o", (dim,), policy.param_dtype, init.zeros)
         out = policy.cast_to_output(out)
-        return out + b_o.astype(out.dtype)
+        out = out + b_o.astype(out.dtype)
+        return out if new_cache is None else (out, new_cache)
