@@ -1,0 +1,95 @@
+"""Transformer-LM decode throughput (KV-cache generation).
+
+Times `lm_generate_builder`'s jitted decode loop on the attached device
+with the differential protocol over STEP COUNTS — T(4s) - T(s) cancels
+the shared prefill + dispatch costs, leaving the marginal cost of one
+cached decode step (the serving metric: tokens/s/chip at batch b).
+
+    python benchmark/lm_decode.py --dim 1024 --layers 12 --batch 8 \
+        --prompt 128 --steps 64
+
+One JSON line.  The reference has no LM-serving twin (2017); this row
+quantifies the beyond-reference generation path next to the training
+MFU rows.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+
+    import paddle_tpu  # noqa: F401  (env platform contract)
+    from paddle_tpu.utils.watchdog import attach_watchdog
+
+    disarm = attach_watchdog(240.0, {"metric": "lm_decode", "value": 0.0,
+                                     "unit": "tokens/s"})
+    import jax
+    import jax.numpy as jnp
+
+    jax.devices()
+    disarm()
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.dtypes import mixed_precision
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder)
+
+    heads = args.heads or args.dim // 64
+    max_len = args.max_len or args.prompt + 4 * args.steps
+    cfg = TransformerConfig(vocab_size=args.vocab, dim=args.dim,
+                            num_heads=heads, num_layers=args.layers,
+                            max_len=max_len, causal=True)
+    rs = np.random.RandomState(0)
+    prompt = jnp.asarray(rs.randint(0, args.vocab,
+                                    (args.batch, args.prompt)), jnp.int32)
+    with mixed_precision():
+        plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+        params, _ = plain.init(jax.random.key(0), prompt[:, :8])
+        generate = lm_generate_builder(cfg)
+
+        s, s4 = args.steps, 4 * args.steps
+        for n in (s, s4):                      # compile + warm both
+            np.asarray(generate(params, prompt, n))
+
+        diffs = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            np.asarray(generate(params, prompt, s))
+            t1 = time.perf_counter()
+            np.asarray(generate(params, prompt, s4))
+            t2 = time.perf_counter()
+            diffs.append(((t2 - t1) - (t1 - t0)) / (s4 - s))
+        per_step = sorted(diffs)[len(diffs) // 2]
+
+    print(json.dumps({
+        "metric": f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
+                  f"prompt{args.prompt}",
+        "backend": jax.default_backend(),
+        "ms_per_step": round(per_step * 1e3, 3),
+        "tokens_per_s": round(args.batch / per_step, 1),
+        "unit": "tokens/s"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
