@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Round-4 TPU measurement campaign — run the moment a chip answers.
+# Strictly ONE jax process at a time (the attachment is single-client).
+# Usage: bash benchmark/run_round4_tpu.sh [outdir]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/r4_tpu}"
+mkdir -p "$OUT"
+
+run() {  # run <name> <cmd...>: log, never abort the campaign on failure
+    local name="$1"; shift
+    echo "== $name =="
+    ("$@" 2>&1 | tee "$OUT/$name.log") || echo "$name FAILED rc=$?"
+}
+
+# 0. attachment sanity + entry compile
+run probe python -c "import jax; print(jax.devices())"
+
+# 1. smoke: Pallas compiles + the new perf floor (fused must beat XLA)
+run tpu_smoke python tpu_smoke.py
+# 1b. perf-floor self-test: planted 4x slowdown MUST fail (expect rc!=0)
+run tpu_smoke_plant env PADDLE_TPU_PERF_PLANT=4 python tpu_smoke.py
+
+# 2. transformer-LM MFU north star (VERDICT #2)
+run lm_d1024 python -m paddle_tpu time --config benchmark/transformer_lm.py \
+    --config-args dim=1024,batch_size=16 --batches 8 --burn-in 8 --repeats 5
+run lm_d1024_flash python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=1024,batch_size=16,flash=1 --batches 8 --burn-in 8 \
+    --repeats 5
+run lm_d2048 python -m paddle_tpu time --config benchmark/transformer_lm.py \
+    --config-args dim=2048,batch_size=8 --batches 4 --burn-in 4 --repeats 5
+# fallback if d2048 OOMs: remat, then fewer layers
+grep -q "RESOURCE_EXHAUSTED\|out of memory" "$OUT/lm_d2048.log" && \
+  run lm_d2048_remat python -m paddle_tpu time \
+      --config benchmark/transformer_lm.py \
+      --config-args dim=2048,batch_size=8,remat=1 --batches 4 --burn-in 4 \
+      --repeats 5
+
+# 3. real-chip C-API serving throughput (VERDICT #5)
+run serving python benchmark/serving_capi.py --threads 1,2,4 --requests 64
+
+# 4. KV-cache decode throughput (beyond-reference row)
+run lm_decode python benchmark/lm_decode.py --dim 1024 --layers 12 \
+    --batch 8 --prompt 128 --steps 64
+
+# 5. Mosaic re-test cadence (VERDICT #10)
+run mosaic_spike python benchmark/spike_fused_dxdw.py
+
+# 6. flagship bench + verify drivers
+run bench python bench.py
+[ -f /tmp/verify_r4.py ] && run verify_r4 python /tmp/verify_r4.py
+[ -f /tmp/verify_mdlstm.py ] && run verify_mdlstm python /tmp/verify_mdlstm.py
+
+echo "campaign done; logs in $OUT"
