@@ -171,6 +171,29 @@ def lm_model_fn_builder(cfg: TransformerConfig, attn_fn=None):
     return model_fn
 
 
+def _cached_lm(cfg: TransformerConfig, attn_fn):
+    """Shared cached-decode setup for the generate/beam builders:
+    resolve the ``cfg.flash`` attention default, build the transformed
+    incremental model, and expose a per-layer zero-cache allocator —
+    one home, so cache layout and attention wiring cannot drift between
+    the two decoders."""
+    if attn_fn is None and cfg.flash:
+        from paddle_tpu.ops.attention import flash_attention_fn
+        attn_fn = flash_attention_fn
+    model = nn.transform(
+        lambda ids, caches, position: TransformerLM(
+            cfg, attn_fn=attn_fn, name="lm")(
+                ids, caches=caches, position=position))
+    hd = cfg.dim // cfg.num_heads
+
+    def make_caches(b, dtype):
+        return [(jnp.zeros((b, cfg.max_len, cfg.num_heads, hd), dtype),
+                 jnp.zeros((b, cfg.max_len, cfg.num_heads, hd), dtype))
+                for _ in range(cfg.num_layers)]
+
+    return model, make_caches
+
+
 def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     """KV-cache autoregressive generation for :class:`TransformerLM` —
     the LM-serving twin of the seq2seq beam decode (``ops/beam_search``).
@@ -187,15 +210,7 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     """
     import functools
 
-    if attn_fn is None and cfg.flash:
-        from paddle_tpu.ops.attention import flash_attention_fn
-        attn_fn = flash_attention_fn
-
-    model = nn.transform(
-        lambda ids, caches, position: TransformerLM(
-            cfg, attn_fn=attn_fn, name="lm")(
-                ids, caches=caches, position=position))
-    hd = cfg.dim // cfg.num_heads
+    model, make_caches = _cached_lm(cfg, attn_fn)
 
     @functools.partial(jax.jit, static_argnums=(2,))
     def generate(params, prompt_ids, steps: int, temperature: float = 0.0,
@@ -205,12 +220,7 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         assert tp + steps <= cfg.max_len, (
             f"prompt {tp} + steps {steps} exceeds max_len {cfg.max_len}")
         policy = get_policy()
-        caches = [
-            (jnp.zeros((b, cfg.max_len, cfg.num_heads, hd),
-                       policy.compute_dtype),
-             jnp.zeros((b, cfg.max_len, cfg.num_heads, hd),
-                       policy.compute_dtype))
-            for _ in range(cfg.num_layers)]
+        caches = make_caches(b, policy.compute_dtype)
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
 
@@ -246,6 +256,74 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         return jnp.concatenate([prompt_ids, gen], axis=1)
 
     return generate
+
+
+def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
+                           attn_fn=None):
+    """Beam search over the KV-cache decode loop — the LM twin of the
+    seq2seq beam decoder (``ops/beam_search.py``), sharing the cached
+    step of :func:`lm_generate_builder`.
+
+    Returns ``search(params, prompt_ids, steps) -> (tokens, scores)``
+    with ``tokens [b, beam, prompt+steps]`` and summed-logprob
+    ``scores [b, beam]`` sorted best-first.  One jitted program: the
+    prompt prefills ONCE per batch row, caches tile to ``b*beam`` lanes,
+    and each step re-gathers every layer's cache rows by the surviving
+    beams' parent indices — the static-shape form of the reference
+    decoder's per-beam state copying.
+    """
+    import functools
+
+    model, make_caches = _cached_lm(cfg, attn_fn)
+    V = cfg.vocab_size
+    K = beam_size
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def search(params, prompt_ids, steps: int):
+        b, tp = prompt_ids.shape
+        assert steps >= 1 and tp + steps <= cfg.max_len
+        policy = get_policy()
+        caches = make_caches(b, policy.compute_dtype)
+        (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
+                                          caches, 0)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        scores, tok0 = jax.lax.top_k(logp, K)          # [b, K]
+        # tile caches to beam lanes: row r of batch i -> lane i*K + r
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=0), caches)
+        hist = jnp.zeros((b, K, steps), prompt_ids.dtype)
+        hist = hist.at[:, :, 0].set(tok0.astype(prompt_ids.dtype))
+        # carry dtype must be stable across the scan: the step emits
+        # hist-dtype tokens, so the seed must match for any prompt dtype
+        tok = tok0.astype(prompt_ids.dtype).reshape(b * K)
+
+        def step(carry, i):
+            caches, tok, scores, hist = carry
+            # ``i`` is the hist column being FILLED; the fed token sits
+            # one position earlier (tp + i - 1), which is where its
+            # keys/values belong in the cache.
+            (lg, caches), _ = model.apply(params, {}, None,
+                                          tok[:, None].astype(jnp.int32),
+                                          caches, tp + i - 1)
+            logp = jax.nn.log_softmax(
+                lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
+            cand = (scores[..., None] + logp).reshape(b, K * V)
+            scores, idx = jax.lax.top_k(cand, K)       # sorted desc
+            parent = idx // V                          # [b, K]
+            tok_new = (idx % V).astype(hist.dtype)
+            rows = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+            caches = jax.tree_util.tree_map(lambda c: c[rows], caches)
+            hist = jnp.take_along_axis(hist, parent[..., None], axis=1)
+            hist = hist.at[:, :, i].set(tok_new)
+            return (caches, tok_new.reshape(b * K), scores, hist), ()
+
+        (_, _, scores, hist), _ = jax.lax.scan(
+            step, (caches, tok, scores, hist), jnp.arange(1, steps))
+        prompt_tiled = jnp.broadcast_to(prompt_ids[:, None],
+                                        (b, K, tp)).astype(hist.dtype)
+        return jnp.concatenate([prompt_tiled, hist], axis=2), scores
+
+    return search
 
 
 def _ln(x, g=None, b=None, eps: float = 1e-6):

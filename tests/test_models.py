@@ -238,3 +238,65 @@ def test_lm_generate_sampling_and_shapes(rng):
     assert a.shape == (3, 10) and a.max() < 17 and a.min() >= 0
     one = np.asarray(generate(params, prompt, 1))   # steps=1: empty scan
     assert one.shape == (3, 5)
+
+
+def test_lm_beam_search_beam1_equals_greedy(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_beam_search_builder,
+                                               lm_generate_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=30, dim=16, num_heads=2,
+                            num_layers=2, max_len=16)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 30, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    greedy = np.asarray(lm_generate_builder(cfg)(params, prompt, 6))
+    toks, scores = lm_beam_search_builder(cfg, 1)(params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(toks)[:, 0], greedy)
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_lm_beam_search_finds_no_worse_sequences(rng):
+    """Beam-0's joint logprob must be >= the greedy sequence's, beams
+    sorted best-first, and reported scores must equal an independent
+    full-recompute scoring of the returned tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_beam_search_builder,
+                                               lm_generate_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=20, dim=16, num_heads=2,
+                            num_layers=1, max_len=14)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 20, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(5), prompt)
+    steps = 6
+
+    def joint_logprob(seq):
+        """sum_t log p(seq[tp+t] | seq[:tp+t]) via the plain model."""
+        total = np.zeros(seq.shape[0])
+        for t in range(steps):
+            logits, _ = plain.apply(params, {}, None,
+                                    jnp.asarray(seq[:, :4 + t]))
+            lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+            total += np.asarray(lp)[np.arange(seq.shape[0]),
+                                    np.asarray(seq[:, 4 + t])]
+        return total
+
+    toks, scores = lm_beam_search_builder(cfg, 3)(params, prompt, steps)
+    toks, scores = np.asarray(toks), np.asarray(scores)
+    assert np.all(np.diff(scores, axis=1) <= 1e-5)      # sorted desc
+    for k in range(3):                                  # scores are real
+        np.testing.assert_allclose(joint_logprob(toks[:, k]), scores[:, k],
+                                   atol=1e-3)
+    greedy = np.asarray(lm_generate_builder(cfg)(params, prompt, steps))
+    assert np.all(scores[:, 0] >= joint_logprob(greedy) - 1e-4)
