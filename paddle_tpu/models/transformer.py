@@ -199,7 +199,8 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     the LM-serving twin of the seq2seq beam decode (``ops/beam_search``).
 
     Returns ``generate(params, prompt_ids, steps, temperature=0.0,
-    rng=None) -> [b, prompt_len + steps]`` — one jitted program: a
+    rng=None, eos_id=None) -> [b, prompt_len + steps]`` — one jitted
+    program: a
     batched PREFILL forward fills every layer's [b, max_len, h, hd]
     key/value cache at position 0, then a ``lax.scan`` emits one token
     per step through the cached 1-token forward.  Shapes are static
@@ -207,14 +208,20 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     compiles once and each decode step costs O(prefix) attention
     reads instead of a full-recompute O(prefix²).  ``temperature`` 0 is
     greedy argmax; > 0 samples ``softmax(logits / temperature)``.
+    ``eos_id`` freezes a row once it emits that token (it keeps
+    emitting ``eos_id`` for the remaining fixed-shape steps — the
+    padding convention downstream tokenizers strip).
     """
     import functools
 
     model, make_caches = _cached_lm(cfg, attn_fn)
 
-    @functools.partial(jax.jit, static_argnums=(2,))
+    @functools.partial(jax.jit, static_argnums=(2, 5))
     def generate(params, prompt_ids, steps: int, temperature: float = 0.0,
-                 rng=None):
+                 rng=None, eos_id=None):
+        """``eos_id``: once a row emits it, the row keeps emitting
+        ``eos_id`` for the remaining (fixed-shape) steps — the padding
+        convention downstream tokenizers strip."""
         b, tp = prompt_ids.shape
         assert steps >= 1, "generate: steps must be >= 1"
         assert tp + steps <= cfg.max_len, (
@@ -224,32 +231,36 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
 
-        def pick(logits, key):
+        def pick(logits, key, done):
             greedy = jnp.argmax(logits, axis=-1)
             sampled = jax.random.categorical(
                 key, logits.astype(jnp.float32)
                 / jnp.maximum(temp, 1e-6), axis=-1)
-            return jnp.where(temp > 0, sampled, greedy).astype(
+            nxt = jnp.where(temp > 0, sampled, greedy).astype(
                 prompt_ids.dtype)
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+                done = done | (nxt == eos_id)
+            return nxt, done
 
         (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
                                           caches, 0)
         k0, rng_key = jax.random.split(rng_key)
-        tok = pick(logits[:, -1], k0)
+        tok, done = pick(logits[:, -1], k0, jnp.zeros((b,), bool))
 
         def step(carry, i):
-            caches, tok, key = carry
+            caches, tok, key, done = carry
             (lg, caches), _ = model.apply(params, {}, None, tok[:, None],
                                           caches, tp + i)
             key, sub = jax.random.split(key)
-            nxt = pick(lg[:, -1], sub)
-            return (caches, nxt, key), tok
+            nxt, done = pick(lg[:, -1], sub, done)
+            return (caches, nxt, key, done), tok
 
         # steps - 1 decode forwards: the prefill already produced tok_0,
         # and each scan step emits its carried token while computing the
         # next, so `last` is tok_{steps-1} — every forward is used.
-        (_, last, _), toks = jax.lax.scan(
-            step, (caches, tok, rng_key), jnp.arange(steps - 1))
+        (_, last, _, _), toks = jax.lax.scan(
+            step, (caches, tok, rng_key, done), jnp.arange(steps - 1))
         gen = jnp.concatenate(
             [jnp.moveaxis(toks, 0, 1).astype(prompt_ids.dtype),
              last[:, None]], axis=1)

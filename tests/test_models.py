@@ -300,3 +300,38 @@ def test_lm_beam_search_finds_no_worse_sequences(rng):
                                    atol=1e-3)
     greedy = np.asarray(lm_generate_builder(cfg)(params, prompt, steps))
     assert np.all(scores[:, 0] >= joint_logprob(greedy) - 1e-4)
+
+
+def test_lm_generate_eos_freezes_rows(rng):
+    """After a row emits eos_id it must keep emitting eos_id (the
+    fixed-shape padding convention) while other rows continue."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=12, dim=16, num_heads=2,
+                            num_layers=1, max_len=20)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 12, (3, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    generate = lm_generate_builder(cfg)
+    # derive eos from the PLAIN model's logits so the choice does not
+    # depend on which compiled program computed it (argmax near-ties
+    # can flip across fusions): row 0's greedy-favored first token.
+    logits, _ = plain.apply(params, {}, None, prompt)
+    eos = int(np.asarray(jnp.argmax(logits[:, -1], -1))[0])
+    for temp, rng_key in ((0.0, None), (0.7, jax.random.key(3))):
+        out = np.asarray(generate(params, prompt, 10, temp, rng_key,
+                                  eos_id=eos))
+        gen = out[:, 4:]
+        for row in gen:
+            hits = np.where(row == eos)[0]
+            if hits.size:                    # freeze property per row
+                assert np.all(row[hits[0]:] == eos), (temp, row)
+    # greedy run: row 0 hit eos at step 0 by construction
+    greedy_gen = np.asarray(generate(params, prompt, 10, eos_id=eos))[:, 4:]
+    assert np.all(greedy_gen[0] == eos)
