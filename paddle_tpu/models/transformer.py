@@ -226,6 +226,9 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         assert steps >= 1, "generate: steps must be >= 1"
         assert tp + steps <= cfg.max_len, (
             f"prompt {tp} + steps {steps} exceeds max_len {cfg.max_len}")
+        assert eos_id is None or 0 <= eos_id < cfg.vocab_size, (
+            f"eos_id {eos_id} outside vocab {cfg.vocab_size} — a "
+            "mismatched id would silently never terminate")
         policy = get_policy()
         caches = make_caches(b, policy.compute_dtype)
         rng_key = jax.random.key(0) if rng is None else rng
@@ -275,13 +278,17 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
     seq2seq beam decoder (``ops/beam_search.py``), sharing the cached
     step of :func:`lm_generate_builder`.
 
-    Returns ``search(params, prompt_ids, steps) -> (tokens, scores)``
-    with ``tokens [b, beam, prompt+steps]`` and summed-logprob
-    ``scores [b, beam]`` sorted best-first.  One jitted program: the
-    prompt prefills ONCE per batch row, caches tile to ``b*beam`` lanes,
-    and each step re-gathers every layer's cache rows by the surviving
-    beams' parent indices — the static-shape form of the reference
-    decoder's per-beam state copying.
+    Returns ``search(params, prompt_ids, steps, eos_id=None) ->
+    (tokens, scores)`` with ``tokens [b, beam, prompt+steps]`` and
+    summed-logprob ``scores [b, beam]`` sorted best-first.  One jitted
+    program: the prompt prefills ONCE per batch row, caches tile to
+    ``b*beam`` lanes, and each step re-gathers every layer's cache rows
+    by the surviving beams' parent indices — the static-shape form of
+    the reference decoder's per-beam state copying.  With ``eos_id``, a
+    hypothesis that emits it is FINISHED: its score freezes and it
+    keeps emitting ``eos_id`` (implemented as a one-hot logprob row —
+    0 at eos, -inf elsewhere — so finished beams compete with live ones
+    at their final score, the reference beam decoder's semantics).
     """
     import functools
 
@@ -289,10 +296,13 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
     V = cfg.vocab_size
     K = beam_size
 
-    @functools.partial(jax.jit, static_argnums=(2,))
-    def search(params, prompt_ids, steps: int):
+    @functools.partial(jax.jit, static_argnums=(2, 3))
+    def search(params, prompt_ids, steps: int, eos_id=None):
         b, tp = prompt_ids.shape
         assert steps >= 1 and tp + steps <= cfg.max_len
+        assert eos_id is None or 0 <= eos_id < cfg.vocab_size, (
+            f"eos_id {eos_id} outside vocab {cfg.vocab_size} — a "
+            "mismatched id would silently never terminate")
         policy = get_policy()
         caches = make_caches(b, policy.compute_dtype)
         (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
@@ -307,9 +317,11 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
         # carry dtype must be stable across the scan: the step emits
         # hist-dtype tokens, so the seed must match for any prompt dtype
         tok = tok0.astype(prompt_ids.dtype).reshape(b * K)
+        done = (tok0 == eos_id) if eos_id is not None else jnp.zeros(
+            (b, K), bool)
 
         def step(carry, i):
-            caches, tok, scores, hist = carry
+            caches, tok, scores, hist, done = carry
             # ``i`` is the hist column being FILLED; the fed token sits
             # one position earlier (tp + i - 1), which is where its
             # keys/values belong in the cache.
@@ -318,6 +330,15 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
                                           caches, tp + i - 1)
             logp = jax.nn.log_softmax(
                 lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
+            if eos_id is not None:
+                # finished beams: one-hot at eos with logprob 0 — the
+                # score freezes and only the eos continuation survives
+                # (NEG_INF, not -inf, shared with ops/beam_search.py so
+                # additive score adjustments stay finite)
+                from paddle_tpu.ops.beam_search import NEG_INF
+                frozen = jnp.full((V,), NEG_INF,
+                                  jnp.float32).at[eos_id].set(0.0)
+                logp = jnp.where(done[..., None], frozen, logp)
             cand = (scores[..., None] + logp).reshape(b, K * V)
             scores, idx = jax.lax.top_k(cand, K)       # sorted desc
             parent = idx // V                          # [b, K]
@@ -326,10 +347,14 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
             caches = jax.tree_util.tree_map(lambda c: c[rows], caches)
             hist = jnp.take_along_axis(hist, parent[..., None], axis=1)
             hist = hist.at[:, :, i].set(tok_new)
-            return (caches, tok_new.reshape(b * K), scores, hist), ()
+            if eos_id is not None:
+                done = (jnp.take_along_axis(done, parent, axis=1)
+                        | (tok_new == eos_id))
+            return (caches, tok_new.reshape(b * K), scores, hist,
+                    done), ()
 
-        (_, _, scores, hist), _ = jax.lax.scan(
-            step, (caches, tok, scores, hist), jnp.arange(1, steps))
+        (_, _, scores, hist, _), _ = jax.lax.scan(
+            step, (caches, tok, scores, hist, done), jnp.arange(1, steps))
         prompt_tiled = jnp.broadcast_to(prompt_ids[:, None],
                                         (b, K, tp)).astype(hist.dtype)
         return jnp.concatenate([prompt_tiled, hist], axis=2), scores
