@@ -331,14 +331,11 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
             logp = jax.nn.log_softmax(
                 lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
             if eos_id is not None:
-                # finished beams: one-hot at eos with logprob 0 — the
-                # score freezes and only the eos continuation survives
-                # (NEG_INF, not -inf, shared with ops/beam_search.py so
-                # additive score adjustments stay finite)
-                from paddle_tpu.ops.beam_search import NEG_INF
-                frozen = jnp.full((V,), NEG_INF,
-                                  jnp.float32).at[eos_id].set(0.0)
-                logp = jnp.where(done[..., None], frozen, logp)
+                # finished beams: score freezes, only eos survives —
+                # the shared seq2seq freeze convention
+                from paddle_tpu.ops.beam_search import frozen_eos_row
+                logp = jnp.where(done[..., None],
+                                 frozen_eos_row(V, eos_id), logp)
             cand = (scores[..., None] + logp).reshape(b, K * V)
             scores, idx = jax.lax.top_k(cand, K)       # sorted desc
             parent = idx // V                          # [b, K]

@@ -26,6 +26,19 @@ from jax import lax
 NEG_INF = -1e9
 
 
+def frozen_eos_row(vocab_size: int, eos_id: int):
+    """Logprob row for a FINISHED hypothesis: 0 at ``eos_id``, NEG_INF
+    elsewhere — the hypothesis keeps emitting eos at an unchanged score
+    while still competing with live beams.  Shared by the seq2seq
+    decoder here and the transformer LM beam search so the freeze
+    semantics cannot drift (NEG_INF rather than -inf keeps additive
+    score adjustments finite)."""
+    import jax.numpy as jnp
+
+    return jnp.full((vocab_size,), NEG_INF,
+                    jnp.float32).at[eos_id].set(0.0)
+
+
 class BeamState(NamedTuple):
     step: jax.Array          # scalar int
     alive_seq: jax.Array     # [b, k, max_len] token ids
@@ -99,7 +112,7 @@ def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
             logprobs = candidate_adjust_fn(logprobs, s.step)
 
         # finished beams: only allow emitting eos with prob 1 (freeze)
-        freeze = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)
+        freeze = frozen_eos_row(v, eos_id)
         logprobs = jnp.where(s.finished[..., None], freeze[None, None, :],
                              logprobs)
 

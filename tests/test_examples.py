@@ -13,6 +13,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONFIGS = [
     ("mnist_lenet.py", "batch_size=32,n_train=128"),
     ("quick_start_text.py", "batch_size=16,vocab_size=200"),
+    ("transformer_char_lm.py", "dim=32,layers=1,batch_size=8,seq_len=24"),
     ("sequence_tagging_crf.py", "batch_size=8,mode=linear"),
     ("seq2seq_nmt.py", "batch_size=8,dict_size=120"),
     ("resnet_cifar.py", "batch_size=8,depth=18"),
@@ -63,3 +64,25 @@ def test_v2_script_example_runs():
         capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
     assert out.returncode == 0, out.stderr[-3000:]
     assert "accuracy" in out.stdout
+
+
+def test_transformer_char_lm_generates_from_checkpoint(tmp_path):
+    """The char-LM example round-trips: CLI training writes a
+    checkpoint, the example's __main__ loads it (deriving the
+    architecture from the parameter shapes) and generates."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = ["--config-args", "dim=32,layers=1,batch_size=8,seq_len=24"]
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "train",
+         "--config", os.path.join(REPO, "examples",
+                                  "transformer_char_lm.py"),
+         *args, "--num-passes", "1", "--checkpoint-dir", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    gen = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "transformer_char_lm.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert gen.returncode == 0, gen.stderr[-2000:]
+    assert "continuation:" in gen.stdout

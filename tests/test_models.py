@@ -337,8 +337,7 @@ def test_lm_generate_eos_freezes_rows(rng):
     greedy_gen = np.asarray(generate(params, prompt, 10, eos_id=eos))[:, 4:]
     assert np.any(greedy_gen == eos)
     # out-of-vocab eos ids fail loudly, not silently never-terminate
-    import pytest as _pytest
-    with _pytest.raises(AssertionError, match="outside vocab"):
+    with pytest.raises(AssertionError, match="outside vocab"):
         generate(params, prompt, 4, eos_id=99)
 
 
