@@ -199,8 +199,9 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     the LM-serving twin of the seq2seq beam decode (``ops/beam_search``).
 
     Returns ``generate(params, prompt_ids, steps, temperature=0.0,
-    rng=None, eos_id=None) -> [b, prompt_len + steps]`` — one jitted
-    program: a
+    rng=None, eos_id=None, top_k=None, top_p=None) ->
+    [b, prompt_len + steps]`` (the decoding knobs past ``steps`` are
+    static — a new value retraces) — one jitted program: a
     batched PREFILL forward fills every layer's [b, max_len, h, hd]
     key/value cache at position 0, then a ``lax.scan`` emits one token
     per step through the cached 1-token forward.  Shapes are static
@@ -216,12 +217,16 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
 
     model, make_caches = _cached_lm(cfg, attn_fn)
 
-    @functools.partial(jax.jit, static_argnums=(2, 5))
+    @functools.partial(jax.jit, static_argnums=(2, 5, 6, 7))
     def generate(params, prompt_ids, steps: int, temperature: float = 0.0,
-                 rng=None, eos_id=None):
+                 rng=None, eos_id=None, top_k=None, top_p=None):
         """``eos_id``: once a row emits it, the row keeps emitting
         ``eos_id`` for the remaining (fixed-shape) steps — the padding
-        convention downstream tokenizers strip."""
+        convention downstream tokenizers strip.  ``top_k`` restricts
+        sampling to the k highest-probability tokens; ``top_p`` to the
+        smallest nucleus whose probability mass reaches p (both only
+        bite when ``temperature > 0``; they compose — k first, then p).
+        """
         b, tp = prompt_ids.shape
         assert steps >= 1, "generate: steps must be >= 1"
         assert tp + steps <= cfg.max_len, (
@@ -229,16 +234,38 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         assert eos_id is None or 0 <= eos_id < cfg.vocab_size, (
             f"eos_id {eos_id} outside vocab {cfg.vocab_size} — a "
             "mismatched id would silently never terminate")
+        assert top_k is None or 1 <= top_k <= cfg.vocab_size
+        assert top_p is None or 0.0 < top_p <= 1.0
         policy = get_policy()
         caches = make_caches(b, policy.compute_dtype)
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
 
+        def restrict(logits):
+            """Apply top-k then top-p to [b, V] f32 logits."""
+            from paddle_tpu.ops.beam_search import NEG_INF
+            if top_k is not None and top_k < cfg.vocab_size:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth, NEG_INF, logits)
+            if top_p is not None and top_p < 1.0:
+                srt = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
+                # threshold = smallest kept logit (position of the last
+                # True in the sorted keep mask)
+                n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+                thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+                logits = jnp.where(logits < thr, NEG_INF, logits)
+            return logits
+
         def pick(logits, key, done):
+            logits = logits.astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1)
+            # temperature scales BEFORE the nucleus is chosen, so the
+            # kept set holds top_p of the ACTUAL sampling distribution
+            # (top-k is invariant to the monotone rescale either way)
             sampled = jax.random.categorical(
-                key, logits.astype(jnp.float32)
-                / jnp.maximum(temp, 1e-6), axis=-1)
+                key, restrict(logits / jnp.maximum(temp, 1e-6)), axis=-1)
             nxt = jnp.where(temp > 0, sampled, greedy).astype(
                 prompt_ids.dtype)
             if eos_id is not None:

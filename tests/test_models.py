@@ -379,3 +379,34 @@ def test_lm_beam_search_eos_finishes_hypotheses(rng):
                                             eos_id=eos))
     t1, _ = lm_beam_search_builder(cfg, 1)(params, prompt, 8, eos)
     np.testing.assert_array_equal(np.asarray(t1)[:, 0], g)
+
+
+def test_lm_generate_topk_topp_restrict_sampling(rng):
+    """top_k=1 sampling must equal greedy exactly; top_p with a tiny p
+    likewise collapses to the argmax token; generous settings still
+    produce in-vocab tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=16, dim=16, num_heads=2,
+                            num_layers=1, max_len=16)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 16, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    generate = lm_generate_builder(cfg)
+
+    greedy = np.asarray(generate(params, prompt, 6))
+    k1 = np.asarray(generate(params, prompt, 6, 1.0, jax.random.key(1),
+                             top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    p_tiny = np.asarray(generate(params, prompt, 6, 1.0,
+                                 jax.random.key(2), top_p=1e-6))
+    np.testing.assert_array_equal(p_tiny, greedy)
+    free = np.asarray(generate(params, prompt, 6, 1.0, jax.random.key(3),
+                               top_k=8, top_p=0.9))
+    assert free.min() >= 0 and free.max() < 16
