@@ -1,11 +1,51 @@
 #!/usr/bin/env bash
 # Round-4 TPU measurement campaign — run the moment a chip answers.
 # Strictly ONE jax process at a time (the attachment is single-client).
-# Usage: bash benchmark/run_round4_tpu.sh [outdir]
+# Usage: bash benchmark/run_round4_tpu.sh [--wait] [outdir]
+#   --wait: bounded attach-probe loop (66 attempts, 600 s apart; worst
+#   case ~15.4 h when every probe burns its full 240 s timeout), each
+#   attempt logged, so the campaign fires the moment the tunnel heals
+#   instead of requiring a human/agent to notice.  A probe that blocks
+#   in the PJRT attach ignores SIGTERM, so timeouts are enforced with
+#   SIGKILL.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+WAIT=0
+if [ "${1:-}" = "--wait" ]; then WAIT=1; shift; fi
 OUT="${1:-/tmp/r4_tpu}"
 mkdir -p "$OUT"
+
+probe_once() {  # attach probe with a hard SIGKILL timeout (arg: seconds)
+    # paddle_tpu import first (JAX_PLATFORMS contract), and require the
+    # tpu backend: a CPU fallback during an outage must NOT count as
+    # attached or the campaign would run chipless.
+    local limit="$1" t=0
+    python -c "import paddle_tpu, jax, sys; print(jax.devices());
+sys.exit(0 if jax.default_backend() == 'tpu' else 4)" \
+        >"$OUT/probe_attempt.log" 2>&1 &
+    local pid=$!
+    while kill -0 "$pid" 2>/dev/null; do
+        sleep 5; t=$((t + 5))
+        if [ "$t" -ge "$limit" ]; then
+            kill -9 "$pid" 2>/dev/null; wait "$pid" 2>/dev/null
+            return 1
+        fi
+    done
+    wait "$pid"
+}
+
+if [ "$WAIT" = 1 ]; then
+    for attempt in $(seq 1 66); do
+        echo "[wait] attempt $attempt $(date -u +%H:%M:%SZ)" | tee -a "$OUT/wait.log"
+        if probe_once 240; then
+            echo "[wait] attached on attempt $attempt $(date -u +%H:%M:%SZ)" | tee -a "$OUT/wait.log"
+            break
+        fi
+        echo "[wait] attach timed out (240s, SIGKILLed); sleeping 600s" | tee -a "$OUT/wait.log"
+        [ "$attempt" = 66 ] && { echo "[wait] giving up" | tee -a "$OUT/wait.log"; exit 3; }
+        sleep 600
+    done
+fi
 
 run() {  # run <name> <cmd...>: log, never abort the campaign on failure
     local name="$1"; shift

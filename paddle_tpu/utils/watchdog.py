@@ -13,23 +13,28 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Callable, Dict
+from typing import Callable, Dict, List, Union
 
 
-def attach_watchdog(seconds: float, payload: Dict) -> Callable[[], None]:
-    """Print ``payload`` (plus an ``error`` field) as one JSON line and
-    hard-exit with code 3 unless the returned ``disarm()`` runs within
-    ``seconds``.  The payload should match the caller's normal output
-    schema so downstream parsers see a well-formed failure record."""
+def attach_watchdog(seconds: float,
+                    payload: Union[Dict, List[Dict]]
+                    ) -> Callable[[], None]:
+    """Print ``payload`` (plus an ``error`` field) as one JSON line — or
+    one line per dict when ``payload`` is a list — and hard-exit with
+    code 3 unless the returned ``disarm()`` runs within ``seconds``.
+    The payload should match the caller's normal output schema so
+    downstream parsers see well-formed failure records."""
     armed = threading.Event()
     armed.set()
+    payloads = payload if isinstance(payload, list) else [payload]
 
     def bark():
         if armed.is_set():
-            print(json.dumps({
-                **payload,
-                "error": f"device attachment did not complete within "
-                         f"{seconds:.0f}s"}), flush=True)
+            for p in payloads:
+                print(json.dumps({
+                    **p,
+                    "error": f"device attachment did not complete within "
+                             f"{seconds:.0f}s"}), flush=True)
             os._exit(3)
 
     timer = threading.Timer(seconds, bark)
