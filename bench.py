@@ -67,9 +67,14 @@ def _attach_probe_with_retry() -> bool:
     """Probe ``jax.devices()`` in a subprocess with a hard-kill timeout;
     retry once after ``RETRY_BACKOFF`` seconds (VERDICT r4 #2)."""
     for attempt in (1, 2):
+        # the probe requires the tpu backend (outside --smoke): a silent
+        # CPU fallback during an outage must NOT count as attached, or
+        # chipless numbers would be recorded as TPU results
         p = subprocess.Popen(
             [sys.executable, "-c",
-             "import paddle_tpu, jax; jax.devices()"])
+             "import paddle_tpu, jax, sys; jax.devices(); "
+             "sys.exit(0 if jax.default_backend() == 'tpu' "
+             f"or {SMOKE} else 4)"])
         try:
             if p.wait(timeout=ATTACH_TIMEOUT) == 0:
                 return True
@@ -220,16 +225,27 @@ def main():
     import jax
     jax.devices()                     # force the attachment eagerly
     disarm()                          # attached; timing may take longer
+    if not SMOKE and jax.default_backend() != "tpu":
+        for row in _ROWS_SCHEMA:
+            print(json.dumps({
+                **row,
+                "error": f"backend is {jax.default_backend()!r}, not "
+                         "tpu — refusing to record chipless numbers"}),
+                flush=True)
+        sys.exit(3)
 
     for schema_row, row_fn in zip(_ROWS_SCHEMA,
                                   (_lstm_row, _resnet_row,
                                    _transformer_row)):
         try:
-            print(json.dumps(row_fn()), flush=True)
+            row = row_fn()
         except Exception as e:  # one bad workload must not hide the rest
-            print(json.dumps({
-                **schema_row,
-                "error": f"{type(e).__name__}: {e}"}), flush=True)
+            row = {**schema_row, "error": f"{type(e).__name__}: {e}"}
+        if SMOKE:
+            # tiny-shape pipeline check, NOT a measurement — mark it so
+            # a scraper can never record smoke output as real numbers
+            row["smoke"] = True
+        print(json.dumps(row), flush=True)
         # reclaim the finished row's HBM (params/opt state/batches) only
         # after its frames are gone, before the next model builds
         gc.collect()

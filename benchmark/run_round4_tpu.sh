@@ -20,9 +20,10 @@ probe_once() {  # attach probe with a hard SIGKILL timeout (arg: seconds)
     # tpu backend: a CPU fallback during an outage must NOT count as
     # attached or the campaign would run chipless.
     local limit="$1" t=0
+    echo "--- probe $(date -u +%H:%M:%SZ)" >>"$OUT/probe_attempts.log"
     python -c "import paddle_tpu, jax, sys; print(jax.devices());
 sys.exit(0 if jax.default_backend() == 'tpu' else 4)" \
-        >"$OUT/probe_attempt.log" 2>&1 &
+        >>"$OUT/probe_attempts.log" 2>&1 &
     local pid=$!
     while kill -0 "$pid" 2>/dev/null; do
         sleep 5; t=$((t + 5))

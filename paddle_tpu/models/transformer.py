@@ -242,11 +242,18 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         temp = jnp.asarray(temperature, jnp.float32)
 
         def restrict(logits):
-            """Apply top-k then top-p to [b, V] f32 logits."""
-            from paddle_tpu.ops.beam_search import NEG_INF
+            """Apply top-k then top-p to [b, V] f32 logits.
+
+            Rejected tokens are masked with -inf, not beam search's
+            finite NEG_INF: these logits were already divided by
+            temperature, and at small temperatures a finite mask is
+            reachable by kept logits (rejected tokens would regain
+            probability).  ``jax.random.categorical`` handles -inf rows;
+            no additive score accumulation happens here.
+            """
             if top_k is not None and top_k < cfg.vocab_size:
                 kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-                logits = jnp.where(logits < kth, NEG_INF, logits)
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
             if top_p is not None and top_p < 1.0:
                 srt = jnp.sort(logits, axis=-1)[:, ::-1]
                 probs = jax.nn.softmax(srt, axis=-1)
@@ -255,7 +262,7 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
                 # True in the sorted keep mask)
                 n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
                 thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
-                logits = jnp.where(logits < thr, NEG_INF, logits)
+                logits = jnp.where(logits < thr, -jnp.inf, logits)
             return logits
 
         def pick(logits, key, done):

@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.core.errors import enforce
@@ -213,13 +214,20 @@ class MultiHeadAttention(Module):
             v_cache = jax.lax.dynamic_update_slice_in_dim(
                 v_cache, v.astype(v_cache.dtype), position, axis=1)
             new_cache = (k_cache, v_cache)
-            if t > 1 and self.attn_fn is not None:
-                # Batched PREFILL (generate always prefills the whole
-                # prompt at position 0): the fresh k/v cover every key
-                # the queries may see, so the flash/ring attn_fn path
-                # applies — the one place it pays off in decoding.
-                # (Chunked prefill at position > 0 is not supported
-                # with an attn_fn; the einsum path below is general.)
+            # Batched PREFILL (generate always prefills the whole
+            # prompt at position 0): the fresh k/v cover every key the
+            # queries may see, so the flash/ring attn_fn path applies —
+            # the one place it pays off in decoding.  Chunked prefill at
+            # a concrete position > 0 with an attn_fn would silently
+            # ignore the cached prefix, so it is an ERROR here; a traced
+            # (non-concrete) position falls through to the general
+            # einsum path, which handles any position.
+            pos_concrete = isinstance(position, (int, np.integer))
+            if t > 1 and self.attn_fn is not None and pos_concrete:
+                enforce(int(position) == 0,
+                        "attn_fn prefill is only supported at position "
+                        "0 (got %d): flash/ring attention sees only the "
+                        "fresh k/v, not the cached prefix", int(position))
                 out = self.attn_fn(q, k, v, mask=None, causal=self.causal)
             else:
                 written = (jnp.arange(k_cache.shape[1])[None, :]

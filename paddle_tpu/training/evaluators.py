@@ -48,9 +48,12 @@ class Evaluator:
         for k in self.STATS:
             v = getattr(self, k)
             enforce(v is not None,
-                    "evaluator %s: statistic %r is unset — distributed "
-                    "merge needs at least one update() on every "
-                    "process", self.name, k)
+                    "evaluator %s: statistic %r is unset — start() must "
+                    "give every STATS attribute its full shape (zeros), "
+                    "not defer to the first update(): a process with an "
+                    "empty eval shard never updates, and an abort here "
+                    "leaves the other processes hanging in the "
+                    "collective merge", self.name, k)
             out[k] = np.asarray(v, np.float64)
         return out
 

@@ -402,6 +402,13 @@ class Trainer:
         pserver round-trip.  It is collective: every process must call
         ``test`` with the same evaluator list, each feeding its own
         shard of the eval data.
+
+        Empty-shard hazard: custom evaluators must give every ``STATS``
+        attribute its full shape in ``start()`` (zeros are fine, as all
+        built-ins do) — NOT lazily on first ``update()``.  A process
+        whose eval shard is empty never calls ``update()``; a
+        still-``None`` statistic there raises before the collective
+        all-gather, and the surviving processes would hang in it.
         """
         for e in evaluators:
             e.start()

@@ -90,8 +90,12 @@ def test_mfu_row_core_on_cpu_reports_time_without_peak():
 
 
 @pytest.mark.slow
-def test_attach_probe_succeeds_on_cpu_platform():
-    # under the test env (JAX_PLATFORMS=cpu, honored by paddle_tpu's
-    # import-time contract) the probe subprocess attaches instantly
+def test_attach_probe_rejects_cpu_fallback():
+    # under the test env (JAX_PLATFORMS=cpu) the subprocess attaches a
+    # CPU backend — which the probe must NOT count as a device (outside
+    # --smoke), or an outage with CPU fallback would record chipless
+    # numbers as TPU results
     bench = _load_bench()
-    assert bench._attach_probe_with_retry() is True
+    assert bench.SMOKE is False
+    bench.RETRY_BACKOFF = 0.1      # don't sleep 30 s in the test
+    assert bench._attach_probe_with_retry() is False
