@@ -81,9 +81,16 @@ grep -q "RESOURCE_EXHAUSTED\|out of memory" "$OUT/lm_d2048.log" && \
 # 3. real-chip C-API serving throughput (VERDICT #5)
 run serving python benchmark/serving_capi.py --threads 1,2,4 --requests 64
 
-# 4. KV-cache decode throughput (beyond-reference row)
+# 4. KV-cache decode throughput (beyond-reference rows; serve decoder
+#    proves one compiled program covers both differential arms)
 run lm_decode python benchmark/lm_decode.py --dim 1024 --layers 12 \
     --batch 8 --prompt 128 --steps 64
+run lm_decode_p512 python benchmark/lm_decode.py --dim 1024 --layers 12 \
+    --batch 8 --prompt 512 --steps 128
+run lm_decode_flash python benchmark/lm_decode.py --dim 1024 --layers 12 \
+    --batch 8 --prompt 128 --steps 64 --flash
+run lm_decode_b32 python benchmark/lm_decode.py --dim 1024 --layers 12 \
+    --batch 32 --prompt 128 --steps 64
 
 # 5. Mosaic re-test cadence (VERDICT #10)
 run mosaic_spike python benchmark/spike_fused_dxdw.py

@@ -34,10 +34,16 @@ from typing import List, Optional, Sequence
 
 def launch_local(nproc: int, argv: Sequence[str],
                  coordinator: str = "127.0.0.1:8476",
-                 extra_env: Optional[dict] = None) -> int:
+                 extra_env: Optional[dict] = None,
+                 deadline_s: Optional[float] = None) -> int:
     """Start ``nproc`` local copies of ``argv``; returns the first nonzero
-    exit code (killing the rest), else 0."""
+    exit code (killing the rest), else 0.  ``deadline_s`` bounds the
+    whole job's wall clock: on expiry every worker is torn down (the
+    finally sweep) and 124 is returned — without it, a worker blocked in
+    a coordination rendezvous would hang the launcher, and a caller that
+    kills the launcher from OUTSIDE would orphan the workers."""
     procs: List[subprocess.Popen] = []
+    t0 = time.monotonic()
     try:
         for rank in range(nproc):
             env = dict(os.environ)
@@ -55,6 +61,8 @@ def launch_local(nproc: int, argv: Sequence[str],
                 return failed[0]
             if all(c is not None for c in codes):
                 return 0
+            if deadline_s is not None and time.monotonic() - t0 > deadline_s:
+                return 124           # the finally sweep kills the rest
             time.sleep(0.05)
     finally:
         for p in procs:

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 import paddle_tpu.nn as nn
 from paddle_tpu.core.dtypes import get_policy
@@ -194,6 +195,55 @@ def _cached_lm(cfg: TransformerConfig, attn_fn):
     return model, make_caches
 
 
+def _sampling_picker(cfg: TransformerConfig, temp, out_dtype, eos_id,
+                     top_k, top_p):
+    """Shared next-token chooser for the cached decoders
+    (:func:`lm_generate_builder` / :func:`lm_serve_builder`): greedy at
+    ``temp`` 0, else ``softmax(logits/temp)`` sampling restricted by
+    top-k then top-p, with the eos row-freeze convention.  One home so
+    the two decode loops cannot drift numerically."""
+
+    def restrict(logits):
+        """Apply top-k then top-p to [b, V] f32 logits.
+
+        Rejected tokens are masked with -inf, not beam search's
+        finite NEG_INF: these logits were already divided by
+        temperature, and at small temperatures a finite mask is
+        reachable by kept logits (rejected tokens would regain
+        probability).  ``jax.random.categorical`` handles -inf rows;
+        no additive score accumulation happens here.
+        """
+        if top_k is not None and top_k < cfg.vocab_size:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
+            # threshold = smallest kept logit (position of the last
+            # True in the sorted keep mask)
+            n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
+            thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
+            logits = jnp.where(logits < thr, -jnp.inf, logits)
+        return logits
+
+    def pick(logits, key, done):
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1)
+        # temperature scales BEFORE the nucleus is chosen, so the
+        # kept set holds top_p of the ACTUAL sampling distribution
+        # (top-k is invariant to the monotone rescale either way)
+        sampled = jax.random.categorical(
+            key, restrict(logits / jnp.maximum(temp, 1e-6)), axis=-1)
+        nxt = jnp.where(temp > 0, sampled, greedy).astype(out_dtype)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return nxt, done
+
+    return pick
+
+
 def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     """KV-cache autoregressive generation for :class:`TransformerLM` —
     the LM-serving twin of the seq2seq beam decode (``ops/beam_search``).
@@ -201,7 +251,9 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
     Returns ``generate(params, prompt_ids, steps, temperature=0.0,
     rng=None, eos_id=None, top_k=None, top_p=None) ->
     [b, prompt_len + steps]`` (the decoding knobs past ``steps`` are
-    static — a new value retraces) — one jitted program: a
+    static — a new value retraces; SERVING callers with varied decode
+    lengths should use :func:`lm_serve_builder`, whose ``steps`` is a
+    traced argument and does not retrace) — one jitted program: a
     batched PREFILL forward fills every layer's [b, max_len, h, hd]
     key/value cache at position 0, then a ``lax.scan`` emits one token
     per step through the cached 1-token forward.  Shapes are static
@@ -240,45 +292,8 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         caches = make_caches(b, policy.compute_dtype)
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
-
-        def restrict(logits):
-            """Apply top-k then top-p to [b, V] f32 logits.
-
-            Rejected tokens are masked with -inf, not beam search's
-            finite NEG_INF: these logits were already divided by
-            temperature, and at small temperatures a finite mask is
-            reachable by kept logits (rejected tokens would regain
-            probability).  ``jax.random.categorical`` handles -inf rows;
-            no additive score accumulation happens here.
-            """
-            if top_k is not None and top_k < cfg.vocab_size:
-                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p is not None and top_p < 1.0:
-                srt = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(srt, axis=-1)
-                keep_sorted = jnp.cumsum(probs, axis=-1) - probs < top_p
-                # threshold = smallest kept logit (position of the last
-                # True in the sorted keep mask)
-                n_keep = jnp.sum(keep_sorted, axis=-1, keepdims=True)
-                thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
-                logits = jnp.where(logits < thr, -jnp.inf, logits)
-            return logits
-
-        def pick(logits, key, done):
-            logits = logits.astype(jnp.float32)
-            greedy = jnp.argmax(logits, axis=-1)
-            # temperature scales BEFORE the nucleus is chosen, so the
-            # kept set holds top_p of the ACTUAL sampling distribution
-            # (top-k is invariant to the monotone rescale either way)
-            sampled = jax.random.categorical(
-                key, restrict(logits / jnp.maximum(temp, 1e-6)), axis=-1)
-            nxt = jnp.where(temp > 0, sampled, greedy).astype(
-                prompt_ids.dtype)
-            if eos_id is not None:
-                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
-                done = done | (nxt == eos_id)
-            return nxt, done
+        pick = _sampling_picker(cfg, temp, prompt_ids.dtype, eos_id,
+                                top_k, top_p)
 
         (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
                                           caches, 0)
@@ -304,6 +319,116 @@ def lm_generate_builder(cfg: TransformerConfig, attn_fn=None):
         return jnp.concatenate([prompt_ids, gen], axis=1)
 
     return generate
+
+
+def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
+    """Serving-shaped KV-cache decode: ONE compiled program per
+    (batch, prompt-length) bucket serves ANY requested decode length.
+
+    Where :func:`lm_generate_builder` takes ``steps`` as a static
+    argument (exact-shape output, but every distinct value retraces —
+    fine for benchmarking, compile-cache-thrashing for a serving caller
+    with varied lengths), here ``steps`` is a TRACED scalar: the decode
+    loop is a ``lax.while_loop`` that runs exactly ``steps`` iterations
+    — or fewer, exiting as soon as every row has emitted ``eos_id`` —
+    inside a single compiled program.  Bucketing convention: the
+    (batch, prompt_len) SHAPE is still a trace key, as with any static-
+    shape XLA program; pad prompts to a few bucket widths and vary
+    ``steps`` freely within each.
+
+    Returns ``serve(params, prompt_ids, steps, temperature=0.0,
+    rng=None, eos_id=None, top_k=None, top_p=None) ->
+    [b, tp + max_new]`` where ``max_new = cfg.max_len - tp``.  Row
+    r's generated tokens occupy columns ``tp .. tp + len_r``; every
+    column past the requested ``steps`` (or past a row's eos) holds PAD
+    (= ``eos_id`` when given, else 0).  Slice ``[:, :tp + steps]`` on
+    the host for the exact-length result.  A concrete (Python-int)
+    ``steps`` outside ``[1, max_new]`` raises; a TRACED out-of-range
+    value can only clamp (no host check is possible under jit) — bound
+    traced requests on the host.  Token streams are identical to
+    :func:`lm_generate_builder` at equal ``steps`` (same rng-split
+    order, shared :func:`_sampling_picker`).
+    """
+    import functools
+
+    model, make_caches = _cached_lm(cfg, attn_fn)
+
+    @functools.partial(jax.jit, static_argnums=(5, 6, 7))
+    def _serve(params, prompt_ids, steps, temperature: float = 0.0,
+               rng=None, eos_id=None, top_k=None, top_p=None):
+        b, tp = prompt_ids.shape
+        max_new = cfg.max_len - tp
+        assert max_new >= 1, (
+            f"prompt {tp} leaves no room to decode in max_len "
+            f"{cfg.max_len}")
+        assert eos_id is None or 0 <= eos_id < cfg.vocab_size, (
+            f"eos_id {eos_id} outside vocab {cfg.vocab_size} — a "
+            "mismatched id would silently never terminate")
+        assert top_k is None or 1 <= top_k <= cfg.vocab_size
+        assert top_p is None or 0.0 < top_p <= 1.0
+        policy = get_policy()
+        caches = make_caches(b, policy.compute_dtype)
+        rng_key = jax.random.key(0) if rng is None else rng
+        temp = jnp.asarray(temperature, jnp.float32)
+        steps = jnp.clip(jnp.asarray(steps, jnp.int32), 1, max_new)
+        pad = jnp.asarray(eos_id if eos_id is not None else 0,
+                          prompt_ids.dtype)
+        pick = _sampling_picker(cfg, temp, prompt_ids.dtype, eos_id,
+                                top_k, top_p)
+
+        (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
+                                          caches, 0)
+        k0, rng_key = jax.random.split(rng_key)
+        tok, done = pick(logits[:, -1], k0, jnp.zeros((b,), bool))
+        buf = jnp.full((b, max_new), pad, prompt_ids.dtype)
+        buf = buf.at[:, 0].set(tok)
+
+        def cond(carry):
+            _, _, _, done, _, i = carry
+            live = i < steps
+            if eos_id is not None:
+                # early exit once every row froze: the remaining
+                # columns already hold eos (the buffer's fill value),
+                # so stopping is exactly equivalent to scanning on
+                live = live & ~jnp.all(done)
+            return live
+
+        def body(carry):
+            caches, tok, key, done, buf, i = carry
+            # feeds token t_{i-1}, whose keys/values belong at cache
+            # row tp + i - 1; picks t_i into buffer column i
+            (lg, caches), _ = model.apply(params, {}, None, tok[:, None],
+                                          caches, tp + i - 1)
+            key, sub = jax.random.split(key)
+            nxt, done = pick(lg[:, -1], sub, done)
+            buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+            return (caches, nxt, key, done, buf, i + 1)
+
+        (_, _, _, _, buf, _) = jax.lax.while_loop(
+            cond, body,
+            (caches, tok, rng_key, done, buf, jnp.asarray(1, jnp.int32)))
+        return jnp.concatenate([prompt_ids, buf], axis=1)
+
+    def serve(params, prompt_ids, steps, temperature: float = 0.0,
+              rng=None, eos_id=None, top_k=None, top_p=None):
+        # host-side wrapper: a concrete over-length request fails
+        # LOUDLY (generate's contract) — inside jit ``steps`` is always
+        # a tracer, so this check cannot live in the compiled body;
+        # traced values can only clamp there
+        max_new = cfg.max_len - prompt_ids.shape[1]
+        if isinstance(steps, (int, np.integer)):
+            assert 1 <= steps <= max_new, (
+                f"serve: steps {int(steps)} outside [1, {max_new}] "
+                f"(prompt {prompt_ids.shape[1]} in max_len "
+                f"{cfg.max_len}) — the result would silently truncate")
+        # normalize to strong i32: a weak-typed Python int and a strong
+        # jnp scalar would otherwise trace as DIFFERENT avals and split
+        # the compile cache in two
+        return _serve(params, prompt_ids, jnp.asarray(steps, jnp.int32),
+                      temperature, rng, eos_id, top_k, top_p)
+
+    serve._cache_size = _serve._cache_size   # the no-retrace proof hook
+    return serve
 
 
 def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,

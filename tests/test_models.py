@@ -410,3 +410,71 @@ def test_lm_generate_topk_topp_restrict_sampling(rng):
     free = np.asarray(generate(params, prompt, 6, 1.0, jax.random.key(3),
                                top_k=8, top_p=0.9))
     assert free.min() >= 0 and free.max() < 16
+
+
+def test_lm_serve_matches_generate_without_retrace(rng):
+    """lm_serve_builder (VERDICT r4 #4): one compiled program serves
+    varied decode lengths — token-identical to lm_generate_builder at
+    equal steps, PAD past the requested length, and the jit cache holds
+    exactly ONE entry after several different `steps` values."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                            num_layers=2, max_len=24)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 32, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    generate = lm_generate_builder(cfg)
+    serve = lm_serve_builder(cfg)
+    tp, max_new = 4, 24 - 4
+
+    for steps in (1, 5, 11):
+        got = np.asarray(serve(params, prompt, steps))
+        assert got.shape == (2, tp + max_new)
+        want = np.asarray(generate(params, prompt, steps))
+        np.testing.assert_array_equal(got[:, :tp + steps], want)
+        assert np.all(got[:, tp + steps:] == 0)      # PAD (no eos -> 0)
+    assert serve._cache_size() == 1, (
+        "serve retraced across steps values — the serving contract")
+
+    # sampled decode: same rng => identical stream to generate
+    s = np.asarray(serve(params, prompt, 7, 0.8, jax.random.key(9)))
+    g = np.asarray(generate(params, prompt, 7, 0.8, jax.random.key(9)))
+    np.testing.assert_array_equal(s[:, :tp + 7], g)
+
+
+def test_lm_serve_eos_early_exit_token_identical(rng):
+    """With eos_id, serve exits the while_loop once every row froze;
+    the output must still equal generate's full-scan freeze output."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=16, dim=16, num_heads=2,
+                            num_layers=1, max_len=20)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 16, (3, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    generate = lm_generate_builder(cfg)
+    serve = lm_serve_builder(cfg)
+
+    # choose the most-emitted greedy token as eos so rows finish early
+    free = np.asarray(generate(params, prompt, 12))[:, 4:]
+    eos = int(np.bincount(free.reshape(-1)).argmax())
+    want = np.asarray(generate(params, prompt, 12, eos_id=eos))
+    got = np.asarray(serve(params, prompt, 12, eos_id=eos))
+    np.testing.assert_array_equal(got[:, :4 + 12], want)
+    # PAD past steps is eos when eos_id is given
+    assert np.all(got[:, 4 + 12:] == eos)
