@@ -1,3 +1,5 @@
+"""Readers, providers, feeders, datasets (the py_paddle
+DataProvider stack twin)."""
 from paddle_tpu.data import reader, datasets, proto_shards, provider
 from paddle_tpu.data.feeder import (DataFeeder, Dense, Integer, IntSequence,
                                     DenseSequence, SparseBinary, SparseFloat)

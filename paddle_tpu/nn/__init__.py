@@ -1,3 +1,5 @@
+"""Module system and layer zoo (functional, transform-based — the
+Layer-registry twin of ref:paddle/gserver/layers)."""
 from paddle_tpu.nn.module import (Module, Transformed, transform, param, state,
                                   set_state, is_training, next_rng_key,
                                   flatten_names, unflatten_names, remat,

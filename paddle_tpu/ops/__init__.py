@@ -1,3 +1,6 @@
+"""Pure-function op library: activations, losses, attention,
+sequence/nested ops, CRF/CTC, Pallas TPU kernels (the hl_*/Function
+layer twin, one source for graph and eager use)."""
 from paddle_tpu.ops import activations
 from paddle_tpu.ops import nested
 

@@ -1,3 +1,6 @@
+"""Optimizers as composable gradient transforms (the
+ParameterOptimizer/TrainingAlgorithmOp twin: 8 v1 optimizers +
+regularizers, clipping, LR schedules, averaging, sparse rows)."""
 from paddle_tpu.optim.transforms import (Transform, apply_updates, chain,
                                          scale, identity)
 from paddle_tpu.optim.optimizers import (sgd, momentum, adagrad,

@@ -1,3 +1,6 @@
+"""Mesh/sharding toolkit: dp/tp/pp/sp/ep rules, ZeRO-1, ring
+attention, MoE expert dispatch, row-sharded embeddings (the
+multi-machine twin — ICI/DCN collectives replace the pserver)."""
 from paddle_tpu.parallel.mesh import (make_mesh, batch_sharding, replicated,
                                       shard_batch, replicate, DP, MP, PP, SP)
 from paddle_tpu.parallel import sharding

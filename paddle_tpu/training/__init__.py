@@ -1,3 +1,5 @@
+"""Trainer, evaluators, events, checkpointing, aux hygiene (the
+ref:paddle/trainer twin)."""
 from paddle_tpu.training.trainer import Trainer
 from paddle_tpu.training import (events, evaluators, checkpoint,
                                  checkpoint_sharded, aux)
