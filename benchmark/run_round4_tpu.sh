@@ -95,6 +95,9 @@ run lm_decode_b32 python benchmark/lm_decode.py --dim 1024 --layers 12 \
 # 5. Mosaic re-test cadence (VERDICT #10)
 run mosaic_spike python benchmark/spike_fused_dxdw.py
 
+# 5b. CSR/BCOO vs gather head-to-head (VERDICT r5 #7)
+run sparse_feed python benchmark/sparse_feed.py
+
 # 6. flagship bench + verify drivers
 run bench python bench.py
 [ -f /tmp/verify_r4.py ] && run verify_r4 python /tmp/verify_r4.py

@@ -1,0 +1,122 @@
+"""CSR/BCOO vs padded-gather head-to-head on the CTR workload
+(VERDICT r4 #7 — settle the last partial SURVEY row with a number).
+
+Both paths consume the SAME host feed (padded ``[b, k]`` id matrices +
+masks, the feeder contract) and share one parameter tree; they differ
+only in the in-graph sparse-input representation:
+
+- ``gather``: padded id-list gather + mean pool (the product default,
+  ``models/wide_deep.py``) — scatter-add row-sparse grads.
+- ``bcoo``: ``jax.experimental.sparse`` BCOO ``[b, vocab]`` built from
+  the same ids, fields computed as CSR x dense sparse matmuls
+  (``ops/sparse_input.py``) — the reference's CpuSparseMatrix form.
+
+Equivalence (loss/grad equality) is pinned by tests/test_sparse_input.py,
+so the delta below is pure representation cost.  2-3 batch/sparsity
+points; one JSON row per (point, path) + a winner row per point:
+
+    python benchmark/sparse_feed.py [--points b,k[;b,k...]] [--fields N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _make_batch(rs, field_vocabs, b, k):
+    batch = {"label": rs.randint(0, 2, b).astype(np.int32)}
+    for i, v in enumerate(field_vocabs):
+        batch[f"f{i}"] = rs.randint(0, v, (b, k)).astype(np.int32)
+        m = rs.rand(b, k) < 0.75
+        m[:, 0] = True
+        batch[f"f{i}_mask"] = m
+    return batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", default="512,8;512,32;2048,8",
+                    help="semicolon-separated batch,k points")
+    ap.add_argument("--fields", type=int, default=0,
+                    help="truncate the 26-field Criteo-ish vocab list")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--batches", type=int, default=2)
+    args = ap.parse_args()
+
+    import paddle_tpu  # noqa: F401  (env platform contract)
+    from paddle_tpu.utils.watchdog import attach_watchdog
+
+    disarm = attach_watchdog(240.0, {"metric": "sparse_feed",
+                                     "value": 0.0, "unit": "ms/batch"})
+    import jax
+    import jax.numpy as jnp
+
+    jax.devices()
+    disarm()
+
+    from paddle_tpu import optim
+    from paddle_tpu.api.config import settings
+    from paddle_tpu.core.dtypes import mixed_precision
+    from paddle_tpu.models.wide_deep import model_fn_builder
+    from paddle_tpu.ops.sparse_input import wide_deep_bcoo_model_fn_builder
+    from paddle_tpu.training import Trainer
+    from paddle_tpu.utils.timing import marginal_ms_with_spread, timed_run
+
+    # benchmark/ctr.py's Criteo-ish field list
+    field_vocabs = ([1_000_000] * 2 + [500_000] * 2 + [100_000] * 6
+                    + [50_000] * 6 + [10_000] * 10)
+    if args.fields:
+        field_vocabs = field_vocabs[:args.fields]
+
+    points = [tuple(int(x) for x in p.split(","))
+              for p in args.points.split(";")]
+    builders = {
+        "gather": lambda: model_fn_builder(field_vocabs, embed_dim=16,
+                                           hidden=(256, 128)),
+        "bcoo": lambda: wide_deep_bcoo_model_fn_builder(
+            field_vocabs, embed_dim=16, hidden=(256, 128)),
+    }
+    rs = np.random.RandomState(0)
+    for b, k in points:
+        batch = _make_batch(rs, field_vocabs, b, k)
+        ms_by_path = {}
+        for path, builder in builders.items():
+            with mixed_precision():
+                trainer = Trainer(builder(), optim.from_config(settings(
+                    learning_rate=1e-3, learning_method_name="adagrad")))
+                trainer.init(batch)
+                dev = {kk: jnp.asarray(v) for kk, v in batch.items()}
+                K = 4
+                stack = {kk: jnp.stack([v] * K) for kk, v in dev.items()}
+                step_fn = lambda: trainer.train_batches(stack)[-1]
+                timed_run(step_fn, 1)               # burn-in/compile
+                ms, spread = marginal_ms_with_spread(
+                    step_fn, n=max(1, args.batches), repeats=args.repeats)
+                ms /= K
+                ms_by_path[path] = ms
+                row = {"metric": f"ctr wide-deep b{b} k{k} "
+                                 f"fields{len(field_vocabs)} [{path}]",
+                       "backend": jax.default_backend(),
+                       "value": round(ms, 3), "unit": "ms/batch"}
+                if spread is not None:
+                    row["spread_ms"] = round(spread / K, 4)
+                print(json.dumps(row), flush=True)
+            del trainer, stack, dev
+            import gc
+            gc.collect()
+        g, s = ms_by_path["gather"], ms_by_path["bcoo"]
+        print(json.dumps({
+            "metric": f"ctr b{b} k{k} winner",
+            "winner": "gather" if g <= s else "bcoo",
+            "gather_ms": round(g, 3), "bcoo_ms": round(s, 3),
+            "bcoo_over_gather": round(s / g, 2)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""BCOO sparse-input path for id-list features — the CSR/CSC question,
+answered by measurement.
+
+The reference stores ``sparse_binary_vector`` slots as CSR/CSC host
+matrices (``ref:paddle/math/CpuSparseMatrix.h``) and keeps sparse-row
+parameter shards (``ref:paddle/math/SparseRowMatrix.h:29``); its sparse
+linear/embedding layers multiply CSR x dense.  The TPU-native default
+here is the padded id-list GATHER (``models/wide_deep.py``): static
+shapes, gather/scatter-add lowering, row-sparse gradients.  This module
+provides the honest alternative — the same multi-hot rows as
+``jax.experimental.sparse`` BCOO matrices and sparse-matmul field ops
+with IDENTICAL parameter paths — so the two input paths can be
+head-to-head measured (``benchmark/sparse_feed.py``) on the CTR
+workload; the verdict lands in ``docs/design/sparse.md``.
+
+Input contract matches the feeder: each field arrives as a padded id
+matrix ``[b, k]`` + mask; conversion to BCOO happens in-graph (both
+paths consume the same host feed, so the conversion cost is part of
+the comparison, exactly like the reference's CPU CSR assembly was).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax.experimental.sparse import BCOO
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import initializers as init
+from paddle_tpu.ops import losses
+
+
+def field_to_bcoo(ids, mask, vocab: int, dtype=jnp.float32) -> BCOO:
+    """Multi-hot field ``[b, k]`` ids + mask -> batched BCOO
+    ``[b, vocab]`` with ``nse = k`` per row: data is the mask (so padded
+    slots contribute zero), indices are the ids.  No densification —
+    this IS the sparse storage format, built in-graph.
+
+    Out-of-vocab ids CLAMP to the last row — JAX sparse ops silently
+    drop out-of-range indices, which would diverge from the gather
+    path's ``jnp.take(mode="clip")`` semantics (``nn/layers.py``
+    Embedding) instead of matching it.
+    """
+    b, k = ids.shape
+    data = mask.astype(dtype)                          # [b, k]
+    ids = jnp.minimum(ids, vocab - 1)
+    indices = ids[..., None].astype(jnp.int32)         # [b, k, 1]
+    return BCOO((data, indices), shape=(b, vocab))
+
+
+class _Table(nn.Module):
+    """Raw embedding table param — same path/init as ``nn.Embedding``'s
+    internal ``w`` so a BCOO module can share a gather twin's params."""
+
+    def __init__(self, vocab: int, dim: int, w_init=None, name=None):
+        super().__init__(name)
+        self.vocab, self.dim = vocab, dim
+        self.w_init = w_init or init.normal(0.01)
+
+    def forward(self):
+        from paddle_tpu.core.dtypes import get_policy
+        return nn.param("w", (self.vocab, self.dim),
+                        get_policy().param_dtype, self.w_init)
+
+
+class BCOOSparseLinear(nn.Module):
+    """Wide half via sparse matmul: ``x_sp [b,V] @ w [V,1]`` — the CSR x
+    dense form of ``models.wide_deep.SparseLinear`` (param-compatible:
+    both store ``<name>/w/w``)."""
+
+    def __init__(self, vocab_size: int, name=None):
+        super().__init__(name)
+        self.vocab = vocab_size
+
+    def forward(self, ids, mask):
+        # mirror the gather twin's dtypes exactly: nn.Embedding casts
+        # its gather to the policy OUTPUT dtype, so the wide sum runs
+        # bf16 under the mixed policy on both paths
+        from paddle_tpu.core.dtypes import get_policy
+        policy = get_policy()
+        w = policy.cast_to_output(
+            _Table(self.vocab, 1, w_init=init.zeros, name="w")())
+        x_sp = field_to_bcoo(ids, mask, self.vocab, dtype=w.dtype)
+        return (x_sp @ w)[..., 0]                              # [b]
+
+
+class BCOOFieldEmbedding(nn.Module):
+    """Deep half via sparse matmul: mean-pooled ``x_sp @ table`` — the
+    CSR x dense form of ``models.wide_deep.FieldEmbedding``
+    (param-compatible: both store ``<name>/table/w``)."""
+
+    def __init__(self, vocab_size: int, dim: int, name=None):
+        super().__init__(name)
+        self.vocab, self.dim = vocab_size, dim
+
+    def forward(self, ids, mask):
+        from paddle_tpu.core.dtypes import get_policy
+        policy = get_policy()
+        # mirror the gather twin dtype-for-dtype (Embedding casts to the
+        # policy OUTPUT dtype; the f32 denom then promotes the result) —
+        # the head-to-head must measure the sparse REPRESENTATION, not a
+        # dtype difference
+        table = policy.cast_to_output(
+            _Table(self.vocab, self.dim, name="table")())
+        x_sp = field_to_bcoo(ids, mask, self.vocab, dtype=table.dtype)
+        pooled = x_sp @ table                                  # [b, d]
+        denom = jnp.maximum(mask.sum(-1, keepdims=True), 1.0)
+        return pooled / denom
+
+
+def wide_deep_bcoo_model_fn_builder(field_vocabs: Sequence[int],
+                                    embed_dim: int = 16,
+                                    hidden: Sequence[int] = (64, 32)):
+    """BCOO-input twin of ``models.wide_deep.model_fn_builder`` — same
+    parameter tree (init from either, apply with both), same loss, only
+    the sparse-input representation differs.  Exists for the measured
+    head-to-head; the gather path stays the product default unless the
+    numbers say otherwise (docs/design/sparse.md)."""
+    from paddle_tpu.models.wide_deep import WideDeep
+
+    class WideDeepBCOO(WideDeep):
+        def forward(self, fields):
+            wide = 0.0
+            deep_in = []
+            for i, (ids, mask) in enumerate(fields):
+                wide = wide + BCOOSparseLinear(
+                    self.field_vocabs[i], name=f"wide_{i}")(ids, mask)
+                deep_in.append(BCOOFieldEmbedding(
+                    self.field_vocabs[i], self.embed_dim,
+                    name=f"embed_{i}")(ids, mask))
+            x = jnp.concatenate(deep_in, axis=-1)
+            for j, h in enumerate(self.hidden):
+                x = nn.Linear(h, act="relu", name=f"fc_{j}")(x)
+            deep = nn.Linear(1, name="fc_out")(x)[..., 0]
+            bias = nn.param("bias", (1,), jnp.float32, init.zeros)
+            return wide + deep + bias[0]
+
+    def model_fn(batch):
+        n = len(field_vocabs)
+        fields = [(batch[f"f{i}"], batch[f"f{i}_mask"]) for i in range(n)]
+        logit = WideDeepBCOO(field_vocabs, embed_dim=embed_dim,
+                             hidden=hidden, name="wd")(fields)
+        label = batch["label"].astype(jnp.float32)
+        loss = losses.sigmoid_cross_entropy(logit[:, None],
+                                            label[:, None]).mean()
+        # same aux surface as the gather builder: evaluators read
+        # "prob"/"label", and the timed graphs must match op-for-op
+        prob = jnp.clip(jnp.where(
+            logit >= 0, 1.0 / (1.0 + jnp.exp(-logit)),
+            jnp.exp(logit) / (1.0 + jnp.exp(logit))), 1e-6, 1 - 1e-6)
+        return loss, {"prob": prob, "label": batch["label"],
+                      "logit": logit}
+
+    return model_fn
