@@ -1,0 +1,145 @@
+"""Transformer-LM MFU decomposition — the per-component cost attribution
+for VERDICT r5 #3: if the d1024 train-step MFU lands under the ~55-60%
+north star, this names WHERE the gap lives (the ResNet-campaign method:
+ideal vs actual HBM bytes + per-component MFU, docs/design/kernels.md).
+
+Components timed with the shared differential protocol, each as a full
+train step over the SAME trainer machinery (so optimizer/dispatch share
+cancels in the comparison):
+
+    full        the benchmark model (transformer_lm.py shapes)
+    no_attn     attention replaced by identity — isolates FFN+proj+embed
+    no_ffn      FFN replaced by identity — isolates attention+embeddings
+    head_only   0 transformer layers — embed + final vocab matmul + loss
+
+Each row reports ms/batch, XLA-counted FLOPs, achieved MFU, and the
+executable's 'bytes accessed' (HBM traffic as compiled) — `full` minus
+component rows attributes time/bytes to the removed block.
+
+    python benchmark/lm_mfu_decompose.py [--dim 1024 ...] [--flash]
+    python benchmark/lm_mfu_decompose.py --smoke   # tiny CPU pipeline check
+
+One JSON line per component.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--flash", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes; pipeline check only")
+    args = ap.parse_args()
+    if args.smoke:
+        args.dim, args.layers, args.vocab = 32, 2, 100
+        args.batch, args.seq, args.repeats = 2, 16, 1
+
+    import paddle_tpu  # noqa: F401  (env platform contract)
+    from paddle_tpu.utils.watchdog import attach_watchdog
+
+    disarm = attach_watchdog(240.0, {"metric": "lm_mfu_decompose",
+                                     "value": 0.0, "unit": "ms/batch"})
+    import jax
+    import jax.numpy as jnp
+
+    jax.devices()
+    disarm()
+
+    from paddle_tpu import optim
+    from paddle_tpu.core.dtypes import mixed_precision
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.training import Trainer
+    from paddle_tpu.utils import mfu as mfu_mod
+    from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
+
+    heads = max(1, args.dim // 64)
+    base = dict(vocab_size=args.vocab, dim=args.dim, num_heads=heads,
+                num_layers=args.layers, ffn_mult=4, max_len=args.seq,
+                causal=True, flash=args.flash)
+
+    # component ablations via monkey-patchable module hooks: identity
+    # attention / identity FFN keep every shape and residual intact, so
+    # the surviving blocks see exactly the benchmark tensors
+    def identity_attn(q, k, v, mask=None, causal=True):
+        return q
+
+    variants = {
+        "full": (tfm.TransformerConfig(**base), None),
+        "no_attn": (tfm.TransformerConfig(**base), identity_attn),
+        "no_ffn": (tfm.TransformerConfig(**{**base, "ffn_mult": 0}), None),
+        "head_only": (tfm.TransformerConfig(**{**base, "num_layers": 0}),
+                      None),
+    }
+
+    rs = np.random.RandomState(0)
+    batch = {"ids": rs.randint(0, args.vocab, (args.batch, args.seq))
+             .astype(np.int32),
+             "ids_mask": np.ones((args.batch, args.seq), bool)}
+    rows = {}
+    for name, (cfg, attn_fn) in variants.items():
+        with mixed_precision():
+            trainer = Trainer(tfm.lm_model_fn_builder(cfg, attn_fn=attn_fn),
+                              optim.adam(3e-4))
+            trainer.init(batch)
+            dev = {k: jnp.asarray(v) for k, v in batch.items()}
+            K = 2 if args.smoke else 4
+            stack = {k: jnp.stack([v] * K) for k, v in dev.items()}
+            step_fn = lambda: trainer.train_batches(stack)[-1]
+            timed_run(step_fn, 1)
+            ms = marginal_ms_per_batch(step_fn, n=1 if args.smoke else 2,
+                                       repeats=args.repeats) / K
+            # ONE compile serves flops AND bytes; both are counted
+            # trip-count-invariantly (the scan body once = one batch),
+            # so neither divides by K
+            cost = mfu_mod.compiled_cost(
+                trainer._train_scan, trainer.params, trainer.net_state,
+                trainer.opt_state, stack, trainer._step_array())
+            flops, nbytes = cost["flops"], cost["bytes_accessed"]
+            gbytes = nbytes / 1e9 if nbytes is not None else None
+            val = (mfu_mod.mfu(flops, ms / 1e3)
+                   if flops is not None else None)
+        rows[name] = (ms, flops, gbytes)
+        print(json.dumps({
+            "component": name, "ms_per_batch": round(ms, 3),
+            "tflops_per_batch": (round(flops / 1e12, 3)
+                                 if flops is not None else None),
+            "hbm_gb_per_batch": (round(gbytes, 3)
+                                 if gbytes is not None else None),
+            "mfu": round(val, 4) if val is not None else None,
+            "backend": jax.default_backend()}), flush=True)
+        # drop EVERY reference (step_fn's closure + the AOT executable
+        # would otherwise keep the whole variant HBM-resident while the
+        # next one initializes)
+        del trainer, stack, dev, step_fn, cost
+        import gc
+        gc.collect()
+
+    full_ms, _, full_gb = rows["full"]
+    for name in ("no_attn", "no_ffn", "head_only"):
+        ms, _, gb = rows[name]
+        row = {"component": f"attributed:{name}",
+               "removed_block_ms": round(full_ms - ms, 3),
+               "removed_block_share": round(1.0 - ms / full_ms, 3)}
+        if full_gb is not None and gb is not None:
+            row["removed_block_hbm_gb"] = round(full_gb - gb, 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,11 @@ grep -q "RESOURCE_EXHAUSTED\|out of memory" "$OUT/lm_d2048.log" && \
       --config-args dim=2048,batch_size=8,remat=1 --batches 4 --burn-in 4 \
       --repeats 5
 
+# 2b. per-component MFU decomposition (the VERDICT #3 follow-up data —
+#     run unconditionally so the attribution exists even if the tunnel
+#     wedges again right after the headline rows)
+run lm_decompose python benchmark/lm_mfu_decompose.py --repeats 3
+
 # 3. real-chip C-API serving throughput (VERDICT #5)
 run serving python benchmark/serving_capi.py --threads 1,2,4 --requests 64
 

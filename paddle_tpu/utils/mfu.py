@@ -39,23 +39,32 @@ def peak_flops(device=None) -> Optional[float]:
     return best[1] if best else None
 
 
-def compiled_flops(fn: Callable, *args, **kwargs) -> Optional[float]:
-    """FLOPs of one execution of ``jit(fn)(*args)`` per XLA's cost
-    analysis of the compiled executable; None if the backend does not
-    report it."""
+def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
+    """``{"flops": float|None, "bytes_accessed": float|None}`` from ONE
+    ``lower().compile()`` of ``fn`` — both read from the same XLA cost
+    analysis, so callers never pay a second multi-minute compile just
+    for the bytes."""
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     compiled = jitted.lower(*args, **kwargs).compile()
     try:
         analyses = compiled.cost_analysis()
     except Exception:
-        return None
-    if analyses is None:
-        return None
+        analyses = None
     # cost_analysis returns one dict (or a per-device list on older jax)
     if isinstance(analyses, (list, tuple)):
         analyses = analyses[0] if analyses else {}
+    analyses = analyses or {}
     flops = analyses.get("flops")
-    return float(flops) if flops else None
+    nbytes = analyses.get("bytes accessed")
+    return {"flops": float(flops) if flops else None,
+            "bytes_accessed": float(nbytes) if nbytes else None}
+
+
+def compiled_flops(fn: Callable, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one execution of ``jit(fn)(*args)`` per XLA's cost
+    analysis of the compiled executable; None if the backend does not
+    report it."""
+    return compiled_cost(fn, *args, **kwargs)["flops"]
 
 
 def mfu(flops_per_step: float, seconds_per_step: float,
