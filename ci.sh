@@ -17,7 +17,7 @@ cd "$(dirname "$0")"
 
 echo "== lint: syntax + bytecode compile =="
 python -m compileall -q paddle_tpu tests benchmark examples bench.py \
-    __graft_entry__.py tpu_smoke.py
+    __graft_entry__.py tpu_smoke.py docs/gen_api_reference.py
 python - <<'EOF'
 # import-surface check: the public package must import clean.  A TPU
 # sitecustomize may have booted the axon plugin already; env vars alone
