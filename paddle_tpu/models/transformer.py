@@ -74,7 +74,8 @@ class TransformerBlock(Module):
         self.layer_idx = layer_idx
         self.attn_fn = attn_fn
 
-    def forward(self, x, mask=None, cache=None, position=None):
+    def forward(self, x, mask=None, cache=None, position=None,
+                cache_valid=None):
         cfg = self.cfg
         new_cache = None
         h = nn.LayerNorm(name="ln_attn")(x)
@@ -82,7 +83,8 @@ class TransformerBlock(Module):
                                   attn_fn=self.attn_fn, name="attn")
         if cache is not None:
             h, new_cache = attn(h, mask=mask, cache=cache,
-                                position=position)
+                                position=position,
+                                cache_valid=cache_valid)
         else:
             h = attn(h, mask=mask)
         if cfg.dropout:
@@ -112,27 +114,40 @@ class TransformerLM(Module):
         self.cfg = cfg
         self.attn_fn = attn_fn
 
-    def forward(self, ids, mask=None, caches=None, position=None):
+    def forward(self, ids, mask=None, caches=None, position=None,
+                pos_ids=None, cache_valid=None):
         """``caches`` (per-layer ``(k, v)`` pairs) + ``position`` run
         the incremental-decoding form: keys/values write into the
         caches at ``position`` and ``(logits, new_caches)`` returns —
         prefill passes the whole prompt at position 0, decode passes
         one token per step.  Static shapes, so one compiled step
-        serves every position."""
+        serves every position.
+
+        Ragged-batch decoding (right-aligned prompts): ``pos_ids``
+        [b, t] overrides the positional-embedding indices per row (a
+        left-padded row's first real token is semantic position 0), and
+        ``cache_valid`` [b, max_len] marks the cache rows holding real
+        tokens so attention never reads a pad key — see
+        :func:`lm_serve_builder`'s ``prompt_lens``."""
         cfg = self.cfg
         policy = get_policy()
         b, t = ids.shape
         x = nn.Embedding(cfg.vocab_size, cfg.dim, name="embed")(ids)
         pos = param("pos_embed", (cfg.max_len, cfg.dim), policy.param_dtype,
                     init.normal(0.02))
-        start = 0 if position is None else position
-        x = x + jax.lax.dynamic_slice_in_dim(pos, start, t, axis=0)[None]
+        if pos_ids is not None:
+            x = x + jnp.take(pos, pos_ids, axis=0, mode="clip")
+        else:
+            start = 0 if position is None else position
+            x = x + jax.lax.dynamic_slice_in_dim(pos, start, t,
+                                                 axis=0)[None]
         new_caches = [] if caches is not None else None
         for i in range(cfg.num_layers):
             block = TransformerBlock(cfg, layer_idx=i, attn_fn=self.attn_fn,
                                      name=f"block_{i}")
             if caches is not None:
-                x, c = block(x, mask, cache=caches[i], position=position)
+                x, c = block(x, mask, cache=caches[i], position=position,
+                             cache_valid=cache_valid)
                 new_caches.append(c)
             elif cfg.remat:
                 x = nn.remat(block, x, mask)
@@ -182,9 +197,10 @@ def _cached_lm(cfg: TransformerConfig, attn_fn):
         from paddle_tpu.ops.attention import flash_attention_fn
         attn_fn = flash_attention_fn
     model = nn.transform(
-        lambda ids, caches, position: TransformerLM(
-            cfg, attn_fn=attn_fn, name="lm")(
-                ids, caches=caches, position=position))
+        lambda ids, caches, position, pos_ids=None, cache_valid=None:
+            TransformerLM(cfg, attn_fn=attn_fn, name="lm")(
+                ids, caches=caches, position=position, pos_ids=pos_ids,
+                cache_valid=cache_valid))
     hd = cfg.dim // cfg.num_heads
 
     def make_caches(b, dtype):
@@ -348,6 +364,13 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
     traced requests on the host.  Token streams are identical to
     :func:`lm_generate_builder` at equal ``steps`` (same rng-split
     order, shared :func:`_sampling_picker`).
+
+    RAGGED batches: pass ``prompt_lens`` [b] with prompts
+    RIGHT-aligned in ``prompt_ids`` (:func:`right_align` builds both
+    from a list) — per-row position ids restart each row's semantic
+    positions at 0 and a cache-validity mask hides the left-pad rows
+    from every attention read, so each row decodes exactly as if it
+    were batched alone (pinned by the ragged-vs-solo equality test).
     """
     import functools
 
@@ -355,7 +378,8 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
 
     @functools.partial(jax.jit, static_argnums=(5, 6, 7))
     def _serve(params, prompt_ids, steps, temperature: float = 0.0,
-               rng=None, eos_id=None, top_k=None, top_p=None):
+               rng=None, eos_id=None, top_k=None, top_p=None,
+               prompt_lens=None):
         b, tp = prompt_ids.shape
         max_new = cfg.max_len - tp
         assert max_new >= 1, (
@@ -376,8 +400,23 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
         pick = _sampling_picker(cfg, temp, prompt_ids.dtype, eos_id,
                                 top_k, top_p)
 
+        if prompt_lens is None:
+            pos_ids = cache_valid = None
+            lens = None
+        else:
+            # ragged batch: prompts are RIGHT-aligned, row r's real
+            # tokens in columns [tp - len_r, tp).  Per-row position ids
+            # restart each row's semantic positions at 0; cache_valid
+            # hides the pad rows from every future attention read.
+            lens = jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, tp)
+            lpad = tp - lens                                   # [b]
+            pos_ids = jnp.maximum(
+                jnp.arange(tp)[None, :] - lpad[:, None], 0)    # [b, tp]
+            cache_valid = (jnp.arange(cfg.max_len)[None, :]
+                           >= lpad[:, None])                   # [b, L]
+
         (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
-                                          caches, 0)
+                                          caches, 0, pos_ids, cache_valid)
         k0, rng_key = jax.random.split(rng_key)
         tok, done = pick(logits[:, -1], k0, jnp.zeros((b,), bool))
         buf = jnp.full((b, max_new), pad, prompt_ids.dtype)
@@ -397,8 +436,11 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
             caches, tok, key, done, buf, i = carry
             # feeds token t_{i-1}, whose keys/values belong at cache
             # row tp + i - 1; picks t_i into buffer column i
+            step_pos_ids = (None if lens is None
+                            else (lens + i - 1)[:, None])      # [b, 1]
             (lg, caches), _ = model.apply(params, {}, None, tok[:, None],
-                                          caches, tp + i - 1)
+                                          caches, tp + i - 1,
+                                          step_pos_ids, cache_valid)
             key, sub = jax.random.split(key)
             nxt, done = pick(lg[:, -1], sub, done)
             buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
@@ -410,7 +452,8 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
         return jnp.concatenate([prompt_ids, buf], axis=1)
 
     def serve(params, prompt_ids, steps, temperature: float = 0.0,
-              rng=None, eos_id=None, top_k=None, top_p=None):
+              rng=None, eos_id=None, top_k=None, top_p=None,
+              prompt_lens=None):
         # host-side wrapper: a concrete over-length request fails
         # LOUDLY (generate's contract) — inside jit ``steps`` is always
         # a tracer, so this check cannot live in the compiled body;
@@ -424,11 +467,47 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
         # normalize to strong i32: a weak-typed Python int and a strong
         # jnp scalar would otherwise trace as DIFFERENT avals and split
         # the compile cache in two
+        if prompt_lens is not None:
+            # loud host-side validation, same contract as steps: a
+            # clipped bad length would silently treat pad tokens as
+            # prompt (the in-jit clip only guards traced values)
+            lens_arr = np.asarray(prompt_lens)
+            if lens_arr.dtype.kind in "iu":      # host-concrete
+                tp = prompt_ids.shape[1]
+                assert lens_arr.min() >= 1 and lens_arr.max() <= tp, (
+                    f"serve: prompt_lens outside [1, {tp}] "
+                    f"(got min {lens_arr.min()}, max {lens_arr.max()}) "
+                    "— pads would be decoded as prompt tokens")
+            prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
         return _serve(params, prompt_ids, jnp.asarray(steps, jnp.int32),
-                      temperature, rng, eos_id, top_k, top_p)
+                      temperature, rng, eos_id, top_k, top_p,
+                      prompt_lens)
 
     serve._cache_size = _serve._cache_size   # the no-retrace proof hook
     return serve
+
+
+def right_align(seqs, width: Optional[int] = None, pad_id: int = 0):
+    """Host-side ragged-batch packer for :func:`lm_serve_builder`:
+    a list of 1-D id sequences -> ``(prompt_ids [b, width] int32,
+    prompt_lens [b] int32)`` with each row RIGHT-aligned (left-padded
+    with ``pad_id``).  ``width`` defaults to the longest sequence —
+    round it up to a few bucket widths in a serving process so ragged
+    requests share compiled programs."""
+    import numpy as onp
+
+    from paddle_tpu.core.errors import enforce
+
+    lens = [len(s) for s in seqs]
+    enforce(bool(lens) and all(n >= 1 for n in lens),
+            "right_align: every sequence needs >= 1 token")
+    w = width or max(lens)
+    enforce(max(lens) <= w, "right_align: longest sequence (%d) "
+            "exceeds width %d", max(lens), w)
+    out = onp.full((len(seqs), w), pad_id, onp.int32)
+    for r, s in enumerate(seqs):
+        out[r, w - len(s):] = onp.asarray(s, onp.int32)
+    return out, onp.asarray(lens, onp.int32)
 
 
 def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,

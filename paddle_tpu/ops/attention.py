@@ -167,7 +167,7 @@ class MultiHeadAttention(Module):
         self.attn_fn = attn_fn
 
     def forward(self, x, kv=None, mask: Optional[jax.Array] = None,
-                cache=None, position=None):
+                cache=None, position=None, cache_valid=None):
         """``cache=(k_cache, v_cache)`` ([b, max_len, h, hd] each) turns
         the call into an INCREMENTAL-DECODING step: the new keys/values
         write into the caches at ``position`` (the global index of
@@ -176,7 +176,18 @@ class MultiHeadAttention(Module):
         every decode position.  Returns ``(out, new_cache)`` then.  The
         decode path always uses the einsum attention (a 1-token query
         has no t² matrix to avoid; flash/ring ``attn_fn`` apply to the
-        batched prefill/training forms)."""
+        batched prefill/training forms).
+
+        ``cache_valid`` ([b, max_len] bool) marks which WRITTEN cache
+        rows hold real tokens — the ragged-batch form: right-aligned
+        (left-padded) prompts leave their pad rows False so no query
+        ever attends a pad key.  It is the cache-axis-aligned
+        replacement for the [b, t] token ``mask``, which stays
+        unsupported in cache mode (it does not line up with the cache
+        axis).  The position-0 prefill keeps the flash/ring ``attn_fn``
+        path: rows [0, t) of ``cache_valid`` are exactly the fresh
+        keys' validity, which the attn_fn takes as its key mask
+        (flash maps it onto SegmentIds)."""
         policy = get_policy()
         b, t, dim = x.shape
         h = self.num_heads
@@ -228,12 +239,21 @@ class MultiHeadAttention(Module):
                         "attn_fn prefill is only supported at position "
                         "0 (got %d): flash/ring attention sees only the "
                         "fresh k/v, not the cached prefix", int(position))
-                out = self.attn_fn(q, k, v, mask=None, causal=self.causal)
+                # ragged prefill keeps the flash path: the fresh keys
+                # are cache rows [0, t), so their validity IS the key
+                # mask (don't drop to the einsum path and materialize
+                # the [t, max_len] scores flash exists to avoid)
+                prefill_mask = (None if cache_valid is None
+                                else cache_valid[:, :t])
+                out = self.attn_fn(q, k, v, mask=prefill_mask,
+                                   causal=self.causal)
             else:
                 written = (jnp.arange(k_cache.shape[1])[None, :]
                            < position + t)              # [1, max_len]
                 key_mask = jnp.broadcast_to(written,
                                             (b, k_cache.shape[1]))
+                if cache_valid is not None:
+                    key_mask = key_mask & cache_valid
                 out = dot_product_attention(
                     q, k_cache, v_cache, mask=key_mask,
                     causal=self.causal, q_offset=position)

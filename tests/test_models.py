@@ -478,3 +478,116 @@ def test_lm_serve_eos_early_exit_token_identical(rng):
     np.testing.assert_array_equal(got[:, :4 + 12], want)
     # PAD past steps is eos when eos_id is given
     assert np.all(got[:, 4 + 12:] == eos)
+
+
+def test_lm_serve_flash_config_matches_generate(rng):
+    """The campaign's --flash serve arm: a flash=True config must decode
+    token-identically through serve (while_loop) and generate (scan) —
+    on CPU via the off-grid fallback, same wiring the chip exercises."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                            num_layers=1, max_len=16, causal=True,
+                            flash=True)
+    plain = nn.transform(lambda ids: TransformerLM(
+        TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                          num_layers=1, max_len=16, causal=True),
+        name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 32, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    want = np.asarray(lm_generate_builder(cfg)(params, prompt, 6))
+    got = np.asarray(lm_serve_builder(cfg)(params, prompt, 6))
+    np.testing.assert_array_equal(got[:, :4 + 6], want)
+
+
+def test_lm_serve_ragged_rows_match_solo_decodes(rng):
+    """Ragged serving (right-aligned prompts + prompt_lens): every row
+    must emit EXACTLY the tokens it would emit batched alone with a
+    dense prompt — per-row position ids + the cache-validity mask make
+    left-pads invisible (greedy; f32 CPU determinism)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder,
+                                               right_align)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=40, dim=16, num_heads=2,
+                            num_layers=2, max_len=24)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    seqs = [list(rng.randint(0, 40, n)) for n in (3, 7, 5)]
+    prompt_ids, prompt_lens = right_align(seqs, pad_id=1)
+    assert prompt_ids.shape == (3, 7)
+    params, _ = plain.init(jax.random.key(0),
+                           jnp.asarray(prompt_ids, jnp.int32))
+    serve = lm_serve_builder(cfg)
+    generate = lm_generate_builder(cfg)
+
+    steps = 6
+    got = np.asarray(serve(params, jnp.asarray(prompt_ids, jnp.int32),
+                           steps, prompt_lens=prompt_lens))
+    tp = prompt_ids.shape[1]
+    for r, s in enumerate(seqs):
+        solo = jnp.asarray(np.asarray(s, np.int32)[None])
+        want = np.asarray(generate(params, solo, steps))[0, len(s):]
+        np.testing.assert_array_equal(got[r, tp:tp + steps], want,
+                                      err_msg=f"row {r} len {len(s)}")
+
+    # the ragged program is still retrace-free across steps values
+    got2 = np.asarray(serve(params, jnp.asarray(prompt_ids, jnp.int32),
+                            3, prompt_lens=prompt_lens))
+    np.testing.assert_array_equal(got2[:, tp:tp + 3],
+                                  got[:, tp:tp + 3])
+    assert serve._cache_size() == 1, (
+        "ragged serve retraced across steps values")
+
+    # bad lengths fail LOUDLY (a silent clip would decode pad tokens)
+    import pytest
+    with pytest.raises(AssertionError, match="prompt_lens"):
+        serve(params, jnp.asarray(prompt_ids, jnp.int32), 3,
+              prompt_lens=np.asarray([9, 1, 1], np.int32))
+
+
+def test_lm_serve_ragged_flash_config_matches_solo(rng):
+    """Ragged serving with flash=True: the position-0 prefill keeps the
+    attn_fn path, feeding cache_valid[:, :t] as the key mask (CPU
+    fallback exercises the same plumbing the TPU kernel gets)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder,
+                                               right_align)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=40, dim=16, num_heads=2,
+                            num_layers=1, max_len=20, flash=True)
+    plain = nn.transform(lambda ids: TransformerLM(
+        TransformerConfig(vocab_size=40, dim=16, num_heads=2,
+                          num_layers=1, max_len=20), name="lm")(ids))
+    seqs = [list(rng.randint(0, 40, n)) for n in (2, 6)]
+    prompt_ids, prompt_lens = right_align(seqs, pad_id=3)
+    params, _ = plain.init(jax.random.key(1),
+                           jnp.asarray(prompt_ids, jnp.int32))
+    got = np.asarray(lm_serve_builder(cfg)(
+        params, jnp.asarray(prompt_ids, jnp.int32), 5,
+        prompt_lens=prompt_lens))
+    generate = lm_generate_builder(cfg)
+    tp = prompt_ids.shape[1]
+    for r, s in enumerate(seqs):
+        solo = jnp.asarray(np.asarray(s, np.int32)[None])
+        want = np.asarray(generate(params, solo, 5))[0, len(s):]
+        np.testing.assert_array_equal(got[r, tp:tp + 5], want,
+                                      err_msg=f"row {r}")
