@@ -559,34 +559,8 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
             (b, K), bool)
 
         def step(carry, i):
-            caches, tok, scores, hist, done = carry
-            # ``i`` is the hist column being FILLED; the fed token sits
-            # one position earlier (tp + i - 1), which is where its
-            # keys/values belong in the cache.
-            (lg, caches), _ = model.apply(params, {}, None,
-                                          tok[:, None].astype(jnp.int32),
-                                          caches, tp + i - 1)
-            logp = jax.nn.log_softmax(
-                lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
-            if eos_id is not None:
-                # finished beams: score freezes, only eos survives —
-                # the shared seq2seq freeze convention
-                from paddle_tpu.ops.beam_search import frozen_eos_row
-                logp = jnp.where(done[..., None],
-                                 frozen_eos_row(V, eos_id), logp)
-            cand = (scores[..., None] + logp).reshape(b, K * V)
-            scores, idx = jax.lax.top_k(cand, K)       # sorted desc
-            parent = idx // V                          # [b, K]
-            tok_new = (idx % V).astype(hist.dtype)
-            rows = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
-            caches = jax.tree_util.tree_map(lambda c: c[rows], caches)
-            hist = jnp.take_along_axis(hist, parent[..., None], axis=1)
-            hist = hist.at[:, :, i].set(tok_new)
-            if eos_id is not None:
-                done = (jnp.take_along_axis(done, parent, axis=1)
-                        | (tok_new == eos_id))
-            return (caches, tok_new.reshape(b * K), scores, hist,
-                    done), ()
+            return _beam_step(model, params, cfg, K, eos_id, tp,
+                              *carry, i), ()
 
         (_, _, scores, hist, _), _ = jax.lax.scan(
             step, (caches, tok, scores, hist, done), jnp.arange(1, steps))
@@ -595,6 +569,121 @@ def lm_beam_search_builder(cfg: TransformerConfig, beam_size: int,
         return jnp.concatenate([prompt_tiled, hist], axis=2), scores
 
     return search
+
+
+def _beam_step(model, params, cfg, K, eos_id, tp, caches, tok, scores,
+               hist, done, i):
+    """One beam-candidate expansion step — the ONE home of the
+    freeze-row/candidate/top-k/parent-gather arithmetic shared by the
+    scan decoder (:func:`lm_beam_search_builder`) and the while_loop
+    decoder (:func:`lm_beam_serve_builder`), so their documented
+    token/score-identity cannot drift.  ``i`` is the hist column being
+    FILLED; the fed token sits one position earlier (``tp + i - 1``),
+    which is where its keys/values belong in the cache."""
+    b = hist.shape[0]
+    V = cfg.vocab_size
+    (lg, caches), _ = model.apply(params, {}, None,
+                                  tok[:, None].astype(jnp.int32),
+                                  caches, tp + i - 1)
+    logp = jax.nn.log_softmax(
+        lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
+    if eos_id is not None:
+        # finished beams: score freezes, only eos survives — the
+        # shared seq2seq freeze convention
+        from paddle_tpu.ops.beam_search import frozen_eos_row
+        logp = jnp.where(done[..., None], frozen_eos_row(V, eos_id),
+                         logp)
+    cand = (scores[..., None] + logp).reshape(b, K * V)
+    scores, idx = jax.lax.top_k(cand, K)       # sorted desc
+    parent = idx // V                          # [b, K]
+    tok_new = (idx % V).astype(hist.dtype)
+    rows = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+    caches = jax.tree_util.tree_map(lambda c: c[rows], caches)
+    hist = jnp.take_along_axis(hist, parent[..., None], axis=1)
+    hist = jax.lax.dynamic_update_slice(hist, tok_new[:, :, None],
+                                        (0, 0, i))
+    if eos_id is not None:
+        done = (jnp.take_along_axis(done, parent, axis=1)
+                | (tok_new == eos_id))
+    return caches, tok_new.reshape(b * K), scores, hist, done
+
+
+def lm_beam_serve_builder(cfg: TransformerConfig, beam_size: int,
+                          attn_fn=None, eos_id=None):
+    """Serving-shaped beam search: the :func:`lm_serve_builder` contract
+    for the beam decoder — ``steps`` is a TRACED scalar, the step loop a
+    ``lax.while_loop`` that exits early once every hypothesis emitted
+    ``eos_id``, so ONE compiled program per (batch, prompt-length)
+    bucket serves any requested beam-decode length.
+
+    Returns ``beam_serve(params, prompt_ids, steps) -> (tokens
+    [b, beam, tp + max_new], scores [b, beam])`` with columns past the
+    requested ``steps`` (or past the all-finished exit) holding PAD
+    (``eos_id``, else 0); slice ``[:, :, :tp + steps]`` on the host.
+    Token- and score-identical to :func:`lm_beam_search_builder` at
+    equal ``steps`` (shared :func:`_beam_step`).  ``eos_id`` is
+    builder-static here (a serving process fixes its tokenizer)."""
+    model, make_caches = _cached_lm(cfg, attn_fn)
+    V = cfg.vocab_size
+    K = beam_size
+    assert eos_id is None or 0 <= eos_id < V, (
+        f"eos_id {eos_id} outside vocab {V}")
+
+    @jax.jit
+    def _beam_serve(params, prompt_ids, steps):
+        b, tp = prompt_ids.shape
+        max_new = cfg.max_len - tp
+        assert max_new >= 1
+        policy = get_policy()
+        steps = jnp.clip(jnp.asarray(steps, jnp.int32), 1, max_new)
+        pad = jnp.asarray(eos_id if eos_id is not None else 0,
+                          prompt_ids.dtype)
+        caches = make_caches(b, policy.compute_dtype)
+        (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
+                                          caches, 0)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        scores, tok0 = jax.lax.top_k(logp, K)          # [b, K]
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=0), caches)
+        hist = jnp.full((b, K, max_new), pad, prompt_ids.dtype)
+        hist = hist.at[:, :, 0].set(tok0.astype(prompt_ids.dtype))
+        tok = tok0.astype(prompt_ids.dtype).reshape(b * K)
+        done = (tok0 == eos_id) if eos_id is not None else jnp.zeros(
+            (b, K), bool)
+
+        def cond(carry):
+            _, _, _, _, done, i = carry
+            live = i < steps
+            if eos_id is not None:
+                live = live & ~jnp.all(done)
+            return live
+
+        def body(carry):
+            caches, tok, scores, hist, done, i = carry
+            caches, tok, scores, hist, done = _beam_step(
+                model, params, cfg, K, eos_id, tp, caches, tok, scores,
+                hist, done, i)
+            return (caches, tok, scores, hist, done, i + 1)
+
+        (_, _, scores, hist, _, _) = jax.lax.while_loop(
+            cond, body, (caches, tok, scores, hist, done,
+                         jnp.asarray(1, jnp.int32)))
+        prompt_tiled = jnp.broadcast_to(prompt_ids[:, None],
+                                        (b, K, tp)).astype(hist.dtype)
+        return jnp.concatenate([prompt_tiled, hist], axis=2), scores
+
+    def beam_serve(params, prompt_ids, steps):
+        max_new = cfg.max_len - prompt_ids.shape[1]
+        if isinstance(steps, (int, np.integer)):
+            assert 1 <= steps <= max_new, (
+                f"beam_serve: steps {int(steps)} outside [1, {max_new}] "
+                f"(prompt {prompt_ids.shape[1]} in max_len "
+                f"{cfg.max_len}) — the result would silently truncate")
+        return _beam_serve(params, prompt_ids,
+                           jnp.asarray(steps, jnp.int32))
+
+    beam_serve._cache_size = _beam_serve._cache_size
+    return beam_serve
 
 
 def _ln(x, g=None, b=None, eps: float = 1e-6):

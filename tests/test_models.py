@@ -591,3 +591,50 @@ def test_lm_serve_ragged_flash_config_matches_solo(rng):
         want = np.asarray(generate(params, solo, 5))[0, len(s):]
         np.testing.assert_array_equal(got[r, tp:tp + 5], want,
                                       err_msg=f"row {r}")
+
+
+def test_lm_beam_serve_matches_search_without_retrace(rng):
+    """Traced-steps beam serving: token- and score-identical to the
+    static-steps beam search at several lengths, PAD past the request,
+    eos early-exit equivalent, ONE jit cache entry across steps."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_beam_search_builder,
+                                               lm_beam_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=24, dim=16, num_heads=2,
+                            num_layers=2, max_len=18)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 24, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    search = lm_beam_search_builder(cfg, 3)
+    tp, max_new = 4, 18 - 4
+
+    # no eos: plain length-bounded beam
+    serve = lm_beam_serve_builder(cfg, 3)
+    for steps in (1, 4, 9):
+        toks, scores = serve(params, prompt, steps)
+        assert np.asarray(toks).shape == (2, 3, tp + max_new)
+        want_t, want_s = search(params, prompt, steps)
+        np.testing.assert_array_equal(
+            np.asarray(toks)[:, :, :tp + steps], np.asarray(want_t))
+        np.testing.assert_allclose(np.asarray(scores),
+                                   np.asarray(want_s), rtol=1e-5)
+        assert np.all(np.asarray(toks)[:, :, tp + steps:] == 0)
+    assert serve._cache_size() == 1
+
+    # eos freeze + early exit: identical to the full-scan freeze
+    free = np.asarray(search(params, prompt, 9)[0])[:, :, tp:]
+    eos = int(np.bincount(free.reshape(-1)).argmax())
+    serve_e = lm_beam_serve_builder(cfg, 3, eos_id=eos)
+    toks_e, scores_e = serve_e(params, prompt, 9)
+    want_te, want_se = search(params, prompt, 9, eos)
+    np.testing.assert_array_equal(
+        np.asarray(toks_e)[:, :, :tp + 9], np.asarray(want_te))
+    np.testing.assert_allclose(np.asarray(scores_e),
+                               np.asarray(want_se), rtol=1e-5)
+    assert np.all(np.asarray(toks_e)[:, :, tp + 9:] == eos)
