@@ -37,6 +37,15 @@ PACKAGES = {
                             "checkpoint", "checkpoint_sharded", "aux"],
     "paddle_tpu.data": ["reader", "provider", "feeder", "image",
                         "proto_shards"],
+    "paddle_tpu.models": ["transformer", "seq2seq", "lstm_classifier",
+                          "resnet", "alexnet", "googlenet", "lenet",
+                          "wide_deep", "sequence_tagging",
+                          "text_classification", "ssd", "gan", "vae",
+                          "traffic_prediction"],
+    "paddle_tpu.framework": ["program", "scope", "registry", "backward",
+                             "executor", "tensor_array", "control_flow",
+                             "ops"],
+    "paddle_tpu.distributed": ["runtime", "master", "launch"],
 }
 
 
