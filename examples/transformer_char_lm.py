@@ -74,7 +74,9 @@ def main(ckpt_dir: str):
 
     import paddle_tpu.nn as nn
     from paddle_tpu.models.transformer import (TransformerLM,
-                                               lm_generate_builder)
+                                               lm_generate_builder,
+                                               lm_serve_builder,
+                                               right_align)
     from paddle_tpu.training import checkpoint as ckpt
 
     trees, _ = ckpt.load(ckpt_dir)
@@ -91,6 +93,22 @@ def main(ckpt_dir: str):
     out = lm_generate_builder(cfg)(params, prompt, 24)
     print("prompt:", prompt[0].tolist())
     print("continuation:", np.asarray(out)[0, 12:].tolist())
+
+    # serving form: one compiled program, a RAGGED batch of requests
+    # (right-aligned + prompt_lens), varied decode lengths — the row
+    # for each request is exactly what it would decode batched alone
+    stream = _stream(2)
+    reqs = [stream[:6].tolist(), stream[6:18].tolist(),
+            stream[18:27].tolist()]
+    ids, lens = right_align(reqs, width=12)
+    serve = lm_serve_builder(cfg)
+    for steps in (6, 12):                 # no retrace across lengths
+        batch_out = np.asarray(serve(params, jnp.asarray(ids), steps,
+                                     prompt_lens=lens))
+        for r in range(len(reqs)):
+            print(f"serve[{r}] steps={steps}:",
+                  batch_out[r, 12:12 + steps].tolist())
+    assert serve._cache_size() == 1
 
 
 if __name__ == "__main__":
