@@ -48,7 +48,14 @@ def main():
                          "1-token and unaffected)")
     ap.add_argument("--decoder", choices=("serve", "generate"),
                     default="serve")
+    ap.add_argument("--ragged", action="store_true",
+                    help="serve a ragged batch (random per-row prompt "
+                         "lengths in [prompt/4, prompt], right-aligned "
+                         "+ prompt_lens) — the realistic serving mix; "
+                         "serve decoder only")
     args = ap.parse_args()
+    if args.ragged and args.decoder != "serve":
+        ap.error("--ragged requires --decoder serve")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.watchdog import attach_watchdog
@@ -77,6 +84,10 @@ def main():
     rs = np.random.RandomState(0)
     prompt = jnp.asarray(rs.randint(0, args.vocab,
                                     (args.batch, args.prompt)), jnp.int32)
+    lens = None
+    if args.ragged:
+        lens = rs.randint(max(1, args.prompt // 4), args.prompt + 1,
+                          args.batch).astype(np.int32)
     with mixed_precision():
         plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
         params, _ = plain.init(jax.random.key(0), prompt[:, :8])
@@ -84,16 +95,22 @@ def main():
                    else lm_generate_builder)
         decode = builder(cfg)
 
+        def run(n):
+            if lens is None:
+                return np.asarray(decode(params, prompt, n))
+            return np.asarray(decode(params, prompt, n,
+                                     prompt_lens=lens))
+
         s, s4 = args.steps, 4 * args.steps
         for n in (s, s4):                      # compile + warm both arms
-            np.asarray(decode(params, prompt, n))
+            run(n)
 
         diffs = []
         for _ in range(args.repeats):
             t0 = time.perf_counter()
-            np.asarray(decode(params, prompt, s))
+            run(s)
             t1 = time.perf_counter()
-            np.asarray(decode(params, prompt, s4))
+            run(s4)
             t2 = time.perf_counter()
             diffs.append(((t2 - t1) - (t1 - t0)) / (s4 - s))
         per_step = sorted(diffs)[len(diffs) // 2]
@@ -102,7 +119,8 @@ def main():
     print(json.dumps({
         "metric": f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
                   f"prompt{args.prompt}"
-                  + (" flash" if args.flash else ""),
+                  + (" flash" if args.flash else "")
+                  + (" ragged" if args.ragged else ""),
         "backend": jax.default_backend(),
         "decoder": args.decoder,
         "compiles": compiles,      # serve contract: 1 across both arms

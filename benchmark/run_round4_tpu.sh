@@ -96,6 +96,8 @@ run lm_decode_flash python benchmark/lm_decode.py --dim 1024 --layers 12 \
     --batch 8 --prompt 128 --steps 64 --flash
 run lm_decode_b32 python benchmark/lm_decode.py --dim 1024 --layers 12 \
     --batch 32 --prompt 128 --steps 64
+run lm_decode_ragged python benchmark/lm_decode.py --dim 1024 --layers 12 \
+    --batch 8 --prompt 128 --steps 64 --ragged
 
 # 5. Mosaic re-test cadence (VERDICT #10)
 run mosaic_spike python benchmark/spike_fused_dxdw.py
