@@ -99,3 +99,21 @@ def test_attach_probe_rejects_cpu_fallback():
     assert bench.SMOKE is False
     bench.RETRY_BACKOFF = 0.1      # don't sleep 30 s in the test
     assert bench._attach_probe_with_retry() is False
+
+
+@pytest.mark.slow
+def test_bench_smoke_pipeline_emits_three_marked_rows():
+    """`python bench.py --smoke` end-to-end: probe subprocess, three
+    schema-conforming rows, every row marked smoke (never confusable
+    with real measurements)."""
+    p = subprocess.run([sys.executable, "bench.py", "--smoke"],
+                       capture_output=True, text=True, timeout=900,
+                       cwd=REPO)
+    assert p.returncode == 0, p.stderr[-500:]
+    rows = [json.loads(ln) for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(rows) == 3, rows
+    for row in rows:
+        assert row["smoke"] is True
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    # the LSTM smoke row actually measured something
+    assert rows[0]["unit"] == "ms/batch" and rows[0]["value"] > 0
