@@ -64,7 +64,8 @@ run tpu_smoke_plant env PADDLE_TPU_PERF_PLANT=4 python tpu_smoke.py
 
 # 2. transformer-LM MFU north star (VERDICT #2)
 run lm_d1024 python -m paddle_tpu time --config benchmark/transformer_lm.py \
-    --config-args dim=1024,batch_size=16 --batches 8 --burn-in 8 --repeats 5
+    --config-args dim=1024,batch_size=16 --batches 8 --burn-in 8 --repeats 5 \
+    --trace "$OUT/trace_d1024"
 run lm_d1024_flash python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=16,flash=1 --batches 8 --burn-in 8 \

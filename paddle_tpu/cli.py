@@ -153,6 +153,7 @@ def cmd_time(args):
                  and all({k: v.shape for k, v in b.items()} == shapes
                          for b in batches))
     n = max(args.batches, 1)
+    trace_dir = getattr(args, "trace", None)
     if stackable:
         K = len(batches)
         stack = {k: jnp.stack([b[k] for b in batches])
@@ -191,12 +192,29 @@ def cmd_time(args):
                                              repeats=args.repeats)
         protocol = "differential"
         mfu_val = None
+    if trace_dir:
+        # one traced, host-synced step AFTER timing (the profiler adds
+        # overhead that must not contaminate the differential arms) —
+        # the per-fusion attribution input for MFU campaigns.  A trace
+        # failure must degrade to a missing trace, never discard the
+        # measurement already taken.
+        import jax
+        try:
+            jax.profiler.start_trace(trace_dir)
+            timed_run(step_fn, 1)
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — report, keep the row
+            print(f"trace capture failed ({type(e).__name__}: {e}); "
+                  "timing row unaffected", file=sys.stderr)
+            trace_dir = None
     out = {"ms_per_batch": ms, "batches": args.batches,
            "last_cost": float(last["cost"]), "protocol": protocol}
     if spread is not None:
         out["spread_ms"] = round(spread, 4)
     if mfu_val is not None:
         out["mfu"] = round(mfu_val, 4)
+    if trace_dir:
+        out["trace"] = trace_dir
     print(json.dumps(out))
 
 
@@ -352,6 +370,11 @@ def main(argv=None):
                    help="paired-difference repeats for the differential "
                         "protocol (odd keeps the median an order "
                         "statistic); raise for noisy CNN rows")
+    p.add_argument("--trace", metavar="DIR", default=None,
+                   help="capture a jax.profiler device trace of the "
+                        "timed section into DIR (the per-fusion "
+                        "attribution input for MFU campaigns; works "
+                        "over the tunnel)")
     p.set_defaults(fn=cmd_time)
 
     p = sub.add_parser("checkgrad",
