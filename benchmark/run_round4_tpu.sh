@@ -106,6 +106,14 @@ run mosaic_spike python benchmark/spike_fused_dxdw.py
 # 5b. CSR/BCOO vs gather head-to-head (VERDICT r5 #7)
 run sparse_feed python benchmark/sparse_feed.py
 
+# 5c. LSTM h=512 re-measure (the round-3 regression check, VERDICT #1)
+run lstm_h512 python -m paddle_tpu time --config benchmark/rnn.py \
+    --config-args hidden=512,batch_size=64 --batches 16 --burn-in 16 \
+    --repeats 7
+run lstm_h512_b128 python -m paddle_tpu time --config benchmark/rnn.py \
+    --config-args hidden=512,batch_size=128 --batches 16 --burn-in 16 \
+    --repeats 7
+
 # 6. flagship bench + verify drivers
 run bench python bench.py
 [ -f /tmp/verify_r4.py ] && run verify_r4 python /tmp/verify_r4.py
