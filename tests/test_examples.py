@@ -86,3 +86,56 @@ def test_transformer_char_lm_generates_from_checkpoint(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
     assert gen.returncode == 0, gen.stderr[-2000:]
     assert "continuation:" in gen.stdout
+
+
+@pytest.mark.slow
+def test_lm_server_microbatcher_requests_match_solo_decodes():
+    """examples/lm_server.py: bucketed micro-batching must be invisible
+    — every request's tokens equal its solo dense-prompt decode, with
+    one compiled program per bucket width."""
+    import importlib.util
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_generate_builder,
+                                               lm_serve_builder)
+
+    spec = importlib.util.spec_from_file_location(
+        "lm_server", os.path.join(os.path.dirname(__file__), "..",
+                                  "examples", "lm_server.py"))
+    lm_server = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lm_server)
+
+    cfg = TransformerConfig(vocab_size=48, dim=16, num_heads=2,
+                            num_layers=2, max_len=40)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = plain.init(jax.random.key(3),
+                           jnp.zeros((1, 4), jnp.int32))
+    serve = lm_serve_builder(cfg)
+    generate = lm_generate_builder(cfg)
+    batcher = lm_server.MicroBatcher(
+        lambda ids, steps, lens: serve(params, ids, steps,
+                                       prompt_lens=lens),
+        bucket_widths=[6, 12], max_batch=3)
+
+    rs = np.random.RandomState(7)
+    requests = [(rs.randint(0, 48, n).tolist(), s)
+                for n, s in ((2, 4), (6, 3), (9, 5), (4, 2), (12, 6),
+                             (3, 3), (11, 2))]
+    outs = batcher.serve_many(requests)
+    for (prompt, steps), toks in zip(requests, outs):
+        solo = jnp.asarray(np.asarray(prompt, np.int32)[None])
+        want = np.asarray(generate(params, solo, steps))[0, len(prompt):]
+        np.testing.assert_array_equal(toks, want)
+    assert serve._cache_size() == 2      # one program per bucket width
+
+    # oversize prompt fails loudly
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError, match="largest bucket"):
+        batcher.serve_many([(list(range(13)), 2)])
