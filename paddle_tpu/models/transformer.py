@@ -248,9 +248,12 @@ def _sampling_picker(cfg: TransformerConfig, temp, out_dtype, eos_id,
         greedy = jnp.argmax(logits, axis=-1)
         # temperature scales BEFORE the nucleus is chosen, so the
         # kept set holds top_p of the ACTUAL sampling distribution
-        # (top-k is invariant to the monotone rescale either way)
+        # (top-k is invariant to the monotone rescale either way).
+        # temp is a scalar or [b] (per-request temperatures in one
+        # serving batch — 0 rows decode greedy, >0 rows sample)
+        tcol = temp[:, None] if temp.ndim else temp
         sampled = jax.random.categorical(
-            key, restrict(logits / jnp.maximum(temp, 1e-6)), axis=-1)
+            key, restrict(logits / jnp.maximum(tcol, 1e-6)), axis=-1)
         nxt = jnp.where(temp > 0, sampled, greedy).astype(out_dtype)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
@@ -371,6 +374,10 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
     positions at 0 and a cache-validity mask hides the left-pad rows
     from every attention read, so each row decodes exactly as if it
     were batched alone (pinned by the ragged-vs-solo equality test).
+
+    ``temperature`` is traced and may be a scalar or ``[b]`` — mixed
+    greedy (0) and sampled (>0) requests decode in ONE batch without a
+    retrace.
     """
     import functools
 
@@ -467,6 +474,17 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
         # normalize to strong i32: a weak-typed Python int and a strong
         # jnp scalar would otherwise trace as DIFFERENT avals and split
         # the compile cache in two
+        # temperature boundary check (same loud-failure convention):
+        # a [b, 1] column or wrong-length vector would otherwise die
+        # deep inside jit with an opaque broadcast error
+        t_arr = np.asarray(temperature) if not hasattr(
+            temperature, "aval") else temperature
+        if getattr(t_arr, "ndim", 0) >= 1:
+            assert t_arr.ndim == 1 and t_arr.shape[0] == \
+                prompt_ids.shape[0], (
+                    f"serve: temperature must be a scalar or "
+                    f"[batch={prompt_ids.shape[0]}] vector, got shape "
+                    f"{tuple(t_arr.shape)}")
         if prompt_lens is not None:
             # loud host-side validation, same contract as steps: a
             # clipped bad length would silently treat pad tokens as

@@ -638,3 +638,50 @@ def test_lm_beam_serve_matches_search_without_retrace(rng):
     np.testing.assert_allclose(np.asarray(scores_e),
                                np.asarray(want_se), rtol=1e-5)
     assert np.all(np.asarray(toks_e)[:, :, tp + 9:] == eos)
+
+
+def test_lm_serve_per_row_temperature(rng):
+    """temperature may be [b]: 0-rows decode greedy while >0 rows
+    sample, in ONE batch; a uniform [b] vector equals the scalar."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                            num_layers=1, max_len=16)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 32, (3, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+    serve = lm_serve_builder(cfg)
+
+    temps = np.asarray([0.0, 0.9, 0.0], np.float32)
+    greedy = np.asarray(serve(params, prompt, 8))
+    mixed = np.asarray(serve(params, prompt, 8, temps,
+                             jax.random.key(5)))
+    mixed2 = np.asarray(serve(params, prompt, 8, temps,
+                              jax.random.key(11)))
+    np.testing.assert_array_equal(mixed[0], greedy[0])
+    np.testing.assert_array_equal(mixed[2], greedy[2])
+    assert mixed[1].min() >= 0 and mixed[1].max() < 32
+    # the >0-temp row really SAMPLES: a different key changes it while
+    # the 0-temp rows stay pinned to greedy (deterministic seeds)
+    assert not np.array_equal(mixed[1], mixed2[1])
+    np.testing.assert_array_equal(mixed2[0], greedy[0])
+    np.testing.assert_array_equal(mixed2[2], greedy[2])
+
+    uniform = np.asarray(serve(params, prompt, 8,
+                               np.full((3,), 0.7, np.float32),
+                               jax.random.key(9)))
+    scalar = np.asarray(serve(params, prompt, 8, 0.7, jax.random.key(9)))
+    np.testing.assert_array_equal(uniform, scalar)
+
+    # malformed temperature shapes fail loudly at the boundary
+    import pytest
+    with pytest.raises(AssertionError, match="temperature"):
+        serve(params, prompt, 8, temps[:, None], jax.random.key(5))
+    with pytest.raises(AssertionError, match="temperature"):
+        serve(params, prompt, 8, temps[:2], jax.random.key(5))
