@@ -25,7 +25,7 @@ point):
 
 import os
 import sys
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 
@@ -34,17 +34,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 class MicroBatcher:
-    """Groups (prompt, steps) requests into bucketed, fixed-shape
-    ``serve`` calls and splits the results back per request.
+    """Groups ``(prompt, steps[, temperature])`` requests into
+    bucketed, fixed-shape ``serve`` calls and splits the results back
+    per request (temperature defaults to 0 = greedy; greedy and
+    sampled requests mix in one dispatch via the [b] vector).
 
     ``bucket_widths`` must be sorted ascending; a request lands in the
     smallest width that fits its prompt.  Each call batch is padded to
     ``max_batch`` rows (repeating the last request) so every bucket
-    compiles exactly ONE program regardless of arrival pattern.
+    compiles exactly ONE program regardless of arrival pattern.  A
+    fresh rng key is split per dispatch, so identical sampled requests
+    in different dispatches draw different noise.
     """
 
     def __init__(self, serve, bucket_widths: Sequence[int],
-                 max_batch: int, pad_id: int = 0):
+                 max_batch: int, pad_id: int = 0, seed: int = 0):
         from paddle_tpu.core.errors import enforce
         enforce(len(bucket_widths) > 0
                 and list(bucket_widths) == sorted(set(bucket_widths)),
@@ -53,6 +57,8 @@ class MicroBatcher:
         self.widths = list(bucket_widths)
         self.max_batch = max_batch
         self.pad_id = pad_id
+        self._seed = seed
+        self._key = None          # lazily created (needs jax imported)
 
     def _bucket_for(self, n: int) -> int:
         from paddle_tpu.core.errors import enforce
@@ -62,34 +68,45 @@ class MicroBatcher:
         enforce(False, "prompt length %d exceeds largest bucket %d",
                 n, self.widths[-1])
 
-    def serve_many(self, requests: Sequence[Tuple[List[int], int]]
-                   ) -> List[np.ndarray]:
-        """``requests``: [(prompt_ids, steps), ...] -> per-request
-        generated-token arrays (length = that request's ``steps``)."""
+    def serve_many(self, requests) -> List[np.ndarray]:
+        """``requests``: ``[(prompt_ids, steps)`` or ``(prompt_ids,
+        steps, temperature), ...]`` -> per-request generated-token
+        arrays (length = that request's ``steps``).  Greedy (0) and
+        sampled (>0) requests mix freely in one dispatch — temperature
+        rides the [b] vector, a traced argument."""
+        import jax
         import jax.numpy as jnp
 
         from paddle_tpu.models.transformer import right_align
 
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
         out: List[np.ndarray] = [None] * len(requests)
         # group request indices by bucket width
         groups = {}
-        for idx, (prompt, steps) in enumerate(requests):
-            groups.setdefault(self._bucket_for(len(prompt)), []).append(idx)
+        for idx, req in enumerate(requests):
+            groups.setdefault(self._bucket_for(len(req[0])), []).append(idx)
         for width, idxs in groups.items():
             for lo in range(0, len(idxs), self.max_batch):
                 chunk = idxs[lo:lo + self.max_batch]
                 prompts = [requests[i][0] for i in chunk]
+                temps = [float(requests[i][2]) if len(requests[i]) > 2
+                         else 0.0 for i in chunk]
                 # pad the BATCH to the fixed size with a repeat of the
                 # last row: one compiled program per bucket, any load
                 while len(prompts) < self.max_batch:
                     prompts.append(prompts[-1])
+                    temps.append(temps[-1])
                 ids, lens = right_align(prompts, width=width,
                                         pad_id=self.pad_id)
                 # one dispatch decodes to the LONGEST request in the
                 # group; shorter requests slice their prefix
                 steps_max = max(requests[i][1] for i in chunk)
-                batch_out = np.asarray(
-                    self.serve(jnp.asarray(ids), steps_max, lens))
+                self._key, sub = jax.random.split(self._key)
+                batch_out = np.asarray(self.serve(
+                    jnp.asarray(ids), steps_max, lens,
+                    np.asarray(temps, np.float32), sub))
                 for row, i in enumerate(chunk):
                     out[i] = batch_out[row, width:width + requests[i][1]]
         return out
@@ -114,19 +131,20 @@ def main():
     serve = lm_serve_builder(cfg)
 
     batcher = MicroBatcher(
-        lambda ids, steps, lens: serve(params, ids, steps,
-                                       prompt_lens=lens),
+        lambda ids, steps, lens, temps, key: serve(
+            params, ids, steps, temps, key, prompt_lens=lens),
         bucket_widths=[8, 16], max_batch=4)
 
     rs = np.random.RandomState(0)
-    requests = [(rs.randint(0, 64, n).tolist(), s)
-                for n, s in ((3, 5), (8, 2), (12, 7), (5, 4), (16, 3),
-                             (2, 6))]
+    requests = [(rs.randint(0, 64, n).tolist(), s, t)
+                for n, s, t in ((3, 5, 0.0), (8, 2, 0.8), (12, 7, 0.0),
+                                (5, 4, 0.0), (16, 3, 0.9), (2, 6, 0.0))]
     outs = batcher.serve_many(requests)
-    for i, ((prompt, steps), toks) in enumerate(zip(requests, outs)):
-        print(f"req[{i}] len={len(prompt)} steps={steps} ->",
-              toks.tolist())
-    assert all(len(t) == s for (_, s), t in zip(requests, outs))
+    for i, ((prompt, steps, temp), toks) in enumerate(
+            zip(requests, outs)):
+        print(f"req[{i}] len={len(prompt)} steps={steps} "
+              f"temp={temp} ->", toks.tolist())
+    assert all(len(t) == s for (_, s, _t), t in zip(requests, outs))
     print("programs compiled:", serve._cache_size(),
           "(one per bucket width)")
     assert serve._cache_size() == len(batcher.widths)

@@ -120,8 +120,8 @@ def test_lm_server_microbatcher_requests_match_solo_decodes():
     serve = lm_serve_builder(cfg)
     generate = lm_generate_builder(cfg)
     batcher = lm_server.MicroBatcher(
-        lambda ids, steps, lens: serve(params, ids, steps,
-                                       prompt_lens=lens),
+        lambda ids, steps, lens, temps, key: serve(
+            params, ids, steps, temps, key, prompt_lens=lens),
         bucket_widths=[6, 12], max_batch=3)
 
     rs = np.random.RandomState(7)
