@@ -685,3 +685,24 @@ def test_lm_serve_per_row_temperature(rng):
         serve(params, prompt, 8, temps[:, None], jax.random.key(5))
     with pytest.raises(AssertionError, match="temperature"):
         serve(params, prompt, 8, temps[:2], jax.random.key(5))
+
+
+def test_fully_masked_attention_rows_are_finite():
+    """The ragged-serving NaN-safety invariant: attn_bias masks with a
+    FINITE NEG_INF, so a query row whose every key is masked (a
+    left-pad query) softmaxes to a uniform don't-care average — never
+    NaN that FP-hygiene checks would trip on.  If masking ever moves
+    to -inf this pins the regression."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import dot_product_attention
+
+    q = jnp.ones((1, 3, 1, 4))
+    k = jnp.ones((1, 3, 1, 4))
+    v = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 3, 1, 4))
+    mask = jnp.zeros((1, 3), bool)          # EVERY key masked
+    out = np.asarray(dot_product_attention(q, k, v, mask=mask))
+    assert np.isfinite(out).all(), "fully-masked rows must not NaN"
+    # uniform average over values (all logits equally masked)
+    np.testing.assert_allclose(out[0, 0, 0],
+                               np.asarray(v)[0].mean(0)[0], rtol=1e-5)
