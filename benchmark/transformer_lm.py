@@ -27,7 +27,9 @@ BATCH = get_config_arg("batch_size", int, 16)
 SEQ = get_config_arg("seq_len", int, 1024)
 VOCAB = get_config_arg("dict_size", int, 32000)
 FFN_MULT = get_config_arg("ffn_mult", int, 4)
-REMAT = bool(get_config_arg("remat", int, 0))
+# remat=0 off, remat=1 whole-block, remat=attn attention-scoped
+_REMAT_RAW = get_config_arg("remat", str, "0")
+REMAT = {"0": False, "1": True}.get(_REMAT_RAW, _REMAT_RAW)
 FLASH = bool(get_config_arg("flash", int, 0))
 
 mixed_precision = True  # bf16 compute (CLI honors this config attr)

@@ -72,6 +72,33 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def remat_wrapped(attn_fn=None):
+    """Attention-scoped remat: wrap ``attn_fn`` in ``jax.checkpoint``.
+
+    The einsum path saves the f32 softmax for backward — [b, h, t, t]
+    per layer (1 GB/layer at b=16, t=1024), which both blows the 16G
+    HBM at training shapes and doubles score-tensor traffic.  An
+    ``attn_fn`` is pure in (q, k, v, mask) — no ``param()`` reads — so
+    a plain ``jax.checkpoint`` (nothing saveable) drops every O(t^2)
+    temporary: backward recomputes scores + softmax from the saved
+    q/k/v (which the surrounding block stores anyway).  Finer than
+    ``TransformerConfig(remat=True)``'s whole-block remat — the FFN
+    and projection activations stay saved, so only the attention core
+    is recomputed.  Selected by ``TransformerConfig(remat="attn")``,
+    which wraps whatever attention is in effect — the default einsum
+    (``attn_fn=None``), Pallas flash, or a ring/sequence-parallel fn —
+    so the remat form cannot be silently dropped by composing options.
+    """
+    inner = attn_fn if attn_fn is not None else dot_product_attention
+
+    def wrapped(q, k, v, mask=None, causal=False):
+        fn = functools.partial(inner, causal=causal)
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.nothing_saveable)(q, k, v, mask)
+    return wrapped
+
+
 def flash_attention_fn(q: jax.Array, k: jax.Array, v: jax.Array,
                        mask: Optional[jax.Array] = None,
                        causal: bool = False) -> jax.Array:

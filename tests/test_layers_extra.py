@@ -287,11 +287,12 @@ def test_remat_transformer_matches_no_remat(rng):
         return params, jax.jit(loss)(params), jax.jit(jax.grad(loss))(params)
 
     p1, l1, g1 = run(False)
-    p2, l2, g2 = run(True)
-    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g1, g2)
+    for remat in (True, "attn"):
+        p2, l2, g2 = run(remat)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g1, g2)
 
 
 def test_spp_non_divisible_input_no_inf(rng):
