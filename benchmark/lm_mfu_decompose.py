@@ -43,6 +43,10 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--flash", action="store_true")
+    ap.add_argument("--remat", default="0", choices=("0", "1", "attn"),
+                    help="0 off / 1 whole-block / attn attention-scoped"
+                         " (mirrors transformer_lm.py; attn is the "
+                         "fastest bs=16 form that fits the v5e HBM)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes; pipeline check only")
     args = ap.parse_args()
@@ -69,9 +73,10 @@ def main():
     from paddle_tpu.utils.timing import marginal_ms_per_batch, timed_run
 
     heads = max(1, args.dim // 64)
+    remat = {"0": False, "1": True}.get(args.remat, args.remat)
     base = dict(vocab_size=args.vocab, dim=args.dim, num_heads=heads,
                 num_layers=args.layers, ffn_mult=4, max_len=args.seq,
-                causal=True, flash=args.flash)
+                causal=True, flash=args.flash, remat=remat)
 
     # component ablations via monkey-patchable module hooks: identity
     # attention / identity FFN keep every shape and residual intact, so
@@ -93,6 +98,7 @@ def main():
              "ids_mask": np.ones((args.batch, args.seq), bool)}
     rows = {}
     for name, (cfg, attn_fn) in variants.items():
+      try:
         with mixed_precision():
             trainer = Trainer(tfm.lm_model_fn_builder(cfg, attn_fn=attn_fn),
                               optim.adam(3e-4))
@@ -126,12 +132,27 @@ def main():
         # drop EVERY reference (step_fn's closure + the AOT executable
         # would otherwise keep the whole variant HBM-resident while the
         # next one initializes)
-        del trainer, stack, dev, step_fn, cost
+      except Exception as e:  # one OOM'd variant must not kill the rest
+        print(json.dumps({"component": name,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+      finally:
+        # drop EVERY reference on success AND failure (step_fn's closure
+        # + the AOT executable would otherwise keep the variant
+        # HBM-resident while the next one initializes; plain rebinding —
+        # del would NameError on whichever locals the failure predates)
+        trainer = stack = dev = step_fn = cost = None
         import gc
         gc.collect()
 
+    if "full" not in rows:
+        # per-variant degradation is graceful, but a missing baseline
+        # means no attribution exists — the campaign must see FAILED
+        sys.exit(4)
     full_ms, _, full_gb = rows["full"]
     for name in ("no_attn", "no_ffn", "head_only"):
+        if name not in rows:
+            continue
         ms, _, gb = rows[name]
         row = {"component": f"attributed:{name}",
                "removed_block_ms": round(full_ms - ms, 3),
