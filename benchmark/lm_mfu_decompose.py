@@ -45,8 +45,10 @@ def main():
     ap.add_argument("--flash", action="store_true")
     ap.add_argument("--remat", default="0", choices=("0", "1", "attn"),
                     help="0 off / 1 whole-block / attn attention-scoped"
-                         " (mirrors transformer_lm.py; attn is the "
-                         "fastest bs=16 form that fits the v5e HBM)")
+                         " (mirrors transformer_lm.py)")
+    ap.add_argument("--scores", default="f32", choices=("f32", "bf16"),
+                    help="score-tensor materialization dtype "
+                         "(mirrors transformer_lm.py)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes; pipeline check only")
     args = ap.parse_args()
@@ -76,7 +78,8 @@ def main():
     remat = {"0": False, "1": True}.get(args.remat, args.remat)
     base = dict(vocab_size=args.vocab, dim=args.dim, num_heads=heads,
                 num_layers=args.layers, ffn_mult=4, max_len=args.seq,
-                causal=True, flash=args.flash, remat=remat)
+                causal=True, flash=args.flash, remat=remat,
+                scores=args.scores)
 
     # component ablations via monkey-patchable module hooks: identity
     # attention / identity FFN keep every shape and residual intact, so

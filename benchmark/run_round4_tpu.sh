@@ -62,27 +62,32 @@ run tpu_smoke python tpu_smoke.py
 # 1b. perf-floor self-test: planted 4x slowdown MUST fail (expect rc!=0)
 run tpu_smoke_plant env PADDLE_TPU_PERF_PLANT=4 python tpu_smoke.py
 
-# 2. transformer-LM MFU north star.  Measured round 5: the un-rematted
-#    bs=16 form OOMs at compile (17.39G > 15.75G — 12 GB of saved f32
-#    softmax), so the bs=16 headline runs attention-scoped remat
-#    (remat=attn, measured-fastest fitting form: 295.7 ms vs 354.8
-#    block-remat / 417.4 flash); bs=8 covers the un-rematted form
-#    (138.5 ms, 37.9% MFU — fastest per sample).
+# 2. transformer-LM MFU north star.  Measured round 5: scores=bf16
+#    (bf16 score materialization, f32 accumulation/softmax math) is
+#    the headline form — fastest at every shape AND what lets bs=16
+#    fit (the f32 form's 12 GB of saved softmax OOMs at compile);
+#    remat=attn covers the f32-scores story, bs=8 the per-sample-best,
+#    flash the Mosaic-deficit record.
+run lm_d1024_sbf16 python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=1024,batch_size=16,scores=bf16 --batches 8 \
+    --burn-in 8 --repeats 5 --trace "$OUT/trace_d1024"
+run lm_d1024_b8_sbf16 python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=1024,batch_size=8,scores=bf16 --batches 8 \
+    --burn-in 8 --repeats 5
 run lm_d1024_rattn python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=16,remat=attn --batches 8 \
-    --burn-in 8 --repeats 5 --trace "$OUT/trace_d1024"
-run lm_d1024_b8 python -m paddle_tpu time \
-    --config benchmark/transformer_lm.py \
-    --config-args dim=1024,batch_size=8 --batches 8 --burn-in 8 --repeats 5
+    --burn-in 8 --repeats 5
 run lm_d1024_flash python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=16,flash=1 --batches 8 --burn-in 8 \
     --repeats 5
-run lm_d2048_rattn python -m paddle_tpu time \
+run lm_d2048_sbf16 python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
-    --config-args dim=2048,batch_size=8,remat=attn --batches 4 --burn-in 4 \
-    --repeats 5
+    --config-args dim=2048,batch_size=8,remat=attn,scores=bf16 \
+    --batches 4 --burn-in 4 --repeats 5
 
 # 2b. per-component MFU decomposition (the VERDICT #3 follow-up data —
 #     run unconditionally so the attribution exists even if the tunnel

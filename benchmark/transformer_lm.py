@@ -30,6 +30,7 @@ FFN_MULT = get_config_arg("ffn_mult", int, 4)
 # remat=0 off, remat=1 whole-block, remat=attn attention-scoped
 _REMAT_RAW = get_config_arg("remat", str, "0")
 REMAT = {"0": False, "1": True}.get(_REMAT_RAW, _REMAT_RAW)
+SCORES = get_config_arg("scores", str, "f32")  # f32 | bf16 score HBM dtype
 FLASH = bool(get_config_arg("flash", int, 0))
 
 mixed_precision = True  # bf16 compute (CLI honors this config attr)
@@ -37,7 +38,7 @@ mixed_precision = True  # bf16 compute (CLI honors this config attr)
 model_fn = lm_model_fn_builder(TransformerConfig(
     vocab_size=VOCAB, dim=DIM, num_heads=HEADS, num_layers=LAYERS,
     ffn_mult=FFN_MULT, max_len=SEQ, causal=True, remat=REMAT,
-    flash=FLASH))
+    flash=FLASH, scores=SCORES))
 
 optimizer = optim.from_config(settings(
     learning_rate=3e-4, learning_method_name="adam"))

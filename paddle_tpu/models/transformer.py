@@ -50,9 +50,16 @@ class TransformerConfig:
     # measured-best training form at d1024 t=1024 on a 16G v5e)
     remat: object = False
 
+    # "f32" (default) | "bf16": the dtype score tensors materialize in
+    # between XLA fusions (accumulation and softmax math stay f32) —
+    # the measured-dominant HBM traffic term at training shapes
+    scores: str = "f32"
+
     def __post_init__(self):
         enforce_in(self.remat, (False, True, "attn"),
                    "a remat typo would silently measure the wrong form")
+        enforce_in(self.scores, ("f32", "bf16"),
+                   "a scores typo would silently measure the wrong form")
     moe_experts: int = 0          # 0 = dense FFN
     moe_top_k: int = 2
     moe_every: int = 1            # MoE in every k-th block
@@ -151,6 +158,12 @@ class TransformerLM(Module):
                                                  axis=0)[None]
         new_caches = [] if caches is not None else None
         attn_fn = self.attn_fn
+        if cfg.scores == "bf16" and attn_fn is None and caches is None:
+            # bf16 score materialization applies to the default einsum
+            # path only (flash/ring keep scores out of HBM already);
+            # decode (caches) runs tiny per-step scores, not worth it
+            from paddle_tpu.ops.attention import bf16_scores_attention_fn
+            attn_fn = bf16_scores_attention_fn
         if cfg.remat == "attn" and caches is None:
             # Wrap whatever attention is in effect (default einsum,
             # flash, ring/sp) — resolved here so no entry point can

@@ -268,6 +268,41 @@ def test_identity_projection_offset():
                                [0, 0, 1, 1, 1, 0, 0, 0])
 
 
+def test_bf16_scores_close_to_f32_and_validated(rng):
+    """scores="bf16" changes only score-tensor materialization dtype:
+    the loss stays within bf16 rounding of the f32 form, grads stay
+    finite, and a typo'd value is rejected at construction."""
+    import pytest
+
+    from paddle_tpu.core.errors import EnforceError
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    ids = jnp.asarray(rng.randint(0, 50, (2, 8)), jnp.int32)
+    batch = {"ids": ids, "ids_mask": jnp.ones((2, 8), bool)}
+
+    def run(scores):
+        cfg = TransformerConfig(vocab_size=50, dim=16, num_heads=2,
+                                num_layers=2, max_len=16, scores=scores)
+        m = nn.transform(lambda b: lm_model_fn_builder(cfg)(b))
+        params, st = m.init(jax.random.key(0), batch)
+
+        def loss(p):
+            (l, _), _ = m.apply(p, st, None, batch)
+            return l
+        return float(jax.jit(loss)(params)), jax.jit(jax.grad(loss))(params)
+
+    l32, _ = run("f32")
+    l16, g16 = run("bf16")
+    np.testing.assert_allclose(l32, l16, rtol=1e-2)
+    # close but NOT identical — identical would mean the bf16 path
+    # silently failed to engage and both runs took the f32 path
+    assert l16 != l32, "scores='bf16' did not change the computation"
+    for leaf in jax.tree_util.tree_leaves(g16):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    with pytest.raises(EnforceError):
+        TransformerConfig(vocab_size=50, scores="bf32")
+
+
 def test_remat_transformer_matches_no_remat(rng):
     """cfg.remat=True must produce identical loss/grads to remat=False."""
     from paddle_tpu.models.transformer import (TransformerConfig,
