@@ -53,6 +53,10 @@ def main():
                          "lengths in [prompt/4, prompt], right-aligned "
                          "+ prompt_lens) — the realistic serving mix; "
                          "serve decoder only")
+    ap.add_argument("--bf16-params", action="store_true",
+                    help="serving_cast the params to bf16 first "
+                         "(inference needs no f32 masters; halves the "
+                         "weight-streaming term that bounds decode)")
     args = ap.parse_args()
     if args.ragged and args.decoder != "serve":
         ap.error("--ragged requires --decoder serve")
@@ -91,6 +95,9 @@ def main():
     with mixed_precision():
         plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
         params, _ = plain.init(jax.random.key(0), prompt[:, :8])
+        if args.bf16_params:
+            from paddle_tpu.inference import serving_cast
+            params = serving_cast(params)
         builder = (lm_serve_builder if args.decoder == "serve"
                    else lm_generate_builder)
         decode = builder(cfg)
@@ -120,7 +127,8 @@ def main():
         "metric": f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
                   f"prompt{args.prompt}"
                   + (" flash" if args.flash else "")
-                  + (" ragged" if args.ragged else ""),
+                  + (" ragged" if args.ragged else "")
+                  + (" bf16-params" if args.bf16_params else ""),
         "backend": jax.default_backend(),
         "decoder": args.decoder,
         "compiles": compiles,      # serve contract: 1 across both arms

@@ -46,6 +46,7 @@ PACKAGES = {
                              "executor", "tensor_array", "control_flow",
                              "ops"],
     "paddle_tpu.distributed": ["runtime", "master", "launch"],
+    "paddle_tpu.inference": [],
 }
 
 

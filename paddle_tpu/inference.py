@@ -25,6 +25,31 @@ import numpy as np
 from paddle_tpu.nn import transform
 from paddle_tpu.training import checkpoint as ckpt_lib
 
+__all__ = ["InferenceMachine", "serving_cast", "export_model",
+           "load_model"]
+
+
+def serving_cast(params, dtype=jnp.bfloat16):
+    """One-time cast of float parameters to the serving dtype.
+
+    Training keeps f32 master weights (the mixed-precision policy);
+    inference needs no masters.  Casting once halves the parameter HBM
+    footprint (800 -> 400 MB for the d1024 benchmark LM) — headroom
+    for bigger serving batches or longer KV caches per chip.  Measured
+    effect on decode THROUGHPUT is small (1.006 -> 0.975 ms/step at
+    b8, none at b32): the v5e decode step is launch/latency-bound, not
+    weight-streaming-bound (`docs/design/serving.md`).  Non-float
+    leaves (int vocab tables, step counters) pass through untouched.
+    Opt-in — bf16 weights round logits, so near-tie greedy picks can
+    differ from the f32 reference (the usual quantized-serving
+    contract).
+    """
+    def leaf(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(leaf, params)
+
 
 class InferenceMachine:
     def __init__(self, model_fn: Callable, params, net_state=None):

@@ -450,6 +450,34 @@ def test_lm_serve_matches_generate_without_retrace(rng):
     np.testing.assert_array_equal(s[:, :tp + 7], g)
 
 
+def test_serving_cast_decodes_with_bf16_params(rng):
+    """serving_cast: float leaves go bf16, ints pass through, and the
+    serve decoder produces valid in-vocab tokens from the cast tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference import serving_cast
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM,
+                                               lm_serve_builder)
+    import paddle_tpu.nn as nn
+
+    cfg = TransformerConfig(vocab_size=32, dim=16, num_heads=2,
+                            num_layers=2, max_len=24)
+    plain = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    prompt = jnp.asarray(rng.randint(0, 32, (2, 4)), jnp.int32)
+    params, _ = plain.init(jax.random.key(0), prompt)
+
+    cast = serving_cast({"p": params, "step": jnp.asarray(3)})
+    for leaf in jax.tree_util.tree_leaves(cast["p"]):
+        assert leaf.dtype == jnp.bfloat16
+    assert cast["step"].dtype == jnp.asarray(3).dtype  # ints untouched
+
+    out = np.asarray(lm_serve_builder(cfg)(cast["p"], prompt, 6))
+    assert out.shape == (2, 24)
+    assert np.all((out >= 0) & (out < 32))
+
+
 def test_lm_serve_eos_early_exit_token_identical(rng):
     """With eos_id, serve exits the while_loop once every row froze;
     the output must still equal generate's full-scan freeze output."""
