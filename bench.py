@@ -52,7 +52,7 @@ LSTM_METRIC = ("stacked-LSTM cls train step, h=256 bs=64 "
                "seq=100 dict=30k")
 RESNET_METRIC = "ResNet-152 bs=128 s2d-stem train-step MFU"
 LM_METRIC = ("transformer-LM d=1024 L=12 bs=16 seq=1024 "
-             "scores=bf16 train-step MFU")
+             "flash train-step MFU")
 
 _ROWS_SCHEMA = [
     {"metric": LSTM_METRIC, "value": 0.0, "unit": "ms/batch",
@@ -194,16 +194,18 @@ def _transformer_row():
     batch = {"ids": rs.randint(0, vocab, (b, t)).astype(np.int32),
              "ids_mask": np.ones((b, t), bool)}
     with mixed_precision():
-        # scores="bf16": bf16 score materialization (f32 accumulation
-        # and softmax math) — measured-fastest bs=16 form, 245.9 ms vs
-        # 295.7 (remat=attn) / 354.8 (block remat) / 417.4 (flash);
-        # also what lets bs=16 fit at all (the f32 form's 12 GB of
-        # saved softmax OOMs the v5e's 15.75G at compile)
+        # flash=True (tuned q1024/k512 Pallas blocks): the measured-
+        # fastest bs=16 form, 223.7 ms vs 245.9 (scores=bf16) / 295.7
+        # (remat=attn) / 417.4 (flash at the kernel's 128 defaults);
+        # flash also keeps the t^2 scores out of HBM entirely, so
+        # bs=16 fits without remat (the f32 einsum form OOMs at
+        # compile).  MFU here is XLA's count of the compiled step;
+        # model-FLOPs MFU is ~46.9% (benchmark/README.md)
         trainer = Trainer(
             lm_model_fn_builder(TransformerConfig(
                 vocab_size=vocab, dim=dim, num_heads=max(1, dim // 64),
                 num_layers=layers, ffn_mult=4, max_len=t, causal=True,
-                scores="bf16")),
+                flash=True)),
             optim.adam(3e-4))
         return _mfu_row(LM_METRIC, trainer, batch,
                         K=2 if SMOKE else 4, n=1 if SMOKE else 2,

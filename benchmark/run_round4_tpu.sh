@@ -62,16 +62,19 @@ run tpu_smoke python tpu_smoke.py
 # 1b. perf-floor self-test: planted 4x slowdown MUST fail (expect rc!=0)
 run tpu_smoke_plant env PADDLE_TPU_PERF_PLANT=4 python tpu_smoke.py
 
-# 2. transformer-LM MFU north star.  Measured round 5: scores=bf16
-#    (bf16 score materialization, f32 accumulation/softmax math) is
-#    the headline form — fastest at every shape AND what lets bs=16
-#    fit (the f32 form's 12 GB of saved softmax OOMs at compile);
-#    remat=attn covers the f32-scores story, bs=8 the per-sample-best,
-#    flash the Mosaic-deficit record.
+# 2. transformer-LM MFU north star.  Measured round 5: tuned-block
+#    Pallas flash (flash=1, _flash_block_sizes) is the headline form —
+#    fastest at every shape, keeps t^2 scores out of HBM (bs=16 fits
+#    without remat); scores=bf16 is the best einsum form; bs=8
+#    scores=bf16 the per-sample einsum best.
+run lm_d1024_flash python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=1024,batch_size=16,flash=1 --batches 8 --burn-in 8 \
+    --repeats 5 --trace "$OUT/trace_d1024"
 run lm_d1024_sbf16 python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=16,scores=bf16 --batches 8 \
-    --burn-in 8 --repeats 5 --trace "$OUT/trace_d1024"
+    --burn-in 8 --repeats 5
 run lm_d1024_b8_sbf16 python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=8,scores=bf16 --batches 8 \
@@ -80,11 +83,27 @@ run lm_d1024_rattn python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=1024,batch_size=16,remat=attn --batches 8 \
     --burn-in 8 --repeats 5
-run lm_d1024_flash python -m paddle_tpu time \
+run lm_d1024_b32_flash python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
-    --config-args dim=1024,batch_size=16,flash=1 --batches 8 --burn-in 8 \
+    --config-args dim=1024,batch_size=32,flash=1 --batches 4 --burn-in 4 \
     --repeats 5
-run lm_d2048_sbf16 python -m paddle_tpu time \
+run lm_d1536_sbf16 python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=1536,batch_size=8,scores=bf16 --batches 8 \
+    --burn-in 8 --repeats 5
+run lm_d2048_flash python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=2048,batch_size=4,flash=1 --batches 4 --burn-in 4 \
+    --repeats 5
+run lm_d2048_b8_flash python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=2048,batch_size=8,flash=1 --batches 4 --burn-in 4 \
+    --repeats 5
+run lm_d2048_b4_sbf16 python -m paddle_tpu time \
+    --config benchmark/transformer_lm.py \
+    --config-args dim=2048,batch_size=4,scores=bf16 --batches 4 \
+    --burn-in 4 --repeats 5
+run lm_d2048_sbf16_rattn python -m paddle_tpu time \
     --config benchmark/transformer_lm.py \
     --config-args dim=2048,batch_size=8,remat=attn,scores=bf16 \
     --batches 4 --burn-in 4 --repeats 5

@@ -160,8 +160,35 @@ def flash_attention_fn(q: jax.Array, k: jax.Array, v: jax.Array,
     out = _fa.flash_attention(
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
         jnp.swapaxes(v, 1, 2), segment_ids=seg, causal=causal,
-        sm_scale=d ** -0.5)
+        sm_scale=d ** -0.5, block_sizes=_flash_block_sizes(tq, k.shape[1]))
     return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_block_sizes(tq: int, tk: int):
+    """Tuned grid for the Pallas flash kernel.
+
+    The kernel's 128-grained defaults leave the Mosaic GEMMs far too
+    narrow: at the transformer-LM shape (b16 h16 t1024 d64) the v5e
+    sweep measured fwd+bwd 26.6 ms with the defaults vs 7.6 ms at
+    q1024/k512 blocks — crossing from 2.2x SLOWER than the XLA einsum
+    to 1.56x faster.  (Round 3's "Mosaic GEMM deficit" verdict on this
+    kernel was really this block-tuning gap; the fused dx+dw spike's
+    deficit stands — it was measured at its own tuned tilings.)
+    Blocks are the largest 128-multiple divisors of each sequence
+    length, capped at 1024 (q) / 512 (k) — e.g. t=1152 gets 384-wide
+    blocks, not a silent degrade to the slow 128 default."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    def pick(n, cap):
+        return max((b for b in range(128, min(cap, n) + 1, 128)
+                    if n % b == 0), default=128)
+
+    bq, bk = pick(tq, 1024), pick(tk, 512)
+    return _fa.BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk,
+        block_q_dq=bq)
 
 
 def blockwise_attn_chunk(q, k, v, bias, carry):

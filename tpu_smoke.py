@@ -145,6 +145,7 @@ def main() -> int:
 
     if os.environ.get("PADDLE_TPU_SMOKE_PERF", "1") != "0":
         failures += perf_floor(rs)
+        failures += flash_perf_floor(rs)
 
     return 1 if failures else 0
 
@@ -205,6 +206,56 @@ def _chained_iter_ms(loop, xw, wh, k_small=4, k_big=16, repeats=5):
         t2 = time.perf_counter()
         diffs.append(((t2 - t1) - (t1 - t0)) / (k_big - k_small) * 1e3)
     return sorted(diffs)[len(diffs) // 2]
+
+
+def flash_perf_floor(rs) -> list:
+    """Tuned-block flash must beat the XLA einsum at the benchmark LM
+    attention shape (b16 h16 t1024 d64 — the exact bench.py flash=1
+    headline shape).  A kernel/toolchain change that regresses the
+    block tuning (round 5 measured the kernel's own 128-defaults at
+    2.2x SLOWER than the einsum) trips this row."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import (dot_product_attention,
+                                          flash_attention_fn)
+
+    b, t, h, d = 16, 1024, 16, 64
+    q, k, v = (jnp.asarray(rs.randn(b, t, h, d), jnp.bfloat16) * 0.1
+               for _ in range(3))
+    plant = int(os.environ.get("PADDLE_TPU_PERF_PLANT", "1"))
+
+    def chained(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=True)
+                           .astype(jnp.float32) ** 2)
+        g = jax.jit(jax.grad(loss, (0, 1, 2)))
+
+        def loop(n, q0, _):
+            qq = q0
+            for _ in range(n):   # grads feed q so iterations chain
+                qq = qq + 1e-6 * g(qq, k, v)[0]
+            return jnp.sum(qq.astype(jnp.float32))
+        return jax.jit(loop, static_argnums=0)
+
+    inner = max(1, plant)
+
+    def planted_flash(q, k, v, causal=False):
+        out = flash_attention_fn(q, k, v, causal=causal)
+        for i in range(inner - 1):   # self-test: multiply the work with
+            # distinct inputs (no CSE) at negligible output weight
+            out = out + 1e-8 * flash_attention_fn(
+                q + (i + 1) * 1e-6, k, v, causal=causal)
+        return out
+
+    fused_ms = _chained_iter_ms(chained(planted_flash), q, None)
+    xla_ms = _chained_iter_ms(chained(dot_product_attention), q, None)
+    ok = fused_ms < xla_ms
+    print(json.dumps({"perf": "flash_attn_b16_t1024",
+                      "fused_ms": round(fused_ms, 3),
+                      "xla_scan_ms": round(xla_ms, 3),
+                      "ratio": round(fused_ms / xla_ms, 3), "ok": ok}))
+    return [] if ok else ["perf:flash_attn_b16_t1024"]
 
 
 def perf_floor(rs) -> list:
