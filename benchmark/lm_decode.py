@@ -53,6 +53,16 @@ def main():
                          "lengths in [prompt/4, prompt], right-aligned "
                          "+ prompt_lens) — the realistic serving mix; "
                          "serve decoder only")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV-cache decode (block-table attention "
+                         "over a global block pool, serving.py) — same "
+                         "differential protocol, token-identical "
+                         "streams; serve decoder only")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="paged pool block size in tokens")
+    ap.add_argument("--pool-blocks", type=int, default=0,
+                    help="paged pool size (0 = dense-equivalent "
+                         "batch * ceil(max_len/block_size))")
     ap.add_argument("--bf16-params", action="store_true",
                     help="serving_cast the params to bf16 first — "
                          "halves the parameter HBM footprint; decode "
@@ -62,6 +72,8 @@ def main():
     args = ap.parse_args()
     if args.ragged and args.decoder != "serve":
         ap.error("--ragged requires --decoder serve")
+    if args.paged and args.decoder != "serve":
+        ap.error("--paged requires --decoder serve")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.watchdog import attach_watchdog
@@ -100,9 +112,15 @@ def main():
         if args.bf16_params:
             from paddle_tpu.inference import serving_cast
             params = serving_cast(params)
-        builder = (lm_serve_builder if args.decoder == "serve"
-                   else lm_generate_builder)
-        decode = builder(cfg)
+        if args.paged:
+            from paddle_tpu.serving import paged_serve_builder
+            decode = paged_serve_builder(
+                cfg, block_size=args.block_size,
+                num_blocks=args.pool_blocks or None)
+        else:
+            builder = (lm_serve_builder if args.decoder == "serve"
+                       else lm_generate_builder)
+            decode = builder(cfg)
 
         def run(n):
             if lens is None:
@@ -125,18 +143,37 @@ def main():
         per_step = sorted(diffs)[len(diffs) // 2]
         compiles = decode._cache_size()
 
-    print(json.dumps({
+    row = {
         "metric": f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
                   f"prompt{args.prompt}"
                   + (" flash" if args.flash else "")
                   + (" ragged" if args.ragged else "")
+                  + (" paged" if args.paged else "")
                   + (" bf16-params" if args.bf16_params else ""),
         "backend": jax.default_backend(),
         "decoder": args.decoder,
         "compiles": compiles,      # serve contract: 1 across both arms
         "ms_per_step": round(per_step * 1e3, 3),
         "tokens_per_s": round(args.batch / per_step, 1),
-        "unit": "tokens/s"}), flush=True)
+        "unit": "tokens/s"}
+    if args.paged:
+        # pool accounting: HBM the paged cache actually pins for the
+        # long differential arm vs the dense [b, max_len] slabs
+        from paddle_tpu.serving import dense_hbm_bytes, paged_hbm_bytes
+        kw = dict(num_layers=args.layers, num_heads=heads,
+                  head_dim=args.dim // heads, dtype_bytes=4)
+        used = paged_hbm_bytes(
+            [int(n) for n in (lens if lens is not None
+                              else [args.prompt] * args.batch)],
+            block_size=args.block_size, **kw)
+        row.update({
+            "block_size": args.block_size,
+            "pool_blocks": args.pool_blocks
+            or args.batch * -(-max_len // args.block_size),
+            "paged_prefill_mib": round(sum(used) / 2**20, 1),
+            "dense_cache_mib": round(
+                args.batch * dense_hbm_bytes(max_len, **kw) / 2**20, 1)})
+    print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

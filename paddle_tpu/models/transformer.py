@@ -60,6 +60,19 @@ class TransformerConfig:
                    "a remat typo would silently measure the wrong form")
         enforce_in(self.scores, ("f32", "bf16"),
                    "a scores typo would silently measure the wrong form")
+        if self.scores == "bf16" and self.flash:
+            # Precedence (ADVICE r5): an explicit attention fn wins —
+            # flash/ring never materialize score tensors in HBM, so
+            # scores="bf16" has nothing to change there.  Warn rather
+            # than enforce: the combination is harmless, but a user
+            # benchmarking "bf16 scores" would otherwise silently
+            # measure the flash form instead.
+            import warnings
+            warnings.warn(
+                "TransformerConfig: scores='bf16' is ignored when "
+                "flash=True — flash attention keeps score tensors out "
+                "of HBM, so there is no materialization dtype to "
+                "change", stacklevel=2)
     moe_experts: int = 0          # 0 = dense FFN
     moe_top_k: int = 2
     moe_every: int = 1            # MoE in every k-th block
@@ -143,7 +156,14 @@ class TransformerLM(Module):
         left-padded row's first real token is semantic position 0), and
         ``cache_valid`` [b, max_len] marks the cache rows holding real
         tokens so attention never reads a pad key — see
-        :func:`lm_serve_builder`'s ``prompt_lens``."""
+        :func:`lm_serve_builder`'s ``prompt_lens``.
+
+        PAGED decoding: each ``caches`` entry may instead be a
+        :class:`paddle_tpu.ops.paged_attention.PagedLayerView` — the
+        block-pool cache form (`paddle_tpu/serving.py`).  Pass
+        ``pos_ids`` (the per-slot write cursors) and any ``position``;
+        the paged branch ignores ``position`` and appends at each
+        view's own lengths."""
         cfg = self.cfg
         policy = get_policy()
         b, t = ids.shape
@@ -158,6 +178,18 @@ class TransformerLM(Module):
                                                  axis=0)[None]
         new_caches = [] if caches is not None else None
         attn_fn = self.attn_fn
+        if cfg.scores == "bf16" and attn_fn is not None and caches is None:
+            # ADVICE r5: scores="bf16" only governs the DEFAULT einsum
+            # path's score materialization; an explicit attn_fn (flash,
+            # ring, custom) supplies its own score handling and wins.
+            # Without this warning the setting silently no-ops.
+            import warnings
+            warnings.warn(
+                "TransformerLM: scores='bf16' is ignored because an "
+                "explicit attn_fn is in effect — the attn_fn owns its "
+                "score handling (flash/ring never materialize scores; "
+                "a custom fn that does must opt in itself)",
+                stacklevel=2)
         if cfg.scores == "bf16" and attn_fn is None and caches is None:
             # bf16 score materialization applies to the default einsum
             # path only (flash/ring keep scores out of HBM already);

@@ -3,5 +3,6 @@ sequence/nested ops, CRF/CTC, Pallas TPU kernels (the hl_*/Function
 layer twin, one source for graph and eager use)."""
 from paddle_tpu.ops import activations
 from paddle_tpu.ops import nested
+from paddle_tpu.ops import paged_attention
 
-__all__ = ["activations", "nested"]
+__all__ = ["activations", "nested", "paged_attention"]

@@ -83,7 +83,13 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     weights = weights.astype(v.dtype if scores_dtype is None
                              else scores_dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    # preferred_element_type keeps the weights·v accumulation f32 even
+    # with bf16 operands — ADVICE r5: without it the docstring's
+    # "accumulation is always f32" held only by TPU-MXU default, not on
+    # CPU fallback paths.  The f32 output is O(t·d), negligible next to
+    # the score-tensor traffic the scores_dtype knob targets.
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v,
+                      preferred_element_type=jnp.float32)
 
 
 def bf16_scores_attention_fn(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -284,8 +290,39 @@ class MultiHeadAttention(Module):
         k = proj("w_k", kv, h * hd).reshape(b, kv.shape[1], h, hd)
         v = proj("w_v", kv, h * hd).reshape(b, kv.shape[1], h, hd)
 
+        from paddle_tpu.ops import paged_attention as paged
+
         new_cache = None
-        if cache is not None:
+        if isinstance(cache, paged.PagedLayerView):
+            # PAGED cache form (block-pool K/V + block table — see
+            # ops/paged_attention.py): append the fresh keys/values
+            # into the pools, then attend by block table.  ``position``
+            # is ignored — the view's per-row ``lengths`` carry each
+            # slot's write cursor (the ragged-by-construction form).
+            enforce(mask is None,
+                    "paged cache mode: per-token masks are unsupported; "
+                    "append_valid bounds the fresh tokens and lengths "
+                    "bound the context")
+            kp, vp = paged.paged_append(cache, k, v)
+            if t == 1:
+                # decode step: gather-by-block-table attention over the
+                # row's committed prefix + the token just written
+                out = paged.paged_decode_attention(
+                    q, kp, vp, cache.block_table,
+                    cache.lengths + cache.append_valid)
+            else:
+                # prefill into a FRESH slot (lengths 0): the context is
+                # exactly the fresh tokens, so attention runs over the
+                # in-flight k/v — flash/ring attn_fn applies, same as
+                # the dense position-0 prefill.  Chunked prefill
+                # (lengths > 0 with t > 1) is not a supported call.
+                prefill_mask = (jnp.arange(t)[None, :]
+                                < cache.append_valid[:, None])
+                inner = self.attn_fn or dot_product_attention
+                out = inner(q, k, v, mask=prefill_mask,
+                            causal=self.causal)
+            new_cache = cache._replace(k_pages=kp, v_pages=vp)
+        elif cache is not None:
             enforce(position is not None,
                     "MultiHeadAttention cache mode needs position")
             # Padded prompts are not supported incrementally: the

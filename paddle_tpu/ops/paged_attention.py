@@ -1,0 +1,286 @@
+"""Paged KV cache: block-pool storage + block-table decode attention.
+
+The dense serving cache (``models/transformer.py::_cached_lm``) gives
+every request slot a ``[max_len, h, hd]`` K/V strip per layer — HBM
+scales with the WORST-CASE length and a finished request's strip stays
+dead until the whole batch drains.  This module pages the cache the way
+Ragged Paged Attention does it for TPU serving (PAPERS.md): one global
+``[num_blocks, block_size, h, hd]`` K/V pool per layer, plus a
+``[num_slots, max_blocks]`` int32 block table and per-slot lengths, so
+
+* cache HBM scales with ACTUAL tokens (allocated blocks), not
+  ``num_slots * max_len``;
+* a retired request's blocks return to the pool immediately and a new
+  prompt splices in mid-flight (continuous batching,
+  ``paddle_tpu/serving.py``) — no head-of-line blocking.
+
+Everything here is PURE-FUNCTIONAL and fixed-shape: alloc/append/free
+are jit-safe pytree -> pytree transforms (the free list is a bool mask,
+allocation is an argsort+cumsum rank assignment), so one compiled
+decode step serves the whole lifetime of a serving process.
+
+:func:`paged_decode_attention` is the decode-step kernel surface:
+gather-by-block-table, f32 accumulation, masked to per-slot length.  It
+is numerically IDENTICAL to the dense ``dot_product_attention`` decode
+path over the same tokens — masked positions carry exactly-zero softmax
+weight, so even the pool's garbage rows (unwritten blocks, the clipped
+``-1`` table entries) cannot perturb the output; the paged-vs-dense
+token-identity test pins this.  The signature is the drop-in point for
+a Pallas kernel later (ROADMAP open item): same (q, pools, table,
+lengths) -> out contract, with the XLA gather form as the everywhere
+fallback, mirroring how ``flash_attention_fn`` wraps its kernel.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30      # finite mask value (see ops/attention.py NEG_INF)
+
+
+class PagedKVCache(NamedTuple):
+    """Global paged K/V state — one pytree, jit-carryable.
+
+    ``k_pages``/``v_pages``: per-layer tuples of
+    ``[num_blocks, block_size, heads, head_dim]`` pools.
+    ``block_tables``: ``[num_slots, max_blocks_per_slot]`` int32,
+    physical block id per (slot, logical block), ``-1`` = unmapped.
+    ``lengths``: ``[num_slots]`` int32 committed tokens per slot.
+    ``blocks_used``: ``[num_slots]`` int32 mapped blocks per slot.
+    ``free``: ``[num_blocks]`` bool, True = block is in the pool.
+    """
+
+    k_pages: Tuple[jax.Array, ...]
+    v_pages: Tuple[jax.Array, ...]
+    block_tables: jax.Array
+    lengths: jax.Array
+    blocks_used: jax.Array
+    free: jax.Array
+
+    # shape-derived statics (usable under jit — shapes are concrete)
+    @property
+    def num_layers(self) -> int:
+        return len(self.k_pages)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k_pages[0].shape[0]
+
+    @property
+    def block_size(self) -> int:
+        return self.k_pages[0].shape[1]
+
+    @property
+    def num_slots(self) -> int:
+        return self.block_tables.shape[0]
+
+    @property
+    def max_blocks_per_slot(self) -> int:
+        return self.block_tables.shape[1]
+
+
+class PagedLayerView(NamedTuple):
+    """One layer's slice of the cache, gathered for a model call.
+
+    ``MultiHeadAttention`` consumes this as its ``cache`` argument (the
+    paged alternative to the dense ``(k_cache, v_cache)`` pair): it
+    appends the fresh keys/values into the pools and attends by block
+    table.  ``block_table``/``lengths`` are already gathered to the
+    call's batch rows (``layer_views``'s ``slot_ids``); ``append_valid``
+    is how many of the call's ``t`` fresh tokens are real per row (0
+    = inactive slot, nothing written, output a don't-care).
+    """
+
+    k_pages: jax.Array       # [num_blocks, block_size, h, hd]
+    v_pages: jax.Array
+    block_table: jax.Array   # [b, max_blocks_per_slot] int32
+    lengths: jax.Array       # [b] int32 — tokens committed BEFORE this call
+    append_valid: jax.Array  # [b] int32 — fresh tokens to commit this call
+
+
+def paged_init(num_layers: int, num_slots: int, max_blocks_per_slot: int,
+               num_blocks: int, block_size: int, num_heads: int,
+               head_dim: int, dtype=jnp.float32) -> PagedKVCache:
+    """Empty cache: zeroed pools, all blocks free, no slot mapped."""
+    shape = (num_blocks, block_size, num_heads, head_dim)
+    return PagedKVCache(
+        k_pages=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+        v_pages=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+        block_tables=jnp.full((num_slots, max_blocks_per_slot), -1,
+                              jnp.int32),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        blocks_used=jnp.zeros((num_slots,), jnp.int32),
+        free=jnp.ones((num_blocks,), bool))
+
+
+def paged_reserve(cache: PagedKVCache, want):
+    """Grow each slot's mapping to hold ``lengths + want`` tokens.
+
+    ``want``: [num_slots] int32 additional tokens about to be appended
+    (decode steps pass the active mask as 0/1; prefill passes the
+    prompt lengths on the admitted slot).  Returns ``(cache, ok)``;
+    ``ok=False`` means the pool ran out of free blocks and the mapping
+    is CORRUPT — a fixed-shape program cannot raise, so callers must
+    check (the serve builder poisons its output, the engine's
+    admission accounting makes this unreachable).
+
+    Allocation is deterministic and pure: free blocks sort first (by
+    index, stable argsort), demand ranks by flat cumsum, rank r takes
+    the r-th free block.
+    """
+    S, maxb = cache.block_tables.shape
+    nb = cache.num_blocks
+    bs = cache.block_size
+    want = jnp.asarray(want, jnp.int32)
+    target = (cache.lengths + want + bs - 1) // bs
+    n_new = jnp.clip(target - cache.blocks_used, 0, maxb)         # [S]
+    need = jnp.arange(maxb)[None, :] < n_new[:, None]             # [S,maxb]
+    flat = need.reshape(-1)
+    ok = jnp.sum(flat) <= jnp.sum(cache.free)
+    order = jnp.argsort(~cache.free)           # free blocks first, by index
+    rank = jnp.cumsum(flat) - 1
+    ids = order[jnp.clip(rank, 0, nb - 1)]
+    ids = jnp.where(flat, ids, nb)             # sentinel -> dropped below
+    claimed = jnp.zeros((nb,), bool).at[ids].max(flat, mode="drop")
+    free = cache.free & ~claimed
+    ids2 = ids.reshape(S, maxb).astype(jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], (S, maxb))
+    cols = cache.blocks_used[:, None] + jnp.arange(maxb)[None, :]
+    cols = jnp.where(need, cols, maxb)         # non-need -> dropped
+    tables = cache.block_tables.at[rows, cols].set(ids2, mode="drop")
+    return cache._replace(free=free, block_tables=tables,
+                          blocks_used=cache.blocks_used + n_new), ok
+
+
+def paged_advance(cache: PagedKVCache, counts) -> PagedKVCache:
+    """Commit ``counts`` [num_slots] freshly appended tokens — called
+    ONCE per model call (every layer writes at the same positions, so
+    lengths advance after the layer loop, not inside it)."""
+    return cache._replace(
+        lengths=cache.lengths + jnp.asarray(counts, jnp.int32))
+
+
+def paged_free(cache: PagedKVCache, slot_mask) -> PagedKVCache:
+    """Return the masked slots' blocks to the pool and reset them.
+
+    ``slot_mask``: [num_slots] bool, True = retire this slot.  The
+    pool rows themselves are NOT zeroed — a freed block's stale K/V is
+    unreachable (no table maps it) and the next owner overwrites it,
+    the same reuse contract as the dense cache's garbage rows beyond
+    ``position``."""
+    S, maxb = cache.block_tables.shape
+    nb = cache.num_blocks
+    slot_mask = jnp.asarray(slot_mask, bool)
+    mapped = jnp.arange(maxb)[None, :] < cache.blocks_used[:, None]
+    drop = slot_mask[:, None] & mapped
+    ids = jnp.where(drop, cache.block_tables, nb)
+    freed = jnp.zeros((nb,), bool).at[ids.reshape(-1)].max(
+        drop.reshape(-1), mode="drop")
+    return cache._replace(
+        free=cache.free | freed,
+        block_tables=jnp.where(slot_mask[:, None], -1,
+                               cache.block_tables),
+        lengths=jnp.where(slot_mask, 0, cache.lengths),
+        blocks_used=jnp.where(slot_mask, 0, cache.blocks_used))
+
+
+def layer_views(cache: PagedKVCache, slot_ids, append_valid):
+    """Per-layer :class:`PagedLayerView` list for a model call over
+    batch rows ``slot_ids`` [b] appending ``append_valid`` [b] tokens."""
+    slot_ids = jnp.asarray(slot_ids, jnp.int32)
+    table = cache.block_tables[slot_ids]
+    lens = cache.lengths[slot_ids]
+    valid = jnp.asarray(append_valid, jnp.int32)
+    return [PagedLayerView(k, v, table, lens, valid)
+            for k, v in zip(cache.k_pages, cache.v_pages)]
+
+
+def merge_views(cache: PagedKVCache, views) -> PagedKVCache:
+    """Fold the model call's updated pools back into the global cache
+    (tables/lengths/free are engine-owned; views only mutate pages)."""
+    return cache._replace(k_pages=tuple(v.k_pages for v in views),
+                          v_pages=tuple(v.v_pages for v in views))
+
+
+def paged_append(view: PagedLayerView, k_new: jax.Array,
+                 v_new: jax.Array):
+    """Write ``t`` fresh K/V rows per batch row into the pools.
+
+    Row r's token j lands at logical position ``lengths[r] + j``,
+    physical ``(block_table[r, pos // bs], pos % bs)``.  Rows past
+    ``append_valid[r]``, rows overflowing the table, and unmapped
+    (``-1``) entries are routed to an out-of-range index and DROPPED —
+    an inactive slot writes nothing.  Returns ``(k_pages, v_pages)``.
+    """
+    nb, bs = view.k_pages.shape[0], view.k_pages.shape[1]
+    maxb = view.block_table.shape[1]
+    b, t = k_new.shape[0], k_new.shape[1]
+    pos = view.lengths[:, None] + jnp.arange(t)[None, :]          # [b,t]
+    valid = jnp.arange(t)[None, :] < view.append_valid[:, None]
+    blk = pos // bs
+    within = pos % bs
+    phys = jnp.take_along_axis(view.block_table,
+                               jnp.clip(blk, 0, maxb - 1), axis=1)
+    phys = jnp.where(valid & (blk < maxb) & (phys >= 0), phys, nb)
+    k_pages = view.k_pages.at[phys, within].set(
+        k_new.astype(view.k_pages.dtype), mode="drop")
+    v_pages = view.v_pages.at[phys, within].set(
+        v_new.astype(view.v_pages.dtype), mode="drop")
+    return k_pages, v_pages
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, block_table: jax.Array,
+                           lengths: jax.Array,
+                           scale=None) -> jax.Array:
+    """Decode attention by block table: ``q`` [b, 1, h, hd] attends each
+    row's ``lengths[r]`` committed tokens gathered from the pools.
+
+    XLA form: gather ``[b, max_blocks, bs, h, hd]``, flatten the token
+    axis (logical position p IS flattened index p — blocks gather in
+    table order), einsum with f32 accumulation, finite-NEG_INF mask to
+    the per-row length, f32 softmax.  Masked/garbage positions get
+    exactly-zero weight, so the result is bit-identical to the dense
+    cache path over the same tokens.  A Pallas paged-attention kernel
+    (ROADMAP open item) drops in behind this exact signature; this
+    gather form stays as the everywhere (CPU/interpret) fallback.
+    """
+    b, tq, h, hd = q.shape
+    nb, bs = k_pages.shape[0], k_pages.shape[1]
+    maxb = block_table.shape[1]
+    scale = (hd ** -0.5) if scale is None else scale
+    table = jnp.clip(block_table, 0, nb - 1)
+    k = k_pages[table].reshape(b, maxb * bs, h, hd)
+    v = v_pages[table].reshape(b, maxb * bs, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(maxb * bs)[None, :] < lengths[:, None]      # [b,K]
+    logits = logits + jnp.where(mask, 0.0, NEG_INF)[:, None, None, :]
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v,
+                      preferred_element_type=jnp.float32)
+
+
+def paged_hbm_bytes(lengths, *, num_layers: int, num_heads: int,
+                    head_dim: int, block_size: int,
+                    dtype_bytes: int = 4):
+    """Host-side cache-HBM accounting: per-request paged bytes (K+V,
+    all layers, whole blocks — internal fragmentation included) for a
+    list of actual token counts.  The dense comparison is
+    :func:`dense_hbm_bytes` at ``max_len``; ``docs/design/serving.md``
+    works the numbers."""
+    per_tok = 2 * num_layers * num_heads * head_dim * dtype_bytes
+    return [int(math.ceil(n / block_size)) * block_size * per_tok
+            for n in lengths]
+
+
+def dense_hbm_bytes(max_len: int, *, num_layers: int, num_heads: int,
+                    head_dim: int, dtype_bytes: int = 4) -> int:
+    """Dense-cache bytes per request slot: ``max_len`` rows regardless
+    of actual length."""
+    return max_len * 2 * num_layers * num_heads * head_dim * dtype_bytes

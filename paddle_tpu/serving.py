@@ -1,0 +1,480 @@
+"""Paged LM serving: block-pool KV cache + continuous batching.
+
+Two serving forms over the paged cache (``ops/paged_attention.py``):
+
+* :func:`paged_serve_builder` — the paged twin of
+  ``models/transformer.py::lm_serve_builder``: ONE jitted program
+  (prefill-into-pages + traced-``steps`` ``lax.while_loop`` decode,
+  in-jit block allocation each step) that is TOKEN-IDENTICAL to the
+  dense serve decoder at equal capacity.  The benchmarking /
+  batch-request form.
+
+* :class:`PagedServingEngine` — CONTINUOUS BATCHING: a fixed-shape
+  jitted decode step over ``num_slots`` request slots plus a host-side
+  admission loop.  A finished request retires immediately (its blocks
+  return to the pool) and a queued prompt prefills into the freed slot
+  MID-STREAM — no head-of-line blocking on long requests, and the
+  decode step never recompiles (the ``compiles == 1`` serving
+  contract).  Admission reserves each request's worst case
+  (``ceil((prompt + max_new)/block_size)`` blocks) in HOST accounting
+  only, so the in-jit allocator can never run dry; physical blocks are
+  still mapped on demand, so reported occupancy tracks ACTUAL tokens.
+
+Why paged: the dense serving cache costs
+``num_slots * max_len * 2 * L * dim * dtype_bytes`` of HBM no matter
+what is actually resident — the paged pool costs
+``num_blocks * block_size`` tokens total, sized to the EXPECTED load
+(p50 lengths), which is what bounds serving batch size on a chip.  The
+HBM math is worked in ``docs/design/serving.md``; the design follows
+Ragged Paged Attention (PAPERS.md) — the TPU-native paged-KV serving
+kernel family.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.core.dtypes import get_policy
+from paddle_tpu.models.transformer import (TransformerConfig,
+                                           TransformerLM,
+                                           _sampling_picker)
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
+                                            paged_hbm_bytes)
+import paddle_tpu.nn as nn
+
+__all__ = ["paged_serve_builder", "PagedServingEngine",
+           "paged_hbm_bytes", "dense_hbm_bytes"]
+
+
+def _paged_model(cfg: TransformerConfig, attn_fn):
+    """Transformed incremental model over paged layer views (the
+    ``_cached_lm`` twin for the paged cache form)."""
+    if attn_fn is None and cfg.flash:
+        from paddle_tpu.ops.attention import flash_attention_fn
+        attn_fn = flash_attention_fn
+    return nn.transform(
+        lambda ids, views, pos_ids:
+            TransformerLM(cfg, attn_fn=attn_fn, name="lm")(
+                ids, caches=views, position=0, pos_ids=pos_ids))
+
+
+def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
+                        block_size: int = 16,
+                        max_blocks_per_slot: Optional[int] = None,
+                        num_blocks: Optional[int] = None):
+    """Serving-shaped PAGED decode: ``lm_serve_builder``'s contract
+    (traced ``steps``, one compiled program per prompt bucket, eos
+    early exit, PAD past each row's end) over the block-pool cache.
+
+    Returns ``serve(params, prompt_ids, steps, temperature=0.0,
+    rng=None, eos_id=None, top_k=None, top_p=None, prompt_lens=None)
+    -> [b, tp + max_new]`` with ``max_new = min(cfg.max_len,
+    max_blocks_per_slot * block_size) - tp``.  Token streams are
+    IDENTICAL to ``lm_serve_builder`` at equal steps (same
+    ``_sampling_picker``, same rng-split order; masked block-table
+    positions carry exactly-zero attention weight, so the paged gather
+    cannot perturb the numerics — pinned by the tier-1 parity test).
+
+    RAGGED batches differ from the dense decoder's convention: prompts
+    are LEFT-aligned (row r's tokens in columns ``[0, len_r)``, pad on
+    the RIGHT) with ``prompt_lens`` [b] — the natural paged layout,
+    where each row's pages hold exactly its real tokens.  Each row
+    decodes as if batched alone.
+
+    ``num_blocks`` sizes the global pool (default: the dense-equivalent
+    ``b * max_blocks_per_slot``); undersize it to serve more rows than
+    dense HBM would allow — the host wrapper rejects a pool that cannot
+    hold the request's worst case (actual prompt lengths + ``steps``),
+    and a traced-``steps`` overflow poisons the output with ``-1``
+    (a fixed-shape program cannot raise).
+    """
+    model = _paged_model(cfg, attn_fn)
+    hd = cfg.dim // cfg.num_heads
+    bs = block_size
+    maxb = (max_blocks_per_slot if max_blocks_per_slot
+            else -(-cfg.max_len // bs))
+    cap = min(cfg.max_len, maxb * bs)     # per-slot token capacity
+
+    @functools.partial(jax.jit, static_argnums=(5, 6, 7))
+    def _pserve(params, prompt_ids, steps, temperature=0.0, rng=None,
+                eos_id=None, top_k=None, top_p=None, prompt_lens=None):
+        b, tp = prompt_ids.shape
+        max_new = cap - tp
+        assert max_new >= 1, (
+            f"prompt {tp} leaves no room to decode in capacity {cap}")
+        assert eos_id is None or 0 <= eos_id < cfg.vocab_size, (
+            f"eos_id {eos_id} outside vocab {cfg.vocab_size} — a "
+            "mismatched id would silently never terminate")
+        assert top_k is None or 1 <= top_k <= cfg.vocab_size
+        assert top_p is None or 0.0 < top_p <= 1.0
+        policy = get_policy()
+        nb = num_blocks if num_blocks else b * maxb
+        cache = paged.paged_init(cfg.num_layers, b, maxb, nb, bs,
+                                 cfg.num_heads, hd, policy.compute_dtype)
+        rng_key = jax.random.key(0) if rng is None else rng
+        temp = jnp.asarray(temperature, jnp.float32)
+        steps = jnp.clip(jnp.asarray(steps, jnp.int32), 1, max_new)
+        pad = jnp.asarray(eos_id if eos_id is not None else 0,
+                          prompt_ids.dtype)
+        pick = _sampling_picker(cfg, temp, prompt_ids.dtype, eos_id,
+                                top_k, top_p)
+        if prompt_lens is None:
+            lens = jnp.full((b,), tp, jnp.int32)
+        else:
+            lens = jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, tp)
+
+        # prefill-into-pages: reserve each row's prompt blocks, write
+        # k/v through the layer views, read the LAST REAL token's
+        # logits (column lens-1; pad columns are masked dead weight)
+        cache, ok = paged.paged_reserve(cache, lens)
+        views = paged.layer_views(cache, jnp.arange(b), lens)
+        pos_ids = jnp.broadcast_to(jnp.arange(tp)[None, :], (b, tp))
+        (logits, views), _ = model.apply(params, {}, None, prompt_ids,
+                                         views, pos_ids)
+        cache = paged.paged_advance(paged.merge_views(cache, views),
+                                    lens)
+        last = jnp.take_along_axis(
+            logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        k0, rng_key = jax.random.split(rng_key)
+        tok, done = pick(last, k0, jnp.zeros((b,), bool))
+        buf = jnp.full((b, max_new), pad, prompt_ids.dtype)
+        buf = buf.at[:, 0].set(tok)
+        oom = ~ok
+
+        def cond(carry):
+            _, _, _, done, _, _, i = carry
+            live = i < steps
+            if eos_id is not None:
+                live = live & ~jnp.all(done)
+            return live
+
+        def body(carry):
+            cache, tok, key, done, buf, oom, i = carry
+            active = (~done).astype(jnp.int32)
+            cache, ok = paged.paged_reserve(cache, active)
+            views = paged.layer_views(cache, jnp.arange(b), active)
+            step_pos = cache.lengths[:, None]            # [b, 1]
+            (lg, views), _ = model.apply(params, {}, None, tok[:, None],
+                                         views, step_pos)
+            cache = paged.paged_advance(paged.merge_views(cache, views),
+                                        active)
+            key, sub = jax.random.split(key)
+            nxt, done = pick(lg[:, -1], sub, done)
+            buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+            return (cache, nxt, key, done, buf, oom | ~ok, i + 1)
+
+        (_, _, _, _, buf, oom, _) = jax.lax.while_loop(
+            cond, body, (cache, tok, rng_key, done, buf, oom,
+                         jnp.asarray(1, jnp.int32)))
+        # a fixed-shape program cannot raise: pool exhaustion poisons
+        # the whole output LOUDLY (-1 is out of every vocab)
+        buf = jnp.where(oom, jnp.asarray(-1, buf.dtype), buf)
+        return jnp.concatenate([prompt_ids, buf], axis=1)
+
+    def serve(params, prompt_ids, steps, temperature=0.0, rng=None,
+              eos_id=None, top_k=None, top_p=None, prompt_lens=None):
+        b, tp = prompt_ids.shape
+        max_new = cap - tp
+        if isinstance(steps, (int, np.integer)):
+            assert 1 <= steps <= max_new, (
+                f"paged serve: steps {int(steps)} outside [1, {max_new}]"
+                f" (prompt {tp} in capacity {cap}) — the result would "
+                "silently truncate")
+        t_arr = np.asarray(temperature) if not hasattr(
+            temperature, "aval") else temperature
+        if getattr(t_arr, "ndim", 0) >= 1:
+            assert t_arr.ndim == 1 and t_arr.shape[0] == b, (
+                f"paged serve: temperature must be a scalar or "
+                f"[batch={b}] vector, got shape {tuple(t_arr.shape)}")
+        lens_arr = np.full((b,), tp, np.int64)
+        if prompt_lens is not None:
+            la = np.asarray(prompt_lens)
+            if la.dtype.kind in "iu":            # host-concrete
+                assert la.min() >= 1 and la.max() <= tp, (
+                    f"paged serve: prompt_lens outside [1, {tp}] — pads "
+                    "would be decoded as prompt tokens")
+                lens_arr = la
+            prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        if num_blocks and isinstance(steps, (int, np.integer)):
+            worst = int(sum(-(-(int(n) + int(steps)) // bs)
+                            for n in lens_arr))
+            assert worst <= num_blocks, (
+                f"paged serve: pool of {num_blocks} blocks cannot hold "
+                f"the worst case {worst} (prompts + {int(steps)} steps "
+                f"at block_size {bs}) — the in-jit allocator would "
+                "poison the output")
+        return _pserve(params, prompt_ids,
+                       jnp.asarray(steps, jnp.int32), temperature, rng,
+                       eos_id, top_k, top_p, prompt_lens)
+
+    serve._cache_size = _pserve._cache_size   # the no-retrace proof hook
+    serve.block_size = bs
+    serve.max_blocks_per_slot = maxb
+    return serve
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "max_new", "temperature", "tokens",
+                 "blocks_reserved", "submitted_at")
+
+    def __init__(self, rid, prompt, max_new, temperature, blocks):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.tokens = []                  # generated ids (host ints)
+        self.blocks_reserved = blocks
+        self.submitted_at = time.perf_counter()
+
+
+class PagedServingEngine:
+    """Continuous-batching LM server over the paged KV cache.
+
+    ``num_slots`` fixes the decode step's batch shape — ONE compile
+    serves the engine's whole lifetime (``compile_counts()['decode']``
+    pins it).  ``submit()`` queues requests; ``run()`` drives the
+    decode/retire/admit loop until everything finishes and returns
+    ``{rid: np.ndarray(generated ids)}``.  Greedy decode is
+    token-identical to ``lm_generate_builder`` per request (the decode
+    math is exact — see ``ops/paged_attention.py``), so mixed-length
+    continuous batching costs nothing in output quality.
+
+    ``prompt_buckets`` are the prefill pad widths (one prefill compile
+    per bucket actually used); ``eos_id``/``top_k``/``top_p`` are
+    engine-static (a serving process fixes its tokenizer and sampler).
+    """
+
+    def __init__(self, cfg: TransformerConfig, params, *,
+                 num_slots: int, num_blocks: int, block_size: int = 16,
+                 max_blocks_per_slot: Optional[int] = None,
+                 prompt_buckets=(64,), eos_id: Optional[int] = None,
+                 top_k=None, top_p=None, attn_fn=None, seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.S = num_slots
+        self.bs = block_size
+        self.nb = num_blocks
+        self.maxb = (max_blocks_per_slot if max_blocks_per_slot
+                     else -(-cfg.max_len // block_size))
+        self.cap = min(cfg.max_len, self.maxb * block_size)
+        self.buckets = tuple(sorted(prompt_buckets))
+        self.eos_id = eos_id
+        enforce(self.nb >= 1 and self.S >= 1, "engine needs a pool and "
+                "at least one slot")
+        hd = cfg.dim // cfg.num_heads
+        model = _paged_model(cfg, attn_fn)
+        S = self.S
+
+        def decode_fn(params, cache, tok, active, temps, done, key):
+            act = active.astype(jnp.int32)
+            cache, ok = paged.paged_reserve(cache, act)
+            views = paged.layer_views(cache, jnp.arange(S), act)
+            (lg, views), _ = model.apply(params, {}, None, tok[:, None],
+                                         views, cache.lengths[:, None])
+            cache = paged.paged_advance(paged.merge_views(cache, views),
+                                        act)
+            pick = _sampling_picker(cfg, temps, jnp.int32, eos_id,
+                                    top_k, top_p)
+            nxt, done = pick(lg[:, -1], key, done)
+            return cache, nxt, done, ok
+
+        def prefill_fn(params, cache, slot, prompt, plen, temp, key):
+            want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
+            cache, ok = paged.paged_reserve(cache, want)
+            views = paged.layer_views(cache, slot[None], plen[None])
+            w = prompt.shape[1]
+            pos_ids = jnp.arange(w)[None, :]
+            (lg, views), _ = model.apply(params, {}, None, prompt,
+                                         views, pos_ids)
+            cache = paged.paged_advance(paged.merge_views(cache, views),
+                                        want)
+            last = jax.lax.dynamic_index_in_dim(lg[0], plen - 1, axis=0,
+                                                keepdims=False)
+            pick = _sampling_picker(cfg, jnp.asarray(temp, jnp.float32),
+                                    jnp.int32, eos_id, top_k, top_p)
+            tok0, done0 = pick(last[None], key, jnp.zeros((1,), bool))
+            return cache, tok0[0], done0[0], ok
+
+        self._decode = jax.jit(decode_fn)
+        self._prefill = jax.jit(prefill_fn)
+        self._free = jax.jit(paged.paged_free)
+        self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
+                                      self.nb, self.bs, cfg.num_heads,
+                                      hd, get_policy().compute_dtype)
+        self._key = jax.random.key(seed)
+        # host mirrors: fixed-shape device carries + per-slot requests
+        self._slots = [None] * S          # _Request or None
+        self._tok = np.zeros((S,), np.int32)
+        self._temps = np.zeros((S,), np.float32)
+        self._done = np.ones((S,), bool)
+        self._queue = deque()
+        self._results = {}
+        self._next_rid = 0
+        self._reserved = 0                # worst-case blocks, admitted
+        self.decode_steps = 0
+        self.tokens_decoded = 0
+        self._run_seconds = 0.0
+
+    # ---------------------------------------------------------- host API
+
+    def submit(self, prompt_ids, max_new: int,
+               temperature: float = 0.0) -> int:
+        """Queue one request; returns its id.  ``prompt_ids``: 1-D int
+        sequence.  Capacity contract is loud: the prompt must fit a
+        bucket and ``prompt + max_new`` the per-slot capacity."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        enforce(n >= 1, "submit: empty prompt")
+        enforce(any(n <= w for w in self.buckets),
+                "submit: prompt length %d exceeds every prefill bucket "
+                "%s", n, self.buckets)
+        enforce(max_new >= 1 and n + max_new <= self.cap,
+                "submit: prompt %d + max_new %d exceeds per-slot "
+                "capacity %d", n, max_new, self.cap)
+        blocks = -(-(n + max_new) // self.bs)
+        enforce(blocks <= self.nb,
+                "submit: request worst case %d blocks exceeds the pool "
+                "(%d) — it could never be admitted", blocks, self.nb)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt, max_new,
+                                    float(temperature), blocks))
+        return rid
+
+    def _split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _admit(self):
+        """Prefill queued requests into free slots while the pool's
+        worst-case accounting allows — called before every decode step,
+        which is what splices new work in MID-STREAM."""
+        while self._queue:
+            try:
+                slot = self._slots.index(None)
+            except ValueError:
+                return                    # all slots busy
+            req = self._queue[0]
+            if self._reserved + req.blocks_reserved > self.nb:
+                return                    # pool cannot take it yet
+            self._queue.popleft()
+            width = min(w for w in self.buckets
+                        if req.prompt.shape[0] <= w)
+            padded = np.zeros((1, width), np.int32)
+            padded[0, :req.prompt.shape[0]] = req.prompt
+            self.cache, tok0, done0, ok = self._prefill(
+                self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded),
+                jnp.asarray(req.prompt.shape[0], jnp.int32),
+                req.temperature, self._split())
+            assert bool(ok), "paged pool exhausted despite admission " \
+                             "accounting (engine bug)"
+            self._reserved += req.blocks_reserved
+            self._slots[slot] = req
+            req.tokens.append(int(tok0))
+            self._tok[slot] = int(tok0)
+            self._temps[slot] = req.temperature
+            self._done[slot] = bool(done0)
+            if bool(done0) or req.max_new == 1:
+                self._retire(slot)
+
+    def _retire(self, slot: int):
+        req = self._slots[slot]
+        self._results[req.rid] = np.asarray(req.tokens, np.int32)
+        self.cache = self._free(
+            self.cache, jnp.asarray(np.arange(self.S) == slot))
+        self._reserved -= req.blocks_reserved
+        self._slots[slot] = None
+        self._done[slot] = True
+
+    def step(self):
+        """One decode step over every active slot, then retire/admit."""
+        self._admit()
+        active = np.asarray([r is not None for r in self._slots])
+        if not active.any():
+            return False
+        self.cache, nxt, done, ok = self._decode(
+            self.params, self.cache, jnp.asarray(self._tok),
+            jnp.asarray(active), jnp.asarray(self._temps),
+            jnp.asarray(self._done), self._split())
+        assert bool(ok), "paged pool exhausted despite admission " \
+                         "accounting (engine bug)"
+        nxt, done = np.asarray(nxt), np.asarray(done)
+        self.decode_steps += 1
+        self.tokens_decoded += int(active.sum())
+        for s in np.nonzero(active)[0]:
+            req = self._slots[s]
+            req.tokens.append(int(nxt[s]))
+            self._tok[s] = nxt[s]
+            self._done[s] = done[s]
+            if done[s] or len(req.tokens) >= req.max_new:
+                self._retire(s)
+        self._admit()                     # splice into freed slots NOW
+        return True
+
+    def run(self):
+        """Drive to completion; returns ``{rid: generated ids}``."""
+        t0 = time.perf_counter()
+        while self._queue or any(r is not None for r in self._slots):
+            progressed = self.step()
+            if not progressed and self._queue:
+                raise RuntimeError(
+                    "serving deadlock: queued work but nothing active "
+                    "— a request too large for the current pool")
+        self._run_seconds += time.perf_counter() - t0
+        out, self._results = self._results, {}
+        return out
+
+    # ------------------------------------------------------- reporting
+
+    def compile_counts(self):
+        return {"decode": self._decode._cache_size(),
+                "prefill": self._prefill._cache_size()}
+
+    def occupancy(self):
+        """Actual pool usage (device truth) + host reservation."""
+        free = int(np.asarray(self.cache.free).sum())
+        return {"pool_blocks": self.nb,
+                "blocks_in_use": self.nb - free,
+                "blocks_reserved_worst_case": self._reserved,
+                "fraction_in_use": (self.nb - free) / self.nb}
+
+    def hbm_report(self):
+        """Cache-HBM accounting: paged bytes for the ACTIVE requests'
+        actual lengths vs what the dense ``[S, max_len]`` cache would
+        pin — the scaling the paged layout exists for."""
+        hd = self.cfg.dim // self.cfg.num_heads
+        dtype_bytes = jnp.dtype(get_policy().compute_dtype).itemsize
+        lens = [len(r.tokens) + r.prompt.shape[0]
+                for r in self._slots if r is not None]
+        kw = dict(num_layers=self.cfg.num_layers,
+                  num_heads=self.cfg.num_heads, head_dim=hd,
+                  dtype_bytes=dtype_bytes)
+        return {
+            "active_lengths": lens,
+            "paged_bytes_per_request": paged_hbm_bytes(
+                lens, block_size=self.bs, **kw),
+            "dense_bytes_per_request": dense_hbm_bytes(
+                self.cfg.max_len, **kw),
+            "pool_bytes_total": self.nb * self.bs * 2
+            * self.cfg.num_layers * self.cfg.num_heads * hd
+            * dtype_bytes,
+        }
+
+    def stats(self):
+        dt = max(self._run_seconds, 1e-9)
+        return {"decode_steps": self.decode_steps,
+                "tokens_decoded": self.tokens_decoded,
+                "tokens_per_s": self.tokens_decoded / dt,
+                "compiles": self.compile_counts(),
+                "occupancy": self.occupancy()}

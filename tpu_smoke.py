@@ -143,6 +143,35 @@ def main() -> int:
 
     compile_grad("flash_attention_fwd_bwd", flash_loss, qkv[0], qkv[1])
 
+    # Paged-decode parity on the chip: the paged KV-cache serve path
+    # (block-table gather + pool scatter, serving.py) must emit the
+    # SAME greedy tokens as the dense decoder.  The CPU suite pins this
+    # bit-exactly; on TPU the scatter/gather lowering differs, so a
+    # layout regression would show up only here.
+    try:
+        import paddle_tpu.nn as nn
+        from paddle_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM,
+                                                   lm_serve_builder)
+        from paddle_tpu.serving import paged_serve_builder
+
+        scfg = TransformerConfig(vocab_size=256, dim=128, num_heads=4,
+                                 num_layers=2, max_len=64)
+        lm = nn.transform(lambda ids: TransformerLM(scfg, name="lm")(ids))
+        sp, _ = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+        spr = jnp.asarray(rs.randint(0, 256, (2, 8)), jnp.int32)
+        dtoks = np.asarray(lm_serve_builder(scfg)(sp, spr, 16))
+        ptoks = np.asarray(paged_serve_builder(scfg, block_size=16)(
+            sp, spr, 16))
+        ok = bool((dtoks[:, :24] == ptoks[:, :24]).all())
+        print(json.dumps({"smoke": "paged_decode_parity", "ok": ok}))
+        if not ok:
+            failures.append("paged_decode_parity")
+    except Exception as e:  # noqa: BLE001 — report and continue
+        failures.append("paged_decode_parity")
+        print(json.dumps({"smoke": "paged_decode_parity", "ok": False,
+                          "error": str(e)[:200]}))
+
     if os.environ.get("PADDLE_TPU_SMOKE_PERF", "1") != "0":
         failures += perf_floor(rs)
         failures += flash_perf_floor(rs)
