@@ -36,6 +36,12 @@ import paddle_tpu.framework
 print("import surface OK on", jax.default_backend())
 EOF
 
+echo "== tpu-lint: jaxpr self-check over registered entrypoints =="
+# Traces the trainer/serve/eval programs on CPU and fails on any
+# error-severity finding (accum-dtype, host-callback-in-loop, ...).
+# Warn-severity findings (gather-in-decode etc.) print but don't gate.
+JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check
+
 echo "== native libs =="
 make -C csrc -q 2>/dev/null || make -C csrc
 

@@ -1,0 +1,42 @@
+"""tpu-lint: jaxpr-level static analysis of this repo's jitted programs.
+
+The invariants PR 1 enforced by hand — f32 matmul accumulation,
+device-resident decode loops, ``compiles == 1``, donated step buffers
+— generalized into a rule registry that walks any traced entrypoint::
+
+    from paddle_tpu.analysis import lint
+    findings = lint(my_jitted_step, (args,))      # traces, never runs
+
+    python -m paddle_tpu.analysis --self-check    # the CI gate
+
+Pieces:
+
+* :func:`lint` / :class:`LintTarget` — trace + walk (``core.py``);
+* the rule registry (``rules.py``): accum-dtype, weak-type-promotion,
+  host-callback-in-loop, gather-in-decode, dead-code, donation-audit;
+* :class:`CompileWatcher` — the runtime companion: compile-count
+  assertions for the retrace contract statics cannot see
+  (``watch.py``);
+* the entrypoint registry (``entrypoints.py``) — what ``--self-check``
+  covers; register yours with :func:`register_entrypoint`.
+
+Suppress a finding at source with ``# tpu-lint: disable=<rule-id>``.
+Catalog and severity policy: ``docs/design/analysis.md``.
+"""
+
+from paddle_tpu.analysis.core import (Finding, LintTarget, lint,
+                                      lint_target, SEVERITIES,
+                                      severity_rank)
+from paddle_tpu.analysis.rules import RULES, Rule, active_rules, \
+    register_rule
+from paddle_tpu.analysis.watch import CompileWatcher
+from paddle_tpu.analysis.entrypoints import (ENTRYPOINTS,
+                                             register_entrypoint,
+                                             self_check_targets)
+
+__all__ = [
+    "Finding", "LintTarget", "lint", "lint_target", "SEVERITIES",
+    "severity_rank", "RULES", "Rule", "active_rules", "register_rule",
+    "CompileWatcher", "ENTRYPOINTS", "register_entrypoint",
+    "self_check_targets",
+]

@@ -1,0 +1,8 @@
+"""``python -m paddle_tpu.analysis`` — the tpu-lint CLI (see cli.py)."""
+
+import sys
+
+from paddle_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

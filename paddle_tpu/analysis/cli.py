@@ -1,0 +1,206 @@
+"""tpu-lint command line.
+
+Two spellings, one implementation::
+
+    python -m paddle_tpu.analysis --self-check          # CI gate
+    python -m paddle_tpu.analysis mypkg.mymod:target    # lint anything
+    python -m paddle_tpu lint --self-check              # cli.py alias
+
+A target is ``module:attr`` where ``attr`` is either
+
+* a zero-argument factory returning a
+  :class:`~paddle_tpu.analysis.core.LintTarget` (the entrypoint-
+  registry convention — build the jitted fn and example args), or
+* any traceable callable, with ``--shapes`` giving the example
+  arguments as avals, e.g. ``--shapes "f32[4,8],i32[4]"`` (dtype
+  shorthand: f32/bf16/f16/i32/i64/u32/bool).
+
+Findings render as a table (or ``--json``); the exit status is the
+gate: 0 = clean at the ``--fail-on`` severity (default ``error``),
+1 = findings at/above it, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import re
+import sys
+from typing import List, Optional, Sequence
+
+__all__ = ["main"]
+
+_DTYPES = {"f32": "float32", "f64": "float64", "bf16": "bfloat16",
+           "f16": "float16", "i32": "int32", "i64": "int64",
+           "i8": "int8", "u32": "uint32", "u8": "uint8", "bool": "bool_"}
+
+
+def _parse_shapes(spec: str):
+    """``"f32[4,8],i32[4],bf16[]"`` -> tuple of ShapeDtypeStructs."""
+    import jax
+    import jax.numpy as jnp
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        m = re.fullmatch(r"(\w+)\[([\d;\s]*)\]", part)
+        if not m or m.group(1) not in _DTYPES:
+            raise SystemExit(
+                f"--shapes: cannot parse {part!r} (want dtype[d;d;...], "
+                f"dtypes: {', '.join(sorted(_DTYPES))})")
+        dims = tuple(int(d) for d in m.group(2).split(";") if d.strip())
+        out.append(jax.ShapeDtypeStruct(
+            dims, getattr(jnp, _DTYPES[m.group(1)])))
+    return tuple(out)
+
+
+def _resolve_target(spec: str, shapes: Optional[str]):
+    from paddle_tpu.analysis.core import LintTarget
+    if ":" not in spec:
+        raise SystemExit(f"target {spec!r} must be module:attr")
+    mod_name, attr = spec.split(":", 1)
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        raise SystemExit(f"cannot import {mod_name}: {e}")
+    try:
+        obj = getattr(mod, attr)
+    except AttributeError:
+        raise SystemExit(f"{mod_name} has no attribute {attr!r}")
+    if isinstance(obj, LintTarget):
+        return obj
+    if shapes is not None:
+        return LintTarget(spec, obj, _parse_shapes(shapes))
+    # factory convention: call with no args, expect a LintTarget
+    try:
+        made = obj()
+    except TypeError:
+        raise SystemExit(
+            f"{spec} takes arguments — pass --shapes to describe them, "
+            "or point at a zero-arg factory returning a LintTarget")
+    if not isinstance(made, LintTarget):
+        raise SystemExit(
+            f"{spec}() returned {type(made).__name__}, expected a "
+            "LintTarget (fn + example args)")
+    return made
+
+
+# -------------------------------------------------------------- rendering
+
+
+def _render_table(findings, out=None) -> None:
+    # resolve sys.stdout per call, not at import (redirects, capsys)
+    out = out if out is not None else sys.stdout
+    if not findings:
+        print("no findings", file=out)
+        return
+    rows = []
+    for f in findings:
+        loc = f.location()
+        # repo-relative paths read better and keep the table narrow
+        loc = re.sub(r"^.*?/paddle_tpu/", "paddle_tpu/", loc)
+        rows.append((f.severity.upper(), f.rule_id, loc, f.path,
+                     f.message))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    for (sev, rule, loc, path, msg), f in zip(rows, findings):
+        print(f"{sev:<{widths[0]}}  {rule:<{widths[1]}}  "
+              f"{loc:<{widths[2]}}  {path:<{widths[3]}}  {msg}",
+              file=out)
+        if f.suggestion:
+            pad = " " * (widths[0] + 2)
+            print(f"{pad}-> {f.suggestion}", file=out)
+        if f.cost:
+            pad = " " * (widths[0] + 2)
+            cost = ", ".join(f"{k}={v:.3g}" for k, v in f.cost.items())
+            print(f"{pad}   program cost: {cost}", file=out)
+
+
+def _gate(findings, fail_on: str) -> int:
+    from paddle_tpu.analysis.core import severity_rank
+    bar = severity_rank(fail_on)
+    return 1 if any(severity_rank(f.severity) >= bar
+                    for f in findings) else 0
+
+
+# ------------------------------------------------------------------- main
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu-lint",
+        description="jaxpr-level static analysis of jitted entrypoints")
+    parser.add_argument("targets", nargs="*",
+                        help="module:attr — a LintTarget factory, or a "
+                             "callable with --shapes")
+    parser.add_argument("--self-check", action="store_true",
+                        help="lint every registered entrypoint (trainer "
+                             "step, dense/paged serve steps, eval step, "
+                             "engine decode step)")
+    parser.add_argument("--shapes", default=None,
+                        help='example avals for a plain callable, e.g. '
+                             '"f32[4;8],i32[4]"')
+    parser.add_argument("--disable", default="",
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--cost", action="store_true",
+                        help="compile (CPU) and attach whole-program "
+                             "flops/bytes to cost-aware findings")
+    parser.add_argument("--fail-on", choices=("info", "warn", "error"),
+                        default="error",
+                        help="exit nonzero at this severity or above "
+                             "(default: error)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    # the analyzer must NEVER touch (or hang on) an attached chip: all
+    # tracing runs on the CPU backend, same discipline as ci.sh lint
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu
+    paddle_tpu._honor_env_platform(force=True)
+
+    from paddle_tpu.analysis.rules import active_rules
+    if args.list_rules:
+        for rule in active_rules():
+            print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+        return 0
+
+    from paddle_tpu.analysis.core import lint_target
+    targets = []
+    if args.self_check:
+        from paddle_tpu.analysis.entrypoints import self_check_targets
+        targets.extend(self_check_targets())
+    for spec in args.targets:
+        targets.append(_resolve_target(spec, args.shapes))
+    if not targets:
+        parser.print_usage(sys.stderr)
+        print("tpu-lint: nothing to lint (pass targets or --self-check)",
+              file=sys.stderr)
+        return 2
+
+    disable = tuple(filter(None, args.disable.split(",")))
+    all_findings = []
+    for target in targets:
+        findings = lint_target(target, disable=disable,
+                               with_cost=args.cost)
+        all_findings.extend(findings)
+        if not args.json:
+            errs = sum(f.severity == "error" for f in findings)
+            warns = sum(f.severity == "warn" for f in findings)
+            print(f"== {target.name}: {errs} error(s), "
+                  f"{warns} warning(s)")
+            _render_table(findings)
+    if args.json:
+        print(json.dumps([f.to_dict() for f in all_findings], indent=2))
+    rc = _gate(all_findings, args.fail_on)
+    if not args.json:
+        n = len(targets)
+        print(f"tpu-lint: {n} entrypoint(s), "
+              f"{len(all_findings)} finding(s) — "
+              f"{'FAIL' if rc else 'OK'} at --fail-on={args.fail_on}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

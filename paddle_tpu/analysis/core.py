@@ -1,0 +1,333 @@
+"""tpu-lint core: trace a callable to a jaxpr and walk it with rules.
+
+The serving and training contracts this repo enforces by hand — f32
+matmul accumulation, device-resident decode loops, ``compiles == 1``,
+donated step buffers — are all *whole-program* properties of the traced
+jaxpr, which is exactly the artifact ``jax.make_jaxpr`` hands us for
+free on any backend (the walk runs under ``JAX_PLATFORMS=cpu``; no
+chip is touched).  :func:`lint` traces a callable, recurses through
+every control-flow sub-jaxpr (``while``/``scan``/``cond``/``pjit``/
+custom-derivative wrappers), and hands each equation to the registered
+rules (``rules.py``), which emit structured :class:`Finding`s.
+
+Walk state the rules key on:
+
+* ``loop_depth`` — how many ``while``/``scan`` bodies enclose the
+  equation (the serving hot path lives at depth >= 1);
+* carry taint — the set of vars derived from loop carries / scanned
+  inputs, i.e. values that CHANGE across iterations.  A gather whose
+  indices are loop-invariant is hoistable; one fed by a carry is the
+  real per-step gather traffic (``gather-in-decode``).
+
+Suppressions are source comments, clang-tidy style::
+
+    y = jnp.dot(a, b)  # tpu-lint: disable=accum-dtype
+    # tpu-lint: disable=all            (line above also counts)
+
+Findings carry the rule id, severity, the equation path through the
+sub-jaxpr tree (``pjit:_pserve/while.body/gather``), the user source
+location, a message, and a suggestion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import linecache
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax._src import core as jcore
+from jax._src import source_info_util
+
+__all__ = ["Finding", "LintTarget", "lint", "SEVERITIES", "severity_rank"]
+
+# Severity policy (docs/design/analysis.md): "error" = a correctness
+# trap (silent bf16 accumulation, host callback on the decode hot
+# path) — CI fails on these; "warn" = a perf/hygiene advisory (gather
+# traffic, dead code, missed donation); "info" = informational.
+SEVERITIES = ("info", "warn", "error")
+
+
+def severity_rank(severity: str) -> int:
+    return SEVERITIES.index(severity)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: str
+    path: str                 # eqn path through the sub-jaxpr tree
+    message: str
+    suggestion: str = ""
+    file: Optional[str] = None
+    line: Optional[int] = None
+    cost: Optional[Dict[str, float]] = None   # program-level, if computed
+
+    def location(self) -> str:
+        if self.file is None:
+            return "<no source>"
+        return f"{self.file}:{self.line}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintTarget:
+    """A lintable entrypoint: a callable plus example arguments.
+
+    ``fn`` may be a ``jax.jit`` product (then donation metadata and —
+    with ``with_cost`` — XLA cost analysis are available via
+    ``.lower()``) or any traceable callable.  ``args``/``kwargs`` may
+    be concrete arrays or ``jax.ShapeDtypeStruct``s; nothing is
+    executed, only traced.
+    """
+    name: str
+    fn: Callable
+    args: Tuple = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+# --------------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-,]+)")
+
+
+def _suppressed(file: Optional[str], line: Optional[int],
+                rule_id: str) -> bool:
+    """True if the flagged source line (or the line above it) carries a
+    ``# tpu-lint: disable=<rule[,rule...]>`` or ``disable=all`` comment."""
+    if file is None or line is None:
+        return False
+    for ln in (line, line - 1):
+        if ln < 1:
+            continue
+        m = _SUPPRESS_RE.search(linecache.getline(file, ln))
+        if m:
+            names = {n.strip() for n in m.group(1).split(",")}
+            if "all" in names or rule_id in names:
+                return True
+    return False
+
+
+def _user_frame(eqn) -> Tuple[Optional[str], Optional[int]]:
+    try:
+        fr = source_info_util.user_frame(eqn.source_info)
+    except Exception:
+        fr = None
+    if fr is None:
+        return None, None
+    return fr.file_name, fr.start_line
+
+
+# ------------------------------------------------------------------ context
+
+
+class LintContext:
+    """Accumulates findings for one :func:`lint` run, applying
+    suppressions and rule disables at report time."""
+
+    def __init__(self, disable: Sequence[str] = (),
+                 cost: Optional[Dict[str, float]] = None):
+        self.findings: List[Finding] = []
+        self.disable = set(disable)
+        self.cost = cost          # whole-program cost_analysis(), if any
+
+    def report(self, rule, path: str, message: str, *, eqn=None,
+               suggestion: str = "", file: Optional[str] = None,
+               line: Optional[int] = None, attach_cost: bool = False):
+        if rule.rule_id in self.disable:
+            return
+        if eqn is not None and file is None:
+            file, line = _user_frame(eqn)
+        if _suppressed(file, line, rule.rule_id):
+            return
+        self.findings.append(Finding(
+            rule_id=rule.rule_id, severity=rule.severity, path=path,
+            message=message, suggestion=suggestion, file=file, line=line,
+            cost=self.cost if attach_cost else None))
+
+
+# ------------------------------------------------------------------- walker
+
+
+@dataclasses.dataclass
+class WalkState:
+    """Per-sub-jaxpr walk state handed to every rule."""
+    path: str = ""
+    loop_depth: int = 0
+    tainted: frozenset = frozenset()     # ids of vars derived from carries
+
+    def at(self, segment: str, *, enter_loop: bool = False,
+           tainted=None) -> "WalkState":
+        return WalkState(
+            path=f"{self.path}/{segment}" if self.path else segment,
+            loop_depth=self.loop_depth + (1 if enter_loop else 0),
+            tainted=self.tainted if tainted is None else tainted)
+
+    def is_tainted(self, var) -> bool:
+        return id(var) in self.tainted
+
+
+def _inner_taint(state: WalkState, outer_invars, inner_invars,
+                 extra_tainted=()) -> frozenset:
+    """Map taint across a sub-jaxpr boundary: inner invar i is tainted
+    iff the outer operand feeding it is, plus any explicitly-seeded
+    vars (loop carries)."""
+    tainted = {id(v) for v in extra_tainted}
+    for outer, inner in zip(outer_invars, inner_invars):
+        if isinstance(outer, jcore.Var) and state.is_tainted(outer):
+            tainted.add(id(inner))
+    return frozenset(tainted)
+
+
+def _closed(j):
+    """Normalize Jaxpr / ClosedJaxpr to ClosedJaxpr."""
+    if isinstance(j, jcore.ClosedJaxpr):
+        return j
+    return jcore.ClosedJaxpr(j, ())
+
+
+def _walk(closed_jaxpr, rules, ctx: LintContext, state: WalkState):
+    jaxpr = closed_jaxpr.jaxpr
+    for rule in rules:
+        check = getattr(rule, "check_jaxpr", None)
+        if check is not None:
+            check(jaxpr, state, ctx)
+    tainted = set(state.tainted)
+    for eqn in jaxpr.eqns:
+        # taint propagation: any output of an eqn fed by a tainted var
+        # is itself iteration-varying
+        if any(isinstance(v, jcore.Var) and id(v) in tainted
+               for v in eqn.invars):
+            tainted.update(id(v) for v in eqn.outvars)
+        eqn_state = dataclasses.replace(state, tainted=frozenset(tainted))
+        for rule in rules:
+            check = getattr(rule, "check_eqn", None)
+            if check is not None:
+                check(eqn, eqn_state, ctx)
+        _descend(eqn, rules, ctx, eqn_state)
+
+
+def _descend(eqn, rules, ctx: LintContext, state: WalkState):
+    """Recurse into an equation's sub-jaxprs with the right loop-depth
+    and carry-taint seeding per control-flow primitive."""
+    prim = eqn.primitive.name
+    params = eqn.params
+    if prim == "pjit":
+        inner = _closed(params["jaxpr"])
+        seg = f"pjit:{params.get('name', '?')}"
+        t = _inner_taint(state, eqn.invars, inner.jaxpr.invars)
+        _walk(inner, rules, ctx, state.at(seg, tainted=t))
+    elif prim == "while":
+        cond = _closed(params["cond_jaxpr"])
+        body = _closed(params["body_jaxpr"])
+        cn, bn = params["cond_nconsts"], params["body_nconsts"]
+        # carries = body invars past the consts; they (and anything
+        # they feed) change every iteration
+        carries = body.jaxpr.invars[bn:]
+        t = _inner_taint(state, eqn.invars[cn + bn:],
+                         body.jaxpr.invars[bn:], extra_tainted=carries)
+        _walk(body, rules, ctx,
+              state.at("while.body", enter_loop=True, tainted=t))
+        tc = _inner_taint(state, eqn.invars[cn + bn:],
+                          cond.jaxpr.invars[cn:],
+                          extra_tainted=cond.jaxpr.invars[cn:])
+        _walk(cond, rules, ctx,
+              state.at("while.cond", enter_loop=True, tainted=tc))
+    elif prim == "scan":
+        inner = _closed(params["jaxpr"])
+        nc = params["num_consts"]
+        # carries AND the per-iteration xs slices vary across steps
+        varying = inner.jaxpr.invars[nc:]
+        t = _inner_taint(state, eqn.invars[nc:], inner.jaxpr.invars[nc:],
+                         extra_tainted=varying)
+        _walk(inner, rules, ctx,
+              state.at("scan.body", enter_loop=True, tainted=t))
+    elif prim == "cond":
+        for i, br in enumerate(params["branches"]):
+            br = _closed(br)
+            t = _inner_taint(state, eqn.invars[1:], br.jaxpr.invars)
+            _walk(br, rules, ctx,
+                  state.at(f"cond.branch{i}", tainted=t))
+    elif prim in ("custom_jvp_call", "custom_vjp_call",
+                  "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"):
+        inner = params.get("call_jaxpr") or params.get("fun_jaxpr")
+        if inner is not None:
+            inner = _closed(inner)
+            t = _inner_taint(state, eqn.invars, inner.jaxpr.invars)
+            _walk(inner, rules, ctx, state.at(prim, tainted=t))
+    else:
+        # generic fallback (remat/checkpoint, closed_call, ...): walk any
+        # jaxpr-valued param without taint mapping — better to see inside
+        # with imprecise taint than to skip a subtree
+        for key, val in params.items():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                    _walk(_closed(v), rules, ctx,
+                          state.at(f"{prim}.{key}"))
+
+
+# -------------------------------------------------------------------- lint
+
+
+def _program_cost(lowered) -> Optional[Dict[str, float]]:
+    """Best-effort whole-program ``cost_analysis()`` (flops / bytes
+    accessed) from the compiled executable — the static twin of the
+    ROADMAP's measured gather-traffic crossover."""
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return {k: float(v) for k, v in ca.items()
+                if k in ("flops", "bytes accessed")}
+    except Exception:
+        return None
+
+
+def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
+         *, name: str = "", rules=None, disable: Sequence[str] = (),
+         with_cost: bool = False) -> List[Finding]:
+    """Trace ``fn(*args, **kwargs)`` and run the rule registry over the
+    resulting jaxpr.  Returns findings sorted most-severe-first.
+
+    ``args`` may be concrete arrays or ``ShapeDtypeStruct``s — nothing
+    executes.  ``disable`` removes rules by id for this run;
+    ``with_cost=True`` additionally compiles the program (CPU) and
+    attaches whole-program flops/bytes to cost-aware findings.
+    """
+    if rules is None:
+        from paddle_tpu.analysis.rules import active_rules
+        rules = active_rules()
+    kwargs = kwargs or {}
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+
+    lowered = None
+    if hasattr(fn, "lower"):
+        try:
+            lowered = fn.lower(*args, **kwargs)
+        except Exception:
+            lowered = None
+    cost = _program_cost(lowered) if (with_cost and lowered) else None
+
+    ctx = LintContext(disable=disable, cost=cost)
+    _walk(closed, rules, ctx, WalkState(path=name))
+
+    # function-level rules (donation-audit) see the lowering, not eqns
+    for rule in rules:
+        check = getattr(rule, "check_fn", None)
+        if check is not None and rule.rule_id not in ctx.disable:
+            check(fn, lowered, ctx, name or getattr(fn, "__name__", "fn"))
+    ctx.findings.sort(key=lambda f: (-severity_rank(f.severity),
+                                     f.rule_id, f.file or "", f.line or 0))
+    return ctx.findings
+
+
+def lint_target(target: LintTarget, **kw) -> List[Finding]:
+    return lint(target.fn, target.args, target.kwargs,
+                name=target.name, **kw)

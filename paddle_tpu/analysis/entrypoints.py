@@ -1,0 +1,139 @@
+"""Registered lint entrypoints: the jitted programs this repo ships.
+
+``python -m paddle_tpu.analysis --self-check`` runs the full rule
+registry over every entrypoint here — the trainer step, the dense and
+paged serve decode steps, the eval step, and the continuous-batching
+engine's decode step.  Each factory builds a TINY model (the lint is a
+property of the PROGRAM STRUCTURE, not the dimensions: a 1-layer
+16-dim transformer traces the same equation graph as the production
+config) and returns a :class:`~paddle_tpu.analysis.core.LintTarget`.
+Nothing executes — entrypoints are traced/lowered only, so the
+self-check runs in CI's lint tier on the CPU backend.
+
+Register project-specific entrypoints with::
+
+    from paddle_tpu.analysis import register_entrypoint, LintTarget
+
+    @register_entrypoint("my-step")
+    def _target():
+        return LintTarget("my-step", my_jitted_fn, (example_args,))
+
+and the CI gate covers them from then on.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.core import LintTarget
+
+__all__ = ["register_entrypoint", "ENTRYPOINTS", "self_check_targets"]
+
+ENTRYPOINTS: Dict[str, Callable[[], LintTarget]] = {}
+
+
+def register_entrypoint(name: str):
+    def deco(factory: Callable[[], LintTarget]):
+        assert name not in ENTRYPOINTS, f"duplicate entrypoint {name}"
+        ENTRYPOINTS[name] = factory
+        return factory
+    return deco
+
+
+def self_check_targets(names=None) -> List[LintTarget]:
+    keys = sorted(ENTRYPOINTS) if names is None else list(names)
+    return [ENTRYPOINTS[k]() for k in keys]
+
+
+# ------------------------------------------------------------ tiny fixtures
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_cfg():
+    from paddle_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                             num_layers=1, ffn_mult=2, max_len=16)
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_lm_params():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import TransformerLM
+    cfg = _tiny_cfg()
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return params
+
+
+@functools.lru_cache(maxsize=None)
+def _tiny_trainer():
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import lm_model_fn_builder
+    from paddle_tpu.training.trainer import Trainer
+    trainer = Trainer(lm_model_fn_builder(_tiny_cfg()), optim.sgd(0.01))
+    trainer.init({"ids": jnp.zeros((2, 8), jnp.int32)})
+    return trainer
+
+
+# -------------------------------------------------------------- entrypoints
+
+
+@register_entrypoint("trainer-train-step")
+def _trainer_train_step() -> LintTarget:
+    tr = _tiny_trainer()
+    steps = tr.jitted_steps()
+    batch = {"ids": jnp.zeros((2, 8), jnp.int32)}
+    return LintTarget(
+        "trainer-train-step", steps["train_step"],
+        (tr.params, tr.net_state, tr.opt_state, batch,
+         jnp.asarray(0, jnp.int32)))
+
+
+@register_entrypoint("trainer-eval-step")
+def _trainer_eval_step() -> LintTarget:
+    tr = _tiny_trainer()
+    steps = tr.jitted_steps()
+    batch = {"ids": jnp.zeros((2, 8), jnp.int32)}
+    return LintTarget("trainer-eval-step", steps["eval_step"],
+                      (tr.params, tr.net_state, batch))
+
+
+@register_entrypoint("dense-serve-step")
+def _dense_serve_step() -> LintTarget:
+    from paddle_tpu.models.transformer import lm_serve_builder
+    serve = lm_serve_builder(_tiny_cfg())
+    prompts = jnp.zeros((2, 4), jnp.int32)
+    return LintTarget(
+        "dense-serve-step", serve._jit,
+        (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
+         0.0, None, None, None, None, None))
+
+
+@register_entrypoint("paged-serve-step")
+def _paged_serve_step() -> LintTarget:
+    from paddle_tpu.serving import paged_serve_builder
+    serve = paged_serve_builder(_tiny_cfg(), block_size=8)
+    prompts = jnp.zeros((2, 4), jnp.int32)
+    return LintTarget(
+        "paged-serve-step", serve._jit,
+        (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
+         0.0, None, None, None, None, None))
+
+
+@register_entrypoint("paged-engine-decode")
+def _paged_engine_decode() -> LintTarget:
+    from paddle_tpu.serving import PagedServingEngine
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,))
+    S = eng.S
+    return LintTarget(
+        "paged-engine-decode", eng._decode,
+        (eng.params, eng.cache, jnp.zeros((S,), jnp.int32),
+         jnp.ones((S,), bool), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)))

@@ -1,0 +1,398 @@
+"""tpu-lint rule registry: the TPU-correctness traps this repo has hit.
+
+Every rule is a class with a unique ``rule_id``, a ``severity``
+(``error`` = correctness trap, CI-fatal; ``warn`` = perf/hygiene
+advisory), a one-line ``doc``, and any of three hooks:
+
+* ``check_eqn(eqn, state, ctx)`` — per equation, with walk state
+  (loop depth, carry taint);
+* ``check_jaxpr(jaxpr, state, ctx)`` — per (sub-)jaxpr, for rules that
+  need def-use context;
+* ``check_fn(fn, lowered, ctx, name)`` — per function, for rules that
+  read jit metadata (donation) rather than equations.
+
+Register with ``@register_rule``; ``active_rules()`` is what
+:func:`paddle_tpu.analysis.lint` runs by default.  The shipped rules
+are each grounded in a bug or hand-rolled guard from this repo's
+history — see docs/design/analysis.md for the catalog.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Type
+
+import jax
+import numpy as np
+from jax._src import core as jcore
+
+__all__ = ["register_rule", "active_rules", "RULES", "Rule"]
+
+_NARROW_FLOATS = ("bfloat16", "float16")
+
+
+class Rule:
+    rule_id: str = ""
+    severity: str = "warn"
+    doc: str = ""
+
+
+RULES: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.rule_id and cls.rule_id not in RULES, cls
+    RULES[cls.rule_id] = cls
+    return cls
+
+
+def active_rules() -> List[Rule]:
+    return [cls() for cls in RULES.values()]
+
+
+def _is_var(v) -> bool:
+    return isinstance(v, jcore.Var)
+
+
+def _dtype_name(aval) -> str:
+    try:
+        return np.dtype(aval.dtype).name
+    except TypeError:           # jax extended dtypes (PRNG keys, ...)
+        return str(aval.dtype)
+
+
+# ----------------------------------------------------------- accum-dtype
+
+
+@register_rule
+class AccumDtypeRule(Rule):
+    """Generalizes PR 1's attention fix: a ``dot_general``/``conv`` on
+    bf16/f16 operands whose result materializes in the narrow dtype
+    accumulates partial sums in bf16 — silent precision loss that grows
+    with the contraction size.  ``preferred_element_type=jnp.float32``
+    keeps the MXU accumulator f32 and downcasts once, in the epilogue.
+    """
+
+    rule_id = "accum-dtype"
+    severity = "error"
+    doc = ("dot/einsum/conv accumulating in bf16/f16 without "
+           "preferred_element_type=float32")
+
+    _PRIMS = ("dot_general", "conv_general_dilated")
+
+    def check_eqn(self, eqn, state, ctx):
+        if eqn.primitive.name not in self._PRIMS:
+            return
+        in_dtypes = [_dtype_name(v.aval) for v in eqn.invars[:2]]
+        out_dtype = _dtype_name(eqn.outvars[0].aval)
+        if (all(d in _NARROW_FLOATS for d in in_dtypes)
+                and out_dtype in _NARROW_FLOATS):
+            ctx.report(
+                self, f"{state.path}/{eqn.primitive.name}",
+                f"{eqn.primitive.name} on {in_dtypes[0]} operands "
+                f"accumulates in {out_dtype}",
+                eqn=eqn,
+                suggestion="pass preferred_element_type=jnp.float32 "
+                           "(cast the result back if the policy wants "
+                           "narrow outputs)")
+
+
+# ---------------------------------------------------- weak-type-promotion
+
+
+@register_rule
+class WeakTypePromotionRule(Rule):
+    """A Python/weak scalar operand silently rewriting an ARRAY's dtype:
+    ``bf16_array * np.float32(2)`` upcasts the whole array to f32 (2x
+    HBM on the hot path), ``int_array * 0.5`` floats an index tensor.
+    Detected as a widening/kind-changing ``convert_element_type``
+    inserted at the SAME source line as the binary op that consumes it
+    against a scalar — an explicit ``.astype`` on its own line stays
+    quiet."""
+
+    rule_id = "weak-type-promotion"
+    severity = "warn"
+    doc = "Python scalar operand silently widening an array dtype"
+
+    _BINOPS = ("add", "sub", "mul", "div", "max", "min", "pow", "rem",
+               "atan2")
+
+    def check_jaxpr(self, jaxpr, state, ctx):
+        from paddle_tpu.analysis.core import _user_frame
+        producers = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                producers[id(v)] = eqn
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name not in self._BINOPS:
+                continue
+            if len(eqn.invars) != 2:
+                continue
+
+            def _scalarish(v):
+                return (isinstance(v, jcore.Literal)
+                        or getattr(v.aval, "shape", None) == ())
+
+            for arr_side, other in (eqn.invars, eqn.invars[::-1]):
+                if not _scalarish(other) or not _is_var(arr_side):
+                    continue
+                prod = producers.get(id(arr_side))
+                if prod is None or prod.primitive.name != \
+                        "convert_element_type":
+                    continue
+                src = prod.invars[0].aval
+                dst = prod.outvars[0].aval
+                if int(np.prod(src.shape)) <= 1:
+                    continue
+                widened = (np.dtype(dst.dtype).itemsize
+                           > np.dtype(src.dtype).itemsize)
+                kind_change = (np.dtype(src.dtype).kind
+                               != np.dtype(dst.dtype).kind)
+                if not (widened or kind_change):
+                    continue
+                # implicit promotion materializes the convert at the
+                # binary op's own source line; explicit .astype lives
+                # on its own line and is intentional
+                if _user_frame(prod) != _user_frame(eqn):
+                    continue
+                ctx.report(
+                    self, f"{state.path}/{eqn.primitive.name}",
+                    f"array {tuple(src.shape)} silently promoted "
+                    f"{_dtype_name(src)} -> {_dtype_name(dst)} by a "
+                    f"scalar operand of {eqn.primitive.name}",
+                    eqn=eqn,
+                    suggestion="make the scalar's dtype explicit (e.g. "
+                               "jnp.asarray(c, x.dtype)) or upcast "
+                               "deliberately with .astype on its own "
+                               "line")
+                break
+
+
+# --------------------------------------------------- host-callback-in-loop
+
+
+@register_rule
+class HostCallbackInLoopRule(Rule):
+    """The serving decode loop must stay device-resident: a
+    ``pure_callback``/``io_callback``/``debug.print`` inside a
+    ``while``/``scan`` body forces a host round trip EVERY iteration —
+    milliseconds per token on a tunneled attachment, and it serializes
+    the loop."""
+
+    rule_id = "host-callback-in-loop"
+    severity = "error"
+    doc = "host callback (pure/io/debug) inside a while/scan body"
+
+    _PRIMS = ("pure_callback", "io_callback", "debug_callback",
+              "callback", "outside_call")
+
+    def check_eqn(self, eqn, state, ctx):
+        if state.loop_depth < 1 or eqn.primitive.name not in self._PRIMS:
+            return
+        ctx.report(
+            self, f"{state.path}/{eqn.primitive.name}",
+            f"{eqn.primitive.name} at loop depth {state.loop_depth} — "
+            "the loop body round-trips to the host every iteration",
+            eqn=eqn,
+            suggestion="move the callback outside the loop, or carry "
+                       "the value out and print after the loop exits")
+
+
+# ------------------------------------------------------- gather-in-decode
+
+
+@register_rule
+class GatherInDecodeRule(Rule):
+    """A gather / dynamic_slice whose indices derive from a LOOP CARRY
+    re-gathers every iteration — the paged-attention traffic pattern.
+    Loop-invariant indices stay quiet (XLA hoists them).  With
+    ``with_cost=True`` the finding carries the whole-program
+    ``cost_analysis()`` flops/bytes — the static twin of the
+    gather-vs-dense crossover measured by ``benchmark/lm_decode.py``.
+    """
+
+    rule_id = "gather-in-decode"
+    severity = "warn"
+    doc = "carry-dependent gather/dynamic_slice inside a decode loop"
+
+    def check_eqn(self, eqn, state, ctx):
+        if state.loop_depth < 1:
+            return
+        prim = eqn.primitive.name
+        if prim == "gather":
+            index_ops = eqn.invars[1:2]
+        elif prim == "dynamic_slice":
+            index_ops = eqn.invars[1:]
+        else:
+            return
+        if not any(_is_var(v) and state.is_tainted(v) for v in index_ops):
+            return
+        operand = eqn.invars[0].aval
+        ctx.report(
+            self, f"{state.path}/{prim}",
+            f"{prim} over {tuple(operand.shape)} "
+            f"{_dtype_name(operand)} with carry-dependent indices runs "
+            "every loop iteration",
+            eqn=eqn, attach_cost=True,
+            suggestion="expected for paged-KV decode (the crossover is "
+                       "a measured trade — see ROADMAP); otherwise "
+                       "hoist the indices or fuse the gather into a "
+                       "kernel")
+
+
+# ------------------------------------------------------------- dead-code
+
+
+@register_rule
+class DeadCodeRule(Rule):
+    """Computed-but-unreturned equations (traced work XLA may or may
+    not DCE — and the trace says intent is muddled either way) and
+    threaded-but-unread loop carries (a carry passed through
+    ``while``/``scan`` unchanged and never read costs carry bandwidth
+    every iteration and hides a stale value)."""
+
+    rule_id = "dead-code"
+    severity = "warn"
+    doc = "dead outputs / threaded-but-unread loop carries"
+
+    def check_jaxpr(self, jaxpr, state, ctx):
+        used = set()
+        for eqn in jaxpr.eqns:
+            used.update(id(v) for v in eqn.invars if _is_var(v))
+        used.update(id(v) for v in jaxpr.outvars if _is_var(v))
+        for eqn in jaxpr.eqns:
+            if eqn.effects:
+                continue
+            if any(id(v) in used for v in eqn.outvars):
+                continue
+            ctx.report(
+                self, f"{state.path}/{eqn.primitive.name}",
+                f"result of {eqn.primitive.name} "
+                f"({', '.join(_dtype_name(v.aval) + str(tuple(v.aval.shape)) for v in eqn.outvars[:1])}) "
+                "is never used",
+                eqn=eqn,
+                suggestion="delete the computation or return it")
+
+    def check_eqn(self, eqn, state, ctx):
+        prim = eqn.primitive.name
+        if prim == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+            cond = eqn.params["cond_jaxpr"].jaxpr
+            bn = eqn.params["body_nconsts"]
+            cn = eqn.params["cond_nconsts"]
+            carries = body.invars[bn:]
+            outs = body.outvars
+            cond_carries = cond.invars[cn:]
+            read = set()
+            for e in list(body.eqns) + list(cond.eqns):
+                read.update(id(v) for v in e.invars if _is_var(v))
+            for i, cv in enumerate(carries):
+                cond_cv = (cond_carries[i]
+                           if i < len(cond_carries) else None)
+                if id(cv) in read or (cond_cv is not None
+                                      and id(cond_cv) in read):
+                    continue
+                if i < len(outs) and outs[i] is cv:
+                    self._report_carry(ctx, state, eqn, i, cv, "while")
+        elif prim == "scan":
+            inner = eqn.params["jaxpr"].jaxpr
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            carries = inner.invars[nc:nc + ncar]
+            outs = inner.outvars[:ncar]
+            read = set()
+            for e in inner.eqns:
+                read.update(id(v) for v in e.invars if _is_var(v))
+            for i, cv in enumerate(carries):
+                if id(cv) in read:
+                    continue
+                if i < len(outs) and outs[i] is cv:
+                    self._report_carry(ctx, state, eqn, i, cv, "scan")
+
+    def _report_carry(self, ctx, state, eqn, i, cv, kind):
+        ctx.report(
+            self, f"{state.path}/{kind}",
+            f"loop carry #{i} ({_dtype_name(cv.aval)}"
+            f"{tuple(cv.aval.shape)}) is threaded through the {kind} "
+            "but never read",
+            eqn=eqn,
+            suggestion="drop it from the carry (close over it instead) "
+                       "— it costs carry bandwidth every iteration")
+
+
+# --------------------------------------------------------- donation-audit
+
+
+@register_rule
+class DonationAuditRule(Rule):
+    """A jitted step that RETURNS an updated version of a large buffer
+    argument without donating it makes XLA keep both copies live — the
+    trainer donates params/opt_state for exactly this reason, and the
+    paged decode step's KV pool is the same shape of buffer.  Flags
+    non-donated args at least ``min_bytes`` whose (shape, dtype)
+    matches an output."""
+
+    rule_id = "donation-audit"
+    severity = "warn"
+    doc = "large buffer arg returned updated but not donated"
+
+    def __init__(self, min_bytes: int = 1 << 16):
+        self.min_bytes = min_bytes
+
+    def check_fn(self, fn, lowered, ctx, name):
+        if lowered is None:
+            return
+        try:
+            args_info = lowered.args_info
+            out_info = lowered.out_info
+        except Exception:
+            return
+        out_leaves = jax.tree_util.tree_leaves(
+            out_info, is_leaf=lambda x: hasattr(x, "shape"))
+        # multiset of output signatures: each donated arg ABSORBS one
+        # matching output (that pair is already in-place), and each
+        # finding consumes one — so N same-shaped args against one
+        # updated output yield one finding, not N
+        out_sigs: Dict = {}
+        for o in out_leaves:
+            sig = (tuple(o.shape), _dtype_name(o))
+            out_sigs[sig] = out_sigs.get(sig, 0) + 1
+        file = line = None
+        try:
+            src = inspect.unwrap(fn)
+            code = getattr(src, "__wrapped__", src).__code__
+            file, line = code.co_filename, code.co_firstlineno
+        except Exception:
+            pass
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            args_info, is_leaf=lambda x: hasattr(x, "donated"))
+
+        def _sig(info):
+            aval = getattr(info, "aval", info)
+            return tuple(aval.shape), _dtype_name(aval)
+
+        for _, info in flat:
+            if info.donated and out_sigs.get(_sig(info), 0) > 0:
+                out_sigs[_sig(info)] -= 1
+        for path, info in flat:
+            if info.donated:
+                continue
+            shape, dtype_name = _sig(info)
+            try:
+                itemsize = np.dtype(dtype_name).itemsize
+            except TypeError:   # extended dtypes are never donation
+                continue        # targets worth flagging
+            nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize
+            if nbytes < self.min_bytes:
+                continue
+            if out_sigs.get((shape, dtype_name), 0) <= 0:
+                continue
+            out_sigs[(shape, dtype_name)] -= 1
+            ctx.report(
+                self, name or "fn",
+                f"arg {jax.tree_util.keystr(path)} ({dtype_name}"
+                f"{shape}, {nbytes / 2**20:.1f} MiB) is returned "
+                "updated but not donated — two live copies on device",
+                file=file, line=line,
+                suggestion="pass donate_argnums for it to jax.jit (the "
+                           "old buffer is dead after the step)")

@@ -1,0 +1,91 @@
+"""CompileWatcher: the runtime companion to the static linter.
+
+``tpu-lint`` proves properties of the traced program; the one serving
+contract it cannot see statically is RETRACING — a decode step that
+recompiles per request length (the bug class ``lm_serve_builder``'s
+traced-``steps`` design exists to prevent).  ``serving.py`` counted
+compiles ad hoc via each jitted function's ``_cache_size()``;
+:class:`CompileWatcher` is that pattern as a reusable utility any test
+or engine can hold::
+
+    watch = CompileWatcher(decode=engine._decode)
+    ... drive traffic ...
+    assert watch.counts() == {"decode": 1}
+
+or as a context manager that snapshots a baseline on entry (for
+asserting a REGION adds no compiles over already-warm functions)::
+
+    with CompileWatcher(serve=serve_fn) as w:
+        serve_fn(...); serve_fn(...)
+    w.assert_counts(serve=0)          # warm path must not retrace
+
+Counts come from ``jit``'s own compile-cache size — exact, backend-
+independent, zero overhead on the measured path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__ = ["CompileWatcher"]
+
+
+def _cache_size(fn) -> int:
+    size = getattr(fn, "_cache_size", None)
+    if size is None:
+        raise TypeError(
+            f"CompileWatcher needs a jax.jit-wrapped callable (or any "
+            f"object exposing _cache_size()), got {type(fn).__name__}")
+    return int(size())
+
+
+class CompileWatcher:
+    """Tracks XLA compile counts of named jitted callables.
+
+    The baseline snapshots at construction (so a watcher created next
+    to ``jax.jit`` counts every compile the function ever does) and
+    re-snapshots on ``__enter__`` (so a ``with`` block counts only the
+    compiles the block adds).
+    """
+
+    def __init__(self, **fns: Callable):
+        self._fns: Dict[str, Callable] = {}
+        self._base: Dict[str, int] = {}
+        for name, fn in fns.items():
+            self.watch(name, fn)
+
+    def watch(self, name: str, fn: Callable) -> "CompileWatcher":
+        """Register another function; its baseline is its current
+        cache size (a warm function starts at count 0)."""
+        _cache_size(fn)             # fail loudly on non-jitted callables
+        self._fns[name] = fn
+        self._base[name] = _cache_size(fn)
+        return self
+
+    def __enter__(self) -> "CompileWatcher":
+        for name, fn in self._fns.items():
+            self._base[name] = _cache_size(fn)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def counts(self) -> Dict[str, int]:
+        """Compiles since baseline, per watched function."""
+        return {name: _cache_size(fn) - self._base[name]
+                for name, fn in self._fns.items()}
+
+    def total(self) -> int:
+        return sum(self.counts().values())
+
+    def assert_counts(self, **expected: int) -> None:
+        """Assert exact per-name compile counts; unlisted names are
+        unchecked.  The failure message carries every count — the
+        ``compiles == 1`` serving pin as one call."""
+        actual = self.counts()
+        bad = {k: (expected[k], actual.get(k))
+               for k in expected if actual.get(k) != expected[k]}
+        assert not bad, (
+            f"compile counts diverged (expected != actual): {bad}; "
+            f"all counts: {actual} — a retrace on the hot path means a "
+            "trace key (shape/dtype/static arg) varies per call")

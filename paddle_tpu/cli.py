@@ -326,6 +326,10 @@ def main(argv=None):
     import paddle_tpu
 
     paddle_tpu._honor_env_platform(force=True)
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        from paddle_tpu.analysis.cli import main as lint_main
+        raise SystemExit(lint_main(argv[1:]))
     parser = argparse.ArgumentParser(prog="paddle_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -401,6 +405,14 @@ def main(argv=None):
                    help="snapshot after this many task acks (1 = per ack, "
                         "the reference's per-state-change etcd cadence)")
     p.set_defaults(fn=cmd_master)
+
+    # tpu-lint owns its own argparse surface — forward everything after
+    # the subcommand verbatim (argparse.REMAINDER can't: it refuses to
+    # start on an optional, so `lint --self-check` would bounce).
+    sub.add_parser(
+        "lint",
+        help="tpu-lint static analyzer (python -m paddle_tpu.analysis "
+             "twin); all arguments pass through, e.g. `lint --self-check`")
 
     p = sub.add_parser("merge_model", help="export checkpoint for serving")
     common(p)

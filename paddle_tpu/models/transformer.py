@@ -563,6 +563,7 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
                       prompt_lens)
 
     serve._cache_size = _serve._cache_size   # the no-retrace proof hook
+    serve._jit = _serve   # the lintable program (analysis/entrypoints.py)
     return serve
 
 

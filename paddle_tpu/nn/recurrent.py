@@ -42,11 +42,15 @@ def gru_cell(gates_x, h_prev, w_hz, w_hc, act, gate_act, policy):
     ``gates_x`` is the 3h input projection [batch, 3h] (z, r, candidate),
     ``w_hz``/``w_hc`` already in compute dtype."""
     h = h_prev.shape[-1]
+    # MXU accumulates the bf16 recurrence matmuls in f32 (tpu-lint:
+    # accum-dtype); cast_to_output then narrows once, after the sum.
     zr = gates_x[:, :2 * h] + policy.cast_to_output(
-        policy.cast_to_compute(h_prev) @ w_hz)
+        jnp.matmul(policy.cast_to_compute(h_prev), w_hz,
+                   preferred_element_type=jnp.float32))
     z, r = jnp.split(gate_act(zr), 2, axis=-1)
     cand = gates_x[:, 2 * h:] + policy.cast_to_output(
-        policy.cast_to_compute(r * h_prev) @ w_hc)
+        jnp.matmul(policy.cast_to_compute(r * h_prev), w_hc,
+                   preferred_element_type=jnp.float32))
     cand = act(cand)
     return (1.0 - z) * h_prev + z * cand
 
@@ -90,7 +94,8 @@ class LSTM(Module):
 
         # One big MXU matmul for all timesteps; only the h-recurrence scans.
         xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
-                        policy.cast_to_compute(w_x))
+                        policy.cast_to_compute(w_x),
+                        preferred_element_type=jnp.float32)
         xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
 
         if initial_state is None:
@@ -128,7 +133,8 @@ class LSTM(Module):
                 h_prev, c_prev = carry
                 gates_x, m = inp
                 gates = gates_x + policy.cast_to_output(
-                    policy.cast_to_compute(h_prev) @ w_h_c)
+                    jnp.matmul(policy.cast_to_compute(h_prev), w_h_c,
+                               preferred_element_type=jnp.float32))
                 i, f, g, o = jnp.split(gates, 4, axis=-1)
                 i = self.gate_act(i)
                 f = self.gate_act(f)
@@ -179,7 +185,8 @@ class GRU(Module):
         bias = param("b", (3 * h,), policy.param_dtype, init.zeros)
 
         xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
-                        policy.cast_to_compute(w_x))
+                        policy.cast_to_compute(w_x),
+                        preferred_element_type=jnp.float32)
         xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
 
         h0 = jnp.zeros((b, h), x.dtype) if initial_state is None else initial_state
@@ -244,7 +251,8 @@ class SimpleRNN(Module):
             w_x = param("w_x", (d, h), policy.param_dtype,
                         init.paddle_default())
             xw = jnp.einsum("btd,dk->btk", policy.cast_to_compute(x),
-                            policy.cast_to_compute(w_x))
+                            policy.cast_to_compute(w_x),
+                            preferred_element_type=jnp.float32)
             xw = policy.cast_to_output(xw) + bias.astype(policy.output_dtype)
         else:
             enforce(d == h, "SimpleRNN(project_input=False): input width "
@@ -263,7 +271,8 @@ class SimpleRNN(Module):
         def step(h_prev, inp):
             gx, m = inp
             hh = self.act(gx + policy.cast_to_output(
-                policy.cast_to_compute(h_prev) @ w_h_c))
+                jnp.matmul(policy.cast_to_compute(h_prev), w_h_c,
+                           preferred_element_type=jnp.float32)))
             hh = _mask_state(hh, h_prev, m)
             return hh, hh
 

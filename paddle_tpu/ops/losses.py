@@ -162,7 +162,8 @@ def nce_loss(embeddings, weights, bias, labels, noise_ids,
     s_pos = jnp.sum(embeddings * w_pos, axis=-1) + b_pos
     w_neg = weights[noise_ids]                      # [b, k, d]
     b_neg = bias[noise_ids]
-    s_neg = jnp.einsum("bd,bkd->bk", embeddings, w_neg) + b_neg
+    s_neg = jnp.einsum("bd,bkd->bk", embeddings, w_neg,
+                       preferred_element_type=jnp.float32) + b_neg
     # -log sigma(x) = log(1 + exp(-x));  -log(1 - sigma(x)) = log(1 + exp(x))
     pos = jnp.log1p(jnp.exp(-(s_pos - label_logq)))
     neg = jnp.log1p(jnp.exp(s_neg - noise_logq))
@@ -178,7 +179,8 @@ def hierarchical_sigmoid(x, weights, bias, codes, code_signs, code_mask):
     code_signs: [b, depth] +1/-1 branch direction; code_mask: [b, depth].
     """
     w = weights[codes]                              # [b, depth, d]
-    s = jnp.einsum("bd,btd->bt", x, w) + bias[codes]
+    s = jnp.einsum("bd,btd->bt", x, w,
+                   preferred_element_type=jnp.float32) + bias[codes]
     z = s * code_signs
     per = jnp.log1p(jnp.exp(-z))
     return jnp.where(code_mask, per, 0.0).sum(axis=-1)

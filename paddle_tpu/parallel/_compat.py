@@ -1,0 +1,22 @@
+"""shard_map across jax versions.
+
+jax>=0.5 exports :func:`jax.shard_map` (replication checking spelled
+``check_vma``); jax<0.5 ships it only as
+``jax.experimental.shard_map.shard_map`` (spelled ``check_rep``).  The
+callers here use the modern spelling; this adapter renames the kwarg
+when falling back so the sharding programs stay version-portable.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+__all__ = ["shard_map"]

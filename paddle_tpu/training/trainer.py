@@ -183,6 +183,19 @@ class Trainer:
         self._eval_step = jax.jit(eval_step)
         self._grads_step = jax.jit(grads_step)
 
+    def jitted_steps(self):
+        """The trainer's compiled programs, by name — the lint surface
+        (``analysis/entrypoints.py`` traces these for the tpu-lint
+        self-check) and the :class:`~paddle_tpu.analysis.CompileWatcher`
+        handle for retrace pins.  Call after :meth:`init`."""
+        enforce(self._train_step is not None,
+                "jitted_steps: call init() first — the steps are built "
+                "against the model's concrete shapes")
+        return {"train_step": self._train_step,
+                "train_scan": self._train_scan,
+                "eval_step": self._eval_step,
+                "grads_step": self._grads_step}
+
     # ---- training ----
 
     def gradients(self, batch: Dict[str, Any]):

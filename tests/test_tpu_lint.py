@@ -1,0 +1,380 @@
+"""tpu-lint: per-rule golden findings, suppressions, CompileWatcher,
+and the CI self-check contract (``paddle_tpu/analysis/``).
+
+Each rule gets the same treatment the reference gave twin kernels: a
+bad snippet it MUST flag and a fixed snippet it MUST stay quiet on —
+the linter's false-positive discipline is as load-bearing as its
+recall, since ci.sh fails on error-severity findings.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis import (CompileWatcher, LintTarget, RULES, lint,
+                                 lint_target, self_check_targets)
+from paddle_tpu.analysis.cli import main as lint_main
+
+BF = jnp.bfloat16
+
+
+def _by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+# ------------------------------------------------------------ accum-dtype
+
+
+def test_accum_dtype_fires_on_bf16_dot():
+    a = jnp.zeros((8, 8), BF)
+    fs = _by_rule(lint(lambda x, y: jnp.dot(x, y), (a, a)), "accum-dtype")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "bfloat16" in fs[0].message
+
+
+def test_accum_dtype_quiet_with_preferred_f32():
+    a = jnp.zeros((8, 8), BF)
+
+    def fixed(x, y):
+        return jnp.dot(x, y, preferred_element_type=jnp.float32)
+
+    assert not _by_rule(lint(fixed, (a, a)), "accum-dtype")
+
+
+def test_accum_dtype_quiet_on_f32():
+    a = jnp.zeros((8, 8), jnp.float32)
+    assert not _by_rule(lint(lambda x, y: x @ y, (a, a)), "accum-dtype")
+
+
+# ---------------------------------------------------- weak-type-promotion
+
+
+def test_weak_type_fires_on_strong_scalar():
+    x = jnp.zeros((4, 4), BF)
+    fs = _by_rule(lint(lambda v: v * np.float32(2.5), (x,)),
+                  "weak-type-promotion")
+    assert len(fs) == 1
+    assert "bfloat16 -> float32" in fs[0].message
+
+
+def test_weak_type_quiet_on_python_float_and_explicit_astype():
+    x = jnp.zeros((4, 4), BF)
+    # Python floats are weak — no promotion, no finding
+    assert not _by_rule(lint(lambda v: v * 2.5, (x,)),
+                        "weak-type-promotion")
+
+    def explicit(v):
+        v = v.astype(jnp.float32)
+        return v * np.float32(2.5)
+
+    # the upcast is deliberate (its own line) — stays quiet
+    assert not _by_rule(lint(explicit, (x,)), "weak-type-promotion")
+
+
+# --------------------------------------------------- host-callback-in-loop
+
+
+def test_host_callback_fires_inside_scan():
+    def bad(x):
+        def step(c, _):
+            jax.debug.print("c={c}", c=c)
+            return c + 1.0, c
+        return lax.scan(step, x, None, length=3)
+
+    fs = _by_rule(lint(bad, (jnp.float32(0.0),)), "host-callback-in-loop")
+    assert fs and fs[0].severity == "error"
+    assert "while" in fs[0].path or "scan" in fs[0].path
+
+
+def test_host_callback_quiet_outside_loop():
+    def ok(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1.0
+
+    assert not _by_rule(lint(ok, (jnp.float32(0.0),)),
+                        "host-callback-in-loop")
+
+
+# ------------------------------------------------------- gather-in-decode
+
+
+def test_gather_fires_on_carry_dependent_slice():
+    table = jnp.arange(32.0)
+
+    def bad(i0):
+        def step(i, _):
+            v = lax.dynamic_slice(table, (i,), (1,))[0]
+            return (i + 1) % 8, v
+        return lax.scan(step, i0, None, length=4)
+
+    fs = _by_rule(lint(bad, (jnp.int32(0),)), "gather-in-decode")
+    assert fs and fs[0].severity == "warn"
+
+
+def test_gather_quiet_on_loop_invariant_indices():
+    table = jnp.arange(32.0)
+
+    def ok(i0, acc):
+        def step(c, _):
+            v = lax.dynamic_slice(table, (i0,), (1,))[0]  # hoistable
+            return c + v, None
+        return lax.scan(step, acc, None, length=4)
+
+    assert not _by_rule(lint(ok, (jnp.int32(3), jnp.float32(0.0))),
+                        "gather-in-decode")
+
+
+# ------------------------------------------------------------- dead-code
+
+
+def test_dead_code_fires_on_unused_result():
+    def bad(x):
+        _ = x * 3.0          # traced, never used
+        return x + 1.0
+
+    fs = _by_rule(lint(bad, (jnp.zeros((4,)),)), "dead-code")
+    assert any("never used" in f.message for f in fs)
+
+
+def test_dead_code_fires_on_unread_while_carry():
+    def bad(x, flag):
+        def cond(c):
+            return c[0] < 3
+
+        def body(c):
+            i, acc, fl = c
+            return i + 1, acc + 1.0, fl   # fl threaded, never read
+
+        return lax.while_loop(cond, body, (jnp.int32(0), x, flag))
+
+    fs = _by_rule(lint(bad, (jnp.float32(0.0), jnp.zeros((4,), bool))),
+                  "dead-code")
+    assert any("never read" in f.message for f in fs)
+
+
+def test_dead_code_quiet_when_everything_used():
+    def ok(x):
+        y = x * 3.0
+        return x + y
+
+    assert not _by_rule(lint(ok, (jnp.zeros((4,)),)), "dead-code")
+
+
+# --------------------------------------------------------- donation-audit
+
+
+def test_donation_audit_fires_then_absorbed_by_donation():
+    big = jnp.zeros((128, 256), jnp.float32)       # 128 KiB
+
+    def step(buf, x):
+        return buf + x, jnp.sum(buf)
+
+    fs = _by_rule(lint(jax.jit(step), (big, jnp.float32(1.0))),
+                  "donation-audit")
+    assert len(fs) == 1 and "not donated" in fs[0].message
+
+    donated = jax.jit(step, donate_argnums=(0,))
+    assert not _by_rule(lint(donated, (big, jnp.float32(1.0))),
+                        "donation-audit")
+
+
+def test_donation_audit_ignores_small_buffers():
+    small = jnp.zeros((4, 4), jnp.float32)
+    fs = _by_rule(lint(jax.jit(lambda b: b + 1.0), (small,)),
+                  "donation-audit")
+    assert not fs
+
+
+# ----------------------------------------------------------- suppressions
+
+
+_SUPPRESSION_MOD = '''\
+import jax.numpy as jnp
+
+
+def bad(a, b):
+    return jnp.dot(a, b)
+
+
+def quiet(a, b):
+    # tpu-lint: disable=accum-dtype
+    return jnp.dot(a, b)
+'''
+
+
+@pytest.fixture
+def suppression_mod(tmp_path, monkeypatch):
+    (tmp_path / "lintme.py").write_text(_SUPPRESSION_MOD)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    import importlib
+    mod = importlib.import_module("lintme")
+    yield mod
+    import sys
+    del sys.modules["lintme"]
+
+
+def test_suppression_comment_honored(suppression_mod):
+    a = jnp.zeros((8, 8), BF)
+    assert _by_rule(lint(suppression_mod.bad, (a, a)), "accum-dtype")
+    assert not _by_rule(lint(suppression_mod.quiet, (a, a)),
+                        "accum-dtype")
+
+
+def test_disable_kwarg_skips_rule():
+    a = jnp.zeros((8, 8), BF)
+    fs = lint(lambda x, y: jnp.dot(x, y), (a, a),
+              disable=("accum-dtype",))
+    assert not _by_rule(fs, "accum-dtype")
+
+
+def test_linear_mixed_bf16_suppression_in_tree():
+    """The one shipped suppression: Linear's deliberate bf16-boundary
+    matmul under MIXED_BF16 must not trip the CI-fatal accum rule."""
+    from paddle_tpu.core import dtypes
+    prev = dtypes.get_policy()
+    dtypes.set_policy(dtypes.MIXED_BF16)
+    try:
+        model = nn.transform(lambda x: nn.Linear(8, name="fc")(x))
+        x = jnp.zeros((4, 16), BF)
+        params, state = model.init(jax.random.key(0), x)
+
+        def fwd(p, v):
+            out, _ = model.apply(p, state, None, v)
+            return out
+
+        assert not _by_rule(lint(fwd, (params, x)), "accum-dtype")
+    finally:
+        dtypes.set_policy(prev)
+
+
+# -------------------------------------------------------- cost attachment
+
+
+def test_cost_attaches_to_gather_findings():
+    table = jnp.arange(64.0)
+
+    def bad(i0):
+        def step(i, _):
+            v = lax.dynamic_slice(table, (i,), (1,))[0]
+            return (i + 1) % 8, v
+        return lax.scan(step, i0, None, length=4)
+
+    fs = _by_rule(lint(jax.jit(bad), (jnp.int32(0),), with_cost=True),
+                  "gather-in-decode")
+    assert fs and fs[0].cost and "flops" in fs[0].cost
+
+
+# -------------------------------------------------------- CompileWatcher
+
+
+def test_compile_watcher_counts_and_asserts():
+    f = jax.jit(lambda x: x + 1.0)
+    w = CompileWatcher(f=f)
+    assert w.counts() == {"f": 0}
+    f(jnp.zeros((2,)))
+    f(jnp.zeros((3,)))          # new shape -> second compile
+    assert w.counts() == {"f": 2} and w.total() == 2
+    with pytest.raises(AssertionError, match="compile counts diverged"):
+        w.assert_counts(f=1)
+    w.assert_counts(f=2)
+
+
+def test_compile_watcher_context_rebaselines():
+    f = jax.jit(lambda x: x * 2.0)
+    f(jnp.zeros((2,)))
+    w = CompileWatcher(warm=f)
+    with w:
+        f(jnp.zeros((2,)))      # warm shape — no new compile
+    w.assert_counts(warm=0)
+
+
+def test_compile_watcher_rejects_plain_callables():
+    with pytest.raises(TypeError, match="_cache_size"):
+        CompileWatcher(f=lambda x: x)
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULES:
+        assert rule_id in out
+
+
+def test_cli_no_targets_is_usage_error():
+    assert lint_main([]) == 2
+
+
+def _bad_dot_target():
+    a = jax.ShapeDtypeStruct((8, 8), BF)
+    return LintTarget("bad-dot", lambda x, y: jnp.dot(x, y), (a, a))
+
+
+@pytest.fixture
+def cli_target_mod(tmp_path, monkeypatch):
+    (tmp_path / "clitarget.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n"
+        "from paddle_tpu.analysis import LintTarget\n\n\n"
+        "def bad_dot():\n"
+        "    a = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)\n"
+        "    return LintTarget('bad-dot', lambda x, y: jnp.dot(x, y),\n"
+        "                      (a, a))\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield "clitarget:bad_dot"
+    import sys
+    sys.modules.pop("clitarget", None)
+
+
+def test_cli_target_factory_gates_on_error(cli_target_mod, capsys):
+    assert lint_main([cli_target_mod]) == 1
+    assert "accum-dtype" in capsys.readouterr().out
+
+
+def test_cli_json_output(cli_target_mod, capsys):
+    assert lint_main([cli_target_mod, "--json"]) == 1
+    findings = json.loads(capsys.readouterr().out)
+    assert any(f["rule_id"] == "accum-dtype" for f in findings)
+
+
+def test_cli_shapes_spec(capsys):
+    rc = lint_main(["jax.numpy:dot", "--shapes", "bf16[8;8],bf16[8;8]"])
+    assert rc == 1
+    assert "accum-dtype" in capsys.readouterr().out
+
+
+def test_cli_disable_flag(cli_target_mod):
+    assert lint_main([cli_target_mod, "--disable", "accum-dtype"]) == 0
+
+
+# ----------------------------------------------------------- self-check
+
+
+def test_rule_registry_is_complete():
+    assert len(RULES) >= 6
+    assert {"accum-dtype", "weak-type-promotion", "host-callback-in-loop",
+            "gather-in-decode", "dead-code",
+            "donation-audit"} <= set(RULES)
+
+
+def test_self_check_entrypoints_lint_clean_at_error():
+    """The CI gate's contract: every registered entrypoint — trainer
+    train/eval steps, dense and paged serve steps, the engine decode
+    step — carries zero error-severity findings.  Warn-level findings
+    (decode gathers etc.) are the review queue, not the gate."""
+    targets = self_check_targets()
+    assert len(targets) >= 4
+    names = {t.name for t in targets}
+    assert {"trainer-train-step", "dense-serve-step",
+            "paged-serve-step"} <= names
+    for target in targets:
+        errors = [f for f in lint_target(target)
+                  if f.severity == "error"]
+        assert not errors, (
+            f"{target.name}: {[(f.rule_id, f.location()) for f in errors]}")
