@@ -36,11 +36,17 @@ import paddle_tpu.framework
 print("import surface OK on", jax.default_backend())
 EOF
 
-echo "== tpu-lint: jaxpr self-check over registered entrypoints =="
+echo "== tpu-lint: jaxpr + SPMD self-check over registered entrypoints =="
 # Traces the trainer/serve/eval programs on CPU and fails on any
-# error-severity finding (accum-dtype, host-callback-in-loop, ...).
-# Warn-severity findings (gather-in-decode etc.) print but don't gate.
-JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check
+# error-severity finding (accum-dtype, host-callback-in-loop, and the
+# shard family: entrypoints with a ShardRecipe lower under a 2-device
+# CPU mesh and their compiled HLO is checked for collective-in-decode,
+# mesh-axis-mismatch, ...).  Three gates in one invocation:
+#   --budgets      per-shard peak-HBM estimate vs analysis/budgets.json
+#   --warn-ratchet post-suppression warn count can only go DOWN
+JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
+    --budgets paddle_tpu/analysis/budgets.json \
+    --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
 echo "== native libs =="
 make -C csrc -q 2>/dev/null || make -C csrc

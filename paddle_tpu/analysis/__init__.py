@@ -18,7 +18,16 @@ Pieces:
   assertions for the retrace contract statics cannot see
   (``watch.py``);
 * the entrypoint registry (``entrypoints.py``) — what ``--self-check``
-  covers; register yours with :func:`register_entrypoint`.
+  covers; register yours with :func:`register_entrypoint`;
+* the SPMD rule family (``shard_rules.py``): entrypoints carrying a
+  :class:`ShardRecipe` are lowered under a real multi-device CPU mesh
+  and their compiled HLO checked for collective-in-decode,
+  mesh-axis-mismatch, replicated-large-param, reshard-churn;
+* the static HBM estimator (``memory.py``): per-shard peak live bytes
+  from a liveness scan, gated against ``analysis/budgets.json`` by
+  ``--memory --budgets``;
+* :func:`nan_check` (``nans.py``): checkify-backed value-level NaN
+  localization behind ``lint --nans``.
 
 Suppress a finding at source with ``# tpu-lint: disable=<rule-id>``.
 Catalog and severity policy: ``docs/design/analysis.md``.
@@ -33,10 +42,21 @@ from paddle_tpu.analysis.watch import CompileWatcher
 from paddle_tpu.analysis.entrypoints import (ENTRYPOINTS,
                                              register_entrypoint,
                                              self_check_targets)
+from paddle_tpu.analysis.shard_rules import (SHARD_RULES, ShardRecipe,
+                                             ShardRule,
+                                             active_shard_rules,
+                                             register_shard_rule,
+                                             shard_check)
+from paddle_tpu.analysis.memory import (MemoryReport, check_budgets,
+                                        estimate_target, load_budgets)
+from paddle_tpu.analysis.nans import nan_check
 
 __all__ = [
     "Finding", "LintTarget", "lint", "lint_target", "SEVERITIES",
     "severity_rank", "RULES", "Rule", "active_rules", "register_rule",
     "CompileWatcher", "ENTRYPOINTS", "register_entrypoint",
-    "self_check_targets",
+    "self_check_targets", "SHARD_RULES", "ShardRecipe", "ShardRule",
+    "active_shard_rules", "register_shard_rule", "shard_check",
+    "MemoryReport", "check_budgets", "estimate_target", "load_budgets",
+    "nan_check",
 ]

@@ -18,6 +18,21 @@ A target is ``module:attr`` where ``attr`` is either
 Findings render as a table (or ``--json``); the exit status is the
 gate: 0 = clean at the ``--fail-on`` severity (default ``error``),
 1 = findings at/above it, 2 = usage error.
+
+Beyond the jaxpr walk, targets whose entrypoint ships a
+:class:`~paddle_tpu.analysis.shard_rules.ShardRecipe` are also lowered
+under a real multi-device CPU mesh and checked by the SPMD rule family
+(collective-in-decode, mesh-axis-mismatch, ...).  Three more modes:
+
+* ``--memory`` prints the per-shard HBM footprint estimate of every
+  target; with ``--budgets analysis/budgets.json`` any entrypoint over
+  (or missing from) its checked-in budget is an error finding.
+* ``--warn-ratchet analysis/warn_baseline.json`` fails when the
+  post-suppression warn count exceeds the checked-in baseline — warns
+  can only go DOWN; ``--write-warn-baseline`` records a new floor.
+* ``--nans`` RUNS each target (tiny shapes, CPU) under checkify float
+  checks and reports the first non-finite-producing op with its source
+  line.  A debug helper, not a tracing-only gate.
 """
 
 from __future__ import annotations
@@ -151,19 +166,47 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="machine-readable findings on stdout")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--memory", action="store_true",
+                        help="report the static per-shard HBM footprint "
+                             "estimate of every target")
+    parser.add_argument("--budgets", default=None, metavar="PATH",
+                        help="budgets.json to gate --memory against: any "
+                             "target over (or missing) its peak_bytes "
+                             "budget is an error finding")
+    parser.add_argument("--warn-ratchet", default=None, metavar="PATH",
+                        help="fail when the post-suppression warn count "
+                             "exceeds the baseline file's warn_count")
+    parser.add_argument("--write-warn-baseline", default=None,
+                        metavar="PATH",
+                        help="record the current warn count as the new "
+                             "ratchet baseline and exit")
+    parser.add_argument("--nans", action="store_true",
+                        help="RUN each target under checkify float "
+                             "checks and localize the first non-finite "
+                             "op (debug helper; executes the program)")
     args = parser.parse_args(argv)
 
     # the analyzer must NEVER touch (or hang on) an attached chip: all
-    # tracing runs on the CPU backend, same discipline as ci.sh lint
+    # tracing runs on the CPU backend, same discipline as ci.sh lint.
+    # Shard recipes need >=2 devices, so provision the same 8-virtual-
+    # device CPU platform tests/conftest.py uses — BEFORE backend init.
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import paddle_tpu
     paddle_tpu._honor_env_platform(force=True)
 
     from paddle_tpu.analysis.rules import active_rules
     if args.list_rules:
+        from paddle_tpu.analysis.shard_rules import active_shard_rules
         for rule in active_rules():
             print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+        for rule in active_shard_rules():
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.rule_id:<22} {rule.severity:<6} {doc}")
         return 0
 
     from paddle_tpu.analysis.core import lint_target
@@ -180,10 +223,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     disable = tuple(filter(None, args.disable.split(",")))
+
+    if args.nans:
+        from paddle_tpu.analysis.nans import nan_check
+        all_findings = []
+        for target in targets:
+            findings = nan_check(target)
+            all_findings.extend(findings)
+            if not args.json:
+                print(f"== {target.name}: "
+                      f"{'NON-FINITE' if findings else 'all finite'}")
+                _render_table(findings)
+        if args.json:
+            print(json.dumps([f.to_dict() for f in all_findings],
+                             indent=2))
+        return _gate(all_findings, args.fail_on)
+
+    from paddle_tpu.analysis.shard_rules import shard_check
     all_findings = []
     for target in targets:
         findings = lint_target(target, disable=disable,
                                with_cost=args.cost)
+        findings.extend(shard_check(target, disable=disable))
         all_findings.extend(findings)
         if not args.json:
             errs = sum(f.severity == "error" for f in findings)
@@ -191,10 +252,63 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"== {target.name}: {errs} error(s), "
                   f"{warns} warning(s)")
             _render_table(findings)
-    if args.json:
-        print(json.dumps([f.to_dict() for f in all_findings], indent=2))
+
+    reports = []
+    if args.memory or args.budgets:
+        from paddle_tpu.analysis.memory import (check_budgets,
+                                                estimate_target,
+                                                load_budgets)
+        reports = [estimate_target(t) for t in targets]
+        if not args.json:
+            print("== memory: static per-shard footprint ==")
+            for rep in reports:
+                xla = (f"  (xla temp {rep.xla['temp_size_in_bytes']}B)"
+                       if rep.xla else "")
+                print(f"{rep.name:<22} mesh={rep.mesh:<12} "
+                      f"peak/shard {rep.peak_bytes}B  "
+                      f"args {rep.args_bytes}B  "
+                      f"largest-transient "
+                      f"{rep.largest_transient_bytes}B{xla}")
+        if args.budgets:
+            budget_findings = check_budgets(reports,
+                                            load_budgets(args.budgets))
+            all_findings.extend(budget_findings)
+            if not args.json:
+                _render_table(budget_findings) if budget_findings else \
+                    print(f"memory budgets OK ({args.budgets})")
+
+    warns = sum(f.severity == "warn" for f in all_findings)
+    if args.write_warn_baseline:
+        with open(args.write_warn_baseline, "w") as f:
+            json.dump({"warn_count": warns}, f, indent=2)
+            f.write("\n")
+        print(f"tpu-lint: wrote warn baseline {warns} -> "
+              f"{args.write_warn_baseline}")
+        return 0
+
     rc = _gate(all_findings, args.fail_on)
-    if not args.json:
+    if args.warn_ratchet:
+        with open(args.warn_ratchet) as f:
+            baseline = int(json.load(f)["warn_count"])
+        if warns > baseline:
+            rc = 1
+            print(f"tpu-lint: warn ratchet FAIL — {warns} warning(s) "
+                  f"exceeds the checked-in baseline {baseline} "
+                  f"({args.warn_ratchet}); fix or justify with a "
+                  "'# tpu-lint: disable=' comment, never by raising "
+                  "the baseline casually", file=sys.stderr)
+        elif not args.json:
+            print(f"warn ratchet OK ({warns} <= baseline {baseline})")
+
+    if args.json:
+        payload = [f.to_dict() for f in all_findings]
+        if reports:
+            print(json.dumps({"findings": payload,
+                              "memory": [r.to_dict() for r in reports]},
+                             indent=2))
+        else:
+            print(json.dumps(payload, indent=2))
+    else:
         n = len(targets)
         print(f"tpu-lint: {n} entrypoint(s), "
               f"{len(all_findings)} finding(s) — "

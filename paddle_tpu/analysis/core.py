@@ -82,11 +82,21 @@ class LintTarget:
     ``.lower()``) or any traceable callable.  ``args``/``kwargs`` may
     be concrete arrays or ``jax.ShapeDtypeStruct``s; nothing is
     executed, only traced.
+
+    ``recipe`` (a :class:`paddle_tpu.analysis.shard_rules.ShardRecipe`,
+    optional) declares the mesh + per-argument shardings this
+    entrypoint ships with in production; when present,
+    :func:`paddle_tpu.analysis.shard_rules.shard_check` additionally
+    lowers the program under that mesh and runs the SPMD rule family
+    (collective placement, replication waste, reshard churn) plus the
+    per-shard HBM footprint estimate.  Recipe-less targets lint
+    single-device exactly as before.
     """
     name: str
     fn: Callable
     args: Tuple = ()
     kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    recipe: Any = None           # ShardRecipe | None (no import cycle)
 
 
 # --------------------------------------------------------------- suppression

@@ -19,6 +19,18 @@ Register project-specific entrypoints with::
         return LintTarget("my-step", my_jitted_fn, (example_args,))
 
 and the CI gate covers them from then on.
+
+Entrypoints that ship with a mesh layout also carry a
+:class:`~paddle_tpu.analysis.shard_rules.ShardRecipe` — then
+``--self-check`` additionally lowers them under a real >=2-device CPU
+mesh and runs the SPMD rule family (shard_rules.py), and ``--memory``
+reports per-shard bytes under that mesh.  The shipped recipes are
+DATA-PARALLEL on purpose: batch/slot-major args shard on ``dp``
+(declared by the serving builders via ``_lint_batch_args`` /
+``_decode_slot_args``), params replicate.  A tensor-parallel recipe
+would put a per-layer all-reduce inside the decode while body — the
+exact program shape ``collective-in-decode`` exists to reject.
+Recipe-less entrypoints lint single-device exactly as before.
 """
 
 from __future__ import annotations
@@ -83,6 +95,18 @@ def _tiny_trainer():
 # -------------------------------------------------------------- entrypoints
 
 
+def _dp_recipe(n_args: int, sharded_args, note: str):
+    """Two-device data-parallel ShardRecipe: the listed positional
+    args shard their leading dim on ``dp``, everything else (params,
+    pools, scalars) replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.analysis.shard_rules import ShardRecipe
+    specs = tuple(P("dp") if i in tuple(sharded_args) else None
+                  for i in range(n_args))
+    return ShardRecipe(axes=(("dp", 2),), arg_specs=specs, note=note)
+
+
 @register_entrypoint("trainer-train-step")
 def _trainer_train_step() -> LintTarget:
     tr = _tiny_trainer()
@@ -91,7 +115,9 @@ def _trainer_train_step() -> LintTarget:
     return LintTarget(
         "trainer-train-step", steps["train_step"],
         (tr.params, tr.net_state, tr.opt_state, batch,
-         jnp.asarray(0, jnp.int32)))
+         jnp.asarray(0, jnp.int32)),
+        recipe=_dp_recipe(5, (3,), "dp over the batch; the gradient "
+                          "all-reduce lands OUTSIDE any loop"))
 
 
 @register_entrypoint("trainer-eval-step")
@@ -100,7 +126,8 @@ def _trainer_eval_step() -> LintTarget:
     steps = tr.jitted_steps()
     batch = {"ids": jnp.zeros((2, 8), jnp.int32)}
     return LintTarget("trainer-eval-step", steps["eval_step"],
-                      (tr.params, tr.net_state, batch))
+                      (tr.params, tr.net_state, batch),
+                      recipe=_dp_recipe(3, (2,), "dp over the batch"))
 
 
 @register_entrypoint("dense-serve-step")
@@ -111,7 +138,10 @@ def _dense_serve_step() -> LintTarget:
     return LintTarget(
         "dense-serve-step", serve._jit,
         (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
-         0.0, None, None, None, None, None))
+         0.0, None, None, None, None, None),
+        recipe=_dp_recipe(9, serve._lint_batch_args,
+                          "dp over prompt rows; a tp recipe would "
+                          "all-reduce inside the decode loop"))
 
 
 @register_entrypoint("paged-serve-step")
@@ -119,10 +149,21 @@ def _paged_serve_step() -> LintTarget:
     from paddle_tpu.serving import paged_serve_builder
     serve = paged_serve_builder(_tiny_cfg(), block_size=8)
     prompts = jnp.zeros((2, 4), jnp.int32)
+    # The paged loop cannot dp-shard its batch yet: the block pool is
+    # SLOT-SHARED ([nb, bs, h, hd], no batch dim), so row-sharded
+    # append/reserve scatters force an all-gather of the pool every
+    # iteration — shard-check proves it (11 collective-in-decode
+    # errors under a dp recipe).  Until the ROADMAP multi-chip pool
+    # item (per-shard pool accounting) lands, the honest contract is
+    # replicated-under-mesh: the gate still compiles the SPMD program
+    # and proves no collective sneaks into the loop.
     return LintTarget(
         "paged-serve-step", serve._jit,
         (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
-         0.0, None, None, None, None, None))
+         0.0, None, None, None, None, None),
+        recipe=_dp_recipe(9, (), "replicated under the mesh — see "
+                          "factory comment; dp blocked on the "
+                          "multi-chip pool ROADMAP item"))
 
 
 @register_entrypoint("paged-engine-decode")
@@ -136,4 +177,8 @@ def _paged_engine_decode() -> LintTarget:
         "paged-engine-decode", eng._decode,
         (eng.params, eng.cache, jnp.zeros((S,), jnp.int32),
          jnp.ones((S,), bool), jnp.zeros((S,), jnp.float32),
-         jnp.zeros((S,), bool), jax.random.key(0)))
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, eng._decode_slot_args,
+                          "dp over slot vectors; pool + block tables "
+                          "replicated until the multi-chip pool item "
+                          "lands (ROADMAP)"))

@@ -1,0 +1,293 @@
+"""Static per-shard HBM footprint estimation for lint entrypoints.
+
+``paddle_tpu lint --memory`` answers, before any chip is booked: *how
+many bytes does one shard of this entrypoint keep live at peak?*  The
+estimate is computed from the traced jaxpr's avals divided by each
+value's sharding factor (the product of the mesh-axis sizes its
+PartitionSpec names) — params and KV/block pools enter through the
+argument avals, transients through a last-use liveness scan over the
+equations:
+
+* a value is live from the equation that produces it to its last use
+  (function outputs stay live to the end);
+* ``pjit`` bodies are walked inline; ``while``/``scan``/``cond``
+  bodies contribute their own internal peak on top of the live set at
+  the call site (minus the carried operands already counted);
+* an equation output's shard factor is the most conservative (min) of
+  its input factors — intermediates are never assumed better-sharded
+  than their inputs.
+
+This is an ESTIMATE of the logical program, not XLA's allocator:
+fusion removes materializations the scan counts, rematerialization
+adds ones it cannot see.  It is deliberately stable across compiler
+versions — that is what makes it a useful CI budget (checked-in
+``analysis/budgets.json``, gated by ci.sh).  When the program also
+compiles, :func:`estimate_target` attaches XLA's own
+``memory_analysis()`` numbers for cross-reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax._src import core as jcore
+
+from paddle_tpu.analysis.core import Finding, LintTarget
+
+__all__ = ["MemoryReport", "aval_bytes", "shard_factor",
+           "estimate_target", "load_budgets", "check_budgets"]
+
+
+def aval_bytes(aval) -> int:
+    """Bytes of one (unsharded) value.  Extended dtypes (PRNG keys)
+    report their key-data size; anything unsized counts 0."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = getattr(dtype, "itemsize", 4)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(itemsize)
+
+
+def shard_factor(sharding) -> int:
+    """How many ways a NamedSharding splits its value: the product of
+    the mesh-axis sizes its spec names.  1 for replicated/None."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return 1
+    from paddle_tpu.parallel.sharding import spec_axes
+    f = 1
+    for name in spec_axes(spec):
+        f *= dict(zip(mesh.axis_names, mesh.devices.shape))[name]
+    return max(1, f)
+
+
+@dataclasses.dataclass
+class MemoryReport:
+    """Per-shard byte accounting for one entrypoint."""
+    name: str
+    mesh: str                       # "{'dp': 2}" or "single-device"
+    shards: int
+    args_bytes: int                 # params + pools + inputs, per shard
+    out_bytes: int
+    peak_bytes: int                 # liveness-scan peak, per shard
+    largest_transient_bytes: int    # biggest single equation output
+    xla: Optional[Dict[str, int]] = None   # memory_analysis(), if any
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ----------------------------------------------------------- liveness scan
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[Any, List]]:
+    """(inner jaxpr, outer operands feeding its invars) pairs for the
+    control-flow primitives the scan recurses into."""
+    prim, params = eqn.primitive.name, eqn.params
+    out = []
+    if prim == "pjit":
+        inner = params["jaxpr"].jaxpr
+        out.append((inner, list(eqn.invars)))
+    elif prim == "while":
+        cn, bn = params["cond_nconsts"], params["body_nconsts"]
+        body = params["body_jaxpr"].jaxpr
+        out.append((body, list(eqn.invars[cn:])))
+    elif prim == "scan":
+        inner = params["jaxpr"].jaxpr
+        out.append((inner, list(eqn.invars)))
+    elif prim == "cond":
+        for br in params["branches"]:
+            out.append((br.jaxpr, list(eqn.invars[1:])))
+    elif prim in ("custom_jvp_call", "custom_vjp_call"):
+        inner = params.get("call_jaxpr") or params.get("fun_jaxpr")
+        if inner is not None:
+            out.append((getattr(inner, "jaxpr", inner),
+                        list(eqn.invars)))
+    return out
+
+
+def _peak(jaxpr, factors: Dict[int, int]) -> Tuple[int, int]:
+    """(peak live bytes, largest single output) for one jaxpr under
+    the given per-var shard factors (mutated with propagated entries).
+    """
+    def b(v) -> int:
+        return aval_bytes(v.aval) // factors.get(id(v), 1)
+
+    n = len(jaxpr.eqns)
+    last: Dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                last[id(v)] = i
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            last[id(v)] = n
+
+    live: Dict[int, int] = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        live[id(v)] = b(v)
+    cur = sum(live.values())
+    peak, largest = cur, 0
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        in_f = [factors.get(id(v), 1) for v in eqn.invars
+                if isinstance(v, jcore.Var)]
+        out_f = min(in_f) if in_f else 1
+        for v in eqn.outvars:
+            factors.setdefault(id(v), out_f)
+
+        inner_peak = 0
+        for inner, operands in _sub_jaxprs(eqn):
+            for outer, iv in zip(operands, inner.invars):
+                if isinstance(outer, jcore.Var) and id(outer) in factors:
+                    factors[id(iv)] = factors[id(outer)]
+            ip, il = _peak(inner, factors)
+            # the inner invars are the outer operands, already counted
+            # in `cur` — only the inner EXTRA is new at this point
+            extra = max(0, ip - sum(
+                aval_bytes(v.aval) // factors.get(id(v), 1)
+                for v in inner.invars))
+            inner_peak = max(inner_peak, extra)
+            largest = max(largest, il)
+
+        out_bytes = sum(b(v) for v in eqn.outvars)
+        largest = max(largest, out_bytes)
+        if _sub_jaxprs(eqn):
+            # a call-style eqn's outputs ARE the inner outvars: the
+            # inner extra already covers the instant they materialize,
+            # and by the time the call returns its transients are gone
+            # — counting both at once would double the outputs
+            peak = max(peak, cur + inner_peak, cur + out_bytes)
+        else:
+            peak = max(peak, cur + out_bytes)
+
+        for v in eqn.outvars:
+            if last.get(id(v), -1) > i:
+                nb = b(v)
+                live[id(v)] = nb
+                cur += nb
+        seen = set()
+        for v in eqn.invars:
+            if (isinstance(v, jcore.Var) and id(v) not in seen
+                    and last.get(id(v)) == i and id(v) in live):
+                cur -= live.pop(id(v))
+                seen.add(id(v))
+    return peak, largest
+
+
+# ------------------------------------------------------------ entry points
+
+
+def estimate_target(target: LintTarget, recipe=None, *,
+                    with_xla: bool = True) -> MemoryReport:
+    """Per-shard footprint of one entrypoint.  With a mesh recipe the
+    argument factors come from the resolved in_shardings and the scan
+    runs over the meshed program; recipe-less targets are a 1-shard
+    estimate of the plain program."""
+    from paddle_tpu.analysis import shard_rules as sr
+    recipe = recipe or getattr(target, "recipe", None)
+    mesh_desc, shards = "single-device", 1
+    fn = target.fn
+    arg_factors: List[int] = []
+
+    flat_args = jax.tree_util.tree_leaves(target.args)
+    if recipe is not None:
+        mesh = sr.build_mesh(recipe)
+        if mesh is not None:
+            ins = sr.resolve_in_shardings(recipe, mesh, target.args)
+            fn = jax.jit(target.fn, in_shardings=ins)
+            arg_factors = [shard_factor(s)
+                           for s in sr._leaf_shardings(ins)]
+            mesh_desc, shards = str(dict(recipe.axes)), mesh.size
+
+    closed = jax.make_jaxpr(fn)(*target.args, **target.kwargs)
+    invars = closed.jaxpr.invars
+    if len(arg_factors) != len(invars):
+        arg_factors = [1] * len(invars)
+    factors = {id(v): f for v, f in zip(invars, arg_factors)}
+
+    peak, largest = _peak(closed.jaxpr, factors)
+    args_bytes = sum(aval_bytes(v.aval) // f
+                     for v, f in zip(invars, arg_factors))
+    out_bytes = sum(aval_bytes(v.aval) // factors.get(id(v), 1)
+                    for v in closed.jaxpr.outvars
+                    if isinstance(v, jcore.Var))
+
+    xla = None
+    if with_xla and hasattr(fn, "lower"):
+        try:
+            from jax._src import config as _jconfig
+            with _jconfig.threefry_partitionable(True):
+                # same RNG stance as shard_check: meshed artifacts are
+                # built the way a multi-chip deployment would build them
+                ma = fn.lower(*target.args,
+                              **target.kwargs).compile().memory_analysis()
+            xla = {
+                "argument_size_in_bytes":
+                    int(ma.argument_size_in_bytes),
+                "output_size_in_bytes": int(ma.output_size_in_bytes),
+                "temp_size_in_bytes": int(ma.temp_size_in_bytes),
+            }
+        except Exception:
+            xla = None
+    _ = flat_args   # (leaves kept for future per-arg breakdowns)
+    return MemoryReport(name=target.name, mesh=mesh_desc, shards=shards,
+                        args_bytes=args_bytes, out_bytes=out_bytes,
+                        peak_bytes=peak,
+                        largest_transient_bytes=largest, xla=xla)
+
+
+# -------------------------------------------------------------- budget gate
+
+
+def load_budgets(path: str) -> Dict[str, Dict[str, int]]:
+    with open(path) as f:
+        data = json.load(f)
+    return {k: v for k, v in data.items() if not k.startswith("_")}
+
+
+def check_budgets(reports: List[MemoryReport],
+                  budgets: Dict[str, Dict[str, int]]) -> List[Finding]:
+    """Error findings for every report over (or missing) its budget —
+    the ci.sh memory gate.  A missing budget entry fails too: a new
+    entrypoint must declare its footprint, that is the whole policy
+    (docs/design/analysis.md)."""
+    out = []
+
+    class _B:                      # severity carrier for Finding rows
+        rule_id, severity = "memory-budget", "error"
+
+    for rep in reports:
+        entry = budgets.get(rep.name)
+        if entry is None:
+            out.append(Finding(
+                rule_id=_B.rule_id, severity=_B.severity, path=rep.name,
+                message=f"no budget entry for {rep.name!r} in "
+                        "budgets.json — add one (current peak "
+                        f"{rep.peak_bytes} bytes/shard)",
+                suggestion="add {\"%s\": {\"peak_bytes\": N}} with "
+                           "headroom" % rep.name))
+            continue
+        budget = int(entry.get("peak_bytes", 0))
+        if rep.peak_bytes > budget:
+            out.append(Finding(
+                rule_id=_B.rule_id, severity=_B.severity, path=rep.name,
+                message=f"per-shard peak {rep.peak_bytes} bytes "
+                        f"exceeds the checked-in budget {budget} — "
+                        "an HBM regression this size would OOM the "
+                        "serving slice before any measurement",
+                suggestion="shrink the footprint, or raise the "
+                           "budget in the SAME pr with the reason"))
+    return out

@@ -1,0 +1,572 @@
+"""shard-check: SPMD sharding analysis of meshed entrypoints.
+
+PR 2's tpu-lint sees the single-device jaxpr; every open multi-chip
+item (paged serving over a mesh, the sp×ep MoE NaN) fails in the
+*partitioned* program — the one GSPMD writes after ``in_shardings``
+are applied.  This module lowers a registered entrypoint under its
+declared :class:`ShardRecipe` — a real ``jax.sharding.Mesh`` over CPU
+devices, shapes straight from the entrypoint registry — and runs a
+second rule family over two artifacts:
+
+* the **pjit-annotated jaxpr** (spec propagation from ``in_shardings``
+  through pjit boundaries: mesh-axis validation, conflicting specs
+  feeding one dot, ``with_sharding_constraint`` churn);
+* the **compiled SPMD module** (the optimized HLO text, where GSPMD's
+  inserted collectives are visible by name, with source metadata):
+  collective placement relative to while/scan decode bodies.
+
+The rules (catalog in docs/design/analysis.md):
+
+==========================  =====  ==================================
+rule                        sev    fires when
+==========================  =====  ==================================
+collective-in-decode        error  all-gather/all-reduce/all-to-all/
+                                   reduce-scatter/collective-permute
+                                   inside a while body/cond — per-step
+                                   latency on the serving hot path
+mesh-axis-mismatch          error  in_shardings name axes the mesh
+                                   does not have, or the two operands
+                                   of one dot contract over dims
+                                   sharded on DIFFERENT mesh axes
+replicated-large-param      warn   an input leaf >= threshold bytes
+                                   left fully replicated on a >1-
+                                   device mesh
+reshard-churn               warn   the same value hit by chained or
+                                   repeated sharding constraints
+                                   between uses
+==========================  =====  ==================================
+
+Nothing executes: the mesh is CPU devices (``ci.sh`` forces
+``--xla_force_host_platform_device_count``), programs are traced,
+lowered and compiled but never run — GSPMD partitioning is backend-
+independent, so the collective schedule the check sees is the one a
+TPU slice would run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax._src import core as jcore
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.analysis.core import (Finding, LintContext, LintTarget,
+                                      severity_rank)
+from paddle_tpu.parallel.sharding import spec_axes
+
+__all__ = ["ShardRecipe", "ShardRule", "SHARD_RULES",
+           "register_shard_rule", "active_shard_rules", "shard_check",
+           "build_mesh", "resolve_in_shardings", "COLLECTIVE_OPS"]
+
+
+# ------------------------------------------------------------------ recipe
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardRecipe:
+    """The mesh + per-argument sharding contract of one entrypoint.
+
+    ``axes``: ordered ``(name, size)`` pairs — the mesh shape.
+    ``arg_specs``: one entry per positional argument —
+
+    * ``None``: fully replicated (the default for missing entries);
+    * a ``PartitionSpec``: applied to every array leaf of the arg;
+    * a callable ``(arg, mesh) -> sharding pytree`` for per-leaf
+      layouts (e.g. :func:`paddle_tpu.parallel.sharding.
+      shardings_like` with a rule table).
+    """
+    axes: Tuple[Tuple[str, int], ...]
+    arg_specs: Tuple[Any, ...] = ()
+    note: str = ""
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for _, size in self.axes:
+            n *= size
+        return n
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+
+def build_mesh(recipe: ShardRecipe) -> Optional[Mesh]:
+    """Real CPU-device mesh for the recipe, or None if the process has
+    fewer devices than the recipe needs (the caller reports, not
+    raises: lint must degrade loudly, never crash the gate)."""
+    devs = jax.devices()
+    if len(devs) < recipe.num_devices:
+        return None
+    shape = tuple(size for _, size in recipe.axes)
+    arr = np.asarray(devs[:recipe.num_devices]).reshape(shape)
+    return Mesh(arr, recipe.axis_names)
+
+
+def resolve_in_shardings(recipe: ShardRecipe, mesh: Mesh, args: Tuple):
+    """Per-argument sharding pytrees (full trees, one NamedSharding per
+    array leaf) from the recipe's ``arg_specs``."""
+    out = []
+    for i, arg in enumerate(args):
+        spec = (recipe.arg_specs[i]
+                if i < len(recipe.arg_specs) else None)
+        if callable(spec) and not isinstance(spec, P):
+            out.append(spec(arg, mesh))
+            continue
+        s = NamedSharding(mesh, spec if isinstance(spec, P) else P())
+        out.append(jax.tree_util.tree_map(lambda _leaf, _s=s: _s, arg))
+    return tuple(out)
+
+
+def _leaf_shardings(in_shardings) -> List[Any]:
+    flat = []
+    for tree in in_shardings:
+        flat.extend(jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, NamedSharding)))
+    return flat
+
+
+# -------------------------------------------------------- the SPMD program
+
+
+@dataclasses.dataclass
+class ShardAnalysis:
+    """Everything a shard rule may read: the recipe, the realized mesh,
+    the resolved shardings, the traced jaxpr of the meshed program and
+    the compiled SPMD module text."""
+    target: LintTarget
+    recipe: ShardRecipe
+    mesh: Mesh
+    in_shardings: Tuple
+    closed: Any                       # ClosedJaxpr of jit(fn, in_shardings)
+    hlo: Optional[str]                # compiled optimized HLO text
+    leaf_specs: List[Tuple[str, Any, Any]]   # (label, aval, NamedSharding)
+
+
+def _arg_leaf_specs(args, in_shardings) -> List[Tuple[str, Any, Any]]:
+    """Flatten (args, shardings) to labelled leaves: the label is the
+    positional index plus the pytree key path, readable in findings."""
+    out = []
+    for i, (arg, shd) in enumerate(zip(args, in_shardings)):
+        leaves, _ = jax.tree_util.tree_flatten_with_path(arg)
+        sleaves = jax.tree_util.tree_leaves(
+            shd, is_leaf=lambda x: isinstance(x, NamedSharding))
+        for (path, leaf), s in zip(leaves, sleaves):
+            label = f"arg{i}" + jax.tree_util.keystr(path)
+            out.append((label, leaf, s))
+    return out
+
+
+# -------------------------------------------------------------- HLO parsing
+
+
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "all-reduce-start", "all-gather-start",
+    "collective-permute-start", "ragged-all-to-all",
+})
+
+_COMP_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w\.\-]+)\s*\(.*\{\s*$")
+_CALLED_RE = re.compile(
+    r"(?:condition|body|to_apply|calls|branch_computations|"
+    r"called_computations)=(\{[^}]*\}|%?[\w\.\-]+)")
+_COMP_NAME_RE = re.compile(r"%?([\w\.\-]+)")
+_META_RE = re.compile(
+    r'metadata=\{[^}]*?op_name="([^"]*)"'
+    r'(?:[^}]*?source_file="([^"]*)")?(?:[^}]*?source_line=(\d+))?')
+
+
+def _hlo_opcode(line: str) -> Optional[str]:
+    """Opcode of one HLO instruction line (``%x = TYPE opcode(...)``);
+    TYPE may itself be a parenthesized tuple."""
+    if " = " not in line:
+        return None
+    rhs = line.split(" = ", 1)[1].lstrip()
+    if rhs.startswith("("):                      # tuple-typed result
+        depth = 0
+        for i, c in enumerate(rhs):
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    rhs = rhs[i + 1:].lstrip()
+                    break
+    else:
+        parts = rhs.split(None, 1)
+        rhs = parts[1] if len(parts) > 1 else ""
+    m = re.match(r"([\w\-]+)\(", rhs)
+    return m.group(1) if m else None
+
+
+def parse_hlo_computations(hlo: str) -> Dict[str, List[str]]:
+    """HLO text -> {computation name: [instruction lines]}."""
+    comps: Dict[str, List[str]] = {}
+    current = None
+    for line in hlo.splitlines():
+        if not line.startswith((" ", "\t")):
+            m = _COMP_HEADER_RE.match(line.strip())
+            if m:
+                current = m.group(1)
+                comps[current] = []
+            elif line.strip() == "}":
+                current = None
+            continue
+        if current is not None and line.strip() and line.strip() != "}":
+            comps[current].append(line.rstrip())
+    return comps
+
+
+def _called_computations(line: str) -> List[str]:
+    out = []
+    for m in _CALLED_RE.finditer(line):
+        blob = m.group(1)
+        if blob.startswith("{"):
+            out.extend(n for n in _COMP_NAME_RE.findall(blob))
+        else:
+            out.append(blob.lstrip("%"))
+    return out
+
+
+def _transitive(comps: Dict[str, List[str]], roots: Sequence[str]):
+    seen, stack = set(), [r for r in roots if r in comps]
+    while stack:
+        name = stack.pop()
+        if name in seen or name not in comps:
+            continue
+        seen.add(name)
+        for line in comps[name]:
+            stack.extend(_called_computations(line))
+    return seen
+
+
+# -------------------------------------------------------------------- rules
+
+
+class ShardRule:
+    rule_id: str = ""
+    severity: str = "warn"
+    doc: str = ""
+
+    def run(self, sa: ShardAnalysis, ctx: LintContext) -> None:
+        raise NotImplementedError
+
+
+SHARD_RULES: Dict[str, type] = {}
+
+
+def register_shard_rule(cls):
+    assert cls.rule_id and cls.rule_id not in SHARD_RULES, cls
+    SHARD_RULES[cls.rule_id] = cls
+    return cls
+
+
+def active_shard_rules() -> List[ShardRule]:
+    return [cls() for cls in SHARD_RULES.values()]
+
+
+@register_shard_rule
+class CollectiveInDecodeRule(ShardRule):
+    """GSPMD placing a collective INSIDE a while/scan body is a
+    per-decode-step latency tax — EQuARX (PAPERS.md) measures the
+    collective share of distributed inference; a loop-invariant one
+    (e.g. a weight all-gather) belongs hoisted, and XLA does hoist it
+    when the spec allows.  One left inside means the sharding makes it
+    genuinely iteration-dependent: an error before a slice is booked.
+    """
+
+    rule_id = "collective-in-decode"
+    severity = "error"
+    doc = ("GSPMD collective (all-gather/all-reduce/all-to-all/...) "
+           "inside a while/scan decode body")
+
+    def run(self, sa, ctx):
+        if not sa.hlo:
+            return
+        comps = parse_hlo_computations(sa.hlo)
+        loop_comps = set()
+        for name, lines in comps.items():
+            for line in lines:
+                if _hlo_opcode(line) == "while":
+                    loop_comps |= _transitive(
+                        comps, _called_computations(line))
+        for name in sorted(loop_comps):
+            for line in comps.get(name, ()):
+                op = _hlo_opcode(line)
+                if op not in COLLECTIVE_OPS:
+                    continue
+                meta = _META_RE.search(line)
+                op_name = meta.group(1) if meta else ""
+                file = meta.group(2) if meta and meta.group(2) else None
+                lineno = (int(meta.group(3))
+                          if meta and meta.group(3) else None)
+                ctx.report(
+                    self, f"{sa.target.name}/spmd/{name}",
+                    f"{op} inside the decode loop "
+                    f"({op_name or 'no op_name'}) — it runs every "
+                    "iteration on the serving hot path",
+                    file=file, line=lineno,
+                    suggestion="reshard so the contraction no longer "
+                    "crosses the mesh inside the loop (e.g. shard the "
+                    "batch, replicate the per-step operand), or hoist "
+                    "the resharded value out of the carry")
+
+
+@register_shard_rule
+class MeshAxisMismatchRule(ShardRule):
+    """Two static spec checks, both fatal before any lowering: (a) an
+    ``in_shardings`` entry naming a mesh axis the recipe's mesh does
+    not define — GSPMD would reject it at jit time with a stack trace
+    instead of a finding; (b) the two operands of one ``dot_general``
+    contracting over dims sharded on DIFFERENT mesh axes — GSPMD
+    resolves that with a full reshard of one side, which is never what
+    the spec author meant."""
+
+    rule_id = "mesh-axis-mismatch"
+    severity = "error"
+    doc = ("in_shardings naming axes absent from the mesh, or one dot "
+           "contracting dims sharded on different axes")
+
+    def run(self, sa, ctx):
+        # (a) is checked in shard_check BEFORE NamedShardings are
+        # built (building one with an unknown axis raises).  Here: (b).
+        if sa.closed is None:
+            return
+        specs: Dict[int, Any] = {}
+        flat = _leaf_shardings(sa.in_shardings)
+        invars = sa.closed.jaxpr.invars
+        for var, s in zip(invars, flat):
+            if isinstance(s, NamedSharding):
+                specs[id(var)] = s.spec
+        self._walk(sa.closed.jaxpr, specs, sa, ctx, sa.target.name)
+
+    def _walk(self, jaxpr, specs, sa, ctx, path):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "dot_general":
+                self._check_dot(eqn, specs, sa, ctx, path)
+            inner = None
+            if prim == "pjit":
+                inner = eqn.params["jaxpr"]
+            elif prim in ("custom_jvp_call", "custom_vjp_call"):
+                inner = (eqn.params.get("call_jaxpr")
+                         or eqn.params.get("fun_jaxpr"))
+            if inner is not None:
+                ij = getattr(inner, "jaxpr", inner)
+                inner_specs = dict(specs)
+                for outer, iv in zip(eqn.invars, ij.invars):
+                    if isinstance(outer, jcore.Var) and id(outer) in specs:
+                        inner_specs[id(iv)] = specs[id(outer)]
+                self._walk(ij, inner_specs, sa, ctx,
+                           f"{path}/pjit:{eqn.params.get('name', '?')}"
+                           if prim == "pjit" else f"{path}/{prim}")
+
+    def _check_dot(self, eqn, specs, sa, ctx, path):
+        lhs, rhs = eqn.invars[:2]
+        sl = specs.get(id(lhs))
+        sr = specs.get(id(rhs))
+        if sl is None or sr is None:
+            return
+        (lc, rc), _ = eqn.params["dimension_numbers"]
+
+        def axis_at(spec, dim):
+            entries = tuple(spec)
+            if dim >= len(entries):
+                return None
+            e = entries[dim]
+            return e if not isinstance(e, (tuple, list)) else tuple(e)
+
+        for dl, dr in zip(lc, rc):
+            al, ar = axis_at(sl, dl), axis_at(sr, dr)
+            if al is not None and ar is not None and al != ar:
+                ctx.report(
+                    self, f"{path}/dot_general",
+                    f"dot contracts lhs dim {dl} (sharded on "
+                    f"{al!r}) against rhs dim {dr} (sharded on "
+                    f"{ar!r}) — GSPMD will reshard a whole operand "
+                    "to reconcile them",
+                    eqn=eqn,
+                    suggestion="shard both contraction dims on the "
+                    "same mesh axis (partial-sum + all-reduce) or "
+                    "leave one side replicated")
+
+
+@register_shard_rule
+class ReplicatedLargeParamRule(ShardRule):
+    """'Automatic Cross-Replica Sharding of Weight Update ...'
+    (PAPERS.md): replicated large tensors are the dominant HBM waste
+    of data-parallel training.  Any input leaf at/over the threshold
+    left fully replicated on a >1-device mesh gets flagged with the
+    bytes it wastes per extra device."""
+
+    rule_id = "replicated-large-param"
+    severity = "warn"
+    doc = "input leaf >= threshold bytes fully replicated on the mesh"
+
+    def __init__(self, min_bytes: int = 1 << 20):
+        self.min_bytes = min_bytes
+
+    def run(self, sa, ctx):
+        if sa.mesh.size <= 1:
+            return
+        from paddle_tpu.analysis.memory import aval_bytes
+        for label, leaf, s in sa.leaf_specs:
+            if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+                continue
+            nbytes = aval_bytes(leaf)
+            replicated = (not isinstance(s, NamedSharding)
+                          or not spec_axes(s.spec))
+            if replicated and nbytes >= self.min_bytes:
+                ctx.report(
+                    self, f"{sa.target.name}/{label}",
+                    f"{label} ({nbytes} bytes) is fully replicated "
+                    f"across the {dict(sa.recipe.axes)} mesh — "
+                    f"{nbytes * (sa.mesh.size - 1)} redundant bytes",
+                    suggestion="shard it (parallel.sharding rule "
+                    "table) or note why replication wins (small, "
+                    "read-every-step) with a tpu-lint disable")
+
+
+@register_shard_rule
+class ReshardChurnRule(ShardRule):
+    """``with_sharding_constraint`` chains: constraining a value that
+    is itself the fresh output of a constraint (or constraining the
+    same value twice with no use in between) makes GSPMD materialize
+    each intermediate layout — real all-to-all traffic, zero reads."""
+
+    rule_id = "reshard-churn"
+    severity = "warn"
+    doc = "same value hit by chained/duplicate sharding constraints"
+
+    _PRIM = "sharding_constraint"
+
+    def run(self, sa, ctx):
+        if sa.closed is None:
+            return
+        self._walk(sa.closed.jaxpr, sa, ctx, sa.target.name)
+
+    def _walk(self, jaxpr, sa, ctx, path):
+        producers: Dict[int, Any] = {}
+        constrained: Dict[int, Any] = {}     # var id -> first constraint
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == self._PRIM:
+                src = eqn.invars[0]
+                prod = producers.get(id(src))
+                if prod is not None and prod.primitive.name == self._PRIM:
+                    ctx.report(
+                        self, f"{path}/{self._PRIM}",
+                        "sharding constraint applied to the "
+                        "IMMEDIATE output of another constraint — "
+                        "the intermediate layout is materialized and "
+                        "never read",
+                        eqn=eqn,
+                        suggestion="keep only the final constraint")
+                elif isinstance(src, jcore.Var) and id(src) in constrained:
+                    ctx.report(
+                        self, f"{path}/{self._PRIM}",
+                        "the same value is resharded more than once "
+                        "between uses",
+                        eqn=eqn,
+                        suggestion="constrain once, at the consumer "
+                        "that needs the layout")
+                if isinstance(src, jcore.Var):
+                    constrained[id(src)] = eqn
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr",
+                        "cond_jaxpr", "body_jaxpr"):
+                inner = eqn.params.get(key) if eqn.params else None
+                if inner is not None:
+                    self._walk(getattr(inner, "jaxpr", inner), sa, ctx,
+                               f"{path}/{eqn.primitive.name}")
+            for key in ("branches",):
+                for inner in (eqn.params.get(key) or ()):
+                    self._walk(getattr(inner, "jaxpr", inner), sa, ctx,
+                               f"{path}/{eqn.primitive.name}")
+            for v in eqn.outvars:
+                producers[id(v)] = eqn
+
+
+# -------------------------------------------------------------- shard_check
+
+
+def _static_axis_findings(recipe: ShardRecipe, target_name: str,
+                          ctx: LintContext) -> bool:
+    """Check (a) of mesh-axis-mismatch: specs naming unknown axes.
+    Runs before any NamedSharding is built.  Returns True if fatal."""
+    rule = SHARD_RULES["mesh-axis-mismatch"]()
+    known = set(recipe.axis_names)
+    bad = False
+    for i, spec in enumerate(recipe.arg_specs):
+        if not isinstance(spec, P):
+            continue
+        unknown = spec_axes(spec) - known
+        if unknown:
+            bad = True
+            ctx.report(
+                rule, f"{target_name}/arg{i}",
+                f"in_shardings for arg {i} name mesh "
+                f"axis(es) {sorted(unknown)} but the recipe's mesh "
+                f"has {sorted(known)}",
+                suggestion="fix the PartitionSpec or add the axis to "
+                "the recipe's mesh")
+    return bad
+
+
+def shard_check(target: LintTarget, recipe: Optional[ShardRecipe] = None,
+                rules: Optional[Sequence[ShardRule]] = None,
+                disable: Sequence[str] = ()) -> List[Finding]:
+    """Lower ``target`` under its mesh recipe and run the SPMD rule
+    family.  Returns findings sorted most-severe-first; a recipe-less
+    target returns ``[]`` (it lints single-device via :func:`lint`).
+    """
+    recipe = recipe or getattr(target, "recipe", None)
+    if recipe is None:
+        return []
+    rules = list(rules) if rules is not None else active_shard_rules()
+    ctx = LintContext(disable=disable)
+
+    mesh = build_mesh(recipe)
+    if mesh is None:
+        ctx.report(
+            SHARD_RULES["mesh-axis-mismatch"](), target.name,
+            f"recipe needs {recipe.num_devices} devices "
+            f"({dict(recipe.axes)}) but only {len(jax.devices())} are "
+            "visible — set XLA_FLAGS="
+            "--xla_force_host_platform_device_count (ci.sh does)")
+        return ctx.findings
+    if _static_axis_findings(recipe, target.name, ctx):
+        return ctx.findings       # NamedSharding would raise past here
+
+    in_shardings = resolve_in_shardings(recipe, mesh, target.args)
+    wrapped = jax.jit(target.fn, in_shardings=in_shardings)
+    # Partitionable RNG for the meshed lowering: legacy threefry (the
+    # jax<0.5 default) broadcasts its key with an all-reduce wherever
+    # random bits feed a sharded shape — a config artifact any real
+    # multi-chip deployment flips off (it IS the default from jax
+    # 0.5), not a property of the recipe under check.
+    from jax._src import config as _jconfig
+    with _jconfig.threefry_partitionable(True):
+        closed = jax.make_jaxpr(wrapped)(*target.args, **target.kwargs)
+        hlo = None
+        try:
+            lowered = wrapped.lower(*target.args, **target.kwargs)
+            hlo = lowered.compile().as_text()
+        except Exception as e:      # compile failure IS a finding
+            ctx.report(SHARD_RULES["mesh-axis-mismatch"](), target.name,
+                       f"SPMD lowering failed under the recipe mesh: "
+                       f"{e}")
+
+    sa = ShardAnalysis(
+        target=target, recipe=recipe, mesh=mesh,
+        in_shardings=in_shardings, closed=closed, hlo=hlo,
+        leaf_specs=_arg_leaf_specs(target.args, in_shardings))
+    for rule in rules:
+        if rule.rule_id not in ctx.disable:
+            rule.run(sa, ctx)
+    ctx.findings.sort(key=lambda f: (-severity_rank(f.severity),
+                                     f.rule_id, f.file or "",
+                                     f.line or 0))
+    return ctx.findings

@@ -171,9 +171,11 @@ class TransformerLM(Module):
         pos = param("pos_embed", (cfg.max_len, cfg.dim), policy.param_dtype,
                     init.normal(0.02))
         if pos_ids is not None:
+            # tpu-lint: disable=gather-in-decode — per-row positional rows ARE cursor-indexed; O(t·dim), dwarfed by the KV read
             x = x + jnp.take(pos, pos_ids, axis=0, mode="clip")
         else:
             start = 0 if position is None else position
+            # tpu-lint: disable=gather-in-decode — one dim-wide row per step at the write cursor; hoisting would defeat the single-program decode
             x = x + jax.lax.dynamic_slice_in_dim(pos, start, t,
                                                  axis=0)[None]
         new_caches = [] if caches is not None else None
@@ -224,12 +226,16 @@ class TransformerLM(Module):
 
 
 def _next_token_loss(logits, ids, mask):
+    # pad column built by shape, not by zeros_like(ids[:, :1]) — the
+    # slice feeding zeros_like is value-dead and traced anyway
+    # (tpu-lint dead-code)
     targets = jnp.concatenate(
-        [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
+        [ids[:, 1:], jnp.zeros((ids.shape[0], 1), ids.dtype)], axis=1)
     per_tok = losses.softmax_cross_entropy(logits, targets)
     if mask is not None:
         valid = jnp.concatenate(
-            [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+            [mask[:, 1:], jnp.zeros((mask.shape[0], 1), mask.dtype)],
+            axis=1)
         return jnp.sum(per_tok * valid) / jnp.maximum(jnp.sum(valid), 1)
     return per_tok[:, :-1].mean()
 
@@ -483,25 +489,37 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
             cache_valid = (jnp.arange(cfg.max_len)[None, :]
                            >= lpad[:, None])                   # [b, L]
 
+        # `done` exists only when an eos id does: with eos_id=None
+        # `pick` passes it through untouched and `cond` never reads it,
+        # so materializing and threading it hauls a dead [b] bool
+        # through every iteration (the tpu-lint dead-code findings this
+        # layout fixes).  eos_id is STATIC, so the two carry layouts
+        # are two compiled programs, never a traced branch.
+        track_done = eos_id is not None
+
         (logits, caches), _ = model.apply(params, {}, None, prompt_ids,
                                           caches, 0, pos_ids, cache_valid)
         k0, rng_key = jax.random.split(rng_key)
-        tok, done = pick(logits[:, -1], k0, jnp.zeros((b,), bool))
+        tok, done0 = pick(logits[:, -1], k0,
+                          jnp.zeros((b,), bool) if track_done else None)
         buf = jnp.full((b, max_new), pad, prompt_ids.dtype)
         buf = buf.at[:, 0].set(tok)
 
         def cond(carry):
-            _, _, _, done, _, i = carry
-            live = i < steps
-            if eos_id is not None:
+            live = carry[-1] < steps
+            if track_done:
                 # early exit once every row froze: the remaining
                 # columns already hold eos (the buffer's fill value),
                 # so stopping is exactly equivalent to scanning on
-                live = live & ~jnp.all(done)
+                live = live & ~jnp.all(carry[3])
             return live
 
         def body(carry):
-            caches, tok, key, done, buf, i = carry
+            if track_done:
+                caches, tok, key, done, buf, i = carry
+            else:
+                caches, tok, key, buf, i = carry
+                done = done0
             # feeds token t_{i-1}, whose keys/values belong at cache
             # row tp + i - 1; picks t_i into buffer column i
             step_pos_ids = (None if lens is None
@@ -512,11 +530,14 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
             key, sub = jax.random.split(key)
             nxt, done = pick(lg[:, -1], sub, done)
             buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
-            return (caches, nxt, key, done, buf, i + 1)
+            if track_done:
+                return (caches, nxt, key, done, buf, i + 1)
+            return (caches, nxt, key, buf, i + 1)
 
-        (_, _, _, _, buf, _) = jax.lax.while_loop(
-            cond, body,
-            (caches, tok, rng_key, done, buf, jnp.asarray(1, jnp.int32)))
+        init = ((caches, tok, rng_key, done0, buf,
+                 jnp.asarray(1, jnp.int32)) if track_done else
+                (caches, tok, rng_key, buf, jnp.asarray(1, jnp.int32)))
+        buf = jax.lax.while_loop(cond, body, init)[-2]
         return jnp.concatenate([prompt_ids, buf], axis=1)
 
     def serve(params, prompt_ids, steps, temperature: float = 0.0,
@@ -564,6 +585,12 @@ def lm_serve_builder(cfg: TransformerConfig, attn_fn=None):
 
     serve._cache_size = _serve._cache_size   # the no-retrace proof hook
     serve._jit = _serve   # the lintable program (analysis/entrypoints.py)
+    # shard-check contract (analysis/shard_rules.py): arg 1
+    # (prompt_ids) is batch-major — a data-parallel mesh recipe shards
+    # it, replicates params.  Tensor-parallel layouts are NOT a valid
+    # recipe for this loop: per-layer all-reduces would land inside
+    # the decode while body, exactly what collective-in-decode rejects.
+    serve._lint_batch_args = (1,)
     return serve
 
 

@@ -89,6 +89,7 @@ class Embedding(Module):
         # mode="clip": out-of-vocab ids clamp to the last row (XLA's
         # native gather semantics) instead of jnp.take's default NaN
         # fill, which silently poisons the whole forward pass.
+        # tpu-lint: disable=gather-in-decode — embedding lookup of the carried token IS the decode step; one row per iteration
         return policy.cast_to_output(jnp.take(table, ids, axis=0,
                                               mode="clip"))
 

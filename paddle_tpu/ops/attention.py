@@ -80,6 +80,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         logits = logits + bias
     if scores_dtype is not None:
         logits = logits.astype(scores_dtype)
+    # tpu-lint: disable=dead-code — jax.nn.softmax's custom-jvp forward leaves an unused normalize chain in the grad trace; XLA DCEs it
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     weights = weights.astype(v.dtype if scores_dtype is None
                              else scores_dtype)

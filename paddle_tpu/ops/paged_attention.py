@@ -143,6 +143,7 @@ def paged_reserve(cache: PagedKVCache, want):
     ok = jnp.sum(flat) <= jnp.sum(cache.free)
     order = jnp.argsort(~cache.free)           # free blocks first, by index
     rank = jnp.cumsum(flat) - 1
+    # tpu-lint: disable=gather-in-decode — free-list allocation is per-step by design; [nb] int32 traffic, noise next to the page reads
     ids = order[jnp.clip(rank, 0, nb - 1)]
     ids = jnp.where(flat, ids, nb)             # sentinel -> dropped below
     claimed = jnp.zeros((nb,), bool).at[ids].max(flat, mode="drop")
@@ -223,6 +224,7 @@ def paged_append(view: PagedLayerView, k_new: jax.Array,
     valid = jnp.arange(t)[None, :] < view.append_valid[:, None]
     blk = pos // bs
     within = pos % bs
+    # tpu-lint: disable=gather-in-decode — block-table lookup at the write cursor is the paged-KV append contract
     phys = jnp.take_along_axis(view.block_table,
                                jnp.clip(blk, 0, maxb - 1), axis=1)
     phys = jnp.where(valid & (blk < maxb) & (phys >= 0), phys, nb)
@@ -254,7 +256,9 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     maxb = block_table.shape[1]
     scale = (hd ** -0.5) if scale is None else scale
     table = jnp.clip(block_table, 0, nb - 1)
+    # tpu-lint: disable=gather-in-decode — the K/V page gather IS paged attention; HBM-vs-gather crossover is the measured trade (ROADMAP)
     k = k_pages[table].reshape(b, maxb * bs, h, hd)
+    # tpu-lint: disable=gather-in-decode — same trade as the K gather above
     v = v_pages[table].reshape(b, maxb * bs, h, hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale

@@ -1,6 +1,10 @@
 """Mesh/sharding toolkit: dp/tp/pp/sp/ep rules, ZeRO-1, ring
 attention, MoE expert dispatch, row-sharded embeddings (the
-multi-machine twin — ICI/DCN collectives replace the pserver)."""
+multi-machine twin — ICI/DCN collectives replace the pserver).
+Mesh layouts built here are statically checkable: give the entrypoint
+a ``paddle_tpu.analysis.ShardRecipe`` and ``tpu-lint`` lowers it under
+a real CPU mesh, rejects collectives inside decode loops, and budgets
+its per-shard HBM footprint (``docs/design/analysis.md``)."""
 from paddle_tpu.parallel.mesh import (make_mesh, batch_sharding, replicated,
                                       shard_batch, replicate, DP, MP, PP, SP)
 from paddle_tpu.parallel import sharding

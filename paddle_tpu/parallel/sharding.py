@@ -22,6 +22,23 @@ from paddle_tpu.nn.module import flatten_names, unflatten_names
 Rules = Sequence[Tuple[str, P]]
 
 
+def spec_axes(spec: Optional[P]) -> frozenset:
+    """Mesh-axis names a PartitionSpec actually uses (nested tuple
+    entries flattened; ``None`` dims skipped).  Empty set == fully
+    replicated.  One home for this so the linter
+    (``analysis/shard_rules.py``) and the runtime sharding helpers
+    cannot disagree about what 'replicated' means."""
+    names = set()
+    for entry in (spec or ()):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.update(e for e in entry if e is not None)
+        else:
+            names.add(entry)
+    return frozenset(names)
+
+
 def apply_rules(params, mesh: Mesh, rules: Optional[Rules]):
     """device_put each param with its matched sharding (replicated default)."""
     flat = flatten_names(params)

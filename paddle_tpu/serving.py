@@ -219,6 +219,11 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
 
     serve._cache_size = _pserve._cache_size   # the no-retrace proof hook
     serve._jit = _pserve   # the lintable program (analysis/entrypoints.py)
+    # sharding contract for the linter's mesh recipes (shard-check):
+    # positional arg 1 (prompt_ids) is batch-major — shard it on a
+    # data axis, replicate the rest.  Declared HERE, by the owner of
+    # the calling convention, so entrypoints.py cannot drift from it.
+    serve._lint_batch_args = (1,)
     serve.block_size = bs
     serve.max_blocks_per_slot = maxb
     return serve
@@ -314,6 +319,12 @@ class PagedServingEngine:
         # donation, TPU honors it).
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
         self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+        # shard-check contract: decode_fn args 2..5 (tok, active,
+        # temps, done) are slot-major [S] vectors — the lint mesh
+        # recipe shards them on the data axis; params and the paged
+        # pool stay replicated (multi-chip pool sharding is the
+        # ROADMAP item this gate de-risks).
+        self._decode_slot_args = (2, 3, 4, 5)
         self._free = jax.jit(paged.paged_free, donate_argnums=(0,))
         from paddle_tpu.analysis.watch import CompileWatcher
         self._compile_watch = CompileWatcher(decode=self._decode,

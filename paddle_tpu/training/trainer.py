@@ -119,6 +119,7 @@ class Trainer:
             fusion_ctx = contextlib.nullcontext
 
         def train_step(params, net_state, opt_state, batch, step):
+            # tpu-lint: disable=dead-code — rng liveness is model-dependent: dead only for dropout-free configs, one fold_in either way
             rng = jax.random.fold_in(jax.random.key(self.seed), step)
 
             def loss_fn(p):

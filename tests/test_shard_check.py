@@ -1,0 +1,278 @@
+"""shard-check: SPMD rule family, HBM estimator, budget gate, nan_check
+(``paddle_tpu/analysis/shard_rules.py`` + ``memory.py`` + ``nans.py``).
+
+Same discipline as test_tpu_lint.py: every rule gets a bad toy meshed
+program it MUST flag and a fixed twin it MUST stay quiet on — ci.sh
+fails on error-severity shard findings, so false positives here would
+brick the gate as surely as missed collectives would brick serving.
+All programs run under the conftest 8-virtual-CPU-device platform.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import pytest
+
+from paddle_tpu.analysis import (LintTarget, MemoryReport, ShardRecipe,
+                                 check_budgets, estimate_target,
+                                 nan_check, shard_check)
+from paddle_tpu.analysis.cli import main as lint_main
+from paddle_tpu.analysis.memory import aval_bytes, load_budgets
+
+DP2 = (("dp", 2),)
+
+
+def _by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def _target(fn, args, recipe):
+    return LintTarget("toy", fn, args, recipe=recipe)
+
+
+# ----------------------------------------------------- collective-in-decode
+
+
+def _loop(x, w):
+    def body(c):
+        i, t = c
+        return i + 1, jnp.dot(t, w, preferred_element_type=jnp.float32)
+
+    return lax.while_loop(lambda c: c[0] < 4,
+                          body, (jnp.asarray(0, jnp.int32), x))
+
+
+def test_collective_in_decode_fires_on_carry_contraction():
+    # x cols and w rows both live on dp: every dot in the body contracts
+    # a sharded dim -> partial sums -> GSPMD all-reduce INSIDE the loop
+    x, w = jnp.ones((8, 8), jnp.float32), jnp.ones((8, 8), jnp.float32)
+    fs = _by_rule(
+        shard_check(_target(
+            _loop, (x, w),
+            ShardRecipe(axes=DP2,
+                        arg_specs=(P(None, "dp"), P("dp", None))))),
+        "collective-in-decode")
+    assert fs and all(f.severity == "error" for f in fs)
+    assert any("all-reduce" in f.message for f in fs)
+
+
+def test_collective_in_decode_quiet_on_row_sharded_carry():
+    # x rows on dp, w replicated: the contraction dim is unsharded, the
+    # carry layout is loop-stable, nothing crosses chips per step
+    x, w = jnp.ones((8, 8), jnp.float32), jnp.ones((8, 8), jnp.float32)
+    fs = shard_check(_target(
+        _loop, (x, w),
+        ShardRecipe(axes=DP2, arg_specs=(P("dp", None), None))))
+    assert not _by_rule(fs, "collective-in-decode")
+
+
+# ---------------------------------------------------- replicated-large-param
+
+
+def test_replicated_large_param_fires_at_a_mebibyte():
+    big = jnp.zeros((512, 1024), jnp.float32)          # 2 MiB
+    fs = _by_rule(
+        shard_check(_target(lambda p: p + 1.0, (big,),
+                            ShardRecipe(axes=DP2, arg_specs=(None,)))),
+        "replicated-large-param")
+    assert len(fs) == 1 and fs[0].severity == "warn"
+
+
+def test_replicated_large_param_quiet_when_sharded_or_small():
+    big = jnp.zeros((512, 1024), jnp.float32)
+    small = jnp.zeros((64, 64), jnp.float32)           # 16 KiB
+    assert not _by_rule(
+        shard_check(_target(lambda p: p + 1.0, (big,),
+                            ShardRecipe(axes=DP2,
+                                        arg_specs=(P("dp"),)))),
+        "replicated-large-param")
+    assert not _by_rule(
+        shard_check(_target(lambda p: p + 1.0, (small,),
+                            ShardRecipe(axes=DP2, arg_specs=(None,)))),
+        "replicated-large-param")
+
+
+# ------------------------------------------------------- mesh-axis-mismatch
+
+
+def test_mesh_axis_mismatch_fires_on_unknown_axis():
+    x = jnp.zeros((8, 8), jnp.float32)
+    fs = _by_rule(
+        shard_check(_target(lambda v: v, (x,),
+                            ShardRecipe(axes=DP2, arg_specs=(P("tp"),)))),
+        "mesh-axis-mismatch")
+    assert fs and fs[0].severity == "error"
+    assert "tp" in fs[0].message
+
+
+def test_mesh_axis_mismatch_quiet_on_known_axis():
+    x = jnp.zeros((8, 8), jnp.float32)
+    assert not shard_check(_target(
+        lambda v: v, (x,), ShardRecipe(axes=DP2, arg_specs=(P("dp"),))))
+
+
+# ----------------------------------------------------------- reshard-churn
+
+
+def _mesh2():
+    return Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+
+
+def test_reshard_churn_fires_on_chained_constraints():
+    mesh = _mesh2()
+
+    def churn(x):
+        y = lax.with_sharding_constraint(
+            x + 1.0, NamedSharding(mesh, P("dp", None)))
+        return lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, "dp")))
+
+    x = jnp.zeros((8, 8), jnp.float32)
+    fs = _by_rule(
+        shard_check(_target(churn, (x,),
+                            ShardRecipe(axes=DP2, arg_specs=(None,)))),
+        "reshard-churn")
+    assert fs and fs[0].severity == "warn"
+
+
+def test_reshard_churn_quiet_on_single_constraint():
+    mesh = _mesh2()
+
+    def pinned(x):
+        return lax.with_sharding_constraint(
+            x + 1.0, NamedSharding(mesh, P("dp", None)))
+
+    x = jnp.zeros((8, 8), jnp.float32)
+    assert not _by_rule(
+        shard_check(_target(pinned, (x,),
+                            ShardRecipe(axes=DP2, arg_specs=(None,)))),
+        "reshard-churn")
+
+
+# ---------------------------------------------------- recipe-less contract
+
+
+def test_recipe_less_target_is_skipped():
+    x = jnp.zeros((8, 8), jnp.float32)
+    assert shard_check(LintTarget("plain", lambda v: v + 1.0, (x,))) == []
+
+
+# ---------------------------------------------------------- HBM estimator
+
+
+def test_aval_bytes():
+    assert aval_bytes(jax.ShapeDtypeStruct((4, 8), jnp.float32)) == 128
+    assert aval_bytes(jax.ShapeDtypeStruct((3,), jnp.bfloat16)) == 6
+
+
+def test_estimator_matches_hand_computed_bytes():
+    # x + 1.0 over f32[128]: one 512 B input live throughout, one 512 B
+    # output produced on top of it -> peak exactly 1 KiB, no recipe
+    x = jnp.zeros((128,), jnp.float32)
+    rep = estimate_target(LintTarget("t", lambda v: v + 1.0, (x,)),
+                          with_xla=False)
+    assert rep.shards == 1 and rep.args_bytes == 512
+    assert rep.out_bytes == 512 and rep.peak_bytes == 1024
+    assert rep.largest_transient_bytes == 512
+
+
+def test_estimator_divides_by_shard_factor():
+    x = jnp.zeros((128,), jnp.float32)
+    rep = estimate_target(LintTarget(
+        "t", lambda v: v + 1.0, (x,),
+        recipe=ShardRecipe(axes=DP2, arg_specs=(P("dp"),))),
+        with_xla=False)
+    assert rep.shards == 2 and rep.args_bytes == 256
+    assert rep.peak_bytes == 512
+
+
+# ------------------------------------------------------------- budget gate
+
+
+def _rep(name, peak):
+    return MemoryReport(name=name, mesh="{'dp': 2}", shards=2,
+                        args_bytes=0, out_bytes=0, peak_bytes=peak,
+                        largest_transient_bytes=0)
+
+
+def test_budget_gate_passes_within_budget():
+    assert check_budgets([_rep("a", 100)], {"a": {"peak_bytes": 200}}) == []
+
+
+def test_budget_gate_fails_over_budget():
+    fs = check_budgets([_rep("a", 300)], {"a": {"peak_bytes": 200}})
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert fs[0].rule_id == "memory-budget" and "300" in fs[0].message
+
+
+def test_budget_gate_fails_on_missing_entry():
+    fs = check_budgets([_rep("new-entry", 10)], {"a": {"peak_bytes": 1}})
+    assert len(fs) == 1 and "no budget entry" in fs[0].message
+
+
+def test_checked_in_budgets_cover_every_entrypoint():
+    from paddle_tpu.analysis import ENTRYPOINTS
+    budgets = load_budgets("paddle_tpu/analysis/budgets.json")
+    assert set(budgets) == set(ENTRYPOINTS)
+    assert all(v["peak_bytes"] > 0 for v in budgets.values())
+
+
+# ---------------------------------------------------------------- nan_check
+
+
+def test_nan_check_localizes_log_of_negative():
+    def bad(x):
+        return jnp.log(x - 1.0)            # log(-1) at x=0 -> NaN
+
+    fs = nan_check(LintTarget(
+        "nan-toy", bad, (jnp.zeros((4,), jnp.float32),)))
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert fs[0].rule_id == "nan-check" and "nan" in fs[0].message.lower()
+
+
+def test_nan_check_quiet_on_finite_program():
+    assert nan_check(LintTarget(
+        "ok", lambda x: x * 2.0, (jnp.ones((4,), jnp.float32),))) == []
+
+
+# -------------------------------------------------------- warn-ratchet CLI
+
+
+def _warn_factory():
+    """Module-level factory the CLI resolves by name: one guaranteed
+    replicated-large-param WARN under a 2-device dp mesh."""
+    big = jnp.zeros((512, 1024), jnp.float32)
+    return LintTarget("ratchet-warn", lambda p: p + 1.0, (big,),
+                      recipe=ShardRecipe(axes=DP2, arg_specs=(None,)))
+
+
+def test_warn_ratchet_rc(tmp_path, capsys):
+    spec = f"{__name__}:_warn_factory"
+    base = tmp_path / "warn_baseline.json"
+
+    base.write_text('{"warn_count": 0}\n')
+    assert lint_main([spec, "--warn-ratchet", str(base)]) == 1
+
+    base.write_text('{"warn_count": 1}\n')
+    assert lint_main([spec, "--warn-ratchet", str(base)]) == 0
+    capsys.readouterr()
+
+
+def test_write_warn_baseline(tmp_path, capsys):
+    spec = f"{__name__}:_warn_factory"
+    out = tmp_path / "baseline.json"
+    assert lint_main([spec, "--write-warn-baseline", str(out)]) == 0
+    assert json.loads(out.read_text()) == {"warn_count": 1}
+    capsys.readouterr()
+
+
+def test_budget_gate_cli_fails_on_missing_entry(tmp_path, capsys):
+    spec = f"{__name__}:_warn_factory"
+    budgets = tmp_path / "budgets.json"
+    budgets.write_text('{"something-else": {"peak_bytes": 1}}\n')
+    assert lint_main([spec, "--memory", "--budgets", str(budgets)]) == 1
+    capsys.readouterr()
