@@ -32,7 +32,6 @@ outage still fails fast with one well-formed error row per metric.
 """
 
 import gc
-import json
 import subprocess
 import sys
 import time
@@ -217,15 +216,18 @@ def main():
     # BEFORE any backend exists (an eager jax.devices() here would pin
     # the sitecustomize's platform and defeat the env var).
     import paddle_tpu  # noqa: F401
+    # every stdout row routes through the shared telemetry emitter (one
+    # schema with benchmark/lm_decode.py); imported after paddle_tpu for
+    # the same env-platform reason
+    from paddle_tpu.telemetry import emit_row
     from paddle_tpu.utils.watchdog import attach_watchdog
 
     if not _attach_probe_with_retry():
         for row in _ROWS_SCHEMA:
-            print(json.dumps({
+            emit_row({
                 **row,
                 "error": "device attachment did not complete within "
-                         f"{ATTACH_TIMEOUT:.0f}s (after 1 retry)"}),
-                flush=True)
+                         f"{ATTACH_TIMEOUT:.0f}s (after 1 retry)"})
         sys.exit(3)
 
     # the probe succeeded moments ago, so the in-process attach should be
@@ -236,11 +238,10 @@ def main():
     disarm()                          # attached; timing may take longer
     if not SMOKE and jax.default_backend() != "tpu":
         for row in _ROWS_SCHEMA:
-            print(json.dumps({
+            emit_row({
                 **row,
                 "error": f"backend is {jax.default_backend()!r}, not "
-                         "tpu — refusing to record chipless numbers"}),
-                flush=True)
+                         "tpu — refusing to record chipless numbers"})
         sys.exit(3)
 
     for schema_row, row_fn in zip(_ROWS_SCHEMA,
@@ -254,7 +255,7 @@ def main():
             # tiny-shape pipeline check, NOT a measurement — mark it so
             # a scraper can never record smoke output as real numbers
             row["smoke"] = True
-        print(json.dumps(row), flush=True)
+        emit_row(row)
         # reclaim the finished row's HBM (params/opt state/batches) only
         # after its frames are gone, before the next model builds
         gc.collect()

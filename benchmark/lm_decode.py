@@ -21,7 +21,6 @@ MFU rows (serving intent twin: the C-API multi-thread example,
 """
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -63,6 +62,11 @@ def main():
     ap.add_argument("--pool-blocks", type=int, default=0,
                     help="paged pool size (0 = dense-equivalent "
                          "batch * ceil(max_len/block_size))")
+    ap.add_argument("--telemetry-out", default=None, metavar="PATH",
+                    help="append a telemetry snapshot record (the row as "
+                         "meta + the process registry, raw differential "
+                         "samples included) to this JSONL file — "
+                         "inspect with `paddle_tpu telemetry show/diff`")
     ap.add_argument("--bf16-params", action="store_true",
                     help="serving_cast the params to bf16 first — "
                          "halves the parameter HBM footprint; decode "
@@ -143,19 +147,24 @@ def main():
         per_step = sorted(diffs)[len(diffs) // 2]
         compiles = decode._cache_size()
 
-    row = {
-        "metric": f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
-                  f"prompt{args.prompt}"
-                  + (" flash" if args.flash else "")
-                  + (" ragged" if args.ragged else "")
-                  + (" paged" if args.paged else "")
-                  + (" bf16-params" if args.bf16_params else ""),
-        "backend": jax.default_backend(),
-        "decoder": args.decoder,
-        "compiles": compiles,      # serve contract: 1 across both arms
-        "ms_per_step": round(per_step * 1e3, 3),
-        "tokens_per_s": round(args.batch / per_step, 1),
-        "unit": "tokens/s"}
+    # dense and --paged rows build through the shared telemetry row
+    # helper, so the keys the crossover analysis joins on cannot diverge
+    from paddle_tpu import telemetry
+
+    row = telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
+               f"prompt{args.prompt}"
+               + (" flash" if args.flash else "")
+               + (" ragged" if args.ragged else "")
+               + (" paged" if args.paged else "")
+               + (" bf16-params" if args.bf16_params else ""),
+        value=round(args.batch / per_step, 1),
+        unit="tokens/s",
+        backend=jax.default_backend(),
+        decoder=args.decoder,
+        compiles=compiles,         # serve contract: 1 across both arms
+        ms_per_step=round(per_step * 1e3, 3),
+        tokens_per_s=round(args.batch / per_step, 1))
     if args.paged:
         # pool accounting: HBM the paged cache actually pins for the
         # long differential arm vs the dense [b, max_len] slabs
@@ -173,7 +182,17 @@ def main():
             "paged_prefill_mib": round(sum(used) / 2**20, 1),
             "dense_cache_mib": round(
                 args.batch * dense_hbm_bytes(max_len, **kw) / 2**20, 1)})
-    print(json.dumps(row), flush=True)
+    if args.telemetry_out:
+        reg = telemetry.get_registry()
+        hist = reg.histogram(
+            "bench_lm_decode_step_seconds",
+            "raw differential per-step samples (one per repeat)")
+        for d in diffs:
+            hist.observe(d, decoder=args.decoder,
+                         paged=str(args.paged).lower())
+        telemetry.append_jsonl(args.telemetry_out, reg.snapshot(),
+                               meta=row)
+    telemetry.emit_row(row)
 
 
 if __name__ == "__main__":

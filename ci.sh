@@ -48,6 +48,14 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \
     --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
+echo "== telemetry gate: instrumented smoke + schema + overhead + re-lint =="
+# Drives a real instrumented paged-serving run (compiles must stay
+# {'decode': 1} WITH telemetry on), validates the snapshot against the
+# documented schema through the JSONL/Prometheus exporters, bounds the
+# per-observation overhead, and re-lints the instrumented entrypoints —
+# host-callback-in-loop must report zero findings.
+JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
+
 echo "== native libs =="
 make -C csrc -q 2>/dev/null || make -C csrc
 

@@ -47,6 +47,7 @@ PACKAGES = {
                              "ops"],
     "paddle_tpu.distributed": ["runtime", "master", "launch"],
     "paddle_tpu.inference": [],
+    "paddle_tpu.telemetry": ["metrics", "spans", "export"],
 }
 
 

@@ -330,6 +330,9 @@ def main(argv=None):
     if argv and argv[0] == "lint":
         from paddle_tpu.analysis.cli import main as lint_main
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "telemetry":
+        from paddle_tpu.telemetry.cli import main as telemetry_main
+        raise SystemExit(telemetry_main(argv[1:]))
     parser = argparse.ArgumentParser(prog="paddle_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -413,6 +416,12 @@ def main(argv=None):
         "lint",
         help="tpu-lint static analyzer (python -m paddle_tpu.analysis "
              "twin); all arguments pass through, e.g. `lint --self-check`")
+
+    # same forwarding scheme for the telemetry snapshot inspector
+    sub.add_parser(
+        "telemetry",
+        help="inspect/diff telemetry JSONL snapshots (python -m "
+             "paddle_tpu.telemetry twin); e.g. `telemetry show run.jsonl`")
 
     p = sub.add_parser("merge_model", help="export checkpoint for serving")
     common(p)

@@ -50,6 +50,7 @@ from paddle_tpu.models.transformer import (TransformerConfig,
 from paddle_tpu.ops import paged_attention as paged
 from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
                                             paged_hbm_bytes)
+from paddle_tpu import telemetry
 import paddle_tpu.nn as nn
 
 __all__ = ["paged_serve_builder", "PagedServingEngine",
@@ -231,7 +232,7 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "tokens",
-                 "blocks_reserved", "submitted_at")
+                 "blocks_reserved", "submitted_at", "first_token_at")
 
     def __init__(self, rid, prompt, max_new, temperature, blocks):
         self.rid = rid
@@ -241,6 +242,7 @@ class _Request:
         self.tokens = []                  # generated ids (host ints)
         self.blocks_reserved = blocks
         self.submitted_at = time.perf_counter()
+        self.first_token_at = None        # set when prefill emits tok0
 
 
 class PagedServingEngine:
@@ -258,13 +260,22 @@ class PagedServingEngine:
     ``prompt_buckets`` are the prefill pad widths (one prefill compile
     per bucket actually used); ``eos_id``/``top_k``/``top_p`` are
     engine-static (a serving process fixes its tokenizer and sampler).
+
+    The engine is deeply instrumented through ``paddle_tpu.telemetry``
+    (``metrics=`` takes a :class:`~paddle_tpu.telemetry.MetricsRegistry`;
+    default: the process-wide one): queue-wait / TTFT /
+    time-per-output-token / step-time histograms, admission-reject and
+    retire counters, per-step occupancy gauges, and compile events via
+    the CompileWatcher — all strictly on the host side of the jitted
+    step (catalog: ``docs/design/telemetry.md``).
     """
 
     def __init__(self, cfg: TransformerConfig, params, *,
                  num_slots: int, num_blocks: int, block_size: int = 16,
                  max_blocks_per_slot: Optional[int] = None,
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
-                 top_k=None, top_p=None, attn_fn=None, seed: int = 0):
+                 top_k=None, top_p=None, attn_fn=None, seed: int = 0,
+                 metrics=None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -345,6 +356,61 @@ class PagedServingEngine:
         self.decode_steps = 0
         self.tokens_decoded = 0
         self._run_seconds = 0.0
+        # Telemetry — ALL host-side, observed only after device values
+        # come home (int()/np.asarray syncs): a metric update inside the
+        # jitted step would be the host-callback-in-loop lint error, and
+        # the compiles == {'decode': 1} pin proves instrumentation does
+        # not perturb tracing.  Handles are resolved once here so the
+        # per-step cost is a few dict-free increments.
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.get_registry())
+        m = self.metrics
+        self._m_queue_wait = m.histogram(
+            "serving_queue_wait_seconds",
+            help="submit() -> admission (prefill start) wait")
+        self._m_ttft = m.histogram(
+            "serving_ttft_seconds",
+            help="submit() -> first token on the host (prefill incl. "
+                 "queue wait)")
+        self._m_tpot = m.histogram(
+            "serving_time_per_output_token_seconds",
+            help="(retire - first token) / (tokens - 1), recorded at "
+                 "retire — the steady-state decode latency per token")
+        self._m_step = m.histogram(
+            "serving_step_seconds",
+            help="one step() call: admit + jitted decode + retire")
+        self._m_steps = m.counter(
+            "serving_decode_steps_total", help="decode steps driven")
+        self._m_tokens = m.counter(
+            "serving_tokens_decoded_total",
+            help="tokens produced by decode steps (prefill tok0 excluded"
+                 ", matching stats()['tokens_decoded'])")
+        self._m_submitted = m.counter(
+            "serving_submitted_total", help="requests accepted by submit")
+        self._m_rejects = m.counter(
+            "serving_admission_rejects_total",
+            help="admission attempts blocked, by reason=slots|pool "
+                 "(counted once per blocked _admit call)")
+        self._m_retired = m.counter(
+            "serving_retired_total",
+            help="requests retired, by reason=eos|max_new")
+        self._m_occup = m.gauge(
+            "serving_pool_occupancy_fraction",
+            help="host-side estimate of pool blocks holding live tokens"
+                 " / pool size, sampled per step (device truth: "
+                 "occupancy(), which syncs)")
+        self._m_blocks = m.gauge(
+            "serving_pool_blocks_in_use",
+            help="host-side estimate of pool blocks holding live tokens")
+        self._m_reserved_g = m.gauge(
+            "serving_blocks_reserved_worst_case",
+            help="admission accounting: worst-case blocks reserved")
+        self._m_slots_g = m.gauge(
+            "serving_slots_active", help="slots holding a live request")
+        self._m_compiles = m.gauge(
+            "serving_compiles",
+            help="compiles since engine construction per jitted fn "
+                 "(CompileWatcher), sampled per step; decode must stay 1")
 
     # ---------------------------------------------------------- host API
 
@@ -370,6 +436,7 @@ class PagedServingEngine:
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt, max_new,
                                     float(temperature), blocks))
+        self._m_submitted.inc()
         return rid
 
     def _split(self):
@@ -384,11 +451,15 @@ class PagedServingEngine:
             try:
                 slot = self._slots.index(None)
             except ValueError:
+                self._m_rejects.inc(reason="slots")
                 return                    # all slots busy
             req = self._queue[0]
             if self._reserved + req.blocks_reserved > self.nb:
+                self._m_rejects.inc(reason="pool")
                 return                    # pool cannot take it yet
             self._queue.popleft()
+            self._m_queue_wait.observe(
+                time.perf_counter() - req.submitted_at)
             width = min(w for w in self.buckets
                         if req.prompt.shape[0] <= w)
             padded = np.zeros((1, width), np.int32)
@@ -402,15 +473,23 @@ class PagedServingEngine:
                              "accounting (engine bug)"
             self._reserved += req.blocks_reserved
             self._slots[slot] = req
-            req.tokens.append(int(tok0))
-            self._tok[slot] = int(tok0)
+            req.tokens.append(int(tok0))   # host sync: tok0 is REAL now
+            req.first_token_at = time.perf_counter()
+            self._m_ttft.observe(req.first_token_at - req.submitted_at)
+            self._tok[slot] = req.tokens[-1]
             self._temps[slot] = req.temperature
             self._done[slot] = bool(done0)
             if bool(done0) or req.max_new == 1:
-                self._retire(slot)
+                self._retire(slot,
+                             "eos" if bool(done0) else "max_new")
 
-    def _retire(self, slot: int):
+    def _retire(self, slot: int, reason: str = "max_new"):
         req = self._slots[slot]
+        n = len(req.tokens)
+        if n > 1 and req.first_token_at is not None:
+            self._m_tpot.observe(
+                (time.perf_counter() - req.first_token_at) / (n - 1))
+        self._m_retired.inc(reason=reason)
         self._results[req.rid] = np.asarray(req.tokens, np.int32)
         self.cache = self._free(
             self.cache, jnp.asarray(np.arange(self.S) == slot))
@@ -418,8 +497,30 @@ class PagedServingEngine:
         self._slots[slot] = None
         self._done[slot] = True
 
+    def _sample_gauges(self):
+        """Per-step host-side gauges.  Block usage is the request-level
+        estimate (``ceil((prompt + tokens)/block_size)`` per active
+        slot — same accounting as :meth:`hbm_report`), so sampling
+        costs no device transfer; :meth:`occupancy` stays the device
+        truth.  Compile counts come from the CompileWatcher already
+        held for the ``compiles == 1`` pin."""
+        active = [r for r in self._slots if r is not None]
+        in_use = sum(-(-(r.prompt.shape[0] + len(r.tokens)) // self.bs)
+                     for r in active)
+        self._m_blocks.set(in_use)
+        self._m_occup.set(in_use / self.nb)
+        self._m_reserved_g.set(self._reserved)
+        self._m_slots_g.set(len(active))
+        for fn, n in self._compile_watch.counts().items():
+            self._m_compiles.set(n, fn=fn)
+
     def step(self):
-        """One decode step over every active slot, then retire/admit."""
+        """One decode step over every active slot, then retire/admit.
+        Each call is timed into ``_run_seconds`` (and the
+        ``serving_step_seconds`` histogram) HERE, so throughput
+        accounting is correct whether callers drive :meth:`step`
+        directly or via :meth:`run`."""
+        t0 = time.perf_counter()
         self._admit()
         active = np.asarray([r is not None for r in self._slots])
         if not active.any():
@@ -432,27 +533,34 @@ class PagedServingEngine:
                          "accounting (engine bug)"
         nxt, done = np.asarray(nxt), np.asarray(done)
         self.decode_steps += 1
-        self.tokens_decoded += int(active.sum())
+        n_active = int(active.sum())
+        self.tokens_decoded += n_active
+        self._m_steps.inc()
+        self._m_tokens.inc(n_active)
         for s in np.nonzero(active)[0]:
             req = self._slots[s]
             req.tokens.append(int(nxt[s]))
             self._tok[s] = nxt[s]
             self._done[s] = done[s]
             if done[s] or len(req.tokens) >= req.max_new:
-                self._retire(s)
+                self._retire(s, "eos" if done[s] else "max_new")
         self._admit()                     # splice into freed slots NOW
+        self._sample_gauges()
+        dt = time.perf_counter() - t0
+        self._run_seconds += dt           # np.asarray above synced: real
+        self._m_step.observe(dt)
         return True
 
     def run(self):
-        """Drive to completion; returns ``{rid: generated ids}``."""
-        t0 = time.perf_counter()
+        """Drive to completion; returns ``{rid: generated ids}``.
+        Timing accumulates per :meth:`step` call, so ``stats()`` rates
+        are identical however the loop is driven."""
         while self._queue or any(r is not None for r in self._slots):
             progressed = self.step()
             if not progressed and self._queue:
                 raise RuntimeError(
                     "serving deadlock: queued work but nothing active "
                     "— a request too large for the current pool")
-        self._run_seconds += time.perf_counter() - t0
         out, self._results = self._results, {}
         return out
 
@@ -496,9 +604,20 @@ class PagedServingEngine:
         }
 
     def stats(self):
+        """Engine counters + rate + latency digests.  ``tokens_per_s``
+        divides by per-``step()`` accumulated wall time (each step call
+        ends on a host sync), so it is correct for callers that drive
+        ``step()`` directly as well as for ``run()``.  The full metric
+        series live in ``self.metrics.snapshot()``."""
         dt = max(self._run_seconds, 1e-9)
         return {"decode_steps": self.decode_steps,
                 "tokens_decoded": self.tokens_decoded,
+                "run_seconds": self._run_seconds,
                 "tokens_per_s": self.tokens_decoded / dt,
                 "compiles": self.compile_counts(),
-                "occupancy": self.occupancy()}
+                "occupancy": self.occupancy(),
+                "latency": {
+                    "queue_wait_s": self._m_queue_wait.summary(),
+                    "ttft_s": self._m_ttft.summary(),
+                    "per_output_token_s": self._m_tpot.summary(),
+                    "step_s": self._m_step.summary()}}

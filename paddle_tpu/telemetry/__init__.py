@@ -1,0 +1,62 @@
+"""Unified telemetry: metrics, spans, exporters, and the serving /
+training instrumentation that feeds them.
+
+The reference's observability layer (``REGISTER_TIMER``/``StatSet``,
+``paddle/utils/Stat.h`` + the trainer's periodic stat dump) rebuilt for
+the serving era — continuous batching is operated by per-request
+latency accounting (TTFT, time-per-output-token, queue wait), none of
+which an ad-hoc counter can carry.  Pieces:
+
+* :class:`MetricsRegistry` (``metrics.py``) — process-wide, labeled,
+  thread-safe counters/gauges/fixed-bucket histograms with a stable
+  ``snapshot()`` dict schema;
+* :func:`span` (``spans.py``) — nesting host timers that feed the
+  ``span_seconds`` histogram AND forward to
+  ``jax.profiler.TraceAnnotation`` so host spans line up with XPlane
+  device traces; :func:`trace`/:func:`start`/:func:`stop` capture the
+  device side (``utils/profiler.py`` is now a shim over these);
+* exporters (``export.py``) — JSONL append-writer (one snapshot per
+  line; ``bench.py``/``benchmark/lm_decode.py`` emit BENCH rows through
+  the same stream), Prometheus text format, console summary, plus
+  :func:`validate_snapshot` (the CI schema gate) and
+  :func:`diff_snapshots`;
+* instrumentation lives in the hot paths themselves —
+  ``serving.PagedServingEngine`` (queue-wait/TTFT/per-output-token
+  histograms, admission/retire counters, occupancy gauges, compile
+  events via ``CompileWatcher``) and ``training.Trainer`` (step-time
+  histogram, tokens/s, MFU, eval/checkpoint spans);
+* ``paddle_tpu telemetry`` CLI (``cli.py``) — pretty-print or diff
+  JSONL snapshot files;
+* the CI gate (``selfcheck.py``, wired into ``ci.sh``) — drives an
+  instrumented paged-serving smoke, validates the snapshot schema,
+  bounds the per-observation overhead, and re-lints the instrumented
+  entrypoints (``host-callback-in-loop`` must stay silent).
+
+The one hard rule: telemetry is HOST-SIDE.  No metric update, span, or
+callback may live inside a jitted program — tpu-lint's
+``host-callback-in-loop`` rule is the enforcement mechanism, and the
+``compiles == 1`` serving contract proves instrumentation does not
+perturb tracing.  Catalog and schema: ``docs/design/telemetry.md``.
+"""
+
+from paddle_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                          MetricsRegistry,
+                                          DEFAULT_LATENCY_BUCKETS,
+                                          SCHEMA_VERSION,
+                                          approx_quantile, get_registry,
+                                          set_registry)
+from paddle_tpu.telemetry.spans import (SPAN_METRIC, current_span, span,
+                                        start, stop, trace)
+from paddle_tpu.telemetry.export import (append_jsonl, bench_row,
+                                         console_summary, diff_snapshots,
+                                         emit_row, prometheus_text,
+                                         read_jsonl, validate_snapshot)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "DEFAULT_LATENCY_BUCKETS", "SCHEMA_VERSION", "approx_quantile",
+    "get_registry", "set_registry",
+    "span", "current_span", "trace", "start", "stop", "SPAN_METRIC",
+    "append_jsonl", "read_jsonl", "prometheus_text", "console_summary",
+    "validate_snapshot", "diff_snapshots", "emit_row", "bench_row",
+]

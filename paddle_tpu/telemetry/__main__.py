@@ -1,0 +1,9 @@
+"""``python -m paddle_tpu.telemetry`` — the telemetry CLI module form
+(same surface as ``python -m paddle_tpu telemetry``)."""
+
+import sys
+
+from paddle_tpu.telemetry.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""``paddle_tpu telemetry`` — inspect and diff JSONL snapshot files.
+
+Two spellings, one implementation::
+
+    python -m paddle_tpu telemetry show  run.jsonl [--index -1] [--prom]
+    python -m paddle_tpu telemetry diff  run.jsonl            # last two
+    python -m paddle_tpu telemetry diff  a.jsonl b.jsonl      # last of each
+    python -m paddle_tpu.telemetry ...                        # module form
+
+``show`` pretty-prints one snapshot record (console table by default,
+``--prom`` for Prometheus text, ``--json`` for the raw snapshot);
+``diff`` subtracts two snapshots of the same registry — counters and
+histogram count/sum as deltas, gauges as old -> new — which is how a
+benchmark run's JSONL stream turns into "what changed between these two
+points" without a dashboard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+__all__ = ["main"]
+
+
+def _load_record(path: str, index: int) -> dict:
+    from paddle_tpu.telemetry.export import read_jsonl
+    records = read_jsonl(path)
+    if not records:
+        raise SystemExit(f"{path}: no snapshot records")
+    try:
+        rec = records[index]
+    except IndexError:
+        raise SystemExit(
+            f"{path}: index {index} out of range ({len(records)} records)")
+    if "snapshot" not in rec:
+        raise SystemExit(f"{path}: record {index} carries no snapshot")
+    return rec
+
+
+def _meta_line(rec: dict) -> str:
+    meta = rec.get("meta") or {}
+    extras = f" meta={json.dumps(meta, sort_keys=True)}" if meta else ""
+    return f"ts={rec.get('ts', 0.0):.3f}{extras}"
+
+
+def cmd_show(args) -> int:
+    from paddle_tpu.telemetry.export import (console_summary,
+                                             prometheus_text)
+    rec = _load_record(args.path, args.index)
+    snap = rec["snapshot"]
+    if args.json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    elif args.prom:
+        sys.stdout.write(prometheus_text(snap))
+    else:
+        print(f"# {args.path}[{args.index}] {_meta_line(rec)}")
+        print(console_summary(snap))
+    return 0
+
+
+def _render_diff(diff: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    if not diff:
+        print("no differences", file=out)
+        return
+    from paddle_tpu.telemetry.export import _fmt_labels  # shared look
+    for name, entry in sorted(diff.items()):
+        for s in entry["series"]:
+            lbl = _fmt_labels(s["labels"])
+            if entry["type"] == "counter":
+                print(f"counter   {name}{lbl} +{s['delta']:g}", file=out)
+            elif entry["type"] == "gauge":
+                old = "-" if s["old"] is None else f"{s['old']:g}"
+                print(f"gauge     {name}{lbl} {old} -> {s['new']:g}",
+                      file=out)
+            else:
+                print(f"histogram {name}{lbl} +{s['delta_count']} obs, "
+                      f"avg {s['delta_avg']:.6g}, p50 {s['p50']:.6g}",
+                      file=out)
+
+
+def cmd_diff(args) -> int:
+    from paddle_tpu.telemetry.export import diff_snapshots
+    if args.path_b:
+        old = _load_record(args.path, args.index)
+        new = _load_record(args.path_b, args.index_b)
+        names = (args.path, args.path_b)
+    else:
+        # one file: adjacent records (default: the last two lines)
+        old = _load_record(args.path, args.index
+                           if args.index != -1 else -2)
+        new = _load_record(args.path, args.index_b)
+        names = (f"{args.path}[old]", f"{args.path}[new]")
+    diff = diff_snapshots(old["snapshot"], new["snapshot"])
+    if args.json:
+        print(json.dumps(diff, indent=2, sort_keys=True))
+        return 0
+    print(f"# {names[0]} ({_meta_line(old)})")
+    print(f"# -> {names[1]} ({_meta_line(new)})")
+    _render_diff(diff)
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu telemetry",
+        description="pretty-print or diff telemetry JSONL snapshots")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("show", help="render one snapshot record")
+    p.add_argument("path", help="JSONL file written by append_jsonl")
+    p.add_argument("--index", type=int, default=-1,
+                   help="record index (default: last line)")
+    p.add_argument("--prom", action="store_true",
+                   help="Prometheus text format instead of the table")
+    p.add_argument("--json", action="store_true",
+                   help="raw snapshot JSON")
+    p.set_defaults(fn=cmd_show)
+
+    p = sub.add_parser("diff", help="delta between two snapshots")
+    p.add_argument("path", help="JSONL file (old side)")
+    p.add_argument("path_b", nargs="?", default=None,
+                   help="second file (new side); omitted = same file, "
+                        "adjacent records")
+    p.add_argument("--index", type=int, default=-1,
+                   help="old record index (default: -2 single-file, "
+                        "-1 two-file)")
+    p.add_argument("--index-b", type=int, default=-1,
+                   help="new record index (default: last line)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable diff")
+    p.set_defaults(fn=cmd_diff)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Exporters: every renderer reads ``MetricsRegistry.snapshot()``.
+
+Three output forms, one schema (validated here, documented in
+``docs/design/telemetry.md``):
+
+* **JSONL** — ``append_jsonl(path, snapshot, meta=...)`` writes one
+  record per line (``{"ts", "meta", "snapshot"}``); ``read_jsonl``
+  round-trips.  ``bench.py`` / ``benchmark/lm_decode.py`` ride the same
+  writer for their BENCH rows (``emit_row``), so dense and ``--paged``
+  rows — and any engine snapshot — share one machine-readable stream.
+* **Prometheus text format** — ``prometheus_text(snapshot)`` renders
+  the classic exposition format (counters/gauges verbatim, histograms
+  as cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``) for a
+  scrape endpoint or a pushgateway.
+* **Console** — ``console_summary(snapshot)``: the human table, with
+  bucket-estimated p50/p95/p99 for histograms (the ``StatSet
+  print_status`` of this layer).
+
+``validate_snapshot`` is the CI contract: the telemetry gate in
+``ci.sh`` runs an instrumented paged-serving smoke and feeds its
+snapshot through it, so an exporter and the registry cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+from typing import IO, List, Optional
+
+from paddle_tpu.telemetry.metrics import (SCHEMA_VERSION, approx_quantile)
+
+__all__ = ["validate_snapshot", "append_jsonl", "read_jsonl",
+           "prometheus_text", "console_summary", "emit_row",
+           "bench_row", "diff_snapshots"]
+
+
+# ------------------------------------------------------------- validation
+
+
+def _fail(msg: str):
+    raise ValueError(f"telemetry snapshot invalid: {msg}")
+
+
+def _check_labels(labels, where: str):
+    if not isinstance(labels, dict):
+        _fail(f"{where}: labels must be a dict, got {type(labels).__name__}")
+    for k, v in labels.items():
+        if not isinstance(k, str) or not isinstance(v, str):
+            _fail(f"{where}: label {k!r}={v!r} must be str->str "
+                  "(stringify at observation time)")
+
+
+def _check_number(v, where: str, allow_none: bool = False):
+    if v is None and allow_none:
+        return
+    if not isinstance(v, (int, float)) or isinstance(v, bool) \
+            or (isinstance(v, float) and not math.isfinite(v)):
+        _fail(f"{where}: expected a finite number, got {v!r}")
+
+
+def validate_snapshot(snapshot: dict) -> dict:
+    """Check ``snapshot`` against the documented schema; returns it
+    unchanged so call sites can chain.  Raises ``ValueError`` with the
+    first violation — the CI telemetry gate's teeth."""
+    if not isinstance(snapshot, dict):
+        _fail(f"top level must be a dict, got {type(snapshot).__name__}")
+    if snapshot.get("schema_version") != SCHEMA_VERSION:
+        _fail(f"schema_version {snapshot.get('schema_version')!r} != "
+              f"{SCHEMA_VERSION}")
+    if not isinstance(snapshot.get("registry"), str):
+        _fail("missing registry name")
+    metrics = snapshot.get("metrics")
+    if not isinstance(metrics, dict):
+        _fail("metrics must be a dict")
+    for name, entry in metrics.items():
+        kind = entry.get("type")
+        if kind not in ("counter", "gauge", "histogram"):
+            _fail(f"{name}: unknown type {kind!r}")
+        if not isinstance(entry.get("help"), str):
+            _fail(f"{name}: help must be a string")
+        series = entry.get("series")
+        if not isinstance(series, list):
+            _fail(f"{name}: series must be a list")
+        if kind == "histogram":
+            bounds = entry.get("bounds")
+            if (not isinstance(bounds, list) or not bounds
+                    or any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:]))):
+                _fail(f"{name}: bounds must be a non-empty strictly "
+                      "increasing list")
+        for i, s in enumerate(series):
+            where = f"{name}[{i}]"
+            if not isinstance(s, dict):
+                _fail(f"{where}: series entry must be a dict")
+            _check_labels(s.get("labels"), where)
+            if kind in ("counter", "gauge"):
+                _check_number(s.get("value"), f"{where}.value")
+            else:
+                _check_number(s.get("count"), f"{where}.count")
+                _check_number(s.get("sum"), f"{where}.sum")
+                _check_number(s.get("min"), f"{where}.min", allow_none=True)
+                _check_number(s.get("max"), f"{where}.max", allow_none=True)
+                counts = s.get("counts")
+                if (not isinstance(counts, list)
+                        or len(counts) != len(entry["bounds"]) + 1):
+                    _fail(f"{where}: counts must have len(bounds)+1 "
+                          "entries (last = overflow)")
+                if sum(counts) != s["count"]:
+                    _fail(f"{where}: bucket counts sum to {sum(counts)} "
+                          f"but count is {s['count']}")
+    return snapshot
+
+
+# ------------------------------------------------------------------ JSONL
+
+
+def append_jsonl(path: str, snapshot: dict, meta: Optional[dict] = None,
+                 ts: Optional[float] = None) -> dict:
+    """Validate + append ONE record line ``{"ts", "meta", "snapshot"}``
+    to ``path``.  Append-only by design: a crashed run leaves every
+    prior snapshot readable, and ``telemetry diff`` works off adjacent
+    lines.  Returns the record."""
+    validate_snapshot(snapshot)
+    record = {"ts": time.time() if ts is None else float(ts),
+              "meta": dict(meta or {}), "snapshot": snapshot}
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse every record line; each snapshot is re-validated so a
+    hand-edited file fails loudly here rather than deep in a diff."""
+    records = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: not JSON: {e}") from e
+            if "snapshot" in rec:
+                validate_snapshot(rec["snapshot"])
+            records.append(rec)
+    return records
+
+
+# ---------------------------------------------------------- BENCH rows
+
+
+def bench_row(metric: str, value: float, unit: str, **extra) -> dict:
+    """The shared benchmark row shape: ``metric``/``value``/``unit``
+    are mandatory (the driver's BENCH schema); extras ride along.  The
+    dense and ``--paged`` decode rows build through here so the two can
+    never diverge on the keys the crossover analysis joins on."""
+    row = {"metric": str(metric), "value": value, "unit": str(unit)}
+    row.update(extra)
+    return row
+
+
+def emit_row(row: dict, stream: Optional[IO[str]] = None) -> dict:
+    """Print one BENCH-style JSON row line (schema-checked: ``metric``
+    and ``unit`` must be present).  ``bench.py`` and
+    ``benchmark/lm_decode.py`` route their rows through here."""
+    missing = [k for k in ("metric", "unit") if k not in row]
+    if missing:
+        raise ValueError(f"bench row missing key(s) {missing}: {row}")
+    out = stream if stream is not None else sys.stdout
+    print(json.dumps(row), file=out, flush=True)
+    return row
+
+
+# ----------------------------------------------------- Prometheus text
+
+
+def _esc(value: str) -> str:
+    return (value.replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _labels_text(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = {**labels, **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_esc(str(v))}"'
+                     for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _num(v: float) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render the classic text exposition format.  Histogram buckets
+    come out CUMULATIVE with an explicit ``+Inf`` bucket, per the
+    format; the snapshot stores them non-cumulative."""
+    validate_snapshot(snapshot)
+    lines = []
+    for name, entry in snapshot["metrics"].items():
+        kind = entry["type"]
+        if entry["help"]:
+            lines.append(f"# HELP {name} {entry['help']}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind in ("counter", "gauge"):
+            for s in entry["series"]:
+                lines.append(
+                    f"{name}{_labels_text(s['labels'])} {_num(s['value'])}")
+            continue
+        bounds = entry["bounds"]
+        for s in entry["series"]:
+            cum = 0
+            for bound, c in zip(bounds, s["counts"]):
+                cum += c
+                le = _labels_text(s["labels"], {"le": _num(float(bound))})
+                lines.append(f"{name}_bucket{le} {cum}")
+            inf = _labels_text(s["labels"], {"le": "+Inf"})
+            lines.append(f"{name}_bucket{inf} {s['count']}")
+            lt = _labels_text(s["labels"])
+            lines.append(f"{name}_sum{lt} {_num(s['sum'])}")
+            lines.append(f"{name}_count{lt} {s['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- console
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) \
+        + "}"
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def console_summary(snapshot: dict) -> str:
+    """Human table of one snapshot — counters/gauges as name=value,
+    histograms with count/avg and bucket-estimated p50/p95/p99."""
+    validate_snapshot(snapshot)
+    lines = [f"===== telemetry[{snapshot['registry']}] ====="]
+    for name, entry in snapshot["metrics"].items():
+        kind = entry["type"]
+        if kind in ("counter", "gauge"):
+            for s in entry["series"]:
+                lines.append(f"{kind:<9} {name}{_fmt_labels(s['labels'])}"
+                             f" = {_fmt(s['value'])}")
+            continue
+        bounds = entry["bounds"]
+        for s in entry["series"]:
+            count = s["count"]
+            avg = s["sum"] / count if count else None
+            q = {p: approx_quantile(bounds, s["counts"], p / 100)
+                 for p in (50, 95, 99)}
+            lines.append(
+                f"histogram {name}{_fmt_labels(s['labels'])}: "
+                f"count={count} avg={_fmt(avg)} p50={_fmt(q[50])} "
+                f"p95={_fmt(q[95])} p99={_fmt(q[99])} "
+                f"max={_fmt(s['max'])}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- diff
+
+
+def diff_snapshots(old: dict, new: dict) -> dict:
+    """Per-series deltas between two snapshots of the same registry:
+    counters and histogram count/sum subtract; gauges report old -> new.
+    Series or metrics present only in ``new`` diff against zero/absent.
+    Returns ``{name: [{"labels", ...delta fields...}]}`` — the
+    ``paddle_tpu telemetry diff`` payload."""
+    validate_snapshot(old)
+    validate_snapshot(new)
+
+    def series_map(entry):
+        return {tuple(sorted(s["labels"].items())): s
+                for s in entry["series"]}
+
+    out = {}
+    for name, entry in new["metrics"].items():
+        kind = entry["type"]
+        olds = series_map(old["metrics"].get(name, {"series": []}))
+        rows = []
+        for s in entry["series"]:
+            key = tuple(sorted(s["labels"].items()))
+            prev = olds.get(key)
+            if kind == "counter":
+                delta = s["value"] - (prev["value"] if prev else 0.0)
+                if delta:
+                    rows.append({"labels": s["labels"], "delta": delta})
+            elif kind == "gauge":
+                before = prev["value"] if prev else None
+                if before != s["value"]:
+                    rows.append({"labels": s["labels"], "old": before,
+                                 "new": s["value"]})
+            else:
+                dc = s["count"] - (prev["count"] if prev else 0)
+                if dc:
+                    ds = s["sum"] - (prev["sum"] if prev else 0.0)
+                    dcounts = [b - (a if prev else 0) for b, a in zip(
+                        s["counts"],
+                        prev["counts"] if prev else [0] * len(s["counts"]))]
+                    rows.append({"labels": s["labels"], "delta_count": dc,
+                                 "delta_sum": ds,
+                                 "delta_avg": ds / dc,
+                                 "p50": approx_quantile(
+                                     entry["bounds"], dcounts, 0.5)})
+        if rows:
+            out[name] = {"type": kind, "series": rows}
+    return out

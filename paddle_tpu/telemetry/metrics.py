@@ -1,0 +1,333 @@
+"""Process-wide metrics: counters, gauges, fixed-bucket histograms.
+
+The reference shipped a real observability core — ``REGISTER_TIMER`` /
+``StatSet`` (``paddle/utils/Stat.h:63-234``) dumped by the trainer's
+periodic ``printSegTimerStatus()`` — and the serving/training stack
+here needs the same thing grown up: continuous-batching engines live or
+die by per-request latency accounting (TTFT, time-per-output-token,
+queue wait under admission churn), and none of that is measurable from
+ad-hoc counters.
+
+Design constraints, in order:
+
+* **Host-side only.**  Nothing here may cross a jit boundary: a metric
+  update inside a traced program would either burn a host callback into
+  the loop body (the exact program shape the ``host-callback-in-loop``
+  lint rule rejects) or silently record tracer values.  Instrumented
+  code observes AFTER device values come home (``np.asarray`` /
+  ``int()`` syncs), never inside ``jit``.
+* **Thread-safe.**  One lock per registry, shared by its metrics, so a
+  ``snapshot()`` is a consistent cut even while serving threads write.
+* **Snapshot-able to a stable dict schema.**  ``snapshot()`` is the one
+  wire format; every exporter (JSONL, Prometheus text, console) renders
+  from it and ``export.validate_snapshot`` checks it in CI, so the
+  schema cannot drift silently.
+* **Fixed buckets.**  Histograms are classic fixed-upper-bound
+  (Prometheus-style ``le``) so snapshots merge/diff by plain addition
+  and the renderer never re-bins.
+
+Labels are passed as keyword arguments at observation time::
+
+    reg = MetricsRegistry("serving")
+    reg.counter("requests_total").inc(reason="eos")
+    reg.gauge("pool_occupancy_fraction").set(0.4)
+    reg.histogram("ttft_seconds").observe(0.031)
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "get_registry", "set_registry", "SCHEMA_VERSION",
+           "DEFAULT_LATENCY_BUCKETS", "approx_quantile"]
+
+#: Bump when the snapshot dict layout changes; validate_snapshot and the
+#: CI telemetry gate pin it.
+SCHEMA_VERSION = 1
+
+#: Wall-time seconds: sub-millisecond host hops up through multi-second
+#: compiles.  The serving latency metrics and ``span`` share these.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _label_key(labels: Mapping[str, object]) -> Tuple[Tuple[str, str], ...]:
+    """Canonical (sorted, stringified) form — the series dict key."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def approx_quantile(bounds: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Quantile estimate from fixed-bucket counts (linear within the
+    bucket, like Prometheus ``histogram_quantile``).  The overflow
+    bucket has no upper bound — its estimate clamps to the last bound.
+    None when the histogram is empty."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if acc + c >= rank:
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            lo = bounds[i - 1] if 0 < i <= len(bounds) else 0.0
+            return lo + (hi - lo) * max(0.0, min(1.0, (rank - acc) / c))
+        acc += c
+    return bounds[-1]
+
+
+class _Metric:
+    """Base: a named family of label-keyed series under the registry's
+    lock (shared so ``snapshot`` cuts all families consistently)."""
+
+    kind = "abstract"
+
+    def __init__(self, name: str, help_: str, lock: threading.RLock):
+        self.name = name
+        self.help = help_
+        self._lock = lock
+        self._series: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+    def _snapshot_series(self):
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    """Monotonically increasing float per label set."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError(
+                f"counter {self.name}: negative increment {value} — "
+                "counters only go up; use a Gauge for levels")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(value)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+    def _snapshot_series(self):
+        return [{"labels": dict(k), "value": v}
+                for k, v in sorted(self._series.items())]
+
+
+class Gauge(_Metric):
+    """Last-write-wins level per label set."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(value)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            v = self._series.get(_label_key(labels))
+            return None if v is None else float(v)
+
+    def _snapshot_series(self):
+        return [{"labels": dict(k), "value": v}
+                for k, v in sorted(self._series.items())]
+
+
+class _HistSeries:
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self, n_bounds: int):
+        self.counts = [0] * (n_bounds + 1)   # + overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+
+class Histogram(_Metric):
+    """Fixed-upper-bound buckets (``value <= bound``, Prometheus ``le``
+    semantics) plus count/sum/min/max per label set.  Bucket counts are
+    NON-cumulative in the snapshot; renderers that need cumulative
+    (Prometheus text) accumulate at render time."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help_, lock, buckets=DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help_, lock)
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError(
+                f"histogram {name}: buckets must be non-empty and "
+                f"strictly increasing, got {bounds}")
+        self.bounds = bounds
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.bounds))
+            s.counts[idx] += 1
+            s.count += 1
+            s.sum += value
+            s.min = min(s.min, value)
+            s.max = max(s.max, value)
+
+    def summary(self, **labels) -> Dict[str, Optional[float]]:
+        """count/sum/avg/min/max/p50/p95/p99 for one label set (zeros /
+        Nones when nothing was observed) — the console and ``stats()``
+        digest."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None:
+                return {"count": 0, "sum": 0.0, "avg": None, "min": None,
+                        "max": None, "p50": None, "p95": None, "p99": None}
+            counts = list(s.counts)
+            count, total = s.count, s.sum
+            mn, mx = s.min, s.max
+        return {"count": count, "sum": total,
+                "avg": total / count if count else None,
+                "min": mn if count else None,
+                "max": mx if count else None,
+                "p50": approx_quantile(self.bounds, counts, 0.50),
+                "p95": approx_quantile(self.bounds, counts, 0.95),
+                "p99": approx_quantile(self.bounds, counts, 0.99)}
+
+    def _snapshot_series(self):
+        out = []
+        for k, s in sorted(self._series.items()):
+            out.append({"labels": dict(k), "count": s.count,
+                        "sum": s.sum,
+                        "min": s.min if s.count else None,
+                        "max": s.max if s.count else None,
+                        "counts": list(s.counts)})
+        return out
+
+
+class MetricsRegistry:
+    """Named, thread-safe home of a process's metric families.
+
+    Metric getters REGISTER on first use and return the existing family
+    after that — instrumented code never needs a separate registration
+    phase, and two call sites naming the same metric share one family
+    (a kind or bucket mismatch between them raises loudly)."""
+
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    # ------------------------------------------------------------ getters
+
+    def _get(self, name: str, kind, help_, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+                return m
+        if not isinstance(m, kind):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {kind.kind}")
+        if help_ and not m.help:
+            m.help = help_
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, help,
+                         lambda: Counter(name, help, self._lock))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, help,
+                         lambda: Gauge(name, help, self._lock))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_LATENCY_BUCKETS) -> Histogram:
+        h = self._get(name, Histogram, help,
+                      lambda: Histogram(name, help, self._lock, buckets))
+        if tuple(float(b) for b in buckets) != h.bounds:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.bounds}; a second registration may not re-bin")
+        return h
+
+    # ---------------------------------------------------------- lifecycle
+
+    def reset(self) -> None:
+        """Drop every metric family (tests / per-run isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """One consistent cut of every family, in the STABLE schema all
+        exporters render from (``docs/design/telemetry.md``)::
+
+            {"schema_version": 1, "registry": <name>, "metrics": {
+                <name>: {"type": "counter"|"gauge", "help": str,
+                         "series": [{"labels": {...}, "value": f}]},
+                <name>: {"type": "histogram", "help": str,
+                         "bounds": [...],
+                         "series": [{"labels": {...}, "count": n,
+                                     "sum": f, "min": f|None,
+                                     "max": f|None,
+                                     "counts": [...]}]}}}
+
+        Histogram ``counts`` has ``len(bounds) + 1`` entries (the last
+        is the overflow bucket) and sums to ``count``."""
+        with self._lock:
+            out = {}
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                entry = {"type": m.kind, "help": m.help,
+                         "series": m._snapshot_series()}
+                if isinstance(m, Histogram):
+                    entry["bounds"] = list(m.bounds)
+                out[name] = entry
+        return {"schema_version": SCHEMA_VERSION, "registry": self.name,
+                "metrics": out}
+
+
+_default = MetricsRegistry("global")
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry — what instrumented subsystems
+    (serving engine, trainer, spans) write to unless handed their own."""
+    return _default
+
+
+def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process default (returns the previous one).  For embed
+    scenarios that own their export pipeline; tests prefer passing a
+    fresh registry to the component under test instead."""
+    global _default
+    prev, _default = _default, reg
+    return prev

@@ -1,0 +1,193 @@
+"""The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
+
+Four checks, each a hard failure (non-zero exit) when violated:
+
+1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
+   (fresh registry) drives real requests to completion; the snapshot
+   must carry the documented serving metrics with data in them
+   (TTFT/queue-wait/step histograms populated, occupancy gauges set,
+   retire counters matching request count) and the ``compiles ==
+   {'decode': 1}`` contract must still hold WITH instrumentation on —
+   proof telemetry did not perturb tracing.
+2. **Schema + exporters** — the live snapshot passes
+   :func:`validate_snapshot`, round-trips through the JSONL writer,
+   and renders to Prometheus text containing the expected families.
+3. **Overhead bound** — per-observation cost of the hot-path calls
+   (counter inc, labeled histogram observe) stays under a generous
+   ceiling; a regression that makes metrics expensive enough to matter
+   fails here rather than silently taxing the serving loop.
+4. **Lint re-check** — the instrumented entrypoints (engine decode,
+   paged serve step, trainer step) re-trace through tpu-lint with ZERO
+   error-severity findings: ``host-callback-in-loop`` is the rule that
+   would fire if any metric update leaked inside a jitted program.
+
+Run on the CPU backend (``JAX_PLATFORMS=cpu``); wired into ``ci.sh``'s
+lint tier.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+# generous on purpose: CI machines are noisy, and the point is to catch
+# a 100x regression (an accidental device sync in observe()), not 2x
+MAX_SECONDS_PER_OBSERVATION = 50e-6
+_N_OVERHEAD = 20000
+
+#: Serving metric families the smoke run must populate — the documented
+#: catalog's load-bearing subset (docs/design/telemetry.md).
+REQUIRED_SERVING_METRICS = (
+    "serving_queue_wait_seconds",
+    "serving_ttft_seconds",
+    "serving_step_seconds",
+    "serving_decode_steps_total",
+    "serving_tokens_decoded_total",
+    "serving_submitted_total",
+    "serving_retired_total",
+    "serving_pool_occupancy_fraction",
+    "serving_pool_blocks_in_use",
+    "serving_slots_active",
+    "serving_compiles",
+)
+
+#: Entrypoints whose factories now construct INSTRUMENTED objects — the
+#: lint re-check proves instrumentation stayed host-side.
+INSTRUMENTED_ENTRYPOINTS = (
+    "paged-engine-decode",
+    "paged-serve-step",
+    "trainer-train-step",
+)
+
+
+def _fail(msg: str) -> None:
+    raise SystemExit(f"telemetry selfcheck FAILED: {msg}")
+
+
+def _check_serving_smoke():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models.transformer import TransformerConfig
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry import MetricsRegistry
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import TransformerLM
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    import jax
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+    reg = MetricsRegistry("selfcheck")
+    eng = PagedServingEngine(cfg, params, num_slots=2, num_blocks=8,
+                             block_size=8, prompt_buckets=(8,),
+                             metrics=reg)
+    rs = np.random.RandomState(0)
+    pr = rs.randint(0, cfg.vocab_size, (3, 6)).astype(np.int32)
+    n_req = 3
+    eng.submit(pr[0, :3], max_new=6)
+    eng.submit(pr[1, :5], max_new=4)
+    eng.submit(pr[2, :2], max_new=5)
+    results = eng.run()
+    if len(results) != n_req:
+        _fail(f"smoke run returned {len(results)} streams, wanted {n_req}")
+
+    compiles = eng.compile_counts()
+    if compiles.get("decode") != 1:
+        _fail("the compiles == {'decode': 1} contract broke WITH "
+              f"instrumentation on: {compiles}")
+
+    snap = reg.snapshot()
+    metrics = snap["metrics"]
+    missing = [m for m in REQUIRED_SERVING_METRICS if m not in metrics]
+    if missing:
+        _fail(f"snapshot missing documented serving metrics: {missing}")
+    for name in ("serving_queue_wait_seconds", "serving_ttft_seconds",
+                 "serving_step_seconds"):
+        total = sum(s["count"] for s in metrics[name]["series"])
+        if total == 0:
+            _fail(f"{name}: histogram empty after a real serving run")
+    ttft = sum(s["count"] for s in
+               metrics["serving_ttft_seconds"]["series"])
+    if ttft != n_req:
+        _fail(f"serving_ttft_seconds count {ttft} != {n_req} requests")
+    retired = sum(s["value"] for s in
+                  metrics["serving_retired_total"]["series"])
+    if retired != n_req:
+        _fail(f"serving_retired_total {retired} != {n_req} requests")
+    stats = eng.stats()
+    if stats["tokens_per_s"] <= 0:
+        _fail(f"stats tokens_per_s must be positive when driven via "
+              f"run(): {stats['tokens_per_s']}")
+    return snap
+
+
+def _check_exporters(snap):
+    from paddle_tpu.telemetry import (append_jsonl, prometheus_text,
+                                      read_jsonl, validate_snapshot)
+    validate_snapshot(snap)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "selfcheck.jsonl")
+        append_jsonl(path, snap, meta={"source": "selfcheck"})
+        records = read_jsonl(path)
+        if len(records) != 1 or records[0]["snapshot"] != snap:
+            _fail("JSONL round-trip did not reproduce the snapshot")
+    text = prometheus_text(snap)
+    for needle in ("# TYPE serving_ttft_seconds histogram",
+                   'serving_ttft_seconds_bucket{le="+Inf"}',
+                   "# TYPE serving_retired_total counter",
+                   "# TYPE serving_pool_occupancy_fraction gauge"):
+        if needle not in text:
+            _fail(f"prometheus text missing {needle!r}")
+
+
+def _check_overhead():
+    from paddle_tpu.telemetry import MetricsRegistry
+    reg = MetricsRegistry("overhead")
+    ctr = reg.counter("c")
+    hist = reg.histogram("h")
+    t0 = time.perf_counter()
+    for _ in range(_N_OVERHEAD):
+        ctr.inc(reason="x")
+        hist.observe(0.002, path="y")
+    per_op = (time.perf_counter() - t0) / (2 * _N_OVERHEAD)
+    if per_op > MAX_SECONDS_PER_OBSERVATION:
+        _fail(f"per-observation overhead {per_op * 1e6:.1f}us exceeds "
+              f"{MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us — something "
+              "heavy (a sync? I/O?) got onto the metrics hot path")
+    return per_op
+
+
+def _check_lint():
+    from paddle_tpu.analysis import lint_target, self_check_targets
+    errors = []
+    for target in self_check_targets(INSTRUMENTED_ENTRYPOINTS):
+        for f in lint_target(target):
+            if f.severity == "error":
+                errors.append(f"{target.name}: {f.rule_id}: {f.message}")
+    if errors:
+        _fail("instrumented entrypoints lint with errors (telemetry "
+              "must stay host-side):\n  " + "\n  ".join(errors))
+
+
+def main(argv=None) -> int:
+    snap = _check_serving_smoke()
+    print("selfcheck: serving smoke ok "
+          f"({len(snap['metrics'])} metric families, compiles==1)")
+    _check_exporters(snap)
+    print("selfcheck: schema + JSONL + prometheus exporters ok")
+    per_op = _check_overhead()
+    print(f"selfcheck: overhead ok ({per_op * 1e6:.2f}us/observation, "
+          f"bound {MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us)")
+    _check_lint()
+    print("selfcheck: tpu-lint re-check ok (0 errors on instrumented "
+          "entrypoints)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

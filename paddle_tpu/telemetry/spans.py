@@ -1,0 +1,112 @@
+"""Host spans that line up with device traces.
+
+``span(name)`` is the one annotation API: a context manager that
+
+* times the enclosed host region and feeds the wall time into the
+  ``span_seconds`` histogram (labeled with the span's full ``a/b/c``
+  nesting path, per-thread);
+* forwards the name to ``jax.profiler.TraceAnnotation`` so the SAME
+  region shows up as a named slice in an XPlane device trace — when a
+  capture is open (``trace(logdir)`` around the region), host spans and
+  device timelines align in TensorBoard/Perfetto.
+
+Spans nest: the path label is the slash-joined stack, so
+``span("trainer") > span("eval")`` records under ``trainer/eval`` and a
+snapshot diff can attribute time to phases without guessing.
+
+``trace``/``start``/``stop`` absorb ``utils/profiler.py`` (now a
+deprecated shim over this module): XPlane capture of the device side.
+
+Host side of the jit boundary, always: a span OUTSIDE ``jit`` times
+dispatch+sync like any wall clock; a span around code that runs INSIDE
+a traced function would record trace time once and then nothing — and
+anything that tried to observe per-iteration from inside the program
+would be exactly the ``host-callback-in-loop`` shape tpu-lint rejects.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+from paddle_tpu.telemetry.metrics import (MetricsRegistry, get_registry)
+
+__all__ = ["span", "current_span", "trace", "start", "stop",
+           "SPAN_METRIC"]
+
+#: The histogram every span feeds; one family, labeled by span path.
+SPAN_METRIC = "span_seconds"
+
+_local = threading.local()
+
+
+def _stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def current_span() -> Optional[str]:
+    """The innermost open span's full path on this thread, or None."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def _annotation(name: str):
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:          # no jax / no profiler: host timing only
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None,
+         **labels) -> Iterator[str]:
+    """Time a host region into ``span_seconds{span=<path>}`` and mirror
+    it into the device trace.  Yields the full nesting path.  Extra
+    keyword labels pass through to the histogram series."""
+    reg = registry if registry is not None else get_registry()
+    st = _stack()
+    path = f"{st[-1]}/{name}" if st else name
+    st.append(path)
+    t0 = time.perf_counter()
+    try:
+        with _annotation(name):
+            yield path
+    finally:
+        dt = time.perf_counter() - t0
+        popped = st.pop()
+        assert popped == path, "span stack corrupted (crossed threads?)"
+        reg.histogram(
+            SPAN_METRIC,
+            help="host wall time per span path (see telemetry.span)",
+        ).observe(dt, span=path, **labels)
+
+
+# ------------------------------------------------- XPlane device capture
+
+
+def start(logdir: str) -> None:
+    """Begin an XPlane trace capture into ``logdir`` (TensorBoard /
+    Perfetto viewable; works over tunneled attachments)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a device trace for the enclosed region."""
+    start(logdir)
+    try:
+        yield
+    finally:
+        stop()

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import optim as optim_lib
+from paddle_tpu import telemetry
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import transform
 from paddle_tpu.parallel import mesh as mesh_lib
@@ -42,12 +43,24 @@ class Trainer:
                  param_rules=None,
                  average_window: int = 0,
                  zero_axis: Optional[str] = None,
-                 batch_spec=None):
+                 batch_spec=None,
+                 metrics=None):
         """``batch_spec`` — PartitionSpec for batch leaves under a mesh
         (default: leading axis over ``dp``).  Non-dp-first topologies set
         it explicitly: ``P(None, "sp")`` shards sequence for a
         ring-attention trainer on an (sp, ep) mesh; ``P()`` replicates
-        (pipeline trainers split microbatches internally)."""
+        (pipeline trainers split microbatches internally).
+
+        ``metrics`` — a :class:`~paddle_tpu.telemetry.MetricsRegistry`
+        (default: the process-wide one).  The trainer feeds a
+        ``train_step_seconds`` histogram (``path=batch`` per-dispatch,
+        ``path=scan`` amortized per scanned step), batch/example/token
+        counters, a ``train_tokens_per_s`` gauge, and ``trainer/eval`` /
+        ``trainer/checkpoint`` spans.  All observations are host-side,
+        around — never inside — the jitted step.  Caveat: under JAX's
+        async dispatch a per-batch time measures dispatch unless the
+        caller syncs; the differential protocol in ``utils/timing.py``
+        remains the benchmark truth (``docs/design/telemetry.md``)."""
         self.model = transform(model_fn)
         self.optimizer = optimizer
         self.seed = seed
@@ -63,6 +76,21 @@ class Trainer:
         self.step = 0
         self._train_step = None
         self._eval_step = None
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.get_registry())
+        self._m_step = self.metrics.histogram(
+            "train_step_seconds",
+            "host wall time per train step (path=batch: one dispatch; "
+            "path=scan: scan wall time / k)")
+        self._m_batches = self.metrics.counter(
+            "train_batches_total", "train steps run")
+        self._m_examples = self.metrics.counter(
+            "train_examples_total", "examples consumed (leading batch dim)")
+        self._m_tokens = self.metrics.counter(
+            "train_tokens_total", "token positions consumed (ids elements)")
+        self._m_tps = self.metrics.gauge(
+            "train_tokens_per_s",
+            "tokens/s of the most recent step or scan chunk")
 
     # ``step`` is plain-int bookkeeping (checkpoints, logs); the jitted
     # step receives a DEVICE-RESIDENT twin incremented with a lazy add.
@@ -208,18 +236,42 @@ class Trainer:
         return self._grads_step(self.params, self.net_state,
                                 self._put(batch), self._step_array())
 
+    def _observe_step(self, batch, dt: float, k: int, path: str) -> None:
+        """Feed the step telemetry.  Shapes are static metadata — reading
+        them never syncs the device; only already-host timings flow in."""
+        leaves = jax.tree_util.tree_leaves(batch)
+        shape = tuple(leaves[0].shape) if leaves else ()
+        if not shape:
+            examples = 0
+        elif k > 1:          # stacked [k, B, ...] chunk
+            examples = int(np.prod(shape[:2]))
+        else:
+            examples = int(shape[0])
+        ids = batch.get("ids") if isinstance(batch, dict) else None
+        tokens = int(np.prod(np.shape(ids))) if ids is not None else 0
+        self._m_step.observe(dt / k, path=path)
+        self._m_batches.inc(k)
+        if examples:
+            self._m_examples.inc(examples)
+        if tokens:
+            self._m_tokens.inc(tokens)
+            if dt > 0:
+                self._m_tps.set(tokens / dt)
+
     def train_batch(self, batch: Dict[str, Any]):
         if self.params is None:
             self.init(batch)
         batch = self._put(batch)
         self._in_step = True
         step_arr = self._step_array()
+        t0 = time.perf_counter()
         try:
             (self.params, self.net_state, self.opt_state, loss,
              outputs) = self._train_step(self.params, self.net_state,
                                          self.opt_state, batch, step_arr)
         finally:
             self._in_step = False
+        self._observe_step(batch, time.perf_counter() - t0, 1, "batch")
         if self.average_window:
             self.avg_state = optim_lib.average.accumulate(
                 self.avg_state, self.params)
@@ -251,6 +303,7 @@ class Trainer:
         k = jax.tree_util.tree_leaves(batch_stack)[0].shape[0]
         step_arr = self._step_array()
         self._in_step = True
+        t0 = time.perf_counter()
         try:
             (self.params, self.net_state, self.opt_state,
              losses) = self._train_scan(self.params, self.net_state,
@@ -258,6 +311,8 @@ class Trainer:
                                         step_arr)
         finally:
             self._in_step = False
+        self._observe_step(batch_stack, time.perf_counter() - t0, int(k),
+                           "scan")
         self._step += int(k)
         self._step_dev = step_arr + k
         handler = getattr(self, "_preemption_handler", None)
@@ -320,6 +375,34 @@ class Trainer:
         return mfu_mod.compiled_flops(
             self._train_scan, self.params, self.net_state, self.opt_state,
             self._put(batch_stack, stacked=True), self._step_array())
+
+    def mfu_report(self, batch_stack: Dict[str, Any]) -> Optional[dict]:
+        """Model-FLOPs-utilization from XLA's cost analysis of the
+        compiled scan body and the OBSERVED ``train_step_seconds``
+        average (scan path preferred — it amortizes dispatch; per-batch
+        otherwise).  Feeds the ``train_mfu`` / ``train_flops_per_batch``
+        gauges and returns ``{"flops_per_batch", "seconds_per_step",
+        "mfu"}``, or None when the backend reports no cost analysis /
+        no peak (CPU) or nothing has been timed yet."""
+        from paddle_tpu.utils import mfu as mfu_mod
+        flops = self.train_scan_flops(batch_stack)
+        if flops is None:
+            return None
+        summ = self._m_step.summary(path="scan")
+        if not summ["count"]:
+            summ = self._m_step.summary(path="batch")
+        if not summ["count"]:
+            return None
+        self.metrics.gauge(
+            "train_flops_per_batch",
+            "XLA cost-analysis FLOPs of one scanned batch").set(flops)
+        value = mfu_mod.mfu(flops, summ["avg"])
+        if value is not None:
+            self.metrics.gauge(
+                "train_mfu",
+                "achieved fraction of peak matmul throughput").set(value)
+        return {"flops_per_batch": flops,
+                "seconds_per_step": summ["avg"], "mfu": value}
 
     def _put(self, batch, stacked: bool = False):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -396,9 +479,14 @@ class Trainer:
             results = {e.name: e.finish() for e in evaluators}
             results["loss"] = float(np.mean(costs)) if costs else 0.0
             if test_reader is not None:
-                results.update(self.test(test_reader, evaluators))
+                with telemetry.span("trainer/eval", registry=self.metrics,
+                                    pass_id=str(pass_id)):
+                    results.update(self.test(test_reader, evaluators))
             if save_dir is not None:
-                self.save(save_dir, pass_id)
+                with telemetry.span("trainer/checkpoint",
+                                    registry=self.metrics,
+                                    pass_id=str(pass_id)):
+                    self.save(save_dir, pass_id)
             handler(ev.EndPass(pass_id, results))
         return results
 

@@ -1,37 +1,18 @@
-"""Device profiling hooks.
+"""Device profiling hooks — DEPRECATED shim over ``paddle_tpu.telemetry``.
 
-Twin of ``hl_profiler_start/end`` (``cuda/include/hl_cuda.h:338-343``, which
-gated nvprof capture): thin wrappers over the JAX/XLA profiler producing
-XPlane traces viewable in TensorBoard/Perfetto.
+The original twin of ``hl_profiler_start/end``
+(``cuda/include/hl_cuda.h:338-343``) lives on in
+``paddle_tpu.telemetry.spans``: ``annotate`` is now ``telemetry.span``
+(same context-manager contract, plus the region's wall time lands in the
+``span_seconds`` histogram), and ``start``/``stop``/``trace`` re-export
+the XPlane capture wrappers unchanged.  Import from
+``paddle_tpu.telemetry`` in new code; this module stays only so existing
+call sites keep working.
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import Iterator, Optional
+from paddle_tpu.telemetry.spans import span as annotate
+from paddle_tpu.telemetry.spans import start, stop, trace
 
-import jax
-
-
-def start(logdir: str) -> None:
-    jax.profiler.start_trace(logdir)
-
-
-def stop() -> None:
-    jax.profiler.stop_trace()
-
-
-@contextlib.contextmanager
-def trace(logdir: str) -> Iterator[None]:
-    start(logdir)
-    try:
-        yield
-    finally:
-        stop()
-
-
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named region in the device trace (TraceAnnotation)."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
+__all__ = ["start", "stop", "trace", "annotate"]
