@@ -47,6 +47,23 @@ MFU_TARGET = 0.60   # BASELINE.json north star: >=60% of peak bf16 matmul
 # end-to-end on CPU in seconds.  Bench numbers come from the bare run.
 SMOKE = "--smoke" in sys.argv
 
+
+def _telemetry_out_arg():
+    """``--telemetry-out PATH`` (or ``--telemetry-out=PATH``) without
+    argparse — this harness keeps bare sys.argv flags."""
+    for i, a in enumerate(sys.argv):
+        if a == "--telemetry-out":
+            if i + 1 >= len(sys.argv):
+                print("--telemetry-out needs a PATH", file=sys.stderr)
+                sys.exit(2)
+            return sys.argv[i + 1]
+        if a.startswith("--telemetry-out="):
+            return a.split("=", 1)[1]
+    return None
+
+
+TELEMETRY_OUT = _telemetry_out_arg()
+
 LSTM_METRIC = ("stacked-LSTM cls train step, h=256 bs=64 "
                "seq=100 dict=30k")
 RESNET_METRIC = "ResNet-152 bs=128 s2d-stem train-step MFU"
@@ -256,6 +273,13 @@ def main():
             # a scraper can never record smoke output as real numbers
             row["smoke"] = True
         emit_row(row)
+        if TELEMETRY_OUT:
+            # snapshot per row, stamped with git_rev + jax version so a
+            # later `telemetry diff` knows which builds it compares
+            from paddle_tpu import telemetry
+            telemetry.append_jsonl(TELEMETRY_OUT,
+                                   telemetry.get_registry().snapshot(),
+                                   meta=telemetry.run_meta(**row))
         # reclaim the finished row's HBM (params/opt state/batches) only
         # after its frames are gone, before the next model builds
         gc.collect()

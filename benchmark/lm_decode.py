@@ -190,8 +190,10 @@ def main():
         for d in diffs:
             hist.observe(d, decoder=args.decoder,
                          paged=str(args.paged).lower())
+        # run_meta stamps git_rev + jax version next to the row, so a
+        # later `telemetry diff` knows which builds it is comparing
         telemetry.append_jsonl(args.telemetry_out, reg.snapshot(),
-                               meta=row)
+                               meta=telemetry.run_meta(**row))
     telemetry.emit_row(row)
 
 

@@ -48,12 +48,16 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \
     --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
-echo "== telemetry gate: instrumented smoke + schema + overhead + re-lint =="
-# Drives a real instrumented paged-serving run (compiles must stay
-# {'decode': 1} WITH telemetry on), validates the snapshot against the
-# documented schema through the JSONL/Prometheus exporters, bounds the
-# per-observation overhead, and re-lints the instrumented entrypoints —
-# host-callback-in-loop must report zero findings.
+echo "== telemetry gate: instrumented smoke + schema + trace + overhead + re-lint =="
+# Drives a real instrumented paged-serving run with the request-level
+# tracer ON (compiles must stay {'decode': 1} WITH telemetry AND
+# tracing on), validates the snapshot against the documented schema
+# through the JSONL/Prometheus exporters, round-trips the request
+# trace (JSONL + per-request waterfalls + Chrome trace-event export
+# structure), bounds the per-observation overhead (metric inc/observe
+# AND tracer event record under the same 50us ceiling), and re-lints
+# the instrumented entrypoints — host-callback-in-loop must report
+# zero findings.
 JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
 
 echo "== native libs =="

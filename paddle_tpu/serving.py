@@ -268,6 +268,18 @@ class PagedServingEngine:
     retire counters, per-step occupancy gauges, and compile events via
     the CompileWatcher — all strictly on the host side of the jitted
     step (catalog: ``docs/design/telemetry.md``).
+
+    ``tracer=`` additionally records the PER-REQUEST lifecycle
+    (submit → queue → prefill → per-step tokens → retire, one trace
+    track per slot plus the ``host`` admission track) into a
+    :class:`~paddle_tpu.telemetry.Tracer` ring buffer — exportable as
+    Chrome trace JSON and readable by ``paddle_tpu telemetry trace``.
+    ``flight_recorder=`` (a path) arms the crash dump: if ``step()`` or
+    ``run()`` raises, the last ``flight_window_s`` seconds of events
+    plus the engine's host state (:meth:`host_state`: slots, queue,
+    pool accounting, compile counts) are written there before the
+    exception propagates.  Arming the flight recorder without an
+    explicit tracer creates one internally.
     """
 
     def __init__(self, cfg: TransformerConfig, params, *,
@@ -275,7 +287,9 @@ class PagedServingEngine:
                  max_blocks_per_slot: Optional[int] = None,
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
-                 metrics=None):
+                 metrics=None, tracer=None,
+                 flight_recorder: Optional[str] = None,
+                 flight_window_s: float = 30.0):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -364,6 +378,18 @@ class PagedServingEngine:
         # per-step cost is a few dict-free increments.
         self.metrics = (metrics if metrics is not None
                         else telemetry.get_registry())
+        # Request-level tracing + flight recorder (telemetry/trace.py).
+        # Host-side like the metrics: every event is stamped after a
+        # device value already came home.  None = tracing off (the
+        # probe per event site is one attribute check).
+        if tracer is None and flight_recorder is not None:
+            tracer = telemetry.Tracer(
+                name="serving", flight_path=flight_recorder,
+                flight_window_s=flight_window_s)
+        elif tracer is not None and flight_recorder is not None:
+            tracer.flight_path = flight_recorder
+            tracer.flight_window_s = float(flight_window_s)
+        self.tracer = tracer
         m = self.metrics
         self._m_queue_wait = m.histogram(
             "serving_queue_wait_seconds",
@@ -434,9 +460,13 @@ class PagedServingEngine:
                 "(%d) — it could never be admitted", blocks, self.nb)
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new,
-                                    float(temperature), blocks))
+        req = _Request(rid, prompt, max_new, float(temperature), blocks)
+        self._queue.append(req)
         self._m_submitted.inc()
+        if self.tracer is not None:
+            self.tracer.instant("submit", track="host", rid=rid,
+                                ts=req.submitted_at, prompt_len=int(n),
+                                max_new=int(max_new))
         return rid
 
     def _split(self):
@@ -452,14 +482,30 @@ class PagedServingEngine:
                 slot = self._slots.index(None)
             except ValueError:
                 self._m_rejects.inc(reason="slots")
+                if self.tracer is not None:
+                    self.tracer.instant("admission_blocked",
+                                        track="host", reason="slots",
+                                        queued=len(self._queue))
                 return                    # all slots busy
             req = self._queue[0]
             if self._reserved + req.blocks_reserved > self.nb:
                 self._m_rejects.inc(reason="pool")
+                if self.tracer is not None:
+                    self.tracer.instant("admission_blocked",
+                                        track="host", reason="pool",
+                                        rid=req.rid,
+                                        queued=len(self._queue))
                 return                    # pool cannot take it yet
             self._queue.popleft()
-            self._m_queue_wait.observe(
-                time.perf_counter() - req.submitted_at)
+            t_admit = time.perf_counter()
+            self._m_queue_wait.observe(t_admit - req.submitted_at)
+            if self.tracer is not None:
+                # queue span sits on the slot's track so the request's
+                # waterfall reads top-to-bottom on one line
+                self.tracer.instant("admit", track="host", rid=req.rid,
+                                    ts=t_admit, slot=slot)
+                self.tracer.complete("queue", req.submitted_at, t_admit,
+                                     track=f"slot{slot}", rid=req.rid)
             width = min(w for w in self.buckets
                         if req.prompt.shape[0] <= w)
             padded = np.zeros((1, width), np.int32)
@@ -475,7 +521,18 @@ class PagedServingEngine:
             self._slots[slot] = req
             req.tokens.append(int(tok0))   # host sync: tok0 is REAL now
             req.first_token_at = time.perf_counter()
-            self._m_ttft.observe(req.first_token_at - req.submitted_at)
+            ttft = req.first_token_at - req.submitted_at
+            self._m_ttft.observe(ttft)
+            if self.tracer is not None:
+                self.tracer.complete("prefill", t_admit,
+                                     req.first_token_at,
+                                     track=f"slot{slot}", rid=req.rid,
+                                     prompt_len=req.prompt.shape[0],
+                                     bucket=width)
+                self.tracer.instant("first_token", track=f"slot{slot}",
+                                    rid=req.rid,
+                                    ts=req.first_token_at,
+                                    ttft_s=ttft)
             self._tok[slot] = req.tokens[-1]
             self._temps[slot] = req.temperature
             self._done[slot] = bool(done0)
@@ -486,10 +543,19 @@ class PagedServingEngine:
     def _retire(self, slot: int, reason: str = "max_new"):
         req = self._slots[slot]
         n = len(req.tokens)
+        t_retire = time.perf_counter()
         if n > 1 and req.first_token_at is not None:
             self._m_tpot.observe(
-                (time.perf_counter() - req.first_token_at) / (n - 1))
+                (t_retire - req.first_token_at) / (n - 1))
         self._m_retired.inc(reason=reason)
+        if self.tracer is not None:
+            if req.first_token_at is not None:
+                self.tracer.complete("decode", req.first_token_at,
+                                     t_retire, track=f"slot{slot}",
+                                     rid=req.rid, tokens=n)
+            self.tracer.instant("retire", track=f"slot{slot}",
+                                rid=req.rid, ts=t_retire,
+                                reason=reason, tokens=n)
         self._results[req.rid] = np.asarray(req.tokens, np.int32)
         self.cache = self._free(
             self.cache, jnp.asarray(np.arange(self.S) == slot))
@@ -519,7 +585,16 @@ class PagedServingEngine:
         Each call is timed into ``_run_seconds`` (and the
         ``serving_step_seconds`` histogram) HERE, so throughput
         accounting is correct whether callers drive :meth:`step`
-        directly or via :meth:`run`."""
+        directly or via :meth:`run`.  If the step raises and a flight
+        recorder is armed, the crash dump is written before the
+        exception propagates."""
+        try:
+            return self._step_impl()
+        except Exception as exc:
+            self._flight_dump(exc)
+            raise
+
+    def _step_impl(self):
         t0 = time.perf_counter()
         self._admit()
         active = np.asarray([r is not None for r in self._slots])
@@ -532,14 +607,23 @@ class PagedServingEngine:
         assert bool(ok), "paged pool exhausted despite admission " \
                          "accounting (engine bug)"
         nxt, done = np.asarray(nxt), np.asarray(done)
+        t_sync = time.perf_counter()      # np.asarray synced: tokens real
         self.decode_steps += 1
         n_active = int(active.sum())
         self.tokens_decoded += n_active
         self._m_steps.inc()
         self._m_tokens.inc(n_active)
+        if self.tracer is not None:
+            self.tracer.complete("decode_step", t0, t_sync, track="host",
+                                 n_active=n_active,
+                                 step=self.decode_steps)
         for s in np.nonzero(active)[0]:
             req = self._slots[s]
             req.tokens.append(int(nxt[s]))
+            if self.tracer is not None:
+                self.tracer.instant("token", track=f"slot{int(s)}",
+                                    rid=req.rid, ts=t_sync,
+                                    index=len(req.tokens) - 1)
             self._tok[s] = nxt[s]
             self._done[s] = done[s]
             if done[s] or len(req.tokens) >= req.max_new:
@@ -554,15 +638,64 @@ class PagedServingEngine:
     def run(self):
         """Drive to completion; returns ``{rid: generated ids}``.
         Timing accumulates per :meth:`step` call, so ``stats()`` rates
-        are identical however the loop is driven."""
+        are identical however the loop is driven.  A raise on the way
+        (from the step itself or the deadlock check) writes the flight
+        record first when one is armed."""
         while self._queue or any(r is not None for r in self._slots):
             progressed = self.step()
             if not progressed and self._queue:
-                raise RuntimeError(
+                exc = RuntimeError(
                     "serving deadlock: queued work but nothing active "
                     "— a request too large for the current pool")
+                self._flight_dump(exc)
+                raise exc
         out, self._results = self._results, {}
         return out
+
+    # --------------------------------------------------- flight recorder
+
+    def host_state(self) -> dict:
+        """JSON-safe engine host state for the flight recorder.  HOST
+        accounting only — no device sync (:meth:`occupancy` would block
+        on a device that may be the thing that just wedged)."""
+        return {
+            "slots": [None if r is None else {
+                "rid": r.rid,
+                "prompt_len": int(r.prompt.shape[0]),
+                "tokens": len(r.tokens),
+                "max_new": r.max_new,
+                "submitted_at": r.submitted_at,
+                "first_token_at": r.first_token_at,
+            } for r in self._slots],
+            "queue_depth": len(self._queue),
+            "queued_rids": [r.rid for r in self._queue],
+            "blocks_reserved_worst_case": self._reserved,
+            "pool_blocks": self.nb,
+            "block_size": self.bs,
+            "num_slots": self.S,
+            "compiles": self.compile_counts(),
+            "decode_steps": self.decode_steps,
+            "tokens_decoded": self.tokens_decoded,
+            "retired": len(self._results),
+        }
+
+    def _flight_dump(self, exc: BaseException):
+        """Write the crash dump once per exception object (``run()``
+        re-raises what ``step()`` already dumped).  Never raises."""
+        if self.tracer is None or self.tracer.flight_path is None:
+            return
+        if getattr(exc, "_ptpu_flight_dumped", False):
+            return
+        try:
+            exc._ptpu_flight_dumped = True
+        except Exception:
+            pass                          # exotic exception: dump anyway
+        try:
+            state = self.host_state()
+        except Exception:
+            state = {"error": "host_state() itself raised"}
+        self.tracer.dump_flight(
+            reason=f"{type(exc).__name__}: {exc}", state=state)
 
     # ------------------------------------------------------- reporting
 

@@ -20,6 +20,14 @@ which an ad-hoc counter can carry.  Pieces:
   the same stream), Prometheus text format, console summary, plus
   :func:`validate_snapshot` (the CI schema gate) and
   :func:`diff_snapshots`;
+* request-level tracing (``trace.py``) — :class:`Tracer`, a bounded
+  ring buffer of per-request/track events with Chrome trace-event JSON
+  export (:func:`chrome_trace`, loads in Perfetto) and a flight
+  recorder (:meth:`Tracer.dump_flight`) that snapshots the last N
+  seconds of events + engine host state when the serving engine raises
+  or the NaN localizer fires; trace records ride the same JSONL stream
+  (``append_trace_jsonl``) and ``paddle_tpu telemetry trace`` renders
+  the per-request waterfall;
 * instrumentation lives in the hot paths themselves —
   ``serving.PagedServingEngine`` (queue-wait/TTFT/per-output-token
   histograms, admission/retire counters, occupancy gauges, compile
@@ -47,10 +55,24 @@ from paddle_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                           set_registry)
 from paddle_tpu.telemetry.spans import (SPAN_METRIC, current_span, span,
                                         start, stop, trace)
-from paddle_tpu.telemetry.export import (append_jsonl, bench_row,
+from paddle_tpu.telemetry.export import (append_jsonl,
+                                         append_trace_jsonl, bench_row,
                                          console_summary, diff_snapshots,
                                          emit_row, prometheus_text,
-                                         read_jsonl, validate_snapshot)
+                                         read_jsonl, run_meta,
+                                         validate_snapshot)
+from paddle_tpu.telemetry.trace import (TRACE_SCHEMA_VERSION, Tracer,
+                                        chrome_trace, get_tracer,
+                                        request_waterfalls, set_tracer,
+                                        validate_chrome_trace,
+                                        validate_trace,
+                                        waterfall_summary)
+# Importing the trace SUBMODULE above rebinds the package attribute
+# ``trace`` from the spans XPlane-capture context manager to the
+# module.  The context manager is the long-standing public
+# ``telemetry.trace(logdir)`` API — restore it; reach the submodule via
+# ``paddle_tpu.telemetry.trace`` imports, or the re-exports here.
+from paddle_tpu.telemetry.spans import trace  # noqa: F811
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -59,4 +81,8 @@ __all__ = [
     "span", "current_span", "trace", "start", "stop", "SPAN_METRIC",
     "append_jsonl", "read_jsonl", "prometheus_text", "console_summary",
     "validate_snapshot", "diff_snapshots", "emit_row", "bench_row",
+    "append_trace_jsonl", "run_meta",
+    "Tracer", "TRACE_SCHEMA_VERSION", "chrome_trace", "get_tracer",
+    "set_tracer", "validate_trace", "validate_chrome_trace",
+    "request_waterfalls", "waterfall_summary",
 ]

@@ -1,10 +1,11 @@
 """``paddle_tpu telemetry`` — inspect and diff JSONL snapshot files.
 
-Two spellings, one implementation::
+Three spellings, one implementation::
 
     python -m paddle_tpu telemetry show  run.jsonl [--index -1] [--prom]
     python -m paddle_tpu telemetry diff  run.jsonl            # last two
     python -m paddle_tpu telemetry diff  a.jsonl b.jsonl      # last of each
+    python -m paddle_tpu telemetry trace run.jsonl [--chrome out.json]
     python -m paddle_tpu.telemetry ...                        # module form
 
 ``show`` pretty-prints one snapshot record (console table by default,
@@ -12,7 +13,11 @@ Two spellings, one implementation::
 ``diff`` subtracts two snapshots of the same registry — counters and
 histogram count/sum as deltas, gauges as old -> new — which is how a
 benchmark run's JSONL stream turns into "what changed between these two
-points" without a dashboard.
+points" without a dashboard.  ``trace`` renders the request waterfall
+of a trace (a JSONL stream carrying ``trace`` records, a ``Tracer``
+snapshot dumped whole, or a flight record): p50/p95 TTFT, queue wait,
+prefill/decode time, the slowest-K requests — and ``--chrome out.json``
+converts it to Chrome trace-event JSON for Perfetto.
 """
 
 from __future__ import annotations
@@ -94,13 +99,115 @@ def cmd_diff(args) -> int:
                            if args.index != -1 else -2)
         new = _load_record(args.path, args.index_b)
         names = (f"{args.path}[old]", f"{args.path}[new]")
-    diff = diff_snapshots(old["snapshot"], new["snapshot"])
+    try:
+        diff = diff_snapshots(old["snapshot"], new["snapshot"])
+    except ValueError as exc:
+        # mismatched registries (e.g. histogram bucket bounds changed
+        # between builds) is an operator error, not a crash
+        raise SystemExit(f"error: {exc}")
     if args.json:
         print(json.dumps(diff, indent=2, sort_keys=True))
         return 0
     print(f"# {names[0]} ({_meta_line(old)})")
     print(f"# -> {names[1]} ({_meta_line(new)})")
     _render_diff(diff)
+    return 0
+
+
+def _load_trace(path: str, index: int) -> dict:
+    """A trace snapshot from any of the shapes we write: a JSONL
+    stream with ``trace`` records (``append_trace_jsonl``), a whole
+    ``Tracer.snapshot()`` JSON dump, or a flight record."""
+    from paddle_tpu.telemetry.trace import validate_trace
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        raise SystemExit(f"{path}: empty file")
+    if _looks_whole_json(text):
+        doc = json.loads(text)
+        if doc.get("kind") == "flight_record":
+            return validate_trace(doc["trace"])
+        if "events" in doc:
+            return validate_trace(doc)
+        if "trace" in doc:
+            return validate_trace(doc["trace"])
+        raise SystemExit(f"{path}: no trace records (did you mean "
+                         "'telemetry show'?)")
+    # JSONL: pick the index-th record that carries a trace
+    traces = []
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            raise SystemExit(f"{path}:{ln}: not JSON ({exc})")
+        if isinstance(rec, dict) and "trace" in rec:
+            traces.append(rec["trace"])
+    if not traces:
+        raise SystemExit(f"{path}: no trace records (did you mean "
+                         "'telemetry show'?)")
+    try:
+        trace = traces[index]
+    except IndexError:
+        raise SystemExit(f"{path}: trace index {index} out of range "
+                         f"({len(traces)} trace records)")
+    try:
+        return validate_trace(trace)
+    except ValueError as exc:
+        raise SystemExit(f"{path}: {exc}")
+
+
+def _looks_whole_json(text: str) -> bool:
+    """Whole-file JSON dump vs JSONL: a pretty-printed (multi-line)
+    dump fails line-by-line parsing, so try the whole body first."""
+    stripped = text.strip()
+    if "\n" not in stripped:
+        return True
+    try:
+        json.loads(stripped.splitlines()[0])
+        return False               # first line parses alone: JSONL
+    except json.JSONDecodeError:
+        return True
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.3f}ms"
+
+
+def cmd_trace(args) -> int:
+    from paddle_tpu.telemetry.trace import (chrome_trace,
+                                            waterfall_summary)
+    trace = _load_trace(args.path, args.index)
+    if args.chrome:
+        doc = chrome_trace(trace)
+        with open(args.chrome, "w") as f:
+            json.dump(doc, f)
+        n = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+        print(f"wrote {args.chrome}: {n} events "
+              f"(load in Perfetto / chrome://tracing)")
+        return 0
+    summary = waterfall_summary(trace["events"], slowest=args.slowest)
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+        return 0
+    print(f"# {args.path}: trace {trace['name']!r}, "
+          f"{len(trace['events'])} events, {trace['dropped']} dropped")
+    print(f"requests: {summary['requests']} "
+          f"({summary['retired']} retired)")
+    for key in ("queue_s", "prefill_s", "ttft_s", "decode_s",
+                "total_s"):
+        d = summary[key]
+        print(f"  {key:<10} n={d['count']:<4} p50={_fmt_s(d['p50'])} "
+              f"p95={_fmt_s(d['p95'])} max={_fmt_s(d['max'])}")
+    if summary["slowest"]:
+        print(f"slowest {len(summary['slowest'])} by total latency:")
+        for r in summary["slowest"]:
+            print(f"  rid={r['rid']:<5} total={_fmt_s(r['total_s'])} "
+                  f"ttft={_fmt_s(r['ttft_s'])} "
+                  f"queue={_fmt_s(r['queue_s'])} "
+                  f"tokens={r['tokens']} slot={r['slot']} "
+                  f"reason={r['retire_reason']}")
     return 0
 
 
@@ -133,6 +240,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="machine-readable diff")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "trace", help="per-request waterfall summary / Chrome export")
+    p.add_argument("path", help="trace file: JSONL with trace records, "
+                                "a Tracer snapshot, or a flight record")
+    p.add_argument("--index", type=int, default=-1,
+                   help="which trace record in a JSONL stream "
+                        "(default: last)")
+    p.add_argument("--chrome", metavar="OUT.json", default=None,
+                   help="convert to Chrome trace-event JSON instead "
+                        "of summarizing")
+    p.add_argument("--slowest", type=int, default=5,
+                   help="how many slowest requests to list (default 5)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable summary")
+    p.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import json
 import math
+import os
+import subprocess
 import sys
 import time
 from typing import IO, List, Optional
@@ -33,7 +35,8 @@ from paddle_tpu.telemetry.metrics import (SCHEMA_VERSION, approx_quantile)
 
 __all__ = ["validate_snapshot", "append_jsonl", "read_jsonl",
            "prometheus_text", "console_summary", "emit_row",
-           "bench_row", "diff_snapshots"]
+           "bench_row", "diff_snapshots", "append_trace_jsonl",
+           "run_meta"]
 
 
 # ------------------------------------------------------------- validation
@@ -129,9 +132,26 @@ def append_jsonl(path: str, snapshot: dict, meta: Optional[dict] = None,
     return record
 
 
+def append_trace_jsonl(path: str, trace: dict,
+                       meta: Optional[dict] = None,
+                       ts: Optional[float] = None) -> dict:
+    """The trace twin of :func:`append_jsonl`: validate + append ONE
+    record line ``{"ts", "meta", "trace"}``.  Trace records share the
+    JSONL stream with metric snapshots (``--telemetry-out`` appends
+    both), and ``paddle_tpu telemetry trace`` reads them back."""
+    from paddle_tpu.telemetry.trace import validate_trace
+    validate_trace(trace)
+    record = {"ts": time.time() if ts is None else float(ts),
+              "meta": dict(meta or {}), "trace": trace}
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record
+
+
 def read_jsonl(path: str) -> List[dict]:
-    """Parse every record line; each snapshot is re-validated so a
-    hand-edited file fails loudly here rather than deep in a diff."""
+    """Parse every record line; snapshot and trace payloads are each
+    re-validated so a hand-edited file fails loudly here rather than
+    deep in a diff."""
     records = []
     with open(path) as f:
         for ln, line in enumerate(f, 1):
@@ -144,8 +164,36 @@ def read_jsonl(path: str) -> List[dict]:
                 raise ValueError(f"{path}:{ln}: not JSON: {e}") from e
             if "snapshot" in rec:
                 validate_snapshot(rec["snapshot"])
+            if "trace" in rec:
+                from paddle_tpu.telemetry.trace import validate_trace
+                validate_trace(rec["trace"])
             records.append(rec)
     return records
+
+
+def run_meta(**extra) -> dict:
+    """Provenance stamp for snapshot/trace records: the repo's git
+    revision and the jax version, so two ``--telemetry-out`` files can
+    be identified when ``telemetry diff`` builds a crossover table
+    weeks later.  Never raises — outside a git checkout ``git_rev`` is
+    ``"unknown"``."""
+    meta = dict(extra)
+    try:
+        import jax
+        meta.setdefault("jax_version", jax.__version__)
+    except Exception:
+        meta.setdefault("jax_version", "unknown")
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        meta.setdefault("git_rev", rev.stdout.strip()
+                        if rev.returncode == 0 and rev.stdout.strip()
+                        else "unknown")
+    except Exception:
+        meta.setdefault("git_rev", "unknown")
+    return meta
 
 
 # ---------------------------------------------------------- BENCH rows
@@ -279,7 +327,12 @@ def diff_snapshots(old: dict, new: dict) -> dict:
     counters and histogram count/sum subtract; gauges report old -> new.
     Series or metrics present only in ``new`` diff against zero/absent.
     Returns ``{name: [{"labels", ...delta fields...}]}`` — the
-    ``paddle_tpu telemetry diff`` payload."""
+    ``paddle_tpu telemetry diff`` payload.
+
+    Snapshots that disagree on a metric's TYPE or a histogram's bucket
+    bounds (two different builds, or a re-binned family) cannot be
+    subtracted — that raises a clear ``ValueError`` naming the metric,
+    rather than producing a silently-wrong table."""
     validate_snapshot(old)
     validate_snapshot(new)
 
@@ -290,7 +343,23 @@ def diff_snapshots(old: dict, new: dict) -> dict:
     out = {}
     for name, entry in new["metrics"].items():
         kind = entry["type"]
-        olds = series_map(old["metrics"].get(name, {"series": []}))
+        old_entry = old["metrics"].get(name)
+        if old_entry is not None:
+            if old_entry["type"] != kind:
+                raise ValueError(
+                    f"telemetry diff: metric {name!r} is a "
+                    f"{old_entry['type']} in the old snapshot but a "
+                    f"{kind} in the new one — these snapshots are not "
+                    "comparable")
+            if kind == "histogram" \
+                    and old_entry["bounds"] != entry["bounds"]:
+                raise ValueError(
+                    f"telemetry diff: histogram {name!r} bucket bounds "
+                    f"differ between snapshots ({old_entry['bounds']} "
+                    f"vs {entry['bounds']}) — fixed-bucket histograms "
+                    "only diff by plain addition when the bounds "
+                    "match; re-record with one build")
+        olds = series_map(old_entry or {"series": []})
         rows = []
         for s in entry["series"]:
             key = tuple(sorted(s["labels"].items()))

@@ -1,22 +1,29 @@
 """The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
 
-Four checks, each a hard failure (non-zero exit) when violated:
+Five checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
-   (fresh registry) drives real requests to completion; the snapshot
-   must carry the documented serving metrics with data in them
-   (TTFT/queue-wait/step histograms populated, occupancy gauges set,
-   retire counters matching request count) and the ``compiles ==
-   {'decode': 1}`` contract must still hold WITH instrumentation on —
-   proof telemetry did not perturb tracing.
+   (fresh registry, request-level tracer ON) drives real requests to
+   completion; the snapshot must carry the documented serving metrics
+   with data in them (TTFT/queue-wait/step histograms populated,
+   occupancy gauges set, retire counters matching request count) and
+   the ``compiles == {'decode': 1}`` contract must still hold WITH
+   instrumentation AND tracing on — proof telemetry did not perturb
+   tracing.
 2. **Schema + exporters** — the live snapshot passes
    :func:`validate_snapshot`, round-trips through the JSONL writer,
    and renders to Prometheus text containing the expected families.
-3. **Overhead bound** — per-observation cost of the hot-path calls
-   (counter inc, labeled histogram observe) stays under a generous
-   ceiling; a regression that makes metrics expensive enough to matter
-   fails here rather than silently taxing the serving loop.
-4. **Lint re-check** — the instrumented entrypoints (engine decode,
+3. **Trace round-trip** — the smoke run's trace rides the JSONL stream
+   (``append_trace_jsonl`` -> ``read_jsonl``), every request shows a
+   complete queue -> prefill -> decode -> retire waterfall with a
+   derivable TTFT, and the Chrome export passes
+   :func:`validate_chrome_trace` (one named thread per slot + host).
+4. **Overhead bound** — per-observation cost of the hot-path calls
+   (counter inc, labeled histogram observe, AND tracer event record)
+   stays under a generous ceiling; a regression that makes telemetry
+   expensive enough to matter fails here rather than silently taxing
+   the serving loop.
+5. **Lint re-check** — the instrumented entrypoints (engine decode,
    paged serve step, trainer step) re-trace through tpu-lint with ZERO
    error-severity findings: ``host-callback-in-loop`` is the rule that
    would fire if any metric update leaked inside a jitted program.
@@ -72,7 +79,7 @@ def _check_serving_smoke():
 
     from paddle_tpu.models.transformer import TransformerConfig
     from paddle_tpu.serving import PagedServingEngine
-    from paddle_tpu.telemetry import MetricsRegistry
+    from paddle_tpu.telemetry import MetricsRegistry, Tracer
     import paddle_tpu.nn as nn
     from paddle_tpu.models.transformer import TransformerLM
 
@@ -83,9 +90,10 @@ def _check_serving_smoke():
     params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
 
     reg = MetricsRegistry("selfcheck")
+    tracer = Tracer(name="selfcheck")
     eng = PagedServingEngine(cfg, params, num_slots=2, num_blocks=8,
                              block_size=8, prompt_buckets=(8,),
-                             metrics=reg)
+                             metrics=reg, tracer=tracer)
     rs = np.random.RandomState(0)
     pr = rs.randint(0, cfg.vocab_size, (3, 6)).astype(np.int32)
     n_req = 3
@@ -123,7 +131,42 @@ def _check_serving_smoke():
     if stats["tokens_per_s"] <= 0:
         _fail(f"stats tokens_per_s must be positive when driven via "
               f"run(): {stats['tokens_per_s']}")
-    return snap
+    return snap, tracer.snapshot(), n_req
+
+
+def _check_trace_roundtrip(trace, n_req):
+    from paddle_tpu.telemetry import (append_trace_jsonl, chrome_trace,
+                                      read_jsonl, request_waterfalls,
+                                      validate_chrome_trace,
+                                      validate_trace)
+    validate_trace(trace)
+    # JSONL round-trip: the trace rides the same stream as snapshots
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "selfcheck_trace.jsonl")
+        append_trace_jsonl(path, trace, meta={"source": "selfcheck"})
+        records = read_jsonl(path)
+        if len(records) != 1 or records[0]["trace"] != trace:
+            _fail("trace JSONL round-trip did not reproduce the trace")
+    # every request must show the full waterfall with derivable TTFT
+    falls = request_waterfalls(trace["events"])
+    if len(falls) != n_req:
+        _fail(f"trace shows {len(falls)} requests, wanted {n_req}")
+    for r in falls:
+        for key in ("submit_ts", "queue_s", "prefill_s", "ttft_s",
+                    "total_s"):
+            if r[key] is None:
+                _fail(f"request {r['rid']}: waterfall missing {key} "
+                      f"(got {r})")
+        if not r["retired"]:
+            _fail(f"request {r['rid']}: never retired in the trace")
+    # Chrome export: structurally valid, host + per-slot tracks named
+    doc = validate_chrome_trace(chrome_trace(trace))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    if "host" not in names or not any(n.startswith("slot")
+                                      for n in names):
+        _fail(f"chrome export tracks {sorted(names)} lack host/slotN")
+    return len(trace["events"])
 
 
 def _check_exporters(snap):
@@ -146,19 +189,26 @@ def _check_exporters(snap):
 
 
 def _check_overhead():
-    from paddle_tpu.telemetry import MetricsRegistry
+    from paddle_tpu.telemetry import MetricsRegistry, Tracer
     reg = MetricsRegistry("overhead")
     ctr = reg.counter("c")
     hist = reg.histogram("h")
+    # a small-capacity ring so the tracer spends the run in its
+    # steady state (dropping oldest) — the always-on serving shape
+    tracer = Tracer(capacity=1024, name="overhead")
     t0 = time.perf_counter()
     for _ in range(_N_OVERHEAD):
         ctr.inc(reason="x")
         hist.observe(0.002, path="y")
-    per_op = (time.perf_counter() - t0) / (2 * _N_OVERHEAD)
+        tracer.instant("tok", track="slot0", rid=1, index=3)
+    per_op = (time.perf_counter() - t0) / (3 * _N_OVERHEAD)
     if per_op > MAX_SECONDS_PER_OBSERVATION:
         _fail(f"per-observation overhead {per_op * 1e6:.1f}us exceeds "
               f"{MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us — something "
-              "heavy (a sync? I/O?) got onto the metrics hot path")
+              "heavy (a sync? I/O?) got onto the telemetry hot path")
+    if tracer.dropped != _N_OVERHEAD - 1024:
+        _fail(f"tracer ring dropped {tracer.dropped} events, expected "
+              f"{_N_OVERHEAD - 1024} (capacity accounting broke)")
     return per_op
 
 
@@ -175,11 +225,15 @@ def _check_lint():
 
 
 def main(argv=None) -> int:
-    snap = _check_serving_smoke()
+    snap, trace, n_req = _check_serving_smoke()
     print("selfcheck: serving smoke ok "
-          f"({len(snap['metrics'])} metric families, compiles==1)")
+          f"({len(snap['metrics'])} metric families, compiles==1, "
+          "tracing on)")
     _check_exporters(snap)
     print("selfcheck: schema + JSONL + prometheus exporters ok")
+    n_events = _check_trace_roundtrip(trace, n_req)
+    print(f"selfcheck: trace round-trip ok ({n_events} events, "
+          f"{n_req} full waterfalls, chrome export valid)")
     per_op = _check_overhead()
     print(f"selfcheck: overhead ok ({per_op * 1e6:.2f}us/observation, "
           f"bound {MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us)")

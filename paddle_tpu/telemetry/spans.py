@@ -68,7 +68,12 @@ def span(name: str, registry: Optional[MetricsRegistry] = None,
          **labels) -> Iterator[str]:
     """Time a host region into ``span_seconds{span=<path>}`` and mirror
     it into the device trace.  Yields the full nesting path.  Extra
-    keyword labels pass through to the histogram series."""
+    keyword labels pass through to the histogram series.
+
+    When a request-level tracer is installed
+    (``telemetry.trace.set_tracer``), the span ALSO records there as a
+    complete event on the ``host`` track — Trainer eval/checkpoint
+    spans and serving request events land on one timeline."""
     reg = registry if registry is not None else get_registry()
     st = _stack()
     path = f"{st[-1]}/{name}" if st else name
@@ -85,6 +90,10 @@ def span(name: str, registry: Optional[MetricsRegistry] = None,
             SPAN_METRIC,
             help="host wall time per span path (see telemetry.span)",
         ).observe(dt, span=path, **labels)
+        from paddle_tpu.telemetry.trace import get_tracer
+        tracer = get_tracer()
+        if tracer is not None:
+            tracer.complete(path, t0, t0 + dt, track="host", **labels)
 
 
 # ------------------------------------------------- XPlane device capture

@@ -1,0 +1,487 @@
+"""Request-level tracing + flight recorder.
+
+The metrics layer (``metrics.py``) answers "how slow is the p95" —
+this module answers "WHERE did request 17 spend its time" and "what was
+the engine doing in the seconds before it died".  Three pieces, one
+event schema:
+
+* :class:`Tracer` — a thread-safe, bounded ring buffer of timestamped
+  events, each scoped to a ``track`` (one per engine slot plus the
+  ``host`` admission track) and optionally a request id (``rid``).
+  Producers call :meth:`Tracer.instant` / :meth:`Tracer.complete` /
+  :meth:`Tracer.span`; the ring bound makes an always-on tracer safe in
+  a serving process (old events fall off, ``dropped`` counts them).
+* **Chrome trace export** — :func:`chrome_trace` renders the events as
+  Chrome trace-event JSON (the ``{"traceEvents": [...]}`` form that
+  loads in Perfetto / ``chrome://tracing``): one named thread per
+  track, complete (``ph: "X"``) events for spans, instant (``ph: "i"``)
+  events for points, request ids and extras in ``args``.
+  :func:`validate_chrome_trace` is the structural check CI runs on the
+  export.
+* **Flight recorder** — :meth:`Tracer.flight_record` snapshots the last
+  ``window_s`` seconds of events plus caller-provided host state into a
+  JSON-safe dict; :meth:`Tracer.dump_flight` writes it.  The serving
+  engine arms this around ``run()``/``step()`` (a raise dumps the
+  engine's ``_slots``/queue/pool/compile state next to the event tail),
+  and the NaN localizer (``analysis/nans.py``) fires it when checkify
+  reports the first non-finite value — the post-mortem the stage-B
+  trail in ROADMAP.md had no tool for.
+
+Like the metrics layer, tracing is HOST-SIDE ONLY: events are recorded
+after device values come home, never inside ``jit`` — the ``compiles ==
+{'decode': 1}`` pin and the selfcheck overhead bound both hold with
+tracing enabled.
+
+Timestamps are ``time.perf_counter()`` seconds (monotonic, the same
+clock the engine's latency metrics use); ``wall_t0``/``perf_t0`` in the
+snapshot anchor them to wall time for cross-process alignment.
+
+A process-wide "active tracer" (:func:`set_tracer` / :func:`get_tracer`)
+lets instrumentation that does not own a tracer handle — ``span()`` in
+``spans.py``, the Trainer's step observer, the NaN localizer — record
+into whatever tracer the application installed.  Default: ``None``
+(tracing off; the probe is one function call).
+
+Schema and ring-buffer bounds: ``docs/design/telemetry.md``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Sequence
+
+__all__ = ["Tracer", "TRACE_SCHEMA_VERSION", "chrome_trace",
+           "validate_chrome_trace", "validate_trace", "set_tracer",
+           "get_tracer", "request_waterfalls", "waterfall_summary"]
+
+#: Bump when the event dict layout changes; validate_trace and the CI
+#: trace round-trip pin it.
+TRACE_SCHEMA_VERSION = 1
+
+#: Event phases (Chrome trace-event vocabulary, the subset we emit):
+#: "X" = complete (has ``dur``), "i" = instant.
+_PHASES = ("X", "i")
+
+
+class Tracer:
+    """Thread-safe bounded ring buffer of trace events.
+
+    ``capacity`` bounds memory: a ``deque(maxlen=...)`` drops the
+    OLDEST event on overflow (``dropped`` counts how many), so an
+    always-on tracer in a serving process costs a fixed few MiB no
+    matter how long it runs — the flight recorder only ever needs the
+    recent tail anyway.
+
+    ``flight_path``/``flight_window_s`` arm the flight recorder: when a
+    wrapped component raises (or the NaN localizer fires), the last
+    ``flight_window_s`` seconds of events + host state dump to
+    ``flight_path``.  Unarmed (``flight_path=None``), ``dump_flight``
+    callers must pass an explicit path.
+    """
+
+    def __init__(self, capacity: int = 65536, name: str = "trace",
+                 flight_path: Optional[str] = None,
+                 flight_window_s: float = 30.0):
+        if capacity < 1:
+            raise ValueError(f"tracer capacity must be >= 1, got "
+                             f"{capacity}")
+        self.name = name
+        self.capacity = int(capacity)
+        self.flight_path = flight_path
+        self.flight_window_s = float(flight_window_s)
+        self._lock = threading.RLock()
+        self._events: deque = deque(maxlen=self.capacity)
+        self.dropped = 0
+        # anchor the monotonic event clock to wall time once, so two
+        # processes' traces (or a trace and a log line) can be aligned
+        self.wall_t0 = time.time()
+        self.perf_t0 = time.perf_counter()
+
+    # ------------------------------------------------------------ record
+
+    @staticmethod
+    def now() -> float:
+        """The event clock — ``time.perf_counter()`` seconds, shared
+        with the engine's latency accounting so spans line up."""
+        return time.perf_counter()
+
+    def _push(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def instant(self, name: str, *, track: str = "host",
+                rid: Optional[int] = None, ts: Optional[float] = None,
+                **args) -> None:
+        """Record a point-in-time event (Chrome ``ph: "i"``)."""
+        self._push({"ts": self.now() if ts is None else float(ts),
+                    "dur": None, "name": str(name), "ph": "i",
+                    "track": str(track),
+                    "rid": None if rid is None else int(rid),
+                    "args": {k: _jsonable(v) for k, v in args.items()}})
+
+    def complete(self, name: str, t0: float, t1: Optional[float] = None,
+                 *, track: str = "host", rid: Optional[int] = None,
+                 **args) -> None:
+        """Record a finished span ``[t0, t1]`` (Chrome ``ph: "X"``).
+        ``t1`` defaults to now; a clock hiccup can never produce a
+        negative duration (clamped to 0)."""
+        t1 = self.now() if t1 is None else float(t1)
+        t0 = float(t0)
+        self._push({"ts": t0, "dur": max(0.0, t1 - t0),
+                    "name": str(name), "ph": "X", "track": str(track),
+                    "rid": None if rid is None else int(rid),
+                    "args": {k: _jsonable(v) for k, v in args.items()}})
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, track: str = "host",
+             rid: Optional[int] = None, **args) -> Iterator[None]:
+        """Context-manager form of :meth:`complete` — records even when
+        the body raises (the raise is exactly when you want the span)."""
+        t0 = self.now()
+        try:
+            yield
+        finally:
+            self.complete(name, t0, track=track, rid=rid, **args)
+
+    # ------------------------------------------------------------- read
+
+    def events(self, last_seconds: Optional[float] = None) -> List[dict]:
+        """A consistent copy of the buffered events (oldest first).
+        ``last_seconds`` keeps only events whose END falls within that
+        window of the newest event — the flight-recorder tail."""
+        with self._lock:
+            evs = [dict(e, args=dict(e["args"])) for e in self._events]
+        if last_seconds is not None and evs:
+            horizon = max(e["ts"] + (e["dur"] or 0.0) for e in evs) \
+                - float(last_seconds)
+            evs = [e for e in evs
+                   if e["ts"] + (e["dur"] or 0.0) >= horizon]
+        return evs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def snapshot(self, last_seconds: Optional[float] = None) -> dict:
+        """The trace wire format — what rides the telemetry JSONL
+        stream (``export.append_trace_jsonl``) and what
+        :func:`chrome_trace` renders."""
+        with self._lock:
+            dropped = self.dropped
+        return {"schema_version": TRACE_SCHEMA_VERSION,
+                "name": self.name, "capacity": self.capacity,
+                "dropped": dropped, "wall_t0": self.wall_t0,
+                "perf_t0": self.perf_t0,
+                "events": self.events(last_seconds)}
+
+    # -------------------------------------------------- flight recorder
+
+    def flight_record(self, reason: str, state: Optional[dict] = None,
+                      window_s: Optional[float] = None) -> dict:
+        """The crash dump: last-``window_s`` events + caller state.
+        Everything is JSON-safe by construction — a flight record is
+        read by humans at 3am, it must never fail to serialize."""
+        window = self.flight_window_s if window_s is None \
+            else float(window_s)
+        return {"schema_version": TRACE_SCHEMA_VERSION,
+                "kind": "flight_record",
+                "reason": str(reason),
+                "wall_time": time.time(),
+                "window_s": window,
+                "state": _jsonable(state if state is not None else {}),
+                "trace": self.snapshot(last_seconds=window)}
+
+    def dump_flight(self, path: Optional[str] = None, *, reason: str,
+                    state: Optional[dict] = None,
+                    window_s: Optional[float] = None) -> Optional[str]:
+        """Write :meth:`flight_record` to ``path`` (default: the armed
+        ``flight_path``).  Returns the path written, or None when no
+        path is configured.  Never raises: the dump rides an exception
+        path already — a broken disk must not mask the real error."""
+        path = self.flight_path if path is None else path
+        if not path:
+            return None
+        try:
+            record = self.flight_record(reason, state, window_s)
+            with open(path, "w") as f:
+                json.dump(record, f, sort_keys=True)
+            return path
+        except Exception:
+            return None
+
+
+def _jsonable(v):
+    """Coerce to JSON-safe: numpy scalars -> Python, arrays -> lists,
+    unknown objects -> repr.  Trace args must survive json.dump."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    item = getattr(v, "item", None)
+    if item is not None and getattr(v, "ndim", None) in (0, None):
+        try:
+            return _jsonable(item())
+        except Exception:
+            pass
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None:
+        try:
+            return _jsonable(tolist())
+        except Exception:
+            pass
+    return repr(v)
+
+
+# ------------------------------------------------------- active tracer
+
+_active_lock = threading.Lock()
+_active: Optional[Tracer] = None
+
+
+def set_tracer(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Install the process-wide active tracer (None = tracing off);
+    returns the previous one.  ``span()``, the Trainer's step observer,
+    and the NaN localizer all record into whatever is installed here,
+    so one ``set_tracer(Tracer())`` puts training spans and serving
+    request events on the same timeline."""
+    global _active
+    with _active_lock:
+        prev, _active = _active, tracer
+    return prev
+
+
+def get_tracer() -> Optional[Tracer]:
+    """The active tracer, or None (the common, zero-cost case)."""
+    return _active
+
+
+# ------------------------------------------------------ trace validation
+
+
+def _fail(msg: str):
+    raise ValueError(f"trace snapshot invalid: {msg}")
+
+
+def validate_trace(trace: dict) -> dict:
+    """Check a :meth:`Tracer.snapshot` payload (or the ``trace`` field
+    of a flight record / JSONL record).  Returns it unchanged so call
+    sites chain — the trace twin of ``export.validate_snapshot``."""
+    if not isinstance(trace, dict):
+        _fail(f"top level must be a dict, got {type(trace).__name__}")
+    if trace.get("schema_version") != TRACE_SCHEMA_VERSION:
+        _fail(f"schema_version {trace.get('schema_version')!r} != "
+              f"{TRACE_SCHEMA_VERSION}")
+    for key in ("name", "capacity", "dropped", "events"):
+        if key not in trace:
+            _fail(f"missing key {key!r}")
+    events = trace["events"]
+    if not isinstance(events, list):
+        _fail("events must be a list")
+    for i, e in enumerate(events):
+        where = f"events[{i}]"
+        if not isinstance(e, dict):
+            _fail(f"{where}: must be a dict")
+        if e.get("ph") not in _PHASES:
+            _fail(f"{where}: phase {e.get('ph')!r} not in {_PHASES}")
+        if not isinstance(e.get("name"), str) \
+                or not isinstance(e.get("track"), str):
+            _fail(f"{where}: name and track must be strings")
+        if not isinstance(e.get("ts"), (int, float)):
+            _fail(f"{where}: ts must be a number")
+        dur = e.get("dur")
+        if e["ph"] == "X":
+            if not isinstance(dur, (int, float)) or dur < 0:
+                _fail(f"{where}: complete event needs dur >= 0, "
+                      f"got {dur!r}")
+        elif dur is not None:
+            _fail(f"{where}: instant event must carry dur=None")
+        rid = e.get("rid")
+        if rid is not None and not isinstance(rid, int):
+            _fail(f"{where}: rid must be int or None, got {rid!r}")
+        if not isinstance(e.get("args"), dict):
+            _fail(f"{where}: args must be a dict")
+    return trace
+
+
+# ------------------------------------------------------- Chrome export
+
+
+def _track_order(tracks: Sequence[str]) -> List[str]:
+    """host first, then slots in numeric order, then the rest sorted —
+    the top-to-bottom reading order of the waterfall."""
+    def key(t):
+        if t == "host":
+            return (0, 0, t)
+        if t.startswith("slot"):
+            try:
+                return (1, int(t[4:]), t)
+            except ValueError:
+                pass
+        return (2, 0, t)
+    return sorted(set(tracks), key=key)
+
+
+def chrome_trace(trace: dict, *, process_name: str = "paddle_tpu") -> dict:
+    """Render a :meth:`Tracer.snapshot` as Chrome trace-event JSON.
+
+    Loads directly in Perfetto / ``chrome://tracing``: one process, one
+    named thread per track (``host`` on top, then ``slot0..slotN``),
+    spans as complete events, points as instants, ``rid`` and extras in
+    ``args``.  Timestamps convert to microseconds relative to the
+    earliest event (the format's unit)."""
+    validate_trace(trace)
+    events = trace["events"]
+    tracks = _track_order([e["track"] for e in events]) or ["host"]
+    tids = {t: i for i, t in enumerate(tracks)}
+    t0 = min((e["ts"] for e in events), default=0.0)
+    out = [{"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": f"{process_name}:{trace['name']}"}}]
+    for t, tid in tids.items():
+        out.append({"ph": "M", "name": "thread_name", "pid": 0,
+                    "tid": tid, "args": {"name": t}})
+    for e in events:
+        args = dict(e["args"])
+        if e["rid"] is not None:
+            args["rid"] = e["rid"]
+        ce = {"name": e["name"], "ph": e["ph"], "pid": 0,
+              "tid": tids[e["track"]],
+              "ts": (e["ts"] - t0) * 1e6, "args": args}
+        if e["ph"] == "X":
+            ce["dur"] = e["dur"] * 1e6
+        else:
+            ce["s"] = "t"          # instant scoped to its thread
+        out.append(ce)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": trace["dropped"],
+                          "wall_t0": trace.get("wall_t0")}}
+
+
+def validate_chrome_trace(doc: dict) -> dict:
+    """Structural check of a Chrome trace-event document — what the CI
+    trace round-trip gate asserts about the export (the viewer itself
+    silently drops malformed events, which is exactly the failure mode
+    a gate must catch)."""
+    def fail(msg):
+        raise ValueError(f"chrome trace invalid: {msg}")
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        fail("top level must be a dict with a traceEvents list")
+    named_threads = set()
+    for i, e in enumerate(doc["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            fail(f"{where}: must be a dict")
+        for key in ("ph", "name", "pid", "tid"):
+            if key not in e:
+                fail(f"{where}: missing {key!r}")
+        if e["ph"] == "M":
+            if e["name"] == "thread_name":
+                named_threads.add(e["tid"])
+            continue
+        if e["ph"] not in _PHASES:
+            fail(f"{where}: unexpected phase {e['ph']!r}")
+        if not isinstance(e.get("ts"), (int, float)) or e["ts"] < 0:
+            fail(f"{where}: ts must be a non-negative number (µs)")
+        if e["ph"] == "X" and (not isinstance(e.get("dur"), (int, float))
+                               or e["dur"] < 0):
+            fail(f"{where}: complete event needs dur >= 0 (µs)")
+        if e["tid"] not in named_threads:
+            fail(f"{where}: tid {e['tid']} has no thread_name metadata "
+                 "— the track would render unlabeled")
+    return doc
+
+
+# --------------------------------------------------------- waterfalls
+
+
+def request_waterfalls(events: List[dict]) -> List[dict]:
+    """Fold the serving engine's lifecycle events into one record per
+    request: submit/queue/prefill/decode/retire timings, TTFT, token
+    count.  Requests still in flight (no retire yet — e.g. a flight
+    record cut mid-run) report what they have, with ``"retired":
+    False``."""
+    reqs: Dict[int, dict] = {}
+
+    def rec(rid):
+        return reqs.setdefault(int(rid), {
+            "rid": int(rid), "submit_ts": None, "queue_s": None,
+            "prefill_s": None, "decode_s": None, "ttft_s": None,
+            "total_s": None, "tokens": None, "slot": None,
+            "retire_reason": None, "retired": False})
+
+    for e in events:
+        if e.get("rid") is None:
+            continue
+        r = rec(e["rid"])
+        name = e["name"]
+        if name == "submit":
+            r["submit_ts"] = e["ts"]
+        elif name == "queue":
+            r["queue_s"] = e["dur"]
+            r["slot"] = e["track"]
+        elif name == "prefill":
+            r["prefill_s"] = e["dur"]
+            r["slot"] = e["track"]
+        elif name == "first_token":
+            r["ttft_s"] = e["args"].get("ttft_s")
+        elif name == "decode":
+            r["decode_s"] = e["dur"]
+        elif name == "retire":
+            r["retired"] = True
+            r["retire_reason"] = e["args"].get("reason")
+            r["tokens"] = e["args"].get("tokens")
+            if r["submit_ts"] is not None:
+                r["total_s"] = e["ts"] - r["submit_ts"]
+    return sorted(reqs.values(), key=lambda r: r["rid"])
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Exact quantile of raw samples (nearest-rank with interpolation)
+    — traces carry the raw timestamps, so no bucket estimate needed."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) \
+        * (pos - lo)
+
+
+def waterfall_summary(events: List[dict], slowest: int = 5) -> dict:
+    """The ``telemetry trace`` CLI payload: per-phase p50/p95/max over
+    every request in the trace, plus the ``slowest``-K requests by
+    total latency (the tail the aggregate histograms cannot explain)."""
+    reqs = request_waterfalls(events)
+
+    def digest(key):
+        vals = sorted(r[key] for r in reqs if r[key] is not None)
+        return {"count": len(vals),
+                "p50": _quantile(vals, 0.50),
+                "p95": _quantile(vals, 0.95),
+                "max": vals[-1] if vals else None}
+
+    ranked = sorted((r for r in reqs if r["total_s"] is not None),
+                    key=lambda r: -r["total_s"])
+    return {"requests": len(reqs),
+            "retired": sum(1 for r in reqs if r["retired"]),
+            "ttft_s": digest("ttft_s"),
+            "queue_s": digest("queue_s"),
+            "prefill_s": digest("prefill_s"),
+            "decode_s": digest("decode_s"),
+            "total_s": digest("total_s"),
+            "slowest": ranked[:max(0, int(slowest))]}

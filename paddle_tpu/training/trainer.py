@@ -251,6 +251,12 @@ class Trainer:
         tokens = int(np.prod(np.shape(ids))) if ids is not None else 0
         self._m_step.observe(dt / k, path=path)
         self._m_batches.inc(k)
+        from paddle_tpu.telemetry.trace import get_tracer
+        tracer = get_tracer()
+        if tracer is not None:
+            t1 = time.perf_counter()
+            tracer.complete(f"train/{path}", t1 - dt, t1,
+                            track="trainer", k=k, tokens=tokens)
         if examples:
             self._m_examples.inc(examples)
         if tokens:

@@ -1,4 +1,13 @@
 from paddle_tpu.utils.stat import StatSet, global_stat, timer
-from paddle_tpu.utils import profiler
 
 __all__ = ["StatSet", "global_stat", "timer", "profiler"]
+
+
+def __getattr__(name):
+    # profiler is a deprecated shim (warns on import) — load it lazily
+    # so merely importing paddle_tpu.utils stays warning-free.
+    if name == "profiler":
+        import importlib
+        return importlib.import_module("paddle_tpu.utils.profiler")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

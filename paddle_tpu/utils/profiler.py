@@ -12,7 +12,14 @@ call sites keep working.
 
 from __future__ import annotations
 
+import warnings
+
 from paddle_tpu.telemetry.spans import span as annotate
 from paddle_tpu.telemetry.spans import start, stop, trace
 
 __all__ = ["start", "stop", "trace", "annotate"]
+
+warnings.warn(
+    "paddle_tpu.utils.profiler is deprecated; import span/start/stop/"
+    "trace from paddle_tpu.telemetry instead",
+    DeprecationWarning, stacklevel=2)

@@ -325,3 +325,33 @@ def test_honor_env_platform_never_orphans_live_client():
                          capture_output=True, timeout=300)
     assert out.returncode == 0, out.stderr[-2000:]
     assert out.stdout.strip().splitlines()[-1] == "3.0"
+
+
+def test_softmax_ce_hand_rolled_lse_stage_b_trail():
+    """The hand-rolled log-sum-exp in ops/losses.py stays FINITE for
+    finite logits of any magnitude (the max-shift), and the documented
+    ``inf - inf -> nan`` appears ONLY when the logits themselves carry
+    ±inf — the stage-B NaN trail's pinned behavior."""
+    from paddle_tpu.ops.losses import softmax_cross_entropy
+
+    labels = jnp.asarray([0, 1], jnp.int32)
+
+    # finite logits, extreme magnitudes: the shift keeps exp in range
+    for scale in (1.0, 1e4, -1e4, 1e37):   # 1e37: near f32 max, finite
+        logits = jnp.asarray([[1.0, 2.0, 3.0],
+                              [-4.0, 0.0, 4.0]], jnp.float32) * scale
+        loss = softmax_cross_entropy(logits, labels)
+        assert bool(jnp.all(jnp.isfinite(loss))), (scale, loss)
+        assert bool(jnp.all(loss >= 0)), (scale, loss)
+
+    # a +inf logit at the picked position: lse = +inf and picked =
+    # +inf, so the subtraction is the documented inf - inf -> nan
+    logits = jnp.asarray([[jnp.inf, 0.0, 0.0]], jnp.float32)
+    loss = softmax_cross_entropy(logits, jnp.asarray([0], jnp.int32))
+    assert bool(jnp.isnan(loss[0]))
+
+    # all--inf row: lse = -inf, picked = -inf -> nan too (documented);
+    # but -inf only at NON-picked positions is fine (prob mass 0)
+    logits = jnp.asarray([[0.0, -jnp.inf, -jnp.inf]], jnp.float32)
+    loss = softmax_cross_entropy(logits, jnp.asarray([0], jnp.int32))
+    assert bool(jnp.isfinite(loss[0])) and float(loss[0]) == 0.0

@@ -1,0 +1,599 @@
+"""Request-level tracing + flight recorder (``telemetry/trace.py``,
+the engine's tracer instrumentation in ``serving.py``, and the hooks
+in ``spans.py``/``trainer.py``/``analysis/nans.py``).
+
+Load-bearing pins (the PR's acceptance criteria):
+
+* an instrumented ``PagedServingEngine`` smoke run yields Chrome-trace
+  JSON with per-request spans covering queue -> prefill -> decode ->
+  retire on one track per slot (plus the host admission track), with
+  TTFT derivable per request;
+* ``compile_counts() == {'decode': 1}`` still holds WITH tracing on;
+* an injected mid-run exception produces a flight-recorder dump
+  carrying the last-N-seconds event tail + the engine's host state;
+* traces ride the existing telemetry JSONL stream next to snapshot
+  records, and the ``telemetry trace`` CLI renders the waterfall.
+"""
+
+import json
+import threading
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.telemetry import MetricsRegistry
+from paddle_tpu.telemetry.trace import (Tracer, TRACE_SCHEMA_VERSION,
+                                        chrome_trace, get_tracer,
+                                        request_waterfalls, set_tracer,
+                                        validate_chrome_trace,
+                                        validate_trace,
+                                        waterfall_summary)
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry("t")
+
+
+@pytest.fixture
+def no_active_tracer():
+    """Tests that install a process-wide tracer must restore None."""
+    prev = set_tracer(None)
+    yield
+    set_tracer(prev)
+
+
+CFG = PARAMS = None
+
+
+def _tiny_engine(reg, **kw):
+    global CFG, PARAMS
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    import paddle_tpu.nn as nn
+    if CFG is None:
+        CFG = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                                num_layers=1, ffn_mult=2, max_len=16)
+        model = nn.transform(
+            lambda ids: TransformerLM(CFG, name="lm")(ids))
+        PARAMS, _ = model.init(jax.random.key(0),
+                               jnp.zeros((1, 4), jnp.int32))
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("num_blocks", 8)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", (8,))
+    return PagedServingEngine(CFG, PARAMS, metrics=reg, **kw)
+
+
+# ------------------------------------------------------------ ring core
+
+
+def test_ring_buffer_bounds_and_dropped_count():
+    t = Tracer(capacity=4, name="ring")
+    for i in range(10):
+        t.instant(f"e{i}", ts=float(i))
+    assert len(t) == 4
+    assert t.dropped == 6
+    names = [e["name"] for e in t.events()]
+    assert names == ["e6", "e7", "e8", "e9"]   # oldest fell off
+    snap = t.snapshot()
+    assert snap["dropped"] == 6 and snap["capacity"] == 4
+    t.clear()
+    assert len(t) == 0 and t.dropped == 0
+
+
+def test_events_last_seconds_window():
+    t = Tracer(name="win")
+    t.instant("old", ts=1.0)
+    t.complete("mid", 9.0, 10.5)       # ends at 10.5
+    t.instant("new", ts=12.0)
+    tail = t.events(last_seconds=3.0)  # horizon = 12.0 - 3.0 = 9.0
+    assert [e["name"] for e in tail] == ["mid", "new"]
+
+
+def test_complete_clamps_negative_duration():
+    t = Tracer()
+    t.complete("backwards", 5.0, 4.0)
+    (e,) = t.events()
+    assert e["dur"] == 0.0
+
+
+def test_tracer_span_records_on_raise():
+    t = Tracer()
+    with pytest.raises(RuntimeError):
+        with t.span("doomed", track="host", rid=7):
+            raise RuntimeError("x")
+    (e,) = t.events()
+    assert e["name"] == "doomed" and e["ph"] == "X" and e["rid"] == 7
+
+
+def test_tracer_thread_safety_no_lost_events():
+    t = Tracer(capacity=100000)
+
+    def work(k):
+        for i in range(500):
+            t.instant(f"w{k}", ts=float(i))
+
+    threads = [threading.Thread(target=work, args=(k,))
+               for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(t) == 2000 and t.dropped == 0
+
+
+def test_args_coerced_jsonable():
+    t = Tracer()
+    t.instant("e", count=np.int64(3), frac=np.float32(0.5),
+              arr=np.arange(2), obj=object())
+    (e,) = t.events()
+    json.dumps(e)                     # must serialize
+    assert e["args"]["count"] == 3
+    assert e["args"]["arr"] == [0, 1]
+    assert isinstance(e["args"]["obj"], str)
+
+
+# ------------------------------------------------------- schema checks
+
+
+def test_validate_trace_accepts_snapshot_and_rejects_garbage():
+    t = Tracer(name="v")
+    t.instant("a")
+    t.complete("b", 0.0, 1.0)
+    snap = validate_trace(t.snapshot())
+    assert snap["schema_version"] == TRACE_SCHEMA_VERSION
+
+    bad = t.snapshot()
+    bad["events"][0]["ph"] = "Z"
+    with pytest.raises(ValueError, match="phase"):
+        validate_trace(bad)
+    bad = t.snapshot()
+    bad["events"][1]["dur"] = -1.0
+    with pytest.raises(ValueError, match="dur"):
+        validate_trace(bad)
+    bad = t.snapshot()
+    bad["schema_version"] = 99
+    with pytest.raises(ValueError, match="schema_version"):
+        validate_trace(bad)
+
+
+def test_chrome_export_structure_and_validator():
+    t = Tracer(name="c")
+    t.complete("queue", 1.0, 1.5, track="slot1", rid=3)
+    t.instant("submit", track="host", rid=3, ts=1.0)
+    t.complete("prefill", 1.5, 2.0, track="slot0", rid=4)
+    doc = validate_chrome_trace(chrome_trace(t.snapshot()))
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    names = {e["args"]["name"]: e["tid"] for e in meta
+             if e["name"] == "thread_name"}
+    # host first, then slots in numeric order
+    assert names["host"] == 0
+    assert names["slot0"] == 1 and names["slot1"] == 2
+    x = [e for e in evs if e["ph"] == "X"]
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in x)  # µs, rel t0
+    q = next(e for e in x if e["name"] == "queue")
+    assert q["dur"] == pytest.approx(0.5e6)
+    assert q["args"]["rid"] == 3
+    # instants carry the thread scope flag
+    i = next(e for e in evs if e["ph"] == "i")
+    assert i["s"] == "t"
+
+    # the validator rejects an event on an unnamed thread
+    doc["traceEvents"].append({"ph": "X", "name": "stray", "pid": 0,
+                               "tid": 99, "ts": 0.0, "dur": 1.0})
+    with pytest.raises(ValueError, match="thread_name"):
+        validate_chrome_trace(doc)
+
+
+def test_trace_rides_jsonl_stream_next_to_snapshots(reg, tmp_path):
+    from paddle_tpu.telemetry import (append_jsonl, append_trace_jsonl,
+                                      read_jsonl)
+    path = str(tmp_path / "mixed.jsonl")
+    reg.counter("c").inc()
+    append_jsonl(path, reg.snapshot(), meta={"kind": "snap"})
+    t = Tracer(name="mix")
+    t.instant("a", rid=1)
+    append_trace_jsonl(path, t.snapshot(), meta={"kind": "trace"})
+    records = read_jsonl(path)
+    assert len(records) == 2
+    assert "snapshot" in records[0] and "trace" in records[1]
+    assert records[1]["trace"]["events"][0]["name"] == "a"
+    # appending an invalid trace is refused before touching the file
+    with pytest.raises(ValueError):
+        append_trace_jsonl(path, {"nope": True})
+    assert len(read_jsonl(path)) == 2
+
+
+# -------------------------------------------------- engine lifecycle
+
+
+def test_engine_trace_full_request_waterfalls(reg):
+    tracer = Tracer(name="serving")
+    eng = _tiny_engine(reg, tracer=tracer)
+    pr = np.arange(1, 9, dtype=np.int32)
+    rids = [eng.submit(pr[:3], max_new=5),
+            eng.submit(pr[:5], max_new=4),
+            eng.submit(pr[:2], max_new=3)]   # queues behind 2 slots
+    res = eng.run()
+    assert sorted(res) == sorted(rids)
+    assert eng.compile_counts()["decode"] == 1, (
+        "tracing must not perturb tracing — the serving contract")
+
+    trace = validate_trace(tracer.snapshot())
+    events = trace["events"]
+    tracks = {e["track"] for e in events}
+    assert "host" in tracks
+    assert {t for t in tracks if t.startswith("slot")} == {"slot0",
+                                                           "slot1"}
+    # every request's lifecycle is complete and TTFT is derivable
+    falls = request_waterfalls(events)
+    assert [f["rid"] for f in falls] == sorted(rids)
+    for f in falls:
+        assert f["retired"] and f["retire_reason"] in ("eos", "max_new")
+        for key in ("submit_ts", "queue_s", "prefill_s", "ttft_s",
+                    "decode_s", "total_s"):
+            assert f[key] is not None, (f["rid"], key)
+        assert f["slot"] in ("slot0", "slot1")
+        assert f["ttft_s"] >= f["queue_s"] >= 0
+        assert f["total_s"] >= f["ttft_s"]
+        assert f["tokens"] >= 1
+    # per-token instants exist and are rid-scoped
+    toks = [e for e in events if e["name"] == "token"]
+    assert toks and all(e["rid"] is not None for e in toks)
+    # decode steps recorded on the host track
+    assert any(e["name"] == "decode_step" and e["track"] == "host"
+               for e in events)
+
+    # and the whole thing exports as valid Chrome trace JSON
+    doc = validate_chrome_trace(chrome_trace(trace))
+    thread_names = {e["args"]["name"] for e in doc["traceEvents"]
+                    if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"host", "slot0", "slot1"} <= thread_names
+
+
+def test_engine_tokens_match_trace_token_events(reg):
+    tracer = Tracer(name="serving")
+    eng = _tiny_engine(reg, tracer=tracer)
+    rid = eng.submit(np.arange(1, 4, dtype=np.int32), max_new=6)
+    res = eng.run()
+    # prefill's tok0 + one instant per decode-step token
+    toks = [e for e in tracer.events()
+            if e["name"] == "token" and e["rid"] == rid]
+    assert len(toks) == len(res[rid]) - 1
+    idx = [e["args"]["index"] for e in toks]
+    assert idx == list(range(1, len(res[rid])))
+
+
+def test_waterfall_summary_digests(reg):
+    tracer = Tracer(name="serving")
+    eng = _tiny_engine(reg, tracer=tracer)
+    for n in (2, 3, 4):
+        eng.submit(np.arange(1, n + 1, dtype=np.int32), max_new=4)
+    eng.run()
+    s = waterfall_summary(tracer.events(), slowest=2)
+    assert s["requests"] == 3 and s["retired"] == 3
+    for key in ("ttft_s", "queue_s", "prefill_s", "decode_s",
+                "total_s"):
+        d = s[key]
+        assert d["count"] == 3
+        assert d["p50"] <= d["p95"] <= d["max"]
+    assert len(s["slowest"]) == 2
+    assert (s["slowest"][0]["total_s"]
+            >= s["slowest"][1]["total_s"])
+
+
+def test_waterfall_quantiles_exact():
+    evs = []
+    for rid, total in enumerate([0.1, 0.2, 0.3, 0.4]):
+        evs.append({"ts": 0.0, "dur": None, "name": "submit",
+                    "ph": "i", "track": "host", "rid": rid, "args": {}})
+        evs.append({"ts": total, "dur": None, "name": "retire",
+                    "ph": "i", "track": "slot0", "rid": rid,
+                    "args": {"reason": "eos", "tokens": 1}})
+    s = waterfall_summary(evs)
+    assert s["total_s"]["p50"] == pytest.approx(0.25)
+    assert s["total_s"]["max"] == pytest.approx(0.4)
+
+
+# --------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_mid_run_exception(reg, tmp_path):
+    crash = tmp_path / "crash.json"
+    eng = _tiny_engine(reg, flight_recorder=str(crash))
+    assert eng.tracer is not None          # armed recorder made one
+    for n in (3, 5, 2):
+        eng.submit(np.arange(1, n + 1, dtype=np.int32), max_new=5)
+
+    real_decode = eng._decode
+    calls = {"n": 0}
+
+    def exploding(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise RuntimeError("injected device wedge")
+        return real_decode(*a, **kw)
+
+    eng._decode = exploding
+    with pytest.raises(RuntimeError, match="injected device wedge"):
+        eng.run()
+
+    dump = json.loads(crash.read_text())
+    assert dump["kind"] == "flight_record"
+    assert "injected device wedge" in dump["reason"]
+    # the event tail is a valid trace with lifecycle events in it
+    trace = validate_trace(dump["trace"])
+    names = {e["name"] for e in trace["events"]}
+    assert {"submit", "queue", "prefill"} <= names
+    # engine host state rides along (host accounting, JSON-safe)
+    state = dump["state"]
+    assert state["pool_blocks"] == 8 and state["num_slots"] == 2
+    assert state["compiles"].get("decode") == 1
+    assert len(state["slots"]) == 2
+    assert any(s is not None for s in state["slots"])
+    assert state["decode_steps"] == 2      # two good steps ran
+
+
+def test_flight_recorder_dumps_once_per_exception(reg, tmp_path):
+    crash = tmp_path / "crash.json"
+    eng = _tiny_engine(reg, flight_recorder=str(crash))
+    eng.submit(np.arange(1, 4, dtype=np.int32), max_new=4)
+
+    def boom(*a, **kw):
+        raise ValueError("first")
+
+    eng._decode = boom
+    with pytest.raises(ValueError):
+        eng.run()                          # step dumps, run re-raises
+    first = crash.read_text()
+    # the same exception object must not overwrite the dump with a
+    # later (emptier) state — marker set on the exception
+    dump = json.loads(first)
+    assert dump["reason"].startswith("ValueError")
+
+
+def test_flight_recorder_deadlock_raise_dumps(reg, tmp_path):
+    crash = tmp_path / "crash.json"
+    # pool of 2 blocks (16 tokens) but both slots busy forever is not
+    # constructible here; instead: a queued request too large for the
+    # FREE pool while another holds its reservation -> deadlock raise
+    eng = _tiny_engine(reg, num_slots=1, num_blocks=2,
+                       flight_recorder=str(crash))
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new=8)   # 2 blocks held
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new=8)   # can never fit
+
+    # drain the first request; the second then deadlocks only if the
+    # pool stays too small — num_blocks=2 frees after retire, so the
+    # second admits fine.  Force the deadlock: reserve phantom blocks.
+    eng._reserved += 1
+    with pytest.raises(RuntimeError, match="deadlock"):
+        eng.run()
+    dump = json.loads(crash.read_text())
+    assert "deadlock" in dump["reason"]
+    assert dump["state"]["queue_depth"] >= 1
+
+
+def test_dump_flight_never_raises(tmp_path):
+    t = Tracer(name="f", flight_path=str(tmp_path / "no" / "dir.json"))
+    t.instant("e")
+    assert t.dump_flight(None, reason="x") is None   # bad dir -> None
+    ok = tmp_path / "ok.json"
+    assert t.dump_flight(str(ok), reason="x",
+                         state={"k": 1}) == str(ok)
+    assert json.loads(ok.read_text())["state"]["k"] == 1
+    unarmed = Tracer(name="u")
+    assert unarmed.dump_flight(None, reason="x") is None
+
+
+# ------------------------------------------- active-tracer hook sites
+
+
+def test_spans_record_into_active_tracer(reg, no_active_tracer):
+    t = Tracer(name="spans")
+    set_tracer(t)
+    with telemetry.span("trainer", registry=reg):
+        with telemetry.span("eval", registry=reg):
+            pass
+    names = [e["name"] for e in t.events()]
+    assert names == ["trainer/eval", "trainer"]   # inner closes first
+    assert all(e["track"] == "host" for e in t.events())
+    assert get_tracer() is t
+
+
+def test_trainer_steps_record_into_active_tracer(reg,
+                                                 no_active_tracer):
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.training import Trainer
+    t = Tracer(name="train")
+    set_tracer(t)
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    tr = Trainer(lm_model_fn_builder(cfg), optim.sgd(0.1), metrics=reg)
+    batch = {"ids": np.zeros((2, 8), np.int32)}
+    tr.train_batch(batch)
+    stack = {"ids": np.zeros((3, 2, 8), np.int32)}
+    tr.train_batches(stack)
+    evs = [e for e in t.events() if e["track"] == "trainer"]
+    assert [e["name"] for e in evs] == ["train/batch", "train/scan"]
+    assert evs[0]["args"]["tokens"] == 16
+    assert evs[1]["args"]["k"] == 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in evs)
+
+
+def test_nan_localizer_fires_flight_recorder(tmp_path,
+                                             no_active_tracer):
+    from paddle_tpu.analysis.core import LintTarget
+    from paddle_tpu.analysis.nans import nan_check
+    crash = tmp_path / "nan.json"
+    t = Tracer(name="nans", flight_path=str(crash))
+    set_tracer(t)
+
+    def bad(x):
+        return jnp.log(-jnp.abs(x))       # nan for any nonzero input
+
+    target = LintTarget(
+        name="bad-log",
+        fn=bad, args=(jax.ShapeDtypeStruct((4,), jnp.float32),))
+    findings = nan_check(target)
+    assert findings and findings[0].rule_id == "nan-check"
+    # the hook stamped the timeline and dumped the flight record
+    assert any(e["name"] == "nan_detected" for e in t.events())
+    dump = json.loads(crash.read_text())
+    assert dump["reason"] == "nan-check: bad-log"
+    assert dump["state"]["target"] == "bad-log"
+
+
+def test_nan_localizer_clean_target_no_dump(tmp_path,
+                                            no_active_tracer):
+    from paddle_tpu.analysis.core import LintTarget
+    from paddle_tpu.analysis.nans import nan_check
+    crash = tmp_path / "nan.json"
+    t = Tracer(name="nans", flight_path=str(crash))
+    set_tracer(t)
+    target = LintTarget(
+        name="fine",
+        fn=lambda x: jnp.sum(x * x),
+        args=(jax.ShapeDtypeStruct((4,), jnp.float32),))
+    assert nan_check(target) == []
+    assert not crash.exists()
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _run_cli(argv, capsys):
+    from paddle_tpu.telemetry.cli import main
+    rc = main(argv)
+    return rc, capsys.readouterr().out
+
+
+def test_cli_trace_summary_from_jsonl(reg, tmp_path, capsys):
+    from paddle_tpu.telemetry import append_trace_jsonl
+    tracer = Tracer(name="serving")
+    eng = _tiny_engine(reg, tracer=tracer)
+    for n in (3, 5):
+        eng.submit(np.arange(1, n + 1, dtype=np.int32), max_new=4)
+    eng.run()
+    path = str(tmp_path / "run.jsonl")
+    append_trace_jsonl(path, tracer.snapshot())
+    rc, out = _run_cli(["trace", path], capsys)
+    assert rc == 0
+    assert "requests: 2 (2 retired)" in out
+    for needle in ("ttft_s", "queue_s", "total_s", "slowest", "rid="):
+        assert needle in out
+
+
+def test_cli_trace_json_and_chrome(reg, tmp_path, capsys):
+    from paddle_tpu.telemetry import append_trace_jsonl
+    tracer = Tracer(name="serving")
+    eng = _tiny_engine(reg, tracer=tracer)
+    eng.submit(np.arange(1, 4, dtype=np.int32), max_new=3)
+    eng.run()
+    path = str(tmp_path / "run.jsonl")
+    append_trace_jsonl(path, tracer.snapshot())
+    rc, out = _run_cli(["trace", path, "--json"], capsys)
+    assert rc == 0
+    assert json.loads(out)["requests"] == 1
+
+    chrome = str(tmp_path / "out.json")
+    rc, out = _run_cli(["trace", path, "--chrome", chrome], capsys)
+    assert rc == 0 and "Perfetto" in out
+    validate_chrome_trace(json.loads(open(chrome).read()))
+
+
+def test_cli_trace_reads_flight_record(reg, tmp_path, capsys):
+    crash = tmp_path / "crash.json"
+    eng = _tiny_engine(reg, flight_recorder=str(crash))
+    eng.submit(np.arange(1, 4, dtype=np.int32), max_new=4)
+
+    def boom(*a, **kw):
+        raise ValueError("wedge")
+
+    eng._decode = boom
+    with pytest.raises(ValueError):
+        eng.run()
+    rc, out = _run_cli(["trace", str(crash)], capsys)
+    assert rc == 0 and "requests: 1" in out
+
+
+def test_cli_trace_no_trace_records_clean_error(reg, tmp_path):
+    from paddle_tpu.telemetry import append_jsonl
+    from paddle_tpu.telemetry.cli import main
+    path = str(tmp_path / "snaps.jsonl")
+    append_jsonl(path, reg.snapshot())
+    with pytest.raises(SystemExit) as ei:
+        main(["trace", path])
+    assert "no trace records" in str(ei.value)
+
+
+def test_cli_diff_mismatched_buckets_clean_exit(tmp_path):
+    from paddle_tpu.telemetry import append_jsonl
+    from paddle_tpu.telemetry.cli import main
+    a = MetricsRegistry("g")
+    a.histogram("h", buckets=(0.1, 1.0)).observe(0.5)
+    b = MetricsRegistry("g")
+    b.histogram("h", buckets=(0.2, 2.0)).observe(0.5)
+    path = str(tmp_path / "run.jsonl")
+    append_jsonl(path, a.snapshot())
+    append_jsonl(path, b.snapshot())
+    with pytest.raises(SystemExit) as ei:
+        main(["diff", path])
+    msg = str(ei.value)
+    assert "bucket bounds differ" in msg and "'h'" in msg
+    # SystemExit with a string message exits nonzero
+    assert ei.value.code != 0
+
+
+def test_diff_snapshots_type_mismatch_raises():
+    from paddle_tpu.telemetry import diff_snapshots
+    a = MetricsRegistry("g")
+    a.counter("m").inc()
+    b = MetricsRegistry("g")
+    b.gauge("m").set(1.0)
+    with pytest.raises(ValueError, match="not comparable"):
+        diff_snapshots(a.snapshot(), b.snapshot())
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_profiler_shim_warns_deprecation():
+    import importlib
+    import sys
+    sys.modules.pop("paddle_tpu.utils.profiler", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        import paddle_tpu.utils.profiler as profiler
+        importlib.reload(profiler)
+    assert any(issubclass(w.category, DeprecationWarning)
+               and "telemetry" in str(w.message) for w in caught)
+    # the shim still forwards to the telemetry implementations
+    assert profiler.annotate is telemetry.span
+    assert profiler.trace is telemetry.trace
+
+
+def test_run_meta_stamps_build_identity():
+    meta = telemetry.run_meta(metric="x", value=1.0)
+    assert meta["metric"] == "x" and meta["value"] == 1.0
+    assert "git_rev" in meta and "jax_version" in meta
+    assert meta["jax_version"] == jax.__version__ \
+        or meta["jax_version"] == "unknown"
+    assert isinstance(meta["git_rev"], str) and meta["git_rev"]
+    # caller-provided values win over the stamped defaults
+    assert telemetry.run_meta(git_rev="abc")["git_rev"] == "abc"
+
+
+def test_telemetry_trace_attribute_is_still_xplane_capture():
+    """Importing the trace SUBMODULE must not shadow the public
+    ``telemetry.trace(logdir)`` XPlane context manager."""
+    import paddle_tpu.telemetry.trace  # noqa: F401 (the submodule)
+    assert telemetry.trace.__module__ == "paddle_tpu.telemetry.spans"
