@@ -32,9 +32,7 @@ outage still fails fast with one well-formed error row per metric.
 """
 
 import gc
-import subprocess
 import sys
-import time
 
 import numpy as np
 
@@ -82,29 +80,15 @@ _ROWS_SCHEMA = [
 
 def _attach_probe_with_retry() -> bool:
     """Probe ``jax.devices()`` in a subprocess with a hard-kill timeout;
-    retry once after ``RETRY_BACKOFF`` seconds (VERDICT r4 #2)."""
-    for attempt in (1, 2):
-        # the probe requires the tpu backend (outside --smoke): a silent
-        # CPU fallback during an outage must NOT count as attached, or
-        # chipless numbers would be recorded as TPU results
-        p = subprocess.Popen(
-            [sys.executable, "-c",
-             "import paddle_tpu, jax, sys; jax.devices(); "
-             "sys.exit(0 if jax.default_backend() == 'tpu' "
-             f"or {SMOKE} else 4)"])
-        try:
-            if p.wait(timeout=ATTACH_TIMEOUT) == 0:
-                return True
-        except subprocess.TimeoutExpired:
-            p.kill()         # SIGKILL: a blocked PJRT attach ignores TERM
-            p.wait()
-        if attempt == 1:
-            # stderr: stdout carries only schema-conforming rows
-            print("attach probe failed; retrying once after "
-                  f"{RETRY_BACKOFF:.0f}s backoff", file=sys.stderr,
-                  flush=True)
-            time.sleep(RETRY_BACKOFF)
-    return False
+    retry once after ``RETRY_BACKOFF`` seconds (VERDICT r4 #2).  The
+    protocol lives in ``paddle_tpu/utils/attach.py`` now, shared with
+    ``benchmark/lm_decode.py``; outside --smoke the probe requires the
+    tpu backend — a silent CPU fallback during an outage must not count
+    as attached."""
+    from paddle_tpu.utils.attach import attach_probe_with_retry
+    return attach_probe_with_retry(require_tpu=not SMOKE,
+                                   timeout=ATTACH_TIMEOUT,
+                                   backoff=RETRY_BACKOFF)
 
 
 def _lstm_row():

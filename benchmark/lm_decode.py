@@ -62,6 +62,13 @@ def main():
     ap.add_argument("--pool-blocks", type=int, default=0,
                     help="paged pool size (0 = dense-equivalent "
                          "batch * ceil(max_len/block_size))")
+    ap.add_argument("--paged-kernel", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="paged decode-attention implementation: auto = "
+                         "Pallas kernel on TPU / XLA gather elsewhere, "
+                         "on = force the kernel (interpret mode off-"
+                         "TPU), off = force the gather form — the row "
+                         "carries the resolved choice as paged_kernel")
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="append a telemetry snapshot record (the row as "
                          "meta + the process registry, raw differential "
@@ -80,8 +87,21 @@ def main():
         ap.error("--paged requires --decoder serve")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
+    from paddle_tpu.utils.attach import attach_probe_with_retry
     from paddle_tpu.utils.watchdog import attach_watchdog
 
+    # bench.py's attachment protocol (BENCH_r04 was lost to a wedged
+    # PJRT attach; ROADMAP asks for this reuse): probe in a subprocess
+    # with SIGKILL + one backoff-retry BEFORE this process touches the
+    # device.  require_tpu=False — the row carries the backend, so a
+    # CPU run is a labeled result here, not a silent fallback.
+    if not attach_probe_with_retry(require_tpu=False):
+        import json
+        print(json.dumps({"metric": "lm_decode", "value": 0.0,
+                          "unit": "tokens/s",
+                          "error": "device attach timed out "
+                                   "(after 1 retry)"}))
+        sys.exit(1)
     disarm = attach_watchdog(240.0, {"metric": "lm_decode", "value": 0.0,
                                      "unit": "tokens/s"})
     import jax
@@ -120,7 +140,9 @@ def main():
             from paddle_tpu.serving import paged_serve_builder
             decode = paged_serve_builder(
                 cfg, block_size=args.block_size,
-                num_blocks=args.pool_blocks or None)
+                num_blocks=args.pool_blocks or None,
+                decode_kernel={"auto": None, "on": True,
+                               "off": False}[args.paged_kernel])
         else:
             builder = (lm_serve_builder if args.decoder == "serve"
                        else lm_generate_builder)
@@ -176,6 +198,9 @@ def main():
                               else [args.prompt] * args.batch)],
             block_size=args.block_size, **kw)
         row.update({
+            # resolved kernel choice (not the knob): the crossover
+            # analysis joins kernel-on vs kernel-off rows on this key
+            "paged_kernel": bool(decode.decode_kernel),
             "block_size": args.block_size,
             "pool_blocks": args.pool_blocks
             or args.batch * -(-max_len // args.block_size),

@@ -41,7 +41,13 @@ echo "== tpu-lint: jaxpr + SPMD self-check over registered entrypoints =="
 # error-severity finding (accum-dtype, host-callback-in-loop, and the
 # shard family: entrypoints with a ShardRecipe lower under a 2-device
 # CPU mesh and their compiled HLO is checked for collective-in-decode,
-# mesh-axis-mismatch, ...).  Three gates in one invocation:
+# mesh-axis-mismatch, ...).  The paged serve/engine entrypoints lint
+# TWICE — XLA gather form and the kernel-selected -kernel twins
+# (Pallas interpret mode; kernel bodies are opaque to the jaxpr rules,
+# and the decode-loop attention gathers must be gone, zero new
+# suppressions).  The -kernel shard recipes stay replicated-under-mesh:
+# the slot-shared-pool rationale is unchanged and GSPMD cannot
+# partition a pallas_call.  Three gates in one invocation:
 #   --budgets      per-shard peak-HBM estimate vs analysis/budgets.json
 #   --warn-ratchet post-suppression warn count can only go DOWN
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
@@ -50,8 +56,9 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
 
 echo "== telemetry gate: instrumented smoke + schema + trace + overhead + re-lint =="
 # Drives a real instrumented paged-serving run with the request-level
-# tracer ON (compiles must stay {'decode': 1} WITH telemetry AND
-# tracing on), validates the snapshot against the documented schema
+# tracer ON and the Pallas decode kernel SELECTED (interpret mode on
+# CPU; compiles must stay {'decode': 1} WITH telemetry AND tracing AND
+# the kernel on), validates the snapshot against the documented schema
 # through the JSONL/Prometheus exporters, round-trips the request
 # trace (JSONL + per-request waterfalls + Chrome trace-event export
 # structure), bounds the per-observation overhead (metric inc/observe

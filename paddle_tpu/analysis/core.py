@@ -268,6 +268,14 @@ def _descend(eqn, rules, ctx: LintContext, state: WalkState):
             inner = _closed(inner)
             t = _inner_taint(state, eqn.invars, inner.jaxpr.invars)
             _walk(inner, rules, ctx, state.at(prim, tainted=t))
+    elif prim == "pallas_call":
+        # Kernel bodies are OPAQUE: the inner jaxpr runs under Mosaic's
+        # machine model (VMEM refs, explicit grid pipelining), where
+        # XLA-HBM rules like gather-in-decode are category errors — a
+        # kernel's ref indexing would false-fire them.  The memory
+        # estimator already treats pallas_call as a leaf for the same
+        # reason (memory.py _sub_jaxprs).
+        return
     else:
         # generic fallback (remat/checkpoint, closed_call, ...): walk any
         # jaxpr-valued param without taint mapping — better to see inside

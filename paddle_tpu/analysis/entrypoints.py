@@ -182,3 +182,48 @@ def _paged_engine_decode() -> LintTarget:
                           "dp over slot vectors; pool + block tables "
                           "replicated until the multi-chip pool item "
                           "lands (ROADMAP)"))
+
+
+# Kernel-selected twins: the same serve programs with decode_kernel
+# FORCED on (Pallas interpret mode on the CPU lint backend — the
+# traced jaxpr carries the pallas_call eqn either way, which is what
+# the gate is for: the kernel body must stay opaque to the XLA-HBM
+# rules and the attention gathers must be GONE from the decode loop,
+# with zero new suppressions).  Both recipes are replicated-under-mesh:
+# the paged-serve rationale above still holds unchanged, and
+# additionally GSPMD cannot partition a pallas_call — the same reason
+# the Trainer traces under fusion_disabled() when sharding rules are
+# active — so a sharded kernel recipe is the multi-chip pool item's
+# problem, not this gate's.
+
+
+@register_entrypoint("paged-serve-step-kernel")
+def _paged_serve_step_kernel() -> LintTarget:
+    from paddle_tpu.serving import paged_serve_builder
+    serve = paged_serve_builder(_tiny_cfg(), block_size=8,
+                                decode_kernel=True)
+    prompts = jnp.zeros((2, 4), jnp.int32)
+    return LintTarget(
+        "paged-serve-step-kernel", serve._jit,
+        (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
+         0.0, None, None, None, None, None),
+        recipe=_dp_recipe(9, (), "replicated under the mesh — "
+                          "paged-serve-step rationale, plus GSPMD "
+                          "cannot partition a pallas_call"))
+
+
+@register_entrypoint("paged-engine-decode-kernel")
+def _paged_engine_decode_kernel() -> LintTarget:
+    from paddle_tpu.serving import PagedServingEngine
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,), decode_kernel=True)
+    S = eng.S
+    return LintTarget(
+        "paged-engine-decode-kernel", eng._decode,
+        (eng.params, eng.cache, jnp.zeros((S,), jnp.int32),
+         jnp.ones((S,), bool), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, (), "replicated under the mesh — slot "
+                          "vectors could dp-shard, but GSPMD cannot "
+                          "partition the pallas_call they feed"))

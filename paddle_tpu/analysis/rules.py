@@ -234,10 +234,13 @@ class GatherInDecodeRule(Rule):
             f"{_dtype_name(operand)} with carry-dependent indices runs "
             "every loop iteration",
             eqn=eqn, attach_cost=True,
-            suggestion="expected for paged-KV decode (the crossover is "
-                       "a measured trade — see ROADMAP); otherwise "
-                       "hoist the indices or fuse the gather into a "
-                       "kernel")
+            suggestion="fuse the gather into a kernel — the Pallas "
+                       "paged decode kernel "
+                       "(ops/pallas_paged_attention.py) is the worked "
+                       "example, and kernel bodies are opaque to this "
+                       "rule; otherwise hoist the indices, or suppress "
+                       "if the per-step gather is the op's contract "
+                       "(free-list alloc, KV append)")
 
 
 # ------------------------------------------------------------- dead-code

@@ -25,15 +25,23 @@ is numerically IDENTICAL to the dense ``dot_product_attention`` decode
 path over the same tokens — masked positions carry exactly-zero softmax
 weight, so even the pool's garbage rows (unwritten blocks, the clipped
 ``-1`` table entries) cannot perturb the output; the paged-vs-dense
-token-identity test pins this.  The signature is the drop-in point for
-a Pallas kernel later (ROADMAP open item): same (q, pools, table,
-lengths) -> out contract, with the XLA gather form as the everywhere
-fallback, mirroring how ``flash_attention_fn`` wraps its kernel.
+token-identity test pins this.  On TPU the op dispatches to the fused
+Pallas kernel (``ops/pallas_paged_attention.py`` — pages streamed into
+VMEM by block table, online softmax, no ``[b, max_blocks*bs, h, hd]``
+materialization); everywhere else, and for shapes past the kernel's
+VMEM budget, the XLA gather form serves as the fallback — the same
+dispatch contract ``flash_attention_fn`` and ``fused_lstm_scan`` use.
+:func:`decode_kernel_scope` forces the choice (the serve builders
+resolve it once at build time and enter the scope inside their traced
+bodies); off-TPU a forced kernel runs in Pallas interpret mode, which
+is how the tier-1 parity suite pins kernel == fallback on CPU.
 """
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import NamedTuple, Tuple
 
 import jax
@@ -235,6 +243,68 @@ def paged_append(view: PagedLayerView, k_new: jax.Array,
     return k_pages, v_pages
 
 
+# --- decode-attention kernel selection -------------------------------
+#
+# Tri-state knob, threaded the same way pallas_kernels._fusion_enabled
+# is: None = auto (TPU backend + fusion on + shape supported), True =
+# force the kernel (interpret mode off-TPU — the CPU parity path;
+# still falls back past the VMEM budget rather than OOM Mosaic),
+# False = force the XLA gather form.  Builders resolve the knob to a
+# bool once at build time (resolve_decode_kernel) and enter
+# decode_kernel_scope inside their traced bodies so the dispatch below
+# sees it at trace time.
+
+_decode_kernel_override = threading.local()
+
+
+@contextlib.contextmanager
+def decode_kernel_scope(select):
+    """Pin decode-attention kernel selection under this context:
+    ``True`` = kernel (interpret mode off-TPU), ``False`` = XLA gather
+    form, ``None`` = auto.  Scopes nest; the previous value restores on
+    exit."""
+    prev = getattr(_decode_kernel_override, "value", None)
+    _decode_kernel_override.value = select
+    try:
+        yield
+    finally:
+        _decode_kernel_override.value = prev
+
+
+def resolve_decode_kernel(select, *, block_size: int, num_heads: int,
+                          head_dim: int, kv_dtype=jnp.float32) -> bool:
+    """Resolve a builder's tri-state ``decode_kernel`` knob to the bool
+    it stores and scopes: ``None`` auto-selects (TPU backend + fusion
+    enabled + shape within the kernel's VMEM budget); ``True`` forces
+    the kernel wherever the shape is supported (interpret mode off-TPU);
+    ``False`` forces the XLA gather form.  A forced ``True`` on an
+    unsupported shape still resolves ``False`` — oversized configs must
+    degrade to the fallback, never OOM Mosaic."""
+    from paddle_tpu.ops.pallas_paged_attention import (
+        paged_attention_supported)
+    supported = paged_attention_supported(block_size, num_heads,
+                                          head_dim, kv_dtype)
+    if select is None:
+        from paddle_tpu.ops.pallas_kernels import _fusion_on, _on_tpu
+        return bool(supported and _on_tpu() and _fusion_on())
+    return bool(select and supported)
+
+
+def _use_kernel(q, k_pages, scale) -> bool:
+    """Trace-time dispatch decision for :func:`paged_decode_attention`."""
+    if q.shape[1] != 1:
+        return False            # kernel serves 1-token decode queries
+    if scale is not None:
+        try:                    # kernel closes over a static scale
+            float(scale)
+        except Exception:       # traced scalar -> XLA form
+            return False
+    select = getattr(_decode_kernel_override, "value", None)
+    return resolve_decode_kernel(
+        select, block_size=k_pages.shape[1], num_heads=k_pages.shape[2],
+        head_dim=k_pages.shape[3], kv_dtype=k_pages.dtype)
+
+
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, block_table: jax.Array,
                            lengths: jax.Array,
@@ -242,23 +312,49 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     """Decode attention by block table: ``q`` [b, 1, h, hd] attends each
     row's ``lengths[r]`` committed tokens gathered from the pools.
 
-    XLA form: gather ``[b, max_blocks, bs, h, hd]``, flatten the token
-    axis (logical position p IS flattened index p — blocks gather in
-    table order), einsum with f32 accumulation, finite-NEG_INF mask to
-    the per-row length, f32 softmax.  Masked/garbage positions get
-    exactly-zero weight, so the result is bit-identical to the dense
-    cache path over the same tokens.  A Pallas paged-attention kernel
-    (ROADMAP open item) drops in behind this exact signature; this
-    gather form stays as the everywhere (CPU/interpret) fallback.
+    Dispatch (the ``fused_lstm_scan`` / ``flash_attention_fn``
+    contract): on TPU — or under ``decode_kernel_scope(True)`` — the
+    fused Pallas kernel (``ops/pallas_paged_attention.py``) streams
+    pages into VMEM by block table with an online softmax; everywhere
+    else, and for shapes past the kernel's VMEM budget or traced
+    ``scale``, the XLA gather form below serves.  Both paths share the
+    finite-NEG_INF masking convention, so masked/garbage positions get
+    exactly-zero weight and the result is bit-identical to the dense
+    cache path over the same tokens; the interpret-mode parity suite
+    pins kernel == fallback within 1e-6 on every nasty shape.
+    """
+    if _use_kernel(q, k_pages, scale):
+        from paddle_tpu.ops.pallas_paged_attention import (
+            paged_decode_attention_kernel)
+        return paged_decode_attention_kernel(q, k_pages, v_pages,
+                                             block_table, lengths, scale)
+    return _paged_decode_attention_xla(q, k_pages, v_pages, block_table,
+                                       lengths, scale)
+
+
+def _paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
+                                v_pages: jax.Array,
+                                block_table: jax.Array,
+                                lengths: jax.Array,
+                                scale=None) -> jax.Array:
+    """The XLA gather form — the everywhere fallback, kept verbatim.
+
+    Gather ``[b, max_blocks, bs, h, hd]``, flatten the token axis
+    (logical position p IS flattened index p — blocks gather in table
+    order), einsum with f32 accumulation, finite-NEG_INF mask to the
+    per-row length, f32 softmax.  The K/V gather materializes worst-case
+    table capacity every step — the HBM-traffic cost the Pallas kernel
+    exists to remove; the suppressions below are justified ONLY on this
+    fallback path.
     """
     b, tq, h, hd = q.shape
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
     scale = (hd ** -0.5) if scale is None else scale
     table = jnp.clip(block_table, 0, nb - 1)
-    # tpu-lint: disable=gather-in-decode — the K/V page gather IS paged attention; HBM-vs-gather crossover is the measured trade (ROADMAP)
+    # tpu-lint: disable=gather-in-decode — FALLBACK-ONLY: on TPU the Pallas kernel serves decode and this gather never traces; off-TPU the gather is the portable form
     k = k_pages[table].reshape(b, maxb * bs, h, hd)
-    # tpu-lint: disable=gather-in-decode — same trade as the K gather above
+    # tpu-lint: disable=gather-in-decode — fallback-only, same as the K gather above
     v = v_pages[table].reshape(b, maxb * bs, h, hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -277,7 +373,13 @@ def paged_hbm_bytes(lengths, *, num_layers: int, num_heads: int,
     all layers, whole blocks — internal fragmentation included) for a
     list of actual token counts.  The dense comparison is
     :func:`dense_hbm_bytes` at ``max_len``; ``docs/design/serving.md``
-    works the numbers."""
+    works the numbers.  Note the trade this measures changed with the
+    Pallas kernel: on the XLA fallback the paged FOOTPRINT win is paid
+    for by per-step gather TRAFFIC (worst-case table capacity read
+    every decode step), so a batch-size crossover exists; the kernel
+    streams only mapped pages, removing the traffic side — footprint
+    stays the only term, and the v5e crossover table reduces to a
+    launch-overhead comparison (ROADMAP follow-up)."""
     per_tok = 2 * num_layers * num_heads * head_dim * dtype_bytes
     return [int(math.ceil(n / block_size)) * block_size * per_tok
             for n in lengths]

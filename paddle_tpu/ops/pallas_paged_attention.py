@@ -1,0 +1,230 @@
+"""Pallas TPU paged-attention decode kernel (Ragged Paged Attention style).
+
+The XLA gather form in ``ops/paged_attention.py`` materializes
+``k_pages[table]`` as ``[b, max_blocks*bs, h, hd]`` every decode step —
+HBM traffic proportional to the WORST-CASE table capacity, twice (K and
+V), regardless of how many tokens each row actually holds.  This kernel
+streams the same pages block-by-block instead, the TPU-native shape
+(Ragged Paged Attention, PAPERS.md):
+
+* grid ``(batch row, KV-head group, page)`` — the page axis is the
+  innermost, sequential loop; rows and head groups are independent;
+* the block table rides as a SCALAR-PREFETCH operand, so each page's
+  K/V block is fetched straight from the pool by table lookup in the
+  BlockSpec index map — the Pallas pipeline double-buffers the
+  HBM->VMEM page copies against compute, and nothing bigger than one
+  ``[block_size, group, hd]`` block per pool ever sits in VMEM;
+* online-softmax accumulation (the ``blockwise_attn_chunk`` merge rule)
+  in f32 VMEM scratch across the page loop — running max / sum / acc,
+  one division at the end, no ``[b, K]`` weight matrix anywhere;
+* per-row ``lengths`` masking with the same finite ``NEG_INF``
+  convention as the fallback: positions past a row's length — garbage
+  tails inside the last real page, unwritten pages behind clipped
+  ``-1`` table entries — get exactly-zero weight, so the kernel is
+  numerically the fallback's twin (the interpret-mode parity suite
+  pins max-abs <= 1e-6 on f32 pools).
+
+A "KV-head group" is the contiguous chunk of heads processed per grid
+step: :func:`_head_group` picks the largest divisor of ``num_heads``
+whose double-buffered working set fits the VMEM budget, so big
+``block_size x heads x head_dim`` configs degrade to smaller groups —
+and past the g=1 working set, :func:`paged_attention_supported` says no
+and the dispatcher keeps the XLA gather form instead of OOMing Mosaic
+(the ``_RESIDENT_BUDGET`` idiom from ``ops/pallas_kernels.py``).
+
+Dispatch lives in ``ops/paged_attention.py::paged_decode_attention``
+(TPU backend -> this kernel, everywhere else -> the XLA gather form);
+off-TPU this kernel runs in Pallas interpret mode, which is how the
+tier-1 suite cross-checks it on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable everywhere jax is, but guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from paddle_tpu.ops.pallas_kernels import _on_tpu
+
+__all__ = ["paged_decode_attention_kernel", "paged_attention_supported"]
+
+NEG_INF = -1e30   # finite mask value — MUST match ops/paged_attention.py
+
+# Budget for the per-grid-step working set estimated below — the
+# ``_RESIDENT_BUDGET`` idiom from ops/pallas_kernels.py (14.5 MB of the
+# ~16 MB/core VMEM, headroom for Mosaic's own temporaries).  The LSTM
+# budget is anchored on v5e compile probes; this kernel's working set
+# is page-sized (KBs at serving shapes — bs=16 h=16 hd=128 bf16
+# estimates ~0.4 MB), so the budget only bites at absurd configs
+# (block_size in the thousands), which is exactly the OOM guard's job.
+# Re-anchor with compile probes when the v5e crossover measurement runs
+# (ROADMAP follow-up).
+_PAGED_RESIDENT_BUDGET = 14 * 1024 * 1024 + 512 * 1024
+
+
+def _paged_vmem_bytes(block_size: int, group: int, head_dim: int,
+                      kv_dtype) -> int:
+    """Estimated VMEM residency of one grid step at head-group ``group``.
+
+    The streamed blocks (one K and one V page slice of
+    ``[block_size, group, head_dim]``) are double-buffered by the Pallas
+    pipeline.  bf16 pools are charged MORE than f32 (6 vs 4 bytes/elt),
+    not less — Mosaic stages (2,1)-packed bf16 tiles through unpacked
+    copies (the measured behavior behind the LSTM budget's probe table
+    in ops/pallas_kernels.py).
+    """
+    per_elt = 6 if jnp.dtype(kv_dtype) == jnp.bfloat16 else 4
+    streamed = 2 * 2 * block_size * group * head_dim * per_elt  # K+V, 2-buf
+    qo = 2 * 2 * group * head_dim * 4        # q in + f32 out blocks, 2-buf
+    scratch = group * head_dim * 4 + 2 * group * 4   # acc + (m, l)
+    return streamed + qo + scratch
+
+
+def _head_group(num_heads: int, block_size: int, head_dim: int,
+                kv_dtype) -> int:
+    """Heads per grid step: the largest divisor of ``num_heads`` whose
+    working set fits the budget, 0 when even one head does not fit
+    (the caller must fall back)."""
+    for g in range(num_heads, 0, -1):
+        if num_heads % g:
+            continue
+        if _paged_vmem_bytes(block_size, g, head_dim,
+                             kv_dtype) <= _PAGED_RESIDENT_BUDGET:
+            return g
+    return 0
+
+
+def paged_attention_supported(block_size: int, num_heads: int,
+                              head_dim: int,
+                              kv_dtype=jnp.float32) -> bool:
+    """Shape/VMEM gate for the paged decode kernel (the
+    ``pallas_supported`` twin): True when some head group's working set
+    fits the budget.  The dispatcher falls back to the XLA gather form
+    otherwise — oversized configs must degrade, not OOM Mosaic."""
+    if pltpu is None:
+        return False
+    return _head_group(num_heads, block_size, head_dim, kv_dtype) > 0
+
+
+def _decode_kernel(group: int, scale: float, table_ref, lens_ref,
+                   q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+    """One (row, head-group, page) grid step of the online softmax.
+
+    Refs: ``table_ref``/``lens_ref`` are the scalar-prefetch operands
+    (the clipped block table and per-row lengths), ``q_ref`` is the
+    row's ``[1, 1, group, hd]`` query block, ``k_ref``/``v_ref`` the
+    page's ``[1, bs, group, hd]`` pool blocks fetched by table lookup
+    in the index map.  Scratch carries the running (acc, max, sum) in
+    f32 across the page loop; the output writes once, on the last page.
+    """
+    b_i = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    bs = k_ref.shape[1]
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Page p's block holds global positions [p*bs, (p+1)*bs): the
+    # logical position IS the flattened (page, offset) index, the same
+    # invariant the fallback's reshape relies on.  Everything at or
+    # past the row's length — the garbage tail of the last real page,
+    # whole unwritten pages behind clipped -1 table entries — takes the
+    # finite NEG_INF bias and exactly-zero weight out of the exp.
+    pos = p * bs + lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+    bias = jnp.where(pos < lens_ref[b_i], 0.0, NEG_INF)      # [1, bs] f32
+
+    for i in range(group):                  # static unroll over the group
+        q_i = q_ref[0, 0, i:i + 1, :]                        # [1, hd]
+        k_i = k_ref[0, :, i, :]                              # [bs, hd]
+        s = lax.dot_general(q_i, k_i, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        s = s * scale + bias                                 # [1, bs] f32
+        m_prev = m_ref[i:i + 1, :]                           # [1, 1]
+        l_prev = l_ref[i:i + 1, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        w = jnp.exp(s - m_new)                               # [1, bs]
+        v_i = v_ref[0, :, i, :].astype(jnp.float32)          # [bs, hd]
+        pv = lax.dot_general(w, v_i, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_ref[i:i + 1, :] = acc_ref[i:i + 1, :] * alpha + pv
+        l_ref[i:i + 1, :] = l_prev * alpha + jnp.sum(w, axis=1,
+                                                     keepdims=True)
+        m_ref[i:i + 1, :] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        o_ref[0, 0] = acc_ref[:] / l_ref[:]
+
+
+def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
+                                  v_pages: jax.Array,
+                                  block_table: jax.Array,
+                                  lengths: jax.Array, scale=None, *,
+                                  interpret=None, head_group=None):
+    """Fused block-table decode attention — the Pallas twin of the XLA
+    gather form behind the exact same ``(q, pools, table, lengths) ->
+    [b, 1, h, hd] f32`` contract (``ops/paged_attention.py``).
+
+    ``interpret=None`` auto-selects interpret mode off-TPU (the CPU
+    test path); ``head_group`` overrides the VMEM-fitted heads-per-step
+    (tests exercise group 1 vs all-heads explicitly).  Call through
+    ``paged_decode_attention`` unless you are the dispatcher or a test.
+    """
+    b, tq, h, hd = q.shape
+    nb, bs = k_pages.shape[0], k_pages.shape[1]
+    maxb = block_table.shape[1]
+    assert tq == 1, f"decode kernel serves 1-token queries, got t={tq}"
+    scale = (hd ** -0.5) if scale is None else float(scale)
+    if interpret is None:
+        interpret = not _on_tpu()
+    g = head_group or _head_group(h, bs, hd, k_pages.dtype)
+    assert 0 < g <= h and h % g == 0, (
+        f"no head group fits VMEM for block_size={bs} heads={h} "
+        f"head_dim={hd} — the dispatcher should have taken the XLA "
+        "fallback (paged_attention_supported)")
+    # Same clip as the fallback: a -1 (unmapped) entry fetches page 0,
+    # whose positions are all >= the row's length and mask to zero.
+    table = jnp.clip(block_table, 0, nb - 1).astype(jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,               # (table, lens) ride in SMEM
+        grid=(b, h // g, maxb),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
+            pl.BlockSpec((1, bs, g, hd),
+                         lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
+            pl.BlockSpec((1, bs, g, hd),
+                         lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, hd), jnp.float32),    # acc
+            pltpu.VMEM((g, 1), jnp.float32),     # running max
+            pltpu.VMEM((g, 1), jnp.float32),     # running sum
+        ])
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, g, scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, hd), jnp.float32),
+        interpret=interpret,
+        **kwargs)(table, lens, q, k_pages, v_pages)

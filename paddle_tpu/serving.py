@@ -72,7 +72,8 @@ def _paged_model(cfg: TransformerConfig, attn_fn):
 def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
                         block_size: int = 16,
                         max_blocks_per_slot: Optional[int] = None,
-                        num_blocks: Optional[int] = None):
+                        num_blocks: Optional[int] = None,
+                        decode_kernel=None):
     """Serving-shaped PAGED decode: ``lm_serve_builder``'s contract
     (traced ``steps``, one compiled program per prompt bucket, eos
     early exit, PAD past each row's end) over the block-pool cache.
@@ -98,6 +99,15 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     hold the request's worst case (actual prompt lengths + ``steps``),
     and a traced-``steps`` overflow poisons the output with ``-1``
     (a fixed-shape program cannot raise).
+
+    ``decode_kernel`` selects the decode-attention implementation (the
+    tri-state ``paged.resolve_decode_kernel`` knob, resolved ONCE here
+    at build time and pinned for the program's lifetime): ``None`` =
+    auto (Pallas kernel on TPU, XLA gather form elsewhere), ``True`` =
+    force the kernel (interpret mode off-TPU — the parity-test path),
+    ``False`` = force the gather form.  The resolved bool is exposed as
+    ``serve.decode_kernel`` for telemetry rows; either way the program
+    still compiles exactly once per bucket.
     """
     model = _paged_model(cfg, attn_fn)
     hd = cfg.dim // cfg.num_heads
@@ -105,10 +115,23 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     maxb = (max_blocks_per_slot if max_blocks_per_slot
             else -(-cfg.max_len // bs))
     cap = min(cfg.max_len, maxb * bs)     # per-slot token capacity
+    use_kernel = paged.resolve_decode_kernel(
+        decode_kernel, block_size=bs, num_heads=cfg.num_heads,
+        head_dim=hd, kv_dtype=get_policy().compute_dtype)
 
     @functools.partial(jax.jit, static_argnums=(5, 6, 7))
     def _pserve(params, prompt_ids, steps, temperature=0.0, rng=None,
                 eos_id=None, top_k=None, top_p=None, prompt_lens=None):
+        # The scope pins decode-attention dispatch AT TRACE TIME —
+        # prefill calls (t>1 queries) take the XLA form regardless;
+        # the per-step t=1 attention inside the while_loop body takes
+        # the kernel iff use_kernel resolved True at build.
+        with paged.decode_kernel_scope(use_kernel):
+            return _pserve_impl(params, prompt_ids, steps, temperature,
+                                rng, eos_id, top_k, top_p, prompt_lens)
+
+    def _pserve_impl(params, prompt_ids, steps, temperature, rng,
+                     eos_id, top_k, top_p, prompt_lens):
         b, tp = prompt_ids.shape
         max_new = cap - tp
         assert max_new >= 1, (
@@ -227,6 +250,7 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     serve._lint_batch_args = (1,)
     serve.block_size = bs
     serve.max_blocks_per_slot = maxb
+    serve.decode_kernel = use_kernel   # resolved choice, for bench rows
     return serve
 
 
@@ -260,6 +284,12 @@ class PagedServingEngine:
     ``prompt_buckets`` are the prefill pad widths (one prefill compile
     per bucket actually used); ``eos_id``/``top_k``/``top_p`` are
     engine-static (a serving process fixes its tokenizer and sampler).
+    ``decode_kernel`` picks the decode-attention implementation (the
+    same tri-state knob as ``paged_serve_builder``: None = Pallas
+    kernel on TPU / XLA gather elsewhere, True forces the kernel —
+    interpret mode off-TPU, the CI path — False forces the gather
+    form); the resolved bool lands in ``self.decode_kernel`` and the
+    ``compiles == {'decode': 1}`` pin holds either way.
 
     The engine is deeply instrumented through ``paddle_tpu.telemetry``
     (``metrics=`` takes a :class:`~paddle_tpu.telemetry.MetricsRegistry`;
@@ -289,7 +319,7 @@ class PagedServingEngine:
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
                  metrics=None, tracer=None,
                  flight_recorder: Optional[str] = None,
-                 flight_window_s: float = 30.0):
+                 flight_window_s: float = 30.0, decode_kernel=None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -305,36 +335,53 @@ class PagedServingEngine:
         hd = cfg.dim // cfg.num_heads
         model = _paged_model(cfg, attn_fn)
         S = self.S
+        # Decode-attention implementation, resolved once for the
+        # engine's lifetime (same tri-state knob as paged_serve_builder;
+        # None = kernel on TPU, True forces it in interpret mode off-TPU
+        # for the parity/CI path, False forces the XLA gather form).
+        self.decode_kernel = paged.resolve_decode_kernel(
+            decode_kernel, block_size=block_size,
+            num_heads=cfg.num_heads, head_dim=hd,
+            kv_dtype=get_policy().compute_dtype)
+        use_kernel = self.decode_kernel
 
         def decode_fn(params, cache, tok, active, temps, done, key):
-            act = active.astype(jnp.int32)
-            cache, ok = paged.paged_reserve(cache, act)
-            views = paged.layer_views(cache, jnp.arange(S), act)
-            (lg, views), _ = model.apply(params, {}, None, tok[:, None],
-                                         views, cache.lengths[:, None])
-            cache = paged.paged_advance(paged.merge_views(cache, views),
-                                        act)
-            pick = _sampling_picker(cfg, temps, jnp.int32, eos_id,
-                                    top_k, top_p)
-            nxt, done = pick(lg[:, -1], key, done)
-            return cache, nxt, done, ok
+            # the scope pins decode-attention dispatch at trace time
+            with paged.decode_kernel_scope(use_kernel):
+                act = active.astype(jnp.int32)
+                cache, ok = paged.paged_reserve(cache, act)
+                views = paged.layer_views(cache, jnp.arange(S), act)
+                (lg, views), _ = model.apply(params, {}, None,
+                                             tok[:, None], views,
+                                             cache.lengths[:, None])
+                cache = paged.paged_advance(
+                    paged.merge_views(cache, views), act)
+                pick = _sampling_picker(cfg, temps, jnp.int32, eos_id,
+                                        top_k, top_p)
+                nxt, done = pick(lg[:, -1], key, done)
+                return cache, nxt, done, ok
 
         def prefill_fn(params, cache, slot, prompt, plen, temp, key):
-            want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
-            cache, ok = paged.paged_reserve(cache, want)
-            views = paged.layer_views(cache, slot[None], plen[None])
-            w = prompt.shape[1]
-            pos_ids = jnp.arange(w)[None, :]
-            (lg, views), _ = model.apply(params, {}, None, prompt,
-                                         views, pos_ids)
-            cache = paged.paged_advance(paged.merge_views(cache, views),
-                                        want)
-            last = jax.lax.dynamic_index_in_dim(lg[0], plen - 1, axis=0,
-                                                keepdims=False)
-            pick = _sampling_picker(cfg, jnp.asarray(temp, jnp.float32),
-                                    jnp.int32, eos_id, top_k, top_p)
-            tok0, done0 = pick(last[None], key, jnp.zeros((1,), bool))
-            return cache, tok0[0], done0[0], ok
+            # same scope for symmetry; t>1 queries take the XLA form
+            with paged.decode_kernel_scope(use_kernel):
+                want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
+                cache, ok = paged.paged_reserve(cache, want)
+                views = paged.layer_views(cache, slot[None], plen[None])
+                w = prompt.shape[1]
+                pos_ids = jnp.arange(w)[None, :]
+                (lg, views), _ = model.apply(params, {}, None, prompt,
+                                             views, pos_ids)
+                cache = paged.paged_advance(
+                    paged.merge_views(cache, views), want)
+                last = jax.lax.dynamic_index_in_dim(lg[0], plen - 1,
+                                                    axis=0,
+                                                    keepdims=False)
+                pick = _sampling_picker(cfg,
+                                        jnp.asarray(temp, jnp.float32),
+                                        jnp.int32, eos_id, top_k, top_p)
+                tok0, done0 = pick(last[None], key,
+                                   jnp.zeros((1,), bool))
+                return cache, tok0[0], done0[0], ok
 
         # The cache (pool + block tables) is DEAD the moment each step
         # returns its successor — donate it so XLA updates the pool

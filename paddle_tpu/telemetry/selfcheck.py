@@ -3,13 +3,15 @@
 Five checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
-   (fresh registry, request-level tracer ON) drives real requests to
+   (fresh registry, request-level tracer ON, ``decode_kernel=True`` so
+   the Pallas paged-attention path — interpret mode on this CPU gate —
+   is the one under instrumentation) drives real requests to
    completion; the snapshot must carry the documented serving metrics
    with data in them (TTFT/queue-wait/step histograms populated,
    occupancy gauges set, retire counters matching request count) and
    the ``compiles == {'decode': 1}`` contract must still hold WITH
    instrumentation AND tracing on — proof telemetry did not perturb
-   tracing.
+   tracing, kernel included.
 2. **Schema + exporters** — the live snapshot passes
    :func:`validate_snapshot`, round-trips through the JSONL writer,
    and renders to Prometheus text containing the expected families.
@@ -64,6 +66,7 @@ REQUIRED_SERVING_METRICS = (
 #: lint re-check proves instrumentation stayed host-side.
 INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode",
+    "paged-engine-decode-kernel",
     "paged-serve-step",
     "trainer-train-step",
 )
@@ -91,9 +94,13 @@ def _check_serving_smoke():
 
     reg = MetricsRegistry("selfcheck")
     tracer = Tracer(name="selfcheck")
+    # decode_kernel=True: the overhead + compiles gates must hold on
+    # the Pallas kernel path, not just the XLA gather fallback
+    # (interpret mode on the CPU gate; the real kernel on TPU)
     eng = PagedServingEngine(cfg, params, num_slots=2, num_blocks=8,
                              block_size=8, prompt_buckets=(8,),
-                             metrics=reg, tracer=tracer)
+                             metrics=reg, tracer=tracer,
+                             decode_kernel=True)
     rs = np.random.RandomState(0)
     pr = rs.randint(0, cfg.vocab_size, (3, 6)).astype(np.int32)
     n_req = 3

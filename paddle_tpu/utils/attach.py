@@ -1,0 +1,54 @@
+"""Device-attachment probe with a hard-kill timeout and one retry.
+
+A wedged PJRT attach (libtpu held by a dying process, a mid-repair
+pod) hangs ``jax.devices()`` forever and ignores SIGTERM — BENCH_r04
+was lost to exactly this.  The cure, proven in ``bench.py`` (VERDICT
+r4 #2): probe the attach in a SUBPROCESS first, SIGKILL it past the
+timeout, back off once, retry once.  Only after the probe succeeds
+does the caller touch the device from its own process.
+
+Shared here so every benchmark harness (``bench.py``,
+``benchmark/lm_decode.py``) uses the identical protocol instead of
+each growing its own — the ROADMAP measurement item asks for this
+reuse by name.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+ATTACH_TIMEOUT = 240.0   # seconds before the probe is hard-killed
+RETRY_BACKOFF = 30.0     # seconds between the two attempts
+
+
+def attach_probe_with_retry(*, require_tpu: bool,
+                            timeout: float = ATTACH_TIMEOUT,
+                            backoff: float = RETRY_BACKOFF) -> bool:
+    """Probe ``jax.devices()`` in a subprocess; retry once after
+    ``backoff`` seconds.  Returns True when a probe attached in time.
+
+    ``require_tpu=True`` additionally demands the tpu backend: a silent
+    CPU fallback during an outage must NOT count as attached, or
+    chipless numbers would be recorded as TPU results.  Harnesses whose
+    rows carry the backend explicitly (``lm_decode``) pass False.
+    """
+    for attempt in (1, 2):
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import paddle_tpu, jax, sys; jax.devices(); "
+             "sys.exit(0 if jax.default_backend() == 'tpu' "
+             f"or {not require_tpu} else 4)"])
+        try:
+            if p.wait(timeout=timeout) == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            p.kill()         # SIGKILL: a blocked PJRT attach ignores TERM
+            p.wait()
+        if attempt == 1:
+            # stderr: stdout carries only schema-conforming rows
+            print("attach probe failed; retrying once after "
+                  f"{backoff:.0f}s backoff", file=sys.stderr, flush=True)
+            time.sleep(backoff)
+    return False

@@ -1,0 +1,282 @@
+"""Pallas paged-attention decode kernel: interpret-mode parity vs the
+XLA gather fallback (``ops/pallas_paged_attention.py`` behind
+``ops/paged_attention.py::paged_decode_attention``).
+
+The load-bearing pins:
+
+* the kernel (Pallas interpret mode on CPU) matches the XLA gather
+  form within 1e-6 max-abs on f32 pools across the nasty shapes —
+  lengths 0, length exactly on a block boundary, full table, ``-1``
+  unmapped tails — and within a bf16-rounding bound on bf16 pools;
+* masked/garbage positions carry EXACTLY-ZERO weight: poisoning every
+  unwritten pool row with huge values cannot move the output off the
+  dense reference over just the real tokens;
+* dispatch: auto on CPU is the XLA form BITWISE; ``decode_kernel_scope
+  (True)`` selects the kernel under jit; traced ``scale`` and t>1
+  queries fall back; the VMEM estimator degrades head groups and
+  ``paged_attention_supported`` says no before Mosaic would OOM;
+* the serve builder and engine with the kernel selected emit
+  TOKEN-IDENTICAL streams to their XLA-form twins, still compiling
+  exactly once (``_cache_size() == 1`` / ``compiles == {'decode': 1}``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.ops import pallas_paged_attention as pp
+from paddle_tpu.serving import PagedServingEngine, paged_serve_builder
+import paddle_tpu.nn as nn
+
+B, H, HD, NB, BS, MAXB = 3, 4, 32, 16, 8, 5
+
+
+def _fixture(seed=0, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, 1, H, HD), dtype)
+    kp = jnp.asarray(rs.randn(NB, BS, H, HD), dtype)
+    vp = jnp.asarray(rs.randn(NB, BS, H, HD), dtype)
+    table = jnp.asarray([[3, 7, 1, -1, -1],
+                         [2, 5, 9, 11, 4],
+                         [6, -1, -1, -1, -1]], jnp.int32)
+    return q, kp, vp, table
+
+
+# ------------------------------------------------------------- parity
+
+
+# Every nasty length pattern in one sweep: empty row (0), mid-page,
+# exactly on a block boundary (BS and 2*BS), full table (MAXB*BS), and
+# rows whose table tail is -1 (unmapped) past the mapped prefix.
+LENGTH_CASES = [
+    pytest.param([0, 0, 0], id="all-empty"),
+    pytest.param([5, 13, 3], id="mid-page"),
+    pytest.param([BS, 2 * BS, BS], id="block-boundary"),
+    pytest.param([3 * BS, MAXB * BS, 1], id="full-table-row"),
+    pytest.param([0, MAXB * BS, BS - 1], id="mixed-empty-full"),
+]
+
+
+@pytest.mark.parametrize("lens", LENGTH_CASES)
+def test_kernel_matches_xla_f32(lens):
+    q, kp, vp, table = _fixture()
+    lengths = jnp.asarray(lens, jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    out = pp.paged_decode_attention_kernel(q, kp, vp, table, lengths,
+                                           interpret=True)
+    assert out.dtype == jnp.float32 and out.shape == ref.shape
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+
+
+@pytest.mark.parametrize("lens", LENGTH_CASES)
+def test_kernel_matches_xla_f32_head_group_1(lens):
+    # group=1 exercises the (batch, head-group, page) grid with h
+    # steps on the head axis — the degraded-VMEM configuration
+    q, kp, vp, table = _fixture(seed=1)
+    lengths = jnp.asarray(lens, jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    out = pp.paged_decode_attention_kernel(q, kp, vp, table, lengths,
+                                           interpret=True, head_group=1)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+
+
+def test_kernel_matches_xla_bf16_pools():
+    # bf16 pools, f32 accumulation both sides; the paths round bf16 at
+    # slightly different points (the fallback casts WEIGHTS to bf16,
+    # the kernel keeps them f32 and casts v up), so the bound is the
+    # bf16 resolution of O(1) outputs, not 1e-6
+    q, kp, vp, table = _fixture(seed=2, dtype=jnp.bfloat16)
+    lengths = jnp.asarray([5, 2 * BS, 0], jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    out = pp.paged_decode_attention_kernel(q, kp, vp, table, lengths,
+                                           interpret=True)
+    assert out.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(out - ref.astype(jnp.float32)))) <= 2e-2
+
+
+def test_explicit_scale_matches():
+    q, kp, vp, table = _fixture(seed=3)
+    lengths = jnp.asarray([7, 20, 40], jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths,
+                                            scale=0.25)
+    out = pp.paged_decode_attention_kernel(q, kp, vp, table, lengths,
+                                           scale=0.25, interpret=True)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+
+
+def test_garbage_positions_carry_exactly_zero_weight():
+    # Poison EVERY pool row, then overwrite only the mapped/real token
+    # positions: if any masked position (page tails, unmapped -1
+    # entries, whole unwritten blocks) leaked epsilon weight, the 1e4
+    # poison would blow the comparison against the dense reference
+    # computed over just the real tokens.
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(B, 1, H, HD), jnp.float32)
+    kp = np.full((NB, BS, H, HD), 1e4, np.float32)
+    vp = np.full((NB, BS, H, HD), -1e4, np.float32)
+    table = np.asarray([[3, 7, 1, -1, -1],
+                        [2, 5, 9, 11, 4],
+                        [6, 0, -1, -1, -1]], np.int32)
+    lens = [5, 13, BS]          # row 2: boundary, page 0 fully unused
+    k_real = rs.randn(B, MAXB * BS, H, HD).astype(np.float32)
+    v_real = rs.randn(B, MAXB * BS, H, HD).astype(np.float32)
+    for r in range(B):
+        for pos in range(lens[r]):
+            blk = table[r, pos // BS]
+            kp[blk, pos % BS] = k_real[r, pos]
+            vp[blk, pos % BS] = v_real[r, pos]
+    kp, vp = jnp.asarray(kp), jnp.asarray(vp)
+    out = pp.paged_decode_attention_kernel(q, kp, vp,
+                                           jnp.asarray(table),
+                                           jnp.asarray(lens, jnp.int32),
+                                           interpret=True)
+    scale = HD ** -0.5
+    for r in range(B):
+        s = np.einsum("hd,khd->hk", np.asarray(q[r, 0]),
+                      k_real[r, :lens[r]]) * scale
+        w = np.exp(s - s.max(axis=1, keepdims=True))
+        w /= w.sum(axis=1, keepdims=True)
+        dense = np.einsum("hk,khd->hd", w, v_real[r, :lens[r]])
+        np.testing.assert_allclose(np.asarray(out[r, 0]), dense,
+                                   atol=2e-5)
+
+
+# ------------------------------------------- estimator + support gate
+
+
+def test_vmem_estimator_units():
+    f32 = pp._paged_vmem_bytes(16, 4, 128, jnp.float32)
+    # streamed K+V double-buffered + q/out + scratch, all f32
+    assert f32 == (2 * 2 * 16 * 4 * 128 * 4 + 2 * 2 * 4 * 128 * 4
+                   + 4 * 128 * 4 + 2 * 4 * 4)
+    # bf16 pools charge MORE (Mosaic unpacks bf16 tiles), never less
+    assert (pp._paged_vmem_bytes(16, 4, 128, jnp.bfloat16) > f32)
+
+
+def test_head_group_degrades_then_refuses():
+    # serving shapes: all heads fit in one group
+    assert pp._head_group(4, BS, HD, jnp.float32) == 4
+    # big block_size forces smaller groups before refusing outright
+    # (streamed bytes scale with bs*g: 1024 fits 4 of 8 heads, 2048
+    # fits 2, 8192 cannot even stream one head's double buffer)
+    assert pp._head_group(8, 1024, 128, jnp.float32) == 4
+    assert pp._head_group(8, 2048, 128, jnp.float32) == 2
+    assert pp._head_group(8, 8192, 128, jnp.float32) == 0
+    assert pp.paged_attention_supported(BS, H, HD)
+    assert not pp.paged_attention_supported(8192, 8, 128)
+
+
+def test_resolve_decode_kernel_tristate():
+    kw = dict(block_size=BS, num_heads=H, head_dim=HD)
+    # auto on the CPU test backend -> XLA form
+    assert paged.resolve_decode_kernel(None, **kw) is False
+    assert paged.resolve_decode_kernel(True, **kw) is True
+    assert paged.resolve_decode_kernel(False, **kw) is False
+    # forced True on an unsupported shape still degrades
+    assert paged.resolve_decode_kernel(
+        True, block_size=10 ** 6, num_heads=8, head_dim=128) is False
+
+
+# ----------------------------------------------------------- dispatch
+
+
+def test_auto_dispatch_on_cpu_is_xla_bitwise():
+    q, kp, vp, table = _fixture(seed=5)
+    lengths = jnp.asarray([5, 13, 3], jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    out = paged.paged_decode_attention(q, kp, vp, table, lengths)
+    assert bool(jnp.all(out == ref))
+
+
+def test_forced_kernel_under_jit():
+    q, kp, vp, table = _fixture(seed=6)
+    lengths = jnp.asarray([5, 13, 3], jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    with paged.decode_kernel_scope(True):
+        out = jax.jit(paged.paged_decode_attention)(q, kp, vp, table,
+                                                    lengths)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+    with paged.decode_kernel_scope(False):
+        out = paged.paged_decode_attention(q, kp, vp, table, lengths)
+    assert bool(jnp.all(out == ref))
+
+
+def test_traced_scale_falls_back():
+    q, kp, vp, table = _fixture(seed=7)
+    lengths = jnp.asarray([5, 13, 3], jnp.int32)
+    with paged.decode_kernel_scope(True):
+        out = jax.jit(lambda s: paged.paged_decode_attention(
+            q, kp, vp, table, lengths, scale=s))(jnp.float32(0.2))
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths,
+                                            scale=0.2)
+    # same math, but jit fusion may reassociate — allclose, not bitwise
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6)
+
+
+def test_prefill_width_queries_fall_back():
+    # t>1 is the prefill shape: the kernel is decode-only, dispatch
+    # must hand it to the gather form even when forced on
+    rs = np.random.RandomState(8)
+    q = jnp.asarray(rs.randn(B, 4, H, HD), jnp.float32)
+    _, kp, vp, table = _fixture(seed=8)
+    lengths = jnp.asarray([5, 13, 3], jnp.int32)
+    ref = paged._paged_decode_attention_xla(q, kp, vp, table, lengths)
+    with paged.decode_kernel_scope(True):
+        out = paged.paged_decode_attention(q, kp, vp, table, lengths)
+    assert bool(jnp.all(out == ref))
+
+
+# --------------------------------------------- serving integrations
+
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def test_builder_kernel_token_identity_and_one_compile(params):
+    prompts = jax.random.randint(jax.random.key(2), (2, 6), 0,
+                                 CFG.vocab_size)
+    s_xla = paged_serve_builder(CFG, block_size=8, decode_kernel=False)
+    s_ker = paged_serve_builder(CFG, block_size=8, decode_kernel=True)
+    assert s_xla.decode_kernel is False and s_ker.decode_kernel is True
+    for steps in (4, 9):        # two lengths, one program
+        assert bool(jnp.all(s_xla(params, prompts, steps)
+                            == s_ker(params, prompts, steps)))
+    assert s_ker._cache_size() == 1
+    # sampled decode shares the rng-split order across implementations
+    assert bool(jnp.all(
+        s_xla(params, prompts, 6, temperature=0.8,
+              rng=jax.random.key(3))
+        == s_ker(params, prompts, 6, temperature=0.8,
+                 rng=jax.random.key(3))))
+
+
+def test_engine_kernel_token_identity_and_compiles(params):
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, CFG.vocab_size, n).astype(np.int32)
+               for n in (3, 6, 2)]
+    outs = []
+    for kernel in (False, True):
+        eng = PagedServingEngine(CFG, params, num_slots=2,
+                                 num_blocks=12, block_size=8,
+                                 prompt_buckets=(8,),
+                                 decode_kernel=kernel)
+        assert eng.decode_kernel is kernel
+        for p in prompts:
+            eng.submit(p, max_new=5)
+        outs.append(eng.run())
+        assert eng.compile_counts()["decode"] == 1
+    assert outs[0].keys() == outs[1].keys()
+    for rid in outs[0]:
+        np.testing.assert_array_equal(outs[0][rid], outs[1][rid])

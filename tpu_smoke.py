@@ -161,12 +161,24 @@ def main() -> int:
         sp, _ = lm.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
         spr = jnp.asarray(rs.randint(0, 256, (2, 8)), jnp.int32)
         dtoks = np.asarray(lm_serve_builder(scfg)(sp, spr, 16))
-        ptoks = np.asarray(paged_serve_builder(scfg, block_size=16)(
+        ptoks = np.asarray(paged_serve_builder(scfg, block_size=16,
+                                               decode_kernel=False)(
             sp, spr, 16))
         ok = bool((dtoks[:, :24] == ptoks[:, :24]).all())
         print(json.dumps({"smoke": "paged_decode_parity", "ok": ok}))
         if not ok:
             failures.append("paged_decode_parity")
+        # Same streams with the Pallas decode kernel COMPILED (the one
+        # configuration the CPU suite cannot reach — interpret mode
+        # proves numerics, only the chip proves the Mosaic lowering).
+        ktoks = np.asarray(paged_serve_builder(scfg, block_size=16,
+                                               decode_kernel=True)(
+            sp, spr, 16))
+        kok = bool((dtoks[:, :24] == ktoks[:, :24]).all())
+        print(json.dumps({"smoke": "paged_decode_kernel_parity",
+                          "ok": kok}))
+        if not kok:
+            failures.append("paged_decode_kernel_parity")
     except Exception as e:  # noqa: BLE001 — report and continue
         failures.append("paged_decode_parity")
         print(json.dumps({"smoke": "paged_decode_parity", "ok": False,
