@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \
     --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
-echo "== telemetry gate: instrumented smoke + schema + trace + overhead + re-lint =="
+echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead + re-lint =="
 # Drives a real instrumented paged-serving run with the request-level
 # tracer ON and the Pallas decode kernel SELECTED (interpret mode on
 # CPU; compiles must stay {'decode': 1} WITH telemetry AND tracing AND
@@ -62,9 +62,13 @@ echo "== telemetry gate: instrumented smoke + schema + trace + overhead + re-lin
 # through the JSONL/Prometheus exporters, round-trips the request
 # trace (JSONL + per-request waterfalls + Chrome trace-event export
 # structure), bounds the per-observation overhead (metric inc/observe
-# AND tracer event record under the same 50us ceiling), and re-lints
-# the instrumented entrypoints — host-callback-in-loop must report
-# zero findings.
+# AND tracer event record under the same 50us ceiling), runs the
+# training-health smoke (Trainer(health=...) batch + scan at cadence:
+# schema-valid train_health_* snapshot, compiles=={step:1, scan:1}
+# with the in-graph statistics vector on, per-step host cost bounded
+# at the default cadence), and re-lints the instrumented entrypoints
+# incl. the health-instrumented train step — host-callback-in-loop
+# must report zero findings.
 JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
 
 echo "== native libs =="

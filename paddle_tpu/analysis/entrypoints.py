@@ -120,6 +120,37 @@ def _trainer_train_step() -> LintTarget:
                           "all-reduce lands OUTSIDE any loop"))
 
 
+@functools.lru_cache(maxsize=None)
+def _tiny_trainer_health():
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import lm_model_fn_builder
+    from paddle_tpu.telemetry.health import HealthConfig
+    from paddle_tpu.training.trainer import Trainer
+    trainer = Trainer(lm_model_fn_builder(_tiny_cfg()), optim.sgd(0.01),
+                      health=HealthConfig(cadence=1))
+    trainer.init({"ids": jnp.zeros((2, 8), jnp.int32)})
+    return trainer
+
+
+@register_entrypoint("trainer-train-step-health")
+def _trainer_train_step_health() -> LintTarget:
+    # The health-instrumented twin: the step packs the in-graph
+    # statistics vector into its outputs.  Linting it is the proof the
+    # health reductions are pure jnp — host-callback-in-loop would fire
+    # on any callback, and the dp lowering shows the stat all-reduces
+    # land OUTSIDE any loop, fused with the gradient psum.
+    tr = _tiny_trainer_health()
+    steps = tr.jitted_steps()
+    batch = {"ids": jnp.zeros((2, 8), jnp.int32)}
+    return LintTarget(
+        "trainer-train-step-health", steps["train_step"],
+        (tr.params, tr.net_state, tr.opt_state, batch,
+         jnp.asarray(0, jnp.int32)),
+        recipe=_dp_recipe(5, (3,), "dp over the batch; health-stat "
+                          "reductions ride the same out-of-loop "
+                          "all-reduce as the gradient psum"))
+
+
 @register_entrypoint("trainer-eval-step")
 def _trainer_eval_step() -> LintTarget:
     tr = _tiny_trainer()

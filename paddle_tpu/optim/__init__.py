@@ -2,7 +2,8 @@
 ParameterOptimizer/TrainingAlgorithmOp twin: 8 v1 optimizers +
 regularizers, clipping, LR schedules, averaging, sparse rows)."""
 from paddle_tpu.optim.transforms import (Transform, apply_updates, chain,
-                                         scale, identity)
+                                         scale, identity, global_norm,
+                                         norm_tap)
 from paddle_tpu.optim.optimizers import (sgd, momentum, adagrad,
                                          decayed_adagrad, adadelta, rmsprop,
                                          adam, adamax, from_name)
@@ -59,5 +60,5 @@ __all__ = [
     "momentum", "adagrad", "decayed_adagrad", "adadelta", "rmsprop", "adam",
     "adamax", "from_name", "from_config", "schedules", "regularizers",
     "average", "sparse", "l1_decay", "l2_decay", "clip_by_value",
-    "clip_by_global_norm",
+    "clip_by_global_norm", "global_norm", "norm_tap",
 ]

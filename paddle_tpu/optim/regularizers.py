@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.optim.transforms import Transform
+from paddle_tpu.optim.transforms import Transform, global_norm
 
 
 def l2_decay(rate: float) -> Transform:
@@ -43,9 +43,7 @@ def clip_by_global_norm(threshold: float) -> Transform:
     """Scale all grads so the global L2 norm <= threshold
     (gradient_clipping_threshold, FirstOrderOptimizer.h:342)."""
     def update(g, s, p, step):
-        leaves = jax.tree_util.tree_leaves(g)
-        norm = jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                            for x in leaves))
+        norm = global_norm(g)
         scale = jnp.minimum(1.0, threshold / jnp.maximum(norm, 1e-12))
         return jax.tree_util.tree_map(lambda x: x * scale, g), s
     return Transform(lambda p: (), update)

@@ -70,3 +70,31 @@ def scale(factor: float) -> Transform:
 def identity() -> Transform:
     return Transform(lambda params: (),
                      lambda g, s, p, step: (g, s))
+
+
+def global_norm(tree) -> jax.Array:
+    """Global L2 norm over every leaf, accumulated in f32 — the shared
+    reduction behind gradient clipping
+    (``regularizers.clip_by_global_norm``) and the training health
+    statistics (``telemetry/health.py``)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))
+
+
+def norm_tap() -> Transform:
+    """Identity transform whose STATE is the global L2 norm of whatever
+    flows through it — the update-ratio observation hook at the
+    transform boundary.  Chain it last (``chain(..., optimizer,
+    norm_tap())``) to capture ``norm(dw)`` of the final update deltas;
+    the state rides the optimizer state tree, so it reaches the host
+    with the step outputs, never via a callback."""
+    def init(params):
+        return jnp.float32(0.0)
+
+    def update(g, s, p, step):
+        return g, global_norm(g)
+
+    return Transform(init, update)

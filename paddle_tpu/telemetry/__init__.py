@@ -28,6 +28,12 @@ which an ad-hoc counter can carry.  Pieces:
   or the NaN localizer fires; trace records ride the same JSONL stream
   (``append_trace_jsonl``) and ``paddle_tpu telemetry trace`` renders
   the per-request waterfall;
+* training health (``health.py``) — in-graph tensor statistics packed
+  into one f32 vector by the jitted train step (per-layer-group
+  grad/weight/update norms, non-finite counts, logits abs-max) and a
+  host-side :class:`HealthMonitor` with anomaly rules: grad-norm spike,
+  update-ratio out-of-band, and the overflow-headroom NaN precursor
+  that alarms BEFORE the first non-finite lands;
 * instrumentation lives in the hot paths themselves —
   ``serving.PagedServingEngine`` (queue-wait/TTFT/per-output-token
   histograms, admission/retire counters, occupancy gauges, compile
@@ -67,6 +73,10 @@ from paddle_tpu.telemetry.trace import (TRACE_SCHEMA_VERSION, Tracer,
                                         validate_chrome_trace,
                                         validate_trace,
                                         waterfall_summary)
+from paddle_tpu.telemetry.health import (Anomaly, HealthConfig,
+                                         HealthMonitor, HealthSpec,
+                                         build_spec, health_vector,
+                                         render_health, unpack)
 # Importing the trace SUBMODULE above rebinds the package attribute
 # ``trace`` from the spans XPlane-capture context manager to the
 # module.  The context manager is the long-standing public
@@ -85,4 +95,6 @@ __all__ = [
     "Tracer", "TRACE_SCHEMA_VERSION", "chrome_trace", "get_tracer",
     "set_tracer", "validate_trace", "validate_chrome_trace",
     "request_waterfalls", "waterfall_summary",
+    "Anomaly", "HealthConfig", "HealthMonitor", "HealthSpec",
+    "build_spec", "health_vector", "render_health", "unpack",
 ]

@@ -3,13 +3,19 @@
 Three spellings, one implementation::
 
     python -m paddle_tpu telemetry show  run.jsonl [--index -1] [--prom]
+    python -m paddle_tpu telemetry show  run.jsonl --grep 'train_health'
     python -m paddle_tpu telemetry diff  run.jsonl            # last two
     python -m paddle_tpu telemetry diff  a.jsonl b.jsonl      # last of each
+    python -m paddle_tpu telemetry health run.jsonl           # norm table
     python -m paddle_tpu telemetry trace run.jsonl [--chrome out.json]
     python -m paddle_tpu.telemetry ...                        # module form
 
 ``show`` pretty-prints one snapshot record (console table by default,
-``--prom`` for Prometheus text, ``--json`` for the raw snapshot);
+``--prom`` for Prometheus text, ``--json`` for the raw snapshot;
+``--grep`` restricts every form to matching metric names — the
+snapshot has outgrown the unfiltered dump); ``health`` renders the
+training health monitor's per-layer-group norm/update-ratio table with
+overflow-headroom and anomaly flags (``telemetry/health.py``);
 ``diff`` subtracts two snapshots of the same registry — counters and
 histogram count/sum as deltas, gauges as old -> new — which is how a
 benchmark run's JSONL stream turns into "what changed between these two
@@ -24,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Optional, Sequence
 
@@ -51,11 +58,34 @@ def _meta_line(rec: dict) -> str:
     return f"ts={rec.get('ts', 0.0):.3f}{extras}"
 
 
+def _compile_grep(pattern: Optional[str]):
+    if pattern is None:
+        return None
+    try:
+        return re.compile(pattern)
+    except re.error as exc:
+        raise SystemExit(f"--grep {pattern!r}: bad regex ({exc})")
+
+
+def _grep_snapshot(snap: dict, rx) -> dict:
+    """Snapshot restricted to metric names matching ``rx`` — the
+    filtered dict still passes validate_snapshot, so every renderer
+    (table/prom/json) works on it unchanged."""
+    if rx is None:
+        return snap
+    metrics = {name: entry for name, entry in snap["metrics"].items()
+               if rx.search(name)}
+    if not metrics:
+        raise SystemExit(f"no metric names match {rx.pattern!r} "
+                         f"({len(snap['metrics'])} families in snapshot)")
+    return {**snap, "metrics": metrics}
+
+
 def cmd_show(args) -> int:
     from paddle_tpu.telemetry.export import (console_summary,
                                              prometheus_text)
     rec = _load_record(args.path, args.index)
-    snap = rec["snapshot"]
+    snap = _grep_snapshot(rec["snapshot"], _compile_grep(args.grep))
     if args.json:
         print(json.dumps(snap, indent=2, sort_keys=True))
     elif args.prom:
@@ -105,12 +135,28 @@ def cmd_diff(args) -> int:
         # mismatched registries (e.g. histogram bucket bounds changed
         # between builds) is an operator error, not a crash
         raise SystemExit(f"error: {exc}")
+    rx = _compile_grep(args.grep)
+    if rx is not None:
+        diff = {name: entry for name, entry in diff.items()
+                if rx.search(name)}
     if args.json:
         print(json.dumps(diff, indent=2, sort_keys=True))
         return 0
     print(f"# {names[0]} ({_meta_line(old)})")
     print(f"# -> {names[1]} ({_meta_line(new)})")
     _render_diff(diff)
+    return 0
+
+
+def cmd_health(args) -> int:
+    from paddle_tpu.telemetry.health import render_health
+    rec = _load_record(args.path, args.index)
+    try:
+        table = render_health(rec["snapshot"])
+    except ValueError as exc:
+        raise SystemExit(f"{args.path}: {exc}")
+    print(f"# {args.path}[{args.index}] {_meta_line(rec)}")
+    print(table)
     return 0
 
 
@@ -225,6 +271,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="Prometheus text format instead of the table")
     p.add_argument("--json", action="store_true",
                    help="raw snapshot JSON")
+    p.add_argument("--grep", metavar="PATTERN", default=None,
+                   help="only metric families whose name matches this "
+                        "regex (re.search)")
     p.set_defaults(fn=cmd_show)
 
     p = sub.add_parser("diff", help="delta between two snapshots")
@@ -239,7 +288,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="new record index (default: last line)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable diff")
+    p.add_argument("--grep", metavar="PATTERN", default=None,
+                   help="only differing metric families whose name "
+                        "matches this regex")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "health", help="training health: per-layer-group norm table + "
+                       "anomaly flags from a snapshot record")
+    p.add_argument("path", help="JSONL file written by append_jsonl "
+                                "(e.g. --telemetry-out)")
+    p.add_argument("--index", type=int, default=-1,
+                   help="record index (default: last line)")
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser(
         "trace", help="per-request waterfall summary / Chrome export")

@@ -25,10 +25,19 @@ Five checks, each a hard failure (non-zero exit) when violated:
    stays under a generous ceiling; a regression that makes telemetry
    expensive enough to matter fails here rather than silently taxing
    the serving loop.
-5. **Lint re-check** — the instrumented entrypoints (engine decode,
-   paged serve step, trainer step) re-trace through tpu-lint with ZERO
-   error-severity findings: ``host-callback-in-loop`` is the rule that
-   would fire if any metric update leaked inside a jitted program.
+5. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
+   real batch + scan steps with the monitor at cadence: the snapshot
+   must validate and carry populated ``train_health_*`` families,
+   ``compiles`` must stay ``{step: 1, scan: 1}`` WITH health enabled
+   (the packed statistics vector may not perturb tracing or donation),
+   and the per-step host cost of ``HealthMonitor.observe`` amortized
+   over the default cadence stays under the same observation ceiling.
+6. **Lint re-check** — the instrumented entrypoints (engine decode,
+   paged serve step, trainer step, health-instrumented trainer step)
+   re-trace through tpu-lint with ZERO error-severity findings:
+   ``host-callback-in-loop`` is the rule that would fire if any metric
+   update — or health statistic — leaked inside a jitted program as a
+   callback instead of an in-graph reduction.
 
 Run on the CPU backend (``JAX_PLATFORMS=cpu``); wired into ``ci.sh``'s
 lint tier.
@@ -69,6 +78,20 @@ INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode-kernel",
     "paged-serve-step",
     "trainer-train-step",
+    "trainer-train-step-health",
+)
+
+#: Health metric families the health-on smoke must populate.
+REQUIRED_HEALTH_METRICS = (
+    "train_health_grad_norm",
+    "train_health_weight_norm",
+    "train_health_update_ratio",
+    "train_health_logit_absmax",
+    "train_health_overflow_headroom_decades",
+    "train_health_nonfinite",
+    "train_health_anomalies_total",
+    "train_health_grad_norm_hist",
+    "train_health_update_ratio_hist",
 )
 
 
@@ -219,6 +242,71 @@ def _check_overhead():
     return per_op
 
 
+def _check_health():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import optim
+    from paddle_tpu.analysis import CompileWatcher
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+    from paddle_tpu.telemetry.health import HealthConfig
+    from paddle_tpu.training.trainer import Trainer
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    reg = MetricsRegistry("selfcheck-health")
+    trainer = Trainer(lm_model_fn_builder(cfg), optim.sgd(0.1),
+                      metrics=reg, health=HealthConfig(cadence=2))
+    rs = np.random.RandomState(0)
+    batch = {"ids": rs.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)}
+    trainer.init(batch)
+    watch = CompileWatcher(step=trainer._train_step,
+                           scan=trainer._train_scan)
+    for _ in range(4):
+        trainer.train_batch(batch)
+    stack = {"ids": jnp.stack([jnp.asarray(batch["ids"])] * 3)}
+    trainer.train_batches(stack)
+    try:
+        watch.assert_counts(step=1, scan=1)
+    except AssertionError as exc:
+        _fail(f"compiles == 1 broke WITH health enabled: {exc}")
+
+    mon = trainer.health_monitor
+    # cadence 2 over steps 0..6: observations at 0, 2, 4, 6
+    if mon._n_obs != 4:
+        _fail(f"health cadence 2 over 7 steps observed {mon._n_obs} "
+              "times, wanted 4")
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    missing = [m for m in REQUIRED_HEALTH_METRICS
+               if m not in snap["metrics"]]
+    if missing:
+        _fail(f"snapshot missing documented health metrics: {missing}")
+    grad = snap["metrics"]["train_health_grad_norm"]["series"]
+    groups = {s["labels"].get("group") for s in grad}
+    if "global" not in groups or len(groups) < 2:
+        _fail(f"health grad-norm gauge lacks per-group series: {groups}")
+    if mon.summary()["nonfinite"]:
+        _fail("health smoke reported non-finite values on a sane run")
+
+    # host-side cost: one observe() per cadence, amortized per STEP
+    vec = np.asarray(trainer._train_step(
+        trainer.params, trainer.net_state, trainer.opt_state,
+        trainer._put(batch), trainer._step_array())[5])
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        mon.observe(vec, step=0)
+    per_step = (time.perf_counter() - t0) / n / HealthConfig().cadence
+    if per_step > MAX_SECONDS_PER_OBSERVATION:
+        _fail(f"health per-step host overhead {per_step * 1e6:.1f}us at "
+              f"default cadence exceeds "
+              f"{MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us")
+    return snap, per_step
+
+
 def _check_lint():
     from paddle_tpu.analysis import lint_target, self_check_targets
     errors = []
@@ -244,6 +332,11 @@ def main(argv=None) -> int:
     per_op = _check_overhead()
     print(f"selfcheck: overhead ok ({per_op * 1e6:.2f}us/observation, "
           f"bound {MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us)")
+    hsnap, h_per_step = _check_health()
+    print("selfcheck: training health smoke ok "
+          f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "
+          f"health families, compiles==1 with health on, "
+          f"{h_per_step * 1e6:.2f}us/step at default cadence)")
     _check_lint()
     print("selfcheck: tpu-lint re-check ok (0 errors on instrumented "
           "entrypoints)")

@@ -34,6 +34,11 @@ class EndIteration:
     batch_id: int
     cost: float
     metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: Latest training-health summary (global grad norm, update ratio,
+    #: overflow headroom, fired anomaly rules) when the trainer runs
+    #: with ``health=`` — ``HealthMonitor.summary()`` shape; None when
+    #: health is off or no cadence point has been observed yet.
+    health: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass

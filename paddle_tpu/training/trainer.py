@@ -28,6 +28,7 @@ import numpy as np
 from paddle_tpu import optim as optim_lib
 from paddle_tpu import telemetry
 from paddle_tpu.core.errors import enforce
+from paddle_tpu.telemetry import health as health_lib
 from paddle_tpu.nn import transform
 from paddle_tpu.parallel import mesh as mesh_lib
 from paddle_tpu.training import checkpoint as ckpt_lib
@@ -44,7 +45,8 @@ class Trainer:
                  average_window: int = 0,
                  zero_axis: Optional[str] = None,
                  batch_spec=None,
-                 metrics=None):
+                 metrics=None,
+                 health=None):
         """``batch_spec`` — PartitionSpec for batch leaves under a mesh
         (default: leading axis over ``dp``).  Non-dp-first topologies set
         it explicitly: ``P(None, "sp")`` shards sequence for a
@@ -60,7 +62,18 @@ class Trainer:
         around — never inside — the jitted step.  Caveat: under JAX's
         async dispatch a per-batch time measures dispatch unless the
         caller syncs; the differential protocol in ``utils/timing.py``
-        remains the benchmark truth (``docs/design/telemetry.md``)."""
+        remains the benchmark truth (``docs/design/telemetry.md``).
+
+        ``health`` — ``True`` or a
+        :class:`~paddle_tpu.telemetry.health.HealthConfig` turns on the
+        training health monitor: the jitted step additionally returns
+        one packed f32 statistics vector (grad/weight/update norms per
+        layer group, non-finite counts, logits abs-max — pure in-graph
+        ``jnp`` reductions, donation and ``compiles==1`` unchanged) and
+        a host-side :class:`~paddle_tpu.telemetry.health.HealthMonitor`
+        decodes it every ``cadence`` steps, feeding ``train_health_*``
+        metrics and firing anomaly / NaN-precursor alarms.  The cadence
+        sync is the only added device->host transfer."""
         self.model = transform(model_fn)
         self.optimizer = optimizer
         self.seed = seed
@@ -91,6 +104,10 @@ class Trainer:
         self._m_tps = self.metrics.gauge(
             "train_tokens_per_s",
             "tokens/s of the most recent step or scan chunk")
+        if health is True:
+            health = health_lib.HealthConfig()
+        self._health_cfg = health or None
+        self.health_monitor = None
 
     # ``step`` is plain-int bookkeeping (checkpoints, logs); the jitted
     # step receives a DEVICE-RESIDENT twin incremented with a lazy add.
@@ -135,6 +152,16 @@ class Trainer:
 
     def _build_steps(self):
         model, optimizer = self.model, self.optimizer
+        if self._health_cfg is not None and self.health_monitor is None:
+            # the spec needs concrete param names; built here (post-init/
+            # restore) and closed over by the step so device and host
+            # agree on the packed-vector layout by construction
+            spec = health_lib.build_spec(self.params,
+                                         group_fn=self._health_cfg.group_fn)
+            self.health_monitor = health_lib.HealthMonitor(
+                spec, self._health_cfg, metrics=self.metrics)
+        health_spec = (self.health_monitor.spec
+                       if self.health_monitor is not None else None)
         # Sharded params cannot flow through Pallas kernels (GSPMD cannot
         # partition a pallas_call), so rule-sharded runs trace with kernel
         # fusion disabled — the mechanism-level twin of picking the XLA
@@ -163,6 +190,16 @@ class Trainer:
             updates, new_opt = optimizer.update(grads, opt_state, params,
                                                 step)
             new_params = optim_lib.apply_updates(params, updates)
+            if health_spec is not None:
+                # in-graph health statistics: jnp reductions XLA fuses
+                # into the step, packed into ONE [n] f32 vector — the
+                # update-ratio numerator reads the updates at the
+                # transform boundary, post-chain (what actually lands)
+                hvec = health_lib.health_vector(
+                    health_spec, loss=loss, grads=grads, params=params,
+                    updates=updates, new_params=new_params,
+                    outputs=outputs)
+                return new_params, new_state, new_opt, loss, outputs, hvec
             return new_params, new_state, new_opt, loss, outputs
 
         def eval_step(params, net_state, batch):
@@ -193,12 +230,17 @@ class Trainer:
             # return stacked.
             def body(carry, batch):
                 p, ns, os_, step = carry
-                p, ns, os_, loss, _ = train_step(p, ns, os_, batch, step)
-                return (p, ns, os_, step + 1), loss
+                out = train_step(p, ns, os_, batch, step)
+                p, ns, os_, loss = out[:4]
+                ys = (loss, out[5]) if health_spec is not None else loss
+                return (p, ns, os_, step + 1), ys
 
-            (p, ns, os_, _), losses = jax.lax.scan(
+            (p, ns, os_, _), ys = jax.lax.scan(
                 body, (params, net_state, opt_state, step0), batch_stack)
-            return p, ns, os_, losses
+            if health_spec is not None:
+                losses, hvecs = ys     # hvecs stacked [k, n]
+                return p, ns, os_, losses, hvecs
+            return p, ns, os_, ys
 
         # params/opt_state buffers are dead after the step — donate them,
         # EXCEPT under debug_nans: its diagnostic re-run needs the original
@@ -264,6 +306,24 @@ class Trainer:
             if dt > 0:
                 self._m_tps.set(tokens / dt)
 
+    def _observe_health(self, hvecs, step0: int, k: int) -> None:
+        """Feed cadence-aligned health vectors to the monitor.  ONE
+        ``np.asarray`` transfer per call covers all ``k`` steps (the
+        scan path hands a stacked ``[k, n]`` array); steps off the
+        cadence grid never reach the host."""
+        mon = self.health_monitor
+        if mon is None:
+            return
+        cadence = mon.config.cadence
+        aligned = [i for i in range(k) if (step0 + i) % cadence == 0]
+        if not aligned:
+            return
+        host = np.asarray(hvecs)
+        if k == 1:
+            host = host.reshape(1, -1)
+        for i in aligned:
+            mon.observe(host[i], step=step0 + i)
+
     def train_batch(self, batch: Dict[str, Any]):
         if self.params is None:
             self.init(batch)
@@ -272,12 +332,15 @@ class Trainer:
         step_arr = self._step_array()
         t0 = time.perf_counter()
         try:
+            res = self._train_step(self.params, self.net_state,
+                                   self.opt_state, batch, step_arr)
             (self.params, self.net_state, self.opt_state, loss,
-             outputs) = self._train_step(self.params, self.net_state,
-                                         self.opt_state, batch, step_arr)
+             outputs) = res[:5]
         finally:
             self._in_step = False
         self._observe_step(batch, time.perf_counter() - t0, 1, "batch")
+        if self.health_monitor is not None:
+            self._observe_health(res[5], self._step, 1)
         if self.average_window:
             self.avg_state = optim_lib.average.accumulate(
                 self.avg_state, self.params)
@@ -311,14 +374,16 @@ class Trainer:
         self._in_step = True
         t0 = time.perf_counter()
         try:
+            res = self._train_scan(self.params, self.net_state,
+                                   self.opt_state, batch_stack, step_arr)
             (self.params, self.net_state, self.opt_state,
-             losses) = self._train_scan(self.params, self.net_state,
-                                        self.opt_state, batch_stack,
-                                        step_arr)
+             losses) = res[:4]
         finally:
             self._in_step = False
         self._observe_step(batch_stack, time.perf_counter() - t0, int(k),
                            "scan")
+        if self.health_monitor is not None:
+            self._observe_health(res[4], self._step, int(k))
         self._step += int(k)
         self._step_dev = step_arr + k
         handler = getattr(self, "_preemption_handler", None)
@@ -481,7 +546,11 @@ class Trainer:
                         print(aux_lib.format_parameter_stats(
                             aux_lib.parameter_stats(self.params)),
                             flush=True)
-                    handler(ev.EndIteration(pass_id, batch_id, cost))
+                    handler(ev.EndIteration(
+                        pass_id, batch_id, cost,
+                        health=(self.health_monitor.summary()
+                                if self.health_monitor is not None
+                                else None)))
             results = {e.name: e.finish() for e in evaluators}
             results["loss"] = float(np.mean(costs)) if costs else 0.0
             if test_reader is not None:

@@ -355,3 +355,66 @@ def test_softmax_ce_hand_rolled_lse_stage_b_trail():
     logits = jnp.asarray([[0.0, -jnp.inf, -jnp.inf]], jnp.float32)
     loss = softmax_cross_entropy(logits, jnp.asarray([0], jnp.int32))
     assert bool(jnp.isfinite(loss[0])) and float(loss[0]) == 0.0
+
+
+def test_health_precursor_fires_before_stage_b_lse_nan(tmp_path):
+    """Minimized stage-B divergence: logits climb toward f32 overflow
+    over several finite steps, then carry the ±inf that turns the
+    hand-rolled LSE into ``inf - inf -> nan`` (``ops/losses.py``).  The
+    health monitor's ``overflow_headroom`` precursor must fire on a
+    FINITE observation, strictly before the first non-finite loss — and
+    the armed flight recorder must dump the trail."""
+    import json as _json
+
+    from paddle_tpu.ops.losses import softmax_cross_entropy
+    from paddle_tpu.telemetry import MetricsRegistry
+    from paddle_tpu.telemetry import health as H
+    from paddle_tpu.telemetry.trace import Tracer, set_tracer
+
+    base = jnp.asarray([[4.0, 0.0, -4.0], [-4.0, 0.0, 4.0]], jnp.float32)
+    labels = jnp.asarray([0, 2], jnp.int32)
+    # the climb: each "step" another 8 decades, still finite (max 4e32)
+    trajectory = [base * s for s in (1e0, 1e8, 1e16, 1e24, 1e32)]
+    # the crash: +inf lands AT the picked positions -> lse - picked = nan
+    trajectory.append(jnp.asarray([[jnp.inf, 0.0, -jnp.inf],
+                                   [-jnp.inf, 0.0, jnp.inf]], jnp.float32))
+
+    params = {"head": {"w": jnp.ones((3,), jnp.float32)}}
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    spec = H.build_spec(params)
+    reg = MetricsRegistry("stage-b-repro")
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    flight = tmp_path / "flight.json"
+    prev = set_tracer(Tracer(name="stage-b-repro",
+                             flight_path=str(flight)))
+    try:
+        first_precursor = first_nonfinite = None
+        for step, logits in enumerate(trajectory):
+            loss = softmax_cross_entropy(logits, labels)
+            mean = jnp.mean(loss)
+            if first_nonfinite is None and not bool(jnp.isfinite(mean)):
+                first_nonfinite = step
+            vec = H.health_vector(spec, loss=mean, grads=zeros,
+                                  params=params,
+                                  outputs={"logits": logits})
+            for a in mon.observe(vec, step=step):
+                if a.rule == "overflow_headroom" and a.precursor \
+                        and first_precursor is None:
+                    first_precursor = step
+    finally:
+        set_tracer(prev)
+
+    # the finite prefix really is finite, and the crash really lands
+    assert first_nonfinite == len(trajectory) - 1
+    # ... but the alarm sounded on an earlier, finite observation
+    assert first_precursor is not None
+    assert first_precursor < first_nonfinite
+    # the step the precursor fired on had a FINITE loss (a prediction,
+    # not a post-mortem)
+    assert mon.anomalies[0].rule == "overflow_headroom"
+    assert mon.anomalies[0].precursor is True
+    # the armed flight recorder dumped the trail with the health state
+    rec = _json.loads(flight.read_text())
+    assert rec["kind"] == "flight_record"
+    assert "health" in rec["reason"]
+    assert "overflow_headroom" in rec["state"]["anomaly_rules"]

@@ -386,6 +386,30 @@ def test_trainer_step_metrics_and_mfu_report(reg):
     validate_snapshot(reg.snapshot())
 
 
+def test_trainer_observe_step_scan_branch(reg):
+    """``_observe_step`` with a stacked ``[k, B, ...]`` chunk: examples
+    come from ``shape[:2]``, the per-step histogram amortizes ``dt/k``
+    under ``path=scan``, and tokens/tps read the whole stacked ids."""
+    from paddle_tpu import optim
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.training import Trainer
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    tr = Trainer(lm_model_fn_builder(cfg), optim.sgd(0.1), metrics=reg)
+    stack = {"ids": np.zeros((5, 2, 8), np.int32)}
+    tr._observe_step(stack, dt=0.5, k=5, path="scan")
+    h = reg.get("train_step_seconds")
+    s = h.summary(path="scan")
+    assert s["count"] == 1
+    assert s["sum"] == pytest.approx(0.1)        # dt/k, one observation
+    assert h.summary(path="batch")["count"] in (0, None)
+    assert reg.get("train_batches_total").value() == 5
+    assert reg.get("train_examples_total").value() == 10   # 5 * 2
+    assert reg.get("train_tokens_total").value() == 80     # 5 * 2 * 8
+    assert reg.get("train_tokens_per_s").value() == pytest.approx(160.0)
+
+
 def test_trainer_eval_checkpoint_spans(reg, tmp_path):
     from paddle_tpu import optim
     from paddle_tpu.models.transformer import (TransformerConfig,

@@ -1,0 +1,482 @@
+"""Training health monitor (``paddle_tpu/telemetry/health.py``): packed
+vector layout, device/host parity, anomaly rules, and the trainer/CLI
+integration.
+
+Load-bearing pins:
+
+* the packed vector is the ONLY device->host health traffic and its
+  layout is fixed by ``HealthSpec`` — ``unpack(spec, health_vector(...))``
+  round-trips to numpy-computed norms;
+* ``compiles == {'step': 1, 'scan': 1}`` holds WITH health enabled
+  (the stats are in-graph reductions, not callbacks);
+* the ``overflow_headroom`` rule is a PRECURSOR: it fires on finite
+  observations (floor or growth extrapolation) before any non-finite
+  value exists;
+* anomalies reach every observability surface: counter, tracer
+  instants, armed flight recorder (once per rule), ``on_anomaly``
+  callbacks, and the ``EndIteration.health`` event field.
+"""
+
+import json
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import optim, telemetry
+from paddle_tpu.telemetry import MetricsRegistry, append_jsonl
+from paddle_tpu.telemetry import health as H
+from paddle_tpu.telemetry.trace import Tracer, set_tracer
+
+
+def _two_group_params():
+    return {"m": {"a": {"w": np.ones((2, 3), np.float32)},
+                  "b": {"w": np.full((4,), 2.0, np.float32)}}}
+
+
+@pytest.fixture
+def spec():
+    return H.build_spec(_two_group_params())
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry("health-test")
+
+
+def _vec(spec, *, loss=1.0, grad=1.0, weight=10.0, update=0.01,
+         nf_grads=0.0, nf_params=0.0, absmax=2.0):
+    """Synthetic packed vector: every group carries the global values
+    (the host rules only read the global slots + group labels)."""
+    v = np.zeros(spec.size, np.float32)
+    v[spec.index("loss")] = loss
+    v[spec.index("grad_norm")] = grad
+    v[spec.index("weight_norm")] = weight
+    v[spec.index("update_norm")] = update
+    v[spec.index("nonfinite_grads")] = nf_grads
+    v[spec.index("nonfinite_params")] = nf_params
+    v[spec.index("logit_absmax")] = absmax
+    for g in spec.groups:
+        v[spec.index("grad_norm", g)] = grad
+        v[spec.index("weight_norm", g)] = weight
+        v[spec.index("update_norm", g)] = update
+    return v
+
+
+# ----------------------------------------------------------------- spec
+
+
+def test_spec_layout_and_groups(spec):
+    assert spec.groups == ("m/a", "m/b")
+    assert spec.size == len(H.GLOBAL_STATS) + 2 * len(H.GROUP_STATS)
+    assert spec.index("loss") == 0
+    assert spec.index("logit_absmax") == 6
+    assert spec.index("grad_norm", "m/a") == 7
+    assert spec.index("update_norm", "m/b") == spec.size - 1
+    layout = spec.layout()
+    assert layout[0] == "loss" and layout[7] == "m/a:grad_norm"
+    assert len(layout) == spec.size
+
+
+def test_default_group_fn():
+    assert H.default_group_fn("lm/h0/attn/wq") == "lm/h0"
+    assert H.default_group_fn("lm/embed/w") == "lm/embed"
+    assert H.default_group_fn("fc/w") == "fc"
+    assert H.default_group_fn("w") == "w"       # bare leaf: own group
+
+
+def test_build_spec_custom_group_fn_and_empty():
+    spec = H.build_spec(_two_group_params(), group_fn=lambda p: "all")
+    assert spec.groups == ("all",)
+    with pytest.raises(ValueError, match="empty"):
+        H.build_spec({})
+
+
+# ------------------------------------------------- vector <-> unpack parity
+
+
+def test_health_vector_unpack_parity():
+    params = _two_group_params()
+    spec = H.build_spec(params)
+    grads = {"m": {"a": {"w": np.full((2, 3), 0.5, np.float32)},
+                   "b": {"w": np.asarray([1.0, -1.0, 1.0, -1.0],
+                                         np.float32)}}}
+    updates = {"m": {"a": {"w": np.full((2, 3), -0.05, np.float32)},
+                     "b": {"w": np.full((4,), 0.1, np.float32)}}}
+    logits = np.asarray([[3.0, -7.5]], np.float32)
+    vec = H.health_vector(spec, loss=1.25, grads=grads, params=params,
+                          updates=updates, outputs={"logits": logits})
+    assert vec.shape == (spec.size,) and vec.dtype == jnp.float32
+    s = H.unpack(spec, vec)
+
+    def l2(tree):
+        flat = [np.asarray(x, np.float64).ravel()
+                for x in (tree["m"]["a"]["w"], tree["m"]["b"]["w"])]
+        return math.sqrt(sum(float(np.sum(x * x)) for x in flat))
+
+    assert s["loss"] == pytest.approx(1.25)
+    assert s["grad_norm"] == pytest.approx(l2(grads), rel=1e-6)
+    assert s["weight_norm"] == pytest.approx(l2(params), rel=1e-6)
+    assert s["logit_absmax"] == pytest.approx(7.5)
+    assert s["update_ratio"] == pytest.approx(
+        s["update_norm"] / s["weight_norm"], rel=1e-6)
+    a = s["groups"]["m/a"]
+    assert a["grad_norm"] == pytest.approx(math.sqrt(6 * 0.25), rel=1e-6)
+    assert a["weight_norm"] == pytest.approx(math.sqrt(6.0), rel=1e-6)
+    assert a["update_ratio"] == pytest.approx(
+        a["update_norm"] / a["weight_norm"], rel=1e-6)
+    b = s["groups"]["m/b"]
+    assert b["grad_norm"] == pytest.approx(2.0, rel=1e-6)
+    assert b["weight_norm"] == pytest.approx(4.0, rel=1e-6)
+    assert s["overflow_headroom_decades"] == pytest.approx(
+        H.F32_MAX_DECADES - math.log10(7.5), rel=1e-6)
+
+
+def test_health_vector_nonfinite_counts_and_optional_updates():
+    params = _two_group_params()
+    spec = H.build_spec(params)
+    grads = {"m": {"a": {"w": np.asarray([[np.nan, 1, 1], [1, 1, np.inf]],
+                                         np.float32)},
+                   "b": {"w": np.ones((4,), np.float32)}}}
+    new_params = {"m": {"a": {"w": np.ones((2, 3), np.float32)},
+                        "b": {"w": np.asarray([1, np.inf, 1, 1],
+                                              np.float32)}}}
+    vec = H.health_vector(spec, loss=0.0, grads=grads, params=params,
+                          new_params=new_params)
+    s = H.unpack(spec, vec)
+    assert s["nonfinite_grads"] == 2.0
+    assert s["nonfinite_params"] == 1.0
+    assert s["update_norm"] == 0.0          # updates=None packs zeros
+    assert s["update_ratio"] == 0.0
+
+
+def test_outputs_absmax_preference_and_fallbacks(spec):
+    params = _two_group_params()
+    zeros = {"m": {"a": {"w": np.zeros((2, 3), np.float32)},
+                   "b": {"w": np.zeros((4,), np.float32)}}}
+
+    def absmax(outputs):
+        v = H.health_vector(spec, loss=0.0, grads=zeros, params=params,
+                            outputs=outputs)
+        return float(v[spec.index("logit_absmax")])
+
+    # dict with logits: other (larger) leaves are ignored
+    assert absmax({"logits": np.asarray([1.0, -2.0], np.float32),
+                   "aux": np.asarray([100.0], np.float32)}) == 2.0
+    # no logits key: every floating leaf counts
+    assert absmax({"a": np.asarray([3.0], np.float32),
+                   "b": np.asarray([-9.0], np.float32)}) == 9.0
+    # ints only / nothing: 0
+    assert absmax({"ids": np.asarray([5], np.int32)}) == 0.0
+    assert absmax(None) == 0.0
+
+
+def test_health_vector_spec_mismatch_raises(spec):
+    params = _two_group_params()
+    with pytest.raises(ValueError, match="health spec mismatch"):
+        H.health_vector(spec, loss=0.0,
+                        grads={"m": {"a": {"w": np.ones(1, np.float32)}}},
+                        params=params)
+
+
+def test_overflow_headroom_decades():
+    assert H.overflow_headroom_decades(1.0) == pytest.approx(
+        H.F32_MAX_DECADES)
+    assert H.overflow_headroom_decades(1e34) == pytest.approx(
+        H.F32_MAX_DECADES - 34, rel=1e-6)
+    assert H.overflow_headroom_decades(0.0) == math.inf
+    assert H.overflow_headroom_decades(math.inf) == 0.0
+    assert H.overflow_headroom_decades(math.nan) == 0.0
+
+
+def test_health_config_validation():
+    with pytest.raises(ValueError):
+        H.HealthConfig(cadence=0)
+    with pytest.raises(ValueError):
+        H.HealthConfig(update_ratio_band=(0.5, 0.1))
+
+
+# ------------------------------------------------------------- monitor
+
+
+def test_monitor_gauges_histograms_and_summary(spec, reg):
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    fired = mon.observe(_vec(spec), step=0)
+    assert fired == []
+    g = reg.get("train_health_grad_norm")
+    assert g.value(group="global") == pytest.approx(1.0)
+    assert g.value(group="m/a") == pytest.approx(1.0)
+    assert reg.get("train_health_update_ratio").value(
+        group="global") == pytest.approx(0.001)
+    assert reg.get("train_health_logit_absmax").value() == pytest.approx(2.0)
+    assert reg.get("train_health_overflow_headroom_decades").value() == \
+        pytest.approx(H.F32_MAX_DECADES - math.log10(2.0), rel=1e-6)
+    assert reg.get("train_health_grad_norm_hist").summary()["count"] == 1
+    assert reg.get("train_health_update_ratio_hist").summary()["count"] == 1
+    s = mon.summary()
+    assert s["step"] == 0 and s["nonfinite"] is False
+    assert s["anomaly_rules"] == [] and s["anomalies_total"] == 0
+    telemetry.validate_snapshot(reg.snapshot())
+
+
+def test_rule_grad_spike(spec, reg):
+    cfg = H.HealthConfig(cadence=1, min_points=4, grad_spike_z=6.0)
+    mon = H.HealthMonitor(spec, cfg, metrics=reg)
+    for i in range(6):          # mean 1.1, std 0.1 — a real baseline
+        assert mon.observe(_vec(spec, grad=1.0 + 0.2 * (i % 2)),
+                           step=i) == []
+    fired = mon.observe(_vec(spec, grad=10.0), step=6)
+    assert [a.rule for a in fired] == ["grad_spike"]
+    assert fired[0].value > 6.0 and not fired[0].precursor
+    assert reg.get("train_health_anomalies_total").value(
+        rule="grad_spike") == 1
+
+
+def test_rule_update_ratio_band(spec, reg):
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    over = mon.observe(_vec(spec, weight=1.0, update=0.5), step=0)
+    assert [a.rule for a in over] == ["update_ratio"]
+    under = mon.observe(_vec(spec, weight=1.0, update=1e-10), step=1)
+    assert [a.rule for a in under] == ["update_ratio"]
+    # update_norm == 0 (eval probe / no updates packed): rule stays quiet
+    assert mon.observe(_vec(spec, weight=1.0, update=0.0), step=2) == []
+
+
+def test_rule_overflow_headroom_static_floor(spec, reg):
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    fired = mon.observe(_vec(spec, absmax=1e36), step=0)
+    assert [a.rule for a in fired] == ["overflow_headroom"]
+    a = fired[0]
+    assert a.precursor is True
+    assert a.value == pytest.approx(H.F32_MAX_DECADES - 36, rel=1e-4)
+    # the vector itself is perfectly finite — this is a PREDICTION
+    assert mon.summary()["nonfinite"] is False
+
+
+def test_rule_overflow_headroom_growth_extrapolation(spec, reg):
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    # 28.5 decades of headroom: far above the 4-decade floor
+    assert mon.observe(_vec(spec, absmax=1e10), step=0) == []
+    # +10 decades in one observation: overflow in ~1.9 obs <= horizon 3
+    fired = mon.observe(_vec(spec, absmax=1e20), step=1)
+    assert [a.rule for a in fired] == ["overflow_headroom"]
+    assert fired[0].precursor and fired[0].value <= 3.0
+    # flat trajectory afterwards: no growth, no alarm
+    assert mon.observe(_vec(spec, absmax=1e20), step=2) == []
+
+
+def test_rule_nonfinite_and_window_hygiene(spec, reg):
+    cfg = H.HealthConfig(cadence=1, min_points=2)
+    mon = H.HealthMonitor(spec, cfg, metrics=reg)
+    mon.observe(_vec(spec), step=0)
+    fired = mon.observe(_vec(spec, loss=math.nan, grad=math.inf,
+                             nf_grads=3, absmax=2.0), step=1)
+    assert [a.rule for a in fired] == ["nonfinite"]
+    assert reg.get("train_health_anomalies_total").value(
+        rule="nonfinite") == 1
+    s = mon.summary()
+    assert s["nonfinite"] is True
+    assert s["loss"] == "nan" and s["grad_norm"] == "inf"   # JSON-safe
+    assert json.dumps(s)                                     # round-trips
+    # the diverged observation must NOT enter the spike baseline
+    assert list(mon._grad_window) == [1.0]
+
+
+def test_anomaly_tracer_instants_and_flight_dump(spec, reg, tmp_path):
+    flight = tmp_path / "flight.json"
+    tracer = Tracer(name="health-test", flight_path=str(flight))
+    prev = set_tracer(tracer)
+    try:
+        mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+        mon.observe(_vec(spec, absmax=1e36), step=0)        # precursor
+        mon.observe(_vec(spec, nf_grads=1.0), step=1)       # landed
+        mon.observe(_vec(spec, nf_grads=2.0), step=2)       # same rule again
+    finally:
+        set_tracer(prev)
+    names = [e["name"] for e in tracer.events()]
+    assert "nan_precursor" in names and "anomaly" in names
+    # once-per-rule flight dumps: both rules dumped, the repeat did not
+    assert mon._dumped_rules == {"overflow_headroom", "nonfinite"}
+    rec = json.loads(flight.read_text())
+    assert rec["kind"] == "flight_record"
+    assert rec["reason"].startswith("health: ")
+    assert rec["state"]["anomaly_rules"] == ["nonfinite",
+                                             "overflow_headroom"]
+
+
+def test_arm_localizer_runs_once_on_precursor(spec, reg, monkeypatch):
+    from paddle_tpu.analysis import nans as nans_mod
+    calls = []
+    monkeypatch.setattr(nans_mod, "nan_check",
+                        lambda target: calls.append(target) or ["report"])
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    mon.arm_localizer(lambda: "the-target")
+    mon.observe(_vec(spec, weight=1.0, update=0.5), step=0)  # not precursor
+    assert calls == [] and mon.localized is None
+    mon.observe(_vec(spec, absmax=1e36), step=1)             # precursor
+    mon.observe(_vec(spec, absmax=1e36), step=2)             # repeat
+    assert calls == ["the-target"]                           # once only
+    assert mon.localized == ["report"]
+
+
+# ------------------------------------------------------------- trainer
+
+
+def _tiny_trainer(reg, **health_kw):
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.training import Trainer
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    tr = Trainer(lm_model_fn_builder(cfg), optim.sgd(0.1), metrics=reg,
+                 health=H.HealthConfig(**health_kw))
+    batch = {"ids": np.arange(16, dtype=np.int32).reshape(2, 8) % 31}
+    return tr, batch
+
+
+def test_trainer_batch_path_health_compiles_once(reg):
+    from paddle_tpu.analysis import CompileWatcher
+    tr, batch = _tiny_trainer(reg, cadence=1)
+    tr.init(batch)
+    watch = CompileWatcher(step=tr._train_step)
+    tr.train_batch(batch)
+    tr.train_batch(batch)
+    watch.assert_counts(step=1)
+    mon = tr.health_monitor
+    assert mon is not None and mon._n_obs == 2
+    assert mon.last_step == 1
+    assert mon.spec.size == len(H.GLOBAL_STATS) + \
+        len(H.GROUP_STATS) * len(mon.spec.groups)
+    groups = {s["labels"].get("group")
+              for s in reg.snapshot()["metrics"]
+              ["train_health_grad_norm"]["series"]}
+    assert "global" in groups and len(groups) >= 3
+    assert mon.summary()["nonfinite"] is False
+
+
+def test_trainer_scan_path_health_and_cadence(reg):
+    from paddle_tpu.analysis import CompileWatcher
+    tr, batch = _tiny_trainer(reg, cadence=2)
+    tr.init(batch)
+    watch = CompileWatcher(scan=tr._train_scan)
+    stack = {"ids": np.stack([batch["ids"]] * 5)}
+    tr.train_batches(stack)
+    watch.assert_counts(scan=1)
+    mon = tr.health_monitor
+    # cadence 2 over scan steps 0..4: observations at 0, 2, 4
+    assert mon._n_obs == 3 and mon.last_step == 4
+    # batch path continues the SAME step counter: next step is 5 (odd)
+    tr.train_batch(batch)
+    assert mon._n_obs == 3
+    tr.train_batch(batch)                   # step 6: on the grid
+    assert mon._n_obs == 4 and mon.last_step == 6
+
+
+def test_trainer_health_off_by_default(reg):
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               lm_model_fn_builder)
+    from paddle_tpu.training import Trainer
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    tr = Trainer(lm_model_fn_builder(cfg), optim.sgd(0.1), metrics=reg)
+    tr.train_batch({"ids": np.zeros((2, 8), np.int32)})
+    assert tr.health_monitor is None
+    assert "train_health_grad_norm" not in reg.snapshot()["metrics"]
+
+
+def test_end_iteration_event_carries_health(reg):
+    from paddle_tpu.training import events as ev
+    tr, batch = _tiny_trainer(reg, cadence=1)
+    seen = []
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            seen.append(e)
+
+    tr.train(lambda: iter([batch, batch]), num_passes=1,
+             event_handler=handler)
+    assert len(seen) == 2
+    for e in seen:
+        assert e.health is not None
+        assert set(e.health) >= {"step", "grad_norm", "update_ratio",
+                                 "overflow_headroom_decades", "nonfinite"}
+    assert seen[0].health["step"] == 0 and seen[1].health["step"] == 1
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def _write_health_snapshot(path, reg, spec):
+    mon = H.HealthMonitor(spec, H.HealthConfig(cadence=1), metrics=reg)
+    mon.observe(_vec(spec, absmax=1e36), step=0)
+    append_jsonl(path, reg.snapshot(), meta={"run": "h"}, ts=1.0)
+    return mon
+
+
+def test_cli_health_renders_table(tmp_path, capsys, spec, reg):
+    from paddle_tpu.telemetry.cli import main
+    path = str(tmp_path / "run.jsonl")
+    _write_health_snapshot(path, reg, spec)
+    assert main(["health", path]) == 0
+    out = capsys.readouterr().out
+    assert "group" in out and "global" in out and "m/a" in out
+    assert "logit abs-max" in out
+    assert "overflow_headroom x1" in out
+
+
+def test_cli_health_rejects_uninstrumented_snapshot(tmp_path, reg):
+    from paddle_tpu.telemetry.cli import main
+    path = str(tmp_path / "plain.jsonl")
+    reg.counter("c").inc()
+    append_jsonl(path, reg.snapshot(), ts=1.0)
+    with pytest.raises(SystemExit, match="no training health"):
+        main(["health", path])
+
+
+def test_cli_show_and_diff_grep(tmp_path, capsys, spec, reg):
+    from paddle_tpu.telemetry.cli import main
+    path = str(tmp_path / "run.jsonl")
+    mon = _write_health_snapshot(path, reg, spec)
+    reg.counter("unrelated_total").inc()
+    mon.observe(_vec(spec, absmax=1e36), step=1)
+    append_jsonl(path, reg.snapshot(), meta={"run": "h"}, ts=2.0)
+
+    assert main(["show", path, "--grep", "train_health_grad"]) == 0
+    out = capsys.readouterr().out
+    assert "train_health_grad_norm" in out
+    assert "unrelated_total" not in out
+
+    assert main(["diff", path, "--grep", "anomalies"]) == 0
+    out = capsys.readouterr().out
+    assert "train_health_anomalies_total" in out
+    assert "train_health_grad_norm" not in out
+
+    with pytest.raises(SystemExit, match="no metric names match"):
+        main(["show", path, "--grep", "no_such_metric"])
+    with pytest.raises(SystemExit, match="bad regex"):
+        main(["show", path, "--grep", "("])
+
+
+# ----------------------------------------------------- optim norm taps
+
+
+def test_global_norm_and_norm_tap():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    assert float(optim.global_norm(tree)) == pytest.approx(5.0)
+    assert float(optim.global_norm({})) == 0.0
+
+    params = {"w": jnp.ones((2,))}
+    tap = optim.norm_tap()
+    state = tap.init(params)
+    u, state = tap.update(tree, state, params, jnp.asarray(0))
+    assert u is tree                        # identity on the update stream
+    assert float(state) == pytest.approx(5.0)
+
+    # chained LAST, it observes the final (scaled) deltas
+    t = optim.chain(optim.sgd(0.1), optim.norm_tap())
+    st = t.init(params)
+    g = {"w": jnp.asarray([3.0, 4.0])}
+    u, st = t.update(g, st, params, jnp.asarray(0))
+    assert float(optim.global_norm(u)) == pytest.approx(0.5, rel=1e-6)
+    assert float(st[1]) == pytest.approx(0.5, rel=1e-6)
