@@ -31,6 +31,90 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _bench_shared_prefix(args, cfg, params, jax):
+    """``--shared-prefix N``: engine-level prefix-cache benchmark.
+
+    N requests share one ``--prompt``-token system prompt (each with an
+    8-token unique tail).  Request 1 misses and prefills the full
+    prompt; requests 2..N match the registered blocks and prefill only
+    the tail, so their prefill span and TTFT collapse toward a single
+    decode step.  Warm-up runs a miss+hit pair behind a THROWAWAY
+    prefix (then flushes it) so every measured span is compile-free."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry.trace import Tracer
+
+    n, sfx, bs = args.shared_prefix, 8, args.block_size
+    plen, steps = args.prompt, args.steps
+    slots = min(n, 8)
+    per_req = -(-(plen + sfx + steps) // bs)
+    pool = args.pool_blocks or \
+        (slots + 1) * per_req + -(-(plen + sfx) // bs) + 4
+    rs = np.random.RandomState(1)
+    tracer = Tracer(capacity=1 << 17, name="lm_decode_shared_prefix")
+    eng = PagedServingEngine(
+        cfg, params, num_slots=slots, num_blocks=pool, block_size=bs,
+        prompt_buckets=(plen + sfx,), prefix_cache=True,
+        decode_kernel={"auto": None, "on": True,
+                       "off": False}[args.paged_kernel],
+        tracer=tracer, seed=0)
+
+    def burst(prefix, count, max_new):
+        return [eng.submit(np.concatenate(
+            [prefix, rs.randint(0, args.vocab, sfx)]).astype(np.int32),
+            max_new=max_new) for _ in range(count)]
+
+    # warm-up: compiles prefill (miss), share + tail prefill (hit) and
+    # the decode step, then returns the throwaway prefix to the pool
+    burst(rs.randint(0, args.vocab, plen), 2, max_new=2)
+    eng.run()
+    eng.flush_prefix_cache()
+    base = dict(eng.host_state()["prefix_cache"])  # cumulative counters
+
+    system = rs.randint(0, args.vocab, plen)
+    t0 = time.perf_counter()
+    rids = set(burst(system, n, max_new=steps))
+    out = eng.run()
+    wall = time.perf_counter() - t0
+
+    ttft, pfill = {}, {}
+    for e in tracer.events():
+        if e["rid"] in rids:
+            if e["name"] == "first_token":
+                ttft[e["rid"]] = e["args"]["ttft_s"]
+            elif e["name"] == "prefill":
+                pfill[e["rid"]] = (e["dur"], e["args"]["prefill_tokens"])
+    miss = [r for r, (_, t) in pfill.items() if t == plen + sfx]
+    hits = sorted(r for r in pfill if r not in miss)
+    med = (lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0)
+    stats = eng.host_state()["prefix_cache"]
+    hit_tokens = stats["hit_tokens"] - base["hit_tokens"]
+    gen = sum(len(v) for v in out.values())
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} prompt{plen} "
+               f"shared-prefix{n}",
+        value=round(med([ttft[r] for r in hits]) * 1e3
+                    if hits else ttft[miss[0]] * 1e3, 3),
+        unit="ms",                         # median HIT TTFT
+        backend=jax.default_backend(),
+        decoder="engine",
+        compiles=eng.compile_counts(),
+        shared_prefix=n,
+        block_size=bs,
+        pool_blocks=pool,
+        paged_kernel=bool(eng.decode_kernel),
+        prefix_hit_tokens=int(hit_tokens),
+        prefix_hits=int(stats["hits"] - base["hits"]),
+        prefix_misses=int(stats["misses"] - base["misses"]),
+        ttft_miss_ms=round(med([ttft[r] for r in miss]) * 1e3, 3),
+        ttft_hit_ms=round(med([ttft[r] for r in hits]) * 1e3, 3),
+        prefill_miss_ms=round(
+            med([pfill[r][0] for r in miss]) * 1e3, 3),
+        prefill_hit_ms=round(
+            med([pfill[r][0] for r in hits]) * 1e3, 3),
+        tokens_per_s=round(gen / wall, 1))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=1024)
@@ -69,6 +153,16 @@ def main():
                          "on = force the kernel (interpret mode off-"
                          "TPU), off = force the gather form — the row "
                          "carries the resolved choice as paged_kernel")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                    help="serve N requests behind ONE shared system "
+                         "prompt (--prompt tokens each, plus an 8-token "
+                         "unique tail) through the paged serving ENGINE "
+                         "with prefix caching on: the first request "
+                         "misses (full prefill), the rest map the "
+                         "resident blocks and prefill only the tail — "
+                         "the row reports miss vs hit TTFT/prefill "
+                         "spans and prefix_hit_tokens instead of the "
+                         "differential step time; requires --paged")
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="append a telemetry snapshot record (the row as "
                          "meta + the process registry, raw differential "
@@ -85,6 +179,9 @@ def main():
         ap.error("--ragged requires --decoder serve")
     if args.paged and args.decoder != "serve":
         ap.error("--paged requires --decoder serve")
+    if args.shared_prefix and not args.paged:
+        ap.error("--shared-prefix requires --paged (the prefix cache "
+                 "lives in the paged serving engine)")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.attach import attach_probe_with_retry
@@ -136,6 +233,15 @@ def main():
         if args.bf16_params:
             from paddle_tpu.inference import serving_cast
             params = serving_cast(params)
+        if args.shared_prefix:
+            row = _bench_shared_prefix(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
         if args.paged:
             from paddle_tpu.serving import paged_serve_builder
             decode = paged_serve_builder(

@@ -215,6 +215,29 @@ def _paged_engine_decode() -> LintTarget:
                           "lands (ROADMAP)"))
 
 
+@register_entrypoint("paged-engine-decode-prefix")
+def _paged_engine_decode_prefix() -> LintTarget:
+    # The prefix-sharing twin: decode with ``prefix_cache=True`` traces
+    # a copy-on-write un-share (refcount test + cond-gated block copy)
+    # ahead of the reserve/append scatters.  Linting it proves the COW
+    # machinery stays in-graph (no host callback resolves "is this
+    # block shared?") and adds no attention gathers to the loop.
+    from paddle_tpu.serving import PagedServingEngine
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,), prefix_cache=True)
+    S = eng.S
+    return LintTarget(
+        "paged-engine-decode-prefix", eng._decode,
+        (eng.params, eng.cache, jnp.zeros((S,), jnp.int32),
+         jnp.ones((S,), bool), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, eng._decode_slot_args,
+                          "dp over slot vectors; the COW copy reads "
+                          "and writes the replicated pool exactly like "
+                          "reserve/append do"))
+
+
 # Kernel-selected twins: the same serve programs with decode_kernel
 # FORCED on (Pallas interpret mode on the CPU lint backend — the
 # traced jaxpr carries the pallas_call eqn either way, which is what

@@ -294,7 +294,22 @@ class MultiHeadAttention(Module):
         from paddle_tpu.ops import paged_attention as paged
 
         new_cache = None
-        if isinstance(cache, paged.PagedLayerView):
+        if isinstance(cache, paged.PagedChunkedView):
+            # CHUNKED tail prefill (prefix-cache hit): t fresh tokens
+            # append BEHIND a nonzero committed prefix; every query
+            # attends the block-table-resident prefix + the fresh
+            # tokens causally.  Distinct view type so the fresh-slot
+            # prefill path below stays byte-identical.
+            enforce(mask is None,
+                    "paged cache mode: per-token masks are unsupported; "
+                    "append_valid bounds the fresh tokens and lengths "
+                    "bound the context")
+            kp, vp = paged.paged_append(cache, k, v)
+            out = paged.paged_chunked_attention(
+                q, kp, vp, cache.block_table, cache.lengths,
+                cache.append_valid)
+            new_cache = cache._replace(k_pages=kp, v_pages=vp)
+        elif isinstance(cache, paged.PagedLayerView):
             # PAGED cache form (block-pool K/V + block table — see
             # ops/paged_attention.py): append the fresh keys/values
             # into the pools, then attend by block table.  ``position``

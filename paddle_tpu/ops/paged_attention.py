@@ -15,9 +15,17 @@ Ragged Paged Attention does it for TPU serving (PAPERS.md): one global
   ``paddle_tpu/serving.py``) — no head-of-line blocking.
 
 Everything here is PURE-FUNCTIONAL and fixed-shape: alloc/append/free
-are jit-safe pytree -> pytree transforms (the free list is a bool mask,
-allocation is an argsort+cumsum rank assignment), so one compiled
-decode step serves the whole lifetime of a serving process.
+are jit-safe pytree -> pytree transforms (the pool state is an int32
+REFCOUNT per block — 0 = free, 1 = one owner, >1 = shared; allocation
+is an argsort+cumsum rank assignment over the zero-refcount mask), so
+one compiled decode step serves the whole lifetime of a serving
+process.  Refcounts are what make PREFIX SHARING a pool-native
+operation (``paddle_tpu/prefix_cache.py`` + the serving engine):
+:func:`paged_share` maps already-resident blocks into another slot's
+table (increment), :func:`paged_free` decrements instead of
+unconditionally freeing, and :func:`paged_cow` copies a shared block
+before the first divergent token is appended into it — copy-on-write,
+so a shared prefix block is never mutated under its other readers.
 
 :func:`paged_decode_attention` is the decode-step kernel surface:
 gather-by-block-table, f32 accumulation, masked to per-slot length.  It
@@ -59,7 +67,11 @@ class PagedKVCache(NamedTuple):
     physical block id per (slot, logical block), ``-1`` = unmapped.
     ``lengths``: ``[num_slots]`` int32 committed tokens per slot.
     ``blocks_used``: ``[num_slots]`` int32 mapped blocks per slot.
-    ``free``: ``[num_blocks]`` bool, True = block is in the pool.
+    ``refcounts``: ``[num_blocks]`` int32 owners per block — 0 = free
+    (in the pool), 1 = exclusively owned, >1 = SHARED (mapped by
+    several slots and/or pinned by the host prefix registry).  The
+    ``free`` property derives the old bool mask, so accounting reads
+    (``occupancy()``, tests) are unchanged.
     """
 
     k_pages: Tuple[jax.Array, ...]
@@ -67,7 +79,12 @@ class PagedKVCache(NamedTuple):
     block_tables: jax.Array
     lengths: jax.Array
     blocks_used: jax.Array
-    free: jax.Array
+    refcounts: jax.Array
+
+    @property
+    def free(self) -> jax.Array:
+        """``[num_blocks]`` bool, True = block is in the pool (rc 0)."""
+        return self.refcounts == 0
 
     # shape-derived statics (usable under jit — shapes are concrete)
     @property
@@ -110,6 +127,27 @@ class PagedLayerView(NamedTuple):
     append_valid: jax.Array  # [b] int32 — fresh tokens to commit this call
 
 
+class PagedChunkedView(NamedTuple):
+    """The CHUNKED-PREFILL twin of :class:`PagedLayerView` — same
+    fields, distinct type, because the attention math differs: a
+    chunked call appends ``t > 1`` fresh tokens BEHIND a nonzero
+    committed prefix (``lengths > 0``), so every query must attend the
+    block-table-resident prefix PLUS the fresh tokens causally —
+    :func:`paged_chunked_attention`.  The plain view's t>1 path
+    assumes a fresh slot (prefix == the fresh tokens) and attends the
+    in-flight K/V only; keeping the types distinct keeps that
+    fast path byte-identical while ``MultiHeadAttention`` dispatches
+    on ``isinstance``.  Built by :func:`chunked_layer_views`; the
+    serving engine uses it to prefill only the unmatched TAIL of a
+    prefix-cache hit."""
+
+    k_pages: jax.Array       # [num_blocks, block_size, h, hd]
+    v_pages: jax.Array
+    block_table: jax.Array   # [b, max_blocks_per_slot] int32
+    lengths: jax.Array       # [b] int32 — tokens committed BEFORE this call
+    append_valid: jax.Array  # [b] int32 — fresh tokens to commit this call
+
+
 def paged_init(num_layers: int, num_slots: int, max_blocks_per_slot: int,
                num_blocks: int, block_size: int, num_heads: int,
                head_dim: int, dtype=jnp.float32) -> PagedKVCache:
@@ -122,7 +160,7 @@ def paged_init(num_layers: int, num_slots: int, max_blocks_per_slot: int,
                               jnp.int32),
         lengths=jnp.zeros((num_slots,), jnp.int32),
         blocks_used=jnp.zeros((num_slots,), jnp.int32),
-        free=jnp.ones((num_blocks,), bool))
+        refcounts=jnp.zeros((num_blocks,), jnp.int32))
 
 
 def paged_reserve(cache: PagedKVCache, want):
@@ -138,7 +176,8 @@ def paged_reserve(cache: PagedKVCache, want):
 
     Allocation is deterministic and pure: free blocks sort first (by
     index, stable argsort), demand ranks by flat cumsum, rank r takes
-    the r-th free block.
+    the r-th free block.  A claimed block's refcount is SET to 1 — the
+    slot is its sole owner until :func:`paged_share` maps it elsewhere.
     """
     S, maxb = cache.block_tables.shape
     nb = cache.num_blocks
@@ -155,13 +194,13 @@ def paged_reserve(cache: PagedKVCache, want):
     ids = order[jnp.clip(rank, 0, nb - 1)]
     ids = jnp.where(flat, ids, nb)             # sentinel -> dropped below
     claimed = jnp.zeros((nb,), bool).at[ids].max(flat, mode="drop")
-    free = cache.free & ~claimed
+    refcounts = jnp.where(claimed, 1, cache.refcounts)
     ids2 = ids.reshape(S, maxb).astype(jnp.int32)
     rows = jnp.broadcast_to(jnp.arange(S)[:, None], (S, maxb))
     cols = cache.blocks_used[:, None] + jnp.arange(maxb)[None, :]
     cols = jnp.where(need, cols, maxb)         # non-need -> dropped
     tables = cache.block_tables.at[rows, cols].set(ids2, mode="drop")
-    return cache._replace(free=free, block_tables=tables,
+    return cache._replace(refcounts=refcounts, block_tables=tables,
                           blocks_used=cache.blocks_used + n_new), ok
 
 
@@ -174,27 +213,137 @@ def paged_advance(cache: PagedKVCache, counts) -> PagedKVCache:
 
 
 def paged_free(cache: PagedKVCache, slot_mask) -> PagedKVCache:
-    """Return the masked slots' blocks to the pool and reset them.
+    """Release the masked slots' block mappings and reset the slots.
 
-    ``slot_mask``: [num_slots] bool, True = retire this slot.  The
-    pool rows themselves are NOT zeroed — a freed block's stale K/V is
-    unreachable (no table maps it) and the next owner overwrites it,
-    the same reuse contract as the dense cache's garbage rows beyond
-    ``position``."""
+    ``slot_mask``: [num_slots] bool, True = retire this slot.  Each
+    mapped block's refcount DECREMENTS by one — a block returns to the
+    pool only when its last owner lets go (rc 0); blocks shared with
+    other slots or pinned by the prefix registry survive with rc >= 1.
+    The pool rows themselves are NOT zeroed — a freed block's stale
+    K/V is unreachable (no table maps it) and the next owner
+    overwrites it, the same reuse contract as the dense cache's
+    garbage rows beyond ``position``."""
     S, maxb = cache.block_tables.shape
     nb = cache.num_blocks
     slot_mask = jnp.asarray(slot_mask, bool)
     mapped = jnp.arange(maxb)[None, :] < cache.blocks_used[:, None]
     drop = slot_mask[:, None] & mapped
     ids = jnp.where(drop, cache.block_tables, nb)
-    freed = jnp.zeros((nb,), bool).at[ids.reshape(-1)].max(
-        drop.reshape(-1), mode="drop")
+    dec = jnp.zeros((nb,), jnp.int32).at[ids.reshape(-1)].add(
+        drop.reshape(-1).astype(jnp.int32), mode="drop")
     return cache._replace(
-        free=cache.free | freed,
+        refcounts=jnp.maximum(cache.refcounts - dec, 0),
         block_tables=jnp.where(slot_mask[:, None], -1,
                                cache.block_tables),
         lengths=jnp.where(slot_mask, 0, cache.lengths),
         blocks_used=jnp.where(slot_mask, 0, cache.blocks_used))
+
+
+def paged_share(cache: PagedKVCache, slot, block_ids, n_mapped,
+                new_len) -> PagedKVCache:
+    """Map already-resident blocks into ``slot``'s table — the prefix
+    cache's admission fast path (no prefill over the shared tokens).
+
+    ``block_ids``: ``[max_blocks_per_slot]`` int32, the first
+    ``n_mapped`` entries are physical blocks to share; each shared
+    block's refcount INCREMENTS (the slot becomes one more owner).
+    ``new_len`` is the committed-token cursor to set — at most the
+    tokens the shared blocks hold, and it may deliberately stop one
+    token SHORT of them (the full-prompt-hit case: the engine replays
+    the final prompt token so the prefill emits sampling logits;
+    :func:`paged_cow` makes the replayed write safe).  The slot must
+    be empty (freshly retired / never used): its previous mappings are
+    NOT released here."""
+    S, maxb = cache.block_tables.shape
+    nb = cache.num_blocks
+    slot = jnp.asarray(slot, jnp.int32)
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    n_mapped = jnp.asarray(n_mapped, jnp.int32)
+    valid = jnp.arange(maxb) < n_mapped
+    row = jnp.where(valid, block_ids, -1)
+    inc = jnp.zeros((nb,), jnp.int32).at[
+        jnp.where(valid, block_ids, nb)].add(valid.astype(jnp.int32),
+                                             mode="drop")
+    return cache._replace(
+        block_tables=cache.block_tables.at[slot].set(row),
+        blocks_used=cache.blocks_used.at[slot].set(n_mapped),
+        lengths=cache.lengths.at[slot].set(
+            jnp.asarray(new_len, jnp.int32)),
+        refcounts=cache.refcounts + inc)
+
+
+def paged_rc_add(cache: PagedKVCache, delta) -> PagedKVCache:
+    """Adjust refcounts by a host-built ``[num_blocks]`` int32 delta —
+    the prefix registry's pin (+1, block survives every slot retiring)
+    and unpin (-1, an evicted prefix block returns to the pool when no
+    slot maps it).  Clamped at zero so a host accounting bug cannot
+    wrap a refcount negative and resurrect a freed block."""
+    return cache._replace(refcounts=jnp.maximum(
+        cache.refcounts + jnp.asarray(delta, jnp.int32), 0))
+
+
+def paged_cow(cache: PagedKVCache, want):
+    """Copy-on-write: un-share each appending slot's cursor block.
+
+    ``want``: [num_slots] int32 tokens about to be appended (the same
+    vector the subsequent :func:`paged_reserve` takes).  A slot whose
+    next write lands in an already-mapped block (``lengths`` inside
+    ``blocks_used`` blocks) that is SHARED (refcount > 1 — other slots
+    and/or the prefix registry read it) gets a private copy first: a
+    fresh block is claimed (same deterministic argsort allocator), the
+    K/V pages copy over, the table remaps, and the old block's
+    refcount drops by one — the divergent token is then written into
+    the copy, never under the other readers.  At most one copy per
+    slot per call; slots at a block boundary, on unshared blocks, or
+    not appending are untouched.  Returns ``(cache, ok)`` with the
+    same cannot-raise contract as ``paged_reserve``.
+
+    The page copies sit behind a ``lax.cond`` on "any slot diverging",
+    so the common no-divergence decode step skips the copy traffic at
+    runtime while the program stays fixed-shape (one compile).
+    """
+    S, maxb = cache.block_tables.shape
+    nb, bs = cache.num_blocks, cache.block_size
+    want = jnp.asarray(want, jnp.int32)
+    blk = cache.lengths // bs                  # cursor block index  [S]
+    blk_c = jnp.clip(blk, 0, maxb - 1)
+    # tpu-lint: disable=gather-in-decode — cursor-block lookup, [S] int32 traffic; the page copy itself is cond-gated on divergence
+    cur = jnp.take_along_axis(cache.block_tables, blk_c[:, None],
+                              axis=1)[:, 0]                       # [S]
+    cur_c = jnp.clip(cur, 0, nb - 1)
+    # tpu-lint: disable=gather-in-decode — refcount probe of S cursor blocks, [S] int32 traffic
+    rc_cur = cache.refcounts[cur_c]
+    diverge = ((want > 0) & (blk < cache.blocks_used) & (cur >= 0)
+               & (rc_cur > 1))                                    # [S]
+
+    def copy(cache):
+        free = cache.refcounts == 0
+        ok = jnp.sum(diverge) <= jnp.sum(free)
+        order = jnp.argsort(~free)
+        rank = jnp.cumsum(diverge) - 1
+        # tpu-lint: disable=gather-in-decode — allocator rank lookup, same justified form as paged_reserve
+        ids = order[jnp.clip(rank, 0, nb - 1)].astype(jnp.int32)
+        ids = jnp.where(diverge, ids, nb)      # sentinel -> dropped
+        src = jnp.where(diverge, cur_c, 0)
+        # tpu-lint: disable=gather-in-decode — the copy-on-write page copy: S blocks per layer, runs only on the divergence step (cond above)
+        k_pages = tuple(k.at[ids].set(k[src], mode="drop")
+                        for k in cache.k_pages)
+        # tpu-lint: disable=gather-in-decode — V half of the copy-on-write page copy
+        v_pages = tuple(v.at[ids].set(v[src], mode="drop")
+                        for v in cache.v_pages)
+        d32 = diverge.astype(jnp.int32)
+        dec = jnp.zeros((nb,), jnp.int32).at[
+            jnp.where(diverge, cur_c, nb)].add(d32, mode="drop")
+        inc = jnp.zeros((nb,), jnp.int32).at[ids].add(d32, mode="drop")
+        tables = cache.block_tables.at[
+            jnp.arange(S), jnp.where(diverge, blk_c, maxb)].set(
+                ids, mode="drop")
+        return cache._replace(
+            k_pages=k_pages, v_pages=v_pages, block_tables=tables,
+            refcounts=jnp.maximum(cache.refcounts - dec, 0) + inc), ok
+
+    return jax.lax.cond(jnp.any(diverge), copy,
+                        lambda c: (c, jnp.asarray(True)), cache)
 
 
 def layer_views(cache: PagedKVCache, slot_ids, append_valid):
@@ -205,6 +354,18 @@ def layer_views(cache: PagedKVCache, slot_ids, append_valid):
     lens = cache.lengths[slot_ids]
     valid = jnp.asarray(append_valid, jnp.int32)
     return [PagedLayerView(k, v, table, lens, valid)
+            for k, v in zip(cache.k_pages, cache.v_pages)]
+
+
+def chunked_layer_views(cache: PagedKVCache, slot_ids, append_valid):
+    """Per-layer :class:`PagedChunkedView` list — the tail-prefill
+    form: the call's ``t`` fresh tokens append BEHIND the slots'
+    committed ``lengths`` and attention spans prefix + fresh."""
+    slot_ids = jnp.asarray(slot_ids, jnp.int32)
+    table = cache.block_tables[slot_ids]
+    lens = cache.lengths[slot_ids]
+    valid = jnp.asarray(append_valid, jnp.int32)
+    return [PagedChunkedView(k, v, table, lens, valid)
             for k, v in zip(cache.k_pages, cache.v_pages)]
 
 
@@ -360,6 +521,49 @@ def _paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
                         preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(maxb * bs)[None, :] < lengths[:, None]      # [b,K]
     logits = logits + jnp.where(mask, 0.0, NEG_INF)[:, None, None, :]
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v,
+                      preferred_element_type=jnp.float32)
+
+
+def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, block_table: jax.Array,
+                            lengths: jax.Array, append_valid: jax.Array,
+                            scale=None) -> jax.Array:
+    """Chunked-prefill attention: ``q`` [b, t, h, hd] fresh queries at
+    positions ``lengths[r] + j`` attend the row's committed prefix
+    PLUS the fresh tokens up to themselves — the t>1, lengths>0 form
+    the plain decode/prefill paths cannot serve.  The fresh K/V are
+    already in the pools (``paged_append`` runs first, exactly like
+    the decode step), so one gather covers prefix and tail and the
+    causal structure is a per-query length bound:
+    ``kpos < lengths[r] + j + 1``.
+
+    Numerics follow the XLA decode form verbatim (f32 accumulation,
+    finite-NEG_INF mask, f32 softmax): masked/garbage positions carry
+    exactly-zero weight and mapped blocks gather in logical order, so
+    a tail prefilled over a SHARED prefix is bit-identical to the same
+    tokens prefilled from scratch — the prefix-cache token-identity
+    contract (pinned by ``tests/test_prefix_cache.py``).  Query
+    columns at or past ``append_valid[r]`` are pad lanes: don't-care
+    outputs the caller never reads.
+    """
+    b, tq, h, hd = q.shape
+    nb, bs = k_pages.shape[0], k_pages.shape[1]
+    maxb = block_table.shape[1]
+    scale = (hd ** -0.5) if scale is None else scale
+    table = jnp.clip(block_table, 0, nb - 1)
+    # tpu-lint: disable=gather-in-decode — chunked TAIL PREFILL, not a decode step: one gather per admitted prefix hit, amortized over the whole request
+    k = k_pages[table].reshape(b, maxb * bs, h, hd)
+    # tpu-lint: disable=gather-in-decode — V half of the tail-prefill gather above
+    v = v_pages[table].reshape(b, maxb * bs, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    limit = (lengths[:, None] + jnp.arange(tq)[None, :] + 1)     # [b,t]
+    mask = (jnp.arange(maxb * bs)[None, None, :]
+            < limit[:, :, None])                                 # [b,t,K]
+    logits = logits + jnp.where(mask, 0.0, NEG_INF)[:, None, :, :]
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     weights = weights.astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v,

@@ -1,0 +1,260 @@
+"""Host-side prefix registry: a radix tree over block-size token chunks.
+
+At production scale most traffic shares a handful of long system
+prompts, so most prefill FLOPs and most pool blocks are redundant
+copies of identical prefixes.  The paged KV cache
+(``ops/paged_attention.py``) already indirects every read through a
+block table, which makes prefix reuse a BOOKKEEPING problem: if the
+first ``k`` blocks of a new prompt hold exactly the tokens another
+request already prefilled, the new slot can map those physical blocks
+(``paged_share`` — a refcount increment) instead of recomputing them,
+and prefill runs only over the unmatched tail.
+
+This module is that bookkeeping — pure host Python, no jax:
+
+* **Chunk nodes.**  The tree's edges are whole block-size token
+  chunks (``tuple`` keys in each node's ``children``), so a match is
+  a walk: chunk ``i`` can only match under matched chunks ``0..i-1``,
+  which is exactly the causal contract that makes a prefix block
+  position-independent of its suffix.  Each node owns ONE physical
+  block holding that chunk's K/V.
+* **Tail nodes.**  A prompt rarely ends on a block boundary; the
+  partial last block registers as a TAIL entry under its parent chunk
+  node (keyed by the exact remaining tokens).  A tail matches only
+  when it is a prefix of the new prompt's remainder — its block can
+  then be shared mid-block, with ``paged_cow`` giving the recipient a
+  private copy before any divergent token is written.  Multiple tails
+  (diverging endings) coexist under one parent.
+* **Pinning.**  Every registered node holds one refcount on its block
+  (the engine pins via ``paged_rc_add``), so a cached prefix survives
+  its donor request retiring.  ``PrefixCache`` itself never touches
+  device state — the ENGINE owns the refcount calls and tells the
+  registry what happened; the registry answers "which blocks would
+  match" and "which may evict".
+* **Eviction.**  ``evict()`` yields LRU LEAF-first victims (no
+  children, no tails) among nodes with no live sharers — evicting an
+  interior node would orphan its descendants' match path, and
+  evicting a block some active slot still maps frees nothing (the
+  refcount would stay > 0).  A sharer-free leaf's block is pinned
+  only by the registry, so its unpin is an immediate pool return.
+
+The serving engine (``serving.py``) drives match -> share -> tail
+prefill -> register; ``docs/design/serving.md`` has the full design.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+__all__ = ["PrefixCache", "PrefixHit"]
+
+
+class _Node:
+    """One cached block: a full chunk (interior-capable) or a tail."""
+
+    __slots__ = ("block_id", "parent", "children", "tails", "sharers",
+                 "last_used", "is_tail", "n_tokens")
+
+    def __init__(self, block_id: int, parent: Optional["_Node"],
+                 n_tokens: int, is_tail: bool, tick: int):
+        self.block_id = int(block_id)
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.tails: Dict[Tuple[int, ...], "_Node"] = {}
+        self.sharers: Set[int] = set()        # rids currently mapping it
+        self.last_used = tick
+        self.is_tail = is_tail
+        self.n_tokens = n_tokens              # tokens the block holds
+
+
+class PrefixHit(NamedTuple):
+    """One ``match()`` result.
+
+    ``shared_len``: prompt tokens covered by registered blocks.
+    ``block_ids``: the physical blocks, in logical (chunk) order.
+    ``nodes``: the matched registry nodes (same order) — the engine
+    marks its rid as a live sharer on each and hands them back at
+    retire time.
+    """
+
+    shared_len: int
+    block_ids: List[int]
+    nodes: List[_Node]
+
+
+class PrefixCache:
+    """Radix registry over block-size token chunks.  Single-threaded —
+    owned and driven by one engine's admission loop."""
+
+    def __init__(self, block_size: int):
+        assert block_size >= 1
+        self.bs = int(block_size)
+        self._root = _Node(-1, None, 0, False, 0)
+        self._tick = itertools.count(1)       # LRU clock (monotonic)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------ match
+
+    def match(self, tokens: Sequence[int]) -> PrefixHit:
+        """Longest registered prefix of ``tokens``: full chunks walked
+        greedily, then the longest matching tail under the last chunk.
+        Touches LRU stamps on the matched path; updates hit/miss
+        counters (a hit = at least one block matched)."""
+        toks = [int(t) for t in tokens]
+        n = len(toks)
+        bs = self.bs
+        now = next(self._tick)
+        node = self._root
+        ids: List[int] = []
+        nodes: List[_Node] = []
+        i = 0
+        while i + bs <= n:
+            child = node.children.get(tuple(toks[i:i + bs]))
+            if child is None:
+                break
+            child.last_used = now
+            ids.append(child.block_id)
+            nodes.append(child)
+            node = child
+            i += bs
+        best: Optional[Tuple[Tuple[int, ...], _Node]] = None
+        if i < n:
+            rest = tuple(toks[i:])
+            for key, tail in node.tails.items():
+                if len(key) <= len(rest) and rest[:len(key)] == key:
+                    if best is None or len(key) > len(best[0]):
+                        best = (key, tail)
+        if best is not None:
+            key, tail = best
+            tail.last_used = now
+            ids.append(tail.block_id)
+            nodes.append(tail)
+            i += len(key)
+        if ids:
+            self.hits += 1
+            self.hit_tokens += i
+        else:
+            self.misses += 1
+        return PrefixHit(i, ids, nodes)
+
+    # ----------------------------------------------------------- insert
+
+    def insert(self, tokens: Sequence[int],
+               block_ids: Sequence[int]) -> List[_Node]:
+        """Register ``tokens``'s blocks: full chunks along the radix
+        path, plus a tail entry for the partial last block.  Existing
+        nodes are left alone (idempotent); ``block_ids`` is the slot's
+        block-table row (physical block per prompt block index).
+        Returns the NEWLY created nodes — the engine pins exactly
+        those blocks (+1 refcount each) and records itself as a live
+        sharer on the whole path."""
+        toks = [int(t) for t in tokens]
+        n = len(toks)
+        bs = self.bs
+        now = next(self._tick)
+        new: List[_Node] = []
+        node = self._root
+        i = 0
+        bi = 0
+        while i + bs <= n:
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(block_ids[bi], node, bs, False, now)
+                node.children[key] = child
+                new.append(child)
+            child.last_used = now
+            node = child
+            i += bs
+            bi += 1
+        if i < n:
+            key = tuple(toks[i:])
+            tail = node.tails.get(key)
+            if tail is None:
+                tail = _Node(block_ids[bi], node, len(key), True, now)
+                node.tails[key] = tail
+                new.append(tail)
+            tail.last_used = now
+        return new
+
+    # --------------------------------------------------------- eviction
+
+    def evictable(self) -> List[_Node]:
+        """Current victims: sharer-free LEAVES (tails, and chunk nodes
+        with no children and no tails), LRU-first."""
+        out: List[_Node] = []
+
+        def walk(node: _Node):
+            for child in node.children.values():
+                walk(child)
+                if (not child.children and not child.tails
+                        and not child.sharers):
+                    out.append(child)
+            for tail in node.tails.values():
+                if not tail.sharers:
+                    out.append(tail)
+
+        walk(self._root)
+        out.sort(key=lambda nd: nd.last_used)
+        return out
+
+    def evict(self, max_blocks: int) -> List[int]:
+        """Drop up to ``max_blocks`` registered blocks (LRU leaf-first,
+        cascading: a parent whose last child left becomes a leaf and
+        may evict in the same call).  Returns the freed block ids —
+        the ENGINE unpins them (``paged_rc_add`` -1); a sharer-free
+        leaf's block then returns to the pool immediately."""
+        freed: List[int] = []
+        while len(freed) < max_blocks:
+            victims = self.evictable()
+            if not victims:
+                break
+            for victim in victims:
+                if len(freed) >= max_blocks:
+                    break
+                self._remove(victim)
+                freed.append(victim.block_id)
+                self.evictions += 1
+        return freed
+
+    def _remove(self, node: _Node) -> None:
+        parent = node.parent
+        table = parent.tails if node.is_tail else parent.children
+        for key, val in list(table.items()):
+            if val is node:
+                del table[key]
+                return
+
+    # ------------------------------------------------------------ stats
+
+    def _count(self) -> Tuple[int, int, int]:
+        chunks = tails = shared = 0
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            chunks += len(node.children)
+            tails += len(node.tails)
+            shared += sum(1 for nd in node.children.values()
+                          if nd.sharers)
+            shared += sum(1 for nd in node.tails.values() if nd.sharers)
+            stack.extend(node.children.values())
+        return chunks, tails, shared
+
+    @property
+    def blocks(self) -> int:
+        """Registered (pinned) blocks."""
+        chunks, tails, _ = self._count()
+        return chunks + tails
+
+    def stats(self) -> dict:
+        chunks, tails, shared = self._count()
+        return {"chunk_nodes": chunks, "tail_nodes": tails,
+                "pinned_blocks": chunks + tails,
+                "shared_blocks": shared,
+                "hits": self.hits, "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions}

@@ -20,6 +20,15 @@ Two serving forms over the paged cache (``ops/paged_attention.py``):
   only, so the in-jit allocator can never run dry; physical blocks are
   still mapped on demand, so reported occupancy tracks ACTUAL tokens.
 
+  ``prefix_cache=True`` adds PREFIX SHARING on top: admitted prompts
+  register their blocks in a host-side radix tree
+  (``paddle_tpu/prefix_cache.py``), a later prompt with the same
+  leading tokens maps those physical blocks by refcount increment
+  (``paged_share``) and prefills only the unmatched tail, and a write
+  into a still-shared block copies first (``paged_cow``) — TTFT on a
+  hit collapses to the tail and effective pool capacity multiplies,
+  with token streams BIT-IDENTICAL to the sharing-off engine.
+
 Why paged: the dense serving cache costs
 ``num_slots * max_len * 2 * L * dim * dtype_bytes`` of HBM no matter
 what is actually resident — the paged pool costs
@@ -50,6 +59,7 @@ from paddle_tpu.models.transformer import (TransformerConfig,
 from paddle_tpu.ops import paged_attention as paged
 from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
                                             paged_hbm_bytes)
+from paddle_tpu.prefix_cache import PrefixCache
 from paddle_tpu import telemetry
 import paddle_tpu.nn as nn
 
@@ -256,7 +266,8 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "tokens",
-                 "blocks_reserved", "submitted_at", "first_token_at")
+                 "blocks_reserved", "submitted_at", "first_token_at",
+                 "prefix_hit_tokens", "prefix_nodes")
 
     def __init__(self, rid, prompt, max_new, temperature, blocks):
         self.rid = rid
@@ -267,6 +278,8 @@ class _Request:
         self.blocks_reserved = blocks
         self.submitted_at = time.perf_counter()
         self.first_token_at = None        # set when prefill emits tok0
+        self.prefix_hit_tokens = 0        # prompt tokens NOT prefilled
+        self.prefix_nodes = ()            # registry nodes this rid shares
 
 
 class PagedServingEngine:
@@ -290,6 +303,23 @@ class PagedServingEngine:
     interpret mode off-TPU, the CI path — False forces the gather
     form); the resolved bool lands in ``self.decode_kernel`` and the
     ``compiles == {'decode': 1}`` pin holds either way.
+
+    ``prefix_cache=True`` turns on PREFIX SHARING: every admitted
+    prompt's blocks register in a host-side radix tree over
+    block-size token chunks (``paddle_tpu/prefix_cache.py``) and stay
+    PINNED (one refcount) past their donor's retirement; a later
+    prompt with the same leading tokens maps the matched blocks into
+    its slot by refcount increment (``paged_share`` — no prefill over
+    the shared tokens) and runs the model only over the unmatched
+    tail (``paged_chunked_attention``).  Appends into a block other
+    readers still hold copy-on-write first (``paged_cow``), so token
+    streams stay BIT-IDENTICAL to the sharing-off engine — pinned by
+    ``tests/test_prefix_cache.py`` on both decode-attention paths.
+    Admission accounting reserves one extra block per request for the
+    COW copy, and pool pressure evicts LRU sharer-free registry
+    leaves before rejecting.  The decode step gains the (cond-gated)
+    COW transform but still compiles exactly once; with the flag off
+    (default) the traced programs are unchanged.
 
     The engine is deeply instrumented through ``paddle_tpu.telemetry``
     (``metrics=`` takes a :class:`~paddle_tpu.telemetry.MetricsRegistry`;
@@ -319,7 +349,8 @@ class PagedServingEngine:
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
                  metrics=None, tracer=None,
                  flight_recorder: Optional[str] = None,
-                 flight_window_s: float = 30.0, decode_kernel=None):
+                 flight_window_s: float = 30.0, decode_kernel=None,
+                 prefix_cache: bool = False):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -344,11 +375,22 @@ class PagedServingEngine:
             num_heads=cfg.num_heads, head_dim=hd,
             kv_dtype=get_policy().compute_dtype)
         use_kernel = self.decode_kernel
+        sharing = bool(prefix_cache)
+        self.prefix_enabled = sharing
 
         def decode_fn(params, cache, tok, active, temps, done, key):
             # the scope pins decode-attention dispatch at trace time
             with paged.decode_kernel_scope(use_kernel):
                 act = active.astype(jnp.int32)
+                if sharing:
+                    # un-share each appending slot's cursor block
+                    # before the write: a freshly registered/shared
+                    # tail block must not mutate under its other
+                    # readers.  Statically gated — with prefix_cache
+                    # off the traced program is unchanged — and the
+                    # copy itself is cond-gated, so the common
+                    # no-divergence step skips the traffic.
+                    cache, cok = paged.paged_cow(cache, act)
                 cache, ok = paged.paged_reserve(cache, act)
                 views = paged.layer_views(cache, jnp.arange(S), act)
                 (lg, views), _ = model.apply(params, {}, None,
@@ -359,6 +401,8 @@ class PagedServingEngine:
                 pick = _sampling_picker(cfg, temps, jnp.int32, eos_id,
                                         top_k, top_p)
                 nxt, done = pick(lg[:, -1], key, done)
+                if sharing:
+                    ok = ok & cok
                 return cache, nxt, done, ok
 
         def prefill_fn(params, cache, slot, prompt, plen, temp, key):
@@ -383,6 +427,37 @@ class PagedServingEngine:
                                    jnp.zeros((1,), bool))
                 return cache, tok0[0], done0[0], ok
 
+        def prefill_tail_fn(params, cache, slot, tail, tlen, temp, key):
+            # TAIL prefill after a prefix-cache hit: ``paged_share``
+            # already mapped the matched blocks and set the slot's
+            # length to the shared token count, so only the unmatched
+            # ``tlen`` tokens run through the model — each attending
+            # the resident prefix plus the earlier tail tokens via the
+            # chunked view.  COW first: a matched partial block is
+            # shared mid-block and the tail appends into it.
+            with paged.decode_kernel_scope(use_kernel):
+                want = jnp.zeros((S,), jnp.int32).at[slot].set(tlen)
+                cache, cok = paged.paged_cow(cache, want)
+                cache, ok = paged.paged_reserve(cache, want)
+                off = cache.lengths[slot]
+                views = paged.chunked_layer_views(cache, slot[None],
+                                                  tlen[None])
+                w = tail.shape[1]
+                pos_ids = (off + jnp.arange(w))[None, :]
+                (lg, views), _ = model.apply(params, {}, None, tail,
+                                             views, pos_ids)
+                cache = paged.paged_advance(
+                    paged.merge_views(cache, views), want)
+                last = jax.lax.dynamic_index_in_dim(lg[0], tlen - 1,
+                                                    axis=0,
+                                                    keepdims=False)
+                pick = _sampling_picker(cfg,
+                                        jnp.asarray(temp, jnp.float32),
+                                        jnp.int32, eos_id, top_k, top_p)
+                tok0, done0 = pick(last[None], key,
+                                   jnp.zeros((1,), bool))
+                return cache, tok0[0], done0[0], ok & cok
+
         # The cache (pool + block tables) is DEAD the moment each step
         # returns its successor — donate it so XLA updates the pool
         # in place instead of holding two copies of the engine's
@@ -398,9 +473,21 @@ class PagedServingEngine:
         # ROADMAP item this gate de-risks).
         self._decode_slot_args = (2, 3, 4, 5)
         self._free = jax.jit(paged.paged_free, donate_argnums=(0,))
+        watched = dict(decode=self._decode, prefill=self._prefill)
+        if sharing:
+            # prefix-sharing host transforms: share/pin are tiny
+            # refcount/table updates, the tail prefill compiles once
+            # per TAIL pad width used (the decode pin is untouched —
+            # tests key on compile_counts()['decode'])
+            self._prefill_tail = jax.jit(prefill_tail_fn,
+                                         donate_argnums=(1,))
+            self._share = jax.jit(paged.paged_share, donate_argnums=(0,))
+            self._rc_add = jax.jit(paged.paged_rc_add,
+                                   donate_argnums=(0,))
+            watched["prefill_tail"] = self._prefill_tail
+            watched["share"] = self._share
         from paddle_tpu.analysis.watch import CompileWatcher
-        self._compile_watch = CompileWatcher(decode=self._decode,
-                                             prefill=self._prefill)
+        self._compile_watch = CompileWatcher(**watched)
         self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
                                       self.nb, self.bs, cfg.num_heads,
                                       hd, get_policy().compute_dtype)
@@ -414,6 +501,13 @@ class PagedServingEngine:
         self._results = {}
         self._next_rid = 0
         self._reserved = 0                # worst-case blocks, admitted
+        self._pinned = 0                  # registry-pinned pool blocks
+        self._prefix = PrefixCache(self.bs) if sharing else None
+        # tail pad widths: a hit's unmatched tail can be one token
+        # (the full-prompt-hit replay), so the tail buckets extend the
+        # prompt buckets downward; one tail-prefill compile per width
+        # actually used
+        self._tail_buckets = tuple(sorted({1, self.bs, *self.buckets}))
         self.decode_steps = 0
         self.tokens_decoded = 0
         self._run_seconds = 0.0
@@ -484,6 +578,37 @@ class PagedServingEngine:
             "serving_compiles",
             help="compiles since engine construction per jitted fn "
                  "(CompileWatcher), sampled per step; decode must stay 1")
+        if sharing:
+            self._m_prefix_hits = m.counter(
+                "serving_prefix_hits_total",
+                help="admissions that mapped >=1 cached prefix block "
+                     "instead of prefilling it")
+            self._m_prefix_misses = m.counter(
+                "serving_prefix_misses_total",
+                help="admissions with no cached prefix block")
+            self._m_prefix_tokens = m.counter(
+                "serving_prefix_hit_tokens_total",
+                help="prompt tokens served from cached blocks instead "
+                     "of prefill (a full-prompt hit still replays its "
+                     "final token, which is counted as prefilled)")
+            self._m_prefix_hist = m.histogram(
+                "serving_prefix_hit_length_tokens",
+                help="matched prefix length per admission, tokens "
+                     "(misses observe 0)",
+                buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                         128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0))
+            self._m_prefix_pinned = m.gauge(
+                "serving_prefix_pinned_blocks",
+                help="pool blocks pinned by the prefix registry (their "
+                     "refcount survives every slot retiring)")
+            self._m_prefix_shared = m.gauge(
+                "serving_prefix_shared_blocks",
+                help="registered blocks currently mapped by at least "
+                     "one live request (host-side estimate)")
+            self._m_prefix_evict = m.counter(
+                "serving_prefix_evictions_total",
+                help="registered blocks unpinned under pool pressure "
+                     "(LRU sharer-free leaves) or by flush")
 
     # ---------------------------------------------------------- host API
 
@@ -502,9 +627,12 @@ class PagedServingEngine:
                 "submit: prompt %d + max_new %d exceeds per-slot "
                 "capacity %d", n, max_new, self.cap)
         blocks = -(-(n + max_new) // self.bs)
-        enforce(blocks <= self.nb,
+        # with prefix sharing a request's worst case carries one extra
+        # block: the copy-on-write replacement of a shared/pinned block
+        worst = blocks + 1 if self.prefix_enabled else blocks
+        enforce(worst <= self.nb,
                 "submit: request worst case %d blocks exceeds the pool "
-                "(%d) — it could never be admitted", blocks, self.nb)
+                "(%d) — it could never be admitted", worst, self.nb)
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, prompt, max_new, float(temperature), blocks)
@@ -523,7 +651,18 @@ class PagedServingEngine:
     def _admit(self):
         """Prefill queued requests into free slots while the pool's
         worst-case accounting allows — called before every decode step,
-        which is what splices new work in MID-STREAM."""
+        which is what splices new work in MID-STREAM.
+
+        With the prefix cache on, each prompt first matches the radix
+        registry: matched blocks map into the slot by refcount
+        increment (no prefill over the shared tokens, the
+        :meth:`_admit_hit` fast path) and only the unmatched tail runs
+        through the model; after prefill the prompt's blocks register
+        and PIN (:meth:`_register_prefix`) so the next request behind
+        the same prefix hits.  Worst-case accounting adds the pinned
+        blocks plus one COW-slack block per admission, and pool
+        pressure evicts LRU sharer-free registry leaves before
+        rejecting."""
         while self._queue:
             try:
                 slot = self._slots.index(None)
@@ -535,7 +674,30 @@ class PagedServingEngine:
                                         queued=len(self._queue))
                 return                    # all slots busy
             req = self._queue[0]
-            if self._reserved + req.blocks_reserved > self.nb:
+            hit = None
+            need = req.blocks_reserved
+            slack = 0
+            if self._prefix is not None:
+                hit = self._prefix.match(req.prompt)
+                if hit.block_ids:
+                    # matched blocks are resident already: reserve the
+                    # tail plus ONE block of copy-on-write slack
+                    need = need - len(hit.block_ids) + 1
+                # registration may pin this request's own tail block
+                # past its reservation's reach — one more COW-slack
+                # block keeps the ledger an upper bound
+                # (_register_prefix works the transfer rule)
+                slack = 1
+                for nd in hit.nodes:      # protect the match from the
+                    nd.sharers.add(req.rid)   # eviction pass below
+                short = (self._reserved + self._pinned + need + slack
+                         - self.nb)
+                if short > 0:
+                    self._evict_prefix(short)
+            if self._reserved + self._pinned + need + slack > self.nb:
+                if hit is not None:
+                    for nd in hit.nodes:
+                        nd.sharers.discard(req.rid)
                 self._m_rejects.inc(reason="pool")
                 if self.tracer is not None:
                     self.tracer.instant("admission_blocked",
@@ -544,6 +706,7 @@ class PagedServingEngine:
                                         queued=len(self._queue))
                 return                    # pool cannot take it yet
             self._queue.popleft()
+            req.blocks_reserved = need
             t_admit = time.perf_counter()
             self._m_queue_wait.observe(t_admit - req.submitted_at)
             if self.tracer is not None:
@@ -553,17 +716,30 @@ class PagedServingEngine:
                                     ts=t_admit, slot=slot)
                 self.tracer.complete("queue", req.submitted_at, t_admit,
                                      track=f"slot{slot}", rid=req.rid)
-            width = min(w for w in self.buckets
-                        if req.prompt.shape[0] <= w)
-            padded = np.zeros((1, width), np.int32)
-            padded[0, :req.prompt.shape[0]] = req.prompt
-            self.cache, tok0, done0, ok = self._prefill(
-                self.params, self.cache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(padded),
-                jnp.asarray(req.prompt.shape[0], jnp.int32),
-                req.temperature, self._split())
+            if hit is not None and hit.block_ids:
+                tok0, done0, ok, width, ptoks = self._admit_hit(
+                    req, slot, hit)
+            else:
+                width = min(w for w in self.buckets
+                            if req.prompt.shape[0] <= w)
+                padded = np.zeros((1, width), np.int32)
+                padded[0, :req.prompt.shape[0]] = req.prompt
+                self.cache, tok0, done0, ok = self._prefill(
+                    self.params, self.cache,
+                    jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
+                    jnp.asarray(req.prompt.shape[0], jnp.int32),
+                    req.temperature, self._split())
+                ptoks = int(req.prompt.shape[0])
             assert bool(ok), "paged pool exhausted despite admission " \
                              "accounting (engine bug)"
+            if self._prefix is not None:
+                if hit.block_ids:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(req.prefix_hit_tokens)
+                else:
+                    self._m_prefix_misses.inc()
+                self._m_prefix_hist.observe(float(hit.shared_len))
+                self._register_prefix(req, slot, hit)
             self._reserved += req.blocks_reserved
             self._slots[slot] = req
             req.tokens.append(int(tok0))   # host sync: tok0 is REAL now
@@ -575,6 +751,7 @@ class PagedServingEngine:
                                      req.first_token_at,
                                      track=f"slot{slot}", rid=req.rid,
                                      prompt_len=req.prompt.shape[0],
+                                     prefill_tokens=ptoks,
                                      bucket=width)
                 self.tracer.instant("first_token", track=f"slot{slot}",
                                     rid=req.rid,
@@ -586,6 +763,89 @@ class PagedServingEngine:
             if bool(done0) or req.max_new == 1:
                 self._retire(slot,
                              "eos" if bool(done0) else "max_new")
+
+    def _admit_hit(self, req, slot, hit):
+        """Admission fast path for a prefix-cache hit: map the matched
+        blocks into the slot (``paged_share`` — refcount increments, no
+        prefill over the shared tokens) and run the model over the
+        unmatched tail only.  A FULL-prompt hit still replays the final
+        prompt token with the length cursor held one short — the
+        prefill must emit sampling logits — and ``paged_cow`` routes
+        the replayed write into a private block, never under the
+        registered copy's other readers."""
+        n = int(req.prompt.shape[0])
+        new_len = hit.shared_len if hit.shared_len < n else n - 1
+        nmap = len(hit.block_ids)
+        bid = np.zeros((self.maxb,), np.int32)
+        bid[:nmap] = hit.block_ids
+        self.cache = self._share(
+            self.cache, jnp.asarray(slot, jnp.int32), jnp.asarray(bid),
+            jnp.asarray(nmap, jnp.int32),
+            jnp.asarray(new_len, jnp.int32))
+        tlen = n - new_len
+        width = min(w for w in self._tail_buckets if tlen <= w)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :tlen] = req.prompt[new_len:]
+        self.cache, tok0, done0, ok = self._prefill_tail(
+            self.params, self.cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(tlen, jnp.int32),
+            req.temperature, self._split())
+        req.prefix_hit_tokens = new_len
+        if self.tracer is not None:
+            self.tracer.instant("prefix_hit", track=f"slot{slot}",
+                                rid=req.rid, shared_tokens=new_len,
+                                matched_tokens=hit.shared_len,
+                                blocks=nmap, prefill_tokens=tlen)
+        return tok0, done0, ok, width, tlen
+
+    def _register_prefix(self, req, slot, hit):
+        """Register the admitted prompt's blocks in the radix tree and
+        PIN the newly registered ones (+1 refcount each: a cached
+        prefix must survive its donor retiring).  Ledger transfer: a
+        pinned block is carried by ``_pinned`` from here on, so the
+        request's reservation drops by the new pins — plus one block
+        of COW slack when its own tail block got pinned (the next
+        decode append into it must copy out first)."""
+        row = np.asarray(self.cache.block_tables)[slot]
+        new_nodes = self._prefix.insert(req.prompt, row)
+        for nd in new_nodes:
+            nd.sharers.add(req.rid)
+        req.prefix_nodes = tuple(hit.nodes) + tuple(new_nodes)
+        if new_nodes:
+            delta = np.zeros((self.nb,), np.int32)
+            for nd in new_nodes:
+                delta[nd.block_id] += 1
+            self.cache = self._rc_add(self.cache, jnp.asarray(delta))
+            self._pinned += len(new_nodes)
+            tail_new = any(nd.is_tail for nd in new_nodes)
+            req.blocks_reserved += (1 if tail_new else 0) - len(new_nodes)
+
+    def _evict_prefix(self, n_blocks: int) -> int:
+        """Unpin up to ``n_blocks`` LRU sharer-free registry leaves.
+        The pin is the only refcount such a block still holds, so the
+        decrement returns it to the pool immediately."""
+        freed = self._prefix.evict(n_blocks)
+        if freed:
+            delta = np.zeros((self.nb,), np.int32)
+            for b in freed:
+                delta[b] -= 1
+            self.cache = self._rc_add(self.cache, jnp.asarray(delta))
+            self._pinned -= len(freed)
+            self._m_prefix_evict.inc(len(freed))
+            if self.tracer is not None:
+                self.tracer.instant("prefix_evict", track="host",
+                                    blocks=len(freed))
+        return len(freed)
+
+    def flush_prefix_cache(self) -> int:
+        """Evict every evictable registry entry (sharer-free leaves,
+        cascading through emptied parents) and return their blocks to
+        the pool; returns how many blocks were unpinned.  Entries
+        still mapped by live requests survive — flush again after they
+        retire for a full clear."""
+        enforce(self._prefix is not None,
+                "flush_prefix_cache: engine built without prefix_cache")
+        return self._evict_prefix(self.nb)
 
     def _retire(self, slot: int, reason: str = "max_new"):
         req = self._slots[slot]
@@ -607,6 +867,12 @@ class PagedServingEngine:
         self.cache = self._free(
             self.cache, jnp.asarray(np.arange(self.S) == slot))
         self._reserved -= req.blocks_reserved
+        if self._prefix is not None:
+            # the registry pins keep this request's registered blocks
+            # resident; only the live-sharer marks (eviction guards)
+            # release here
+            for nd in req.prefix_nodes:
+                nd.sharers.discard(req.rid)
         self._slots[slot] = None
         self._done[slot] = True
 
@@ -626,6 +892,10 @@ class PagedServingEngine:
         self._m_slots_g.set(len(active))
         for fn, n in self._compile_watch.counts().items():
             self._m_compiles.set(n, fn=fn)
+        if self._prefix is not None:
+            st = self._prefix.stats()
+            self._m_prefix_pinned.set(st["pinned_blocks"])
+            self._m_prefix_shared.set(st["shared_blocks"])
 
     def step(self):
         """One decode step over every active slot, then retire/admit.
@@ -717,6 +987,9 @@ class PagedServingEngine:
             "queue_depth": len(self._queue),
             "queued_rids": [r.rid for r in self._queue],
             "blocks_reserved_worst_case": self._reserved,
+            "prefix_pinned_blocks": self._pinned,
+            "prefix_cache": (None if self._prefix is None
+                             else self._prefix.stats()),
             "pool_blocks": self.nb,
             "block_size": self.bs,
             "num_slots": self.S,
@@ -759,6 +1032,7 @@ class PagedServingEngine:
         return {"pool_blocks": self.nb,
                 "blocks_in_use": self.nb - free,
                 "blocks_reserved_worst_case": self._reserved,
+                "blocks_pinned_prefix": self._pinned,
                 "fraction_in_use": (self.nb - free) / self.nb}
 
     def hbm_report(self):
@@ -779,6 +1053,12 @@ class PagedServingEngine:
             "dense_bytes_per_request": dense_hbm_bytes(
                 self.cfg.max_len, **kw),
             "pool_bytes_total": self.nb * self.bs * 2
+            * self.cfg.num_layers * self.cfg.num_heads * hd
+            * dtype_bytes,
+            # blocks the prefix registry holds resident past their
+            # donors (the HBM rent prefix sharing pays for its hits)
+            "prefix_pinned_blocks": self._pinned,
+            "prefix_pinned_bytes": self._pinned * self.bs * 2
             * self.cfg.num_layers * self.cfg.num_heads * hd
             * dtype_bytes,
         }

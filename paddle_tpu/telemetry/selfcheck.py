@@ -1,6 +1,6 @@
 """The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
 
-Five checks, each a hard failure (non-zero exit) when violated:
+Seven checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
    (fresh registry, request-level tracer ON, ``decode_kernel=True`` so
@@ -25,16 +25,25 @@ Five checks, each a hard failure (non-zero exit) when violated:
    stays under a generous ceiling; a regression that makes telemetry
    expensive enough to matter fails here rather than silently taxing
    the serving loop.
-5. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
+5. **Shared-prefix smoke** — the same tiny engine with
+   ``prefix_cache=True`` serves two prompts behind one common prefix:
+   the second request must HIT the radix registry (nonzero
+   ``serving_prefix_hits_total`` and hit-token counter), the
+   ``compiles == {'decode': 1}`` contract must hold with sharing on
+   (copy-on-write rides the same traced decode step), and
+   ``hbm_report()`` must reconcile — pinned prefix blocks are the only
+   pool residue after the run and a flush returns the pool to empty.
+6. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
    real batch + scan steps with the monitor at cadence: the snapshot
    must validate and carry populated ``train_health_*`` families,
    ``compiles`` must stay ``{step: 1, scan: 1}`` WITH health enabled
    (the packed statistics vector may not perturb tracing or donation),
    and the per-step host cost of ``HealthMonitor.observe`` amortized
    over the default cadence stays under the same observation ceiling.
-6. **Lint re-check** — the instrumented entrypoints (engine decode,
-   paged serve step, trainer step, health-instrumented trainer step)
-   re-trace through tpu-lint with ZERO error-severity findings:
+7. **Lint re-check** — the instrumented entrypoints (engine decode,
+   its prefix-sharing twin, paged serve step, trainer step,
+   health-instrumented trainer step) re-trace through tpu-lint with
+   ZERO error-severity findings:
    ``host-callback-in-loop`` is the rule that would fire if any metric
    update — or health statistic — leaked inside a jitted program as a
    callback instead of an in-graph reduction.
@@ -76,6 +85,7 @@ REQUIRED_SERVING_METRICS = (
 INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode",
     "paged-engine-decode-kernel",
+    "paged-engine-decode-prefix",
     "paged-serve-step",
     "trainer-train-step",
     "trainer-train-step-health",
@@ -242,6 +252,73 @@ def _check_overhead():
     return per_op
 
 
+def _check_prefix_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+    reg = MetricsRegistry("selfcheck-prefix")
+    eng = PagedServingEngine(cfg, params, num_slots=2, num_blocks=12,
+                             block_size=4, prompt_buckets=(8,),
+                             metrics=reg, prefix_cache=True)
+    common = np.arange(1, 7, dtype=np.int32)       # 6 shared tokens
+    eng.submit(np.concatenate([common, [9]]), max_new=4)
+    eng.submit(np.concatenate([common, [11]]), max_new=4)
+    results = eng.run()
+    if len(results) != 2:
+        _fail(f"prefix smoke returned {len(results)} streams, wanted 2")
+
+    compiles = eng.compile_counts()
+    if compiles.get("decode") != 1:
+        _fail("the compiles == {'decode': 1} contract broke WITH "
+              f"prefix sharing on: {compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    for name in ("serving_prefix_hits_total",
+                 "serving_prefix_hit_tokens_total"):
+        if name not in metrics:
+            _fail(f"snapshot missing {name} with prefix sharing on")
+        total = sum(s["value"] for s in metrics[name]["series"])
+        if total <= 0:
+            _fail(f"{name} is {total} after a shared-prefix run — the "
+                  "second request did not hit the radix registry")
+
+    # pool reconciliation: after the run only the REGISTERED prefix
+    # blocks remain resident, hbm_report agrees, and a flush empties it
+    occ = eng.occupancy()
+    report = eng.hbm_report()
+    pinned = eng.host_state()["prefix_cache"]["pinned_blocks"]
+    if occ["blocks_in_use"] != pinned or \
+            report["prefix_pinned_blocks"] != pinned:
+        _fail(f"pool residue disagrees: in_use {occ['blocks_in_use']}, "
+              f"hbm_report {report['prefix_pinned_blocks']}, registry "
+              f"{pinned}")
+    if report["prefix_pinned_bytes"] <= 0:
+        _fail("hbm_report prefix_pinned_bytes not positive with blocks "
+              "pinned")
+    eng.flush_prefix_cache()
+    if eng.occupancy()["blocks_in_use"] != 0:
+        _fail(f"flush left blocks resident: {eng.occupancy()}")
+    hits = sum(s["value"] for s in
+               metrics["serving_prefix_hits_total"]["series"])
+    toks = sum(s["value"] for s in
+               metrics["serving_prefix_hit_tokens_total"]["series"])
+    return int(hits), int(toks)
+
+
 def _check_health():
     import jax.numpy as jnp
     import numpy as np
@@ -332,6 +409,10 @@ def main(argv=None) -> int:
     per_op = _check_overhead()
     print(f"selfcheck: overhead ok ({per_op * 1e6:.2f}us/observation, "
           f"bound {MAX_SECONDS_PER_OBSERVATION * 1e6:.0f}us)")
+    p_hits, p_toks = _check_prefix_smoke()
+    print(f"selfcheck: shared-prefix smoke ok ({p_hits} hit(s), "
+          f"{p_toks} shared tokens, compiles==1 with sharing on, "
+          "pool reconciles + flush empties)")
     hsnap, h_per_step = _check_health()
     print("selfcheck: training health smoke ok "
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "

@@ -14,6 +14,7 @@ chosen by the model ("logits", "label", "weight", ...).
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,11 +65,66 @@ class Evaluator:
                     float(v) if np.ndim(v) == 0 else np.asarray(v))
 
 
+#: Collective-call counter for the coordination-service fallback below.
+#: allgather_sum_f64 is collective (every process calls it the same
+#: number of times in the same order), so the counter advances in
+#: lockstep and gives each exchange a distinct key namespace.
+_KV_ROUND = itertools.count()
+
+
+def _kv_allgather_u32(wire):
+    """All-gather over the distributed COORDINATION SERVICE's key-value
+    store, for backends with no cross-process collective runtime: the
+    CPU backend raises "Multiprocess computations aren't implemented on
+    the CPU backend" from ``multihost_utils.process_allgather``, but the
+    coordinator (which ``distributed.initialize`` always brings up) can
+    still move bytes.  Evaluator partials are a handful of scalars and
+    small histograms once per eval pass, so a KV round-trip is plenty.
+
+    Each process publishes its leaves as one base64 blob keyed by
+    (round, rank) and blocking-reads every peer's blob; leaf shapes are
+    identical across processes (same STATS pytree), so the local byte
+    layout slices every peer blob too."""
+    import base64
+
+    import jax
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    enforce(client is not None,
+            "evaluator all-gather fallback needs the distributed "
+            "coordination service — call distributed.initialize() (or "
+            "paddle_tpu.distributed.runtime.initialize()) first")
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    rid = next(_KV_ROUND)
+    blob = b"".join(np.ascontiguousarray(w).tobytes() for w in wire)
+    client.key_value_set(f"paddle_tpu/evalgather/{rid}/{rank}",
+                         base64.b64encode(blob).decode("ascii"))
+    blobs = []
+    for p in range(nproc):
+        if p == rank:
+            blobs.append(blob)
+        else:
+            s = client.blocking_key_value_get(
+                f"paddle_tpu/evalgather/{rid}/{p}", 120_000)
+            blobs.append(base64.b64decode(s))
+    out = []
+    off = 0
+    for w in wire:
+        nb = w.nbytes
+        out.append(np.stack([np.frombuffer(b[off:off + nb], np.uint32)
+                             for b in blobs]))
+        off += nb
+    return out
+
+
 def allgather_sum_f64(tree):
     """Sum a pytree of float64 arrays across all JAX processes without
     precision loss: x32-mode JAX downcasts float64 transfers to float32,
     so values travel as uint32 bit-pattern views and are reassembled
-    before the float64 sum."""
+    before the float64 sum.  On the CPU backend (no collective runtime)
+    the transfer rides the coordination-service KV store instead."""
     import jax
     from jax.experimental import multihost_utils
 
@@ -76,7 +132,10 @@ def allgather_sum_f64(tree):
     wire = [np.ascontiguousarray(
         np.atleast_1d(np.asarray(leaf, np.float64))).view(np.uint32)
         for leaf in leaves]
-    gathered = multihost_utils.process_allgather(wire)
+    if jax.process_count() > 1 and jax.default_backend() == "cpu":
+        gathered = _kv_allgather_u32(wire)
+    else:
+        gathered = multihost_utils.process_allgather(wire)
     out = []
     for leaf, g in zip(leaves, gathered):
         f = np.ascontiguousarray(np.asarray(g, np.uint32)).view(np.float64)

@@ -1,0 +1,381 @@
+"""Prefix caching + copy-on-write block sharing (``prefix_cache.py``,
+``ops/paged_attention.py`` refcounts, ``serving.py`` engine wiring).
+
+The load-bearing pins:
+
+* TOKEN IDENTITY: a shared-prefix batch served with ``prefix_cache=
+  True`` is bit-identical to the same batch with sharing disabled —
+  greedy and sampled, on the XLA gather decode path AND the Pallas
+  kernel (interpret mode) path.  Prefix reuse must be invisible in the
+  output stream.
+* REFCOUNTS NEVER LEAK: at every host-visible point, each block's
+  device refcount equals (# slot block-table rows mapping it) + (1 if
+  the prefix registry pins it) — randomized admit/share/COW/retire
+  sequences included — and a drained engine's pool holds exactly the
+  pinned blocks (zero after ``flush_prefix_cache()``).
+* COW: an append into a shared (rc > 1) block lands in a private copy
+  — the registered block's bytes do not change — and a no-divergence
+  step leaves the cache untouched.
+* The serving contracts survive sharing: ``compiles == {'decode': 1}``
+  and hit admissions prefill ONLY the unmatched tail (trace event +
+  counters prove it).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.prefix_cache import PrefixCache
+from paddle_tpu.serving import PagedServingEngine
+from paddle_tpu import telemetry
+import paddle_tpu.nn as nn
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _engine(params, *, sharing, num_blocks=24, num_slots=2, seed=0,
+            decode_kernel=None, metrics=None, tracer=None, eos_id=None):
+    return PagedServingEngine(
+        CFG, params, num_slots=num_slots, num_blocks=num_blocks,
+        block_size=4, prompt_buckets=(16,), prefix_cache=sharing,
+        seed=seed, decode_kernel=decode_kernel, eos_id=eos_id,
+        metrics=metrics if metrics is not None
+        else telemetry.MetricsRegistry(), tracer=tracer)
+
+
+PREFIX = (np.arange(1, 11) % 50 + 1).astype(np.int32)   # 10 tokens
+PROMPTS = [np.concatenate([PREFIX, [17, 23, 5]]).astype(np.int32),
+           np.concatenate([PREFIX, [17, 29]]).astype(np.int32),
+           np.concatenate([PREFIX, [40]]).astype(np.int32),
+           PREFIX.copy()]
+
+
+# ------------------------------------------------------- radix registry
+
+
+def test_radix_match_walks_chunks_then_longest_tail():
+    pc = PrefixCache(block_size=4)
+    toks = list(range(10))                       # 2 chunks + tail [8,9]
+    new = pc.insert(toks, [5, 6, 7])
+    assert [nd.block_id for nd in new] == [5, 6, 7]
+    assert new[-1].is_tail and new[-1].n_tokens == 2
+    hit = pc.match(list(range(10)) + [99])
+    assert hit.shared_len == 10
+    assert hit.block_ids == [5, 6, 7]
+    # a shorter tail prefix of the registered tail does NOT match (the
+    # registered block holds 2 tokens; the query offers only [8])
+    hit = pc.match(list(range(9)))
+    assert hit.shared_len == 8 and hit.block_ids == [5, 6]
+    # diverging first chunk: clean miss
+    assert pc.match([99] * 8).shared_len == 0
+
+
+def test_radix_longest_of_several_tails_wins():
+    pc = PrefixCache(block_size=4)
+    pc.insert([0, 1, 2, 3, 7], [1, 2])           # tail [7]
+    pc.insert([0, 1, 2, 3, 7, 8], [1, 3])        # tail [7, 8]
+    hit = pc.match([0, 1, 2, 3, 7, 8, 9])
+    assert hit.shared_len == 6 and hit.block_ids == [1, 3]
+
+
+def test_radix_insert_is_idempotent_and_eviction_is_lru_leaf_first():
+    pc = PrefixCache(block_size=4)
+    pc.insert(list(range(8)), [1, 2])            # chunks A -> B
+    assert pc.insert(list(range(8)), [9, 9]) == []   # no duplicates
+    pc.insert(list(range(4)) + [70, 71, 72, 73], [1, 3])   # A -> C
+    pc.match(list(range(8)))                     # touch B: C is LRU
+    freed = pc.evict(1)
+    assert freed == [3], "LRU leaf (untouched branch) evicts first"
+    # interior node A (block 1) is not evictable while B hangs off it;
+    # cascading evict drains leaf-first
+    assert pc.evict(10) == [2, 1]
+    assert pc.blocks == 0
+
+
+def test_radix_sharer_guard_blocks_eviction():
+    pc = PrefixCache(block_size=4)
+    (node,) = pc.insert(list(range(4)), [4])
+    node.sharers.add(0)
+    assert pc.evict(10) == []
+    node.sharers.discard(0)
+    assert pc.evict(10) == [4]
+
+
+# ------------------------------------------------- pool-op unit tests
+
+
+def _tiny_cache():
+    return paged.paged_init(num_layers=1, num_slots=2,
+                            max_blocks_per_slot=4, num_blocks=6,
+                            block_size=4, num_heads=2, head_dim=4)
+
+
+def test_paged_share_increments_refcounts_and_maps_row():
+    cache = _tiny_cache()
+    cache, ok = paged.paged_reserve(cache, jnp.array([5, 0]))
+    assert bool(ok)
+    cache = paged.paged_advance(cache, jnp.array([5, 0]))
+    donor = np.asarray(cache.block_tables)[0, :2]
+    bid = np.zeros((4,), np.int32)
+    bid[:2] = donor
+    cache = jax.jit(paged.paged_share)(cache, jnp.asarray(1), bid,
+                                       jnp.asarray(2), jnp.asarray(5))
+    rc = np.asarray(cache.refcounts)
+    assert (rc[donor] == 2).all(), "shared blocks gain an owner"
+    row = np.asarray(cache.block_tables)[1]
+    assert (row[:2] == donor).all() and (row[2:] == -1).all()
+    assert int(cache.lengths[1]) == 5 and int(cache.blocks_used[1]) == 2
+    # freeing the donor leaves the shared blocks resident (rc 1)
+    cache = paged.paged_free(cache, jnp.array([True, False]))
+    rc = np.asarray(cache.refcounts)
+    assert (rc[donor] == 1).all()
+    assert int(cache.free.sum()) == 4
+
+
+def test_paged_cow_copies_shared_cursor_block():
+    cache = _tiny_cache()
+    cache, _ = paged.paged_reserve(cache, jnp.array([5, 0]))
+    # make block contents recognizable
+    k0 = cache.k_pages[0].at[:, :, :, :].set(
+        jnp.arange(6, dtype=jnp.float32)[:, None, None, None])
+    cache = cache._replace(k_pages=(k0,), v_pages=(k0,))
+    cache = paged.paged_advance(cache, jnp.array([5, 0]))
+    donor = np.asarray(cache.block_tables)[0, :2]
+    bid = np.zeros((4,), np.int32)
+    bid[:2] = donor
+    cache = paged.paged_share(cache, jnp.asarray(1), bid,
+                              jnp.asarray(2), jnp.asarray(5))
+    # slot 1 appends its 6th token -> cursor block = donor[1], rc 2
+    cache2, ok = jax.jit(paged.paged_cow)(cache, jnp.array([0, 1]))
+    assert bool(ok)
+    rc = np.asarray(cache2.refcounts)
+    row1 = np.asarray(cache2.block_tables)[1]
+    assert row1[1] != donor[1], "cursor block must remap to a copy"
+    assert rc[donor[1]] == 1 and rc[row1[1]] == 1
+    assert (np.asarray(cache2.block_tables)[0, :2] == donor).all()
+    np.testing.assert_array_equal(
+        np.asarray(cache2.k_pages[0][row1[1]]),
+        np.asarray(cache2.k_pages[0][donor[1]]),
+        "the copy must carry the shared block's bytes")
+    # no divergence (exclusive blocks) -> cache unchanged
+    cache3, ok = paged.paged_cow(cache2, jnp.array([1, 1]))
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(cache3.block_tables),
+                                  np.asarray(cache2.block_tables))
+    np.testing.assert_array_equal(np.asarray(cache3.refcounts),
+                                  np.asarray(cache2.refcounts))
+
+
+def test_paged_cow_block_boundary_and_unmapped_are_untouched():
+    cache = _tiny_cache()
+    cache, _ = paged.paged_reserve(cache, jnp.array([4, 0]))
+    cache = paged.paged_advance(cache, jnp.array([4, 0]))
+    # slot 0 sits ON a block boundary (4 tokens, cursor = next block,
+    # unmapped); slot 1 is empty — neither diverges even under want>0
+    before = np.asarray(cache.block_tables)
+    cache2, ok = paged.paged_cow(cache, jnp.array([1, 1]))
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(cache2.block_tables), before)
+
+
+# ------------------------------------------------- token identity pins
+
+
+def _serve(params, *, sharing, decode_kernel=None, temperature=0.0,
+           seed=0, eos_id=None, num_blocks=24):
+    eng = _engine(params, sharing=sharing, decode_kernel=decode_kernel,
+                  seed=seed, eos_id=eos_id, num_blocks=num_blocks)
+    rids = [eng.submit(p, max_new=6, temperature=temperature)
+            for p in PROMPTS]
+    out = eng.run()
+    return eng, [out[r] for r in rids]
+
+
+def test_token_identity_xla_greedy(params):
+    eng0, t0 = _serve(params, sharing=False)
+    eng1, t1 = _serve(params, sharing=True)
+    for a, b in zip(t0, t1):
+        np.testing.assert_array_equal(a, b)
+    assert eng1.compile_counts()["decode"] == 1
+    assert eng1._prefix.stats()["hits"] >= 2
+
+
+def test_token_identity_xla_sampled(params):
+    # same engine seed => same rng split sequence => identical streams.
+    # The pool is sized so admission timing cannot differ between the
+    # engines (pinned blocks delaying an admit would reorder splits).
+    eng0, t0 = _serve(params, sharing=False, temperature=0.9, seed=3,
+                      eos_id=3, num_blocks=64)
+    eng1, t1 = _serve(params, sharing=True, temperature=0.9, seed=3,
+                      eos_id=3, num_blocks=64)
+    for a, b in zip(t0, t1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_token_identity_kernel_interpret(params):
+    eng0, t0 = _serve(params, sharing=False, decode_kernel=True)
+    eng1, t1 = _serve(params, sharing=True, decode_kernel=True)
+    assert eng1.decode_kernel, "interpret-mode kernel must resolve on"
+    for a, b in zip(t0, t1):
+        np.testing.assert_array_equal(a, b)
+    assert eng1.compile_counts()["decode"] == 1
+
+
+def test_full_prompt_hit_replays_one_token(params):
+    eng = _engine(params, sharing=True,
+                  tracer=telemetry.Tracer(name="t"))
+    r0 = eng.submit(PREFIX, max_new=4)
+    eng.run()
+    r1 = eng.submit(PREFIX, max_new=4)
+    out = eng.run()
+    solo = _engine(params, sharing=False)
+    r2 = solo.submit(PREFIX, max_new=4)
+    ref = solo.run()[r2]
+    np.testing.assert_array_equal(out[r1], ref)
+    hits = [e for e in eng.tracer.events() if e["name"] == "prefix_hit"]
+    assert hits and hits[-1]["args"]["prefill_tokens"] == 1, (
+        "a full-prompt hit must replay exactly the final token")
+    prefills = [e for e in eng.tracer.events() if e["name"] == "prefill"]
+    assert prefills[-1]["args"]["prefill_tokens"] == 1
+    assert prefills[0]["args"]["prefill_tokens"] == len(PREFIX)
+
+
+# --------------------------------------------- refcount-leak invariant
+
+
+def _registry_pins(eng):
+    """Walk the radix tree: block id -> pin count (always 1/node)."""
+    pins = {}
+    stack = [eng._prefix._root]
+    while stack:
+        node = stack.pop()
+        for nd in list(node.children.values()) + list(node.tails.values()):
+            pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
+        stack.extend(node.children.values())
+    return pins
+
+
+def _assert_refcounts_exact(eng):
+    """Device refcounts == slot mappings + registry pins, everywhere."""
+    tables = np.asarray(eng.cache.block_tables)
+    used = np.asarray(eng.cache.blocks_used)
+    rc = np.asarray(eng.cache.refcounts)
+    expect = np.zeros_like(rc)
+    for s in range(eng.S):
+        for b in tables[s, :used[s]]:
+            assert b >= 0, "mapped prefix of a row must be physical"
+            expect[b] += 1
+    for b, n in _registry_pins(eng).items():
+        expect[b] += n
+    np.testing.assert_array_equal(rc, expect)
+    assert sum(_registry_pins(eng).values()) == eng._pinned
+    assert eng._reserved + eng._pinned <= eng.nb, (
+        "ledger must stay within the pool")
+
+
+def test_refcounts_never_leak_randomized(params):
+    rng = np.random.default_rng(0)
+    eng = _engine(params, sharing=True, num_blocks=20, num_slots=2)
+    prefixes = [PREFIX, (PREFIX + 7) % 50 + 1]
+    pending = 0
+    for step in range(60):
+        roll = rng.random()
+        if roll < 0.35 and pending < 6:
+            base = prefixes[int(rng.integers(len(prefixes)))]
+            tail = rng.integers(0, CFG.vocab_size,
+                                size=int(rng.integers(0, 4)))
+            prompt = np.concatenate([base, tail]).astype(np.int32)
+            eng.submit(prompt, max_new=int(rng.integers(1, 6)))
+            pending += 1
+        elif roll < 0.45 and eng._prefix.blocks:
+            eng.flush_prefix_cache()
+        else:
+            progressed = eng.step()
+            if not progressed and not eng._queue:
+                pending = 0
+        _assert_refcounts_exact(eng)
+    eng.run()
+    _assert_refcounts_exact(eng)
+    occ = eng.occupancy()
+    assert occ["blocks_in_use"] == eng._pinned, (
+        "a drained engine's pool holds exactly the pinned blocks")
+    eng.flush_prefix_cache()
+    assert eng.occupancy()["blocks_in_use"] == 0
+    assert eng._pinned == 0 and eng._prefix.blocks == 0
+
+
+def test_eviction_relieves_pool_pressure(params):
+    # pool sized so the registry must give blocks back: two disjoint
+    # prompts of 10 tokens pin 3 blocks each (bs=4); a pool of 8 cannot
+    # hold 6 pinned + a third request's worst case without evicting
+    eng = _engine(params, sharing=True, num_blocks=8, num_slots=1)
+    p1 = PREFIX
+    p2 = ((PREFIX + 13) % 50 + 1).astype(np.int32)
+    eng.submit(p1, max_new=2)
+    eng.run()
+    eng.submit(p2, max_new=2)
+    eng.run()
+    assert eng._pinned > 0
+    before = eng._prefix.evictions
+    p3 = ((PREFIX + 29) % 50 + 1).astype(np.int32)
+    eng.submit(p3, max_new=6)
+    out = eng.run()
+    assert len(out) == 1
+    assert eng._prefix.evictions > before, (
+        "pool pressure must evict sharer-free registry leaves")
+    _assert_refcounts_exact(eng)
+
+
+# ----------------------------------------------------- serving surface
+
+
+def test_prefix_metrics_and_trace(params):
+    reg = telemetry.MetricsRegistry()
+    tracer = telemetry.Tracer(name="t")
+    eng = _engine(params, sharing=True, metrics=reg, tracer=tracer)
+    rids = [eng.submit(p, max_new=4) for p in PROMPTS]
+    eng.run()
+    snap = reg.snapshot()["metrics"]
+    hits = snap["serving_prefix_hits_total"]["series"][0]["value"]
+    toks = snap["serving_prefix_hit_tokens_total"]["series"][0]["value"]
+    assert hits >= 2 and toks >= 16
+    assert snap["serving_prefix_misses_total"]["series"][0]["value"] >= 1
+    assert "serving_prefix_hit_length_tokens" in snap
+    assert snap["serving_prefix_pinned_blocks"]["series"][0]["value"] > 0
+    ev = [e for e in tracer.events() if e["name"] == "prefix_hit"]
+    assert len(ev) == int(hits)
+    for e in ev:
+        assert e["args"]["prefill_tokens"] < len(PREFIX), (
+            "hits must prefill only the unmatched tail")
+    # hit admissions' prefill event records the TAIL length
+    pf = {e["rid"]: e["args"]["prefill_tokens"]
+          for e in tracer.events() if e["name"] == "prefill"}
+    assert pf[rids[0]] == len(PROMPTS[0])        # miss: full prompt
+    assert pf[rids[1]] < len(PROMPTS[1])         # hit: tail only
+
+
+def test_submit_worst_case_includes_cow_slack(params):
+    from paddle_tpu.core.errors import EnforceError
+    eng = _engine(params, sharing=True, num_blocks=5, num_slots=1)
+    # 16 tokens + 4 new = 5 blocks + 1 COW slack > pool of 5
+    with pytest.raises(EnforceError):
+        eng.submit(np.arange(16, dtype=np.int32) % 50, max_new=4)
+
+
+def test_prefix_disabled_engine_unchanged(params):
+    eng = _engine(params, sharing=False)
+    assert eng._prefix is None and not eng.prefix_enabled
+    assert set(eng.compile_counts()) == {"decode", "prefill"}
+    with pytest.raises(Exception):
+        eng.flush_prefix_cache()
