@@ -115,6 +115,96 @@ def _bench_shared_prefix(args, cfg, params, jax):
         tokens_per_s=round(gen / wall, 1))
 
 
+def _bench_frontend(args, cfg, params, jax):
+    """``--frontend --engines N``: SLO front-end serving benchmark.
+
+    Drives a burst of requests through :class:`ServingFrontend` — N
+    supervised paged engines behind one admission queue — and reports
+    the two SLO numbers next to the throughput: ``shed_rate`` (the
+    fraction of OFFERED load dropped, submit-time rejects + queued
+    sheds) and ``deadline_miss_rate`` (late completions / completions).
+    ``--deadline-ms`` attaches a completion deadline to every request
+    so both admission (deadline_unmeetable) and queued-expiry shedding
+    are exercised; ``--max-queue`` bounds the submit queue so overload
+    sheds instead of queuing without bound.  Warm-up runs one request
+    per engine first, so the measured burst is compile-free."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.frontend import ServingFrontend, SubmitRejected
+
+    plen, steps, bs = args.prompt, args.steps, args.block_size
+    slots = min(args.batch, 8)
+    per_req = -(-(plen + steps) // bs)
+    pool = args.pool_blocks or slots * per_req + 4
+    rs = np.random.RandomState(1)
+    fe = ServingFrontend(
+        cfg, params, num_engines=args.engines, num_slots=slots,
+        num_blocks=pool, block_size=bs, prompt_buckets=(plen,),
+        decode_kernel={"auto": None, "on": True,
+                       "off": False}[args.paged_kernel],
+        max_queue=args.max_queue or None, seed=0)
+    try:
+        # warm-up: one tiny request per engine compiles prefill+decode
+        # on every seat AND primes the queue-wait/TTFT telemetry the
+        # admission predictor reads (a cold frontend admits everything)
+        for _ in range(args.engines):
+            fe.submit(rs.randint(0, args.vocab, plen).astype(np.int32),
+                      max_new=2)
+        fe.run(timeout_s=600.0)
+
+        reqs = args.frontend_requests or 4 * slots * args.engines
+        deadline = (args.deadline_ms / 1e3) if args.deadline_ms else None
+        rids, rejects = [], {"queue_full": 0, "deadline_unmeetable": 0,
+                             "too_large": 0}
+        t0 = time.perf_counter()
+        for i in range(reqs):
+            try:
+                rids.append(fe.submit(
+                    rs.randint(0, args.vocab, plen).astype(np.int32),
+                    max_new=steps, priority=1 + (i % 3),
+                    deadline_s=deadline))
+            except SubmitRejected as exc:
+                rejects[exc.reason] += 1
+        out = fe.run(timeout_s=600.0)
+        wall = time.perf_counter() - t0
+
+        burst = [out[r] for r in rids]
+        done = [r for r in burst if r["status"] == "completed"]
+        shed = sum(1 for r in burst if r["status"] == "shed")
+        missed = sum(1 for r in done if r["deadline_missed"])
+        rejected = sum(rejects.values())
+        gen = sum(len(r["tokens"]) for r in done)
+        stats = fe.stats()
+        compiles = fe.compile_counts()
+    finally:
+        fe.close()
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} prompt{plen} "
+               f"frontend x{args.engines}",
+        value=round(gen / wall, 1),
+        unit="tokens/s",
+        backend=jax.default_backend(),
+        decoder="frontend",
+        compiles=compiles,             # {'decode': 1} per live engine
+        engines=args.engines,
+        num_slots=slots,
+        block_size=bs,
+        pool_blocks=pool,
+        requests=reqs,
+        completed=len(done),
+        deadline_ms=args.deadline_ms or None,
+        max_queue=args.max_queue or None,
+        # offered-load shed fraction: submit-time rejects (never
+        # journaled) AND queued requests shed later, over the burst
+        shed_rate=round((rejected + shed) / reqs, 4) if reqs else 0.0,
+        submit_rejects=rejects,
+        shed=shed,
+        deadline_miss_rate=round(missed / len(done), 4) if done else 0.0,
+        deadline_misses=missed,
+        retries=stats["retries"],
+        engine_restarts=stats["engine_restarts"],
+        tokens_per_s=round(gen / wall, 1))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=1024)
@@ -163,6 +253,29 @@ def main():
                          "the row reports miss vs hit TTFT/prefill "
                          "spans and prefix_hit_tokens instead of the "
                          "differential step time; requires --paged")
+    ap.add_argument("--frontend", action="store_true",
+                    help="serve the burst through the SLO-aware "
+                         "ServingFrontend (frontend.py): --engines "
+                         "supervised paged engines behind one admission "
+                         "queue — the row reports shed_rate and "
+                         "deadline_miss_rate next to tokens/s; "
+                         "requires --paged")
+    ap.add_argument("--engines", type=int, default=1, metavar="N",
+                    help="number of supervised engines behind the "
+                         "frontend (with --frontend)")
+    ap.add_argument("--frontend-requests", type=int, default=0,
+                    metavar="N",
+                    help="burst size for --frontend (0 = 4 * slots * "
+                         "engines)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="with --frontend: completion deadline attached "
+                         "to every request in ms (0 = none) — exercises "
+                         "admission-time deadline_unmeetable rejects and "
+                         "queued-expiry shedding")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="with --frontend: submit-queue bound (0 = "
+                         "unbounded) — overload sheds lowest-priority "
+                         "first instead of queuing without bound")
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="append a telemetry snapshot record (the row as "
                          "meta + the process registry, raw differential "
@@ -182,6 +295,14 @@ def main():
     if args.shared_prefix and not args.paged:
         ap.error("--shared-prefix requires --paged (the prefix cache "
                  "lives in the paged serving engine)")
+    if args.frontend and not args.paged:
+        ap.error("--frontend requires --paged (the frontend supervises "
+                 "paged serving engines)")
+    if args.frontend and args.shared_prefix:
+        ap.error("--frontend and --shared-prefix are separate rows; "
+                 "pick one")
+    if args.engines < 1:
+        ap.error("--engines must be >= 1")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.attach import attach_probe_with_retry
@@ -233,6 +354,15 @@ def main():
         if args.bf16_params:
             from paddle_tpu.inference import serving_cast
             params = serving_cast(params)
+        if args.frontend:
+            row = _bench_frontend(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
         if args.shared_prefix:
             row = _bench_shared_prefix(args, cfg, params, jax)
             from paddle_tpu import telemetry

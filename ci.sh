@@ -54,7 +54,7 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \
     --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
-echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead + re-lint =="
+echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead + chaos + re-lint =="
 # Drives a real instrumented paged-serving run with the request-level
 # tracer ON and the Pallas decode kernel SELECTED (interpret mode on
 # CPU; compiles must stay {'decode': 1} WITH telemetry AND tracing AND
@@ -66,9 +66,15 @@ echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead
 # training-health smoke (Trainer(health=...) batch + scan at cadence:
 # schema-valid train_health_* snapshot, compiles=={step:1, scan:1}
 # with the in-graph statistics vector on, per-step host cost bounded
-# at the default cadence), and re-lints the instrumented entrypoints
-# incl. the health-instrumented train step — host-callback-in-loop
-# must report zero findings.
+# at the default cadence), runs the chaos smoke (the serving frontend
+# under a deterministic fault schedule — crash mid-decode, hung step,
+# failed engine construction, overload: exactly-once terminal status,
+# retried greedy streams bit-identical to the fault-free run,
+# compiles=={'decode':1} per engine, and the fault-free single-engine
+# fast path byte-for-byte the direct engine), and re-lints the
+# instrumented entrypoints incl. the health-instrumented train step
+# and the fault-injection engine twin — host-callback-in-loop must
+# report zero findings.
 JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
 
 echo "== native libs =="

@@ -238,6 +238,34 @@ def _paged_engine_decode_prefix() -> LintTarget:
                           "reserve/append do"))
 
 
+@register_entrypoint("paged-engine-decode-faults")
+def _paged_engine_decode_faults() -> LintTarget:
+    # The fault-injection twin: an engine with an armed FaultInjector
+    # fires its points strictly in the HOST loop, so the traced decode
+    # program must be byte-for-byte the plain engine's — same rules,
+    # same budget, zero new suppressions.  Linting it pins the chaos
+    # harness to the host side (an injection point inside the jitted
+    # step would be the host-callback-in-loop error).
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.testing.faults import FaultInjector
+    inj = FaultInjector()                 # empty schedule: count only
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,),
+                             faults=inj.scope("lint"))
+    S = eng.S
+    return LintTarget(
+        "paged-engine-decode-faults", eng._decode,
+        (eng.params, eng.cache, jnp.zeros((S,), jnp.int32),
+         jnp.ones((S,), bool), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, eng._decode_slot_args,
+                          "dp over slot vectors, exactly as "
+                          "paged-engine-decode: the injector lives in "
+                          "the host loop and contributes nothing to "
+                          "the traced program"))
+
+
 # Kernel-selected twins: the same serve programs with decode_kernel
 # FORCED on (Pallas interpret mode on the CPU lint backend — the
 # traced jaxpr carries the pallas_call eqn either way, which is what

@@ -1,0 +1,952 @@
+"""SLO-aware serving front-end: deadlines, shedding, engine supervision.
+
+The reference Paddle snapshot's cloud runtime (go/master + go/pserver
+over etcd) is organized around one idea: WORK OUTLIVES WORKERS.  The
+master journals task leases; a dead trainer's pending tasks go back on
+the todo queue and are retried with backoff; the service degrades under
+load instead of falling over.  :class:`ServingFrontend` is that idea
+applied to our serving stack — it turns "a
+:class:`~paddle_tpu.serving.PagedServingEngine`" into "a service":
+
+* **Deadlines + priorities.**  Every request carries an optional
+  completion deadline and an integer priority class.  Admission
+  predicts the queue delay of the best engine from live telemetry
+  (queue-wait / TTFT / per-token histograms each engine already
+  records) and REJECTS a request that cannot meet its deadline instead
+  of queuing it to die (``SubmitRejected(reason="deadline_unmeetable")``).
+  A bounded frontend queue sheds the LOWEST-priority queued request to
+  make room for a higher-priority arrival, and rejects equal-or-lower
+  arrivals with ``reason="queue_full"``.
+* **Supervision.**  Each engine runs on its own worker thread (a seat).
+  A watchdog in the supervisor loop reads each seat's heartbeat (the
+  engine's ``host_state()['last_step_wall']`` twin lives on the seat)
+  and a ``step()``-in-progress timestamp: an engine exception or a
+  step that exceeds ``hang_timeout_s`` fires the flight recorder (the
+  frontend's tracer dumps the hung engine's ``host_state()``), takes
+  the seat down, and schedules a replacement engine with CAPPED
+  EXPONENTIAL BACKOFF.  A replacement failing to construct (the
+  ``attach`` fault point) just reschedules — repeated-restart chaos is
+  a tested scenario, not an outage.
+* **Journal + replay.**  The frontend journals every request's prompt,
+  sampling parameters and priority at submit.  When a seat dies, its
+  non-terminal requests are REQUEUED from the journal (attempts capped
+  by ``max_retries``, then ``FAILED``) and rerun from scratch on a
+  replacement engine built with the same config and seed.  Greedy
+  decode (``temperature=0``) is a pure argmax — the engine's rng key
+  never touches the stream — so a retried greedy request's tokens are
+  BIT-IDENTICAL to a fault-free run (the chaos gate pins this).
+  Sampled streams depend on the engine rng's slot interleaving, so
+  replay determinism is only guaranteed for greedy decode.
+* **Exactly-once terminal status.**  Every submitted request ends in
+  exactly one of ``completed`` / ``shed`` / ``failed``.  Completions
+  from a replaced engine generation are discarded (the requeued copy
+  is the one that counts), and ``_finalize`` asserts a request is
+  never terminated twice — the invariant the seeded chaos property
+  test (``tests/test_frontend.py``) sweeps fault schedules against.
+
+The frontend is HOST CODE ONLY: it never touches a traced program, so
+``compiles == {'decode': 1}`` holds per engine with the frontend on,
+and with one engine and no faults the per-request token streams are
+byte-for-byte the direct-engine behavior.
+
+Metrics land in ``frontend_*`` families (catalog:
+``docs/design/telemetry.md``); each seat's engine gets its OWN
+:class:`~paddle_tpu.telemetry.MetricsRegistry` (``engine0``,
+``engine1``, ...) so per-engine telemetry never aliases across seats —
+that per-seat registry is also what admission reads its predictions
+from.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu import telemetry
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.serving import PagedServingEngine, QueueFull
+
+__all__ = ["ServingFrontend", "SubmitRejected",
+           "QUEUED", "RUNNING", "COMPLETED", "SHED", "FAILED",
+           "TERMINAL"]
+
+# Request lifecycle.  QUEUED = journaled, waiting for a seat; RUNNING =
+# handed to an engine (its inbox, queue or a slot); the rest are the
+# three terminal states every request reaches EXACTLY ONCE.
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+SHED = "shed"
+FAILED = "failed"
+TERMINAL = frozenset({COMPLETED, SHED, FAILED})
+
+#: Reasons a submit() raises SubmitRejected / a queued request is shed.
+REJECT_REASONS = ("queue_full", "deadline_unmeetable", "too_large")
+SHED_REASONS = ("deadline", "preempted")
+
+
+class SubmitRejected(RuntimeError):
+    """Typed submit-time rejection — the load-shedding signal.
+
+    ``reason`` is one of :data:`REJECT_REASONS`: ``queue_full`` (the
+    bounded frontend queue is full of equal-or-higher priority work),
+    ``deadline_unmeetable`` (predicted completion time exceeds the
+    request's deadline), ``too_large`` (the request could never fit any
+    engine's buckets / per-slot capacity / pool — rejecting here keeps
+    an impossible request from crash-looping every seat)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"submit rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
+
+
+class _FrontendRequest:
+    """One journaled request: everything needed to replay it from
+    scratch on a replacement engine, plus its lifecycle bookkeeping."""
+
+    __slots__ = ("rid", "prompt", "max_new", "temperature", "priority",
+                 "deadline_s", "deadline_at", "submitted_at", "status",
+                 "reason", "tokens", "attempts", "engine", "assigned_at",
+                 "finished_at", "deadline_missed")
+
+    def __init__(self, rid, prompt, max_new, temperature, priority,
+                 deadline_s):
+        self.rid = rid
+        self.prompt = prompt              # np.int32 copy: THE journal
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.priority = int(priority)
+        self.deadline_s = deadline_s
+        self.submitted_at = time.perf_counter()
+        self.deadline_at = (None if deadline_s is None
+                            else self.submitted_at + float(deadline_s))
+        self.status = QUEUED
+        self.reason = None                # terminal detail string
+        self.tokens = None                # np.ndarray once COMPLETED
+        self.attempts = 0                 # completed execution attempts
+        self.engine = None                # seat index while RUNNING
+        self.assigned_at = None
+        self.finished_at = None
+        self.deadline_missed = False
+
+    def record(self) -> dict:
+        """The JSON-ish view callers get back (tokens stay ndarray)."""
+        return {"status": self.status, "tokens": self.tokens,
+                "reason": self.reason, "attempts": self.attempts,
+                "priority": self.priority, "engine": self.engine,
+                "deadline_s": self.deadline_s,
+                "deadline_missed": self.deadline_missed}
+
+
+# Seat states.  A seat is the supervisor's stable handle on "engine
+# slot i" — engines come and go (restarts), the seat persists.
+_UP = "up"
+_DOWN = "down"
+
+
+class _Seat:
+    __slots__ = ("index", "label", "state", "engine", "generation",
+                 "thread", "inbox", "assigned", "wake", "crash",
+                 "step_started_at", "last_beat", "restarts",
+                 "restart_at", "registry", "avg_service_s",
+                 "avg_tokens", "warmed")
+
+    def __init__(self, index: int, registry):
+        self.index = index
+        self.label = f"engine{index}"
+        self.state = _DOWN
+        self.engine = None
+        self.generation = 0               # bumped on every takedown
+        self.thread = None
+        self.inbox: deque = deque()       # assigned, not yet submitted
+        self.assigned: set = set()        # frontend rids on this seat
+        self.wake = threading.Event()
+        self.crash = None                 # exception from the worker
+        self.step_started_at = None       # perf_counter at step entry
+        self.last_beat = 0.0              # perf_counter after any step
+        self.restarts = 0
+        self.restart_at = 0.0             # perf_counter gate for retry
+        self.registry = registry          # per-seat MetricsRegistry
+        # EMAs the router's prediction model falls back on (seconds per
+        # completed request on this seat / tokens per completed stream)
+        self.avg_service_s = None
+        self.avg_tokens = None
+        # a fresh engine's FIRST step jit-compiles (every restart
+        # recompiles: new jit objects) — the watchdog widens its hang
+        # bound until this flips
+        self.warmed = False
+
+
+class ServingFrontend:
+    """Supervise ``num_engines`` paged serving engines as ONE service.
+
+    Construction mirrors :class:`~paddle_tpu.serving.PagedServingEngine`
+    (``num_slots`` .. ``prefix_cache`` are forwarded to every seat's
+    engine, each built with the SAME ``seed`` so a replacement engine
+    is the journal-replay twin of the one it replaces).  Frontend-level
+    knobs:
+
+    ``max_queue``
+        Bound on frontend-queued requests (``None`` = unbounded).  At
+        the bound, a new arrival preempts the lowest-priority queued
+        request if strictly lower-priority than itself (that victim is
+        shed with ``reason="preempted"``); otherwise the arrival is
+        rejected ``queue_full``.
+    ``engine_max_queue``
+        Forwarded per-engine submit bound (the engine's own typed
+        :class:`~paddle_tpu.serving.QueueFull` backpressure); the
+        worker catches it and bounces the request back to the frontend
+        queue — it is flow control, not a failure.
+    ``hang_timeout_s``
+        Watchdog bound on a single ``step()``: a step in flight longer
+        than this declares the engine hung.  A fresh engine's FIRST
+        step jit-compiles (every restart recompiles — new jit
+        objects), so until an engine completes a step the bound is
+        ``max(hang_timeout_s, first_step_grace_s)``; a hang injected on
+        a first step is instead unwound by the injector's
+        ``max_hang_s`` and surfaces as a crash.
+    ``restart_backoff_s`` / ``restart_backoff_cap_s``
+        Capped exponential backoff between an engine's takedown and its
+        replacement attempt (doubles per consecutive restart).
+    ``max_retries``
+        Execution attempts per request beyond the first; a request
+        requeued more than this many times is ``FAILED``
+        (``reason="retries_exhausted"``).
+    ``faults``
+        A :class:`~paddle_tpu.testing.faults.FaultInjector`; each seat's
+        engine fires its injection points under the seat's scope label
+        (``engine0``, ...), and a hang takedown releases injected hangs
+        so the stale worker unwinds.
+
+    Drive it like the engine: ``submit(...)`` then ``run()`` (the
+    supervisor loop runs in the calling thread until every journaled
+    request is terminal) — or call ``pump()`` yourself.  ``close()``
+    stops the worker threads; the frontend is a context manager.
+    """
+
+    def __init__(self, cfg, params, *, num_engines: int = 1,
+                 num_slots: int, num_blocks: int, block_size: int = 16,
+                 max_blocks_per_slot: Optional[int] = None,
+                 prompt_buckets=(64,), eos_id: Optional[int] = None,
+                 top_k=None, top_p=None, attn_fn=None, seed: int = 0,
+                 decode_kernel=None, prefix_cache: bool = False,
+                 engine_max_queue: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 hang_timeout_s: float = 10.0,
+                 first_step_grace_s: float = 120.0,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0,
+                 max_retries: int = 3,
+                 metrics=None, tracer=None,
+                 flight_recorder: Optional[str] = None,
+                 flight_window_s: float = 30.0,
+                 faults=None):
+        enforce(num_engines >= 1, "frontend needs at least one engine, "
+                "got num_engines=%s", num_engines)
+        enforce(max_queue is None or max_queue >= 1,
+                "max_queue must be None (unbounded) or >= 1, got %s",
+                max_queue)
+        enforce(max_retries >= 0, "max_retries must be >= 0, got %s",
+                max_retries)
+        self.cfg = cfg
+        self.params = params
+        self.num_engines = int(num_engines)
+        self.num_slots = int(num_slots)
+        self.max_queue = max_queue
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.first_step_grace_s = float(first_step_grace_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self.max_retries = int(max_retries)
+        self._faults = faults
+        # engine capacity contract, precomputed so submit() can reject
+        # an impossible request as too_large instead of letting it
+        # crash-loop every seat it is ever dispatched to
+        self._buckets = tuple(sorted(prompt_buckets))
+        maxb = (max_blocks_per_slot if max_blocks_per_slot
+                else -(-cfg.max_len // block_size))
+        self._cap = min(cfg.max_len, maxb * block_size)
+        self._bs = int(block_size)
+        self._nb = int(num_blocks)
+        self._prefix = bool(prefix_cache)
+        self._engine_kwargs = dict(
+            num_slots=num_slots, num_blocks=num_blocks,
+            block_size=block_size,
+            max_blocks_per_slot=max_blocks_per_slot,
+            prompt_buckets=prompt_buckets, eos_id=eos_id, top_k=top_k,
+            top_p=top_p, attn_fn=attn_fn, seed=seed,
+            decode_kernel=decode_kernel, prefix_cache=prefix_cache,
+            max_queue=engine_max_queue)
+
+        self._lock = threading.RLock()
+        self._requests: Dict[int, _FrontendRequest] = {}   # the journal
+        self._queue: List[int] = []       # frontend-queued rids
+        self._done_events: deque = deque()  # (gen, seat, rid, tokens)
+        self._next_rid = 0
+        self._stopping = False
+        self._zombies: List[threading.Thread] = []
+
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.get_registry())
+        if tracer is None and flight_recorder is not None:
+            tracer = telemetry.Tracer(
+                name="frontend", flight_path=flight_recorder,
+                flight_window_s=flight_window_s)
+        elif tracer is not None and flight_recorder is not None:
+            tracer.flight_path = flight_recorder
+            tracer.flight_window_s = float(flight_window_s)
+        self.tracer = tracer
+
+        m = self.metrics
+        self._m_submitted = m.counter(
+            "frontend_submitted_total",
+            help="requests accepted into the frontend journal")
+        self._m_shed = m.counter(
+            "frontend_shed_total",
+            help="requests dropped by the frontend, by reason "
+                 "(queue_full|deadline_unmeetable|too_large at submit; "
+                 "deadline|preempted from the queue)")
+        self._m_completed = m.counter(
+            "frontend_completed_total", help="requests completed")
+        self._m_failed = m.counter(
+            "frontend_failed_total",
+            help="requests terminally failed, by reason")
+        self._m_retries = m.counter(
+            "frontend_retries_total",
+            help="journal-replay requeues after an engine takedown")
+        self._m_restarts = m.counter(
+            "frontend_engine_restarts_total",
+            help="engine takedowns, by cause=crash|hang|attach and "
+                 "engine seat")
+        self._m_deadline_miss = m.counter(
+            "frontend_deadline_miss_total",
+            help="requests that COMPLETED after their deadline (shed "
+                 "requests count under frontend_shed_total instead)")
+        self._m_queue_g = m.gauge(
+            "frontend_queue_depth", help="frontend-queued requests")
+        self._m_live_g = m.gauge(
+            "frontend_engines_live", help="seats with a live engine")
+        self._m_predicted = m.histogram(
+            "frontend_predicted_wait_seconds",
+            help="admission's predicted completion time per accepted "
+                 "request (queue delay + service estimate)")
+        self._m_request = m.histogram(
+            "frontend_request_seconds",
+            help="submit -> terminal status, any outcome")
+
+        # Seats last: engine construction can fire the attach fault,
+        # and a seat that fails to come up must already have its
+        # backoff/telemetry plumbing in place.
+        self._seats = [
+            _Seat(i, telemetry.MetricsRegistry(name=f"engine{i}"))
+            for i in range(self.num_engines)]
+        for seat in self._seats:
+            self._seat_start(seat)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt_ids, max_new: int, temperature: float = 0.0,
+               *, priority: int = 1,
+               deadline_s: Optional[float] = None) -> int:
+        """Journal one request; returns its frontend rid.
+
+        ``priority`` — larger is MORE important; it orders dispatch and
+        decides who is shed under overload.  ``deadline_s`` — seconds
+        from now by which the request should COMPLETE; admission
+        rejects it if the predicted completion time already exceeds the
+        deadline, and a queued request is shed the moment its deadline
+        passes.  Once dispatched to an engine a request runs to
+        completion — a late finish counts a deadline miss, not a shed.
+
+        Raises :class:`SubmitRejected` (``reason`` in
+        :data:`REJECT_REASONS`) instead of queuing work it already
+        knows it will drop."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1).copy()
+        n = int(prompt.shape[0])
+        reason = self._size_reject(n, max_new)
+        if reason is not None:
+            self._shed_metric("too_large")
+            raise SubmitRejected("too_large", reason)
+        with self._lock:
+            if self._stopping:
+                raise RuntimeError("frontend is closed")
+            est = None
+            if deadline_s is not None:
+                est = self._predicted_completion_locked(int(max_new))
+                if deadline_s <= 0 or est > float(deadline_s):
+                    self._shed_metric("deadline_unmeetable")
+                    if self.tracer is not None:
+                        self.tracer.instant(
+                            "shed", track="frontend",
+                            reason="deadline_unmeetable",
+                            predicted_s=est, deadline_s=deadline_s)
+                    raise SubmitRejected(
+                        "deadline_unmeetable",
+                        f"predicted completion {est:.3f}s > deadline "
+                        f"{deadline_s}s")
+            if self.max_queue is not None \
+                    and len(self._queue) >= self.max_queue:
+                victim = min(
+                    (self._requests[r] for r in self._queue),
+                    key=lambda q: (q.priority, -q.rid), default=None)
+                if victim is None or victim.priority >= int(priority):
+                    self._shed_metric("queue_full")
+                    if self.tracer is not None:
+                        self.tracer.instant(
+                            "shed", track="frontend",
+                            reason="queue_full",
+                            queued=len(self._queue))
+                    raise SubmitRejected(
+                        "queue_full",
+                        f"{len(self._queue)} queued >= max_queue "
+                        f"{self.max_queue}")
+                # lowest priority goes first — the arrival outranks it
+                self._queue.remove(victim.rid)
+                self._finalize_locked(victim, SHED, reason="preempted")
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _FrontendRequest(rid, prompt, max_new, temperature,
+                                   priority, deadline_s)
+            self._requests[rid] = req
+            self._queue.append(rid)
+            self._m_submitted.inc()
+            if est is not None:
+                self._m_predicted.observe(est)
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "submit", track="frontend", rid=rid,
+                    prompt_len=n, max_new=int(max_new),
+                    priority=int(priority), deadline_s=deadline_s)
+            return rid
+
+    def _size_reject(self, n: int, max_new: int) -> Optional[str]:
+        """The engine capacity contract, checked up front (None = ok)."""
+        if n < 1:
+            return "empty prompt"
+        if not any(n <= w for w in self._buckets):
+            return (f"prompt length {n} exceeds every prefill bucket "
+                    f"{self._buckets}")
+        if max_new < 1 or n + max_new > self._cap:
+            return (f"prompt {n} + max_new {max_new} exceeds per-slot "
+                    f"capacity {self._cap}")
+        worst = -(-(n + max_new) // self._bs) + (1 if self._prefix
+                                                else 0)
+        if worst > self._nb:
+            return (f"worst case {worst} blocks exceeds the pool "
+                    f"({self._nb})")
+        return None
+
+    def _shed_metric(self, reason: str):
+        self._m_shed.inc(reason=reason)
+
+    # -------------------------------------------------------- prediction
+
+    def _service_estimate_locked(self, seat: _Seat,
+                                 max_new: int) -> float:
+        """Expected on-engine seconds for one request on this seat,
+        from its live telemetry: prefill ≈ avg(TTFT) - avg(queue wait),
+        decode ≈ max_new × avg(per-token) (falling back to avg step
+        time, then the seat's completed-request EMA).  Cold seats
+        estimate 0 — admission stays open until there is evidence."""
+        reg = seat.registry
+        ttft = reg.histogram("serving_ttft_seconds").summary()
+        qw = reg.histogram("serving_queue_wait_seconds").summary()
+        tpot = reg.histogram(
+            "serving_time_per_output_token_seconds").summary()
+        step = reg.histogram("serving_step_seconds").summary()
+        prefill = max(0.0, (ttft["avg"] or 0.0) - (qw["avg"] or 0.0))
+        per_tok = tpot["avg"] or step["avg"] or 0.0
+        est = prefill + per_tok * max_new
+        if est <= 0.0 and seat.avg_service_s is not None:
+            est = seat.avg_service_s
+        return est
+
+    def _predicted_wait_locked(self, seat: _Seat) -> float:
+        """Predicted queue delay for a NEW request on this seat: how
+        many full service waves are already committed ahead of it.  A
+        seat with a free slot predicts 0; a down seat predicts inf."""
+        if seat.state != _UP:
+            return math.inf
+        depth = len(seat.assigned)
+        if depth < self.num_slots:
+            return 0.0
+        waves = (depth - self.num_slots) // self.num_slots + 1
+        tokens = seat.avg_tokens or 0.0
+        return waves * self._service_estimate_locked(
+            seat, int(tokens) or 1)
+
+    def _predicted_completion_locked(self, max_new: int) -> float:
+        """Best-case predicted completion time across seats (queue
+        delay on the least-loaded live seat + its service estimate).
+        With every seat down, predict from queue depth alone — the
+        restart backoff is bounded, so queued work is not hopeless and
+        deadline expiry handles the rest."""
+        live = [s for s in self._seats if s.state == _UP]
+        if not live:
+            return 0.0
+        best = min(live, key=lambda s: (self._predicted_wait_locked(s),
+                                        len(s.assigned), s.index))
+        return (self._predicted_wait_locked(best)
+                + self._service_estimate_locked(best, max_new))
+
+    def _route_locked(self) -> Optional[_Seat]:
+        """Least predicted wait, ties to fewest assigned then lowest
+        index — deterministic for a deterministic submit sequence."""
+        best, key = None, None
+        for seat in self._seats:
+            if seat.state != _UP:
+                continue
+            cap = self._engine_kwargs["max_queue"]
+            if cap is not None \
+                    and len(seat.assigned) >= self.num_slots + cap:
+                continue                  # would just bounce QueueFull
+            k = (self._predicted_wait_locked(seat), len(seat.assigned),
+                 seat.index)
+            if key is None or k < key:
+                best, key = seat, k
+        return best
+
+    # ------------------------------------------------------- worker side
+
+    def _worker(self, seat: _Seat, generation: int,
+                eng: PagedServingEngine):
+        """One engine's drive loop: drain the seat inbox into
+        ``engine.submit``, step while the seat has work, push finished
+        streams to the supervisor.  Any engine exception parks on
+        ``seat.crash`` for the watchdog; a stale generation (the seat
+        was taken down around us) exits silently."""
+        rid_of = {}                       # engine rid -> frontend rid
+        try:
+            while True:
+                with self._lock:
+                    if self._stopping or seat.generation != generation:
+                        return
+                    work = list(seat.inbox)
+                    seat.inbox.clear()
+                # the heartbeat beats every loop, idle or not — the
+                # watchdog's staleness backstop must not fire on a seat
+                # that was merely quiet before work arrived
+                seat.last_beat = time.perf_counter()
+                for req in work:
+                    try:
+                        erid = eng.submit(req.prompt, req.max_new,
+                                          req.temperature)
+                    except QueueFull:
+                        # backpressure, not failure: bounce it back to
+                        # the frontend queue for another seat
+                        with self._lock:
+                            if seat.generation == generation \
+                                    and req.status == RUNNING:
+                                seat.assigned.discard(req.rid)
+                                req.status = QUEUED
+                                req.engine = None
+                                self._queue.append(req.rid)
+                        continue
+                    except Exception as exc:
+                        # a request the engine itself refuses (size
+                        # prechecks should make this unreachable) must
+                        # not crash-loop the seat
+                        with self._lock:
+                            if seat.generation == generation \
+                                    and req.status == RUNNING:
+                                seat.assigned.discard(req.rid)
+                                self._finalize_locked(
+                                    req, FAILED,
+                                    reason=f"submit_error: {exc}")
+                        continue
+                    # engine-rid -> frontend-rid map is LOCAL to this
+                    # worker generation: a replaced engine's ids can
+                    # never alias the replacement's
+                    rid_of[erid] = req.rid
+                stepped = False
+                if seat.assigned:
+                    seat.step_started_at = time.perf_counter()
+                    try:
+                        progressed = eng.step()
+                    finally:
+                        # a stale worker unwinding from a released hang
+                        # must not clobber the REPLACEMENT engine's
+                        # in-flight step timestamp
+                        if seat.generation == generation:
+                            seat.step_started_at = None
+                    if seat.generation == generation:
+                        seat.warmed = True
+                    seat.last_beat = time.perf_counter()
+                    stepped = True
+                    done = eng.pop_results()
+                    if done:
+                        with self._lock:
+                            for erid, toks in done.items():
+                                self._done_events.append(
+                                    (generation, seat.index,
+                                     rid_of.pop(erid, None), toks))
+                    if not progressed:
+                        if not done \
+                                and eng.host_state()["queue_depth"] > 0:
+                            raise RuntimeError(
+                                "engine deadlock: queued work but "
+                                "nothing active")
+                        # work is in flight at the supervisor; yield
+                        time.sleep(0.001)
+                if not stepped:
+                    seat.wake.wait(0.002)
+                    seat.wake.clear()
+        except BaseException as exc:       # noqa: BLE001 — watchdog feed
+            with self._lock:
+                if seat.generation == generation:
+                    seat.crash = exc
+
+    # --------------------------------------------------- supervisor side
+
+    def _seat_start(self, seat: _Seat):
+        """(Re)build the seat's engine and worker thread.  Construction
+        failure (the ``attach`` fault point) counts a restart and
+        reschedules with backoff — never raises."""
+        try:
+            faults = (None if self._faults is None
+                      else self._faults.scope(seat.label))
+            eng = PagedServingEngine(
+                self.cfg, self.params, metrics=seat.registry,
+                faults=faults, **self._engine_kwargs)
+        except Exception as exc:
+            seat.restarts += 1
+            seat.restart_at = (time.perf_counter()
+                               + self._backoff(seat.restarts))
+            self._m_restarts.inc(cause="attach", engine=seat.label)
+            if self.tracer is not None:
+                self.tracer.instant("engine_restart", track="frontend",
+                                    engine=seat.label, cause="attach",
+                                    restarts=seat.restarts,
+                                    error=f"{type(exc).__name__}: "
+                                          f"{exc}")
+            return
+        seat.engine = eng
+        seat.state = _UP
+        seat.crash = None
+        seat.step_started_at = None
+        seat.warmed = False
+        seat.last_beat = time.perf_counter()
+        seat.thread = threading.Thread(
+            target=self._worker, args=(seat, seat.generation, eng),
+            name=f"ptpu-frontend-{seat.label}", daemon=True)
+        seat.thread.start()
+
+    def _backoff(self, restarts: int) -> float:
+        return min(self.restart_backoff_s * (2.0 ** max(0,
+                                                        restarts - 1)),
+                   self.restart_backoff_cap_s)
+
+    def _seat_down_locked(self, seat: _Seat, cause: str, exc):
+        """Take the seat down: flight-record it, bump the generation
+        (in-flight worker output becomes discardable), release injected
+        hangs, requeue the seat's journaled requests, schedule the
+        replacement."""
+        state = None
+        if seat.engine is not None:
+            try:
+                state = seat.engine.host_state()
+            except Exception:
+                state = {"error": "host_state() raised"}
+        if self.tracer is not None:
+            self.tracer.instant(
+                f"engine_{cause}", track="frontend", engine=seat.label,
+                restarts=seat.restarts + 1,
+                error=None if exc is None
+                else f"{type(exc).__name__}: {exc}")
+            if self.tracer.flight_path is not None:
+                self.tracer.dump_flight(
+                    reason=f"{cause} on {seat.label}"
+                    + (f": {exc}" if exc is not None else ""),
+                    state={"engine": seat.label,
+                           "engine_host_state": state,
+                           "frontend": self._snapshot_locked()})
+        self._m_restarts.inc(cause=cause, engine=seat.label)
+        seat.generation += 1
+        seat.state = _DOWN
+        seat.engine = None
+        if seat.thread is not None:
+            # the stale worker exits on its own (generation check /
+            # released hang), but close() must still be able to wait
+            # for it — a daemon thread dying inside an XLA call at
+            # interpreter teardown takes the process with it
+            self._zombies.append(seat.thread)
+        seat.thread = None
+        seat.crash = None
+        seat.step_started_at = None
+        seat.inbox.clear()
+        seat.restarts += 1
+        seat.restart_at = (time.perf_counter()
+                           + self._backoff(seat.restarts))
+        if self._faults is not None and cause == "hang":
+            self._faults.release_hangs()
+        # journal replay: every non-terminal request on the seat goes
+        # back to the queue (same prompt, same sampling params — greedy
+        # streams replay bit-identically), or FAILED past the retry cap
+        for rid in sorted(seat.assigned):
+            req = self._requests[rid]
+            if req.status in TERMINAL:
+                continue
+            req.attempts += 1
+            req.engine = None
+            if req.attempts > self.max_retries:
+                self._finalize_locked(req, FAILED,
+                                      reason="retries_exhausted")
+                continue
+            req.status = QUEUED
+            self._queue.append(rid)
+            self._m_retries.inc()
+            if self.tracer is not None:
+                self.tracer.instant("retry", track="frontend", rid=rid,
+                                    attempt=req.attempts,
+                                    engine=seat.label)
+        seat.assigned.clear()
+
+    def _finalize_locked(self, req: _FrontendRequest, status: str,
+                         *, reason: Optional[str] = None, tokens=None):
+        """The ONE place a request becomes terminal — exactly-once is
+        asserted, not hoped for."""
+        if req.status in TERMINAL:
+            raise AssertionError(
+                f"request {req.rid} finalized twice: {req.status} "
+                f"then {status} (frontend bug)")
+        req.status = status
+        req.reason = reason
+        req.finished_at = time.perf_counter()
+        self._m_request.observe(req.finished_at - req.submitted_at)
+        if status == COMPLETED:
+            req.tokens = np.asarray(tokens, np.int32)
+            self._m_completed.inc()
+            if req.deadline_at is not None \
+                    and req.finished_at > req.deadline_at:
+                req.deadline_missed = True
+                self._m_deadline_miss.inc()
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "deadline_miss", track="frontend", rid=req.rid,
+                        late_s=req.finished_at - req.deadline_at)
+        elif status == SHED:
+            self._shed_metric(reason or "deadline")
+            if self.tracer is not None:
+                self.tracer.instant("shed", track="frontend",
+                                    rid=req.rid, reason=reason)
+        else:
+            self._m_failed.inc(reason=reason or "error")
+
+    def pump(self):
+        """One supervisor pass: collect completions, run the watchdog,
+        restart due seats, expire deadlines, dispatch the queue.
+        ``run()`` loops this; tests can call it directly."""
+        to_start = []
+        with self._lock:
+            now = time.perf_counter()
+            # 1. completions (stale generations are a replaced engine
+            # finishing work the journal already re-owns — drop them)
+            while self._done_events:
+                gen, si, rid, toks = self._done_events.popleft()
+                seat = self._seats[si]
+                if rid is None or gen != seat.generation:
+                    continue
+                req = self._requests[rid]
+                seat.assigned.discard(rid)
+                if req.status in TERMINAL:
+                    continue
+                if req.assigned_at is not None:
+                    dt = now - req.assigned_at
+                    seat.avg_service_s = (
+                        dt if seat.avg_service_s is None
+                        else 0.7 * seat.avg_service_s + 0.3 * dt)
+                ntok = float(len(toks))
+                seat.avg_tokens = (
+                    ntok if seat.avg_tokens is None
+                    else 0.7 * seat.avg_tokens + 0.3 * ntok)
+                self._finalize_locked(req, COMPLETED, tokens=toks)
+            # 2. watchdog: crashes parked by workers, steps over the
+            # hang bound, and a stale heartbeat with work on the seat
+            for seat in self._seats:
+                if seat.state != _UP:
+                    continue
+                started = seat.step_started_at
+                limit = (self.hang_timeout_s if seat.warmed
+                         else max(self.hang_timeout_s,
+                                  self.first_step_grace_s))
+                if seat.crash is not None:
+                    self._seat_down_locked(seat, "crash", seat.crash)
+                elif started is not None and now - started > limit:
+                    self._seat_down_locked(seat, "hang", None)
+                elif seat.assigned \
+                        and now - seat.last_beat > 4 * max(limit, 0.25):
+                    # heartbeat backstop: the worker owes us a step
+                    self._seat_down_locked(seat, "hang", None)
+            # 3. seats due for a restart (engines are BUILT outside the
+            # lock — construction does device allocation and can fire
+            # the attach fault)
+            for seat in self._seats:
+                if seat.state == _DOWN and now >= seat.restart_at:
+                    to_start.append(seat)
+            # 4. deadline expiry while frontend-queued
+            for rid in list(self._queue):
+                req = self._requests[rid]
+                if req.deadline_at is not None \
+                        and now > req.deadline_at:
+                    self._queue.remove(rid)
+                    self._finalize_locked(req, SHED, reason="deadline")
+            # 5. dispatch: priority first, then arrival order
+            self._queue.sort(key=lambda r:
+                             (-self._requests[r].priority, r))
+            remaining = []
+            woken = set()
+            for rid in self._queue:
+                req = self._requests[rid]
+                seat = self._route_locked()
+                if seat is None:
+                    remaining.append(rid)
+                    continue
+                req.status = RUNNING
+                req.engine = seat.index
+                req.assigned_at = now
+                seat.assigned.add(rid)
+                seat.inbox.append(req)
+                woken.add(seat.index)
+            self._queue = remaining
+            for si in woken:
+                self._seats[si].wake.set()
+            self._m_queue_g.set(float(len(self._queue)))
+            self._m_live_g.set(float(sum(
+                1 for s in self._seats if s.state == _UP)))
+        for seat in to_start:
+            self._seat_start(seat)
+
+    def run(self, timeout_s: Optional[float] = None,
+            poll_s: float = 0.001) -> Dict[int, dict]:
+        """Drive the supervisor loop until every journaled request is
+        terminal; returns ``{rid: record}`` (see
+        :meth:`_FrontendRequest.record`).  ``timeout_s`` bounds the
+        wait — on expiry the flight recorder (if armed) dumps the
+        frontend snapshot and a ``TimeoutError`` raises."""
+        t0 = time.perf_counter()
+        while True:
+            self.pump()
+            with self._lock:
+                if all(r.status in TERMINAL
+                       for r in self._requests.values()):
+                    return self.results()
+            if timeout_s is not None \
+                    and time.perf_counter() - t0 > timeout_s:
+                with self._lock:
+                    snap = self._snapshot_locked()
+                if self.tracer is not None \
+                        and self.tracer.flight_path is not None:
+                    self.tracer.dump_flight(
+                        reason=f"run() timeout after {timeout_s}s",
+                        state=snap)
+                raise TimeoutError(
+                    f"frontend.run() exceeded {timeout_s}s; "
+                    f"non-terminal: {snap['non_terminal']}")
+            time.sleep(poll_s)
+
+    # --------------------------------------------------------- reporting
+
+    def results(self) -> Dict[int, dict]:
+        """Every journaled request's record (terminal or not)."""
+        with self._lock:
+            return {rid: r.record()
+                    for rid, r in self._requests.items()}
+
+    def status(self, rid: int) -> str:
+        with self._lock:
+            return self._requests[rid].status
+
+    def stats(self) -> dict:
+        """Service-level rollup for benches and gates: counts, rates,
+        restarts.  ``shed_rate`` / ``deadline_miss_rate`` are the two
+        SLO numbers ``benchmark/lm_decode.py --frontend`` reports."""
+        with self._lock:
+            recs = list(self._requests.values())
+            n = len(recs)
+            shed = sum(1 for r in recs if r.status == SHED)
+            completed = sum(1 for r in recs if r.status == COMPLETED)
+            failed = sum(1 for r in recs if r.status == FAILED)
+            missed = sum(1 for r in recs if r.deadline_missed)
+            restarts = sum(s.restarts for s in self._seats)
+            return {
+                "submitted": n,
+                "completed": completed,
+                "shed": shed,
+                "failed": failed,
+                "queued": len(self._queue),
+                "retries": sum(r.attempts for r in recs),
+                "engine_restarts": restarts,
+                "engines_live": sum(1 for s in self._seats
+                                    if s.state == _UP),
+                "deadline_misses": missed,
+                "shed_rate": (shed / n) if n else 0.0,
+                "deadline_miss_rate": (missed / completed)
+                if completed else 0.0,
+            }
+
+    def engine_states(self) -> List[Optional[dict]]:
+        """Each live seat's ``host_state()`` (None for a down seat)."""
+        with self._lock:
+            seats = [(s.state, s.engine) for s in self._seats]
+        return [eng.host_state() if state == _UP and eng is not None
+                else None for state, eng in seats]
+
+    def compile_counts(self) -> List[Optional[dict]]:
+        """Per-seat ``compile_counts()`` — the chaos gate's
+        ``compiles == {'decode': 1}`` check, per live engine."""
+        with self._lock:
+            engines = [s.engine if s.state == _UP else None
+                       for s in self._seats]
+        return [None if e is None else e.compile_counts()
+                for e in engines]
+
+    def _snapshot_locked(self) -> dict:
+        return {
+            "queue_depth": len(self._queue),
+            "non_terminal": sorted(
+                rid for rid, r in self._requests.items()
+                if r.status not in TERMINAL),
+            "seats": [{
+                "label": s.label, "state": s.state,
+                "generation": s.generation, "restarts": s.restarts,
+                "assigned": sorted(s.assigned),
+                "step_started_at": s.step_started_at,
+                "last_beat": s.last_beat,
+            } for s in self._seats],
+            "stats": None,                # stats() re-locks; keep flat
+        }
+
+    # --------------------------------------------------------- lifecycle
+
+    def close(self):
+        """Stop every worker thread and take the seats down.  Queued
+        and running requests stay journaled (non-terminal) — close is
+        shutdown, not resolution."""
+        with self._lock:
+            self._stopping = True
+            for seat in self._seats:
+                seat.generation += 1
+                seat.state = _DOWN
+                seat.engine = None
+                seat.wake.set()
+            threads = [s.thread for s in self._seats
+                       if s.thread is not None] + self._zombies
+        if self._faults is not None:
+            self._faults.release_hangs()
+        for t in threads:
+            # generously: a worker mid-compile must come home before
+            # the interpreter starts tearing down XLA under it
+            t.join(timeout=30.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

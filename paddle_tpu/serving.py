@@ -63,8 +63,24 @@ from paddle_tpu.prefix_cache import PrefixCache
 from paddle_tpu import telemetry
 import paddle_tpu.nn as nn
 
-__all__ = ["paged_serve_builder", "PagedServingEngine",
+__all__ = ["paged_serve_builder", "PagedServingEngine", "QueueFull",
            "paged_hbm_bytes", "dense_hbm_bytes"]
+
+
+class QueueFull(RuntimeError):
+    """Typed ``submit()`` backpressure signal: the bounded host queue
+    already holds ``max_queue`` requests.  A caller that keeps
+    submitting into an overloaded engine must hear "not now" as a
+    TYPED condition it can route on (shed, retry elsewhere, surface a
+    429) — an unbounded deque just converts overload into memory growth
+    and unbounded queue-wait, the exact failure mode SLO-aware serving
+    exists to remove."""
+
+    def __init__(self, depth: int, limit: int):
+        self.depth = int(depth)
+        self.limit = int(limit)
+        super().__init__(
+            f"submit queue full: {depth} queued >= max_queue {limit}")
 
 
 def _paged_model(cfg: TransformerConfig, attn_fn):
@@ -340,6 +356,21 @@ class PagedServingEngine:
     pool accounting, compile counts) are written there before the
     exception propagates.  Arming the flight recorder without an
     explicit tracer creates one internally.
+
+    ``max_queue`` bounds the host submit queue: ``submit()`` past the
+    bound raises the typed :class:`QueueFull` (counted in
+    ``serving_submit_rejects_total{reason="queue_full"}``) instead of
+    growing the deque without limit — backpressure the caller can route
+    on.  Default ``None`` keeps the historical unbounded behavior.
+
+    ``faults=`` attaches a fault-injection scope
+    (``paddle_tpu.testing.faults`` — anything with ``fire(point)``).
+    The engine fires the named points ``attach`` / ``admit`` /
+    ``prefill`` / ``decode_step`` / ``retire`` at the matching spots in
+    its HOST loop, strictly outside the jitted programs, so an armed
+    injector changes no traced bytes (the ``paged-engine-decode-faults``
+    lint entrypoint pins it).  ``None`` (the default) costs one
+    attribute check per point.
     """
 
     def __init__(self, cfg: TransformerConfig, params, *,
@@ -350,7 +381,8 @@ class PagedServingEngine:
                  metrics=None, tracer=None,
                  flight_recorder: Optional[str] = None,
                  flight_window_s: float = 30.0, decode_kernel=None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 max_queue: Optional[int] = None, faults=None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -363,6 +395,13 @@ class PagedServingEngine:
         self.eos_id = eos_id
         enforce(self.nb >= 1 and self.S >= 1, "engine needs a pool and "
                 "at least one slot")
+        enforce(max_queue is None or max_queue >= 1,
+                "max_queue must be None (unbounded) or >= 1, got %s",
+                max_queue)
+        self.max_queue = max_queue
+        self._faults = faults
+        if self._faults is not None:
+            self._faults.fire("attach")
         hd = cfg.dim // cfg.num_heads
         model = _paged_model(cfg, attn_fn)
         S = self.S
@@ -511,6 +550,9 @@ class PagedServingEngine:
         self.decode_steps = 0
         self.tokens_decoded = 0
         self._run_seconds = 0.0
+        # last-step heartbeat (host_state(): the watchdog/router feed)
+        self._last_step_wall = None       # time.time() at last step end
+        self._last_step_seconds = None    # duration of that step
         # Telemetry — ALL host-side, observed only after device values
         # come home (int()/np.asarray syncs): a metric update inside the
         # jitted step would be the host-callback-in-loop lint error, and
@@ -558,6 +600,10 @@ class PagedServingEngine:
             "serving_admission_rejects_total",
             help="admission attempts blocked, by reason=slots|pool "
                  "(counted once per blocked _admit call)")
+        self._m_submit_rejects = m.counter(
+            "serving_submit_rejects_total",
+            help="submit() calls rejected before queuing, by reason "
+                 "(queue_full = bounded-queue backpressure)")
         self._m_retired = m.counter(
             "serving_retired_total",
             help="requests retired, by reason=eos|max_new")
@@ -633,6 +679,16 @@ class PagedServingEngine:
         enforce(worst <= self.nb,
                 "submit: request worst case %d blocks exceeds the pool "
                 "(%d) — it could never be admitted", worst, self.nb)
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            # backpressure, not memory growth: the typed reject is the
+            # signal SLO-aware callers (the frontend) shed on
+            self._m_submit_rejects.inc(reason="queue_full")
+            if self.tracer is not None:
+                self.tracer.instant("submit_rejected", track="host",
+                                    reason="queue_full",
+                                    queued=len(self._queue))
+            raise QueueFull(len(self._queue), self.max_queue)
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, prompt, max_new, float(temperature), blocks)
@@ -664,6 +720,12 @@ class PagedServingEngine:
         pressure evicts LRU sharer-free registry leaves before
         rejecting."""
         while self._queue:
+            if self._faults is not None:
+                # one "admit" invocation per admission ATTEMPT with
+                # queued work, before any state moves — an injected
+                # raise here models admission failure and leaves the
+                # queue/slots/ledger exactly as they were
+                self._faults.fire("admit")
             try:
                 slot = self._slots.index(None)
             except ValueError:
@@ -705,6 +767,18 @@ class PagedServingEngine:
                                         rid=req.rid,
                                         queued=len(self._queue))
                 return                    # pool cannot take it yet
+            if self._faults is not None:
+                try:
+                    # fires once per request actually reaching its
+                    # prefill dispatch; the request is still queued, so
+                    # an injected raise loses nothing — only the
+                    # eviction-guard marks need unwinding
+                    self._faults.fire("prefill")
+                except BaseException:
+                    if hit is not None:
+                        for nd in hit.nodes:
+                            nd.sharers.discard(req.rid)
+                    raise
             self._queue.popleft()
             req.blocks_reserved = need
             t_admit = time.perf_counter()
@@ -848,6 +922,10 @@ class PagedServingEngine:
         return self._evict_prefix(self.nb)
 
     def _retire(self, slot: int, reason: str = "max_new"):
+        if self._faults is not None:
+            # before any mutation: an injected raise leaves the
+            # finished request in its slot for the supervisor to replay
+            self._faults.fire("retire")
         req = self._slots[slot]
         n = len(req.tokens)
         t_retire = time.perf_counter()
@@ -917,6 +995,11 @@ class PagedServingEngine:
         active = np.asarray([r is not None for r in self._slots])
         if not active.any():
             return False
+        if self._faults is not None:
+            # "crash/hang mid-decode": requests hold slots and blocks,
+            # generated prefixes exist only in host memory — exactly
+            # the state a supervisor must requeue-and-replay
+            self._faults.fire("decode_step")
         self.cache, nxt, done, ok = self._decode(
             self.params, self.cache, jnp.asarray(self._tok),
             jnp.asarray(active), jnp.asarray(self._temps),
@@ -950,6 +1033,8 @@ class PagedServingEngine:
         dt = time.perf_counter() - t0
         self._run_seconds += dt           # np.asarray above synced: real
         self._m_step.observe(dt)
+        self._last_step_wall = time.time()
+        self._last_step_seconds = dt
         return True
 
     def run(self):
@@ -966,6 +1051,14 @@ class PagedServingEngine:
                     "— a request too large for the current pool")
                 self._flight_dump(exc)
                 raise exc
+        return self.pop_results()
+
+    def pop_results(self):
+        """Take (and clear) the finished streams ``{rid: np.ndarray}``.
+        The step-driven twin of :meth:`run`'s return — a caller that
+        drives :meth:`step` itself (the serving front-end) collects
+        completions here after each step instead of reading the private
+        results dict."""
         out, self._results = self._results, {}
         return out
 
@@ -986,10 +1079,28 @@ class PagedServingEngine:
             } for r in self._slots],
             "queue_depth": len(self._queue),
             "queued_rids": [r.rid for r in self._queue],
+            "submit_queue": {
+                "depth": len(self._queue),
+                "max_queue": self.max_queue,
+            },
             "blocks_reserved_worst_case": self._reserved,
             "prefix_pinned_blocks": self._pinned,
             "prefix_cache": (None if self._prefix is None
                              else self._prefix.stats()),
+            # the pool ledger in one place: everything the watchdog and
+            # the frontend's router read, with no private attributes
+            "ledger": {
+                "reserved_blocks": self._reserved,
+                "pinned_blocks": self._pinned,
+                "shared_blocks": (0 if self._prefix is None
+                                  else self._prefix.stats()
+                                  ["shared_blocks"]),
+                "pool_blocks": self.nb,
+            },
+            # heartbeat: when the last decode step ENDED (wall clock)
+            # and how long it took — None before the first step
+            "last_step_wall": self._last_step_wall,
+            "last_step_seconds": self._last_step_seconds,
             "pool_blocks": self.nb,
             "block_size": self.bs,
             "num_slots": self.S,

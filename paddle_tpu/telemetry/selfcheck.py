@@ -1,6 +1,6 @@
 """The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
 
-Seven checks, each a hard failure (non-zero exit) when violated:
+Eight checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
    (fresh registry, request-level tracer ON, ``decode_kernel=True`` so
@@ -40,10 +40,22 @@ Seven checks, each a hard failure (non-zero exit) when violated:
    (the packed statistics vector may not perturb tracing or donation),
    and the per-step host cost of ``HealthMonitor.observe`` amortized
    over the default cadence stays under the same observation ceiling.
-7. **Lint re-check** — the instrumented entrypoints (engine decode,
-   its prefix-sharing twin, paged serve step, trainer step,
-   health-instrumented trainer step) re-trace through tpu-lint with
-   ZERO error-severity findings:
+7. **Chaos smoke** — the serving FRONTEND (``paddle_tpu/frontend.py``)
+   first proves its fault-free single-engine fast path is
+   byte-for-byte the direct engine (identical greedy token streams,
+   ``compiles == {'decode': 1}``), then runs a two-engine service
+   through a deterministic fault schedule
+   (``paddle_tpu/testing/faults.py``: crash mid-decode, hung step,
+   failed engine construction) plus an overload burst against a
+   bounded queue: every request must reach EXACTLY ONE terminal
+   status, retried requests' token streams must be bit-identical to
+   the fault-free run, each live engine must still hold the
+   ``compiles == {'decode': 1}`` pin, and the overload burst must shed
+   lowest-priority-first with typed reject reasons.
+8. **Lint re-check** — the instrumented entrypoints (engine decode,
+   its prefix-sharing and fault-injection twins, paged serve step,
+   trainer step, health-instrumented trainer step) re-trace through
+   tpu-lint with ZERO error-severity findings:
    ``host-callback-in-loop`` is the rule that would fire if any metric
    update — or health statistic — leaked inside a jitted program as a
    callback instead of an in-graph reduction.
@@ -84,6 +96,7 @@ REQUIRED_SERVING_METRICS = (
 #: lint re-check proves instrumentation stayed host-side.
 INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode",
+    "paged-engine-decode-faults",
     "paged-engine-decode-kernel",
     "paged-engine-decode-prefix",
     "paged-serve-step",
@@ -384,6 +397,135 @@ def _check_health():
     return snap, per_step
 
 
+def _check_chaos():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.frontend import (COMPLETED, TERMINAL,
+                                     ServingFrontend, SubmitRejected)
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry import MetricsRegistry
+    from paddle_tpu.testing.faults import (Fault, FaultInjector,
+                                           FaultSchedule)
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=48)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    kw = dict(num_slots=2, num_blocks=24, block_size=4,
+              prompt_buckets=(16,), decode_kernel=False, seed=0)
+    prompts = [np.arange(1, 7, dtype=np.int32),
+               np.arange(3, 12, dtype=np.int32),
+               np.arange(2, 5, dtype=np.int32),
+               np.arange(5, 9, dtype=np.int32)]
+    max_new = 8
+
+    # the fault-free reference: every stream comparison below is
+    # against these exact bytes
+    ref_eng = PagedServingEngine(cfg, params,
+                                 metrics=MetricsRegistry("chaos-ref"),
+                                 **kw)
+    for p in prompts:
+        ref_eng.submit(p, max_new)
+    reference = ref_eng.run()
+
+    # fast path: one engine, no faults — byte-for-byte the engine
+    with ServingFrontend(cfg, params, num_engines=1,
+                         metrics=MetricsRegistry("chaos-fast"),
+                         **kw) as fe:
+        rids = [fe.submit(p, max_new) for p in prompts]
+        out = fe.run(timeout_s=300)
+        compiles = fe.compile_counts()
+    for i, rid in enumerate(rids):
+        if out[rid]["status"] != COMPLETED:
+            _fail(f"fault-free frontend request {rid} ended "
+                  f"{out[rid]['status']}, wanted completed")
+        if not np.array_equal(out[rid]["tokens"], reference[i]):
+            _fail(f"fault-free frontend stream {rid} diverged from the "
+                  "direct engine — the fast path is not byte-for-byte")
+    if compiles != [{"decode": 1, "prefill": 1}]:
+        _fail("compiles == {'decode': 1} broke with the frontend on "
+              f"(fault-free): {compiles}")
+
+    # chaos: crash engine0 mid-decode, fail its first replacement's
+    # construction, hang engine1 mid-decode — then an overload burst
+    sched = FaultSchedule([
+        Fault("decode_step", 3, "raise", scope="engine0"),
+        Fault("attach", 2, "raise", scope="engine0"),
+        Fault("decode_step", 4, "hang", scope="engine1"),
+    ])
+    inj = FaultInjector(sched, max_hang_s=10.0)
+    reg = MetricsRegistry("chaos")
+    with ServingFrontend(cfg, params, num_engines=2, metrics=reg,
+                         faults=inj, hang_timeout_s=0.5,
+                         restart_backoff_s=0.01,
+                         restart_backoff_cap_s=0.05, max_queue=8,
+                         **kw) as fe:
+        rids = [fe.submit(p, max_new) for p in prompts]
+        out = fe.run(timeout_s=300)
+        st = fe.stats()
+        compiles = fe.compile_counts()
+        fired = [f["point"] for f in inj.fired()]
+        if sorted(fired) != ["attach", "decode_step", "decode_step"]:
+            _fail(f"fault schedule misfired: {inj.fired()}")
+        if st["engine_restarts"] != 3:
+            _fail(f"wanted 3 engine restarts (crash+attach+hang), got "
+                  f"{st['engine_restarts']}")
+        for i, rid in enumerate(rids):
+            if out[rid]["status"] != COMPLETED:
+                _fail(f"chaos request {rid} ended {out[rid]['status']} "
+                      f"({out[rid]['reason']}), wanted completed")
+            if not np.array_equal(out[rid]["tokens"], reference[i]):
+                _fail(f"retried stream {rid} is not bit-identical to "
+                      "the fault-free run")
+        # per live engine the decode step compiled AT MOST once (an
+        # idle replacement that never stepped again holds 0); any
+        # engine that did work holds exactly 1
+        for c in compiles:
+            if c is not None and c.get("decode", 0) > 1:
+                _fail("compiles == {'decode': 1} broke on a restarted "
+                      f"engine: {compiles}")
+        if not any(c and c.get("decode") == 1 for c in compiles):
+            _fail(f"no live engine shows a compiled decode: {compiles}")
+        if st["retries"] < 1:
+            _fail("chaos run recorded no retries — the faults did not "
+                  "exercise requeue/replay")
+
+        # overload burst against the same (warm) service: a bounded
+        # queue must reject typed and shed lowest-priority-first
+        fe.max_queue = 2
+        q0 = fe.submit(prompts[0], 4, priority=1)
+        fe.submit(prompts[1], 4, priority=2)
+        try:
+            fe.submit(prompts[2], 4, priority=1)
+            _fail("overload submit past max_queue did not raise")
+        except SubmitRejected as exc:
+            if exc.reason != "queue_full":
+                _fail(f"overload reject reason {exc.reason!r}, wanted "
+                      "'queue_full'")
+        fe.submit(prompts[3], 4, priority=5)   # preempts lowest
+        if fe.status(q0) != "shed":
+            _fail("higher-priority arrival did not shed the "
+                  f"lowest-priority queued request (status {fe.status(q0)})")
+        out = fe.run(timeout_s=300)
+        st = fe.stats()
+    n_terminal = st["completed"] + st["shed"] + st["failed"]
+    if n_terminal != st["submitted"] or any(
+            r["status"] not in TERMINAL for r in out.values()):
+        _fail(f"exactly-once violated: {st['submitted']} submitted vs "
+              f"{n_terminal} terminal ({st})")
+    if reg.counter("frontend_shed_total").value(reason="preempted") \
+            != 1.0:
+        _fail("frontend_shed_total{reason=preempted} != 1 after the "
+              "overload burst")
+    return st
+
+
 def _check_lint():
     from paddle_tpu.analysis import lint_target, self_check_targets
     errors = []
@@ -418,6 +560,11 @@ def main(argv=None) -> int:
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "
           f"health families, compiles==1 with health on, "
           f"{h_per_step * 1e6:.2f}us/step at default cadence)")
+    cst = _check_chaos()
+    print("selfcheck: chaos smoke ok (fast path byte-identical, "
+          f"{cst['engine_restarts']} restart(s) recovered, "
+          f"{cst['completed']}/{cst['submitted']} completed + "
+          f"{cst['shed']} shed = exactly-once, compiles==1 per engine)")
     _check_lint()
     print("selfcheck: tpu-lint re-check ok (0 errors on instrumented "
           "entrypoints)")

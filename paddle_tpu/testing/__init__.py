@@ -1,4 +1,4 @@
-"""Testing utilities: numeric gradient checking and tolerant comparison.
+"""Testing utilities: gradient checking, comparison, fault injection.
 
 TPU-native twin of the reference's core correctness tooling —
 ``paddle/gserver/tests/LayerGradUtil.h:203-306`` (``testLayerGrad``) and the
@@ -6,6 +6,12 @@ new-IR ``python/paddle/v2/framework/tests/op_test.py:95``
 (``get_numeric_gradient`` / ``check_grad``): central finite differences of a
 scalarized function compared against ``jax.grad``, applied over whole
 parameter pytrees.
+
+``paddle_tpu.testing.faults`` is the deterministic fault-injection
+harness the serving chaos tests drive — seeded schedules of
+raise/delay/hang faults fired at named injection points threaded
+through the serving engine (the runtime-robustness twin of the
+reference's fault-tolerant go/master + go/pserver cloud runtime).
 """
 
 from __future__ import annotations

@@ -1,0 +1,307 @@
+"""Deterministic fault injection for the serving stack.
+
+The reference snapshot's headline systems property is that its cloud
+runtime DEGRADES instead of dying: go/master journals task leases and
+retries a dead worker's work with backoff.  Reproducing that robustness
+loop needs a way to make things die ON DEMAND and REPRODUCIBLY — this
+module is that tool.
+
+Three pieces:
+
+* :class:`Fault` — one scheduled failure: a named injection ``point``
+  (the engine threads :data:`POINTS` through its host loop), the
+  1-based invocation index ``at`` it fires on, an ``action``
+  (``"raise"`` / ``"delay"`` / ``"hang"``), and an optional ``scope``
+  restricting it to one engine.  Faults are one-shot unless ``every``
+  repeats them.
+* :class:`FaultSchedule` — an ordered set of faults.
+  :meth:`FaultSchedule.seeded` derives a schedule from a seed through
+  ``np.random.RandomState``, so a chaos property test can sweep seeds
+  and every failure it finds replays exactly.
+* :class:`FaultInjector` — the runtime: owns per-``(scope, point)``
+  invocation counters, matches each :meth:`fire` call against the
+  schedule, and performs the action.  ``fire`` is what the engine
+  calls at each injection point; with no injector attached the call
+  site is a single ``is None`` check.
+
+Determinism contract: a fault fires on the N-th ``fire(point)`` call
+within its scope — nothing is keyed on wall time.  The engine's host
+loop is single-threaded per engine and its step/admission sequence is a
+pure function of its submitted requests, so invocation counts (and
+therefore fault timing) reproduce run-to-run even when several engine
+workers run on threads.  Counters survive an engine restart (the scope
+string names the engine SEAT, not the engine object), so a one-shot
+fault cannot re-fire against the replacement engine.
+
+Hangs are EVENT-RELEASED, never unbounded: a hanging ``fire`` blocks on
+a ``threading.Event`` until :meth:`FaultInjector.release_hangs` (what
+the supervisor calls as part of restarting a hung engine) or
+``max_hang_s`` elapses, then raises :class:`FaultError` so the stuck
+worker thread unwinds instead of leaking.  A test can therefore inject
+a real observable hang — the watchdog sees a step that never returns —
+without ever wedging the test process.
+
+Injected failures raise :class:`FaultError` (a ``RuntimeError``
+subclass) so supervisors and tests can tell injected chaos from real
+engine bugs: the frontend restarts on ANY engine exception, but the
+chaos gate asserts the failures it sees are its own.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["POINTS", "ACTIONS", "Fault", "FaultError", "FaultSchedule",
+           "FaultInjector"]
+
+#: The named injection points the serving engine threads through its
+#: host loop (``serving.py``; catalog in docs/design/serving.md):
+#: ``attach``      engine construction (device attach / jit build),
+#: ``admit``       top of each admission attempt,
+#: ``prefill``     before a prompt's prefill dispatch,
+#: ``decode_step`` before each jitted decode step,
+#: ``retire``      before a finished request's blocks are freed.
+POINTS = ("attach", "admit", "prefill", "decode_step", "retire")
+
+#: What a fault does when it fires: ``raise`` throws :class:`FaultError`
+#: (a crash), ``delay`` sleeps ``delay_s`` (latency chaos — deadline
+#: and watchdog-margin tests), ``hang`` blocks until released (a wedged
+#: device / deadlocked step).
+ACTIONS = ("raise", "delay", "hang")
+
+
+class FaultError(RuntimeError):
+    """An injected failure.  ``point``/``scope``/``index`` identify the
+    exact scheduled fault that fired, so a chaos test can assert the
+    crash it observed is the crash it scheduled."""
+
+    def __init__(self, point: str, scope: str, index: int,
+                 detail: str = ""):
+        self.point = point
+        self.scope = scope
+        self.index = index
+        super().__init__(
+            f"injected fault at {scope}:{point} call #{index}"
+            + (f" ({detail})" if detail else ""))
+
+
+class Fault:
+    """One scheduled failure.  ``at`` is the 1-based invocation index of
+    ``point`` (within ``scope``) the fault fires on; ``every`` repeats
+    it each ``every`` further calls (``at=3, every=2`` fires on calls
+    3, 5, 7, ...).  ``scope=None`` matches every scope — a single-
+    engine test need not name its engine."""
+
+    __slots__ = ("point", "at", "action", "scope", "every", "delay_s")
+
+    def __init__(self, point: str, at: int, action: str = "raise", *,
+                 scope: Optional[str] = None, every: Optional[int] = None,
+                 delay_s: float = 0.0):
+        if point not in POINTS:
+            raise ValueError(f"unknown injection point {point!r}; "
+                             f"catalog: {POINTS}")
+        if action not in ACTIONS:
+            raise ValueError(f"unknown action {action!r}; "
+                             f"catalog: {ACTIONS}")
+        if at < 1:
+            raise ValueError(f"fault fires on a 1-based call index, "
+                             f"got at={at}")
+        if every is not None and every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.point = point
+        self.at = int(at)
+        self.action = action
+        self.scope = scope
+        self.every = every
+        self.delay_s = float(delay_s)
+
+    def matches(self, point: str, scope: str, index: int) -> bool:
+        if point != self.point:
+            return False
+        if self.scope is not None and scope != self.scope:
+            return False
+        if index == self.at:
+            return True
+        return (self.every is not None and index > self.at
+                and (index - self.at) % self.every == 0)
+
+    def __repr__(self):
+        where = self.point if self.scope is None \
+            else f"{self.scope}:{self.point}"
+        rep = f", every={self.every}" if self.every else ""
+        return (f"Fault({where}@{self.at}, {self.action}"
+                f"{rep})")
+
+
+class FaultSchedule:
+    """An ordered collection of :class:`Fault`.  Immutable once built —
+    a schedule is a test INPUT, and replaying a seed must replay the
+    exact schedule object state."""
+
+    def __init__(self, faults: Sequence[Fault] = ()):
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+
+    def __iter__(self):
+        return iter(self.faults)
+
+    def __len__(self):
+        return len(self.faults)
+
+    def __repr__(self):
+        return f"FaultSchedule({list(self.faults)!r})"
+
+    @classmethod
+    def seeded(cls, seed: int, *, n_faults: int = 3,
+               points: Sequence[str] = ("decode_step", "prefill",
+                                        "admit"),
+               max_at: int = 12,
+               scopes: Sequence[Optional[str]] = (None,),
+               actions: Sequence[str] = ("raise", "delay", "hang"),
+               delay_s: float = 0.002) -> "FaultSchedule":
+        """Derive a reproducible schedule from ``seed`` — the chaos
+        property test's generator.  Every choice flows through one
+        ``RandomState(seed)``, so the same seed always builds the same
+        schedule (and a failing seed is a complete repro).  Duplicate
+        ``(scope, point, at)`` draws collapse (the first wins), so a
+        schedule never stacks two actions on one call."""
+        rs = np.random.RandomState(seed)
+        seen = set()
+        faults: List[Fault] = []
+        for _ in range(n_faults):
+            point = points[int(rs.randint(len(points)))]
+            at = int(rs.randint(1, max_at + 1))
+            scope = scopes[int(rs.randint(len(scopes)))]
+            action = actions[int(rs.randint(len(actions)))]
+            key = (scope, point, at)
+            if key in seen:
+                continue
+            seen.add(key)
+            faults.append(Fault(point, at, action, scope=scope,
+                                delay_s=delay_s))
+        return cls(faults)
+
+
+class _Scoped:
+    """An injector view bound to one scope label — what the engine
+    actually holds, so its call sites never repeat the engine name."""
+
+    __slots__ = ("injector", "scope")
+
+    def __init__(self, injector: "FaultInjector", scope: str):
+        self.injector = injector
+        self.scope = scope
+
+    def fire(self, point: str) -> None:
+        self.injector.fire(point, scope=self.scope)
+
+
+class FaultInjector:
+    """The runtime half: counts invocations per ``(scope, point)`` and
+    performs scheduled faults.  Thread-safe — engine workers fire from
+    their own threads while the supervisor reads counters and releases
+    hangs.
+
+    ``max_hang_s`` bounds every injected hang: a hang the supervisor
+    never notices still unwinds (as a :class:`FaultError`) instead of
+    leaking a blocked thread — tests stay bounded even when the
+    watchdog under test is broken, which is exactly when it matters.
+    """
+
+    def __init__(self, schedule: FaultSchedule = FaultSchedule(), *,
+                 max_hang_s: float = 30.0):
+        self.schedule = schedule
+        self.max_hang_s = float(max_hang_s)
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._fired: List[dict] = []
+        self._release = threading.Event()
+        self._hanging = 0
+
+    # ----------------------------------------------------------- engine API
+
+    def scope(self, label: str) -> _Scoped:
+        """A view bound to one engine seat — restarted engines reuse
+        their seat's scope so counters (and one-shot faults already
+        spent) carry across the restart."""
+        return _Scoped(self, str(label))
+
+    def fire(self, point: str, scope: str = "engine0") -> None:
+        """One invocation of ``point`` within ``scope``: bump the
+        counter, then perform the first scheduled fault that matches.
+        Raises :class:`FaultError` for ``raise`` (and for a released or
+        timed-out ``hang``), sleeps for ``delay``, returns untouched
+        otherwise."""
+        if point not in POINTS:
+            raise ValueError(f"unknown injection point {point!r}; "
+                             f"catalog: {POINTS}")
+        with self._lock:
+            index = self._counts.get((scope, point), 0) + 1
+            self._counts[(scope, point)] = index
+            fault = next((f for f in self.schedule
+                          if f.matches(point, scope, index)), None)
+            if fault is not None:
+                self._fired.append({"point": point, "scope": scope,
+                                    "index": index,
+                                    "action": fault.action})
+            if fault is not None and fault.action == "hang":
+                # capture the CURRENT release event while still inside
+                # the lock: a release racing this fire must either see
+                # the waiter or hand it the already-set event
+                self._hanging += 1
+                release = self._release
+        if fault is None:
+            return
+        if fault.action == "raise":
+            raise FaultError(point, scope, index)
+        if fault.action == "delay":
+            import time
+            time.sleep(fault.delay_s)
+            return
+        # hang: block until the supervisor restarts us (release_hangs)
+        # or the safety bound elapses, then unwind as an injected error
+        # — the stale worker thread must exit, not resume into an
+        # engine seat that has already been handed to its replacement.
+        try:
+            released = release.wait(self.max_hang_s)
+        finally:
+            with self._lock:
+                self._hanging -= 1
+        raise FaultError(point, scope, index,
+                         "hang " + ("released" if released
+                                    else "timed out"))
+
+    # ------------------------------------------------------- supervisor API
+
+    def release_hangs(self) -> None:
+        """Unblock every currently injected hang (each unwinds as a
+        :class:`FaultError` in its worker thread).  The supervisor
+        calls this when restarting a hung engine; future hangs re-arm
+        automatically."""
+        with self._lock:
+            # swap under the lock: every waiter captured the old event
+            # inside this lock, so setting it after the swap reaches
+            # exactly the hangs that existed at release time — later
+            # hangs wait on the fresh, unset event
+            released, self._release = self._release, threading.Event()
+        released.set()
+
+    @property
+    def hanging(self) -> int:
+        """How many threads are currently blocked in an injected hang."""
+        with self._lock:
+            return self._hanging
+
+    def counts(self) -> Dict[Tuple[str, str], int]:
+        """Invocation counts per ``(scope, point)`` — the reproducible
+        clock fault schedules are written against."""
+        with self._lock:
+            return dict(self._counts)
+
+    def fired(self) -> List[dict]:
+        """The faults that actually fired, in order — what a chaos test
+        asserts its observed failures against."""
+        with self._lock:
+            return [dict(f) for f in self._fired]

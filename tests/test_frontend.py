@@ -1,0 +1,403 @@
+"""SLO-aware serving front-end (``frontend.py``) + fault injection
+(``testing/faults.py``) + the engine's backpressure/host-state
+satellites (``serving.py``).
+
+The load-bearing pins:
+
+* deterministic faults: a schedule fires on exact invocation counts,
+  seeded schedules replay from their seed, hangs are event-released;
+* backpressure is TYPED: the engine's ``QueueFull`` and the frontend's
+  ``SubmitRejected`` carry machine-routable reasons;
+* the single-engine, fault-free frontend path is byte-for-byte the
+  direct engine (greedy token streams identical, ``compiles ==
+  {'decode': 1}``);
+* supervision closes the loop: crash / hang / attach-failure chaos
+  ends with every request in EXACTLY ONE terminal status, retried
+  greedy streams bit-identical to the fault-free run, and no pool
+  accounting leaked across restarts (the seeded property test sweeps
+  schedules).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry
+from paddle_tpu.frontend import (COMPLETED, FAILED, QUEUED, SHED,
+                                 TERMINAL, ServingFrontend,
+                                 SubmitRejected)
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.serving import PagedServingEngine, QueueFull
+from paddle_tpu.testing.faults import (Fault, FaultError, FaultInjector,
+                                       FaultSchedule)
+
+CFG = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                        num_layers=1, ffn_mult=2, max_len=48)
+
+PROMPTS = [np.arange(1, 7, dtype=np.int32),
+           np.arange(3, 12, dtype=np.int32),
+           np.arange(2, 5, dtype=np.int32),
+           np.arange(5, 9, dtype=np.int32),
+           np.arange(1, 4, dtype=np.int32)]
+MAX_NEW = 8
+
+ENGINE_KW = dict(num_slots=2, num_blocks=24, block_size=4,
+                 prompt_buckets=(16,), decode_kernel=False, seed=0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+@pytest.fixture(scope="module")
+def direct_streams(params):
+    """Fault-free direct-engine streams — the bit-identity reference
+    for every frontend/chaos comparison in this file."""
+    eng = PagedServingEngine(CFG, params,
+                             metrics=telemetry.MetricsRegistry("ref"),
+                             **ENGINE_KW)
+    for p in PROMPTS:
+        eng.submit(p, MAX_NEW)
+    return eng.run()
+
+
+# ------------------------------------------------------------- faults unit
+
+
+def test_fault_matches_on_exact_index_and_every():
+    f = Fault("decode_step", 3, "raise")
+    assert not f.matches("decode_step", "e0", 2)
+    assert f.matches("decode_step", "e0", 3)
+    assert not f.matches("decode_step", "e0", 4)
+    assert not f.matches("prefill", "e0", 3)
+    rec = Fault("admit", 2, "delay", every=3, scope="e1")
+    assert [i for i in range(1, 12)
+            if rec.matches("admit", "e1", i)] == [2, 5, 8, 11]
+    assert not rec.matches("admit", "e0", 2)   # scoped to e1
+
+
+def test_fault_validation_is_loud():
+    with pytest.raises(ValueError):
+        Fault("not_a_point", 1)
+    with pytest.raises(ValueError):
+        Fault("admit", 0)
+    with pytest.raises(ValueError):
+        Fault("admit", 1, "explode")
+    with pytest.raises(ValueError):
+        Fault("admit", 1, every=0)
+    inj = FaultInjector()
+    with pytest.raises(ValueError):
+        inj.fire("not_a_point")
+
+
+def test_injector_counts_and_fires_deterministically():
+    inj = FaultInjector(FaultSchedule([
+        Fault("decode_step", 3, "raise", scope="engine0")]))
+    s0 = inj.scope("engine0")
+    s1 = inj.scope("engine1")
+    s0.fire("decode_step")
+    s1.fire("decode_step")                # other scope: independent
+    s1.fire("decode_step")
+    s1.fire("decode_step")                # index 3, but wrong scope
+    s0.fire("decode_step")
+    with pytest.raises(FaultError) as ei:
+        s0.fire("decode_step")            # engine0's third call
+    assert ei.value.point == "decode_step"
+    assert ei.value.scope == "engine0"
+    assert ei.value.index == 3
+    s0.fire("decode_step")                # one-shot: spent
+    assert inj.counts()[("engine0", "decode_step")] == 4
+    assert inj.fired() == [{"point": "decode_step", "scope": "engine0",
+                            "index": 3, "action": "raise"}]
+
+
+def test_injector_delay_and_seeded_schedule_replay():
+    inj = FaultInjector(FaultSchedule([
+        Fault("admit", 1, "delay", delay_s=0.05)]))
+    t0 = time.perf_counter()
+    inj.fire("admit")
+    assert time.perf_counter() - t0 >= 0.05
+    a = FaultSchedule.seeded(7, n_faults=5)
+    b = FaultSchedule.seeded(7, n_faults=5)
+    assert repr(a) == repr(b) and len(a) >= 1
+    assert repr(a) != repr(FaultSchedule.seeded(8, n_faults=5))
+
+
+def test_hang_is_event_released_and_bounded():
+    inj = FaultInjector(FaultSchedule([Fault("decode_step", 1, "hang")]),
+                        max_hang_s=30.0)
+    errs = []
+
+    def worker():
+        try:
+            inj.fire("decode_step")
+        except FaultError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    for _ in range(200):
+        if inj.hanging == 1:
+            break
+        time.sleep(0.005)
+    assert inj.hanging == 1
+    inj.release_hangs()
+    t.join(timeout=5.0)
+    assert not t.is_alive() and inj.hanging == 0
+    assert "released" in str(errs[0])
+    # and the timeout path unwinds on its own
+    inj2 = FaultInjector(FaultSchedule([Fault("admit", 1, "hang")]),
+                         max_hang_s=0.05)
+    with pytest.raises(FaultError, match="timed out"):
+        inj2.fire("admit")
+
+
+# ------------------------------------------------- engine satellites
+
+
+def test_engine_queue_full_backpressure_and_host_state(params):
+    reg = telemetry.MetricsRegistry("qf")
+    eng = PagedServingEngine(CFG, params, metrics=reg, max_queue=2,
+                             **ENGINE_KW)
+    hs = eng.host_state()
+    assert hs["submit_queue"] == {"depth": 0, "max_queue": 2}
+    assert hs["ledger"] == {"reserved_blocks": 0, "pinned_blocks": 0,
+                            "shared_blocks": 0, "pool_blocks": 24}
+    assert hs["last_step_wall"] is None
+    assert hs["last_step_seconds"] is None
+    eng.submit(PROMPTS[0], MAX_NEW)
+    eng.submit(PROMPTS[1], MAX_NEW)
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(PROMPTS[2], MAX_NEW)
+    assert ei.value.depth == 2 and ei.value.limit == 2
+    rej = reg.counter("serving_submit_rejects_total")
+    assert rej.value(reason="queue_full") == 1.0
+    out = eng.run()                        # the queued two still finish
+    assert sorted(out) == [0, 1]
+    hs = eng.host_state()
+    assert hs["submit_queue"]["depth"] == 0
+    assert hs["ledger"]["reserved_blocks"] == 0
+    assert hs["last_step_wall"] is not None
+    assert hs["last_step_seconds"] > 0.0
+
+
+def test_engine_fault_points_fire_in_host_loop(params):
+    inj = FaultInjector()                  # empty schedule: count only
+    eng = PagedServingEngine(CFG, params,
+                             metrics=telemetry.MetricsRegistry("fp"),
+                             faults=inj.scope("e0"), **ENGINE_KW)
+    eng.submit(PROMPTS[0], 4)
+    eng.run()
+    counts = inj.counts()
+    assert counts[("e0", "attach")] == 1
+    assert counts[("e0", "prefill")] == 1
+    assert counts[("e0", "retire")] == 1
+    assert counts[("e0", "decode_step")] >= 1
+    assert counts[("e0", "admit")] >= 1
+
+
+# ------------------------------------------------------ frontend fast path
+
+
+def test_frontend_fast_path_matches_direct_engine(params,
+                                                  direct_streams):
+    reg = telemetry.MetricsRegistry("fe-fast")
+    tr = telemetry.Tracer(name="fe-fast")
+    with ServingFrontend(CFG, params, num_engines=1, metrics=reg,
+                         tracer=tr, **ENGINE_KW) as fe:
+        rids = [fe.submit(p, MAX_NEW) for p in PROMPTS]
+        out = fe.run(timeout_s=120)
+        compiles = fe.compile_counts()
+        st = fe.stats()
+    for i, rid in enumerate(rids):
+        assert out[rid]["status"] == COMPLETED
+        assert np.array_equal(out[rid]["tokens"], direct_streams[i])
+    assert compiles == [{"decode": 1, "prefill": 1}]
+    assert st["completed"] == len(PROMPTS) and st["shed"] == 0 \
+        and st["failed"] == 0 and st["engine_restarts"] == 0
+    assert reg.counter("frontend_submitted_total").value() \
+        == float(len(PROMPTS))
+    assert reg.counter("frontend_completed_total").value() \
+        == float(len(PROMPTS))
+    names = {e["name"] for e in tr.events()}
+    assert "submit" in names
+
+
+def test_frontend_rejects_too_large_and_dead_deadline(params):
+    with ServingFrontend(CFG, params, num_engines=1,
+                         metrics=telemetry.MetricsRegistry("fe-rej"),
+                         **ENGINE_KW) as fe:
+        with pytest.raises(SubmitRejected) as ei:
+            fe.submit(np.arange(20, dtype=np.int32), 4)   # > bucket 16
+        assert ei.value.reason == "too_large"
+        with pytest.raises(SubmitRejected) as ei:
+            fe.submit(PROMPTS[0], MAX_NEW, deadline_s=0.0)
+        assert ei.value.reason == "deadline_unmeetable"
+        assert fe.stats()["submitted"] == 0
+
+
+def test_frontend_deadline_unmeetable_uses_live_telemetry(params):
+    with ServingFrontend(CFG, params, num_engines=1,
+                         metrics=telemetry.MetricsRegistry("fe-slo"),
+                         **ENGINE_KW) as fe:
+        for p in PROMPTS:
+            fe.submit(p, MAX_NEW)
+        fe.run(timeout_s=120)              # primes TTFT/step telemetry
+        with pytest.raises(SubmitRejected) as ei:
+            fe.submit(PROMPTS[0], MAX_NEW, deadline_s=1e-9)
+        assert ei.value.reason == "deadline_unmeetable"
+        # a generous deadline is admitted and met
+        rid = fe.submit(PROMPTS[0], MAX_NEW, deadline_s=60.0)
+        out = fe.run(timeout_s=120)
+        assert out[rid]["status"] == COMPLETED
+        assert not out[rid]["deadline_missed"]
+        assert fe.stats()["deadline_misses"] == 0
+
+
+def test_frontend_queue_full_sheds_lowest_priority_first(params):
+    reg = telemetry.MetricsRegistry("fe-prio")
+    with ServingFrontend(CFG, params, num_engines=1, max_queue=2,
+                         metrics=reg, **ENGINE_KW) as fe:
+        # no pump runs until run(): submissions stay frontend-queued
+        r0 = fe.submit(PROMPTS[0], 4, priority=1)
+        r1 = fe.submit(PROMPTS[1], 4, priority=2)
+        with pytest.raises(SubmitRejected) as ei:
+            fe.submit(PROMPTS[2], 4, priority=1)   # does not outrank
+        assert ei.value.reason == "queue_full"
+        r3 = fe.submit(PROMPTS[3], 4, priority=5)  # preempts lowest
+        assert fe.status(r0) == SHED
+        recs = fe.results()
+        assert recs[r0]["reason"] == "preempted"
+        assert fe.status(r1) == QUEUED and fe.status(r3) == QUEUED
+        assert reg.counter("frontend_shed_total").value(
+            reason="queue_full") == 1.0
+        assert reg.counter("frontend_shed_total").value(
+            reason="preempted") == 1.0
+        out = fe.run(timeout_s=120)        # survivors still complete
+        assert out[r1]["status"] == COMPLETED
+        assert out[r3]["status"] == COMPLETED
+
+
+def test_frontend_sheds_queued_requests_past_deadline(params):
+    # every engine construction fails: requests can never dispatch, so
+    # a deadlined request must be shed from the queue, not forgotten
+    inj = FaultInjector(FaultSchedule([
+        Fault("attach", 1, "raise", every=1)]))
+    reg = telemetry.MetricsRegistry("fe-exp")
+    with ServingFrontend(CFG, params, num_engines=1, metrics=reg,
+                         faults=inj, restart_backoff_s=0.01,
+                         restart_backoff_cap_s=0.05,
+                         **ENGINE_KW) as fe:
+        rid = fe.submit(PROMPTS[0], 4, deadline_s=0.2)
+        out = fe.run(timeout_s=30)
+        st = fe.stats()
+    assert out[rid]["status"] == SHED
+    assert out[rid]["reason"] == "deadline"
+    assert st["engine_restarts"] >= 1      # attach kept failing
+    assert reg.counter("frontend_engine_restarts_total").value(
+        cause="attach", engine="engine0") >= 1.0
+    assert reg.counter("frontend_shed_total").value(
+        reason="deadline") == 1.0
+
+
+# ------------------------------------------------------------ chaos
+
+
+def test_chaos_crash_hang_attach_replays_bit_identical(
+        params, direct_streams, tmp_path):
+    flight = tmp_path / "flight.json"
+    sched = FaultSchedule([
+        Fault("decode_step", 3, "raise", scope="engine0"),
+        Fault("decode_step", 6, "hang", scope="engine0"),
+        Fault("attach", 3, "raise", scope="engine0"),
+    ])
+    inj = FaultInjector(sched, max_hang_s=10.0)
+    reg = telemetry.MetricsRegistry("fe-chaos")
+    with ServingFrontend(CFG, params, num_engines=1, metrics=reg,
+                         faults=inj, hang_timeout_s=0.5,
+                         restart_backoff_s=0.01,
+                         restart_backoff_cap_s=0.05,
+                         flight_recorder=str(flight),
+                         **ENGINE_KW) as fe:
+        rids = [fe.submit(p, MAX_NEW) for p in PROMPTS]
+        out = fe.run(timeout_s=300)
+        st = fe.stats()
+        compiles = fe.compile_counts()
+        tr = fe.tracer
+    # every scheduled fault actually fired
+    assert [f["action"] for f in inj.fired()] == ["raise", "hang",
+                                                  "raise"]
+    # exactly-once terminal status, all completed, streams bit-identical
+    for i, rid in enumerate(rids):
+        assert out[rid]["status"] == COMPLETED
+        assert np.array_equal(out[rid]["tokens"], direct_streams[i])
+    assert st["completed"] == len(PROMPTS)
+    assert st["engine_restarts"] == 3      # crash + hang + attach
+    assert st["failed"] == 0 and st["shed"] == 0
+    # the replacement engine still compiled decode exactly once
+    assert compiles == [{"decode": 1, "prefill": 1}]
+    # supervision left its telemetry trail
+    assert reg.counter("frontend_engine_restarts_total").value(
+        cause="crash", engine="engine0") == 1.0
+    assert reg.counter("frontend_engine_restarts_total").value(
+        cause="hang", engine="engine0") == 1.0
+    assert reg.counter("frontend_retries_total").value() >= 1.0
+    names = {e["name"] for e in tr.events()}
+    assert {"engine_crash", "engine_hang", "retry"} <= names
+    assert flight.exists()                 # crash dump was written
+
+
+def _chaos_property(seed, params, direct_streams):
+    sched = FaultSchedule.seeded(
+        seed, n_faults=4,
+        points=("decode_step", "prefill", "admit", "retire"),
+        max_at=10, actions=("raise", "delay", "hang"))
+    inj = FaultInjector(sched, max_hang_s=1.5)
+    with ServingFrontend(CFG, params, num_engines=2,
+                         metrics=telemetry.MetricsRegistry(
+                             f"fe-prop{seed}"),
+                         faults=inj, hang_timeout_s=0.75,
+                         restart_backoff_s=0.01,
+                         restart_backoff_cap_s=0.05, max_retries=8,
+                         **ENGINE_KW) as fe:
+        rids = [fe.submit(p, MAX_NEW) for p in PROMPTS]
+        out = fe.run(timeout_s=300)        # a double-finalize would
+        st = fe.stats()                    # raise out of run()
+        states = fe.engine_states()
+    # exactly one terminal status per request
+    assert all(out[r]["status"] in TERMINAL for r in rids)
+    assert st["completed"] + st["shed"] + st["failed"] == len(rids)
+    # no deadlines + bounded one-shot faults: everything completes,
+    # and completed streams replay bit-identically
+    for i, rid in enumerate(rids):
+        assert out[rid]["status"] == COMPLETED, (seed, rid, out[rid])
+        assert np.array_equal(out[rid]["tokens"], direct_streams[i]), \
+            (seed, rid)
+    # no pool accounting leaked across restarts
+    for hs in states:
+        if hs is None:
+            continue
+        assert hs["ledger"]["reserved_blocks"] == 0
+        assert hs["queue_depth"] == 0
+        assert all(s is None for s in hs["slots"])
+        assert hs["compiles"].get("decode", 0) <= 1
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chaos_property_exactly_once_no_leaks(seed, params,
+                                              direct_streams):
+    _chaos_property(seed, params, direct_streams)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [2, 3, 4, 5, 6])
+def test_chaos_property_sweep(seed, params, direct_streams):
+    _chaos_property(seed, params, direct_streams)
