@@ -115,6 +115,77 @@ def _bench_shared_prefix(args, cfg, params, jax):
         tokens_per_s=round(gen / wall, 1))
 
 
+def _bench_spec(args, cfg, params, jax):
+    """``--spec K``: speculative-decoding engine benchmark.
+
+    Serves one greedy burst of ``--batch`` requests through the paged
+    engine twice IN THE SAME PROCESS — target-only first, then with
+    ``SpecConfig(k=K, draft_layers=--draft-layers)`` — and reports the
+    speculative ms/token next to the accept rate and tokens/step the
+    engine's own histograms measured, plus the target-only baseline
+    ms/token so the row carries its own speedup denominator.  Greedy
+    speculative streams are bit-identical to target-only decode (the
+    tier-1 contract); the burst asserts it, so both timings cover
+    token-for-token identical work."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+
+    n, plen, steps = args.batch, args.prompt, args.steps
+    bs = args.block_size
+    slots = min(n, 8)
+    # +K slack per request: a verify step reserves up to K+1 positions
+    # before the rejected tail rolls back to the committed cursor
+    pool = args.pool_blocks or \
+        slots * -(-(plen + steps + args.spec) // bs) + 4
+    kern = {"auto": None, "on": True, "off": False}[args.paged_kernel]
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, args.vocab, plen).astype(np.int32)
+               for _ in range(n)]
+
+    def drive(spec):
+        eng = PagedServingEngine(
+            cfg, params, num_slots=slots, num_blocks=pool,
+            block_size=bs, prompt_buckets=(plen,),
+            decode_kernel=kern, spec=spec, seed=0)
+        for p in prompts[:2]:     # warm-up: compile every program
+            eng.submit(p, max_new=4)
+        eng.run()
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p, max_new=steps)
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        return eng, out, wall
+
+    base_eng, base_out, base_wall = drive(None)
+    eng, out, wall = drive(SpecConfig(k=args.spec,
+                                      draft_layers=args.draft_layers))
+    streams = [list(map(int, out[r])) for r in sorted(out)]
+    assert streams == [list(map(int, base_out[r]))
+                       for r in sorted(base_out)], \
+        "greedy speculative streams diverged from target-only decode"
+    gen = sum(len(v) for v in streams)
+    base_gen = max(sum(len(v) for v in base_out.values()), 1)
+    sp = eng.stats()["spec"]
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} b{n} "
+               f"prompt{plen} spec{args.spec} draft{args.draft_layers}",
+        value=round(wall * 1e3 / max(gen, 1), 3),
+        unit="ms",                        # ms per committed token
+        backend=jax.default_backend(),
+        decoder="engine",
+        compiles=eng.compile_counts(),    # decode/verify/draft each 1
+        spec_k=args.spec,
+        draft_layers=args.draft_layers,
+        accept_rate=round(sp["accept_rate"]["avg"] or 0.0, 4),
+        tokens_per_step=round(sp["tokens_per_step"]["avg"] or 0.0, 3),
+        paged_kernel=bool(eng.decode_kernel),
+        block_size=bs,
+        pool_blocks=pool,
+        baseline_ms_per_token=round(base_wall * 1e3 / base_gen, 3),
+        tokens_per_s=round(gen / wall, 1))
+
+
 def _bench_frontend(args, cfg, params, jax):
     """``--frontend --engines N``: SLO front-end serving benchmark.
 
@@ -253,6 +324,20 @@ def main():
                          "the row reports miss vs hit TTFT/prefill "
                          "spans and prefix_hit_tokens instead of the "
                          "differential step time; requires --paged")
+    ap.add_argument("--spec", type=int, default=0, metavar="K",
+                    help="speculative decoding through the paged "
+                         "serving ENGINE: a truncated-layer draft "
+                         "proposes K tokens per slot per step and one "
+                         "batched verify step scores all K+1 positions "
+                         "over the paged cache — the row reports "
+                         "ms/token with accept_rate and tokens_per_step "
+                         "next to a target-only baseline ms/token from "
+                         "the same process (greedy streams asserted "
+                         "bit-identical); requires --paged")
+    ap.add_argument("--draft-layers", type=int, default=1, metavar="N",
+                    help="layers kept by the truncated-layer draft "
+                         "(with --spec); N == --layers is the "
+                         "self-draft parity case (accept rate 1.0)")
     ap.add_argument("--frontend", action="store_true",
                     help="serve the burst through the SLO-aware "
                          "ServingFrontend (frontend.py): --engines "
@@ -301,6 +386,14 @@ def main():
     if args.frontend and args.shared_prefix:
         ap.error("--frontend and --shared-prefix are separate rows; "
                  "pick one")
+    if args.spec and not args.paged:
+        ap.error("--spec requires --paged (speculative decoding lives "
+                 "in the paged serving engine)")
+    if args.spec and (args.frontend or args.shared_prefix):
+        ap.error("--spec is its own row; drop "
+                 "--frontend/--shared-prefix")
+    if args.spec and args.draft_layers > args.layers:
+        ap.error("--draft-layers cannot exceed --layers")
     if args.engines < 1:
         ap.error("--engines must be >= 1")
 
@@ -356,6 +449,15 @@ def main():
             params = serving_cast(params)
         if args.frontend:
             row = _bench_frontend(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
+        if args.spec:
+            row = _bench_spec(args, cfg, params, jax)
             from paddle_tpu import telemetry
             if args.telemetry_out:
                 telemetry.append_jsonl(

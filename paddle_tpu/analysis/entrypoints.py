@@ -309,3 +309,28 @@ def _paged_engine_decode_kernel() -> LintTarget:
         recipe=_dp_recipe(7, (), "replicated under the mesh — slot "
                           "vectors could dp-shard, but GSPMD cannot "
                           "partition the pallas_call they feed"))
+
+
+@register_entrypoint("paged-engine-decode-spec")
+def _paged_engine_decode_spec() -> LintTarget:
+    # The speculative-decoding VERIFY step: one chunked-attention
+    # program scores all k+1 candidate positions per slot and appends
+    # their KVs optimistically (the host rolls back rejects).  Linting
+    # it proves the multi-token verify keeps the decode-loop
+    # discipline: per-layer chunked gathers (amortized over the k+1
+    # queries), in-graph reserve/COW, no host callbacks — the accept/
+    # reject decision stays strictly on the host side.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,),
+                             spec=SpecConfig(k=2, draft_layers=1))
+    S, k = eng.S, eng.spec_k
+    return LintTarget(
+        "paged-engine-decode-spec", eng._verify,
+        (eng.params, eng.cache, jnp.zeros((S, k + 1), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32)),
+        recipe=_dp_recipe(5, eng._verify_slot_args,
+                          "dp over slot-major verify inputs (toks/"
+                          "valid/temps); pool + block tables "
+                          "replicated exactly as the decode twin"))

@@ -187,10 +187,13 @@ class ServingFrontend:
     """Supervise ``num_engines`` paged serving engines as ONE service.
 
     Construction mirrors :class:`~paddle_tpu.serving.PagedServingEngine`
-    (``num_slots`` .. ``prefix_cache`` are forwarded to every seat's
-    engine, each built with the SAME ``seed`` so a replacement engine
-    is the journal-replay twin of the one it replaces).  Frontend-level
-    knobs:
+    (``num_slots`` .. ``prefix_cache`` and ``spec`` — a
+    :class:`~paddle_tpu.serving.SpecConfig` turns on speculative
+    decoding — are forwarded to every seat's engine, each built with
+    the SAME ``seed`` so a replacement engine is the journal-replay
+    twin of the one it replaces; deadline/admission prediction then
+    reads each seat's live tokens-per-step rate, see
+    :meth:`_service_estimate_locked`).  Frontend-level knobs:
 
     ``max_queue``
         Bound on frontend-queued requests (``None`` = unbounded).  At
@@ -236,7 +239,7 @@ class ServingFrontend:
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
                  decode_kernel=None, prefix_cache: bool = False,
-                 engine_max_queue: Optional[int] = None,
+                 spec=None, engine_max_queue: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  hang_timeout_s: float = 10.0,
                  first_step_grace_s: float = 120.0,
@@ -282,7 +285,7 @@ class ServingFrontend:
             prompt_buckets=prompt_buckets, eos_id=eos_id, top_k=top_k,
             top_p=top_p, attn_fn=attn_fn, seed=seed,
             decode_kernel=decode_kernel, prefix_cache=prefix_cache,
-            max_queue=engine_max_queue)
+            spec=spec, max_queue=engine_max_queue)
 
         self._lock = threading.RLock()
         self._requests: Dict[int, _FrontendRequest] = {}   # the journal
@@ -453,15 +456,28 @@ class ServingFrontend:
         from its live telemetry: prefill ≈ avg(TTFT) - avg(queue wait),
         decode ≈ max_new × avg(per-token) (falling back to avg step
         time, then the seat's completed-request EMA).  Cold seats
-        estimate 0 — admission stays open until there is evidence."""
+        estimate 0 — admission stays open until there is evidence.
+
+        SPECULATIVE engines commit more than one token per step, so
+        the step-time fallback divides by the seat's LIVE
+        tokens-per-step rate (``serving_spec_tokens_per_step``) —
+        assuming 1 token/step would overshoot every estimate by the
+        acceptance speedup and shed load the engine could serve.  The
+        primary per-token signal (``serving_time_per_output_token``)
+        is wall-time over tokens at retire, already spec-correct."""
         reg = seat.registry
         ttft = reg.histogram("serving_ttft_seconds").summary()
         qw = reg.histogram("serving_queue_wait_seconds").summary()
         tpot = reg.histogram(
             "serving_time_per_output_token_seconds").summary()
         step = reg.histogram("serving_step_seconds").summary()
+        # reg.get, not reg.histogram: the engine registers this with
+        # k-dependent buckets; a non-spec seat simply lacks it
+        tps_h = reg.get("serving_spec_tokens_per_step")
+        tps = (tps_h.summary()["avg"] if tps_h is not None
+               else None) or 1.0
         prefill = max(0.0, (ttft["avg"] or 0.0) - (qw["avg"] or 0.0))
-        per_tok = tpot["avg"] or step["avg"] or 0.0
+        per_tok = tpot["avg"] or ((step["avg"] or 0.0) / max(tps, 1.0))
         est = prefill + per_tok * max_new
         if est <= 0.0 and seat.avg_service_s is not None:
             est = seat.avg_service_s

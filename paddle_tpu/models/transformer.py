@@ -278,24 +278,23 @@ def _cached_lm(cfg: TransformerConfig, attn_fn):
     return model, make_caches
 
 
-def _sampling_picker(cfg: TransformerConfig, temp, out_dtype, eos_id,
-                     top_k, top_p):
-    """Shared next-token chooser for the cached decoders
-    (:func:`lm_generate_builder` / :func:`lm_serve_builder`): greedy at
-    ``temp`` 0, else ``softmax(logits/temp)`` sampling restricted by
-    top-k then top-p, with the eos row-freeze convention.  One home so
-    the two decode loops cannot drift numerically."""
+def _restrict_logits(cfg: TransformerConfig, top_k, top_p):
+    """Top-k-then-top-p restriction over [b, V] f32 logits — the
+    sampling-support mask shared by :func:`_sampling_picker` and the
+    speculative decoder (``paddle_tpu/speculative.py``): the verify
+    step's target distribution and the draft's proposal distribution
+    MUST be ``softmax(restrict(logits / temp))`` with exactly these
+    masks, or rejection sampling would correct toward the wrong
+    distribution.  One home, one set of numerics.
+
+    Rejected tokens are masked with -inf, not beam search's finite
+    NEG_INF: these logits were already divided by temperature, and at
+    small temperatures a finite mask is reachable by kept logits
+    (rejected tokens would regain probability).
+    ``jax.random.categorical`` handles -inf rows; no additive score
+    accumulation happens here."""
 
     def restrict(logits):
-        """Apply top-k then top-p to [b, V] f32 logits.
-
-        Rejected tokens are masked with -inf, not beam search's
-        finite NEG_INF: these logits were already divided by
-        temperature, and at small temperatures a finite mask is
-        reachable by kept logits (rejected tokens would regain
-        probability).  ``jax.random.categorical`` handles -inf rows;
-        no additive score accumulation happens here.
-        """
         if top_k is not None and top_k < cfg.vocab_size:
             kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -309,6 +308,20 @@ def _sampling_picker(cfg: TransformerConfig, temp, out_dtype, eos_id,
             thr = jnp.take_along_axis(srt, n_keep - 1, axis=-1)
             logits = jnp.where(logits < thr, -jnp.inf, logits)
         return logits
+
+    return restrict
+
+
+def _sampling_picker(cfg: TransformerConfig, temp, out_dtype, eos_id,
+                     top_k, top_p):
+    """Shared next-token chooser for the cached decoders
+    (:func:`lm_generate_builder` / :func:`lm_serve_builder`): greedy at
+    ``temp`` 0, else ``softmax(logits/temp)`` sampling restricted by
+    top-k then top-p (:func:`_restrict_logits`), with the eos
+    row-freeze convention.  One home so the decode loops cannot drift
+    numerically."""
+
+    restrict = _restrict_logits(cfg, top_k, top_p)
 
     def pick(logits, key, done):
         logits = logits.astype(jnp.float32)

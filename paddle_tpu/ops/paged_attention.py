@@ -346,6 +346,41 @@ def paged_cow(cache: PagedKVCache, want):
                         lambda c: (c, jnp.asarray(True)), cache)
 
 
+def paged_rollback(cache: PagedKVCache, new_lengths) -> PagedKVCache:
+    """Truncate each slot's committed-token cursor to ``new_lengths``
+    [num_slots] int32 — the SPECULATIVE-DECODE rejection path
+    (``paddle_tpu/speculative.py``): a verify step appends k+1 tokens
+    optimistically, the host accepts a prefix, and the rejected suffix
+    rolls back here as a POINTER TRUNCATION, never a copy.
+
+    Blocks past ``ceil(new_len / block_size)`` unmap (table entry back
+    to ``-1``) and their refcounts DECREMENT by one — a rolled-back
+    block returns to the pool only when this slot was its last owner;
+    blocks shared with other slots or pinned by the prefix registry
+    survive with rc >= 1, exactly the :func:`paged_free` contract.  The
+    kept cursor block's stale K/V rows past ``new_len`` are unreachable
+    (attention masks to ``lengths``) and the next append overwrites
+    them — same garbage-row reuse contract as the rest of the pool.
+    ``new_lengths`` above a slot's current length clamps to a no-op, so
+    inactive slots pass their current length unchanged."""
+    S, maxb = cache.block_tables.shape
+    nb = cache.num_blocks
+    bs = cache.block_size
+    new_len = jnp.minimum(cache.lengths,
+                          jnp.asarray(new_lengths, jnp.int32))
+    keep = jnp.minimum((new_len + bs - 1) // bs, cache.blocks_used)
+    cols = jnp.arange(maxb)[None, :]
+    drop = (cols >= keep[:, None]) & (cols < cache.blocks_used[:, None])
+    ids = jnp.where(drop, cache.block_tables, nb)
+    dec = jnp.zeros((nb,), jnp.int32).at[ids.reshape(-1)].add(
+        drop.reshape(-1).astype(jnp.int32), mode="drop")
+    return cache._replace(
+        refcounts=jnp.maximum(cache.refcounts - dec, 0),
+        block_tables=jnp.where(drop, -1, cache.block_tables),
+        lengths=new_len,
+        blocks_used=keep)
+
+
 def layer_views(cache: PagedKVCache, slot_ids, append_valid):
     """Per-layer :class:`PagedLayerView` list for a model call over
     batch rows ``slot_ids`` [b] appending ``append_valid`` [b] tokens."""
@@ -451,6 +486,68 @@ def resolve_decode_kernel(select, *, block_size: int, num_heads: int,
     return bool(select and supported)
 
 
+#: Typed reasons a kernel-selected decode-attention call dispatched to
+#: the XLA form anyway — the values ``serving_kernel_fallback_total``
+#: labels by.  ``multi_token_query``: the Pallas kernel serves t=1
+#: decode queries only, so chunked/verify steps (t>1) take the gather
+#: form by design.  ``traced_scale``: the kernel closes over a static
+#: scale; a traced scalar cannot specialize it.  ``unsupported_shape``:
+#: the shape is past the kernel's VMEM budget (resolve_decode_kernel
+#: would also have resolved False at build time).
+KERNEL_FALLBACK_REASONS = ("multi_token_query", "traced_scale",
+                           "unsupported_shape")
+
+_fallback_observer = threading.local()
+
+
+@contextlib.contextmanager
+def kernel_fallback_scope(observer):
+    """Install a host observer fired AT TRACE TIME with a typed reason
+    (one of :data:`KERNEL_FALLBACK_REASONS`) whenever a KERNEL-SELECTED
+    decode-attention call dispatches to the XLA form anyway.  Dispatch
+    happens while tracing, so the observer fires once per compiled
+    program per fallback site — strictly host-side, invisible to the
+    traced bytes (the lint gate pins it).  With no scope installed, or
+    with the kernel not selected, nothing fires: the XLA form is then
+    the CHOICE, not a fallback."""
+    prev = getattr(_fallback_observer, "value", None)
+    _fallback_observer.value = observer
+    try:
+        yield
+    finally:
+        _fallback_observer.value = prev
+
+
+def _note_fallback(reason) -> None:
+    if reason is None:
+        return
+    obs = getattr(_fallback_observer, "value", None)
+    if obs is not None:
+        obs(reason)
+
+
+def _fallback_reason(q, k_pages, scale):
+    """Why a kernel-selected call is NOT taking the kernel — a typed
+    reason string, or ``None`` when the kernel was never selected (the
+    XLA form is then the configured choice, not a silent fallback)."""
+    select = getattr(_decode_kernel_override, "value", None)
+    if not select:
+        return None
+    from paddle_tpu.ops.pallas_paged_attention import (
+        paged_attention_supported)
+    if not paged_attention_supported(k_pages.shape[1], k_pages.shape[2],
+                                     k_pages.shape[3], k_pages.dtype):
+        return "unsupported_shape"
+    if q.shape[1] != 1:
+        return "multi_token_query"
+    if scale is not None:
+        try:
+            float(scale)
+        except Exception:
+            return "traced_scale"
+    return None
+
+
 def _use_kernel(q, k_pages, scale) -> bool:
     """Trace-time dispatch decision for :func:`paged_decode_attention`."""
     if q.shape[1] != 1:
@@ -489,6 +586,7 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
             paged_decode_attention_kernel)
         return paged_decode_attention_kernel(q, k_pages, v_pages,
                                              block_table, lengths, scale)
+    _note_fallback(_fallback_reason(q, k_pages, scale))
     return _paged_decode_attention_xla(q, k_pages, v_pages, block_table,
                                        lengths, scale)
 
@@ -553,8 +651,12 @@ def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
     scale = (hd ** -0.5) if scale is None else scale
+    # a kernel-selected caller (the speculative VERIFY step) lands here
+    # because the kernel serves t=1 only — surface the typed reason
+    _note_fallback(_fallback_reason(q, k_pages, scale))
     table = jnp.clip(block_table, 0, nb - 1)
-    # tpu-lint: disable=gather-in-decode — chunked TAIL PREFILL, not a decode step: one gather per admitted prefix hit, amortized over the whole request
+    # tpu-lint: disable=gather-in-decode — chunked TAIL PREFILL / speculative VERIFY, not a per-token decode step: one gather covers t tokens, amortized
+
     k = k_pages[table].reshape(b, maxb * bs, h, hd)
     # tpu-lint: disable=gather-in-decode — V half of the tail-prefill gather above
     v = v_pages[table].reshape(b, maxb * bs, h, hd)

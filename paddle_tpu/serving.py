@@ -55,16 +55,19 @@ from paddle_tpu.core.errors import enforce
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.models.transformer import (TransformerConfig,
                                            TransformerLM,
+                                           _restrict_logits,
                                            _sampling_picker)
 from paddle_tpu.ops import paged_attention as paged
 from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
                                             paged_hbm_bytes)
 from paddle_tpu.prefix_cache import PrefixCache
+from paddle_tpu import speculative as spec_mod
+from paddle_tpu.speculative import SpecConfig, TruncatedDraft
 from paddle_tpu import telemetry
 import paddle_tpu.nn as nn
 
 __all__ = ["paged_serve_builder", "PagedServingEngine", "QueueFull",
-           "paged_hbm_bytes", "dense_hbm_bytes"]
+           "SpecConfig", "paged_hbm_bytes", "dense_hbm_bytes"]
 
 
 class QueueFull(RuntimeError):
@@ -99,7 +102,7 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
                         block_size: int = 16,
                         max_blocks_per_slot: Optional[int] = None,
                         num_blocks: Optional[int] = None,
-                        decode_kernel=None):
+                        decode_kernel=None, draft=None):
     """Serving-shaped PAGED decode: ``lm_serve_builder``'s contract
     (traced ``steps``, one compiled program per prompt bucket, eos
     early exit, PAD past each row's end) over the block-pool cache.
@@ -134,7 +137,37 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     ``False`` = force the gather form.  The resolved bool is exposed as
     ``serve.decode_kernel`` for telemetry rows; either way the program
     still compiles exactly once per bucket.
+
+    ``draft`` builds the DRAFT TWIN of the target from the same
+    machinery (the speculative-decoding proposer —
+    ``paddle_tpu/speculative.py``): an int ``N`` returns a serve whose
+    program runs the target's bottom ``N`` layers (``serve(params,
+    ...)`` still takes the FULL target params; they are sliced by
+    :func:`~paddle_tpu.speculative.truncate_lm_params` per call — no
+    copies), a :class:`~paddle_tpu.speculative.DraftModel` returns a
+    serve over its config (pass its own params).  Either way the
+    truncated config is exposed as ``serve.draft_cfg`` — how
+    benchmarks time the proposer in isolation and how custom drafts
+    reuse the paged program machinery.  The FULL speculative pipeline
+    (draft + batched verify + rollback) is the engine's
+    ``spec=SpecConfig(...)`` knob.
     """
+    dslice = None
+    if draft is not None:
+        import dataclasses as _dc
+        from paddle_tpu.speculative import truncate_lm_params
+        if isinstance(draft, (int, np.integer)):
+            enforce(1 <= int(draft) <= cfg.num_layers,
+                    "paged_serve_builder: draft=%s layers outside "
+                    "[1, %s]", draft, cfg.num_layers)
+            cfg = _dc.replace(cfg, num_layers=int(draft))
+            dslice = functools.partial(truncate_lm_params,
+                                       num_layers=int(draft))
+        else:
+            enforce(draft.cfg.vocab_size == cfg.vocab_size,
+                    "paged_serve_builder: draft vocab %s != target "
+                    "vocab %s", draft.cfg.vocab_size, cfg.vocab_size)
+            cfg = draft.cfg
     model = _paged_model(cfg, attn_fn)
     hd = cfg.dim // cfg.num_heads
     bs = block_size
@@ -233,6 +266,8 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
 
     def serve(params, prompt_ids, steps, temperature=0.0, rng=None,
               eos_id=None, top_k=None, top_p=None, prompt_lens=None):
+        if dslice is not None:
+            params = dslice(params)       # target params -> draft twin
         b, tp = prompt_ids.shape
         max_new = cap - tp
         if isinstance(steps, (int, np.integer)):
@@ -277,6 +312,7 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     serve.block_size = bs
     serve.max_blocks_per_slot = maxb
     serve.decode_kernel = use_kernel   # resolved choice, for bench rows
+    serve.draft_cfg = cfg if draft is not None else None
     return serve
 
 
@@ -337,6 +373,27 @@ class PagedServingEngine:
     COW transform but still compiles exactly once; with the flag off
     (default) the traced programs are unchanged.
 
+    ``spec=SpecConfig(k=...)`` turns on SPECULATIVE DECODING
+    (``paddle_tpu/speculative.py``): a draft model (``draft=`` — any
+    :class:`~paddle_tpu.speculative.DraftModel`; default the target's
+    own bottom ``spec.draft_layers`` layers via
+    :class:`~paddle_tpu.speculative.TruncatedDraft`) proposes up to
+    ``k`` tokens per slot from its OWN paged cache, the target scores
+    all ``k + 1`` positions in ONE batched verify step
+    (``paged_chunked_attention`` — the multi-token form with per-query
+    causal bounds), host-side accept/reject commits a prefix
+    (greedy = longest-prefix match, BIT-IDENTICAL to the spec-off
+    engine; sampled = rejection sampling with the target's own
+    restricted/tempered distributions, distribution-identical), and
+    the rejected suffix ROLLS BACK by truncating the slot's
+    block-table cursor (``paged_rollback`` — a pointer truncation that
+    respects refcounts, so prefix sharing composes).  Per-slot verify
+    windows shrink near ``max_new`` so transient cache lengths never
+    exceed the admission reservation, and a step where every live slot
+    needs exactly one more token runs the PLAIN decode program — the
+    compile contract with speculation on is ``{'decode': 1, 'verify':
+    1, 'draft': 1}`` (plus one draft-prefill compile per bucket used).
+
     The engine is deeply instrumented through ``paddle_tpu.telemetry``
     (``metrics=`` takes a :class:`~paddle_tpu.telemetry.MetricsRegistry`;
     default: the process-wide one): queue-wait / TTFT /
@@ -382,7 +439,8 @@ class PagedServingEngine:
                  flight_recorder: Optional[str] = None,
                  flight_window_s: float = 30.0, decode_kernel=None,
                  prefix_cache: bool = False,
-                 max_queue: Optional[int] = None, faults=None):
+                 max_queue: Optional[int] = None, faults=None,
+                 spec: Optional[SpecConfig] = None, draft=None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -418,8 +476,13 @@ class PagedServingEngine:
         self.prefix_enabled = sharing
 
         def decode_fn(params, cache, tok, active, temps, done, key):
-            # the scope pins decode-attention dispatch at trace time
-            with paged.decode_kernel_scope(use_kernel):
+            # the scopes pin decode-attention dispatch at trace time;
+            # the fallback observer fires (once per compile, host-side)
+            # when a kernel-selected program takes the XLA form anyway,
+            # feeding serving_kernel_fallback_total{reason=...}
+            with paged.decode_kernel_scope(use_kernel), \
+                    paged.kernel_fallback_scope(
+                        self._note_kernel_fallback):
                 act = active.astype(jnp.int32)
                 if sharing:
                     # un-share each appending slot's cursor block
@@ -525,6 +588,150 @@ class PagedServingEngine:
                                    donate_argnums=(0,))
             watched["prefill_tail"] = self._prefill_tail
             watched["share"] = self._share
+        self.spec = spec
+        self.spec_k = None
+        self.draft = None
+        if spec is not None:
+            enforce(isinstance(spec, SpecConfig),
+                    "spec must be a SpecConfig, got %r", type(spec))
+            if draft is None:
+                draft = TruncatedDraft(cfg, params, spec.draft_layers)
+            enforce(draft.cfg.vocab_size == cfg.vocab_size,
+                    "draft vocab %s != target vocab %s — the accept "
+                    "rule compares distributions over one vocabulary",
+                    draft.cfg.vocab_size, cfg.vocab_size)
+            self.draft = draft
+            self._draft_params = draft.params
+            k = int(spec.k)
+            self.spec_k = k
+            dmodel = _paged_model(draft.cfg, attn_fn)
+            restrict = _restrict_logits(cfg, top_k, top_p)
+            V = cfg.vocab_size
+            arange_s = jnp.arange(S)
+
+            def _propose(lg_row, temps, sub):
+                # the draft's proposal rule mirrors _sampling_picker
+                # exactly (greedy from RAW f32 argmax, sampling from
+                # the restricted/tempered distribution) and returns q
+                # itself — rejection sampling needs the proposal
+                # distribution, not just the token
+                lf = lg_row.astype(jnp.float32)           # [S, V]
+                greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+                scaled = restrict(
+                    lf / jnp.maximum(temps, 1e-6)[:, None])
+                sampled = jax.random.categorical(
+                    sub, scaled, axis=-1).astype(jnp.int32)
+                tok = jnp.where(temps > 0, sampled, greedy)
+                return tok, jax.nn.softmax(scaled, axis=-1)
+
+            def draft_fn(dparams, dcache, pend, pend_len, temps, key):
+                # ONE program per spec step: a chunked catch-up append
+                # of the 1-2 pending committed tokens (committed to the
+                # stream last step but not yet in the draft cache)
+                # yields proposal d_1, then k-1 unrolled t=1 decode
+                # steps propose the rest.  The t=1 steps take the
+                # Pallas kernel when resolved; the t=2 catch-up is
+                # chunked, and the observer records its typed fallback.
+                with paged.decode_kernel_scope(use_kernel), \
+                        paged.kernel_fallback_scope(
+                            self._note_kernel_fallback):
+                    keys = jax.random.split(key, k)
+                    dcache, ok = paged.paged_reserve(dcache, pend_len)
+                    views = paged.chunked_layer_views(dcache, arange_s,
+                                                      pend_len)
+                    pos_ids = (dcache.lengths[:, None]
+                               + jnp.arange(2)[None, :])
+                    (lg, views), _ = dmodel.apply(dparams, {}, None,
+                                                  pend, views, pos_ids)
+                    dcache = paged.paged_advance(
+                        paged.merge_views(dcache, views), pend_len)
+                    last = jnp.take_along_axis(
+                        lg, jnp.maximum(pend_len - 1, 0)[:, None, None],
+                        axis=1)[:, 0]
+                    tok, q = _propose(last, temps, keys[0])
+                    drafts, qs = [tok], [q]
+                    for i in range(1, k):
+                        stp = (pend_len > 0).astype(jnp.int32)
+                        dcache, ok_i = paged.paged_reserve(dcache, stp)
+                        views = paged.layer_views(dcache, arange_s, stp)
+                        (lg, views), _ = dmodel.apply(
+                            dparams, {}, None, tok[:, None], views,
+                            dcache.lengths[:, None])
+                        dcache = paged.paged_advance(
+                            paged.merge_views(dcache, views), stp)
+                        ok = ok & ok_i
+                        tok, q = _propose(lg[:, -1], temps, keys[i])
+                        drafts.append(tok)
+                        qs.append(q)
+                    return (dcache, jnp.stack(drafts, axis=1),
+                            jnp.stack(qs, axis=1), ok)
+
+            def verify_fn(params, cache, toks, valid, temps):
+                # the multi-token VERIFY: one chunked-attention step
+                # scores all k+1 positions per slot (position j
+                # conditions on the committed stream plus drafts[:j]
+                # via paged_chunked_attention's per-query causal
+                # bound), appending the candidate KVs optimistically —
+                # the host truncates the rejected suffix with
+                # paged_rollback.  COW first when sharing: a rollback
+                # into a shared block must never leave behind a write
+                # its other readers can see.
+                with paged.decode_kernel_scope(use_kernel), \
+                        paged.kernel_fallback_scope(
+                            self._note_kernel_fallback):
+                    if sharing:
+                        cache, cok = paged.paged_cow(cache, valid)
+                    cache, ok = paged.paged_reserve(cache, valid)
+                    views = paged.chunked_layer_views(cache, arange_s,
+                                                      valid)
+                    pos_ids = (cache.lengths[:, None]
+                               + jnp.arange(k + 1)[None, :])
+                    (lg, views), _ = model.apply(params, {}, None, toks,
+                                                 views, pos_ids)
+                    cache = paged.paged_advance(
+                        paged.merge_views(cache, views), valid)
+                    lf = lg.astype(jnp.float32)           # [S, k+1, V]
+                    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+                    tcol = jnp.maximum(temps, 1e-6)[:, None, None]
+                    probs = jax.nn.softmax(restrict(
+                        (lf / tcol).reshape(S * (k + 1), V)),
+                        axis=-1).reshape(S, k + 1, V)
+                    if sharing:
+                        ok = ok & cok
+                    return cache, greedy, probs, ok
+
+            def draft_prefill_fn(dparams, dcache, slot, prompt, plen):
+                # the draft sees the FULL prompt even when the target's
+                # admission was a prefix-cache hit: the draft pool has
+                # no registry, and proposal quality is all this buys
+                with paged.decode_kernel_scope(use_kernel):
+                    want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
+                    dcache, ok = paged.paged_reserve(dcache, want)
+                    views = paged.layer_views(dcache, slot[None],
+                                              plen[None])
+                    w = prompt.shape[1]
+                    pos_ids = jnp.arange(w)[None, :]
+                    (_, views), _ = dmodel.apply(dparams, {}, None,
+                                                 prompt, views, pos_ids)
+                    dcache = paged.paged_advance(
+                        paged.merge_views(dcache, views), want)
+                    return dcache, ok
+
+            self._draft = jax.jit(draft_fn, donate_argnums=(1,))
+            self._verify = jax.jit(verify_fn, donate_argnums=(1,))
+            self._draft_prefill = jax.jit(draft_prefill_fn,
+                                          donate_argnums=(1,))
+            self._rollback = jax.jit(paged.paged_rollback,
+                                     donate_argnums=(0,))
+            # shard-check contract (paged-engine-decode-spec): verify
+            # args 2..4 (toks, valid, temps) are slot-major — shard
+            # them on the data axis, params + pool replicated (same
+            # rationale as _decode_slot_args)
+            self._verify_slot_args = (2, 3, 4)
+            watched["draft"] = self._draft
+            watched["verify"] = self._verify
+            watched["draft_prefill"] = self._draft_prefill
+            watched["rollback"] = self._rollback
         from paddle_tpu.analysis.watch import CompileWatcher
         self._compile_watch = CompileWatcher(**watched)
         self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
@@ -547,6 +754,24 @@ class PagedServingEngine:
         # prompt buckets downward; one tail-prefill compile per width
         # actually used
         self._tail_buckets = tuple(sorted({1, self.bs, *self.buckets}))
+        if spec is not None:
+            # the draft's own block pool, sized to the worst case
+            # (every slot at per-slot capacity plus k in-flight
+            # proposals): the draft allocator can never run dry, so it
+            # needs no admission ledger of its own.  Draft positions
+            # can transiently exceed max_len by up to k-2 near
+            # capacity — the position embedding clips (mode="clip"),
+            # degrading PROPOSALS only, never committed tokens.
+            self._dmaxb = -(-(self.cap + self.spec_k) // self.bs)
+            self._dnb = S * self._dmaxb
+            self.dcache = paged.paged_init(
+                draft.cfg.num_layers, S, self._dmaxb, self._dnb,
+                self.bs, draft.cfg.num_heads,
+                draft.cfg.dim // draft.cfg.num_heads,
+                get_policy().compute_dtype)
+            self._dlen = [None] * S       # draft cache length mirror
+            self._dpend = [None] * S      # committed, not yet drafted
+            self._spec_rng = np.random.default_rng(seed)
         self.decode_steps = 0
         self.tokens_decoded = 0
         self._run_seconds = 0.0
@@ -624,6 +849,38 @@ class PagedServingEngine:
             "serving_compiles",
             help="compiles since engine construction per jitted fn "
                  "(CompileWatcher), sampled per step; decode must stay 1")
+        self._m_kernel_fallback = m.counter(
+            "serving_kernel_fallback_total",
+            help="kernel-selected attention calls that traced the XLA "
+                 "gather form anyway, by reason="
+                 + "|".join(paged.KERNEL_FALLBACK_REASONS)
+                 + " (fires at trace time, once per attention call per"
+                 " layer per compiled program — never per step)")
+        if spec is not None:
+            self._m_spec_drafted = m.counter(
+                "serving_spec_draft_tokens_total",
+                help="draft tokens proposed into verify windows (a "
+                     "slot's window is 1+min(k, remaining-1) wide)")
+            self._m_spec_accepted = m.counter(
+                "serving_spec_accepted_tokens_total",
+                help="draft tokens accepted by verify and committed")
+            self._m_spec_rollback = m.counter(
+                "serving_spec_rollback_tokens_total",
+                help="verify-appended tokens discarded by accept/"
+                     "reject (cursor truncation via paged_rollback, or "
+                     "freed with the slot at retire)")
+            self._m_spec_accept_rate = m.histogram(
+                "serving_spec_accept_rate",
+                help="per-slot accepted/proposed per spec step (slots "
+                     "with a non-empty draft window)",
+                buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                         0.875, 1.0))
+            self._m_spec_tps = m.histogram(
+                "serving_spec_tokens_per_step",
+                help="tokens committed per slot per spec step (1 to "
+                     "k+1) — the frontend's completion-rate feed",
+                buckets=tuple(float(i)
+                              for i in range(1, self.spec_k + 2)))
         if sharing:
             self._m_prefix_hits = m.counter(
                 "serving_prefix_hits_total",
@@ -703,6 +960,14 @@ class PagedServingEngine:
     def _split(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def _note_kernel_fallback(self, reason: str):
+        """Trace-time observer (``paged.kernel_fallback_scope``): a
+        program that SELECTED the Pallas decode kernel traced the XLA
+        gather form anyway.  Fires on the host during tracing (once
+        per attention call per layer per compiled program) — never
+        inside a compiled step."""
+        self._m_kernel_fallback.inc(reason=reason)
 
     def _admit(self):
         """Prefill queued requests into free slots while the pool's
@@ -951,6 +1216,14 @@ class PagedServingEngine:
             # release here
             for nd in req.prefix_nodes:
                 nd.sharers.discard(req.rid)
+        if self.spec is not None and self._dlen[slot] is not None:
+            # the draft cache mirrors the slot's lifetime: free its
+            # blocks with the slot (refcount decrement of every mapped
+            # block — any un-rolled-back proposal KVs go with them)
+            self.dcache = self._free(
+                self.dcache, jnp.asarray(np.arange(self.S) == slot))
+            self._dlen[slot] = None
+            self._dpend[slot] = None
         self._slots[slot] = None
         self._done[slot] = True
 
@@ -1000,6 +1273,26 @@ class PagedServingEngine:
             # generated prefixes exist only in host memory — exactly
             # the state a supervisor must requeue-and-replay
             self._faults.fire("decode_step")
+        if self.spec is not None and any(
+                r is not None and r.max_new - len(r.tokens) > 1
+                for r in self._slots):
+            self._spec_decode(active, t0)
+        else:
+            # spec off — or every live slot needs exactly ONE more
+            # token, where the plain step beats draft+verify and is
+            # what keeps the 'decode' compile count at exactly 1 with
+            # speculation on (the bounded-compile contract)
+            self._plain_decode(active, t0)
+        self._admit()                     # splice into freed slots NOW
+        self._sample_gauges()
+        dt = time.perf_counter() - t0
+        self._run_seconds += dt           # the decode paths synced: real
+        self._m_step.observe(dt)
+        self._last_step_wall = time.time()
+        self._last_step_seconds = dt
+        return True
+
+    def _plain_decode(self, active, t0):
         self.cache, nxt, done, ok = self._decode(
             self.params, self.cache, jnp.asarray(self._tok),
             jnp.asarray(active), jnp.asarray(self._temps),
@@ -1028,14 +1321,152 @@ class PagedServingEngine:
             self._done[s] = done[s]
             if done[s] or len(req.tokens) >= req.max_new:
                 self._retire(s, "eos" if done[s] else "max_new")
-        self._admit()                     # splice into freed slots NOW
-        self._sample_gauges()
-        dt = time.perf_counter() - t0
-        self._run_seconds += dt           # np.asarray above synced: real
-        self._m_step.observe(dt)
-        self._last_step_wall = time.time()
-        self._last_step_seconds = dt
-        return True
+
+    def _draft_admit(self, slot: int):
+        """Prefill the draft cache for a freshly admitted slot — on
+        demand at its first speculative step, over the FULL prompt
+        (the draft pool has no prefix registry; a target-side prefix
+        hit changes nothing here).  One draft-prefill compile per
+        prompt bucket actually used."""
+        req = self._slots[slot]
+        assert len(req.tokens) == 1, \
+            "draft admit after plain decode steps (engine bug)"
+        n = int(req.prompt.shape[0])
+        width = min(w for w in self.buckets if n <= w)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :n] = req.prompt
+        self.dcache, ok = self._draft_prefill(
+            self._draft_params, self.dcache,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
+            jnp.asarray(n, jnp.int32))
+        assert bool(ok), "draft pool exhausted (engine bug: the draft " \
+                         "pool is sized for the worst case)"
+        self._dlen[slot] = n
+        # the prefill's sampling already happened on the TARGET; the
+        # draft only needs the pending token appended next step
+        self._dpend[slot] = [int(req.tokens[-1])]
+
+    def _spec_decode(self, active, t0):
+        """One SPECULATIVE step: draft up to ``k`` proposals per live
+        slot from the draft cache, verify all ``k + 1`` positions in
+        one batched target step, accept/reject on the host, roll the
+        rejected suffix back by cursor truncation.  Per-slot verify
+        windows are ``1 + min(k, remaining - 1)`` wide, so a transient
+        cache length never exceeds the slot's admission reservation
+        and commits never overshoot ``max_new``."""
+        S, k = self.S, self.spec_k
+        for s in np.nonzero(active)[0]:
+            if self._dlen[int(s)] is None:
+                self._draft_admit(int(s))
+        valid = np.zeros((S,), np.int32)
+        pend = np.zeros((S, 2), np.int32)
+        pend_len = np.zeros((S,), np.int32)
+        for s in np.nonzero(active)[0]:
+            req = self._slots[s]
+            rem = req.max_new - len(req.tokens)
+            valid[s] = 1 + min(k, rem - 1)
+            pl = self._dpend[int(s)]
+            pend[s, :len(pl)] = pl
+            pend_len[s] = len(pl)
+        temps = jnp.asarray(self._temps)
+        self.dcache, drafts, qprobs, dok = self._draft(
+            self._draft_params, self.dcache, jnp.asarray(pend),
+            jnp.asarray(pend_len), temps, self._split())
+        drafts_h = np.asarray(drafts)                    # [S, k]
+        toks = np.zeros((S, k + 1), np.int32)
+        toks[:, 0] = self._tok                # the pending target token
+        toks[:, 1:] = drafts_h
+        self.cache, greedy, probs, vok = self._verify(
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(valid), temps)
+        greedy_h = np.asarray(greedy)                    # [S, k+1]
+        assert bool(dok) and bool(vok), \
+            "paged pool exhausted despite admission accounting " \
+            "(engine bug)"
+        if any(self._temps[int(s)] > 0 for s in np.nonzero(active)[0]):
+            probs_h = np.asarray(probs)       # V-sized transfers only
+            q_h = np.asarray(qprobs)          # when someone samples
+        t_sync = time.perf_counter()
+        cur = np.asarray(self.cache.lengths).copy()
+        dcur = np.asarray(self.dcache.lengths).copy()
+        tnew, dnew = cur.copy(), dcur.copy()
+        plans = []
+        n_committed = n_accepted = n_drafted = n_rejected = 0
+        for s in np.nonzero(active)[0]:
+            s = int(s)
+            req = self._slots[s]
+            nd = int(valid[s]) - 1            # drafts in this window
+            n_drafted += nd
+            d = [int(x) for x in drafts_h[s, :nd]]
+            if self._temps[s] > 0:
+                out, a = spec_mod.rejection_sample(
+                    probs_h[s, :nd + 1], q_h[s, :nd], d, self._spec_rng)
+            else:
+                out, a = spec_mod.greedy_accept(
+                    d, [int(x) for x in greedy_h[s, :nd + 1]])
+            if self.eos_id is not None and self.eos_id in out:
+                out = out[:out.index(self.eos_id) + 1]
+            c = len(out)
+            a = min(a, c)                     # drafts surviving eos cut
+            n_accepted += a
+            n_rejected += int(valid[s]) - c
+            reason = None
+            if self.eos_id is not None and out[-1] == self.eos_id:
+                reason = "eos"
+            elif len(req.tokens) + c >= req.max_new:
+                reason = "max_new"
+            if reason is None:
+                # non-retiring: truncate the target cache back to the
+                # committed stream minus its pending token, the draft
+                # back to the accepted-proposal frontier.  Retiring
+                # slots skip rollback — _retire's free decrements every
+                # mapped block's refcount, rejected KVs included.
+                tnew[s] = cur[s] - (int(valid[s]) - c)
+                dnew[s] = dcur[s] - ((k - 1) - min(a, k - 1))
+            plans.append((s, out, a, nd, reason))
+        if np.any(tnew < cur):
+            self.cache = self._rollback(
+                self.cache, jnp.asarray(tnew.astype(np.int32)))
+        if np.any(dnew < dcur):
+            self.dcache = self._rollback(
+                self.dcache, jnp.asarray(dnew.astype(np.int32)))
+        for s, out, a, nd, reason in plans:
+            req = self._slots[s]
+            for t in out:
+                req.tokens.append(int(t))
+                if self.tracer is not None:
+                    # one instant PER COMMITTED TOKEN: multi-token
+                    # steps stay legible in the trace waterfalls
+                    self.tracer.instant("token", track=f"slot{s}",
+                                        rid=req.rid, ts=t_sync,
+                                        index=len(req.tokens) - 1)
+            n_committed += len(out)
+            self._tok[s] = out[-1]
+            if nd > 0:
+                self._m_spec_accept_rate.observe(a / nd)
+            self._m_spec_tps.observe(float(len(out)))
+            if reason is not None:
+                self._retire(s, reason)
+            else:
+                # next step's draft catch-up: the correction token
+                # alone, or (every draft accepted) the last proposal —
+                # whose KV the draft never appended — plus the bonus
+                self._dpend[s] = ([int(out[-2]), int(out[-1])]
+                                  if a >= k else [int(out[-1])])
+                self._dlen[s] = int(dnew[s])
+        self.decode_steps += 1
+        self.tokens_decoded += n_committed
+        self._m_steps.inc()
+        self._m_tokens.inc(n_committed)
+        self._m_spec_drafted.inc(n_drafted)
+        self._m_spec_accepted.inc(n_accepted)
+        self._m_spec_rollback.inc(n_rejected)
+        if self.tracer is not None:
+            self.tracer.complete("decode_step", t0, t_sync, track="host",
+                                 n_active=len(plans),
+                                 step=self.decode_steps, spec=True,
+                                 committed=n_committed,
+                                 accepted=n_accepted)
 
     def run(self):
         """Drive to completion; returns ``{rid: generated ids}``.
@@ -1104,6 +1535,13 @@ class PagedServingEngine:
             "pool_blocks": self.nb,
             "block_size": self.bs,
             "num_slots": self.S,
+            "spec": (None if self.spec is None else {
+                "k": self.spec_k,
+                "draft_layers": self.draft.cfg.num_layers,
+                "draft_pool_blocks": self._dnb,
+                "draft_lengths": [None if v is None else int(v)
+                                  for v in self._dlen],
+            }),
             "compiles": self.compile_counts(),
             "decode_steps": self.decode_steps,
             "tokens_decoded": self.tokens_decoded,
@@ -1181,12 +1619,20 @@ class PagedServingEngine:
         ``step()`` directly as well as for ``run()``.  The full metric
         series live in ``self.metrics.snapshot()``."""
         dt = max(self._run_seconds, 1e-9)
+        spec_stats = None
+        if self.spec is not None:
+            spec_stats = {
+                "k": self.spec_k,
+                "accept_rate": self._m_spec_accept_rate.summary(),
+                "tokens_per_step": self._m_spec_tps.summary(),
+            }
         return {"decode_steps": self.decode_steps,
                 "tokens_decoded": self.tokens_decoded,
                 "run_seconds": self._run_seconds,
                 "tokens_per_s": self.tokens_decoded / dt,
                 "compiles": self.compile_counts(),
                 "occupancy": self.occupancy(),
+                "spec": spec_stats,
                 "latency": {
                     "queue_wait_s": self._m_queue_wait.summary(),
                     "ttft_s": self._m_ttft.summary(),

@@ -1,0 +1,221 @@
+"""Speculative decoding for the paged serving engine.
+
+Decode is latency-bound: every token costs one full serving round trip
+(dispatch + kernel launch + host sync) for one token of progress.
+Speculative decoding buys multiple tokens per round trip without
+changing the output distribution: a cheap DRAFT model proposes ``k``
+tokens per slot, the target model scores all ``k + 1`` positions in
+ONE batched step over the paged cache (the multi-token verify form —
+``paged_chunked_attention``'s per-query causal bounds, the same op
+that serves tail prefill), and host-side rejection sampling accepts a
+prefix of the proposals.  Rejected tokens roll back by truncating the
+slot's block-table cursor (:func:`~paddle_tpu.ops.paged_attention.
+paged_rollback`) — on a paged cache, undo is a pointer truncation, not
+a copy, which is why the ROADMAP calls this the block table's second
+payoff.
+
+The module is the engine-independent core:
+
+* :class:`SpecConfig` — the engine knob (``PagedServingEngine(spec=
+  SpecConfig(k=4, draft_layers=1))``).
+* :class:`DraftModel` — the proposer protocol: anything exposing a
+  ``TransformerConfig`` + params the engine can build its draft
+  programs from.  First implementation :class:`TruncatedDraft`: the
+  target's own bottom ``N`` layers (plus embeddings / final norm /
+  output head), built by PARAMETER SLICING — ``nn.transform``'s apply
+  ignores unused param subtrees, so the truncated twin shares the
+  target's weights with zero extra memory and zero training.
+* :func:`greedy_accept` / :func:`rejection_sample` — the host-side
+  accept rules.  Greedy is longest-prefix match against the target's
+  argmax chain, which makes the speculative stream BIT-IDENTICAL to
+  target-only greedy decode by induction: the correction token after
+  the matched prefix is exactly the argmax the direct engine would
+  have emitted.  Sampled decode is standard speculative rejection
+  sampling [Leviathan et al.; Chen et al.]: accept draft ``d_j`` with
+  probability ``min(1, p_j(d_j) / q_j(d_j))``, on the first rejection
+  emit a correction from ``normalize(max(p_j - q_j, 0))``, and when
+  every draft survives emit a BONUS token from the target's ``k``-th
+  distribution — the classical argument gives output marginals exactly
+  equal to target-only sampling, for ANY draft (a bad draft costs
+  speed, never correctness).  Both ``p`` and ``q`` must be
+  ``softmax(restrict(logits / temp))`` with the target's own
+  ``_restrict_logits`` masks — the engine builds them from the same
+  helper the direct sampler uses, so the corrected distribution is the
+  direct engine's distribution to the bit.
+
+The serving integration (draft/verify programs, per-slot accept
+windows, the rollback ledger, telemetry) lives in
+``paddle_tpu/serving.py``; ``docs/design/serving.md`` works the
+correctness argument and the compile contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import (List, Protocol, Sequence, Tuple,
+                    runtime_checkable)
+
+import numpy as np
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.models.transformer import TransformerConfig
+
+__all__ = ["SpecConfig", "DraftModel", "TruncatedDraft",
+           "truncate_lm_params", "greedy_accept", "rejection_sample"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Engine-facing speculative-decoding knob.
+
+    ``k``: draft tokens proposed per slot per step — the verify step
+    scores ``k + 1`` positions and a step commits between 1 and
+    ``k + 1`` tokens.  ``draft_layers``: layers kept by the default
+    :class:`TruncatedDraft` when no explicit ``draft=`` model is
+    passed.
+    """
+
+    k: int = 4
+    draft_layers: int = 1
+
+    def __post_init__(self):
+        enforce(self.k >= 1, "SpecConfig.k must be >= 1, got %s", self.k)
+        enforce(self.draft_layers >= 1,
+                "SpecConfig.draft_layers must be >= 1, got %s",
+                self.draft_layers)
+
+
+@runtime_checkable
+class DraftModel(Protocol):
+    """A proposer the engine can build draft programs from: a
+    transformer config (same vocab as the target — the accept rule
+    compares distributions over one vocabulary) plus a params pytree
+    ``nn.transform``-compatible with that config."""
+
+    @property
+    def cfg(self) -> TransformerConfig: ...
+
+    @property
+    def params(self): ...
+
+
+def truncate_lm_params(params, num_layers: int, *, name: str = "lm"):
+    """Slice a :class:`TransformerLM` params tree down to its bottom
+    ``num_layers`` blocks (keeping embeddings, final norm and the
+    output head).  ``nn.transform``'s apply tolerates a params tree
+    with exactly the keys the traced program reads, so the returned
+    subtree IS the truncated model's params — no copy of the arrays,
+    just a smaller dict over the same buffers."""
+    sub = params[name]
+    kept = {}
+    n_blocks = 0
+    for key, val in sub.items():
+        if key.startswith("block_"):
+            if int(key.split("_", 1)[1]) < num_layers:
+                kept[key] = val
+                n_blocks += 1
+        else:
+            kept[key] = val
+    enforce(n_blocks == num_layers,
+            "truncate_lm_params: wanted %s blocks, params hold %s",
+            num_layers, n_blocks)
+    return {name: kept}
+
+
+class TruncatedDraft:
+    """The zero-training draft: the target's own bottom ``num_layers``
+    blocks re-read through the final norm and output head.  Shares the
+    target's buffers (parameter slicing, no copies); quality degrades
+    gracefully with depth, and ``num_layers == cfg.num_layers`` is the
+    self-draft degenerate case (every proposal accepted — the parity
+    fixture the tests pin greedy bit-identity with)."""
+
+    def __init__(self, cfg: TransformerConfig, params, num_layers: int,
+                 *, name: str = "lm"):
+        enforce(1 <= num_layers <= cfg.num_layers,
+                "TruncatedDraft: num_layers %s outside [1, %s]",
+                num_layers, cfg.num_layers)
+        self._cfg = dataclasses.replace(cfg, num_layers=num_layers)
+        self._params = truncate_lm_params(params, num_layers, name=name)
+
+    @property
+    def cfg(self) -> TransformerConfig:
+        return self._cfg
+
+    @property
+    def params(self):
+        return self._params
+
+
+# ---------------------------------------------------------- host accept
+
+
+def greedy_accept(drafts: Sequence[int],
+                  greedy: Sequence[int]) -> Tuple[List[int], int]:
+    """Greedy accept rule: longest prefix of ``drafts`` matching the
+    target's argmax chain ``greedy`` (``greedy[j]`` = target argmax
+    after consuming ``drafts[:j]``), then the correction/bonus token
+    ``greedy[a]``.  Returns ``(committed_tokens, n_accepted)`` with
+    ``committed == greedy[:a + 1]`` — by induction exactly the stream
+    target-only greedy decode emits, which is the bit-identity
+    contract the tier-1 test pins."""
+    enforce(len(greedy) == len(drafts) + 1,
+            "greedy_accept: need k+1 target tokens for k drafts "
+            "(got %s for %s)", len(greedy), len(drafts))
+    a = 0
+    while a < len(drafts) and int(drafts[a]) == int(greedy[a]):
+        a += 1
+    return [int(t) for t in greedy[:a + 1]], a
+
+
+def rejection_sample(p: np.ndarray, q: np.ndarray,
+                     drafts: Sequence[int],
+                     rng: np.random.Generator,
+                     ) -> Tuple[List[int], int]:
+    """Standard speculative rejection sampling for ONE slot.
+
+    ``p``: ``[k + 1, V]`` target distributions (``p[j]`` conditions on
+    the committed stream plus ``drafts[:j]``); ``q``: ``[k, V]`` draft
+    proposal distributions; ``drafts``: the ``k`` proposed tokens.
+    Accept ``drafts[j]`` with probability ``min(1, p[j, d] / q[j, d])``;
+    on the first rejection emit a correction sampled from
+    ``normalize(max(p[j] - q[j], 0))`` and stop; with every draft
+    accepted emit a bonus from ``p[k]``.  Returns
+    ``(committed_tokens, n_accepted)`` — between 1 and ``k + 1``
+    tokens.
+
+    Correctness (the classical argument): for each position the
+    emitted marginal is ``min(p, q) + (1 - beta) * normalize(max(p - q,
+    0)) = p`` with ``beta = sum_t min(p(t), q(t))`` — the output
+    distribution equals target-only sampling for ANY proposal ``q``,
+    so a weak draft costs acceptance rate, never correctness.  The
+    seeded distribution-equivalence test pins this empirically."""
+    k = len(drafts)
+    assert p.shape[0] == k + 1 and (k == 0 or q.shape[0] == k), (
+        f"rejection_sample: p {p.shape} / q {getattr(q, 'shape', None)} "
+        f"do not cover {k} drafts")
+    out: List[int] = []
+    for j in range(k):
+        d = int(drafts[j])
+        pd = float(p[j, d])
+        qd = max(float(q[j, d]), 1e-30)
+        if rng.random() < min(1.0, pd / qd):
+            out.append(d)
+            continue
+        resid = np.maximum(p[j].astype(np.float64) - q[j], 0.0)
+        total = float(resid.sum())
+        if total <= 0.0:
+            # p == q exactly (or numerics collapsed the residual): the
+            # correction distribution is degenerate — fall back to the
+            # target distribution itself, which the identity above
+            # makes exact in this limit
+            resid = np.maximum(p[j].astype(np.float64), 0.0)
+            total = float(resid.sum())
+        out.append(int(rng.choice(resid.shape[0], p=resid / total)))
+        return out, j
+    bonus = np.maximum(p[k].astype(np.float64), 0.0)
+    total = float(bonus.sum())
+    enforce(total > 0.0, "rejection_sample: target bonus distribution "
+            "sums to %s — non-finite logits upstream", total)
+    out.append(int(rng.choice(bonus.shape[0], p=bonus / total)))
+    return out, k

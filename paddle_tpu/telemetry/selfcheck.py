@@ -1,6 +1,6 @@
 """The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
 
-Eight checks, each a hard failure (non-zero exit) when violated:
+Nine checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
    (fresh registry, request-level tracer ON, ``decode_kernel=True`` so
@@ -33,14 +33,25 @@ Eight checks, each a hard failure (non-zero exit) when violated:
    (copy-on-write rides the same traced decode step), and
    ``hbm_report()`` must reconcile — pinned prefix blocks are the only
    pool residue after the run and a flush returns the pool to empty.
-6. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
+6. **Speculative smoke** — the same tiny engine with
+   ``spec=SpecConfig(...)`` (and the prefix cache on) serves greedy
+   requests next to a spec-off twin: the streams must be
+   BYTE-IDENTICAL (the accept rule's bit-identity contract), the
+   accept counter must be nonzero (the self-draft fixture guarantees
+   acceptances), the compile set must stay bounded
+   (``decode <= 1, verify == 1, draft == 1`` — one program each for
+   draft, verify, and the plain tail step), and the pool ledger must
+   reconcile with speculation + sharing on (only registry-pinned
+   blocks survive the run, the draft pool returns to empty, flush
+   clears the rest).
+7. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
    real batch + scan steps with the monitor at cadence: the snapshot
    must validate and carry populated ``train_health_*`` families,
    ``compiles`` must stay ``{step: 1, scan: 1}`` WITH health enabled
    (the packed statistics vector may not perturb tracing or donation),
    and the per-step host cost of ``HealthMonitor.observe`` amortized
    over the default cadence stays under the same observation ceiling.
-7. **Chaos smoke** — the serving FRONTEND (``paddle_tpu/frontend.py``)
+8. **Chaos smoke** — the serving FRONTEND (``paddle_tpu/frontend.py``)
    first proves its fault-free single-engine fast path is
    byte-for-byte the direct engine (identical greedy token streams,
    ``compiles == {'decode': 1}``), then runs a two-engine service
@@ -52,7 +63,7 @@ Eight checks, each a hard failure (non-zero exit) when violated:
    the fault-free run, each live engine must still hold the
    ``compiles == {'decode': 1}`` pin, and the overload burst must shed
    lowest-priority-first with typed reject reasons.
-8. **Lint re-check** — the instrumented entrypoints (engine decode,
+9. **Lint re-check** — the instrumented entrypoints (engine decode,
    its prefix-sharing and fault-injection twins, paged serve step,
    trainer step, health-instrumented trainer step) re-trace through
    tpu-lint with ZERO error-severity findings:
@@ -99,6 +110,7 @@ INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode-faults",
     "paged-engine-decode-kernel",
     "paged-engine-decode-prefix",
+    "paged-engine-decode-spec",
     "paged-serve-step",
     "trainer-train-step",
     "trainer-train-step-health",
@@ -332,6 +344,86 @@ def _check_prefix_smoke():
     return int(hits), int(toks)
 
 
+def _check_spec_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+    common = np.arange(1, 6, dtype=np.int32)       # 5 shared tokens
+    def drive(spec, prefix, reg=None):
+        eng = PagedServingEngine(cfg, params, num_slots=2,
+                                 num_blocks=12, block_size=4,
+                                 prompt_buckets=(8,), seed=0,
+                                 metrics=(reg if reg is not None
+                                          else MetricsRegistry()),
+                                 prefix_cache=prefix, spec=spec)
+        eng.submit(np.concatenate([common, [9]]), max_new=6)
+        eng.submit(np.concatenate([common, [11]]), max_new=5)
+        eng.submit(common[:4], max_new=2)      # rem==1 tail: plain step
+        return eng.run(), eng
+
+    direct, _ = drive(None, False)
+    reg = MetricsRegistry("selfcheck-spec")
+    # draft_layers == num_layers: the SELF-DRAFT fixture — every
+    # greedy proposal must be accepted, so a nonzero accept counter is
+    # deterministic, not a property of this tiny model's logits
+    spec_out, eng = drive(SpecConfig(k=2, draft_layers=1), True, reg)
+    if set(direct) != set(spec_out) or any(
+            len(direct[r]) != len(spec_out[r])
+            or (direct[r] != spec_out[r]).any() for r in direct):
+        _fail("greedy speculative streams are not byte-identical to "
+              "the direct engine's")
+
+    compiles = eng.compile_counts()
+    if compiles.get("decode", 0) > 1 or compiles.get("verify") != 1 \
+            or compiles.get("draft") != 1:
+        _fail("the bounded compile contract (decode <= 1, verify == 1, "
+              f"draft == 1) broke with speculation on: {compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    accepted = sum(s["value"] for s in
+                   metrics["serving_spec_accepted_tokens_total"]
+                   ["series"])
+    if accepted <= 0:
+        _fail("serving_spec_accepted_tokens_total is 0 after a "
+              "self-draft run — the accept path never fired")
+    tps = metrics["serving_spec_tokens_per_step"]["series"]
+    if sum(s["count"] for s in tps) <= 0:
+        _fail("serving_spec_tokens_per_step empty after a spec run")
+
+    # pool ledger with speculation + sharing on: registry pins are the
+    # only target-pool residue, the DRAFT pool is empty (every slot
+    # freed at retire), and a flush clears the rest
+    occ = eng.occupancy()
+    pinned = eng.host_state()["prefix_cache"]["pinned_blocks"]
+    if occ["blocks_in_use"] != pinned:
+        _fail(f"spec+prefix pool residue disagrees: in_use "
+              f"{occ['blocks_in_use']} != pinned {pinned}")
+    dfree = int(np.asarray(eng.dcache.free).sum())
+    if dfree != eng._dnb:
+        _fail(f"draft pool leaked: {eng._dnb - dfree} blocks still "
+              "mapped after every request retired")
+    if int(np.asarray(eng.dcache.refcounts).max()) != 0:
+        _fail("draft pool refcounts corrupted after the run")
+    eng.flush_prefix_cache()
+    if eng.occupancy()["blocks_in_use"] != 0:
+        _fail(f"flush left blocks resident: {eng.occupancy()}")
+    return int(accepted), compiles
+
+
 def _check_health():
     import jax.numpy as jnp
     import numpy as np
@@ -555,6 +647,11 @@ def main(argv=None) -> int:
     print(f"selfcheck: shared-prefix smoke ok ({p_hits} hit(s), "
           f"{p_toks} shared tokens, compiles==1 with sharing on, "
           "pool reconciles + flush empties)")
+    s_accepted, s_compiles = _check_spec_smoke()
+    print(f"selfcheck: speculative smoke ok ({s_accepted} accepted "
+          "draft tokens, greedy byte-identical, compiles bounded "
+          f"(decode={s_compiles.get('decode', 0)}, verify=1, draft=1), "
+          "pool + draft pool reconcile)")
     hsnap, h_per_step = _check_health()
     print("selfcheck: training health smoke ok "
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "

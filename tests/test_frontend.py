@@ -401,3 +401,57 @@ def test_chaos_property_exactly_once_no_leaks(seed, params,
 @pytest.mark.parametrize("seed", [2, 3, 4, 5, 6])
 def test_chaos_property_sweep(seed, params, direct_streams):
     _chaos_property(seed, params, direct_streams)
+
+
+# --------------------------------------------- speculative prediction
+
+
+def test_frontend_spec_engines_use_live_tokens_per_step(
+        params, direct_streams):
+    """``spec=`` forwards to every engine seat, greedy streams through
+    the frontend stay byte-identical to the direct engine, and the
+    burst primes the per-seat tokens-per-step histogram the admission
+    predictor reads (self-draft: rate strictly above 1 token/step)."""
+    from paddle_tpu.serving import SpecConfig
+
+    with ServingFrontend(CFG, params, num_engines=1,
+                         metrics=telemetry.MetricsRegistry("fe-spec"),
+                         spec=SpecConfig(k=2, draft_layers=1),
+                         **ENGINE_KW) as fe:
+        rids = [fe.submit(p, MAX_NEW) for p in PROMPTS]
+        out = fe.run(timeout_s=120)
+        seat = fe._seats[0]
+        tps = seat.registry.get(
+            "serving_spec_tokens_per_step").summary()
+        est = fe._service_estimate_locked(seat, MAX_NEW)
+        compiles = fe.compile_counts()
+    for i, rid in enumerate(rids):
+        assert out[rid]["status"] == COMPLETED
+        # CFG is 1 layer, so draft_layers=1 is self-draft: bit-identity
+        # must hold against the target-only reference streams
+        assert np.array_equal(out[rid]["tokens"], direct_streams[i])
+    assert tps["count"] > 0 and tps["avg"] > 1.0
+    assert est > 0.0
+    assert compiles[0].get("decode", 0) <= 1
+    assert compiles[0]["verify"] == 1 and compiles[0]["draft"] == 1
+
+
+def test_service_estimate_divides_step_fallback_by_spec_rate(params):
+    """The satellite pin: with no per-token samples yet, the estimate
+    falls back to avg step time DIVIDED by the live tokens-per-step
+    rate — a spec seat committing 3 tokens/step predicts a third of
+    the naive 1-token/step estimate for the same step telemetry."""
+    from paddle_tpu.serving import SpecConfig
+
+    with ServingFrontend(CFG, params, num_engines=1,
+                         metrics=telemetry.MetricsRegistry("fe-est"),
+                         spec=SpecConfig(k=3, draft_layers=1),
+                         **ENGINE_KW) as fe:
+        seat = fe._seats[0]
+        seat.registry.histogram("serving_step_seconds").observe(0.03)
+        naive = fe._service_estimate_locked(seat, 10)   # empty tps -> 1
+        seat.registry.get(
+            "serving_spec_tokens_per_step").observe(3.0)
+        spec_est = fe._service_estimate_locked(seat, 10)
+    assert naive == pytest.approx(0.3)
+    assert spec_est == pytest.approx(0.1)

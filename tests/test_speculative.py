@@ -1,0 +1,399 @@
+"""Speculative decoding (``speculative.py``, the ``serving.py`` spec
+integration, ``ops/paged_attention.py`` rollback + kernel-fallback
+surfacing).
+
+The load-bearing pins:
+
+* GREEDY BIT-IDENTITY: a spec engine's greedy streams equal the
+  target-only engine's token for token — XLA gather AND
+  Pallas-interpret decode paths, prefix cache on and off, truncated
+  draft and the self-draft degenerate case (accept rate exactly 1.0).
+* SAMPLED EXACTNESS: ``rejection_sample``'s emitted marginal equals
+  the target distribution for an arbitrary draft (seeded, TV-bounded)
+  and the engine's sampled streams are distribution-equivalent to the
+  direct engine's.
+* ROLLBACK NEVER LEAKS: ``paged_rollback`` is a pointer truncation
+  that respects sharing (a dropped mapping decrements, never frees a
+  pinned/shared block), reconciled against a host mirror under
+  randomized reserve/advance/rollback/free schedules, and a drained
+  spec engine returns BOTH pools to empty with zero refcounts.
+* The serving contracts survive spec: ``compiles`` stays bounded
+  (``decode <= 1``, ``verify == 1``, ``draft == 1``), the spec metric
+  family populates, and the kernel's multi-token verify fallback is
+  TYPED (``serving_kernel_fallback_total{reason=...}``), not silent.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry
+from paddle_tpu.core.errors import EnforceError
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.serving import (PagedServingEngine, SpecConfig,
+                                paged_serve_builder)
+from paddle_tpu.speculative import (TruncatedDraft, greedy_accept,
+                                    rejection_sample,
+                                    truncate_lm_params)
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+PROMPTS = [np.arange(1, 9, dtype=np.int32),
+           np.arange(3, 15, dtype=np.int32),
+           np.arange(2, 6, dtype=np.int32),
+           np.arange(7, 12, dtype=np.int32)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _engine(params, *, spec=None, sharing=False, decode_kernel=False,
+            num_blocks=40, num_slots=2, seed=0, eos_id=None,
+            top_k=None, metrics=None):
+    return PagedServingEngine(
+        CFG, params, num_slots=num_slots, num_blocks=num_blocks,
+        block_size=4, prompt_buckets=(16,), prefix_cache=sharing,
+        decode_kernel=decode_kernel, spec=spec, seed=seed,
+        eos_id=eos_id, top_k=top_k,
+        metrics=metrics if metrics is not None
+        else telemetry.MetricsRegistry())
+
+
+def _drive(eng, prompts=PROMPTS, max_new=10, temperature=0.0):
+    for p in prompts:
+        eng.submit(p, max_new, temperature=temperature)
+    out = eng.run()
+    return [list(map(int, out[r])) for r in sorted(out)]
+
+
+# ------------------------------------------------------- host-side core
+
+
+def test_truncate_lm_params_slices_blocks(params):
+    sub = truncate_lm_params(params, 1)["lm"]
+    assert "block_0" in sub and "block_1" not in sub
+    full = set(params["lm"])
+    assert set(sub) == {k for k in full if k != "block_1"}
+    # shared buffers, not copies
+    leaf = jax.tree_util.tree_leaves(sub["block_0"])[0]
+    ref = jax.tree_util.tree_leaves(params["lm"]["block_0"])[0]
+    assert leaf is ref
+    with pytest.raises(EnforceError):
+        truncate_lm_params(params, 3)
+
+
+def test_truncated_draft_and_spec_config_validate(params):
+    d = TruncatedDraft(CFG, params, 1)
+    assert d.cfg.num_layers == 1 and d.cfg.vocab_size == CFG.vocab_size
+    assert "block_1" not in d.params["lm"]
+    with pytest.raises(EnforceError):
+        TruncatedDraft(CFG, params, 3)
+    with pytest.raises(EnforceError):
+        SpecConfig(k=0)
+    with pytest.raises(EnforceError):
+        SpecConfig(k=2, draft_layers=0)
+
+
+def test_greedy_accept_longest_prefix():
+    out, a = greedy_accept([5, 7, 9], [5, 7, 2, 4])
+    assert (out, a) == ([5, 7, 2], 2)       # prefix + correction
+    out, a = greedy_accept([1, 2], [9, 9, 9])
+    assert (out, a) == ([9], 0)             # immediate mismatch
+    out, a = greedy_accept([4, 4], [4, 4, 8])
+    assert (out, a) == ([4, 4, 8], 2)       # all accepted + bonus
+    with pytest.raises(EnforceError):
+        greedy_accept([1, 2], [1, 2])       # k+1 targets required
+
+
+def test_rejection_sample_marginal_equals_target():
+    """The classical exactness property, empirically: for an ARBITRARY
+    draft q, the first emitted token's marginal is the target p[0] —
+    min(p, q) mass from acceptance plus (1 - beta) * residual from the
+    correction."""
+    rng = np.random.default_rng(7)
+    V, k, n = 8, 1, 20000
+    p = rng.dirichlet(np.ones(V), size=k + 1)
+    q = rng.dirichlet(np.ones(V) * 0.3, size=k)     # deliberately off
+    counts = np.zeros(V)
+    accepted = 0
+    for _ in range(n):
+        d = [int(rng.choice(V, p=q[0]))]            # draft ~ q
+        out, a = rejection_sample(p, q, d, rng)
+        counts[out[0]] += 1
+        accepted += a
+    tv = 0.5 * np.abs(counts / n - p[0]).sum()
+    assert tv < 0.02, f"first-token marginal TV {tv:.4f} vs target"
+    assert 0 < accepted < n                          # both paths taken
+
+
+def test_rejection_sample_identical_draft_always_accepts():
+    rng = np.random.default_rng(3)
+    p = rng.dirichlet(np.ones(6), size=3)
+    q = p[:2].copy()                                 # q == p exactly
+    for _ in range(50):
+        d = [int(rng.choice(6, p=q[j])) for j in range(2)]
+        out, a = rejection_sample(p, q, d, rng)
+        assert a == 2 and out[:2] == d and len(out) == 3
+
+
+# ------------------------------------------------------ paged_rollback
+
+
+def test_paged_rollback_truncates_cursor_and_frees_blocks():
+    cache = paged.paged_init(1, 2, 4, 8, 4, 1, 4)
+    cache, ok = paged.paged_reserve(cache, jnp.asarray([10, 6]))
+    assert bool(ok)
+    cache = paged.paged_advance(cache, jnp.asarray([10, 6]))
+    assert np.asarray(cache.blocks_used).tolist() == [3, 2]
+    assert int(np.asarray(cache.refcounts).sum()) == 5
+    cache = paged.paged_rollback(cache, jnp.asarray([5, 6]))
+    assert np.asarray(cache.lengths).tolist() == [5, 6]
+    assert np.asarray(cache.blocks_used).tolist() == [2, 2]
+    assert int(np.asarray(cache.refcounts).sum()) == 4
+    assert int(np.asarray(cache.block_tables)[0, 2]) == -1
+    # lengths above the cursor clamp to a no-op
+    before = jax.tree_util.tree_map(np.asarray, cache)
+    cache = paged.paged_rollback(cache, jnp.asarray([100, 100]))
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, cache))):
+        assert np.array_equal(a, b)
+
+
+def test_paged_rollback_respects_shared_refcounts():
+    """A rolled-back mapping DECREMENTS — a block the prefix registry
+    pins (rc 2) survives with rc 1, exactly the paged_free contract."""
+    cache = paged.paged_init(1, 1, 4, 8, 4, 1, 4)
+    cache, _ = paged.paged_reserve(cache, jnp.asarray([8]))
+    cache = paged.paged_advance(cache, jnp.asarray([8]))
+    pinned = int(np.asarray(cache.block_tables)[0, 1])
+    pin = jnp.zeros((8,), jnp.int32).at[pinned].set(1)
+    cache = paged.paged_rc_add(cache, pin)           # registry pin
+    cache = paged.paged_rollback(cache, jnp.asarray([2]))
+    rc = np.asarray(cache.refcounts)
+    assert rc[pinned] == 1                           # pinned, not freed
+    assert int(rc.sum()) == 2                        # slot block + pin
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_rollback_refcount_property_randomized(seed):
+    """Randomized reserve/advance/rollback/free schedule against a
+    host mirror: every block's device refcount must equal the number
+    of block-table rows mapping it plus its registry pins, at every
+    host-visible point."""
+    rng = np.random.default_rng(seed)
+    S, maxb, nb, bs = 3, 6, 16, 4
+    cache = paged.paged_init(1, S, maxb, nb, bs, 1, 4)
+    pins = np.zeros(nb, np.int32)
+
+    def check(cache):
+        tables = np.asarray(cache.block_tables)
+        expect = np.zeros(nb, np.int32)
+        for b in tables[tables >= 0].reshape(-1):
+            expect[b] += 1
+        assert np.array_equal(np.asarray(cache.refcounts),
+                              expect + pins), \
+            f"refcount mismatch at seed {seed}"
+
+    for _ in range(60):
+        op = rng.integers(0, 4)
+        lengths = np.asarray(cache.lengths)
+        if op == 0:                                  # reserve + advance
+            want = rng.integers(0, 5, S)
+            want = np.minimum(want, maxb * bs - lengths)
+            cache, ok = paged.paged_reserve(cache, jnp.asarray(
+                want.astype(np.int32)))
+            if bool(ok):
+                cache = paged.paged_advance(cache, jnp.asarray(
+                    want.astype(np.int32)))
+            # a failed reserve corrupts by contract — regenerate
+            else:
+                cache = paged.paged_init(1, S, maxb, nb, bs, 1, 4)
+                pins[:] = 0
+        elif op == 1:                                # speculative undo
+            newlen = rng.integers(0, lengths + 1)
+            cache = paged.paged_rollback(cache, jnp.asarray(
+                newlen.astype(np.int32)))
+        elif op == 2:                                # retire one slot
+            s = int(rng.integers(0, S))
+            cache = paged.paged_free(
+                cache, jnp.asarray(np.arange(S) == s))
+        else:                                        # registry pin
+            b = int(rng.integers(0, nb))
+            if np.asarray(cache.refcounts)[b] > 0 or pins[b] > 0:
+                delta = 1 if pins[b] == 0 else -1
+                pins[b] += delta
+                cache = paged.paged_rc_add(
+                    cache, jnp.zeros((nb,), jnp.int32).at[b].set(delta))
+        check(cache)
+
+
+# --------------------------------------------- engine greedy bit-identity
+
+
+@pytest.mark.parametrize("decode_kernel,sharing,draft_layers", [
+    (False, False, 1),         # XLA gather path, truncated draft
+    (True, False, 1),          # Pallas kernel (interpret) path
+    (False, True, 1),          # prefix cache stacked on spec
+    (False, False, 2),         # self-draft parity (accept rate 1.0)
+])
+def test_greedy_spec_bit_identical(params, decode_kernel, sharing,
+                                   draft_layers):
+    base = _drive(_engine(params, decode_kernel=decode_kernel,
+                          sharing=sharing))
+    eng = _engine(params, decode_kernel=decode_kernel, sharing=sharing,
+                  spec=SpecConfig(k=3, draft_layers=draft_layers))
+    streams = _drive(eng)
+    assert streams == base
+    compiles = eng.compile_counts()
+    assert compiles.get("decode", 0) <= 1
+    assert compiles["verify"] == 1 and compiles["draft"] == 1
+    if draft_layers == CFG.num_layers:
+        sp = eng.stats()["spec"]
+        assert sp["accept_rate"]["avg"] == pytest.approx(1.0)
+        assert sp["tokens_per_step"]["avg"] > 1.0
+
+
+def test_greedy_spec_bit_identical_with_eos(params):
+    """EOS inside an accepted window truncates the committed tokens at
+    the stop token — streams (and early retirement) must still match
+    the direct engine exactly."""
+    eos = 7
+    base = _drive(_engine(params, eos_id=eos), max_new=12)
+    eng = _engine(params, eos_id=eos, spec=SpecConfig(k=3,
+                                                      draft_layers=1))
+    assert _drive(eng, max_new=12) == base
+
+
+# -------------------------------------------------- engine sampled path
+
+
+def test_sampled_spec_distribution_equivalence(params):
+    """Engine-level wiring check for the exactness the numpy test pins:
+    sampled spec streams and direct streams are drawn from the same
+    distribution.  Compares the marginal over all spec-committed
+    positions (everything after the prefill token) across a seeded
+    request burst; also proves REAL rejections happened, so the
+    correction path is inside the comparison."""
+    def marginal(spec, seed):
+        eng = _engine(params, spec=spec, seed=seed, top_k=4,
+                      num_blocks=60)
+        counts = np.zeros(CFG.vocab_size)
+        for rep in range(30):
+            streams = _drive(eng, max_new=5, temperature=0.8)
+            for s in streams:
+                for t in s[1:]:
+                    counts[t] += 1
+        return counts / counts.sum(), eng
+
+    got, eng = marginal(SpecConfig(k=2, draft_layers=1), seed=11)
+    want, _ = marginal(None, seed=23)
+    tv = 0.5 * np.abs(got - want).sum()
+    assert tv < 0.12, f"sampled spec marginal TV {tv:.4f} vs direct"
+    reg = eng.metrics
+    acc = reg.counter("serving_spec_accepted_tokens_total").value()
+    rb = reg.counter("serving_spec_rollback_tokens_total").value()
+    assert acc > 0 and rb > 0                # both accept AND reject
+
+
+def test_spec_engine_pools_reconcile_after_drain(params):
+    """Rollback never leaks: after a mixed greedy/sampled burst with
+    mid-window EOS retirements, both the target pool and the draft
+    pool return to empty with zero refcounts."""
+    eng = _engine(params, spec=SpecConfig(k=3, draft_layers=1),
+                  eos_id=5, num_blocks=60)
+    rng = np.random.default_rng(0)
+    for rep in range(3):
+        for i, p in enumerate(PROMPTS):
+            eng.submit(p, int(rng.integers(2, 12)),
+                       temperature=float(rng.choice([0.0, 0.9])))
+        eng.run()
+    occ = eng.occupancy()
+    assert occ["blocks_in_use"] == 0
+    assert int(np.asarray(eng.cache.refcounts).max()) == 0
+    assert int(np.asarray(eng.dcache.refcounts).max()) == 0
+    assert int(np.asarray(eng.dcache.free.sum())) == eng._dnb
+
+
+# ----------------------------------------------- telemetry + fallback
+
+
+def test_spec_metrics_and_tracer_instants(params):
+    tr = telemetry.Tracer(name="spec-test")
+    reg = telemetry.MetricsRegistry("spec-test")
+    eng = PagedServingEngine(
+        CFG, params, num_slots=2, num_blocks=40, block_size=4,
+        prompt_buckets=(16,), spec=SpecConfig(k=3, draft_layers=2),
+        metrics=reg, tracer=tr, seed=0)
+    streams = _drive(eng, max_new=8)
+    drafted = reg.counter("serving_spec_draft_tokens_total").value()
+    acc = reg.counter("serving_spec_accepted_tokens_total").value()
+    rb = reg.counter("serving_spec_rollback_tokens_total").value()
+    assert drafted > 0 and acc > 0
+    assert acc + rb == drafted               # every proposal accounted
+    tps = reg.get("serving_spec_tokens_per_step").summary()
+    assert tps["count"] > 0 and 1.0 <= tps["avg"] <= 4.0
+    # every committed DECODE token got its per-token tracer instant
+    # (tok0 arrives from prefill as the first_token instant)
+    toks = [e for e in tr.events() if e["name"] == "token"]
+    firsts = [e for e in tr.events() if e["name"] == "first_token"]
+    assert len(firsts) == len(streams)
+    assert len(toks) == sum(len(s) - 1 for s in streams)
+    spans = [e for e in tr.events()
+             if e["name"] == "decode_step" and e["args"].get("spec")]
+    assert spans and all(s["args"]["committed"] >= 1 for s in spans)
+
+
+def test_kernel_fallback_counter_is_typed(params):
+    """Satellite: the multi-token verify query CANNOT run the Pallas
+    decode kernel; the fallback to the XLA gather form must surface a
+    typed reason, never silently."""
+    reg = telemetry.MetricsRegistry("fb-test")
+    eng = _engine(params, decode_kernel=True,
+                  spec=SpecConfig(k=2, draft_layers=1), metrics=reg)
+    _drive(eng, max_new=6)
+    snap = reg.snapshot()["metrics"]["serving_kernel_fallback_total"]
+    reasons = {s["labels"]["reason"]: s["value"]
+               for s in snap["series"]}
+    assert reasons.get("multi_token_query", 0) > 0
+    assert set(reasons) <= set(paged.KERNEL_FALLBACK_REASONS)
+
+
+def test_kernel_fallback_scope_unit():
+    seen = []
+    q = jnp.zeros((1, 3, 2, 4))              # t=3 multi-token query
+    kp = jnp.zeros((4, 4, 2, 4))
+    with paged.kernel_fallback_scope(seen.append):
+        with paged.decode_kernel_scope(True):
+            assert paged._fallback_reason(q, kp, 1.0) \
+                == "multi_token_query"
+
+
+# ------------------------------------------------- builder draft= form
+
+
+def test_paged_serve_builder_draft_layers(params):
+    prompt = jnp.asarray(np.stack([np.arange(1, 9)] * 2), jnp.int32)
+    twin = paged_serve_builder(CFG, block_size=4, draft=1,
+                               decode_kernel=False)
+    assert twin.draft_cfg.num_layers == 1
+    # the explicit-DraftModel form serves the same truncated program
+    d = TruncatedDraft(CFG, params, 1)
+    direct = paged_serve_builder(d.cfg, block_size=4,
+                                 decode_kernel=False)
+    a = np.asarray(twin(params, prompt, 6))       # slices internally
+    b = np.asarray(direct(d.params, prompt, 6))
+    assert np.array_equal(a, b)
+    obj = paged_serve_builder(CFG, block_size=4, draft=d,
+                              decode_kernel=False)
+    assert np.array_equal(np.asarray(obj(d.params, prompt, 6)), a)
+    with pytest.raises(EnforceError):
+        paged_serve_builder(CFG, draft=5)         # > num_layers
