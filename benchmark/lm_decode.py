@@ -186,6 +186,136 @@ def _bench_spec(args, cfg, params, jax):
         tokens_per_s=round(gen / wall, 1))
 
 
+def _bench_mixed_batch(args, cfg, params, jax):
+    """``--mixed-batch``: unified-step mixed prefill+decode benchmark.
+
+    A burst of short-prompt requests decodes while LONG ``--prompt``
+    prompts arrive mid-stream (one every few steps), optionally with
+    ``--spec K`` verify stacked — the workload the unified ragged step
+    exists for.  The SAME staggered burst runs twice in one process:
+    ``unified_step=True`` (one compiled step program; ragged windows
+    serve decode, tail prefill, and verify) and ``unified_step=False``
+    (the legacy separate-program engine) — greedy streams are asserted
+    bit-identical with the kernel off, and reported (``streams_match``)
+    with ``--paged-kernel on``, where the unified prefill's kernel and
+    the legacy XLA prefill reduce in different orders under bf16.
+    Two numbers per engine ride the row next to ms/token:
+
+    * ``decode_stall_ms`` — median wall time of a step in which a long
+      prompt was ADMITTED minus the median plain step, i.e. the extra
+      latency a concurrent admission adds to every in-flight decode
+      stream (the SLO number the ROADMAP frontend item cares about);
+    * ``ragged_dispatches`` — ``serving_kernel_dispatch_total`` by
+      form, nonzero ``ragged`` proving the kernel (not the XLA gather
+      fallback) served the multi-token windows when ``--paged-kernel
+      on``."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+
+    plen, steps, bs = args.prompt, args.steps, args.block_size
+    short = max(8, plen // 4)
+    slots = min(args.batch, 8)
+    k = args.spec
+    spec = (SpecConfig(k=k, draft_layers=args.draft_layers)
+            if k else None)
+    per_req = -(-(plen + steps + k) // bs)
+    pool = args.pool_blocks or (slots + 2) * per_req + 4
+    kern = {"auto": None, "on": True, "off": False}[args.paged_kernel]
+    rs = np.random.RandomState(3)
+    shorts = [rs.randint(0, args.vocab, short).astype(np.int32)
+              for _ in range(slots)]
+    longs = [rs.randint(0, args.vocab, plen).astype(np.int32)
+             for _ in range(max(2, slots // 2))]
+
+    def drive(unified):
+        reg = telemetry.MetricsRegistry(
+            f"mixed_{'unified' if unified else 'legacy'}")
+        eng = PagedServingEngine(
+            cfg, params, num_slots=slots, num_blocks=pool,
+            block_size=bs, prompt_buckets=(short, plen),
+            decode_kernel=kern, spec=spec, unified_step=unified,
+            metrics=reg, seed=0)
+        # warm-up: one short + one long admission compiles every
+        # program both modes will touch, so the measured burst is
+        # compile-free in each
+        eng.submit(shorts[0], max_new=2)
+        eng.submit(longs[0], max_new=2)
+        eng.run()
+
+        t0 = time.perf_counter()
+        for p in shorts:
+            eng.submit(p, max_new=steps)
+        queue = list(longs)
+        plain, stall = [], []
+        i = 0
+        while eng.host_state()["queue_depth"] \
+                or any(s is not None
+                       for s in eng.host_state()["slots"]) or queue:
+            if queue and i >= 2 and i % 3 == 0:
+                # a long prompt lands while the shorts are mid-decode:
+                # the NEXT step carries its admission prefill
+                eng.submit(queue.pop(0), max_new=max(2, steps // 2))
+                admitting = True
+            else:
+                admitting = i == 0  # first step admits the short burst
+            s0 = time.perf_counter()
+            progressed = eng.step()
+            (stall if admitting else plain).append(
+                time.perf_counter() - s0)
+            if not progressed and not queue:
+                break
+            i += 1
+        out = eng.pop_results()
+        wall = time.perf_counter() - t0
+        disp = {s["labels"]["form"]: int(s["value"]) for s in
+                reg.snapshot()["metrics"]
+                ["serving_kernel_dispatch_total"]["series"]}
+        med = (lambda xs: sorted(xs)[len(xs) // 2] if xs else 0.0)
+        stall_ms = max(0.0, (med(stall) - med(plain)) * 1e3)
+        return (eng, {r: list(map(int, out[r])) for r in sorted(out)},
+                wall, stall_ms, disp)
+
+    eng, out_u, wall_u, stall_u, disp_u = drive(True)
+    leg, out_l, wall_l, stall_l, _ = drive(False)
+    # With the kernel OFF both engines' prefills are XLA forms that
+    # reduce in the same order, so greedy streams must be bitwise
+    # equal.  With ``--paged-kernel on`` the unified prefill runs the
+    # ragged kernel while the legacy per-bucket prefill stays on the
+    # XLA layer_views form — under this bench's bf16 compute a greedy
+    # near-tie can flip, so identity is REPORTED in the row rather
+    # than asserted (decode and verify windows share one form either
+    # way; the f32 identity contract lives in tests/).
+    ident = out_u == out_l
+    if eng.decode_kernel is not True:
+        assert ident, ("greedy mixed-batch streams diverged: unified "
+                       "vs legacy engine")
+    gen = max(sum(len(v) for v in out_u.values()), 1)
+    lgen = max(sum(len(v) for v in out_l.values()), 1)
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} prompt{plen} "
+               f"mixed-batch x{slots}"
+               + (f" spec{k}" if k else ""),
+        value=round(wall_u * 1e3 / gen, 3),
+        unit="ms",                         # unified ms per token
+        backend=jax.default_backend(),
+        decoder="engine",
+        compiles=eng.compile_counts(),     # {'step':1,'prefill':1,...}
+        baseline_compiles=leg.compile_counts(),
+        spec_k=k or None,
+        draft_layers=args.draft_layers if k else None,
+        paged_kernel=bool(eng.decode_kernel),
+        block_size=bs,
+        pool_blocks=pool,
+        long_prompts=len(longs),
+        short_prompt=short,
+        decode_stall_ms=round(stall_u, 3),
+        baseline_decode_stall_ms=round(stall_l, 3),
+        baseline_ms_per_token=round(wall_l * 1e3 / lgen, 3),
+        ragged_dispatches=disp_u,
+        streams_match=ident,
+        tokens_per_s=round(gen / wall_u, 1))
+
+
 def _bench_frontend(args, cfg, params, jax):
     """``--frontend --engines N``: SLO front-end serving benchmark.
 
@@ -334,6 +464,17 @@ def main():
                          "next to a target-only baseline ms/token from "
                          "the same process (greedy streams asserted "
                          "bit-identical); requires --paged")
+    ap.add_argument("--mixed-batch", action="store_true",
+                    help="serve a STAGGERED mix through the paged "
+                         "engine: short prompts decode while long "
+                         "--prompt prompts arrive mid-stream (add "
+                         "--spec K to stack verify) — runs the "
+                         "unified-step engine AND the separate-program "
+                         "baseline in one process (greedy streams "
+                         "asserted bit-identical) and reports ms/token "
+                         "+ decode_stall_ms for both, plus the "
+                         "ragged-kernel dispatch counts; requires "
+                         "--paged")
     ap.add_argument("--draft-layers", type=int, default=1, metavar="N",
                     help="layers kept by the truncated-layer draft "
                          "(with --spec); N == --layers is the "
@@ -389,6 +530,12 @@ def main():
     if args.spec and not args.paged:
         ap.error("--spec requires --paged (speculative decoding lives "
                  "in the paged serving engine)")
+    if args.mixed_batch and not args.paged:
+        ap.error("--mixed-batch requires --paged (the unified step "
+                 "lives in the paged serving engine)")
+    if args.mixed_batch and (args.frontend or args.shared_prefix):
+        ap.error("--mixed-batch is its own row; drop "
+                 "--frontend/--shared-prefix")
     if args.spec and (args.frontend or args.shared_prefix):
         ap.error("--spec is its own row; drop "
                  "--frontend/--shared-prefix")
@@ -449,6 +596,15 @@ def main():
             params = serving_cast(params)
         if args.frontend:
             row = _bench_frontend(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
+        if args.mixed_batch:
+            row = _bench_mixed_batch(args, cfg, params, jax)
             from paddle_tpu import telemetry
             if args.telemetry_out:
                 telemetry.append_jsonl(

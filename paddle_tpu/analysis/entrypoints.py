@@ -199,10 +199,14 @@ def _paged_serve_step() -> LintTarget:
 
 @register_entrypoint("paged-engine-decode")
 def _paged_engine_decode() -> LintTarget:
+    # unified_step=False on this and the twins below: these entrypoints
+    # pin the LEGACY multi-program engine's decode/verify shapes (the
+    # baseline the unified step is measured against); the default
+    # engine's one-program form lints as paged-engine-step-ragged.
     from paddle_tpu.serving import PagedServingEngine
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
-                             prompt_buckets=(8,))
+                             prompt_buckets=(8,), unified_step=False)
     S = eng.S
     return LintTarget(
         "paged-engine-decode", eng._decode,
@@ -225,7 +229,8 @@ def _paged_engine_decode_prefix() -> LintTarget:
     from paddle_tpu.serving import PagedServingEngine
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
-                             prompt_buckets=(8,), prefix_cache=True)
+                             prompt_buckets=(8,), prefix_cache=True,
+                             unified_step=False)
     S = eng.S
     return LintTarget(
         "paged-engine-decode-prefix", eng._decode,
@@ -252,7 +257,8 @@ def _paged_engine_decode_faults() -> LintTarget:
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
                              prompt_buckets=(8,),
-                             faults=inj.scope("lint"))
+                             faults=inj.scope("lint"),
+                             unified_step=False)
     S = eng.S
     return LintTarget(
         "paged-engine-decode-faults", eng._decode,
@@ -299,7 +305,8 @@ def _paged_engine_decode_kernel() -> LintTarget:
     from paddle_tpu.serving import PagedServingEngine
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
-                             prompt_buckets=(8,), decode_kernel=True)
+                             prompt_buckets=(8,), decode_kernel=True,
+                             unified_step=False)
     S = eng.S
     return LintTarget(
         "paged-engine-decode-kernel", eng._decode,
@@ -324,7 +331,8 @@ def _paged_engine_decode_spec() -> LintTarget:
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
                              prompt_buckets=(8,),
-                             spec=SpecConfig(k=2, draft_layers=1))
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             unified_step=False)
     S, k = eng.S, eng.spec_k
     return LintTarget(
         "paged-engine-decode-spec", eng._verify,
@@ -334,3 +342,32 @@ def _paged_engine_decode_spec() -> LintTarget:
                           "dp over slot-major verify inputs (toks/"
                           "valid/temps); pool + block tables "
                           "replicated exactly as the decode twin"))
+
+
+@register_entrypoint("paged-engine-step-ragged")
+def _paged_engine_step_ragged() -> LintTarget:
+    # The UNIFIED ragged step (the default engine's ONE compiled
+    # program): plain decode is a width-1 query window, chunked tail
+    # prefill and k-token spec verify are wider windows, all appended
+    # and scored through the same per-row ragged causal bounds.
+    # Linting it proves the collapsed program keeps the decode-loop
+    # discipline the three legacy programs pinned separately: in-graph
+    # COW/reserve/append scatters, amortized chunked gathers, no host
+    # callbacks — the accept/reject decision stays on the host.  Built
+    # with spec= so the traced window width is k+1 (the widest form);
+    # qlens=1 rows trace the same program plain decode runs.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,),
+                             spec=SpecConfig(k=2, draft_layers=1))
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-ragged", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, eng._decode_slot_args,
+                          "dp over slot-major step inputs (toks/qlens/"
+                          "temps/done); pool + block tables replicated "
+                          "exactly as the legacy decode twin"))

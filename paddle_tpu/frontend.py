@@ -45,7 +45,7 @@ applied to our serving stack — it turns "a
   test (``tests/test_frontend.py``) sweeps fault schedules against.
 
 The frontend is HOST CODE ONLY: it never touches a traced program, so
-``compiles == {'decode': 1}`` holds per engine with the frontend on,
+``compiles == {'step': 1}`` holds per engine with the frontend on,
 and with one engine and no faults the per-request token streams are
 byte-for-byte the direct-engine behavior.
 
@@ -915,7 +915,7 @@ class ServingFrontend:
 
     def compile_counts(self) -> List[Optional[dict]]:
         """Per-seat ``compile_counts()`` — the chaos gate's
-        ``compiles == {'decode': 1}`` check, per live engine."""
+        ``compiles == {'step': 1}`` check, per live engine."""
         with self._lock:
             engines = [s.engine if s.state == _UP else None
                        for s in self._seats]

@@ -468,33 +468,40 @@ def decode_kernel_scope(select):
 
 
 def resolve_decode_kernel(select, *, block_size: int, num_heads: int,
-                          head_dim: int, kv_dtype=jnp.float32) -> bool:
+                          head_dim: int, kv_dtype=jnp.float32,
+                          max_q: int = 1) -> bool:
     """Resolve a builder's tri-state ``decode_kernel`` knob to the bool
     it stores and scopes: ``None`` auto-selects (TPU backend + fusion
     enabled + shape within the kernel's VMEM budget); ``True`` forces
     the kernel wherever the shape is supported (interpret mode off-TPU);
-    ``False`` forces the XLA gather form.  A forced ``True`` on an
-    unsupported shape still resolves ``False`` — oversized configs must
-    degrade to the fallback, never OOM Mosaic."""
+    ``False`` forces the XLA gather form.  ``max_q`` widens the budget
+    check to a ragged query window (1 = plain decode).  A forced
+    ``True`` on an unsupported shape still resolves ``False`` —
+    oversized configs must degrade to the fallback, never OOM Mosaic."""
     from paddle_tpu.ops.pallas_paged_attention import (
         paged_attention_supported)
     supported = paged_attention_supported(block_size, num_heads,
-                                          head_dim, kv_dtype)
+                                          head_dim, kv_dtype,
+                                          max_q=max_q)
     if select is None:
         from paddle_tpu.ops.pallas_kernels import _fusion_on, _on_tpu
         return bool(supported and _on_tpu() and _fusion_on())
     return bool(select and supported)
 
 
-#: Typed reasons a kernel-selected decode-attention call dispatched to
+#: Typed reasons a kernel-selected paged-attention call dispatched to
 #: the XLA form anyway — the values ``serving_kernel_fallback_total``
-#: labels by.  ``multi_token_query``: the Pallas kernel serves t=1
-#: decode queries only, so chunked/verify steps (t>1) take the gather
-#: form by design.  ``traced_scale``: the kernel closes over a static
-#: scale; a traced scalar cannot specialize it.  ``unsupported_shape``:
-#: the shape is past the kernel's VMEM budget (resolve_decode_kernel
-#: would also have resolved False at build time).
-KERNEL_FALLBACK_REASONS = ("multi_token_query", "traced_scale",
+#: labels by.  ``ragged_unsupported_shape``: the base shape fits the
+#: kernel at t=1 but this call's t>1 ragged query window busts the
+#: VMEM budget (q/o blocks and softmax scratch scale with t) — the
+#: successor of the retired ``multi_token_query`` reason, fired only
+#: for GENUINELY unsupported windows now that the ragged kernel serves
+#: chunked prefill and verify shapes natively.  ``traced_scale``: the
+#: kernel closes over a static scale; a traced scalar cannot
+#: specialize it.  ``unsupported_shape``: the shape is past the
+#: kernel's VMEM budget at t=1 already (resolve_decode_kernel would
+#: also have resolved False at build time).
+KERNEL_FALLBACK_REASONS = ("ragged_unsupported_shape", "traced_scale",
                            "unsupported_shape")
 
 _fallback_observer = threading.local()
@@ -526,6 +533,37 @@ def _note_fallback(reason) -> None:
         obs(reason)
 
 
+#: Forms the dispatch observer labels by: ``decode`` = a t=1 query
+#: window took the kernel, ``ragged`` = a multi-token (chunked prefill
+#: / spec verify) window took it.
+KERNEL_DISPATCH_FORMS = ("decode", "ragged")
+
+_dispatch_observer = threading.local()
+
+
+@contextlib.contextmanager
+def kernel_dispatch_scope(observer):
+    """Install a host observer fired AT TRACE TIME with a form (one of
+    :data:`KERNEL_DISPATCH_FORMS`) whenever a paged-attention call
+    dispatches to the Pallas kernel — the positive twin of
+    :func:`kernel_fallback_scope`, so a compile set can be AUDITED for
+    nonzero ragged-kernel invocations (the selfcheck mixed-batch gate)
+    rather than inferred from the absence of fallbacks.  Strictly
+    host-side, invisible to the traced bytes."""
+    prev = getattr(_dispatch_observer, "value", None)
+    _dispatch_observer.value = observer
+    try:
+        yield
+    finally:
+        _dispatch_observer.value = prev
+
+
+def _note_dispatch(form: str) -> None:
+    obs = getattr(_dispatch_observer, "value", None)
+    if obs is not None:
+        obs(form)
+
+
 def _fallback_reason(q, k_pages, scale):
     """Why a kernel-selected call is NOT taking the kernel — a typed
     reason string, or ``None`` when the kernel was never selected (the
@@ -538,8 +576,10 @@ def _fallback_reason(q, k_pages, scale):
     if not paged_attention_supported(k_pages.shape[1], k_pages.shape[2],
                                      k_pages.shape[3], k_pages.dtype):
         return "unsupported_shape"
-    if q.shape[1] != 1:
-        return "multi_token_query"
+    if q.shape[1] > 1 and not paged_attention_supported(
+            k_pages.shape[1], k_pages.shape[2], k_pages.shape[3],
+            k_pages.dtype, max_q=q.shape[1]):
+        return "ragged_unsupported_shape"
     if scale is not None:
         try:
             float(scale)
@@ -549,9 +589,9 @@ def _fallback_reason(q, k_pages, scale):
 
 
 def _use_kernel(q, k_pages, scale) -> bool:
-    """Trace-time dispatch decision for :func:`paged_decode_attention`."""
-    if q.shape[1] != 1:
-        return False            # kernel serves 1-token decode queries
+    """Trace-time dispatch decision for :func:`paged_decode_attention`
+    and :func:`paged_chunked_attention` — the ragged kernel serves any
+    query width whose working set fits the VMEM budget."""
     if scale is not None:
         try:                    # kernel closes over a static scale
             float(scale)
@@ -560,7 +600,8 @@ def _use_kernel(q, k_pages, scale) -> bool:
     select = getattr(_decode_kernel_override, "value", None)
     return resolve_decode_kernel(
         select, block_size=k_pages.shape[1], num_heads=k_pages.shape[2],
-        head_dim=k_pages.shape[3], kv_dtype=k_pages.dtype)
+        head_dim=k_pages.shape[3], kv_dtype=k_pages.dtype,
+        max_q=q.shape[1])
 
 
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
@@ -581,12 +622,19 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     cache path over the same tokens; the interpret-mode parity suite
     pins kernel == fallback within 1e-6 on every nasty shape.
     """
-    if _use_kernel(q, k_pages, scale):
+    if q.shape[1] == 1 and _use_kernel(q, k_pages, scale):
         from paddle_tpu.ops.pallas_paged_attention import (
             paged_decode_attention_kernel)
+        _note_dispatch("decode")
         return paged_decode_attention_kernel(q, k_pages, v_pages,
                                              block_table, lengths, scale)
-    _note_fallback(_fallback_reason(q, k_pages, scale))
+    # t>1 through THIS entrypoint is the uniform-bound form (every
+    # query attends the same lengths[r] tokens, no causal offset) —
+    # the ragged kernel implements the chunked per-query bound, so
+    # multi-token windows take the kernel via paged_chunked_attention;
+    # here the gather form is the defined semantics, not a fallback.
+    if q.shape[1] == 1:
+        _note_fallback(_fallback_reason(q, k_pages, scale))
     return _paged_decode_attention_xla(q, k_pages, v_pages, block_table,
                                        lengths, scale)
 
@@ -646,13 +694,25 @@ def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
     contract (pinned by ``tests/test_prefix_cache.py``).  Query
     columns at or past ``append_valid[r]`` are pad lanes: don't-care
     outputs the caller never reads.
+
+    Dispatch mirrors :func:`paged_decode_attention`: the RAGGED Pallas
+    kernel serves any window width whose working set fits the VMEM
+    budget (the ``multi_token_query`` fallback reason is retired); a
+    kernel-selected call past the budget surfaces the typed
+    ``ragged_unsupported_shape`` reason and takes the gather form.
     """
     b, tq, h, hd = q.shape
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
+    if _use_kernel(q, k_pages, scale):
+        from paddle_tpu.ops.pallas_paged_attention import (
+            paged_ragged_attention_kernel)
+        _note_dispatch("ragged" if tq > 1 else "decode")
+        return paged_ragged_attention_kernel(q, k_pages, v_pages,
+                                             block_table, lengths, scale)
     scale = (hd ** -0.5) if scale is None else scale
-    # a kernel-selected caller (the speculative VERIFY step) lands here
-    # because the kernel serves t=1 only — surface the typed reason
+    # a kernel-selected caller past the ragged VMEM budget (or with a
+    # traced scale) lands here — surface the typed reason
     _note_fallback(_fallback_reason(q, k_pages, scale))
     table = jnp.clip(block_table, 0, nb - 1)
     # tpu-lint: disable=gather-in-decode — chunked TAIL PREFILL / speculative VERIFY, not a per-token decode step: one gather covers t tokens, amortized

@@ -1,10 +1,12 @@
-"""Pallas TPU paged-attention decode kernel (Ragged Paged Attention style).
+"""Pallas TPU RAGGED paged-attention kernel (Ragged Paged Attention).
 
-The XLA gather form in ``ops/paged_attention.py`` materializes
-``k_pages[table]`` as ``[b, max_blocks*bs, h, hd]`` every decode step —
-HBM traffic proportional to the WORST-CASE table capacity, twice (K and
-V), regardless of how many tokens each row actually holds.  This kernel
-streams the same pages block-by-block instead, the TPU-native shape
+The XLA gather forms in ``ops/paged_attention.py`` materialize
+``k_pages[table]`` as ``[b, max_blocks*bs, h, hd]`` every step — HBM
+traffic proportional to the WORST-CASE table capacity, twice (K and
+V), regardless of how many tokens each row actually holds.  This ONE
+kernel streams the same pages block-by-block instead and serves every
+query shape the engine has — chunked prefill windows, plain t=1
+decode, and speculative k+1 verify windows — the TPU-native shape
 (Ragged Paged Attention, PAPERS.md):
 
 * grid ``(batch row, KV-head group, page)`` — the page axis is the
@@ -14,13 +16,20 @@ streams the same pages block-by-block instead, the TPU-native shape
   BlockSpec index map — the Pallas pipeline double-buffers the
   HBM->VMEM page copies against compute, and nothing bigger than one
   ``[block_size, group, hd]`` block per pool ever sits in VMEM;
+* the query window is RAGGED per row: alongside the table, the
+  scalar-prefetched per-row base ``lengths`` place each row's ``t``
+  query columns at positions ``lengths[r] + j`` with the per-query
+  causal bound ``kpos < lengths[r] + j + 1`` — one compiled program
+  covers rows mid-prefill, rows decoding one token, and rows verifying
+  a draft window, mixed freely in a batch;
 * online-softmax accumulation (the ``blockwise_attn_chunk`` merge rule)
-  in f32 VMEM scratch across the page loop — running max / sum / acc,
-  one division at the end, no ``[b, K]`` weight matrix anywhere;
-* per-row ``lengths`` masking with the same finite ``NEG_INF``
-  convention as the fallback: positions past a row's length — garbage
-  tails inside the last real page, unwritten pages behind clipped
-  ``-1`` table entries — get exactly-zero weight, so the kernel is
+  in f32 VMEM scratch across the page loop — running max / sum / acc
+  per (head, query column), one division at the end, no ``[b, K]``
+  weight matrix anywhere;
+* masking keeps the same finite ``NEG_INF`` convention as the
+  fallback: positions past a query's bound — garbage tails inside the
+  last real page, unwritten pages behind clipped ``-1`` table entries,
+  pad query lanes — get exactly-zero weight, so the kernel is
   numerically the fallback's twin (the interpret-mode parity suite
   pins max-abs <= 1e-6 on f32 pools).
 
@@ -54,7 +63,8 @@ except ImportError:  # pragma: no cover
 
 from paddle_tpu.ops.pallas_kernels import _on_tpu
 
-__all__ = ["paged_decode_attention_kernel", "paged_attention_supported"]
+__all__ = ["paged_decode_attention_kernel",
+           "paged_ragged_attention_kernel", "paged_attention_supported"]
 
 NEG_INF = -1e30   # finite mask value — MUST match ops/paged_attention.py
 
@@ -71,8 +81,11 @@ _PAGED_RESIDENT_BUDGET = 14 * 1024 * 1024 + 512 * 1024
 
 
 def _paged_vmem_bytes(block_size: int, group: int, head_dim: int,
-                      kv_dtype) -> int:
-    """Estimated VMEM residency of one grid step at head-group ``group``.
+                      kv_dtype, max_q: int = 1) -> int:
+    """Estimated VMEM residency of one grid step at head-group ``group``
+    and query-window width ``max_q`` (1 = plain decode; ragged
+    prefill/verify windows widen the q/o blocks and the softmax scratch
+    but never the streamed page blocks).
 
     The streamed blocks (one K and one V page slice of
     ``[block_size, group, head_dim]``) are double-buffered by the Pallas
@@ -83,47 +96,63 @@ def _paged_vmem_bytes(block_size: int, group: int, head_dim: int,
     """
     per_elt = 6 if jnp.dtype(kv_dtype) == jnp.bfloat16 else 4
     streamed = 2 * 2 * block_size * group * head_dim * per_elt  # K+V, 2-buf
-    qo = 2 * 2 * group * head_dim * 4        # q in + f32 out blocks, 2-buf
-    scratch = group * head_dim * 4 + 2 * group * 4   # acc + (m, l)
+    qo = 2 * 2 * max_q * group * head_dim * 4  # q in + f32 out, 2-buf
+    scratch = (max_q * group * head_dim * 4    # acc
+               + 2 * max_q * group * 4)        # (m, l)
     return streamed + qo + scratch
 
 
 def _head_group(num_heads: int, block_size: int, head_dim: int,
-                kv_dtype) -> int:
+                kv_dtype, max_q: int = 1) -> int:
     """Heads per grid step: the largest divisor of ``num_heads`` whose
     working set fits the budget, 0 when even one head does not fit
     (the caller must fall back)."""
     for g in range(num_heads, 0, -1):
         if num_heads % g:
             continue
-        if _paged_vmem_bytes(block_size, g, head_dim,
-                             kv_dtype) <= _PAGED_RESIDENT_BUDGET:
+        if _paged_vmem_bytes(block_size, g, head_dim, kv_dtype,
+                             max_q) <= _PAGED_RESIDENT_BUDGET:
             return g
     return 0
 
 
 def paged_attention_supported(block_size: int, num_heads: int,
-                              head_dim: int,
-                              kv_dtype=jnp.float32) -> bool:
-    """Shape/VMEM gate for the paged decode kernel (the
+                              head_dim: int, kv_dtype=jnp.float32,
+                              max_q: int = 1) -> bool:
+    """Shape/VMEM gate for the paged attention kernel (the
     ``pallas_supported`` twin): True when some head group's working set
-    fits the budget.  The dispatcher falls back to the XLA gather form
-    otherwise — oversized configs must degrade, not OOM Mosaic."""
+    fits the budget at query-window width ``max_q``.  The dispatcher
+    falls back to the XLA gather form otherwise — oversized configs
+    must degrade, not OOM Mosaic."""
     if pltpu is None:
         return False
-    return _head_group(num_heads, block_size, head_dim, kv_dtype) > 0
+    if max_q < 1:
+        return False
+    return _head_group(num_heads, block_size, head_dim, kv_dtype,
+                       max_q) > 0
 
 
-def _decode_kernel(group: int, scale: float, table_ref, lens_ref,
-                   q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
-    """One (row, head-group, page) grid step of the online softmax.
+def _ragged_kernel(group: int, tq: int, scale: float, table_ref,
+                   lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                   m_ref, l_ref):
+    """One (row, head-group, page) grid step of the online softmax over
+    a RAGGED query window.
 
     Refs: ``table_ref``/``lens_ref`` are the scalar-prefetch operands
-    (the clipped block table and per-row lengths), ``q_ref`` is the
-    row's ``[1, 1, group, hd]`` query block, ``k_ref``/``v_ref`` the
-    page's ``[1, bs, group, hd]`` pool blocks fetched by table lookup
-    in the index map.  Scratch carries the running (acc, max, sum) in
-    f32 across the page loop; the output writes once, on the last page.
+    (the clipped block table and per-row committed base lengths),
+    ``q_ref`` is the row's ``[1, tq, group, hd]`` query-window block,
+    ``k_ref``/``v_ref`` the page's ``[1, bs, group, hd]`` pool blocks
+    fetched by table lookup in the index map.  Query column ``j`` sits
+    at logical position ``lens[row] + j`` and takes the per-query
+    causal bound ``kpos < lens[row] + j + 1`` — exactly the
+    ``paged_chunked_attention`` limit, so masked/garbage positions
+    (unwritten pages behind clipped ``-1`` table entries, pad query
+    lanes past a row's real window) carry the finite ``NEG_INF`` bias
+    and contribute exactly-zero weight; pad-lane OUTPUTS are the same
+    don't-care values the XLA form computes.  Scratch carries the
+    running (acc, max, sum) in f32 across the page loop, ``tq`` rows
+    per head (head-major: head ``i`` owns scratch rows
+    ``[i*tq, (i+1)*tq)``); the output writes once, on the last page.
     """
     b_i = pl.program_id(0)
     p = pl.program_id(2)
@@ -138,63 +167,80 @@ def _decode_kernel(group: int, scale: float, table_ref, lens_ref,
 
     # Page p's block holds global positions [p*bs, (p+1)*bs): the
     # logical position IS the flattened (page, offset) index, the same
-    # invariant the fallback's reshape relies on.  Everything at or
-    # past the row's length — the garbage tail of the last real page,
-    # whole unwritten pages behind clipped -1 table entries — takes the
-    # finite NEG_INF bias and exactly-zero weight out of the exp.
-    pos = p * bs + lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-    bias = jnp.where(pos < lens_ref[b_i], 0.0, NEG_INF)      # [1, bs] f32
+    # invariant the fallback's reshape relies on.  Query j attends the
+    # row's committed prefix plus the fresh window up to itself:
+    # kpos < lens + j + 1 (j = 0 with lens passed one short reproduces
+    # the plain decode mask kpos < lengths).
+    pos = p * bs + lax.broadcasted_iota(jnp.int32, (tq, bs), 1)
+    limit = (lens_ref[b_i] + 1
+             + lax.broadcasted_iota(jnp.int32, (tq, bs), 0))
+    bias = jnp.where(pos < limit, 0.0, NEG_INF)         # [tq, bs] f32
 
     for i in range(group):                  # static unroll over the group
-        q_i = q_ref[0, 0, i:i + 1, :]                        # [1, hd]
+        r0 = i * tq
+        q_i = q_ref[0, :, i, :]                              # [tq, hd]
         k_i = k_ref[0, :, i, :]                              # [bs, hd]
         s = lax.dot_general(q_i, k_i, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        s = s * scale + bias                                 # [1, bs] f32
-        m_prev = m_ref[i:i + 1, :]                           # [1, 1]
-        l_prev = l_ref[i:i + 1, :]
+        s = s * scale + bias                                 # [tq, bs] f32
+        m_prev = m_ref[r0:r0 + tq, :]                        # [tq, 1]
+        l_prev = l_ref[r0:r0 + tq, :]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        w = jnp.exp(s - m_new)                               # [1, bs]
+        w = jnp.exp(s - m_new)                               # [tq, bs]
         v_i = v_ref[0, :, i, :].astype(jnp.float32)          # [bs, hd]
         pv = lax.dot_general(w, v_i, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        acc_ref[i:i + 1, :] = acc_ref[i:i + 1, :] * alpha + pv
-        l_ref[i:i + 1, :] = l_prev * alpha + jnp.sum(w, axis=1,
-                                                     keepdims=True)
-        m_ref[i:i + 1, :] = m_new
+        acc_ref[r0:r0 + tq, :] = acc_ref[r0:r0 + tq, :] * alpha + pv
+        l_ref[r0:r0 + tq, :] = l_prev * alpha + jnp.sum(
+            w, axis=1, keepdims=True)
+        m_ref[r0:r0 + tq, :] = m_new
 
     @pl.when(p == n_pages - 1)
     def _():
-        o_ref[0, 0] = acc_ref[:] / l_ref[:]
+        for i in range(group):
+            r0 = i * tq
+            o_ref[0, :, i, :] = (acc_ref[r0:r0 + tq, :]
+                                 / l_ref[r0:r0 + tq, :])
 
 
-def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
+def paged_ragged_attention_kernel(q: jax.Array, k_pages: jax.Array,
                                   v_pages: jax.Array,
                                   block_table: jax.Array,
                                   lengths: jax.Array, scale=None, *,
                                   interpret=None, head_group=None):
-    """Fused block-table decode attention — the Pallas twin of the XLA
-    gather form behind the exact same ``(q, pools, table, lengths) ->
-    [b, 1, h, hd] f32`` contract (``ops/paged_attention.py``).
+    """Fused block-table RAGGED attention — one program for chunked
+    prefill, plain decode, and speculative verify windows, the Pallas
+    twin of ``paged_chunked_attention``'s XLA gather form behind the
+    exact same ``(q [b, t, h, hd], pools, table, lengths) ->
+    [b, t, h, hd] f32`` contract.
+
+    ``lengths`` is each row's COMMITTED token count BEFORE the fresh
+    window (the ``paged_chunked_attention`` convention): query column
+    ``j`` sits at position ``lengths[r] + j`` and attends
+    ``kpos < lengths[r] + j + 1``.  The window is ragged per row via
+    ``lengths`` — rows with fewer than ``t`` real fresh tokens get
+    don't-care pad-lane outputs identical to the XLA form's, and a row
+    with ``lengths == 0`` attends only its own fresh tokens.
 
     ``interpret=None`` auto-selects interpret mode off-TPU (the CPU
     test path); ``head_group`` overrides the VMEM-fitted heads-per-step
     (tests exercise group 1 vs all-heads explicitly).  Call through
-    ``paged_decode_attention`` unless you are the dispatcher or a test.
+    ``paged_chunked_attention`` / ``paged_decode_attention`` unless you
+    are the dispatcher or a test.
     """
     b, tq, h, hd = q.shape
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
-    assert tq == 1, f"decode kernel serves 1-token queries, got t={tq}"
+    assert tq >= 1, f"ragged kernel needs t >= 1 query columns, got {tq}"
     scale = (hd ** -0.5) if scale is None else float(scale)
     if interpret is None:
         interpret = not _on_tpu()
-    g = head_group or _head_group(h, bs, hd, k_pages.dtype)
+    g = head_group or _head_group(h, bs, hd, k_pages.dtype, tq)
     assert 0 < g <= h and h % g == 0, (
         f"no head group fits VMEM for block_size={bs} heads={h} "
-        f"head_dim={hd} — the dispatcher should have taken the XLA "
-        "fallback (paged_attention_supported)")
+        f"head_dim={hd} max_q={tq} — the dispatcher should have taken "
+        "the XLA fallback (paged_attention_supported)")
     # Same clip as the fallback: a -1 (unmapped) entry fetches page 0,
     # whose positions are all >= the row's length and mask to zero.
     table = jnp.clip(block_table, 0, nb - 1).astype(jnp.int32)
@@ -208,23 +254,47 @@ def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
         num_scalar_prefetch=2,               # (table, lens) ride in SMEM
         grid=(b, h // g, maxb),
         in_specs=[
-            pl.BlockSpec((1, 1, g, hd),
+            pl.BlockSpec((1, tq, g, hd),
                          lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
             pl.BlockSpec((1, bs, g, hd),
                          lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
             pl.BlockSpec((1, bs, g, hd),
                          lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, hd),
+        out_specs=pl.BlockSpec((1, tq, g, hd),
                                lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g, hd), jnp.float32),    # acc
-            pltpu.VMEM((g, 1), jnp.float32),     # running max
-            pltpu.VMEM((g, 1), jnp.float32),     # running sum
+            pltpu.VMEM((g * tq, hd), jnp.float32),   # acc, head-major
+            pltpu.VMEM((g * tq, 1), jnp.float32),    # running max
+            pltpu.VMEM((g * tq, 1), jnp.float32),    # running sum
         ])
     return pl.pallas_call(
-        functools.partial(_decode_kernel, g, scale),
+        functools.partial(_ragged_kernel, g, tq, scale),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, h, hd), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, tq, h, hd), jnp.float32),
         interpret=interpret,
         **kwargs)(table, lens, q, k_pages, v_pages)
+
+
+def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
+                                  v_pages: jax.Array,
+                                  block_table: jax.Array,
+                                  lengths: jax.Array, scale=None, *,
+                                  interpret=None, head_group=None):
+    """Fused block-table decode attention — the t=1 face of the ragged
+    kernel behind the exact same ``(q, pools, table, lengths) ->
+    [b, 1, h, hd] f32`` contract as the XLA gather form
+    (``ops/paged_attention.py``).
+
+    ``lengths`` here INCLUDES the fresh token (the decode convention:
+    mask is ``kpos < lengths``), so the ragged kernel — whose bound is
+    ``kpos < base + j + 1`` — takes ``base = lengths - 1``, unclamped:
+    a row with ``lengths == 0`` yields an all-masked (garbage-softmax)
+    lane on both paths, the finite-NEG_INF parity contract.
+    """
+    b, tq, h, hd = q.shape
+    assert tq == 1, f"decode kernel serves 1-token queries, got t={tq}"
+    lens = jnp.asarray(lengths, jnp.int32)
+    return paged_ragged_attention_kernel(
+        q, k_pages, v_pages, block_table, lens - 1, scale,
+        interpret=interpret, head_group=head_group)

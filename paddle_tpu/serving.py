@@ -354,7 +354,7 @@ class PagedServingEngine:
     kernel on TPU / XLA gather elsewhere, True forces the kernel —
     interpret mode off-TPU, the CI path — False forces the gather
     form); the resolved bool lands in ``self.decode_kernel`` and the
-    ``compiles == {'decode': 1}`` pin holds either way.
+    ``compiles == {'step': 1}`` pin holds either way.
 
     ``prefix_cache=True`` turns on PREFIX SHARING: every admitted
     prompt's blocks register in a host-side radix tree over
@@ -389,10 +389,23 @@ class PagedServingEngine:
     block-table cursor (``paged_rollback`` — a pointer truncation that
     respects refcounts, so prefix sharing composes).  Per-slot verify
     windows shrink near ``max_new`` so transient cache lengths never
-    exceed the admission reservation, and a step where every live slot
-    needs exactly one more token runs the PLAIN decode program — the
-    compile contract with speculation on is ``{'decode': 1, 'verify':
-    1, 'draft': 1}`` (plus one draft-prefill compile per bucket used).
+    exceed the admission reservation.
+
+    ``unified_step=True`` (the default) serves plain decode, chunked
+    tail prefill, and the speculative verify window through ONE
+    compiled ragged step program (``compile_counts()['step']``): each
+    row carries its own query-window width (``qlens``) against its
+    committed base, and the ragged Pallas paged-attention kernel (or
+    its XLA twin) masks per-query causal bounds, so the compile set is
+    ``{'step': 1, 'prefill': 1}`` — plus ``{'draft': 1,
+    'draft_prefill': 1}`` with speculation — regardless of prompt
+    widths, batch mix, or verify windows.  Prefill pads to the single
+    ``max(prompt_buckets)`` width instead of compiling per bucket.
+    ``unified_step=False`` keeps the legacy multi-program engine
+    (separate decode/prefill/tail/verify programs; with speculation
+    the compile contract is ``{'decode': 1, 'verify': 1, 'draft': 1}``
+    plus one prefill compile per bucket used) — retained as the
+    bit-identity baseline the unified step is pinned against.
 
     The engine is deeply instrumented through ``paddle_tpu.telemetry``
     (``metrics=`` takes a :class:`~paddle_tpu.telemetry.MetricsRegistry`;
@@ -440,7 +453,8 @@ class PagedServingEngine:
                  flight_window_s: float = 30.0, decode_kernel=None,
                  prefix_cache: bool = False,
                  max_queue: Optional[int] = None, faults=None,
-                 spec: Optional[SpecConfig] = None, draft=None):
+                 spec: Optional[SpecConfig] = None, draft=None,
+                 unified_step: bool = True):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -560,37 +574,13 @@ class PagedServingEngine:
                                    jnp.zeros((1,), bool))
                 return cache, tok0[0], done0[0], ok & cok
 
-        # The cache (pool + block tables) is DEAD the moment each step
-        # returns its successor — donate it so XLA updates the pool
-        # in place instead of holding two copies of the engine's
-        # biggest buffer live across every decode step (the
-        # donation-audit lint rule's canonical case; CPU ignores
-        # donation, TPU honors it).
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
-        # shard-check contract: decode_fn args 2..5 (tok, active,
-        # temps, done) are slot-major [S] vectors — the lint mesh
-        # recipe shards them on the data axis; params and the paged
-        # pool stay replicated (multi-chip pool sharding is the
-        # ROADMAP item this gate de-risks).
-        self._decode_slot_args = (2, 3, 4, 5)
-        self._free = jax.jit(paged.paged_free, donate_argnums=(0,))
-        watched = dict(decode=self._decode, prefill=self._prefill)
-        if sharing:
-            # prefix-sharing host transforms: share/pin are tiny
-            # refcount/table updates, the tail prefill compiles once
-            # per TAIL pad width used (the decode pin is untouched —
-            # tests key on compile_counts()['decode'])
-            self._prefill_tail = jax.jit(prefill_tail_fn,
-                                         donate_argnums=(1,))
-            self._share = jax.jit(paged.paged_share, donate_argnums=(0,))
-            self._rc_add = jax.jit(paged.paged_rc_add,
-                                   donate_argnums=(0,))
-            watched["prefill_tail"] = self._prefill_tail
-            watched["share"] = self._share
+        # Speculation config resolves FIRST: the unified step's static
+        # window width is k+1 with a draft attached (verify windows),
+        # 1 without (plain decode).
         self.spec = spec
         self.spec_k = None
         self.draft = None
+        dmodel = None
         if spec is not None:
             enforce(isinstance(spec, SpecConfig),
                     "spec must be a SpecConfig, got %r", type(spec))
@@ -605,9 +595,142 @@ class PagedServingEngine:
             k = int(spec.k)
             self.spec_k = k
             dmodel = _paged_model(draft.cfg, attn_fn)
-            restrict = _restrict_logits(cfg, top_k, top_p)
-            V = cfg.vocab_size
-            arange_s = jnp.arange(S)
+        restrict = _restrict_logits(cfg, top_k, top_p)
+        V = cfg.vocab_size
+        arange_s = jnp.arange(S)
+        self._unified = bool(unified_step)
+        #: static query-window width of the unified step program
+        self.step_width = 1 if spec is None else self.spec_k + 1
+        #: the ONE ragged-prefill pad width (replaces per-bucket
+        #: prefill compiles in unified mode)
+        self._prefill_width = max(self.buckets)
+
+        def step_fn(params, cache, toks, qlens, temps, done, key):
+            # THE unified ragged step: every live slot appends and
+            # scores ``qlens[s]`` fresh tokens (0 = idle this call)
+            # through ONE compiled program — a plain-decode row is a
+            # width-1 window, a speculative verify row a 1+drafts
+            # window, all served by the ragged paged-attention kernel
+            # (per-query causal bounds against the per-row committed
+            # base).  Outputs: the sampled/greedy next token at each
+            # row's last real window column (the decode contract), the
+            # per-column argmax (greedy accept), and — with a draft
+            # attached — the restricted/tempered per-column target
+            # distributions rejection sampling consumes.  Idle and pad
+            # lanes compute don't-care values the host never reads.
+            W = self.step_width
+            with paged.decode_kernel_scope(use_kernel), \
+                    paged.kernel_fallback_scope(
+                        self._note_kernel_fallback), \
+                    paged.kernel_dispatch_scope(
+                        self._note_kernel_dispatch):
+                if sharing:
+                    # un-share each appending slot's cursor block
+                    # before the write (cond-gated in-graph COW)
+                    cache, cok = paged.paged_cow(cache, qlens)
+                cache, ok = paged.paged_reserve(cache, qlens)
+                views = paged.chunked_layer_views(cache, arange_s,
+                                                  qlens)
+                pos_ids = (cache.lengths[:, None]
+                           + jnp.arange(W)[None, :])
+                (lg, views), _ = model.apply(params, {}, None, toks,
+                                             views, pos_ids)
+                cache = paged.paged_advance(
+                    paged.merge_views(cache, views), qlens)
+                lf = lg.astype(jnp.float32)               # [S, W, V]
+                greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+                last = jnp.take_along_axis(
+                    lg, jnp.maximum(qlens - 1, 0)[:, None, None],
+                    axis=1)[:, 0]                         # [S, V]
+                pick = _sampling_picker(cfg, temps, jnp.int32, eos_id,
+                                        top_k, top_p)
+                nxt, done = pick(last, key, done)
+                if sharing:
+                    ok = ok & cok
+                if spec is not None:
+                    tcol = jnp.maximum(temps, 1e-6)[:, None, None]
+                    probs = jax.nn.softmax(restrict(
+                        (lf / tcol).reshape(S * W, V)),
+                        axis=-1).reshape(S, W, V)
+                    return cache, nxt, done, greedy, probs, ok
+                return cache, nxt, done, greedy, ok
+
+        def prefill_ragged_fn(params, cache, slot, toks, tlen, temp,
+                              key):
+            # ONE ragged prefill program for fresh prompts AND
+            # prefix-hit tails: append ``tlen`` tokens to ``slot`` at
+            # its current committed base (0 for a fresh slot,
+            # shared_len after paged_share) and score them through the
+            # chunked view — the per-query causal bound makes the
+            # fresh-prompt case (base 0) and the tail case one shape,
+            # so the per-bucket prefill/tail compiles collapse to one.
+            with paged.decode_kernel_scope(use_kernel), \
+                    paged.kernel_fallback_scope(
+                        self._note_kernel_fallback), \
+                    paged.kernel_dispatch_scope(
+                        self._note_kernel_dispatch):
+                want = jnp.zeros((S,), jnp.int32).at[slot].set(tlen)
+                if sharing:
+                    cache, cok = paged.paged_cow(cache, want)
+                cache, ok = paged.paged_reserve(cache, want)
+                off = cache.lengths[slot]
+                views = paged.chunked_layer_views(cache, slot[None],
+                                                  tlen[None])
+                w = toks.shape[1]
+                pos_ids = (off + jnp.arange(w))[None, :]
+                (lg, views), _ = model.apply(params, {}, None, toks,
+                                             views, pos_ids)
+                cache = paged.paged_advance(
+                    paged.merge_views(cache, views), want)
+                last = jax.lax.dynamic_index_in_dim(lg[0], tlen - 1,
+                                                    axis=0,
+                                                    keepdims=False)
+                pick = _sampling_picker(cfg,
+                                        jnp.asarray(temp, jnp.float32),
+                                        jnp.int32, eos_id, top_k, top_p)
+                tok0, done0 = pick(last[None], key,
+                                   jnp.zeros((1,), bool))
+                if sharing:
+                    ok = ok & cok
+                return cache, tok0[0], done0[0], ok
+
+        # The cache (pool + block tables) is DEAD the moment each step
+        # returns its successor — donate it so XLA updates the pool
+        # in place instead of holding two copies of the engine's
+        # biggest buffer live across every decode step (the
+        # donation-audit lint rule's canonical case; CPU ignores
+        # donation, TPU honors it).
+        self._free = jax.jit(paged.paged_free, donate_argnums=(0,))
+        if self._unified:
+            self._step = jax.jit(step_fn, donate_argnums=(1,))
+            self._prefill = jax.jit(prefill_ragged_fn,
+                                    donate_argnums=(1,))
+            watched = dict(step=self._step, prefill=self._prefill)
+        else:
+            self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+            self._prefill = jax.jit(prefill_fn, donate_argnums=(1,))
+            watched = dict(decode=self._decode, prefill=self._prefill)
+        # shard-check contract: decode_fn/step_fn args 2..5 (tok[s],
+        # active/qlens, temps, done) are slot-major [S]-leading
+        # vectors — the lint mesh recipe shards them on the data axis;
+        # params and the paged pool stay replicated (multi-chip pool
+        # sharding is the ROADMAP item this gate de-risks).
+        self._decode_slot_args = (2, 3, 4, 5)
+        if sharing:
+            # prefix-sharing host transforms: share/pin are tiny
+            # refcount/table updates.  Legacy mode additionally keeps
+            # the per-tail-width prefill program (one compile per TAIL
+            # pad width used); unified mode serves tails through the
+            # single ragged prefill program.
+            if not self._unified:
+                self._prefill_tail = jax.jit(prefill_tail_fn,
+                                             donate_argnums=(1,))
+                watched["prefill_tail"] = self._prefill_tail
+            self._share = jax.jit(paged.paged_share, donate_argnums=(0,))
+            self._rc_add = jax.jit(paged.paged_rc_add,
+                                   donate_argnums=(0,))
+            watched["share"] = self._share
+        if spec is not None:
 
             def _propose(lg_row, temps, sub):
                 # the draft's proposal rule mirrors _sampling_picker
@@ -718,7 +841,6 @@ class PagedServingEngine:
                     return dcache, ok
 
             self._draft = jax.jit(draft_fn, donate_argnums=(1,))
-            self._verify = jax.jit(verify_fn, donate_argnums=(1,))
             self._draft_prefill = jax.jit(draft_prefill_fn,
                                           donate_argnums=(1,))
             self._rollback = jax.jit(paged.paged_rollback,
@@ -729,9 +851,14 @@ class PagedServingEngine:
             # rationale as _decode_slot_args)
             self._verify_slot_args = (2, 3, 4)
             watched["draft"] = self._draft
-            watched["verify"] = self._verify
             watched["draft_prefill"] = self._draft_prefill
             watched["rollback"] = self._rollback
+            if not self._unified:
+                # legacy multi-program mode: verify is its own
+                # compiled program; unified mode folds the verify
+                # window into the step program above
+                self._verify = jax.jit(verify_fn, donate_argnums=(1,))
+                watched["verify"] = self._verify
         from paddle_tpu.analysis.watch import CompileWatcher
         self._compile_watch = CompileWatcher(**watched)
         self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
@@ -781,7 +908,7 @@ class PagedServingEngine:
         # Telemetry — ALL host-side, observed only after device values
         # come home (int()/np.asarray syncs): a metric update inside the
         # jitted step would be the host-callback-in-loop lint error, and
-        # the compiles == {'decode': 1} pin proves instrumentation does
+        # the compiles == {'step': 1} pin proves instrumentation does
         # not perturb tracing.  Handles are resolved once here so the
         # per-step cost is a few dict-free increments.
         self.metrics = (metrics if metrics is not None
@@ -856,6 +983,13 @@ class PagedServingEngine:
                  + "|".join(paged.KERNEL_FALLBACK_REASONS)
                  + " (fires at trace time, once per attention call per"
                  " layer per compiled program — never per step)")
+        self._m_kernel_dispatch = m.counter(
+            "serving_kernel_dispatch_total",
+            help="paged-attention calls that traced the Pallas kernel,"
+                 " by form=" + "|".join(paged.KERNEL_DISPATCH_FORMS)
+                 + " — the positive twin of serving_kernel_fallback_"
+                 "total (fires at trace time; the selfcheck mixed-"
+                 "batch gate pins form=ragged nonzero)")
         if spec is not None:
             self._m_spec_drafted = m.counter(
                 "serving_spec_draft_tokens_total",
@@ -969,6 +1103,14 @@ class PagedServingEngine:
         inside a compiled step."""
         self._m_kernel_fallback.inc(reason=reason)
 
+    def _note_kernel_dispatch(self, form: str):
+        """Trace-time observer (``paged.kernel_dispatch_scope``): a
+        paged-attention call traced the Pallas kernel — ``form`` is
+        ``decode`` (t=1 window) or ``ragged`` (multi-token window).
+        The selfcheck mixed-batch gate asserts nonzero ragged
+        dispatches so a silent regression to the XLA path is loud."""
+        self._m_kernel_dispatch.inc(form=form)
+
     def _admit(self):
         """Prefill queued requests into free slots while the pool's
         worst-case accounting allows — called before every decode step,
@@ -1059,8 +1201,13 @@ class PagedServingEngine:
                 tok0, done0, ok, width, ptoks = self._admit_hit(
                     req, slot, hit)
             else:
-                width = min(w for w in self.buckets
-                            if req.prompt.shape[0] <= w)
+                # unified mode pads every prompt to the ONE ragged
+                # prefill width (the program masks per-row, so pad
+                # lanes are don't-care); legacy picks a bucket and
+                # compiles per width used
+                width = (self._prefill_width if self._unified
+                         else min(w for w in self.buckets
+                                  if req.prompt.shape[0] <= w))
                 padded = np.zeros((1, width), np.int32)
                 padded[0, :req.prompt.shape[0]] = req.prompt
                 self.cache, tok0, done0, ok = self._prefill(
@@ -1122,10 +1269,17 @@ class PagedServingEngine:
             jnp.asarray(nmap, jnp.int32),
             jnp.asarray(new_len, jnp.int32))
         tlen = n - new_len
-        width = min(w for w in self._tail_buckets if tlen <= w)
+        if self._unified:
+            # the unified ragged prefill serves tails too — same
+            # program, same pad width, no per-tail-bucket compiles
+            width = self._prefill_width
+            tail_prog = self._prefill
+        else:
+            width = min(w for w in self._tail_buckets if tlen <= w)
+            tail_prog = self._prefill_tail
         padded = np.zeros((1, width), np.int32)
         padded[0, :tlen] = req.prompt[new_len:]
-        self.cache, tok0, done0, ok = self._prefill_tail(
+        self.cache, tok0, done0, ok = tail_prog(
             self.params, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(padded), jnp.asarray(tlen, jnp.int32),
             req.temperature, self._split())
@@ -1293,10 +1447,27 @@ class PagedServingEngine:
         return True
 
     def _plain_decode(self, active, t0):
-        self.cache, nxt, done, ok = self._decode(
-            self.params, self.cache, jnp.asarray(self._tok),
-            jnp.asarray(active), jnp.asarray(self._temps),
-            jnp.asarray(self._done), self._split())
+        if self._unified:
+            # plain decode through the unified step: every active row
+            # is a width-1 ragged window (column 0 = its pending
+            # token; spec engines pad to the k+1 step width, idle
+            # verify columns are don't-care lanes)
+            toks = np.zeros((self.S, self.step_width), np.int32)
+            toks[:, 0] = self._tok
+            out = self._step(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(active.astype(np.int32)),
+                jnp.asarray(self._temps), jnp.asarray(self._done),
+                self._split())
+            if self.spec is not None:
+                self.cache, nxt, done, _greedy, _probs, ok = out
+            else:
+                self.cache, nxt, done, _greedy, ok = out
+        else:
+            self.cache, nxt, done, ok = self._decode(
+                self.params, self.cache, jnp.asarray(self._tok),
+                jnp.asarray(active), jnp.asarray(self._temps),
+                jnp.asarray(self._done), self._split())
         assert bool(ok), "paged pool exhausted despite admission " \
                          "accounting (engine bug)"
         nxt, done = np.asarray(nxt), np.asarray(done)
@@ -1332,7 +1503,8 @@ class PagedServingEngine:
         assert len(req.tokens) == 1, \
             "draft admit after plain decode steps (engine bug)"
         n = int(req.prompt.shape[0])
-        width = min(w for w in self.buckets if n <= w)
+        width = (self._prefill_width if self._unified
+                 else min(w for w in self.buckets if n <= w))
         padded = np.zeros((1, width), np.int32)
         padded[0, :n] = req.prompt
         self.dcache, ok = self._draft_prefill(
@@ -1376,9 +1548,19 @@ class PagedServingEngine:
         toks = np.zeros((S, k + 1), np.int32)
         toks[:, 0] = self._tok                # the pending target token
         toks[:, 1:] = drafts_h
-        self.cache, greedy, probs, vok = self._verify(
-            self.params, self.cache, jnp.asarray(toks),
-            jnp.asarray(valid), temps)
+        if self._unified:
+            # the verify window rides the unified step (same compiled
+            # program as plain decode): the step's own pick/done
+            # outputs are for width-1 rows — the host accept/reject
+            # below is what commits spec tokens, so both are discarded
+            self.cache, _nxt, _done, greedy, probs, vok = self._step(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(valid), temps, jnp.asarray(self._done),
+                self._split())
+        else:
+            self.cache, greedy, probs, vok = self._verify(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(valid), temps)
         greedy_h = np.asarray(greedy)                    # [S, k+1]
         assert bool(dok) and bool(vok), \
             "paged pool exhausted despite admission accounting " \
@@ -1571,7 +1753,7 @@ class PagedServingEngine:
     def compile_counts(self):
         """Compiles since engine construction, via the shared
         :class:`~paddle_tpu.analysis.CompileWatcher` — the
-        ``compiles == {'decode': 1}`` serving contract's measuring
+        ``compiles == {'step': 1}`` serving contract's measuring
         stick."""
         return self._compile_watch.counts()
 

@@ -1,6 +1,6 @@
 """The CI telemetry gate: ``python -m paddle_tpu.telemetry.selfcheck``.
 
-Nine checks, each a hard failure (non-zero exit) when violated:
+Ten checks, each a hard failure (non-zero exit) when violated:
 
 1. **Instrumented serving smoke** — a tiny :class:`PagedServingEngine`
    (fresh registry, request-level tracer ON, ``decode_kernel=True`` so
@@ -9,7 +9,7 @@ Nine checks, each a hard failure (non-zero exit) when violated:
    completion; the snapshot must carry the documented serving metrics
    with data in them (TTFT/queue-wait/step histograms populated,
    occupancy gauges set, retire counters matching request count) and
-   the ``compiles == {'decode': 1}`` contract must still hold WITH
+   the ``compiles == {'step': 1}`` contract must still hold WITH
    instrumentation AND tracing on — proof telemetry did not perturb
    tracing, kernel included.
 2. **Schema + exporters** — the live snapshot passes
@@ -29,8 +29,8 @@ Nine checks, each a hard failure (non-zero exit) when violated:
    ``prefix_cache=True`` serves two prompts behind one common prefix:
    the second request must HIT the radix registry (nonzero
    ``serving_prefix_hits_total`` and hit-token counter), the
-   ``compiles == {'decode': 1}`` contract must hold with sharing on
-   (copy-on-write rides the same traced decode step), and
+   ``compiles == {'step': 1}`` contract must hold with sharing on
+   (copy-on-write rides the same traced unified step), and
    ``hbm_report()`` must reconcile — pinned prefix blocks are the only
    pool residue after the run and a flush returns the pool to empty.
 6. **Speculative smoke** — the same tiny engine with
@@ -39,12 +39,24 @@ Nine checks, each a hard failure (non-zero exit) when violated:
    BYTE-IDENTICAL (the accept rule's bit-identity contract), the
    accept counter must be nonzero (the self-draft fixture guarantees
    acceptances), the compile set must stay bounded
-   (``decode <= 1, verify == 1, draft == 1`` — one program each for
-   draft, verify, and the plain tail step), and the pool ledger must
+   (``step == 1, draft == 1`` and NO separate verify or decode
+   programs — spec-verify rides the unified step), and the pool ledger must
    reconcile with speculation + sharing on (only registry-pinned
    blocks survive the run, the draft pool returns to empty, flush
    clears the rest).
-7. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
+7. **Unified mixed-batch smoke** — the same tiny engine (spec on,
+   ``decode_kernel=True``) serves a long prompt next to a short one so
+   ONE unified step program covers ragged tail-prefill, plain decode,
+   and k-token spec-verify windows side by side: the compile set must
+   stay shrunken (``step == 1``, at most one ragged-prefill program,
+   no decode/verify/prefill_tail), the
+   ``serving_kernel_dispatch_total{form="ragged"}`` counter must be
+   nonzero (the ragged kernel actually traced in), and the typed
+   fallback counter must be ZERO — the unified path may not silently
+   regress to the XLA gather form.  The dispatch/fallback observers
+   ride the same counter machinery check 4 holds under its
+   per-observation ceiling.
+8. **Training health smoke** — a tiny ``Trainer(health=...)`` drives
    real batch + scan steps with the monitor at cadence: the snapshot
    must validate and carry populated ``train_health_*`` families,
    ``compiles`` must stay ``{step: 1, scan: 1}`` WITH health enabled
@@ -54,16 +66,16 @@ Nine checks, each a hard failure (non-zero exit) when violated:
 8. **Chaos smoke** — the serving FRONTEND (``paddle_tpu/frontend.py``)
    first proves its fault-free single-engine fast path is
    byte-for-byte the direct engine (identical greedy token streams,
-   ``compiles == {'decode': 1}``), then runs a two-engine service
+   ``compiles == {'step': 1}``), then runs a two-engine service
    through a deterministic fault schedule
    (``paddle_tpu/testing/faults.py``: crash mid-decode, hung step,
    failed engine construction) plus an overload burst against a
    bounded queue: every request must reach EXACTLY ONE terminal
    status, retried requests' token streams must be bit-identical to
    the fault-free run, each live engine must still hold the
-   ``compiles == {'decode': 1}`` pin, and the overload burst must shed
+   ``compiles == {'step': 1}`` pin, and the overload burst must shed
    lowest-priority-first with typed reject reasons.
-9. **Lint re-check** — the instrumented entrypoints (engine decode,
+10. **Lint re-check** — the instrumented entrypoints (engine decode,
    its prefix-sharing and fault-injection twins, paged serve step,
    trainer step, health-instrumented trainer step) re-trace through
    tpu-lint with ZERO error-severity findings:
@@ -111,6 +123,7 @@ INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode-kernel",
     "paged-engine-decode-prefix",
     "paged-engine-decode-spec",
+    "paged-engine-step-ragged",
     "paged-serve-step",
     "trainer-train-step",
     "trainer-train-step-health",
@@ -170,8 +183,8 @@ def _check_serving_smoke():
         _fail(f"smoke run returned {len(results)} streams, wanted {n_req}")
 
     compiles = eng.compile_counts()
-    if compiles.get("decode") != 1:
-        _fail("the compiles == {'decode': 1} contract broke WITH "
+    if compiles.get("step") != 1:
+        _fail("the compiles == {'step': 1} contract broke WITH "
               f"instrumentation on: {compiles}")
 
     snap = reg.snapshot()
@@ -305,8 +318,8 @@ def _check_prefix_smoke():
         _fail(f"prefix smoke returned {len(results)} streams, wanted 2")
 
     compiles = eng.compile_counts()
-    if compiles.get("decode") != 1:
-        _fail("the compiles == {'decode': 1} contract broke WITH "
+    if compiles.get("step") != 1:
+        _fail("the compiles == {'step': 1} contract broke WITH "
               f"prefix sharing on: {compiles}")
 
     snap = reg.snapshot()
@@ -386,10 +399,11 @@ def _check_spec_smoke():
               "the direct engine's")
 
     compiles = eng.compile_counts()
-    if compiles.get("decode", 0) > 1 or compiles.get("verify") != 1 \
-            or compiles.get("draft") != 1:
-        _fail("the bounded compile contract (decode <= 1, verify == 1, "
-              f"draft == 1) broke with speculation on: {compiles}")
+    if compiles.get("step") != 1 or compiles.get("draft") != 1 \
+            or "verify" in compiles or "decode" in compiles:
+        _fail("the unified compile contract (step == 1, draft == 1, "
+              "no separate verify/decode programs) broke with "
+              f"speculation on: {compiles}")
 
     snap = reg.snapshot()
     validate_snapshot(snap)
@@ -422,6 +436,68 @@ def _check_spec_smoke():
     if eng.occupancy()["blocks_in_use"] != 0:
         _fail(f"flush left blocks resident: {eng.occupancy()}")
     return int(accepted), compiles
+
+
+def _check_unified_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.ops import paged_attention as paged
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=32)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+    reg = MetricsRegistry("selfcheck-unified")
+    eng = PagedServingEngine(cfg, params, num_slots=2, num_blocks=16,
+                             block_size=4, prompt_buckets=(4, 16),
+                             metrics=reg, decode_kernel=True,
+                             spec=SpecConfig(k=2, draft_layers=1))
+    # a MIXED batch — a long prompt next to a short one — so the ONE
+    # unified step program serves ragged tail-prefill, plain decode,
+    # and k-token spec-verify windows side by side
+    eng.submit(np.arange(1, 13, dtype=np.int32), max_new=6)
+    eng.submit(np.arange(2, 5, dtype=np.int32), max_new=6)
+    results = eng.run()
+    if len(results) != 2:
+        _fail(f"unified smoke returned {len(results)} streams, "
+              "wanted 2")
+
+    compiles = eng.compile_counts()
+    if compiles.get("step") != 1 or compiles.get("draft") != 1 \
+            or compiles.get("prefill", 0) > 1 or "decode" in compiles \
+            or "verify" in compiles or "prefill_tail" in compiles:
+        _fail("the shrunken compile set (step == 1, draft == 1, at "
+              "most one ragged-prefill program, no decode/verify/"
+              f"prefill_tail) broke on the mixed batch: {compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    disp = metrics.get("serving_kernel_dispatch_total", {"series": []})
+    forms = {s["labels"].get("form") for s in disp["series"]}
+    if not forms <= set(paged.KERNEL_DISPATCH_FORMS):
+        _fail(f"undocumented kernel dispatch form label(s): {forms}")
+    ragged = sum(s["value"] for s in disp["series"]
+                 if s["labels"].get("form") == "ragged")
+    if ragged <= 0:
+        _fail("serving_kernel_dispatch_total{form=ragged} is 0 after a "
+              "mixed-batch run with the kernel on — the unified step "
+              "traced without the ragged kernel")
+    fb = metrics.get("serving_kernel_fallback_total", {"series": []})
+    fell = sum(s["value"] for s in fb["series"])
+    if fell != 0:
+        _fail("the unified path silently regressed to the XLA gather "
+              "form: serving_kernel_fallback_total carries "
+              f"{[(s['labels'], s['value']) for s in fb['series']]}")
+    return int(ragged), compiles
 
 
 def _check_health():
@@ -540,8 +616,8 @@ def _check_chaos():
         if not np.array_equal(out[rid]["tokens"], reference[i]):
             _fail(f"fault-free frontend stream {rid} diverged from the "
                   "direct engine — the fast path is not byte-for-byte")
-    if compiles != [{"decode": 1, "prefill": 1}]:
-        _fail("compiles == {'decode': 1} broke with the frontend on "
+    if compiles != [{"step": 1, "prefill": 1}]:
+        _fail("compiles == {'step': 1} broke with the frontend on "
               f"(fault-free): {compiles}")
 
     # chaos: crash engine0 mid-decode, fail its first replacement's
@@ -575,15 +651,15 @@ def _check_chaos():
             if not np.array_equal(out[rid]["tokens"], reference[i]):
                 _fail(f"retried stream {rid} is not bit-identical to "
                       "the fault-free run")
-        # per live engine the decode step compiled AT MOST once (an
+        # per live engine the unified step compiled AT MOST once (an
         # idle replacement that never stepped again holds 0); any
         # engine that did work holds exactly 1
         for c in compiles:
-            if c is not None and c.get("decode", 0) > 1:
-                _fail("compiles == {'decode': 1} broke on a restarted "
+            if c is not None and c.get("step", 0) > 1:
+                _fail("compiles == {'step': 1} broke on a restarted "
                       f"engine: {compiles}")
-        if not any(c and c.get("decode") == 1 for c in compiles):
-            _fail(f"no live engine shows a compiled decode: {compiles}")
+        if not any(c and c.get("step") == 1 for c in compiles):
+            _fail(f"no live engine shows a compiled step: {compiles}")
         if st["retries"] < 1:
             _fail("chaos run recorded no retries — the faults did not "
                   "exercise requeue/replay")
@@ -650,8 +726,13 @@ def main(argv=None) -> int:
     s_accepted, s_compiles = _check_spec_smoke()
     print(f"selfcheck: speculative smoke ok ({s_accepted} accepted "
           "draft tokens, greedy byte-identical, compiles bounded "
-          f"(decode={s_compiles.get('decode', 0)}, verify=1, draft=1), "
-          "pool + draft pool reconcile)")
+          f"(step={s_compiles.get('step', 0)}, draft=1, no separate "
+          "verify), pool + draft pool reconcile)")
+    u_ragged, u_compiles = _check_unified_smoke()
+    print(f"selfcheck: unified mixed-batch smoke ok ({u_ragged} ragged "
+          "kernel dispatch(es), 0 fallbacks, compile set shrunken to "
+          f"{{step: 1, prefill: {u_compiles.get('prefill', 0)}}} "
+          "+ draft programs)")
     hsnap, h_per_step = _check_health()
     print("selfcheck: training health smoke ok "
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "

@@ -29,7 +29,7 @@ event schema:
 
 Like the metrics layer, tracing is HOST-SIDE ONLY: events are recorded
 after device values come home, never inside ``jit`` — the ``compiles ==
-{'decode': 1}`` pin and the selfcheck overhead bound both hold with
+{'step': 1}`` pin and the selfcheck overhead bound both hold with
 tracing enabled.
 
 Timestamps are ``time.perf_counter()`` seconds (monotonic, the same

@@ -220,7 +220,7 @@ def test_frontend_fast_path_matches_direct_engine(params,
     for i, rid in enumerate(rids):
         assert out[rid]["status"] == COMPLETED
         assert np.array_equal(out[rid]["tokens"], direct_streams[i])
-    assert compiles == [{"decode": 1, "prefill": 1}]
+    assert compiles == [{"step": 1, "prefill": 1}]
     assert st["completed"] == len(PROMPTS) and st["shed"] == 0 \
         and st["failed"] == 0 and st["engine_restarts"] == 0
     assert reg.counter("frontend_submitted_total").value() \
@@ -342,8 +342,8 @@ def test_chaos_crash_hang_attach_replays_bit_identical(
     assert st["completed"] == len(PROMPTS)
     assert st["engine_restarts"] == 3      # crash + hang + attach
     assert st["failed"] == 0 and st["shed"] == 0
-    # the replacement engine still compiled decode exactly once
-    assert compiles == [{"decode": 1, "prefill": 1}]
+    # the replacement engine still compiled its unified step exactly once
+    assert compiles == [{"step": 1, "prefill": 1}]
     # supervision left its telemetry trail
     assert reg.counter("frontend_engine_restarts_total").value(
         cause="crash", engine="engine0") == 1.0
@@ -388,7 +388,7 @@ def _chaos_property(seed, params, direct_streams):
         assert hs["ledger"]["reserved_blocks"] == 0
         assert hs["queue_depth"] == 0
         assert all(s is None for s in hs["slots"])
-        assert hs["compiles"].get("decode", 0) <= 1
+        assert hs["compiles"].get("step", 0) <= 1
 
 
 @pytest.mark.parametrize("seed", [0, 1])
@@ -432,8 +432,8 @@ def test_frontend_spec_engines_use_live_tokens_per_step(
         assert np.array_equal(out[rid]["tokens"], direct_streams[i])
     assert tps["count"] > 0 and tps["avg"] > 1.0
     assert est > 0.0
-    assert compiles[0].get("decode", 0) <= 1
-    assert compiles[0]["verify"] == 1 and compiles[0]["draft"] == 1
+    assert compiles[0]["step"] == 1 and compiles[0]["draft"] == 1
+    assert "verify" not in compiles[0] and "decode" not in compiles[0]
 
 
 def test_service_estimate_divides_step_fallback_by_spec_rate(params):

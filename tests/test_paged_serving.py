@@ -183,11 +183,11 @@ def test_engine_retire_admit_mid_stream(params, prompts):
         solo = np.asarray(gen(params, jnp.asarray(pr[r:r + 1, :n]),
                               len(res[rid])))
         assert (res[rid] == solo[0, n:]).all(), f"request {rid}"
-    assert eng.compile_counts()["decode"] == 1, (
-        "retire/admit must not recompile the decode step")
+    assert eng.compile_counts()["step"] == 1, (
+        "retire/admit must not recompile the unified step")
     # same pin, watcher-native spelling (analysis/watch.py): the failure
     # message carries every count when a trace key varies per call
-    eng._compile_watch.assert_counts(decode=1)
+    eng._compile_watch.assert_counts(step=1)
     occ = eng.occupancy()
     assert occ["blocks_in_use"] == 0, "all blocks must return to the pool"
     assert eng.stats()["tokens_decoded"] == (12 + 6 + 10) - 3  # prefill toks

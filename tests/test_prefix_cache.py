@@ -207,7 +207,7 @@ def test_token_identity_xla_greedy(params):
     eng1, t1 = _serve(params, sharing=True)
     for a, b in zip(t0, t1):
         np.testing.assert_array_equal(a, b)
-    assert eng1.compile_counts()["decode"] == 1
+    assert eng1.compile_counts()["step"] == 1
     assert eng1._prefix.stats()["hits"] >= 2
 
 
@@ -229,7 +229,7 @@ def test_token_identity_kernel_interpret(params):
     assert eng1.decode_kernel, "interpret-mode kernel must resolve on"
     for a, b in zip(t0, t1):
         np.testing.assert_array_equal(a, b)
-    assert eng1.compile_counts()["decode"] == 1
+    assert eng1.compile_counts()["step"] == 1
 
 
 def test_full_prompt_hit_replays_one_token(params):
@@ -376,6 +376,6 @@ def test_submit_worst_case_includes_cow_slack(params):
 def test_prefix_disabled_engine_unchanged(params):
     eng = _engine(params, sharing=False)
     assert eng._prefix is None and not eng.prefix_enabled
-    assert set(eng.compile_counts()) == {"decode", "prefill"}
+    assert set(eng.compile_counts()) == {"step", "prefill"}
     with pytest.raises(Exception):
         eng.flush_prefix_cache()

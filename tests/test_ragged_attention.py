@@ -1,0 +1,246 @@
+"""The unified ragged paged-attention contract (ISSUE 11 acceptance).
+
+Two layers of pins:
+
+* KERNEL PARITY — ``paged_ragged_attention_kernel`` (interpret mode)
+  against ``paged_chunked_attention``'s XLA gather form on every nasty
+  window shape: len-0 rows (fresh prompts attending only their own
+  window), windows crossing block boundaries, rows whose window fills
+  the whole table, k-token verify windows, and bf16 pools — plus a
+  poison test pinning the ragged per-query bound
+  ``kpos < lengths[r] + j + 1`` against a dense numpy reference.
+* ENGINE IDENTITY — the unified single-program engine
+  (``unified_step=True``, the default) produces greedy streams
+  bit-identical to the legacy separate-program engine across the
+  stacked feature matrix (spec + prefix sharing, XLA and
+  kernel-interpret), while its compile set stays SHRUNKEN: one step
+  program, at most one ragged-prefill program, and NO decode / verify
+  / prefill_tail programs.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.ops import pallas_paged_attention as pp
+from paddle_tpu.serving import PagedServingEngine, SpecConfig
+
+B, H, HD, NB, BS, MAXB = 3, 4, 32, 16, 8, 5
+
+
+def _fixture(t, seed=0, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, t, H, HD), dtype)
+    kp = jnp.asarray(rs.randn(NB, BS, H, HD), dtype)
+    vp = jnp.asarray(rs.randn(NB, BS, H, HD), dtype)
+    table = jnp.asarray([[3, 7, 1, 12, -1],
+                         [2, 5, 9, 11, 4],
+                         [6, 0, -1, -1, -1]], jnp.int32)
+    return q, kp, vp, table
+
+
+def _xla_chunked(q, kp, vp, table, lens):
+    # the dispatcher's gather form: kernel scope OFF forces it
+    with paged.decode_kernel_scope(False):
+        return paged.paged_chunked_attention(
+            q, kp, vp, table, lens, jnp.full((B,), q.shape[1], jnp.int32))
+
+
+# ------------------------------------------------------ kernel parity
+
+
+# (window width t, committed bases) — every ragged shape the unified
+# step emits: fresh-prompt windows (base 0), windows crossing a block
+# boundary, a row whose window ends exactly at table capacity, and the
+# k+1-wide verify window with mixed bases.
+WINDOW_CASES = [
+    pytest.param(4, [0, 0, 0], id="len0-fresh-prompt-rows"),
+    pytest.param(4, [6, BS - 1, BS], id="window-crosses-boundary"),
+    pytest.param(4, [3 * BS, MAXB * BS - 4, 0], id="full-table-row"),
+    pytest.param(3, [0, 13, BS], id="verify-window-k2"),
+    pytest.param(1, [5, 2 * BS, 0], id="decode-face"),
+    pytest.param(8, [0, BS, 2 * BS - 3], id="wide-prefill-window"),
+]
+
+
+@pytest.mark.parametrize("t,bases", WINDOW_CASES)
+def test_ragged_kernel_matches_xla_f32(t, bases):
+    q, kp, vp, table = _fixture(t)
+    lens = jnp.asarray(bases, jnp.int32)
+    ref = _xla_chunked(q, kp, vp, table, lens)
+    out = pp.paged_ragged_attention_kernel(q, kp, vp, table, lens,
+                                           interpret=True)
+    assert out.dtype == jnp.float32 and out.shape == ref.shape
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+
+
+@pytest.mark.parametrize("t,bases", WINDOW_CASES)
+def test_ragged_kernel_matches_xla_head_group_1(t, bases):
+    # group=1 walks the head axis in grid steps — the degraded-VMEM
+    # configuration must honour the same ragged bound
+    q, kp, vp, table = _fixture(t, seed=1)
+    lens = jnp.asarray(bases, jnp.int32)
+    ref = _xla_chunked(q, kp, vp, table, lens)
+    out = pp.paged_ragged_attention_kernel(q, kp, vp, table, lens,
+                                           interpret=True, head_group=1)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-6
+
+
+def test_ragged_kernel_matches_xla_bf16_pools():
+    # bf16 pools, f32 accumulation both sides; the paths round bf16 at
+    # different points, so the bound is bf16 resolution of O(1) outputs
+    q, kp, vp, table = _fixture(4, seed=2, dtype=jnp.bfloat16)
+    lens = jnp.asarray([0, 13, BS], jnp.int32)
+    ref = _xla_chunked(q, kp, vp, table, lens)
+    out = pp.paged_ragged_attention_kernel(q, kp, vp, table, lens,
+                                           interpret=True)
+    assert out.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(out - ref.astype(jnp.float32)))) <= 2e-2
+
+
+def test_ragged_bound_against_dense_reference():
+    # Poison EVERY pool row, then write real tokens only at positions
+    # the ragged bound may touch (`base + t` per row): if query column
+    # j leaked weight past ``kpos < base + j + 1`` — or into unmapped
+    # -1 pages — the 1e4 poison would blow the dense comparison.
+    t = 3
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(B, t, H, HD), jnp.float32)
+    kp = np.full((NB, BS, H, HD), 1e4, np.float32)
+    vp = np.full((NB, BS, H, HD), -1e4, np.float32)
+    table = np.asarray([[3, 7, 1, -1, -1],
+                        [2, 5, 9, 11, 4],
+                        [6, 0, -1, -1, -1]], np.int32)
+    bases = [0, 13, BS - 1]       # fresh row, mid-page, boundary-cross
+    k_real = rs.randn(B, MAXB * BS, H, HD).astype(np.float32)
+    v_real = rs.randn(B, MAXB * BS, H, HD).astype(np.float32)
+    for r in range(B):
+        for pos in range(bases[r] + t):
+            blk = table[r, pos // BS]
+            kp[blk, pos % BS] = k_real[r, pos]
+            vp[blk, pos % BS] = v_real[r, pos]
+    out = pp.paged_ragged_attention_kernel(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(bases, jnp.int32),
+        interpret=True)
+    scale = HD ** -0.5
+    for r in range(B):
+        for j in range(t):
+            n = bases[r] + j + 1
+            s = np.einsum("hd,khd->hk", np.asarray(q[r, j]),
+                          k_real[r, :n]) * scale
+            w = np.exp(s - s.max(axis=1, keepdims=True))
+            w /= w.sum(axis=1, keepdims=True)
+            dense = np.einsum("hk,khd->hd", w, v_real[r, :n])
+            np.testing.assert_allclose(np.asarray(out[r, j]), dense,
+                                       atol=2e-5)
+
+
+def test_decode_face_is_the_same_kernel():
+    # paged_decode_attention_kernel == ragged kernel at base = len - 1:
+    # one program, two conventions
+    q, kp, vp, table = _fixture(1, seed=5)
+    lens = jnp.asarray([5, 2 * BS, 1], jnp.int32)
+    dec = pp.paged_decode_attention_kernel(q, kp, vp, table, lens,
+                                           interpret=True)
+    rag = pp.paged_ragged_attention_kernel(q, kp, vp, table, lens - 1,
+                                           interpret=True)
+    assert float(jnp.max(jnp.abs(dec - rag))) == 0.0
+
+
+# ----------------------------------------------------- engine identity
+
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+# mixed lengths: short (one bucket), long (the other), and a pair
+# sharing a prefix so the prefix-cache tail path engages when on
+PROMPTS = [np.arange(1, 9, dtype=np.int32),
+           np.arange(3, 17, dtype=np.int32),
+           np.arange(1, 9, dtype=np.int32)[:6],
+           np.arange(7, 12, dtype=np.int32)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _drive(params, *, unified, spec=None, sharing=False,
+           decode_kernel=False):
+    eng = PagedServingEngine(
+        CFG, params, num_slots=2, num_blocks=40, block_size=4,
+        prompt_buckets=(8, 16), prefix_cache=sharing,
+        decode_kernel=decode_kernel, spec=spec, seed=0,
+        unified_step=unified, metrics=telemetry.MetricsRegistry())
+    for p in PROMPTS:
+        eng.submit(p, max_new=8)
+    out = eng.run()
+    return [list(map(int, out[r])) for r in sorted(out)], \
+        eng.compile_counts()
+
+
+MATRIX = [
+    pytest.param(dict(), id="plain-xla"),
+    pytest.param(dict(decode_kernel=True), id="plain-kernel"),
+    pytest.param(dict(spec=SpecConfig(k=2, draft_layers=1),
+                      sharing=True), id="spec-prefix-xla"),
+    pytest.param(dict(spec=SpecConfig(k=2, draft_layers=1),
+                      sharing=True, decode_kernel=True),
+                 id="spec-prefix-kernel"),
+]
+
+
+@pytest.mark.parametrize("kw", MATRIX)
+def test_unified_vs_legacy_greedy_bit_identity(params, kw):
+    uni, uc = _drive(params, unified=True, **kw)
+    leg, lc = _drive(params, unified=False, **kw)
+    assert uni == leg, (
+        f"unified step diverged from the separate-program engine: "
+        f"{uni} vs {leg}")
+    # the tentpole's compile-set contract: ONE step program (+ at most
+    # one ragged-prefill), none of the programs it replaced
+    assert uc["step"] == 1 and uc.get("prefill", 0) <= 1
+    for retired in ("decode", "verify", "prefill_tail"):
+        assert retired not in uc, (uc, retired)
+    if kw.get("spec"):
+        assert uc["draft"] == 1
+        assert lc["verify"] == 1      # the legacy twin still splits
+    else:
+        assert lc["decode"] == 1
+
+
+def test_unified_compile_set_is_the_acceptance_set(params):
+    # the ISSUE's acceptance pin, exactly: non-spec unified serves any
+    # mixed batch with {'step': 1, 'prefill': 1}
+    _, compiles = _drive(params, unified=True)
+    assert compiles == {"step": 1, "prefill": 1}, compiles
+
+
+def test_unified_spec_kernel_dispatches_ragged(params):
+    # the unified spec step's verify window is multi-token: with the
+    # kernel forced on, the RAGGED form must trace in and the typed
+    # fallback counter must stay silent
+    reg = telemetry.MetricsRegistry()
+    eng = PagedServingEngine(
+        CFG, params, num_slots=2, num_blocks=40, block_size=4,
+        prompt_buckets=(8, 16), decode_kernel=True,
+        spec=SpecConfig(k=2, draft_layers=1), seed=0, metrics=reg)
+    for p in PROMPTS[:2]:
+        eng.submit(p, max_new=6)
+    eng.run()
+    snap = reg.snapshot()["metrics"]
+    disp = {s["labels"]["form"]: s["value"]
+            for s in snap["serving_kernel_dispatch_total"]["series"]}
+    assert disp.get("ragged", 0) > 0, disp
+    assert set(disp) <= set(paged.KERNEL_DISPATCH_FORMS)
+    fb = snap["serving_kernel_fallback_total"]["series"]
+    assert sum(s["value"] for s in fb) == 0, fb

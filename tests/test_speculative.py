@@ -254,8 +254,9 @@ def test_greedy_spec_bit_identical(params, decode_kernel, sharing,
     streams = _drive(eng)
     assert streams == base
     compiles = eng.compile_counts()
-    assert compiles.get("decode", 0) <= 1
-    assert compiles["verify"] == 1 and compiles["draft"] == 1
+    assert compiles["step"] == 1 and compiles["draft"] == 1
+    assert "verify" not in compiles and "decode" not in compiles, (
+        "spec verify and plain decode both ride the unified step")
     if draft_layers == CFG.num_layers:
         sp = eng.stats()["spec"]
         assert sp["accept_rate"]["avg"] == pytest.approx(1.0)
@@ -352,29 +353,39 @@ def test_spec_metrics_and_tracer_instants(params):
     assert spans and all(s["args"]["committed"] >= 1 for s in spans)
 
 
-def test_kernel_fallback_counter_is_typed(params):
-    """Satellite: the multi-token verify query CANNOT run the Pallas
-    decode kernel; the fallback to the XLA gather form must surface a
-    typed reason, never silently."""
+def test_kernel_no_fallback_on_verify_and_dispatch_is_typed(params):
+    """Satellite: the k+1-token verify window now RUNS the ragged
+    Pallas kernel — a kernel-selected spec engine must record ZERO
+    fallbacks and NONZERO ragged dispatches, so a silent regression to
+    the XLA gather path is observable in the counters."""
     reg = telemetry.MetricsRegistry("fb-test")
     eng = _engine(params, decode_kernel=True,
                   spec=SpecConfig(k=2, draft_layers=1), metrics=reg)
     _drive(eng, max_new=6)
-    snap = reg.snapshot()["metrics"]["serving_kernel_fallback_total"]
-    reasons = {s["labels"]["reason"]: s["value"]
-               for s in snap["series"]}
-    assert reasons.get("multi_token_query", 0) > 0
-    assert set(reasons) <= set(paged.KERNEL_FALLBACK_REASONS)
+    snap = reg.snapshot()["metrics"]
+    fb = {s["labels"]["reason"]: s["value"]
+          for s in snap["serving_kernel_fallback_total"]["series"]}
+    assert not fb, f"verify/prefill must not fall back, got {fb}"
+    disp = {s["labels"]["form"]: s["value"]
+            for s in snap["serving_kernel_dispatch_total"]["series"]}
+    assert disp.get("ragged", 0) > 0         # k+1-wide verify windows
+    assert set(disp) <= set(paged.KERNEL_DISPATCH_FORMS)
 
 
 def test_kernel_fallback_scope_unit():
-    seen = []
-    q = jnp.zeros((1, 3, 2, 4))              # t=3 multi-token query
     kp = jnp.zeros((4, 4, 2, 4))
-    with paged.kernel_fallback_scope(seen.append):
-        with paged.decode_kernel_scope(True):
-            assert paged._fallback_reason(q, kp, 1.0) \
-                == "multi_token_query"
+    with paged.decode_kernel_scope(True):
+        # t=3 verify windows are kernel-served now: no fallback reason
+        assert paged._fallback_reason(
+            jnp.zeros((1, 3, 2, 4)), kp, 1.0) is None
+        # a window too wide for the VMEM budget even at head-group 1
+        # keeps a TYPED reason — the base shape fits at t=1, so it's
+        # the ragged successor of the retired multi_token_query, not
+        # unsupported_shape
+        assert paged._fallback_reason(
+            jnp.zeros((1, 8192, 2, 128)),
+            jnp.zeros((4, 4, 2, 128)), 1.0) \
+            == "ragged_unsupported_shape"
 
 
 # ------------------------------------------------- builder draft= form

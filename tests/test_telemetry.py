@@ -295,7 +295,7 @@ def test_engine_ttft_queue_wait_and_compiles(reg):
     eng.submit(pr[:2], max_new=3)    # queues behind the 2 slots
     res = eng.run()
     assert len(res) == 3
-    assert eng.compile_counts()["decode"] == 1, (
+    assert eng.compile_counts()["step"] == 1, (
         "telemetry must not perturb tracing")
     m = reg.snapshot()["metrics"]
     # one TTFT and one queue-wait observation per admitted request
@@ -313,7 +313,7 @@ def test_engine_ttft_queue_wait_and_compiles(reg):
     # gauges sampled per step; pool drained at the end
     assert reg.get("serving_pool_blocks_in_use").value() == 0
     assert reg.get("serving_slots_active").value() == 0
-    assert reg.get("serving_compiles").value(fn="decode") == 1
+    assert reg.get("serving_compiles").value(fn="step") == 1
     validate_snapshot(reg.snapshot())
 
 

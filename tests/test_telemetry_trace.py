@@ -223,7 +223,7 @@ def test_engine_trace_full_request_waterfalls(reg):
             eng.submit(pr[:2], max_new=3)]   # queues behind 2 slots
     res = eng.run()
     assert sorted(res) == sorted(rids)
-    assert eng.compile_counts()["decode"] == 1, (
+    assert eng.compile_counts()["step"] == 1, (
         "tracing must not perturb tracing — the serving contract")
 
     trace = validate_trace(tracer.snapshot())
@@ -312,16 +312,16 @@ def test_flight_recorder_mid_run_exception(reg, tmp_path):
     for n in (3, 5, 2):
         eng.submit(np.arange(1, n + 1, dtype=np.int32), max_new=5)
 
-    real_decode = eng._decode
+    real_step = eng._step
     calls = {"n": 0}
 
     def exploding(*a, **kw):
         calls["n"] += 1
         if calls["n"] >= 3:
             raise RuntimeError("injected device wedge")
-        return real_decode(*a, **kw)
+        return real_step(*a, **kw)
 
-    eng._decode = exploding
+    eng._step = exploding
     with pytest.raises(RuntimeError, match="injected device wedge"):
         eng.run()
 
@@ -335,7 +335,7 @@ def test_flight_recorder_mid_run_exception(reg, tmp_path):
     # engine host state rides along (host accounting, JSON-safe)
     state = dump["state"]
     assert state["pool_blocks"] == 8 and state["num_slots"] == 2
-    assert state["compiles"].get("decode") == 1
+    assert state["compiles"].get("step") == 1
     assert len(state["slots"]) == 2
     assert any(s is not None for s in state["slots"])
     assert state["decode_steps"] == 2      # two good steps ran
@@ -349,7 +349,7 @@ def test_flight_recorder_dumps_once_per_exception(reg, tmp_path):
     def boom(*a, **kw):
         raise ValueError("first")
 
-    eng._decode = boom
+    eng._step = boom
     with pytest.raises(ValueError):
         eng.run()                          # step dumps, run re-raises
     first = crash.read_text()
@@ -518,7 +518,7 @@ def test_cli_trace_reads_flight_record(reg, tmp_path, capsys):
     def boom(*a, **kw):
         raise ValueError("wedge")
 
-    eng._decode = boom
+    eng._step = boom
     with pytest.raises(ValueError):
         eng.run()
     rc, out = _run_cli(["trace", str(crash)], capsys)
