@@ -371,3 +371,33 @@ def _paged_engine_step_ragged() -> LintTarget:
                           "dp over slot-major step inputs (toks/qlens/"
                           "temps/done); pool + block tables replicated "
                           "exactly as the legacy decode twin"))
+
+
+@register_entrypoint("paged-engine-step-int8")
+def _paged_engine_step_int8() -> LintTarget:
+    # The quantized twin of paged-engine-step-ragged: same unified
+    # ragged step, same spec window, but the KV pool is int8 pages +
+    # per-block f32 scales.  Two gates ride on it: (1) the dequant
+    # write/read paths (quantize-on-append scatters, scale growth +
+    # cursor requantize, dequant before the score dot) keep the
+    # decode-loop discipline — f32 accumulation (the extended
+    # accum-dtype rule's dequant-matmul face), no host callbacks, no
+    # fresh gather suppressions; (2) the budgets.json peak RATCHETS
+    # the footprint win — the quantized step's live bytes must stay
+    # BELOW the bf16 twin's measured peak (31142), so the capacity
+    # gain cannot silently regress.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,), kv_dtype="int8",
+                             spec=SpecConfig(k=2, draft_layers=1))
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-int8", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_dp_recipe(7, eng._decode_slot_args,
+                          "dp over slot-major step inputs; pool, scale "
+                          "tables and block tables replicated exactly "
+                          "as the ragged twin"))

@@ -76,9 +76,14 @@ class AccumDtypeRule(Rule):
     rule_id = "accum-dtype"
     severity = "error"
     doc = ("dot/einsum/conv accumulating in bf16/f16 without "
-           "preferred_element_type=float32")
+           "preferred_element_type=float32 (incl. dequant-matmul "
+           "chains from int8 sources)")
 
     _PRIMS = ("dot_general", "conv_general_dilated")
+    # elementwise/layout ops a dequantization chain is made of:
+    # convert(int8) -> * scale -> (broadcast/reshape/transpose) -> dot
+    _DEQUANT_CHAIN = ("convert_element_type", "mul", "add",
+                      "broadcast_in_dim", "reshape", "transpose")
 
     def check_eqn(self, eqn, state, ctx):
         if eqn.primitive.name not in self._PRIMS:
@@ -95,6 +100,64 @@ class AccumDtypeRule(Rule):
                 suggestion="pass preferred_element_type=jnp.float32 "
                            "(cast the result back if the policy wants "
                            "narrow outputs)")
+
+    def check_jaxpr(self, jaxpr, state, ctx):
+        # The DEQUANT-MATMUL face of the same trap (PR 12's int8 KV
+        # pools): a dot whose operand IS (or traces, through a short
+        # dequant chain, to) a quantized byte-wide int tensor, with the
+        # result materializing in a narrow float — the dequantized
+        # values lose their one recovery of precision in the
+        # accumulator.  The all-narrow-operand form is check_eqn's;
+        # this hook covers the dots that slip it because one operand's
+        # dtype is integral.  Byte-wide int kinds only — bool masks and
+        # int32 index math are not quantized data.
+        producers = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                producers[id(v)] = eqn
+
+        def _quant_source(v, depth):
+            if not _is_var(v):
+                return None
+            try:
+                dt = np.dtype(v.aval.dtype)
+            except TypeError:               # extended dtypes (PRNG, ...)
+                return None
+            if dt.kind in "iu" and dt.itemsize == 1:
+                return v.aval
+            prod = producers.get(id(v))
+            if prod is None or depth >= 6 or \
+                    prod.primitive.name not in self._DEQUANT_CHAIN:
+                return None
+            for iv in prod.invars:
+                src = _quant_source(iv, depth + 1)
+                if src is not None:
+                    return src
+            return None
+
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name not in self._PRIMS:
+                continue
+            out_dtype = _dtype_name(eqn.outvars[0].aval)
+            if out_dtype not in _NARROW_FLOATS:
+                continue
+            in_dtypes = [_dtype_name(v.aval) for v in eqn.invars[:2]]
+            if all(d in _NARROW_FLOATS for d in in_dtypes):
+                continue            # check_eqn already reported this one
+            for v in eqn.invars[:2]:
+                src = _quant_source(v, 0)
+                if src is not None:
+                    ctx.report(
+                        self, f"{state.path}/{eqn.primitive.name}",
+                        f"dequant-matmul: {eqn.primitive.name} operand "
+                        f"traces to a {_dtype_name(src)} quantized "
+                        f"tensor but accumulates in {out_dtype}",
+                        eqn=eqn,
+                        suggestion="dequantize into f32 (scale in f32, "
+                                   "preferred_element_type=jnp.float32)"
+                                   " so the only rounding is the int8 "
+                                   "grid itself")
+                    break
 
 
 # ---------------------------------------------------- weak-type-promotion

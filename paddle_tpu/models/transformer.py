@@ -52,7 +52,15 @@ class TransformerConfig:
 
     # "f32" (default) | "bf16": the dtype score tensors materialize in
     # between XLA fusions (accumulation and softmax math stay f32) —
-    # the measured-dominant HBM traffic term at training shapes
+    # the measured-dominant HBM traffic term at training shapes.
+    # PRECEDENCE: this knob only governs the default einsum attention
+    # (dot_product_attention).  An explicit attention implementation
+    # wins over it — ``flash=True`` and a custom ``attn_fn`` (flash,
+    # ring, paged views) never materialize score tensors in HBM, so
+    # there is nothing for ``scores`` to change and the setting is a
+    # no-op there; both combinations warn once (``__post_init__`` for
+    # flash, the forward pass for attn_fn) rather than erroring, since
+    # they are harmless but would silently mis-measure a benchmark.
     scores: str = "f32"
 
     def __post_init__(self):

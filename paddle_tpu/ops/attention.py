@@ -217,9 +217,9 @@ def blockwise_attn_chunk(q, k, v, bias, carry):
     probs = jnp.exp(logits - new_max[..., None])       # [b,h,q,k]
     chunk_sum = jnp.sum(probs, axis=-1)
     new_sum = row_sum * correction + chunk_sum
-    chunk_out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
-    acc = acc * jnp.swapaxes(correction, 1, 2)[..., None] + \
-        chunk_out.astype(jnp.float32)
+    chunk_out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                           preferred_element_type=jnp.float32)
+    acc = acc * jnp.swapaxes(correction, 1, 2)[..., None] + chunk_out
     return acc, new_max, new_sum
 
 
@@ -304,11 +304,12 @@ class MultiHeadAttention(Module):
                     "paged cache mode: per-token masks are unsupported; "
                     "append_valid bounds the fresh tokens and lengths "
                     "bound the context")
-            kp, vp = paged.paged_append(cache, k, v)
+            cache = paged.paged_append(cache, k, v)
             out = paged.paged_chunked_attention(
-                q, kp, vp, cache.block_table, cache.lengths,
-                cache.append_valid)
-            new_cache = cache._replace(k_pages=kp, v_pages=vp)
+                q, cache.k_pages, cache.v_pages, cache.block_table,
+                cache.lengths, cache.append_valid,
+                k_scales=cache.k_scales, v_scales=cache.v_scales)
+            new_cache = cache
         elif isinstance(cache, paged.PagedLayerView):
             # PAGED cache form (block-pool K/V + block table — see
             # ops/paged_attention.py): append the fresh keys/values
@@ -319,25 +320,30 @@ class MultiHeadAttention(Module):
                     "paged cache mode: per-token masks are unsupported; "
                     "append_valid bounds the fresh tokens and lengths "
                     "bound the context")
-            kp, vp = paged.paged_append(cache, k, v)
+            cache = paged.paged_append(cache, k, v)
             if t == 1:
                 # decode step: gather-by-block-table attention over the
                 # row's committed prefix + the token just written
                 out = paged.paged_decode_attention(
-                    q, kp, vp, cache.block_table,
-                    cache.lengths + cache.append_valid)
+                    q, cache.k_pages, cache.v_pages, cache.block_table,
+                    cache.lengths + cache.append_valid,
+                    k_scales=cache.k_scales, v_scales=cache.v_scales)
             else:
                 # prefill into a FRESH slot (lengths 0): the context is
                 # exactly the fresh tokens, so attention runs over the
                 # in-flight k/v — flash/ring attn_fn applies, same as
                 # the dense position-0 prefill.  Chunked prefill
                 # (lengths > 0 with t > 1) is not a supported call.
+                # On quantized pools this path scores the UNQUANTIZED
+                # in-flight k/v; the quantization error enters on the
+                # first pool READ, exactly like the dense->paged
+                # handoff in the chunked path.
                 prefill_mask = (jnp.arange(t)[None, :]
                                 < cache.append_valid[:, None])
                 inner = self.attn_fn or dot_product_attention
                 out = inner(q, k, v, mask=prefill_mask,
                             causal=self.causal)
-            new_cache = cache._replace(k_pages=kp, v_pages=vp)
+            new_cache = cache
         elif cache is not None:
             enforce(position is not None,
                     "MultiHeadAttention cache mode needs position")

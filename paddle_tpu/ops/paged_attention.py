@@ -57,6 +57,9 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30      # finite mask value (see ops/attention.py NEG_INF)
 
+INT8_QMAX = 127.0    # symmetric int8 range; -128 unused so dequant is
+                     # sign-symmetric and |q*scale| <= amax exactly
+
 
 class PagedKVCache(NamedTuple):
     """Global paged K/V state — one pytree, jit-carryable.
@@ -72,6 +75,21 @@ class PagedKVCache(NamedTuple):
     several slots and/or pinned by the host prefix registry).  The
     ``free`` property derives the old bool mask, so accounting reads
     (``occupancy()``, tests) are unchanged.
+
+    ``k_scales``/``v_scales``: per-layer tuples of ``[num_blocks,
+    heads]`` f32 dequant scales, present only when the pools are
+    QUANTIZED (``paged_init(dtype="int8")``); ``()`` otherwise, so the
+    unquantized pytree — and every program compiled over it — is
+    byte-identical to the pre-quantization layout.  Scales are
+    PHYSICAL-block-indexed: sharing a block into another slot's table
+    (``paged_share``) or rolling a cursor back (``paged_rollback``)
+    never touches them, a COW copy carries them with the pages, and
+    ``paged_reserve`` zeroes a claimed block's scales so a recycled
+    block cannot inherit its previous owner's range.  A scale only
+    GROWS while a block is owned (monotone max over appended |K|/|V|
+    per head, see ``paged_append``), which is what makes quantize-on-
+    append safe under chunked writes: already-committed rows requantize
+    in place when their block's scale grows.
     """
 
     k_pages: Tuple[jax.Array, ...]
@@ -80,11 +98,22 @@ class PagedKVCache(NamedTuple):
     lengths: jax.Array
     blocks_used: jax.Array
     refcounts: jax.Array
+    k_scales: Tuple[jax.Array, ...] = ()
+    v_scales: Tuple[jax.Array, ...] = ()
 
     @property
     def free(self) -> jax.Array:
         """``[num_blocks]`` bool, True = block is in the pool (rc 0)."""
         return self.refcounts == 0
+
+    @property
+    def quantized(self) -> bool:
+        """True when the pools store quantized values + scale tensors."""
+        return len(self.k_scales) > 0
+
+    @property
+    def kv_dtype(self):
+        return self.k_pages[0].dtype
 
     # shape-derived statics (usable under jit — shapes are concrete)
     @property
@@ -125,6 +154,8 @@ class PagedLayerView(NamedTuple):
     block_table: jax.Array   # [b, max_blocks_per_slot] int32
     lengths: jax.Array       # [b] int32 — tokens committed BEFORE this call
     append_valid: jax.Array  # [b] int32 — fresh tokens to commit this call
+    k_scales: jax.Array = None   # [num_blocks, h] f32, None = unquantized
+    v_scales: jax.Array = None
 
 
 class PagedChunkedView(NamedTuple):
@@ -146,13 +177,35 @@ class PagedChunkedView(NamedTuple):
     block_table: jax.Array   # [b, max_blocks_per_slot] int32
     lengths: jax.Array       # [b] int32 — tokens committed BEFORE this call
     append_valid: jax.Array  # [b] int32 — fresh tokens to commit this call
+    k_scales: jax.Array = None   # [num_blocks, h] f32, None = unquantized
+    v_scales: jax.Array = None
 
 
 def paged_init(num_layers: int, num_slots: int, max_blocks_per_slot: int,
                num_blocks: int, block_size: int, num_heads: int,
                head_dim: int, dtype=jnp.float32) -> PagedKVCache:
-    """Empty cache: zeroed pools, all blocks free, no slot mapped."""
+    """Empty cache: zeroed pools, all blocks free, no slot mapped.
+
+    ``dtype="int8"`` (or ``jnp.int8``) builds QUANTIZED pools: int8
+    K/V blocks plus per-block-per-head f32 scale tensors — 1 byte per
+    element instead of 2 (bf16) or 4 (f32), the admission-capacity
+    knob (ROADMAP: int8 pools double-to-quadruple resident requests).
+    Every write path quantizes on append and every read path dequants
+    (XLA gather forms here, the Pallas kernel in
+    ``ops/pallas_paged_attention.py``); parity against a float pool is
+    a bounded max-logit divergence, not bit-exactness.
+    """
+    dtype = jnp.dtype(dtype)
     shape = (num_blocks, block_size, num_heads, head_dim)
+
+    def _scales():
+        # distinct buffers per leaf: k_scales and v_scales must never
+        # alias, or donating the cache donates one buffer twice
+        if dtype != jnp.int8:
+            return ()
+        return tuple(jnp.zeros((num_blocks, num_heads), jnp.float32)
+                     for _ in range(num_layers))
+
     return PagedKVCache(
         k_pages=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
         v_pages=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
@@ -160,7 +213,8 @@ def paged_init(num_layers: int, num_slots: int, max_blocks_per_slot: int,
                               jnp.int32),
         lengths=jnp.zeros((num_slots,), jnp.int32),
         blocks_used=jnp.zeros((num_slots,), jnp.int32),
-        refcounts=jnp.zeros((num_blocks,), jnp.int32))
+        refcounts=jnp.zeros((num_blocks,), jnp.int32),
+        k_scales=_scales(), v_scales=_scales())
 
 
 def paged_reserve(cache: PagedKVCache, want):
@@ -200,8 +254,18 @@ def paged_reserve(cache: PagedKVCache, want):
     cols = cache.blocks_used[:, None] + jnp.arange(maxb)[None, :]
     cols = jnp.where(need, cols, maxb)         # non-need -> dropped
     tables = cache.block_tables.at[rows, cols].set(ids2, mode="drop")
-    return cache._replace(refcounts=refcounts, block_tables=tables,
-                          blocks_used=cache.blocks_used + n_new), ok
+    out = cache._replace(refcounts=refcounts, block_tables=tables,
+                         blocks_used=cache.blocks_used + n_new)
+    if cache.quantized:
+        # a recycled block must not inherit its previous owner's range:
+        # scales grow monotonically while owned, so the reset happens
+        # at claim time, never at free time
+        out = out._replace(
+            k_scales=tuple(jnp.where(claimed[:, None], 0.0, s)
+                           for s in cache.k_scales),
+            v_scales=tuple(jnp.where(claimed[:, None], 0.0, s)
+                           for s in cache.v_scales))
+    return out, ok
 
 
 def paged_advance(cache: PagedKVCache, counts) -> PagedKVCache:
@@ -331,6 +395,16 @@ def paged_cow(cache: PagedKVCache, want):
         # tpu-lint: disable=gather-in-decode — V half of the copy-on-write page copy
         v_pages = tuple(v.at[ids].set(v[src], mode="drop")
                         for v in cache.v_pages)
+        scale_upd = {}
+        if cache.quantized:
+            # a quantized copy is byte-for-byte: the private block
+            # starts from the shared block's scales and grows from
+            # there — shared readers keep dequantizing identically
+            scale_upd = dict(
+                k_scales=tuple(s.at[ids].set(s[src], mode="drop")
+                               for s in cache.k_scales),
+                v_scales=tuple(s.at[ids].set(s[src], mode="drop")
+                               for s in cache.v_scales))
         d32 = diverge.astype(jnp.int32)
         dec = jnp.zeros((nb,), jnp.int32).at[
             jnp.where(diverge, cur_c, nb)].add(d32, mode="drop")
@@ -340,7 +414,8 @@ def paged_cow(cache: PagedKVCache, want):
                 ids, mode="drop")
         return cache._replace(
             k_pages=k_pages, v_pages=v_pages, block_tables=tables,
-            refcounts=jnp.maximum(cache.refcounts - dec, 0) + inc), ok
+            refcounts=jnp.maximum(cache.refcounts - dec, 0) + inc,
+            **scale_upd), ok
 
     return jax.lax.cond(jnp.any(diverge), copy,
                         lambda c: (c, jnp.asarray(True)), cache)
@@ -388,8 +463,10 @@ def layer_views(cache: PagedKVCache, slot_ids, append_valid):
     table = cache.block_tables[slot_ids]
     lens = cache.lengths[slot_ids]
     valid = jnp.asarray(append_valid, jnp.int32)
-    return [PagedLayerView(k, v, table, lens, valid)
-            for k, v in zip(cache.k_pages, cache.v_pages)]
+    ks = cache.k_scales or (None,) * cache.num_layers
+    vs = cache.v_scales or (None,) * cache.num_layers
+    return [PagedLayerView(k, v, table, lens, valid, sk, sv)
+            for k, v, sk, sv in zip(cache.k_pages, cache.v_pages, ks, vs)]
 
 
 def chunked_layer_views(cache: PagedKVCache, slot_ids, append_valid):
@@ -400,15 +477,73 @@ def chunked_layer_views(cache: PagedKVCache, slot_ids, append_valid):
     table = cache.block_tables[slot_ids]
     lens = cache.lengths[slot_ids]
     valid = jnp.asarray(append_valid, jnp.int32)
-    return [PagedChunkedView(k, v, table, lens, valid)
-            for k, v in zip(cache.k_pages, cache.v_pages)]
+    ks = cache.k_scales or (None,) * cache.num_layers
+    vs = cache.v_scales or (None,) * cache.num_layers
+    return [PagedChunkedView(k, v, table, lens, valid, sk, sv)
+            for k, v, sk, sv in zip(cache.k_pages, cache.v_pages, ks, vs)]
 
 
 def merge_views(cache: PagedKVCache, views) -> PagedKVCache:
     """Fold the model call's updated pools back into the global cache
-    (tables/lengths/free are engine-owned; views only mutate pages)."""
-    return cache._replace(k_pages=tuple(v.k_pages for v in views),
-                          v_pages=tuple(v.v_pages for v in views))
+    (tables/lengths/free are engine-owned; views only mutate pages —
+    and, when quantized, the scales their appends grew)."""
+    out = cache._replace(k_pages=tuple(v.k_pages for v in views),
+                         v_pages=tuple(v.v_pages for v in views))
+    if cache.quantized:
+        out = out._replace(k_scales=tuple(v.k_scales for v in views),
+                           v_scales=tuple(v.v_scales for v in views))
+    return out
+
+
+def _quantized_append(pages: jax.Array, scales: jax.Array,
+                      new: jax.Array, phys: jax.Array):
+    """Quantize-on-append for one pool tensor (K or V of one layer).
+
+    ``pages`` [nb, bs, h, hd] int8, ``scales`` [nb, h] f32, ``new``
+    [b, t, h, hd] float, ``phys`` [b, t] physical block per fresh token
+    (``nb`` = drop sentinel for invalid lanes).  Three fixed-shape
+    steps, all conflict-free under the engine's invariants:
+
+    1. scatter-max the fresh tokens' per-head |amax| onto their blocks
+       and GROW each touched block's scale monotonically
+       (``max(scale, amax / 127)`` — never shrink, so rows committed
+       earlier stay representable);
+    2. requantize the cursor block's already-committed rows where its
+       scale grew (``q' = round(q * old / new)``).  Only the FIRST
+       block of a row's append window can hold committed rows — later
+       blocks were claimed by this call's ``paged_reserve`` (scales
+       reset to 0) — and an appending slot owns its cursor block
+       exclusively (``paged_cow`` runs first on shared blocks), so the
+       block-granular scatter cannot race another slot's data;
+    3. quantize the fresh rows against the grown scales and scatter
+       them in (overwriting their requantized-garbage positions).
+    """
+    nb = pages.shape[0]
+    h = new.shape[2]
+    newf = new.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(newf), axis=-1)                     # [b,t,h]
+    blk_amax = jnp.zeros((nb, h), jnp.float32).at[
+        phys.reshape(-1)].max(amax.reshape(-1, h), mode="drop")
+    grown = jnp.maximum(scales, blk_amax / INT8_QMAX)          # [nb,h]
+    # tpu-lint: disable=gather-in-decode — cursor-block requantize reads S blocks, the quantized-append contract
+    cur = phys[:, 0]                        # first-token block = cursor
+    cur_c = jnp.clip(cur, 0, nb - 1)
+    old_s = scales[cur_c]                                      # [b,h]
+    new_s = grown[cur_c]
+    factor = jnp.where(new_s > 0,
+                       old_s / jnp.where(new_s > 0, new_s, 1.0), 0.0)
+    grew = (cur < nb) & jnp.any(new_s > old_s, axis=-1)        # [b]
+    requant = jnp.clip(
+        jnp.round(pages[cur_c].astype(jnp.float32)
+                  * factor[:, None, :, None]),
+        -INT8_QMAX, INT8_QMAX).astype(pages.dtype)
+    pages = pages.at[jnp.where(grew, cur_c, nb)].set(requant,
+                                                     mode="drop")
+    tok_s = grown[jnp.clip(phys, 0, nb - 1)]                   # [b,t,h]
+    safe = jnp.where(tok_s > 0, tok_s, 1.0)
+    q = jnp.clip(jnp.round(newf / safe[..., None]),
+                 -INT8_QMAX, INT8_QMAX).astype(pages.dtype)
+    return pages, q, grown
 
 
 def paged_append(view: PagedLayerView, k_new: jax.Array,
@@ -419,7 +554,10 @@ def paged_append(view: PagedLayerView, k_new: jax.Array,
     physical ``(block_table[r, pos // bs], pos % bs)``.  Rows past
     ``append_valid[r]``, rows overflowing the table, and unmapped
     (``-1``) entries are routed to an out-of-range index and DROPPED —
-    an inactive slot writes nothing.  Returns ``(k_pages, v_pages)``.
+    an inactive slot writes nothing.  Returns the view with its pools
+    (and, on quantized pools, scales) updated — every write path
+    (decode append, chunked tail prefill, speculative verify windows)
+    funnels through here, so quantize-on-append covers them all.
     """
     nb, bs = view.k_pages.shape[0], view.k_pages.shape[1]
     maxb = view.block_table.shape[1]
@@ -432,11 +570,20 @@ def paged_append(view: PagedLayerView, k_new: jax.Array,
     phys = jnp.take_along_axis(view.block_table,
                                jnp.clip(blk, 0, maxb - 1), axis=1)
     phys = jnp.where(valid & (blk < maxb) & (phys >= 0), phys, nb)
+    if view.k_scales is not None:
+        k_pages, k_q, k_scales = _quantized_append(
+            view.k_pages, view.k_scales, k_new, phys)
+        v_pages, v_q, v_scales = _quantized_append(
+            view.v_pages, view.v_scales, v_new, phys)
+        return view._replace(
+            k_pages=k_pages.at[phys, within].set(k_q, mode="drop"),
+            v_pages=v_pages.at[phys, within].set(v_q, mode="drop"),
+            k_scales=k_scales, v_scales=v_scales)
     k_pages = view.k_pages.at[phys, within].set(
         k_new.astype(view.k_pages.dtype), mode="drop")
     v_pages = view.v_pages.at[phys, within].set(
         v_new.astype(view.v_pages.dtype), mode="drop")
-    return k_pages, v_pages
+    return view._replace(k_pages=k_pages, v_pages=v_pages)
 
 
 # --- decode-attention kernel selection -------------------------------
@@ -607,7 +754,8 @@ def _use_kernel(q, k_pages, scale) -> bool:
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, block_table: jax.Array,
                            lengths: jax.Array,
-                           scale=None) -> jax.Array:
+                           scale=None, *, k_scales=None,
+                           v_scales=None) -> jax.Array:
     """Decode attention by block table: ``q`` [b, 1, h, hd] attends each
     row's ``lengths[r]`` committed tokens gathered from the pools.
 
@@ -621,13 +769,25 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     exactly-zero weight and the result is bit-identical to the dense
     cache path over the same tokens; the interpret-mode parity suite
     pins kernel == fallback within 1e-6 on every nasty shape.
+
+    ``k_scales``/``v_scales`` ([num_blocks, h] f32) are REQUIRED for
+    int8 pools: both paths dequantize per (block, head) before the
+    dot, keeping f32 accumulation, and kernel-vs-XLA parity stays a
+    tight elementwise bound (the quantization error itself lives in
+    the pools, identically on both paths).
     """
+    assert (k_scales is not None) == (jnp.dtype(k_pages.dtype)
+                                      == jnp.int8), (
+        "int8 pools need k_scales/v_scales and float pools must not "
+        "pass them — a raw int8 gather would attend garbage")
     if q.shape[1] == 1 and _use_kernel(q, k_pages, scale):
         from paddle_tpu.ops.pallas_paged_attention import (
             paged_decode_attention_kernel)
         _note_dispatch("decode")
         return paged_decode_attention_kernel(q, k_pages, v_pages,
-                                             block_table, lengths, scale)
+                                             block_table, lengths, scale,
+                                             k_scales=k_scales,
+                                             v_scales=v_scales)
     # t>1 through THIS entrypoint is the uniform-bound form (every
     # query attends the same lengths[r] tokens, no causal offset) —
     # the ragged kernel implements the chunked per-query bound, so
@@ -636,22 +796,48 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
     if q.shape[1] == 1:
         _note_fallback(_fallback_reason(q, k_pages, scale))
     return _paged_decode_attention_xla(q, k_pages, v_pages, block_table,
-                                       lengths, scale)
+                                       lengths, scale,
+                                       k_scales=k_scales,
+                                       v_scales=v_scales)
+
+
+def _gather_pages(k_pages, v_pages, table, k_scales, v_scales):
+    """Shared gather + (when quantized) dequant for the XLA forms:
+    ``[nb, bs, h, hd]`` pools -> ``[b, maxb*bs, h, hd]`` per-row
+    context, multiplied by the per-(block, head) scales gathered
+    through the same table so quantized and float pools read through
+    one code path."""
+    b, maxb = table.shape
+    bs, h, hd = k_pages.shape[1], k_pages.shape[2], k_pages.shape[3]
+    # tpu-lint: disable=gather-in-decode — FALLBACK-ONLY: on TPU the Pallas kernel serves decode and this gather never traces; off-TPU the gather is the portable form
+    k = k_pages[table]
+    # tpu-lint: disable=gather-in-decode — fallback-only, same as the K gather above
+    v = v_pages[table]
+    if k_scales is not None:
+        # tpu-lint: disable=gather-in-decode — [b, maxb, h] f32 scale gather, noise next to the page reads above
+        k = k.astype(jnp.float32) * k_scales[table][:, :, None, :, None]
+        v = v.astype(jnp.float32) * v_scales[table][:, :, None, :, None]
+    return (k.reshape(b, maxb * bs, h, hd),
+            v.reshape(b, maxb * bs, h, hd))
 
 
 def _paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
                                 v_pages: jax.Array,
                                 block_table: jax.Array,
                                 lengths: jax.Array,
-                                scale=None) -> jax.Array:
+                                scale=None, *, k_scales=None,
+                                v_scales=None) -> jax.Array:
     """The XLA gather form — the everywhere fallback, kept verbatim.
 
     Gather ``[b, max_blocks, bs, h, hd]``, flatten the token axis
     (logical position p IS flattened index p — blocks gather in table
     order), einsum with f32 accumulation, finite-NEG_INF mask to the
-    per-row length, f32 softmax.  The K/V gather materializes worst-case
-    table capacity every step — the HBM-traffic cost the Pallas kernel
-    exists to remove; the suppressions below are justified ONLY on this
+    per-row length, f32 softmax.  Quantized pools dequant right after
+    the gather (per-block-per-head scale broadcast), so everything
+    downstream is the float path unchanged.  The K/V gather
+    materializes worst-case table capacity every step — the
+    HBM-traffic cost the Pallas kernel exists to remove; the
+    suppressions in ``_gather_pages`` are justified ONLY on this
     fallback path.
     """
     b, tq, h, hd = q.shape
@@ -659,10 +845,7 @@ def _paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
     maxb = block_table.shape[1]
     scale = (hd ** -0.5) if scale is None else scale
     table = jnp.clip(block_table, 0, nb - 1)
-    # tpu-lint: disable=gather-in-decode — FALLBACK-ONLY: on TPU the Pallas kernel serves decode and this gather never traces; off-TPU the gather is the portable form
-    k = k_pages[table].reshape(b, maxb * bs, h, hd)
-    # tpu-lint: disable=gather-in-decode — fallback-only, same as the K gather above
-    v = v_pages[table].reshape(b, maxb * bs, h, hd)
+    k, v = _gather_pages(k_pages, v_pages, table, k_scales, v_scales)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(maxb * bs)[None, :] < lengths[:, None]      # [b,K]
@@ -676,7 +859,8 @@ def _paged_decode_attention_xla(q: jax.Array, k_pages: jax.Array,
 def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, block_table: jax.Array,
                             lengths: jax.Array, append_valid: jax.Array,
-                            scale=None) -> jax.Array:
+                            scale=None, *, k_scales=None,
+                            v_scales=None) -> jax.Array:
     """Chunked-prefill attention: ``q`` [b, t, h, hd] fresh queries at
     positions ``lengths[r] + j`` attend the row's committed prefix
     PLUS the fresh tokens up to themselves — the t>1, lengths>0 form
@@ -704,22 +888,24 @@ def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
     b, tq, h, hd = q.shape
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
+    assert (k_scales is not None) == (jnp.dtype(k_pages.dtype)
+                                      == jnp.int8), (
+        "int8 pools need k_scales/v_scales and float pools must not "
+        "pass them — a raw int8 gather would attend garbage")
     if _use_kernel(q, k_pages, scale):
         from paddle_tpu.ops.pallas_paged_attention import (
             paged_ragged_attention_kernel)
         _note_dispatch("ragged" if tq > 1 else "decode")
         return paged_ragged_attention_kernel(q, k_pages, v_pages,
-                                             block_table, lengths, scale)
+                                             block_table, lengths, scale,
+                                             k_scales=k_scales,
+                                             v_scales=v_scales)
     scale = (hd ** -0.5) if scale is None else scale
     # a kernel-selected caller past the ragged VMEM budget (or with a
     # traced scale) lands here — surface the typed reason
     _note_fallback(_fallback_reason(q, k_pages, scale))
     table = jnp.clip(block_table, 0, nb - 1)
-    # tpu-lint: disable=gather-in-decode — chunked TAIL PREFILL / speculative VERIFY, not a per-token decode step: one gather covers t tokens, amortized
-
-    k = k_pages[table].reshape(b, maxb * bs, h, hd)
-    # tpu-lint: disable=gather-in-decode — V half of the tail-prefill gather above
-    v = v_pages[table].reshape(b, maxb * bs, h, hd)
+    k, v = _gather_pages(k_pages, v_pages, table, k_scales, v_scales)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     limit = (lengths[:, None] + jnp.arange(tq)[None, :] + 1)     # [b,t]
@@ -756,3 +942,22 @@ def dense_hbm_bytes(max_len: int, *, num_layers: int, num_heads: int,
     """Dense-cache bytes per request slot: ``max_len`` rows regardless
     of actual length."""
     return max_len * 2 * num_layers * num_heads * head_dim * dtype_bytes
+
+
+def paged_pool_bytes(num_blocks: int, *, num_layers: int,
+                     num_heads: int, head_dim: int, block_size: int,
+                     kv_dtype=jnp.float32) -> int:
+    """TOTAL allocated pool bytes for a cache of ``num_blocks`` —
+    K+V pools across layers plus, for quantized pools, the
+    per-block-per-head f32 scale tensors.  This is the honest
+    bytes-per-block the serving engine's admission capacity divides
+    by (``PagedServingEngine(kv_pool_bytes=...)``): an int8 pool pays
+    ``2 * layers * heads * 4`` scale bytes per block on top of its
+    1-byte elements, so the capacity gain is computed from real
+    footprint, not the element-width ratio."""
+    dt = jnp.dtype(kv_dtype)
+    per_block = (2 * num_layers * block_size * num_heads * head_dim
+                 * dt.itemsize)
+    if dt == jnp.int8:
+        per_block += 2 * num_layers * num_heads * 4     # f32 scales
+    return num_blocks * per_block

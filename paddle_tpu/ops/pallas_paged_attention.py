@@ -92,9 +92,20 @@ def _paged_vmem_bytes(block_size: int, group: int, head_dim: int,
     pipeline.  bf16 pools are charged MORE than f32 (6 vs 4 bytes/elt),
     not less — Mosaic stages (2,1)-packed bf16 tiles through unpacked
     copies (the measured behavior behind the LSTM budget's probe table
-    in ops/pallas_kernels.py).
+    in ops/pallas_kernels.py).  int8 pools are charged 5 bytes/elt:
+    1 packed byte streamed plus a 4-byte f32 staging copy for the
+    dequantized tile the dots consume — still below bf16's 6, so the
+    quantized kernel's supported-shape envelope is a superset of the
+    bf16 one (scales ride the scalar-prefetch SMEM path and cost no
+    VMEM).
     """
-    per_elt = 6 if jnp.dtype(kv_dtype) == jnp.bfloat16 else 4
+    dt = jnp.dtype(kv_dtype)
+    if dt == jnp.bfloat16:
+        per_elt = 6
+    elif dt.itemsize == 1:
+        per_elt = 5
+    else:
+        per_elt = 4
     streamed = 2 * 2 * block_size * group * head_dim * per_elt  # K+V, 2-buf
     qo = 2 * 2 * max_q * group * head_dim * 4  # q in + f32 out, 2-buf
     scratch = (max_q * group * head_dim * 4    # acc
@@ -132,9 +143,8 @@ def paged_attention_supported(block_size: int, num_heads: int,
                        max_q) > 0
 
 
-def _ragged_kernel(group: int, tq: int, scale: float, table_ref,
-                   lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
-                   m_ref, l_ref):
+def _ragged_kernel(group: int, tq: int, scale: float, quantized: bool,
+                   table_ref, lens_ref, *refs):
     """One (row, head-group, page) grid step of the online softmax over
     a RAGGED query window.
 
@@ -153,8 +163,23 @@ def _ragged_kernel(group: int, tq: int, scale: float, table_ref,
     running (acc, max, sum) in f32 across the page loop, ``tq`` rows
     per head (head-major: head ``i`` owns scratch rows
     ``[i*tq, (i+1)*tq)``); the output writes once, on the last page.
+
+    ``quantized``: two more scalar-prefetch refs follow ``lens_ref`` —
+    the ``[num_blocks, h]`` f32 K/V scales, read per (page, global
+    head) from SMEM next to the table — and each int8 page tile
+    dequantizes into f32 in VMEM before the online-softmax dots, so
+    the accumulation path below is IDENTICAL to the float one (f32
+    throughout, same masking); the only quantized-specific work is
+    one broadcast multiply per tile.
     """
+    if quantized:
+        (k_scales_ref, v_scales_ref, q_ref, k_ref, v_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        k_scales_ref = v_scales_ref = None
     b_i = pl.program_id(0)
+    hg = pl.program_id(1)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
     bs = k_ref.shape[1]
@@ -180,6 +205,12 @@ def _ragged_kernel(group: int, tq: int, scale: float, table_ref,
         r0 = i * tq
         q_i = q_ref[0, :, i, :]                              # [tq, hd]
         k_i = k_ref[0, :, i, :]                              # [bs, hd]
+        if quantized:
+            # dequant into the VMEM tile before the dot: the page's
+            # physical block and this lane's GLOBAL head index select
+            # one f32 scale from SMEM (scales are per-block-per-head)
+            k_i = (k_i.astype(jnp.float32)
+                   * k_scales_ref[table_ref[b_i, p], hg * group + i])
         s = lax.dot_general(q_i, k_i, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         s = s * scale + bias                                 # [tq, bs] f32
@@ -189,6 +220,8 @@ def _ragged_kernel(group: int, tq: int, scale: float, table_ref,
         alpha = jnp.exp(m_prev - m_new)
         w = jnp.exp(s - m_new)                               # [tq, bs]
         v_i = v_ref[0, :, i, :].astype(jnp.float32)          # [bs, hd]
+        if quantized:
+            v_i = v_i * v_scales_ref[table_ref[b_i, p], hg * group + i]
         pv = lax.dot_general(w, v_i, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
         acc_ref[r0:r0 + tq, :] = acc_ref[r0:r0 + tq, :] * alpha + pv
@@ -208,6 +241,7 @@ def paged_ragged_attention_kernel(q: jax.Array, k_pages: jax.Array,
                                   v_pages: jax.Array,
                                   block_table: jax.Array,
                                   lengths: jax.Array, scale=None, *,
+                                  k_scales=None, v_scales=None,
                                   interpret=None, head_group=None):
     """Fused block-table RAGGED attention — one program for chunked
     prefill, plain decode, and speculative verify windows, the Pallas
@@ -228,11 +262,24 @@ def paged_ragged_attention_kernel(q: jax.Array, k_pages: jax.Array,
     (tests exercise group 1 vs all-heads explicitly).  Call through
     ``paged_chunked_attention`` / ``paged_decode_attention`` unless you
     are the dispatcher or a test.
+
+    QUANTIZED pools pass ``k_scales``/``v_scales`` ([num_blocks, h]
+    f32): they ride the scalar-prefetch path next to the block table
+    (two more SMEM operands, same grid, same BlockSpecs), each page
+    tile dequantizes into VMEM before the online-softmax dots, and the
+    f32 accumulation is untouched — so quantized-vs-XLA parity is the
+    same tight elementwise bound as the float pools' (the quantization
+    error lives in the pool bytes, identically on both paths).
     """
     b, tq, h, hd = q.shape
     nb, bs = k_pages.shape[0], k_pages.shape[1]
     maxb = block_table.shape[1]
     assert tq >= 1, f"ragged kernel needs t >= 1 query columns, got {tq}"
+    quantized = k_scales is not None
+    assert quantized == (jnp.dtype(k_pages.dtype) == jnp.int8), (
+        "int8 pools need k_scales/v_scales and float pools must not "
+        "pass them")
+    assert (v_scales is None) == (k_scales is None)
     scale = (hd ** -0.5) if scale is None else float(scale)
     if interpret is None:
         interpret = not _on_tpu()
@@ -250,36 +297,45 @@ def paged_ragged_attention_kernel(q: jax.Array, k_pages: jax.Array,
     if not interpret and pltpu is not None:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if quantized:
+        # index maps take every scalar-prefetch ref: (table, lens,
+        # k_scales, v_scales); only the table feeds the page lookup
+        q_map = lambda bi, hg, p, tbl, ln, ks, vs: (bi, 0, hg, 0)
+        kv_map = lambda bi, hg, p, tbl, ln, ks, vs: (tbl[bi, p], 0,
+                                                     hg, 0)
+        prefetch = (table, lens, jnp.asarray(k_scales, jnp.float32),
+                    jnp.asarray(v_scales, jnp.float32))
+    else:
+        q_map = lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)
+        kv_map = lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)
+        prefetch = (table, lens)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,               # (table, lens) ride in SMEM
+        num_scalar_prefetch=len(prefetch),   # (table, lens[, scales])
         grid=(b, h // g, maxb),
         in_specs=[
-            pl.BlockSpec((1, tq, g, hd),
-                         lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
-            pl.BlockSpec((1, bs, g, hd),
-                         lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
-            pl.BlockSpec((1, bs, g, hd),
-                         lambda bi, hg, p, tbl, ln: (tbl[bi, p], 0, hg, 0)),
+            pl.BlockSpec((1, tq, g, hd), q_map),
+            pl.BlockSpec((1, bs, g, hd), kv_map),
+            pl.BlockSpec((1, bs, g, hd), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, tq, g, hd),
-                               lambda bi, hg, p, tbl, ln: (bi, 0, hg, 0)),
+        out_specs=pl.BlockSpec((1, tq, g, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((g * tq, hd), jnp.float32),   # acc, head-major
             pltpu.VMEM((g * tq, 1), jnp.float32),    # running max
             pltpu.VMEM((g * tq, 1), jnp.float32),    # running sum
         ])
     return pl.pallas_call(
-        functools.partial(_ragged_kernel, g, tq, scale),
+        functools.partial(_ragged_kernel, g, tq, scale, quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, tq, h, hd), jnp.float32),
         interpret=interpret,
-        **kwargs)(table, lens, q, k_pages, v_pages)
+        **kwargs)(*prefetch, q, k_pages, v_pages)
 
 
 def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
                                   v_pages: jax.Array,
                                   block_table: jax.Array,
                                   lengths: jax.Array, scale=None, *,
+                                  k_scales=None, v_scales=None,
                                   interpret=None, head_group=None):
     """Fused block-table decode attention — the t=1 face of the ragged
     kernel behind the exact same ``(q, pools, table, lengths) ->
@@ -297,4 +353,5 @@ def paged_decode_attention_kernel(q: jax.Array, k_pages: jax.Array,
     lens = jnp.asarray(lengths, jnp.int32)
     return paged_ragged_attention_kernel(
         q, k_pages, v_pages, block_table, lens - 1, scale,
+        k_scales=k_scales, v_scales=v_scales,
         interpret=interpret, head_group=head_group)

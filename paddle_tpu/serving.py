@@ -102,7 +102,8 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
                         block_size: int = 16,
                         max_blocks_per_slot: Optional[int] = None,
                         num_blocks: Optional[int] = None,
-                        decode_kernel=None, draft=None):
+                        decode_kernel=None, draft=None,
+                        kv_dtype=None):
     """Serving-shaped PAGED decode: ``lm_serve_builder``'s contract
     (traced ``steps``, one compiled program per prompt bucket, eos
     early exit, PAD past each row's end) over the block-pool cache.
@@ -174,9 +175,15 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     maxb = (max_blocks_per_slot if max_blocks_per_slot
             else -(-cfg.max_len // bs))
     cap = min(cfg.max_len, maxb * bs)     # per-slot token capacity
+    # kv_dtype=None inherits the numerics policy; "int8" switches the
+    # pool to quantized pages + per-block scales (token streams then
+    # hold to a divergence BOUND vs the policy-dtype pool, not
+    # bit-identity — tests/test_quantized_kv.py pins it)
+    kv_dt = jnp.dtype(kv_dtype if kv_dtype is not None
+                      else get_policy().compute_dtype)
     use_kernel = paged.resolve_decode_kernel(
         decode_kernel, block_size=bs, num_heads=cfg.num_heads,
-        head_dim=hd, kv_dtype=get_policy().compute_dtype)
+        head_dim=hd, kv_dtype=kv_dt)
 
     @functools.partial(jax.jit, static_argnums=(5, 6, 7))
     def _pserve(params, prompt_ids, steps, temperature=0.0, rng=None,
@@ -200,10 +207,9 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
             "mismatched id would silently never terminate")
         assert top_k is None or 1 <= top_k <= cfg.vocab_size
         assert top_p is None or 0.0 < top_p <= 1.0
-        policy = get_policy()
         nb = num_blocks if num_blocks else b * maxb
         cache = paged.paged_init(cfg.num_layers, b, maxb, nb, bs,
-                                 cfg.num_heads, hd, policy.compute_dtype)
+                                 cfg.num_heads, hd, kv_dt)
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
         steps = jnp.clip(jnp.asarray(steps, jnp.int32), 1, max_new)
@@ -312,8 +318,87 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     serve.block_size = bs
     serve.max_blocks_per_slot = maxb
     serve.decode_kernel = use_kernel   # resolved choice, for bench rows
+    serve.kv_dtype = kv_dt             # resolved pool dtype, ditto
     serve.draft_cfg = cfg if draft is not None else None
     return serve
+
+
+def kv_parity_probe(cfg: TransformerConfig, params, prompts, *,
+                    steps: int = 8, kv_dtype="int8",
+                    block_size: int = 16, attn_fn=None,
+                    decode_kernel=False, prompt_lens=None) -> float:
+    """Measured max-logit divergence of a quantized paged pool against
+    the policy-dtype reference pool: prefill ``prompts`` into BOTH
+    pools, then drive ``steps`` greedy decode steps feeding the
+    quantized pool the REFERENCE's token stream (so the number
+    isolates pool quantization error — trajectories cannot fork and
+    turn one flipped argmax into unbounded drift).  Returns
+    ``max_t max_i |logit_q[t, i] - logit_ref[t, i]|`` over the prefill
+    last-token logits and every decode step, as a host float.
+
+    This is the parity CONTRACT's measuring stick (docs/design/
+    serving.md): int8 pools promise a divergence bound, not
+    bit-exactness.  Feed the result to
+    :meth:`PagedServingEngine.note_kv_divergence` to surface it in
+    telemetry, or to a ``bench_row`` (``benchmark/lm_decode.py
+    --kv-dtype``).  ``decode_kernel`` is the usual tri-state; default
+    ``False`` keeps the probe on the XLA form (cheap on CPU CI) —
+    pass ``True`` to probe the kernel-interpret path."""
+    prompts = jnp.asarray(prompts, jnp.int32)
+    b, tp = prompts.shape
+    enforce(steps >= 1 and tp + steps <= cfg.max_len,
+            "kv_parity_probe: prompt %s + steps %s exceeds max_len %s",
+            tp, steps, cfg.max_len)
+    model = _paged_model(cfg, attn_fn)
+    hd = cfg.dim // cfg.num_heads
+    bs = block_size
+    maxb = -(-(tp + steps) // bs)
+    nb = b * maxb
+    lens_j = (jnp.full((b,), tp, jnp.int32) if prompt_lens is None
+              else jnp.clip(jnp.asarray(prompt_lens, jnp.int32), 1, tp))
+    kv_dt = jnp.dtype(kv_dtype)
+    use_kernel = paged.resolve_decode_kernel(
+        decode_kernel, block_size=bs, num_heads=cfg.num_heads,
+        head_dim=hd, kv_dtype=kv_dt)
+
+    def prefill(cache):
+        cache, _ = paged.paged_reserve(cache, lens_j)
+        views = paged.layer_views(cache, jnp.arange(b), lens_j)
+        pos = jnp.broadcast_to(jnp.arange(tp)[None, :], (b, tp))
+        with paged.decode_kernel_scope(use_kernel):
+            (lg, views), _ = model.apply(params, {}, None, prompts,
+                                         views, pos)
+        cache = paged.paged_advance(paged.merge_views(cache, views),
+                                    lens_j)
+        last = jnp.take_along_axis(
+            lg, (lens_j - 1)[:, None, None], axis=1)[:, 0]
+        return cache, last.astype(jnp.float32)
+
+    def step(cache, tok):
+        act = jnp.ones((b,), jnp.int32)
+        cache, _ = paged.paged_reserve(cache, act)
+        views = paged.layer_views(cache, jnp.arange(b), act)
+        with paged.decode_kernel_scope(use_kernel):
+            (lg, views), _ = model.apply(params, {}, None, tok[:, None],
+                                         views, cache.lengths[:, None])
+        cache = paged.paged_advance(paged.merge_views(cache, views),
+                                    act)
+        return cache, lg[:, -1].astype(jnp.float32)
+
+    def make(dt):
+        return paged.paged_init(cfg.num_layers, b, maxb, nb, bs,
+                                cfg.num_heads, hd, dt)
+
+    ref_c, last_r = prefill(make(get_policy().compute_dtype))
+    q_c, last_q = prefill(make(kv_dt))
+    div = jnp.max(jnp.abs(last_q - last_r))
+    tok = jnp.argmax(last_r, axis=-1).astype(jnp.int32)
+    for _ in range(int(steps)):
+        ref_c, lr = step(ref_c, tok)
+        q_c, lq = step(q_c, tok)      # same tokens: no trajectory fork
+        div = jnp.maximum(div, jnp.max(jnp.abs(lq - lr)))
+        tok = jnp.argmax(lr, axis=-1).astype(jnp.int32)
+    return float(div)
 
 
 class _Request:
@@ -444,7 +529,8 @@ class PagedServingEngine:
     """
 
     def __init__(self, cfg: TransformerConfig, params, *,
-                 num_slots: int, num_blocks: int, block_size: int = 16,
+                 num_slots: int, num_blocks: Optional[int] = None,
+                 block_size: int = 16,
                  max_blocks_per_slot: Optional[int] = None,
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
@@ -454,11 +540,39 @@ class PagedServingEngine:
                  prefix_cache: bool = False,
                  max_queue: Optional[int] = None, faults=None,
                  spec: Optional[SpecConfig] = None, draft=None,
-                 unified_step: bool = True):
+                 unified_step: bool = True, kv_dtype=None,
+                 kv_pool_bytes: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
         self.bs = block_size
+        hd = cfg.dim // cfg.num_heads
+        # KV-pool dtype: None inherits the numerics policy's compute
+        # dtype (the pre-quantization behavior, byte-identical pytree);
+        # "int8" stores quantized block pools + per-block-per-head f32
+        # scales (ops/paged_attention.py — the capacity knob).
+        self.kv_dtype = jnp.dtype(kv_dtype if kv_dtype is not None
+                                  else get_policy().compute_dtype)
+        #: real HBM bytes ONE pool block costs across all layers (K+V
+        #: pages plus, when quantized, their scale rows) — the unit the
+        #: admission ledger and kv_pool_bytes sizing are denominated in
+        self.block_bytes = paged.paged_pool_bytes(
+            1, num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=hd, block_size=block_size, kv_dtype=self.kv_dtype)
+        enforce((num_blocks is None) != (kv_pool_bytes is None),
+                "engine pool sizing: pass exactly one of num_blocks "
+                "(block count) or kv_pool_bytes (byte budget; blocks = "
+                "budget // block_bytes), got num_blocks=%s "
+                "kv_pool_bytes=%s", num_blocks, kv_pool_bytes)
+        if num_blocks is None:
+            # byte-budget sizing: the SAME budget admits more blocks
+            # (so more resident requests) under a narrower kv_dtype —
+            # the int8 capacity win, derived from real bytes-per-block
+            num_blocks = int(kv_pool_bytes) // self.block_bytes
+            enforce(num_blocks >= 1,
+                    "kv_pool_bytes=%s cannot hold even one block "
+                    "(%s bytes at kv_dtype=%s)", kv_pool_bytes,
+                    self.block_bytes, self.kv_dtype.name)
         self.nb = num_blocks
         self.maxb = (max_blocks_per_slot if max_blocks_per_slot
                      else -(-cfg.max_len // block_size))
@@ -474,7 +588,6 @@ class PagedServingEngine:
         self._faults = faults
         if self._faults is not None:
             self._faults.fire("attach")
-        hd = cfg.dim // cfg.num_heads
         model = _paged_model(cfg, attn_fn)
         S = self.S
         # Decode-attention implementation, resolved once for the
@@ -484,7 +597,7 @@ class PagedServingEngine:
         self.decode_kernel = paged.resolve_decode_kernel(
             decode_kernel, block_size=block_size,
             num_heads=cfg.num_heads, head_dim=hd,
-            kv_dtype=get_policy().compute_dtype)
+            kv_dtype=self.kv_dtype)
         use_kernel = self.decode_kernel
         sharing = bool(prefix_cache)
         self.prefix_enabled = sharing
@@ -863,7 +976,7 @@ class PagedServingEngine:
         self._compile_watch = CompileWatcher(**watched)
         self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
                                       self.nb, self.bs, cfg.num_heads,
-                                      hd, get_policy().compute_dtype)
+                                      hd, self.kv_dtype)
         self._key = jax.random.key(seed)
         # host mirrors: fixed-shape device carries + per-slot requests
         self._slots = [None] * S          # _Request or None
@@ -990,6 +1103,20 @@ class PagedServingEngine:
                  + " — the positive twin of serving_kernel_fallback_"
                  "total (fires at trace time; the selfcheck mixed-"
                  "batch gate pins form=ragged nonzero)")
+        self._m_kv_pool_bytes = m.gauge(
+            "serving_kv_pool_bytes",
+            help="target KV block-pool footprint in HBM bytes (pages + "
+                 "quantization scales), by dtype= — set once at "
+                 "construction; the int8/bf16 ratio IS the capacity "
+                 "headline")
+        self._m_kv_pool_bytes.set(float(self.nb * self.block_bytes),
+                                  dtype=self.kv_dtype.name)
+        self._m_kv_div = m.gauge(
+            "serving_kv_max_logit_divergence",
+            help="max |logit(quantized) - logit(reference)| observed by "
+                 "the most recent parity probe (kv_parity_probe / "
+                 "note_kv_divergence) — NOT sampled by the engine loop; "
+                 "0 until a probe reports")
         if spec is not None:
             self._m_spec_drafted = m.counter(
                 "serving_spec_draft_tokens_total",
@@ -1110,6 +1237,15 @@ class PagedServingEngine:
         The selfcheck mixed-batch gate asserts nonzero ragged
         dispatches so a silent regression to the XLA path is loud."""
         self._m_kernel_dispatch.inc(form=form)
+
+    def note_kv_divergence(self, value: float):
+        """Record a measured quantization divergence (max absolute
+        logit delta vs a reference pool, the ``kv_parity_probe``
+        output) into ``serving_kv_max_logit_divergence{dtype=}``.  The
+        engine never measures this itself — a probe needs a second,
+        reference-dtype forward pass — so the gauge reports whatever
+        the operator's most recent probe found."""
+        self._m_kv_div.set(float(value), dtype=self.kv_dtype.name)
 
     def _admit(self):
         """Prefill queued requests into free slots while the pool's
@@ -1769,29 +1905,38 @@ class PagedServingEngine:
     def hbm_report(self):
         """Cache-HBM accounting: paged bytes for the ACTIVE requests'
         actual lengths vs what the dense ``[S, max_len]`` cache would
-        pin — the scaling the paged layout exists for."""
+        pin — the scaling the paged layout exists for.  Pool totals
+        come from the REAL bytes-per-block (``self.block_bytes``, which
+        counts the quantization scale tensors alongside the int8
+        pages); the dense comparison stays at the compute dtype — a
+        dense cache has no quantized form here, so comparing against
+        it at kv bytes would overstate the paged win."""
         hd = self.cfg.dim // self.cfg.num_heads
-        dtype_bytes = jnp.dtype(get_policy().compute_dtype).itemsize
+        kv_bytes = self.kv_dtype.itemsize
         lens = [len(r.tokens) + r.prompt.shape[0]
                 for r in self._slots if r is not None]
-        kw = dict(num_layers=self.cfg.num_layers,
-                  num_heads=self.cfg.num_heads, head_dim=hd,
-                  dtype_bytes=dtype_bytes)
+        L, h = self.cfg.num_layers, self.cfg.num_heads
+        # scale rows: [num_blocks, num_heads] f32 per layer, K and V
+        scale_bytes = (2 * L * h * 4 * self.nb
+                       if self.cache.quantized else 0)
         return {
             "active_lengths": lens,
+            "kv_dtype": self.kv_dtype.name,
+            "block_bytes": self.block_bytes,
             "paged_bytes_per_request": paged_hbm_bytes(
-                lens, block_size=self.bs, **kw),
+                lens, block_size=self.bs, num_layers=L, num_heads=h,
+                head_dim=hd, dtype_bytes=kv_bytes),
             "dense_bytes_per_request": dense_hbm_bytes(
-                self.cfg.max_len, **kw),
-            "pool_bytes_total": self.nb * self.bs * 2
-            * self.cfg.num_layers * self.cfg.num_heads * hd
-            * dtype_bytes,
+                self.cfg.max_len, num_layers=L, num_heads=h,
+                head_dim=hd,
+                dtype_bytes=jnp.dtype(get_policy().compute_dtype)
+                .itemsize),
+            "pool_bytes_total": self.nb * self.block_bytes,
+            "kv_scale_bytes": scale_bytes,
             # blocks the prefix registry holds resident past their
             # donors (the HBM rent prefix sharing pays for its hits)
             "prefix_pinned_blocks": self._pinned,
-            "prefix_pinned_bytes": self._pinned * self.bs * 2
-            * self.cfg.num_layers * self.cfg.num_heads * hd
-            * dtype_bytes,
+            "prefix_pinned_bytes": self._pinned * self.block_bytes,
         }
 
     def stats(self):

@@ -123,6 +123,7 @@ INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode-kernel",
     "paged-engine-decode-prefix",
     "paged-engine-decode-spec",
+    "paged-engine-step-int8",
     "paged-engine-step-ragged",
     "paged-serve-step",
     "trainer-train-step",
@@ -500,6 +501,111 @@ def _check_unified_smoke():
     return int(ragged), compiles
 
 
+#: Spec accept-rate slack the int8 pool is allowed vs the bf16 twin on
+#: the selfcheck fixture: quantized verify logits may flip near-tie
+#: accepts, but a collapse (the draft never agreeing with the target
+#: because the pool dequantizes garbage) blows through this bound.
+INT8_ACCEPT_RATE_SLACK = 0.35
+
+
+def _check_int8_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.ops import paged_attention as paged
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=32)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0),
+                           jnp.zeros((1, 4), jnp.int32))
+
+    def drive(kv_dtype, reg):
+        # the unified-smoke mixed batch, so the ONE quantized step
+        # program serves ragged tail-prefill, plain decode, and
+        # k-token spec-verify windows — every pool write path
+        # quantizes, every read path dequantizes
+        eng = PagedServingEngine(cfg, params, num_slots=2,
+                                 num_blocks=16, block_size=4,
+                                 prompt_buckets=(4, 16), metrics=reg,
+                                 decode_kernel=True, kv_dtype=kv_dtype,
+                                 spec=SpecConfig(k=2, draft_layers=1),
+                                 seed=0)
+        eng.submit(np.arange(1, 13, dtype=np.int32), max_new=6)
+        eng.submit(np.arange(2, 5, dtype=np.int32), max_new=6)
+        out = eng.run()
+        hist = reg.snapshot()["metrics"].get(
+            "serving_spec_accept_rate", {"series": []})["series"]
+        n = sum(s["count"] for s in hist)
+        rate = (sum(s["sum"] for s in hist) / n) if n else 0.0
+        return eng, out, rate
+
+    ref_reg = MetricsRegistry("selfcheck-int8-ref")
+    _, ref_out, ref_rate = drive(None, ref_reg)
+    reg = MetricsRegistry("selfcheck-int8")
+    eng, out, rate = drive("int8", reg)
+    if len(out) != 2:
+        _fail(f"int8 smoke returned {len(out)} streams, wanted 2")
+
+    compiles = eng.compile_counts()
+    if compiles.get("step") != 1 or compiles.get("draft") != 1 \
+            or compiles.get("prefill", 0) > 1 or "decode" in compiles \
+            or "verify" in compiles:
+        _fail("the compile-set pin (step == 1, at most one prefill) "
+              f"broke under kv_dtype=int8: {compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    disp = metrics.get("serving_kernel_dispatch_total", {"series": []})
+    ragged = sum(s["value"] for s in disp["series"]
+                 if s["labels"].get("form") == "ragged")
+    if ragged <= 0:
+        _fail("serving_kernel_dispatch_total{form=ragged} is 0 under "
+              "kv_dtype=int8 — the quantized step traced without the "
+              "ragged kernel")
+    fb = metrics.get("serving_kernel_fallback_total", {"series": []})
+    if sum(s["value"] for s in fb["series"]) != 0:
+        _fail("the quantized path silently regressed to the XLA "
+              "gather form: serving_kernel_fallback_total carries "
+              f"{[(s['labels'], s['value']) for s in fb['series']]}")
+
+    # accept-rate bound vs the bf16 twin (the spec-verify stress test:
+    # quantized verify logits score quantized-pool context)
+    if rate < ref_rate - INT8_ACCEPT_RATE_SLACK:
+        _fail(f"int8 spec accept rate {rate:.3f} fell more than "
+              f"{INT8_ACCEPT_RATE_SLACK} below the reference pool's "
+              f"{ref_rate:.3f} — quantization is corrupting verify")
+
+    # footprint truth: the pool gauge carries the int8 dtype label and
+    # agrees with hbm_report, which must count the scale tensors
+    pool_g = metrics.get("serving_kv_pool_bytes", {"series": []})
+    by_dtype = {s["labels"].get("dtype"): s["value"]
+                for s in pool_g["series"]}
+    rep = eng.hbm_report()
+    if by_dtype.get("int8") != float(rep["pool_bytes_total"]):
+        _fail(f"serving_kv_pool_bytes{{dtype=int8}} {by_dtype} does "
+              f"not match hbm_report pool_bytes_total "
+              f"{rep['pool_bytes_total']}")
+    if rep["kv_scale_bytes"] <= 0:
+        _fail("hbm_report kv_scale_bytes is 0 for an int8 pool — the "
+              "scale tensors are unaccounted HBM")
+    hd = cfg.dim // cfg.num_heads
+    bf16_total = eng.nb * paged.paged_pool_bytes(
+        1, num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+        head_dim=hd, block_size=eng.bs, kv_dtype=jnp.bfloat16)
+    if rep["pool_bytes_total"] >= bf16_total:
+        _fail(f"int8 pool bytes {rep['pool_bytes_total']} not below "
+              f"the bf16 pool's {bf16_total} at equal capacity")
+    return rate, ref_rate, int(ragged)
+
+
 def _check_health():
     import jax.numpy as jnp
     import numpy as np
@@ -733,6 +839,12 @@ def main(argv=None) -> int:
           "kernel dispatch(es), 0 fallbacks, compile set shrunken to "
           f"{{step: 1, prefill: {u_compiles.get('prefill', 0)}}} "
           "+ draft programs)")
+    i_rate, i_ref, i_ragged = _check_int8_smoke()
+    print(f"selfcheck: int8 pool smoke ok ({i_ragged} ragged "
+          "dispatch(es) on the quantized kernel, 0 fallbacks, pool "
+          "gauge matches hbm_report with scale bytes counted, spec "
+          f"accept rate {i_rate:.2f} within {INT8_ACCEPT_RATE_SLACK} "
+          f"of the bf16 twin's {i_ref:.2f})")
     hsnap, h_per_step = _check_health()
     print("selfcheck: training health smoke ok "
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "

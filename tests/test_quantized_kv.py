@@ -1,0 +1,419 @@
+"""Quantized int8 KV block pools (``paged_init(dtype="int8")`` through
+``PagedServingEngine(kv_dtype=)``).
+
+The load-bearing pins:
+
+* dequant-on-read parity is a BOUNDED max divergence, never garbage:
+  the XLA gather form and the Pallas kernels (interpret mode) read an
+  int8 pool within ``INT8_ATTN_TOL`` of the f32 twin holding the same
+  tokens, across the nasty shapes — length 0, lengths exactly on a
+  block boundary, chunked appends, ragged multi-token windows;
+* kernel-vs-XLA parity on the SAME int8 pool stays a tight elementwise
+  bound (1e-5): the quantization error lives in the pool, identically
+  on both read paths;
+* the scale lifecycle: monotone growth requantizes committed rows in
+  place, ``paged_reserve`` zeroes a recycled block's scales,
+  ``paged_cow`` copies scales with the pages and isolates writers,
+  sharing never perturbs the shared reader;
+* footprint is honest: ``paged_pool_bytes`` halves bf16 (quarter f32)
+  plus exactly the per-block scale overhead, and the engine's
+  byte-budget admission (``kv_pool_bytes=``) turns that into more
+  resident blocks at the same HBM;
+* the engine contract survives quantization: ``compiles == {'step': 1,
+  'prefill': 1}``, ``hbm_report`` counts the scale tensors, spec
+  accept rate stays within a bound of the bf16 twin, and the
+  ``kv_parity_probe`` divergence is small;
+* tpu-lint's accum-dtype rule catches the DEQUANT-MATMUL face: a dot
+  tracing to an int8 tensor but accumulating narrow is an error, the
+  f32-dequant discipline is clean.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.analysis import lint
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.ops import pallas_paged_attention as pp
+from paddle_tpu.serving import (PagedServingEngine, SpecConfig,
+                                kv_parity_probe, paged_serve_builder)
+from paddle_tpu.telemetry import MetricsRegistry
+import paddle_tpu.nn as nn
+
+L, H, HD, NB, BS, MAXB = 2, 4, 16, 12, 8, 4
+
+#: Max |attention-output| divergence an int8 pool is allowed vs the f32
+#: twin on randn-scale K/V: per-block-per-head symmetric scales put
+#: ~amax/127 of rounding on each K and V element; the softmax keeps
+#: outputs O(1), so the bound is a small multiple of the elementwise
+#: rounding, not something that grows with sequence length.
+INT8_ATTN_TOL = 0.06
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _fill(dtype, k_all, v_all, lens, chunk=3):
+    """Build a pool holding ``lens[s]`` tokens of ``k_all``/``v_all``
+    ([L, S, T, H, HD] float32) per slot, appended ``chunk`` tokens at a
+    time through the real write path (reserve -> layer_views ->
+    paged_append -> merge -> advance) so quantized pools exercise the
+    monotone-scale/requantize machinery exactly as serving does."""
+    S = k_all.shape[1]
+    cache = paged.paged_init(L, S, MAXB, NB, BS, H, HD, dtype=dtype)
+    done = np.zeros(S, np.int64)
+    lens = np.asarray(lens, np.int64)
+    while (done < lens).any():
+        want = np.minimum(chunk, lens - done)
+        t = int(want.max())
+        cache, ok = paged.paged_reserve(cache,
+                                        jnp.asarray(want, jnp.int32))
+        assert bool(ok)
+        views = paged.layer_views(cache, jnp.arange(S),
+                                  jnp.asarray(want, jnp.int32))
+        upd = []
+        for li, view in enumerate(views):
+            kc = np.zeros((S, t, H, HD), np.float32)
+            vc = np.zeros((S, t, H, HD), np.float32)
+            for s in range(S):
+                w = int(want[s])
+                kc[s, :w] = k_all[li, s, done[s]:done[s] + w]
+                vc[s, :w] = v_all[li, s, done[s]:done[s] + w]
+            upd.append(paged.paged_append(view, jnp.asarray(kc),
+                                          jnp.asarray(vc)))
+        cache = paged.merge_views(cache, upd)
+        cache = paged.paged_advance(cache, jnp.asarray(want, jnp.int32))
+        done += want
+    return cache
+
+
+def _twin_pools(lens, seed=0, chunk=3):
+    T = int(max(lens)) if len(lens) else 1
+    T = max(T, 1)
+    rs = np.random.RandomState(seed)
+    k_all = rs.randn(L, len(lens), T, H, HD).astype(np.float32)
+    v_all = rs.randn(L, len(lens), T, H, HD).astype(np.float32)
+    ref = _fill(jnp.float32, k_all, v_all, lens, chunk)
+    q8 = _fill(jnp.int8, k_all, v_all, lens, chunk)
+    return ref, q8
+
+
+# ------------------------------------------------- dequant-read parity
+
+
+# length 0, mid-page, exactly on a block boundary, and a chunk pattern
+# that splits appends across block boundaries mid-chunk
+LENGTH_CASES = [
+    pytest.param([0, 5, 13], id="with-empty"),
+    pytest.param([BS, 2 * BS, BS], id="block-boundary"),
+    pytest.param([3 * BS, 1, BS - 1], id="deep-row"),
+]
+
+
+@pytest.mark.parametrize("lens", LENGTH_CASES)
+def test_xla_decode_divergence_bounded(lens):
+    ref, q8 = _twin_pools(lens)
+    assert q8.quantized and q8.k_pages[0].dtype == jnp.int8
+    assert not ref.quantized and ref.k_scales == ()
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(len(lens), 1, H, HD), jnp.float32)
+    for li in range(L):
+        out_ref = paged._paged_decode_attention_xla(
+            q, ref.k_pages[li], ref.v_pages[li], ref.block_tables,
+            ref.lengths)
+        out_q8 = paged._paged_decode_attention_xla(
+            q, q8.k_pages[li], q8.v_pages[li], q8.block_tables,
+            q8.lengths, k_scales=q8.k_scales[li],
+            v_scales=q8.v_scales[li])
+        div = float(jnp.max(jnp.abs(out_ref - out_q8)))
+        assert div <= INT8_ATTN_TOL, f"layer {li}: {div}"
+
+
+def test_kernel_interpret_matches_xla_on_int8_pool():
+    # kernel vs XLA over ONE int8 pool must be tight — both dequantize
+    # the same stored bytes, so quantization error cancels and only
+    # accumulation-order noise remains
+    lens = [BS, 2 * BS - 3, 5]
+    _, q8 = _twin_pools(lens, seed=1)
+    rs = np.random.RandomState(8)
+    q = jnp.asarray(rs.randn(len(lens), 1, H, HD), jnp.float32)
+    ref = paged._paged_decode_attention_xla(
+        q, q8.k_pages[0], q8.v_pages[0], q8.block_tables, q8.lengths,
+        k_scales=q8.k_scales[0], v_scales=q8.v_scales[0])
+    out = pp.paged_decode_attention_kernel(
+        q, q8.k_pages[0], q8.v_pages[0], q8.block_tables, q8.lengths,
+        k_scales=q8.k_scales[0], v_scales=q8.v_scales[0],
+        interpret=True)
+    assert out.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-5
+
+
+def test_ragged_kernel_interpret_matches_xla_on_int8_pool():
+    # the spec-verify / tail-prefill face: t=3 fresh queries behind
+    # committed prefixes, per-query causal bound, same int8 pool both
+    # sides (the unified-step read path under quantization)
+    lens = [BS + 3, 2 * BS, 6]
+    _, q8 = _twin_pools(lens, seed=2)
+    w = 3
+    before = q8.lengths - w                    # committed BEFORE the window
+    rs = np.random.RandomState(9)
+    q = jnp.asarray(rs.randn(len(lens), w, H, HD), jnp.float32)
+    with paged.decode_kernel_scope(False):
+        ref = paged.paged_chunked_attention(
+            q, q8.k_pages[1], q8.v_pages[1], q8.block_tables, before,
+            jnp.full((len(lens),), w, jnp.int32),
+            k_scales=q8.k_scales[1], v_scales=q8.v_scales[1])
+    out = pp.paged_ragged_attention_kernel(
+        q, q8.k_pages[1], q8.v_pages[1], q8.block_tables, before,
+        k_scales=q8.k_scales[1], v_scales=q8.v_scales[1],
+        interpret=True)
+    assert float(jnp.max(jnp.abs(out - ref))) <= 1e-5
+
+
+# ------------------------------------------------------ scale lifecycle
+
+
+def test_append_requantizes_committed_rows_when_scale_grows():
+    # small tokens commit first, then a 100x outlier lands in the SAME
+    # block: the block scale must grow and the committed rows must
+    # requantize in place, staying decodable at the coarser grid
+    S = 1
+    small = np.random.RandomState(3).randn(L, S, 4, H, HD).astype(
+        np.float32) * 0.1
+    cache = _fill(jnp.int8, small, small, [4], chunk=4)
+    s0 = np.asarray(cache.k_scales[0]).copy()
+    cache, ok = paged.paged_reserve(cache, jnp.asarray([1], jnp.int32))
+    assert bool(ok)
+    big = jnp.full((S, 1, H, HD), 10.0, jnp.float32)
+    views = paged.layer_views(cache, jnp.arange(S),
+                              jnp.asarray([1], jnp.int32))
+    upd = [paged.paged_append(v, big, big) for v in views]
+    cache = paged.merge_views(cache, upd)
+    cache = paged.paged_advance(cache, jnp.asarray([1], jnp.int32))
+    blk = int(np.asarray(cache.block_tables)[0, 0])
+    s1 = np.asarray(cache.k_scales[0])
+    assert (s1[blk] > s0[blk]).all(), "outlier must grow the scale"
+    # committed rows decode within the GROWN grid's resolution
+    deq = (np.asarray(cache.k_pages[0][blk, :4], np.float32)
+           * s1[blk][None, :, None])
+    err = np.abs(deq - np.asarray(small[0, 0]))
+    assert err.max() <= s1[blk].max() * 0.51 + 1e-6
+    # and the outlier row itself is near-exact at its own amplitude
+    out_row = (np.asarray(cache.k_pages[0][blk, 4], np.float32)
+               * s1[blk][:, None])
+    assert np.abs(out_row - 10.0).max() <= 10.0 / 127 + 1e-6
+
+
+def test_reserve_zeroes_recycled_block_scales():
+    lens = [BS]
+    _, q8 = _twin_pools(lens, seed=4)
+    blk = int(np.asarray(q8.block_tables)[0, 0])
+    assert np.asarray(q8.k_scales[0])[blk].max() > 0
+    q8 = paged.paged_free(q8, jnp.asarray([True], bool))
+    # scales persist after free (monotone while owned, reset at claim)
+    assert np.asarray(q8.k_scales[0])[blk].max() > 0
+    q8, ok = paged.paged_reserve(q8, jnp.asarray([3], jnp.int32))
+    assert bool(ok)
+    blk2 = int(np.asarray(q8.block_tables)[0, 0])
+    assert np.asarray(q8.k_scales[0])[blk2].max() == 0.0
+    assert np.asarray(q8.v_scales[0])[blk2].max() == 0.0
+
+
+def test_cow_copies_scales_and_isolates_the_shared_reader():
+    lens = [10, 0]
+    ref, q8 = _twin_pools(lens, seed=5)
+    rs = np.random.RandomState(10)
+    q = jnp.asarray(rs.randn(2, 1, H, HD), jnp.float32)
+    tok = jnp.asarray(rs.randn(2, 1, H, HD), jnp.float32)
+
+    def share_then_diverge(cache):
+        # map slot 0's blocks into slot 1 (the prefix-cache fast path),
+        # then append one divergent token on slot 1: paged_cow must
+        # privatize the cursor block first
+        row = cache.block_tables[0]
+        cache = paged.paged_share(cache, 1, row, cache.blocks_used[0],
+                                  cache.lengths[0])
+        want = jnp.asarray([0, 1], jnp.int32)
+        cache, ok = paged.paged_cow(cache, want)
+        assert bool(ok)
+        cache, ok = paged.paged_reserve(cache, want)
+        assert bool(ok)
+        views = paged.layer_views(cache, jnp.arange(2), want)
+        upd = [paged.paged_append(v, tok, tok) for v in views]
+        cache = paged.merge_views(cache, upd)
+        return paged.paged_advance(cache, want)
+
+    before = paged._paged_decode_attention_xla(
+        q, q8.k_pages[0], q8.v_pages[0], q8.block_tables, q8.lengths,
+        k_scales=q8.k_scales[0], v_scales=q8.v_scales[0])
+    q8b = share_then_diverge(q8)
+    refb = share_then_diverge(ref)
+    # the writer got a PRIVATE cursor block
+    t = np.asarray(q8b.block_tables)
+    assert t[1, 1] != t[0, 1] and t[1, 0] == t[0, 0]
+    # slot 0's read is BIT-identical — the shared reader never sees the
+    # divergent write or any scale churn
+    after = paged._paged_decode_attention_xla(
+        q, q8b.k_pages[0], q8b.v_pages[0], q8b.block_tables,
+        q8b.lengths, k_scales=q8b.k_scales[0],
+        v_scales=q8b.v_scales[0])
+    assert (np.asarray(before[0]) == np.asarray(after[0])).all()
+    # and slot 1's post-divergence read still tracks the f32 twin
+    # subjected to the identical share/COW/append sequence
+    out_ref = paged._paged_decode_attention_xla(
+        q, refb.k_pages[0], refb.v_pages[0], refb.block_tables,
+        refb.lengths)
+    div = float(jnp.max(jnp.abs(out_ref[1] - after[1])))
+    assert div <= INT8_ATTN_TOL
+
+
+# -------------------------------------------------- footprint + engine
+
+
+def test_pool_bytes_halves_bf16_and_counts_scales():
+    kw = dict(num_layers=L, num_heads=H, head_dim=HD, block_size=BS)
+    f32 = paged.paged_pool_bytes(NB, kv_dtype=jnp.float32, **kw)
+    bf16 = paged.paged_pool_bytes(NB, kv_dtype=jnp.bfloat16, **kw)
+    i8 = paged.paged_pool_bytes(NB, kv_dtype=jnp.int8, **kw)
+    scales = NB * 2 * L * H * 4
+    assert i8 == bf16 // 2 + scales == f32 // 4 + scales
+    assert i8 < bf16 < f32
+
+
+def test_engine_byte_budget_raises_capacity_under_int8():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    budget = 24 * paged.paged_pool_bytes(
+        1, num_layers=CFG.num_layers, num_heads=CFG.num_heads,
+        head_dim=CFG.dim // CFG.num_heads, block_size=8,
+        kv_dtype=jnp.bfloat16)
+    mk = lambda dt: PagedServingEngine(CFG, p, num_slots=2,
+                                       kv_pool_bytes=budget,
+                                       block_size=8,
+                                       prompt_buckets=(8,),
+                                       kv_dtype=dt)
+    bf = mk("bfloat16")
+    q8 = mk("int8")
+    assert bf.nb == 24
+    assert q8.nb > bf.nb, "int8 must buy more blocks at the same HBM"
+    assert q8.nb * q8.block_bytes <= budget
+    # the engine refuses ambiguous sizing
+    with pytest.raises(Exception):
+        PagedServingEngine(CFG, p, num_slots=2, num_blocks=8,
+                           kv_pool_bytes=budget, block_size=8,
+                           prompt_buckets=(8,))
+
+
+def test_engine_int8_compile_set_report_and_accept_rate(params):
+    def drive(dt, reg):
+        eng = PagedServingEngine(CFG, params, num_slots=2,
+                                 num_blocks=16, block_size=8,
+                                 prompt_buckets=(8, 16), metrics=reg,
+                                 kv_dtype=dt, seed=0,
+                                 spec=SpecConfig(k=2, draft_layers=1))
+        eng.submit(np.arange(1, 12, dtype=np.int32), max_new=6)
+        eng.submit(np.arange(2, 6, dtype=np.int32), max_new=6)
+        out = eng.run()
+        hist = reg.snapshot()["metrics"].get(
+            "serving_spec_accept_rate", {"series": []})["series"]
+        n = sum(s["count"] for s in hist)
+        return eng, out, (sum(s["sum"] for s in hist) / n) if n else 0.0
+
+    _, _, ref_rate = drive(None, MetricsRegistry())
+    reg = MetricsRegistry("int8")
+    eng, out, rate = drive("int8", reg)
+    assert len(out) == 2 and all(len(v) for v in out.values())
+    compiles = eng.compile_counts()
+    assert compiles.get("step") == 1
+    assert compiles.get("prefill", 0) <= 1
+    assert "decode" not in compiles and "verify" not in compiles
+    # quantized verify may flip near-tie accepts but must not collapse
+    assert rate >= ref_rate - 0.35
+    rep = eng.hbm_report()
+    assert rep["kv_dtype"] == "int8"
+    assert rep["kv_scale_bytes"] == \
+        2 * CFG.num_layers * CFG.num_heads * 4 * eng.nb
+    assert rep["pool_bytes_total"] == eng.nb * eng.block_bytes
+    assert rep["block_bytes"] == eng.block_bytes
+    # the pool-bytes gauge carries the dtype label and agrees
+    series = reg.snapshot()["metrics"]["serving_kv_pool_bytes"]["series"]
+    by = {s["labels"].get("dtype"): s["value"] for s in series}
+    assert by.get("int8") == float(rep["pool_bytes_total"])
+
+
+def test_kv_parity_probe_divergence_small(params):
+    prompts = np.arange(1, 9, dtype=np.int32).reshape(2, 4)
+    div = kv_parity_probe(CFG, params, prompts, steps=4,
+                          kv_dtype="int8", block_size=8)
+    assert 0.0 <= div <= 0.25, div
+    # a bf16 pool diverges by at most bf16 rounding of O(1) logits
+    div_bf = kv_parity_probe(CFG, params, prompts, steps=4,
+                             kv_dtype="bfloat16", block_size=8)
+    assert div_bf <= 0.1, div_bf
+
+
+def test_builder_kv_dtype_threads_through(params):
+    serve = paged_serve_builder(CFG, block_size=8, num_blocks=16,
+                                kv_dtype="int8")
+    assert serve.kv_dtype == jnp.int8
+    out = serve(params, np.arange(1, 9, dtype=np.int32).reshape(2, 4),
+                steps=3)
+    assert out.shape[0] == 2 and out.shape[1] >= 7
+
+
+# ------------------------------------------------------------ tpu-lint
+
+
+def _accum(findings):
+    return [f for f in findings if f.rule_id == "accum-dtype"]
+
+
+def test_lint_flags_dequant_matmul_into_narrow_accum():
+    a8 = jnp.zeros((8, 8), jnp.int8)
+    w = jnp.zeros((8, 8), jnp.bfloat16)
+
+    def bad(q8, w):
+        return jax.lax.dot_general(q8, w, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.bfloat16)
+
+    fs = _accum(lint(bad, (a8, w)))
+    assert fs and "dequant-matmul" in fs[0].message
+
+    def bad_chain(q8, scale, w32):
+        deq = q8.astype(jnp.bfloat16) * scale
+        return jax.lax.dot_general(deq, w32, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.bfloat16)
+
+    fs = _accum(lint(bad_chain, (a8, jnp.ones((8, 8), jnp.bfloat16),
+                                 jnp.zeros((8, 8), jnp.float32))))
+    assert fs and "int8" in fs[0].message
+
+
+def test_lint_dequant_into_f32_is_clean():
+    a8 = jnp.zeros((8, 8), jnp.int8)
+
+    def good(q8, scale, w):
+        deq = q8.astype(jnp.float32) * scale
+        return jnp.dot(deq, w, preferred_element_type=jnp.float32)
+
+    assert not _accum(lint(good, (a8, jnp.ones((8, 8), jnp.float32),
+                                  jnp.zeros((8, 8), jnp.float32))))
+    # and the quantized read path itself lints clean end to end
+    lens = [5, 9]
+    _, q8 = _twin_pools(lens, seed=6)
+    q = jnp.zeros((2, 1, H, HD), jnp.float32)
+    fs = _accum(lint(
+        lambda *a: paged._paged_decode_attention_xla(
+            a[0], a[1], a[2], a[3], a[4], k_scales=a[5], v_scales=a[6]),
+        (q, q8.k_pages[0], q8.v_pages[0], q8.block_tables, q8.lengths,
+         q8.k_scales[0], q8.v_scales[0])))
+    assert not fs
