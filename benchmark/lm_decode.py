@@ -464,6 +464,117 @@ def _bench_frontend(args, cfg, params, jax):
         tokens_per_s=round(gen / wall, 1))
 
 
+def _bench_disagg(args, cfg, params, jax):
+    """``--disagg --prefill-workers N --decode-workers M``:
+    disaggregated prefill/decode serving benchmark.
+
+    Serves one greedy burst twice — through a single in-process
+    :class:`PagedServingEngine` (the baseline) and through a
+    :class:`ClusterController` whose prefill and decode phases run in
+    separate OS worker processes with the KV blocks handed across the
+    wire — asserts the streams bit-identical, and reports the two
+    numbers disaggregation adds to the story: ``handoff_ms_p50/p95``
+    (prefill dispatch -> validated KV payload at the controller) and
+    TTFT p50/p95 next to the in-process baseline's.  Worker processes
+    pay a spawn + jax-import + warmup cost (seconds each), so the row
+    carries ``spawn_s`` separately — steady-state throughput is the
+    burst wall time, not the cold start."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.cluster import ClusterController
+    from paddle_tpu.serving import PagedServingEngine
+
+    plen, steps, bs = args.prompt, args.steps, args.block_size
+    slots = min(args.batch, 8)
+    per_req = -(-(plen + steps) // bs)
+    pool = args.pool_blocks or slots * per_req + 4
+    kv_dtype = {"policy": None, "bf16": "bfloat16",
+                "int8": "int8"}[args.kv_dtype]
+    kw = dict(num_slots=slots, num_blocks=pool, block_size=bs,
+              prompt_buckets=(plen,),
+              decode_kernel={"auto": None, "on": True,
+                             "off": False}[args.paged_kernel],
+              kv_dtype=kv_dtype, seed=0)
+    rs = np.random.RandomState(1)
+    reqs = args.frontend_requests or 2 * slots * args.decode_workers
+    prompts = [rs.randint(0, args.vocab, plen).astype(np.int32)
+               for _ in range(reqs)]
+
+    # ---- baseline: one in-process engine, same config/params/seed
+    breg = telemetry.MetricsRegistry(name="disagg-base")
+    eng = PagedServingEngine(cfg, params, metrics=breg, **kw)
+    eng.submit(prompts[0][:8], max_new=2, temperature=0.0)
+    eng.run()                              # warm: compile prefill+step
+    t0 = time.perf_counter()
+    brids = [eng.submit(p, max_new=steps, temperature=0.0)
+             for p in prompts]
+    bout = eng.run()
+    base_wall = time.perf_counter() - t0
+    base = [np.asarray(bout[r]) for r in brids]
+    base_ttft = breg.get("serving_ttft_seconds").summary()
+
+    # ---- disaggregated: prefill and decode in separate processes
+    reg = telemetry.MetricsRegistry(name="disagg")
+    t0 = time.perf_counter()
+    with ClusterController(cfg, params,
+                           prefill_workers=args.prefill_workers,
+                           decode_workers=args.decode_workers,
+                           metrics=reg, hb_timeout_s=10.0,
+                           **kw) as ctl:
+        # warmup=True: each worker compiled prefill+step before hello,
+        # so once the fleet reports ready the burst is compile-free on
+        # every process and TTFT measures serving, not cold start
+        ctl.wait_ready()
+        spawn_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rids = [ctl.submit(p, max_new=steps) for p in prompts]
+        out = ctl.run(timeout_s=600.0)
+        wall = time.perf_counter() - t0
+        for b, r in zip(base, rids):
+            np.testing.assert_array_equal(b, out[r])
+        stats = ctl.stats()
+        compiles = {label: s["compiles"] for label, s
+                    in ctl.snapshot_workers().items()}
+    snap = reg.snapshot()
+    handoff_bytes = sum(
+        s["value"] for s in
+        snap["metrics"]["cluster_handoff_bytes_total"]["series"])
+    handoff = stats["handoff_seconds"]
+    ttft = stats["ttft_s"]
+    gen = sum(len(out[r]) for r in rids)
+
+    def _ms(v):
+        return round(v * 1e3, 3) if v is not None else None
+
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} prompt{plen} "
+               f"disagg {args.prefill_workers}p+{args.decode_workers}d",
+        value=round(gen / wall, 1),
+        unit="tokens/s",
+        backend=jax.default_backend(),
+        decoder="disagg",
+        compiles=compiles,       # {'step': 1, 'prefill': 1} per worker
+        prefill_workers=args.prefill_workers,
+        decode_workers=args.decode_workers,
+        num_slots=slots,
+        block_size=bs,
+        pool_blocks=pool,
+        kv_dtype=args.kv_dtype,
+        requests=reqs,
+        completed=stats["requests"]["completed"],
+        worker_restarts=stats["worker_restarts"],
+        bit_identical=True,      # asserted against the baseline above
+        spawn_s=round(spawn_s, 2),
+        handoff_ms_p50=_ms(handoff["p50"]),
+        handoff_ms_p95=_ms(handoff["p95"]),
+        handoff_kib_per_request=round(handoff_bytes / 1024 / reqs, 1),
+        ttft_ms_p50=_ms(ttft["p50"]),
+        ttft_ms_p95=_ms(ttft["p95"]),
+        baseline_ttft_ms_p50=_ms(base_ttft["p50"]),
+        baseline_ttft_ms_p95=_ms(base_ttft["p95"]),
+        baseline_tokens_per_s=round(gen / base_wall, 1),
+        tokens_per_s=round(gen / wall, 1))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=1024)
@@ -561,7 +672,23 @@ def main():
     ap.add_argument("--frontend-requests", type=int, default=0,
                     metavar="N",
                     help="burst size for --frontend (0 = 4 * slots * "
-                         "engines)")
+                         "engines) or --disagg (0 = 2 * slots * "
+                         "decode workers)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="serve the burst through the DISAGGREGATED "
+                         "cluster (cluster/): prefill and decode in "
+                         "separate OS worker processes with the KV "
+                         "blocks handed across the wire — the row "
+                         "reports handoff_ms_p50/p95 and TTFT next to "
+                         "an in-process engine baseline (greedy "
+                         "streams asserted bit-identical); composes "
+                         "with --kv-dtype; requires --paged")
+    ap.add_argument("--prefill-workers", type=int, default=1,
+                    metavar="N",
+                    help="prefill worker processes (with --disagg)")
+    ap.add_argument("--decode-workers", type=int, default=1,
+                    metavar="M",
+                    help="decode worker processes (with --disagg)")
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="with --frontend: completion deadline attached "
                          "to every request in ms (0 = none) — exercises "
@@ -617,6 +744,15 @@ def main():
                  "lives in the paged KV cache)")
     if args.kv_dtype != "policy" and args.frontend:
         ap.error("--kv-dtype does not compose with --frontend yet")
+    if args.disagg and not args.paged:
+        ap.error("--disagg requires --paged (the cluster workers run "
+                 "paged serving engines)")
+    if args.disagg and (args.frontend or args.shared_prefix
+                        or args.spec or args.mixed_batch):
+        ap.error("--disagg is its own row; drop --frontend/"
+                 "--shared-prefix/--spec/--mixed-batch")
+    if args.prefill_workers < 1 or args.decode_workers < 1:
+        ap.error("--prefill-workers/--decode-workers must be >= 1")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.attach import attach_probe_with_retry
@@ -674,6 +810,15 @@ def main():
         if args.bf16_params:
             from paddle_tpu.inference import serving_cast
             params = serving_cast(params)
+        if args.disagg:
+            row = _bench_disagg(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
         if args.frontend:
             row = _bench_frontend(args, cfg, params, jax)
             from paddle_tpu import telemetry

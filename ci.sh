@@ -77,6 +77,16 @@ echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead
 # report zero findings.
 JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
 
+echo "== cluster gate: disaggregated prefill/decode over real processes =="
+# Spawns 1 prefill + 1 decode worker as real OS processes on the CPU
+# backend, serves a greedy burst through the KV handoff path, SIGKILLs
+# the decode worker mid-stream, and pins: streams bit-identical to a
+# single in-process engine (clean AND after journal-replay), per-worker
+# compiles == {'step': 1, 'prefill': 1}, exactly-once terminal status,
+# generation-tagged restart, merged per-worker telemetry snapshots, and
+# populated cluster_* metric families.
+JAX_PLATFORMS=cpu python -m paddle_tpu.cluster.selfcheck
+
 echo "== native libs =="
 make -C csrc -q 2>/dev/null || make -C csrc
 

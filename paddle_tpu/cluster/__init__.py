@@ -1,0 +1,29 @@
+"""Disaggregated prefill/decode serving cluster.
+
+The serving stack's first genuinely multi-process surface: worker
+processes each own a :class:`~paddle_tpu.serving.PagedServingEngine`
+(one device set per worker on hardware, a virtual CPU platform in
+tests), a controller speaks a length-prefixed JSON control channel
+(submit / token-stream / heartbeat / snapshot / drain), and the roles
+specialize — PREFILL workers compute KV blocks for admitted prompts
+and hand them to DECODE workers as ``(block_ids, pool_pages, scales,
+prefix keys)`` payloads that the decode side maps in with
+``paged_share``-style refcount pinning (``ops/paged_attention.py``:
+``paged_export_blocks`` / ``paged_import_blocks``), so int8 pools
+(PR 12) transfer with their per-block scales intact.
+
+Supervision carries the in-process frontend's full story across the
+process boundary: heartbeat-timeout detection, SIGKILL takedown,
+generation-tagged restart with backoff, and journal-replay requeue
+with retried greedy streams bit-identical.  On top, a queue-driven
+autoscaler (:mod:`paddle_tpu.cluster.autoscaler`) grows and retires
+workers from the live queue-wait/TTFT histograms.
+
+Design doc: ``docs/design/serving.md`` (disaggregation section);
+metric catalog: ``docs/design/telemetry.md`` (``cluster_*`` family).
+"""
+
+from paddle_tpu.cluster.autoscaler import AutoscalePolicy
+from paddle_tpu.cluster.controller import ClusterController
+
+__all__ = ["AutoscalePolicy", "ClusterController"]

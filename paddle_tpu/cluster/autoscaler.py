@@ -1,0 +1,97 @@
+"""Queue-driven autoscaling policy for the serving cluster.
+
+A PURE decision function over host-side observations — no sockets, no
+processes, no clocks of its own — so the policy unit-tests without a
+cluster and the controller stays the single place that touches OS
+state.  The controller feeds it the live queue-wait/TTFT digests (the
+same histograms the in-process frontend predicts admission from) plus
+per-worker idleness, and applies whatever it decides.
+
+Policy shape (deliberately boring):
+
+* GROW a role when demand outruns it — queued work is waiting longer
+  than ``grow_queue_wait_s`` (p50) or decode TTFT blows past
+  ``grow_ttft_s`` (p95) — and the role is below its max.
+* RETIRE the longest-idle worker of a role when the role has been
+  idle past ``retire_idle_s`` with nothing queued and sits above its
+  min.
+* A shared ``cooldown_s`` between actions per role damps flapping;
+  scale-up wins ties with scale-down.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["AutoscalePolicy"]
+
+ROLES = ("prefill", "decode")
+
+
+class AutoscalePolicy:
+    """See module docstring.  ``decide`` consumes an observation dict
+
+    ``{"queue_depth": int, "queue_wait_p50_s": float | None,
+    "ttft_p95_s": float | None, "workers": {role: [{"label": str,
+    "up": bool, "active": int, "idle_s": float}]}}``
+
+    and returns ``[("grow" | "retire", role, label | None), ...]``
+    (label names the retiree; ``None`` for grow — the controller
+    picks the next index)."""
+
+    def __init__(self, *, min_workers: Optional[Dict[str, int]] = None,
+                 max_workers: Optional[Dict[str, int]] = None,
+                 grow_queue_wait_s: float = 0.5,
+                 grow_ttft_s: Optional[float] = None,
+                 retire_idle_s: float = 10.0,
+                 cooldown_s: float = 2.0):
+        self.min_workers = {"prefill": 1, "decode": 1,
+                            **(min_workers or {})}
+        self.max_workers = {"prefill": 2, "decode": 4,
+                            **(max_workers or {})}
+        for role in ROLES:
+            if self.min_workers[role] > self.max_workers[role]:
+                raise ValueError(
+                    f"autoscaler: min_workers[{role}]="
+                    f"{self.min_workers[role]} > max_workers[{role}]="
+                    f"{self.max_workers[role]}")
+        self.grow_queue_wait_s = float(grow_queue_wait_s)
+        self.grow_ttft_s = (None if grow_ttft_s is None
+                            else float(grow_ttft_s))
+        self.retire_idle_s = float(retire_idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self._last_action_at = {role: None for role in ROLES}
+
+    def _cooling(self, role: str, now: float) -> bool:
+        last = self._last_action_at[role]
+        return last is not None and (now - last) < self.cooldown_s
+
+    def decide(self, now: float, obs: dict) -> List[Tuple]:
+        """One scaling pass; at most one action per role per call."""
+        actions = []
+        queue_depth = int(obs.get("queue_depth", 0))
+        wait_p50 = obs.get("queue_wait_p50_s")
+        ttft_p95 = obs.get("ttft_p95_s")
+        pressured = queue_depth > 0 and (
+            (wait_p50 is not None
+             and wait_p50 > self.grow_queue_wait_s)
+            or (self.grow_ttft_s is not None and ttft_p95 is not None
+                and ttft_p95 > self.grow_ttft_s))
+        for role in ROLES:
+            workers = [w for w in obs.get("workers", {}).get(role, ())]
+            up = [w for w in workers if w.get("up")]
+            if self._cooling(role, now):
+                continue
+            if pressured and len(workers) < self.max_workers[role]:
+                actions.append(("grow", role, None))
+                self._last_action_at[role] = now
+                continue
+            if queue_depth == 0 and len(up) > self.min_workers[role]:
+                idle = [w for w in up
+                        if w.get("active", 0) == 0
+                        and w.get("idle_s", 0.0) >= self.retire_idle_s]
+                if idle:
+                    victim = max(idle, key=lambda w: w["idle_s"])
+                    actions.append(("retire", role, victim["label"]))
+                    self._last_action_at[role] = now
+        return actions

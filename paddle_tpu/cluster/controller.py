@@ -1,0 +1,796 @@
+"""Cluster controller: spawn, route, supervise, scale.
+
+The process-boundary twin of ``frontend.ServingFrontend``'s seat
+supervision: every worker is an OS process (``cluster/worker.py``)
+speaking the length-prefixed JSON channel (``cluster/wire.py``), and
+the controller carries the in-process story across the boundary —
+
+* **routing**: queued prompts go to a prefill worker, whose KV
+  payload comes back and is forwarded to the least-loaded decode
+  worker (``handoff_submit``); with no prefill workers configured,
+  decode workers prefill locally (``submit``);
+* **supervision**: a worker that misses heartbeats past
+  ``hb_timeout_s`` is SIGKILLed (idempotent if it already died — the
+  usual cause), its generation bumps, its in-flight requests
+  journal-replay through the full pipeline (re-prefill + re-decode on
+  the restarted twin — bit-identical greedy streams, because engines
+  are pure functions of (config, params, seed)), and it restarts
+  after exponential backoff.  Events tagged with a stale generation
+  drop, so a zombie's late messages cannot corrupt the journal;
+* **exactly-once**: request finalization asserts — a replayed request
+  completes exactly once or fails loudly, never silently twice;
+* **autoscaling**: an attached :class:`~paddle_tpu.cluster.autoscaler.
+  AutoscalePolicy` reads the live queue-wait/TTFT digests and grows /
+  retires workers; the controller applies its decisions and counts
+  them in ``cluster_scale_events_total``.
+
+Fault points (``testing/faults.py``, process scope): ``proc_kill``
+(SIGKILL the named worker; fired once per heartbeat received from it,
+so ``at=`` counts its heartbeats) and ``heartbeat`` (drop with
+``raise``, delay with ``delay`` — fired controller-side on receipt,
+so the worker process stays untouched and detection genuinely runs
+through the timeout machinery).
+
+Threading contract: reader/accept threads only enqueue events; ALL
+journal and worker state mutates on the caller's thread inside
+:meth:`pump` — call ``submit``/``pump``/``run`` from one thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import queue
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu import telemetry
+from paddle_tpu.cluster import handoff, wire
+
+__all__ = ["ClusterController", "TERMINAL"]
+
+QUEUED = "queued"
+PREFILLING = "prefilling"
+PREFILLED = "prefilled"
+DECODING = "decoding"
+COMPLETED = "completed"
+FAILED = "failed"
+TERMINAL = frozenset({COMPLETED, FAILED})
+
+_ROLES = ("prefill", "decode")
+
+
+class _ClusterRequest:
+    __slots__ = ("rid", "prompt", "max_new", "temperature", "status",
+                 "reason", "tokens", "attempts", "payload", "worker",
+                 "submitted_at", "prefill_sent_at", "first_token_at",
+                 "done_at")
+
+    def __init__(self, rid, prompt, max_new, temperature):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.status = QUEUED
+        self.reason = None
+        self.tokens = []
+        self.attempts = 0
+        self.payload = None
+        self.worker = None
+        self.submitted_at = time.monotonic()
+        self.prefill_sent_at = None
+        self.first_token_at = None
+        self.done_at = None
+
+
+class _Worker:
+    __slots__ = ("label", "role", "index", "generation", "proc",
+                 "sock", "up", "retired", "last_beat", "restarts",
+                 "restart_at", "assigned", "idle_since", "compiles",
+                 "snapshot", "spawned_at")
+
+    def __init__(self, label, role, index):
+        self.label = label
+        self.role = role
+        self.index = index
+        self.generation = 0
+        self.proc = None
+        self.sock = None
+        self.up = False
+        self.retired = False
+        self.last_beat = None
+        self.restarts = 0
+        self.restart_at = None
+        self.assigned = set()
+        self.idle_since = None
+        self.compiles = None
+        self.snapshot = None
+        self.spawned_at = None
+
+    def state(self) -> str:
+        if self.retired:
+            return "retired"
+        if self.up:
+            return "up"
+        if self.restart_at is not None:
+            return "down"
+        return "starting"
+
+
+class ClusterController:
+    """See module docstring.  Construction spawns the initial workers
+    and returns immediately; they come up asynchronously (jax import +
+    warmup compile), and :meth:`run` / :meth:`pump` route work as
+    they do.  Use as a context manager or call :meth:`close`."""
+
+    def __init__(self, cfg, params, *, prefill_workers: int = 1,
+                 decode_workers: int = 1, num_slots: int,
+                 num_blocks: Optional[int] = None,
+                 block_size: int = 16,
+                 max_blocks_per_slot: Optional[int] = None,
+                 prompt_buckets=(64,), eos_id: Optional[int] = None,
+                 decode_kernel=None, prefix_cache: bool = False,
+                 kv_dtype=None, kv_pool_bytes: Optional[int] = None,
+                 engine_max_queue: Optional[int] = None, seed: int = 0,
+                 hb_interval_s: float = 0.05,
+                 hb_timeout_s: float = 1.0,
+                 restart_backoff_s: float = 0.05,
+                 restart_backoff_cap_s: float = 2.0,
+                 max_retries: int = 3, autoscaler=None, metrics=None,
+                 faults=None, platform: str = "cpu",
+                 devices_per_worker: int = 1, warmup: bool = True,
+                 workdir: Optional[str] = None):
+        if decode_workers < 1:
+            raise ValueError("cluster needs at least one decode worker")
+        if prefill_workers < 0:
+            raise ValueError("prefill_workers must be >= 0")
+        self.cfg = cfg
+        self.hb_interval_s = float(hb_interval_s)
+        self.hb_timeout_s = float(hb_timeout_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.restart_backoff_cap_s = float(restart_backoff_cap_s)
+        self.max_retries = int(max_retries)
+        self.autoscaler = autoscaler
+        self._faults = faults
+        self._closing = False
+        self._journal = {}
+        self._order = deque()            # dispatch order (rids)
+        self._next_rid = 0
+        self._events = queue.Queue()
+        self._own_workdir = workdir is None
+        self.workdir = workdir or tempfile.mkdtemp(
+            prefix="ptpu-cluster-")
+        self._params_path = os.path.join(self.workdir, "params.pkl")
+        with open(self._params_path, "wb") as f:
+            import jax
+            pickle.dump(jax.tree.map(np.asarray, params), f)
+        engine_kw = dict(
+            num_slots=num_slots, num_blocks=num_blocks,
+            block_size=block_size,
+            max_blocks_per_slot=max_blocks_per_slot,
+            prompt_buckets=list(prompt_buckets), eos_id=eos_id,
+            decode_kernel=decode_kernel, prefix_cache=prefix_cache,
+            kv_dtype=kv_dtype, kv_pool_bytes=kv_pool_bytes,
+            max_queue=engine_max_queue)
+        # the numerics policy is ambient process state
+        # (core/dtypes.py) — a caller constructing the cluster under
+        # mixed_precision() expects worker engines numerically
+        # identical to an in-process one, so it ships with the config
+        from paddle_tpu.core.dtypes import get_policy
+        pol = get_policy()
+        self._config_path = os.path.join(self.workdir, "config.json")
+        with open(self._config_path, "w") as f:
+            json.dump({"platform": platform,
+                       "devices": devices_per_worker,
+                       "cfg": dataclasses.asdict(cfg),
+                       "engine": engine_kw, "seed": seed,
+                       "warmup": warmup,
+                       "policy": {
+                           "param": np.dtype(pol.param_dtype).name,
+                           "compute": np.dtype(pol.compute_dtype).name,
+                           "output": np.dtype(pol.output_dtype).name,
+                       }}, f)
+
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.get_registry())
+        m = self.metrics
+        self._m_workers = m.gauge(
+            "cluster_workers",
+            help="worker processes by role= and state="
+                 "up|starting|down|retired, sampled per pump")
+        self._m_restarts = m.counter(
+            "cluster_worker_restarts_total",
+            help="worker takedowns by cause= and worker= — each bumps "
+                 "the generation tag and journal-replays its in-flight "
+                 "requests")
+        self._m_heartbeats = m.counter(
+            "cluster_heartbeats_total",
+            help="heartbeats accepted from workers, by worker= "
+                 "(dropped/delayed injected heartbeats never count)")
+        self._m_handoff_bytes = m.counter(
+            "cluster_handoff_bytes_total",
+            help="raw KV tensor bytes handed from prefill to decode "
+                 "workers (pages + scales + prompt; wire framing "
+                 "excluded — see cluster/handoff.py)")
+        self._m_handoff_lat = m.histogram(
+            "cluster_handoff_seconds",
+            help="prefill dispatch -> payload arrival at the "
+                 "controller (prefill compute + wire)")
+        self._m_queue_wait = m.histogram(
+            "cluster_queue_wait_seconds",
+            help="submit -> decode dispatch (includes the prefill "
+                 "hop) — the autoscaler's grow signal")
+        self._m_ttft = m.histogram(
+            "cluster_ttft_seconds",
+            help="submit -> first streamed token at the controller")
+        self._m_requests = m.counter(
+            "cluster_requests_total",
+            help="requests finalized, by status=completed|failed")
+        self._m_scale = m.counter(
+            "cluster_scale_events_total",
+            help="autoscaler actions applied, by action=grow|retire "
+                 "and role=")
+
+        self._workers = {}
+        self._next_index = {role: 0 for role in _ROLES}
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self._port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        for _ in range(prefill_workers):
+            self._grow("prefill", scaled=False)
+        for _ in range(decode_workers):
+            self._grow("decode", scaled=False)
+
+    # ------------------------------------------------------------ spawn
+
+    def _grow(self, role: str, scaled: bool = True) -> "_Worker":
+        index = self._next_index[role]
+        self._next_index[role] = index + 1
+        w = _Worker(f"{role}{index}", role, index)
+        self._workers[w.label] = w
+        self._spawn(w)
+        if scaled:
+            self._m_scale.inc(action="grow", role=role)
+        return w
+
+    def _spawn(self, w: "_Worker"):
+        cmd = [sys.executable, "-m", "paddle_tpu.cluster.worker",
+               "--controller", f"127.0.0.1:{self._port}",
+               "--worker-id", w.label, "--role", w.role,
+               "--generation", str(w.generation),
+               "--params", self._params_path,
+               "--config", self._config_path,
+               "--hb-interval", str(self.hb_interval_s)]
+        env = dict(os.environ)
+        # the parent may force a virtual-device count (the test
+        # harness's 8-device CPU platform); workers provision their
+        # own from the shipped config, so drop the inherited flag
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(flags)
+        log_path = os.path.join(
+            self.workdir, f"{w.label}.g{w.generation}.log")
+        with open(log_path, "wb") as log:
+            w.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=env)
+        w.spawned_at = time.monotonic()
+        w.up = False
+        w.sock = None
+        w.restart_at = None
+
+    def _sigkill(self, w: "_Worker"):
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                os.kill(w.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def kill_worker(self, label: str):
+        """SIGKILL a named worker's process — the chaos-test hammer
+        (the supervisor then detects it by heartbeat timeout exactly
+        as it would a real crash)."""
+        self._sigkill(self._workers[label])
+
+    # ----------------------------------------------------------- threads
+
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                hello = wire.recv_msg(conn)
+            except (ConnectionError, ValueError, OSError):
+                conn.close()
+                continue
+            if not hello or hello.get("type") != "hello":
+                conn.close()
+                continue
+            self._events.put((hello["worker"],
+                              int(hello["generation"]), hello, conn))
+
+    def _reader(self, conn, label, gen):
+        while True:
+            try:
+                msg = wire.recv_msg(conn)
+            except (ConnectionError, ValueError, OSError):
+                break
+            if msg is None:
+                break
+            self._events.put((label, gen, msg, None))
+        self._events.put((label, gen, {"type": "_eof"}, None))
+
+    def _send(self, w: "_Worker", msg: dict) -> bool:
+        if w.sock is None:
+            return False
+        try:
+            wire.send_msg(w.sock, msg)
+            return True
+        except OSError:
+            return False
+
+    # ------------------------------------------------------------- pump
+
+    def pump(self):
+        """One supervision pass: drain events, watchdog, restarts,
+        autoscale, dispatch, gauges."""
+        self._drain_events()
+        now = time.monotonic()
+        self._watchdog(now)
+        self._restart_due(now)
+        self._autoscale(now)
+        self._dispatch(now)
+        self._sample_gauges()
+
+    def _drain_events(self):
+        while True:
+            try:
+                label, gen, msg, conn = self._events.get_nowait()
+            except queue.Empty:
+                return
+            w = self._workers.get(label)
+            if w is None or gen != w.generation:
+                if conn is not None:
+                    conn.close()          # zombie generation
+                continue
+            kind = msg.get("type")
+            if kind == "hello":
+                w.sock = conn
+                w.up = True
+                w.last_beat = time.monotonic()
+                w.idle_since = w.last_beat
+                w.compiles = msg.get("compiles")
+                threading.Thread(target=self._reader,
+                                 args=(conn, label, gen),
+                                 daemon=True).start()
+            elif kind == "heartbeat":
+                self._on_heartbeat(w)
+            elif kind == "tokens":
+                self._on_tokens(w, msg)
+            elif kind == "handoff":
+                self._on_handoff(w, msg)
+            elif kind == "snapshot":
+                w.snapshot = msg
+            elif kind == "error":
+                rid = msg.get("rid")
+                if rid is not None and rid in self._journal:
+                    w.assigned.discard(rid)
+                    self._requeue(rid, f"worker_error: "
+                                       f"{msg.get('detail')}")
+
+    def _on_heartbeat(self, w: "_Worker"):
+        if self._faults is not None:
+            from paddle_tpu.testing.faults import FaultError
+            try:
+                # indexed per heartbeat received from this worker:
+                # Fault("proc_kill", at=3, scope=label) SIGKILLs the
+                # real process after its 3rd heartbeat — detection
+                # then runs through the genuine timeout machinery
+                self._faults.fire("proc_kill", scope=w.label)
+            except FaultError:
+                self._sigkill(w)
+            try:
+                # raise = drop this heartbeat, delay = deliver late
+                self._faults.fire("heartbeat", scope=w.label)
+            except FaultError:
+                return
+        w.last_beat = time.monotonic()
+        self._m_heartbeats.inc(worker=w.label)
+
+    def _on_tokens(self, w: "_Worker", msg: dict):
+        rid = int(msg["rid"])
+        req = self._journal.get(rid)
+        if req is None or req.status in TERMINAL:
+            return
+        toks = np.asarray(msg["tokens"], np.int32).reshape(-1)
+        if toks.size and req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+            self._m_ttft.observe(
+                req.first_token_at - req.submitted_at)
+        req.tokens.extend(int(t) for t in toks)
+        if msg.get("done"):
+            w.assigned.discard(rid)
+            self._touch_idle(w)
+            self._finalize(rid, COMPLETED)
+
+    def _on_handoff(self, w: "_Worker", msg: dict):
+        rid = int(msg["rid"])
+        req = self._journal.get(rid)
+        if req is None or req.status != PREFILLING:
+            return                        # stale replay of a requeue
+        payload = handoff.validate_payload(msg["payload"])
+        self._m_handoff_bytes.inc(handoff.payload_nbytes(payload))
+        if req.prefill_sent_at is not None:
+            self._m_handoff_lat.observe(
+                time.monotonic() - req.prefill_sent_at)
+        req.payload = payload
+        req.status = PREFILLED
+        req.worker = None
+        w.assigned.discard(rid)
+        self._touch_idle(w)
+
+    def _touch_idle(self, w: "_Worker"):
+        if not w.assigned:
+            w.idle_since = time.monotonic()
+
+    # -------------------------------------------------- supervision
+
+    def _watchdog(self, now: float):
+        for w in self._workers.values():
+            if w.up and not w.retired \
+                    and now - w.last_beat > self.hb_timeout_s:
+                self._worker_down(w, "heartbeat_timeout", now)
+
+    def _worker_down(self, w: "_Worker", cause: str, now: float):
+        # SIGKILL takedown (idempotent when the process already died —
+        # the usual reason its heartbeats stopped), generation bump so
+        # the zombie's late events drop, journal-replay requeue
+        self._sigkill(w)
+        if w.sock is not None:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+            w.sock = None
+        w.up = False
+        w.generation += 1
+        w.restarts += 1
+        self._m_restarts.inc(cause=cause, worker=w.label)
+        for rid in sorted(w.assigned):
+            self._requeue(rid, cause)
+        w.assigned.clear()
+        w.restart_at = now + min(
+            self.restart_backoff_s * 2 ** max(0, w.restarts - 1),
+            self.restart_backoff_cap_s)
+
+    def _requeue(self, rid: int, cause: str):
+        req = self._journal[rid]
+        if req.status in TERMINAL:
+            return
+        req.attempts += 1
+        if req.attempts > self.max_retries:
+            self._finalize(rid, FAILED, reason="retries_exhausted")
+            return
+        # journal replay: the prompt re-runs the FULL pipeline
+        # (re-prefill, re-handoff, re-decode) on the restarted twin;
+        # partial tokens are discarded — the replayed greedy stream is
+        # bit-identical, so the caller never sees the difference
+        req.tokens = []
+        req.payload = None
+        req.first_token_at = None
+        req.prefill_sent_at = None
+        req.worker = None
+        req.status = QUEUED
+
+    def _restart_due(self, now: float):
+        for w in self._workers.values():
+            if (not w.up and not w.retired
+                    and w.restart_at is not None
+                    and now >= w.restart_at):
+                self._spawn(w)
+
+    # -------------------------------------------------- autoscaling
+
+    def _autoscale(self, now: float):
+        if self.autoscaler is None:
+            return
+        by_role = {role: [] for role in _ROLES}
+        for w in self._workers.values():
+            if w.retired:
+                continue
+            by_role[w.role].append({
+                "label": w.label, "up": w.up,
+                "active": len(w.assigned),
+                "idle_s": (now - w.idle_since
+                           if w.up and w.idle_since is not None
+                           else 0.0)})
+        obs = {
+            # demand = every non-terminal request: retiring a worker
+            # while requests are mid-pipeline (PREFILLING/DECODING)
+            # would flap capacity exactly when it is being used
+            "queue_depth": sum(
+                1 for r in self._journal.values()
+                if r.status not in TERMINAL),
+            "queue_wait_p50_s": self._m_queue_wait.summary()["p50"],
+            "ttft_p95_s": self._m_ttft.summary()["p95"],
+            "workers": by_role,
+        }
+        for action, role, label in self.autoscaler.decide(now, obs):
+            if action == "grow":
+                self._grow(role)
+            elif action == "retire":
+                self._retire(label)
+
+    def _retire(self, label: str):
+        w = self._workers.get(label)
+        if w is None or w.retired or w.assigned:
+            return
+        self._send(w, {"type": "shutdown"})
+        w.retired = True
+        w.up = False
+        if w.sock is not None:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+            w.sock = None
+        self._m_scale.inc(action="retire", role=w.role)
+
+    # ----------------------------------------------------- dispatch
+
+    def _pick(self, role: str) -> Optional["_Worker"]:
+        ups = [w for w in self._workers.values()
+               if w.role == role and w.up and not w.retired]
+        if not ups:
+            return None
+        return min(ups, key=lambda w: (len(w.assigned), w.index))
+
+    def _has_role(self, role: str) -> bool:
+        return any(w.role == role and not w.retired
+                   for w in self._workers.values())
+
+    def _dispatch(self, now: float):
+        for rid in list(self._order):
+            req = self._journal[rid]
+            if req.status in TERMINAL:
+                self._order.remove(rid)
+                continue
+            if req.status == QUEUED:
+                if self._has_role("prefill"):
+                    w = self._pick("prefill")
+                    if w is None:
+                        continue
+                    if self._send(w, {
+                            "type": "prefill", "rid": rid,
+                            "prompt": req.prompt,
+                            "temperature": req.temperature}):
+                        req.status = PREFILLING
+                        req.worker = w.label
+                        req.prefill_sent_at = now
+                        w.assigned.add(rid)
+                else:
+                    w = self._pick("decode")
+                    if w is None:
+                        continue
+                    if self._send(w, {
+                            "type": "submit", "rid": rid,
+                            "prompt": req.prompt,
+                            "max_new": req.max_new,
+                            "temperature": req.temperature}):
+                        req.status = DECODING
+                        req.worker = w.label
+                        self._m_queue_wait.observe(
+                            now - req.submitted_at)
+                        w.assigned.add(rid)
+            elif req.status == PREFILLED:
+                w = self._pick("decode")
+                if w is None:
+                    continue
+                if self._send(w, {
+                        "type": "handoff_submit", "rid": rid,
+                        "payload": req.payload,
+                        "max_new": req.max_new,
+                        "temperature": req.temperature}):
+                    req.payload = None    # shipped; replay re-prefills
+                    req.status = DECODING
+                    req.worker = w.label
+                    self._m_queue_wait.observe(now - req.submitted_at)
+                    w.assigned.add(rid)
+
+    def _finalize(self, rid: int, status: str, reason=None):
+        req = self._journal[rid]
+        assert req.status not in TERMINAL, (
+            f"double finalize of rid {rid} "
+            f"({req.status} -> {status})")  # the exactly-once pin
+        req.status = status
+        req.reason = reason
+        req.done_at = time.monotonic()
+        self._m_requests.inc(status=status)
+
+    def _sample_gauges(self):
+        counts = {}
+        for w in self._workers.values():
+            counts[(w.role, w.state())] = counts.get(
+                (w.role, w.state()), 0) + 1
+        for role in _ROLES:
+            for state in ("up", "starting", "down", "retired"):
+                self._m_workers.set(
+                    float(counts.get((role, state), 0)),
+                    role=role, state=state)
+
+    # ----------------------------------------------------- host API
+
+    def submit(self, prompt_ids, max_new: int,
+               temperature: float = 0.0) -> int:
+        """Journal a request and return its id; :meth:`pump` routes
+        it.  The journal entry (prompt copy + sampling params) is the
+        replay source if its worker dies mid-flight."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1).copy()
+        rid = self._next_rid
+        self._next_rid += 1
+        self._journal[rid] = _ClusterRequest(rid, prompt,
+                                             int(max_new),
+                                             float(temperature))
+        self._order.append(rid)
+        return rid
+
+    def run(self, timeout_s: Optional[float] = None,
+            poll_s: float = 0.002) -> dict:
+        """Pump until every journaled request is terminal; returns
+        :meth:`results`.  ``timeout_s`` bounds the wait (worker
+        startup includes a jax import and warmup compile — allow tens
+        of seconds on a cold CPU rig)."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while any(r.status not in TERMINAL
+                  for r in self._journal.values()):
+            self.pump()
+            if deadline is not None and time.monotonic() > deadline:
+                raise RuntimeError(
+                    "cluster run timed out; status="
+                    + json.dumps(self.status(), default=str))
+            time.sleep(poll_s)
+        return self.results()
+
+    def wait_ready(self, timeout_s: float = 180.0):
+        """Pump until every non-retired worker is UP (hello received).
+        Spawn cost is a jax import + warmup compile per process —
+        benchmarks call this so measured traffic starts from a warm
+        fleet instead of amortizing cold starts into TTFT."""
+        deadline = time.monotonic() + timeout_s
+        while any(not w.up for w in self._workers.values()
+                  if not w.retired):
+            self.pump()
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "cluster workers not ready; states="
+                    + json.dumps(self.worker_states()))
+            time.sleep(0.002)
+
+    def results(self) -> dict:
+        return {rid: np.asarray(r.tokens, np.int32)
+                for rid, r in self._journal.items()
+                if r.status == COMPLETED}
+
+    def status(self) -> dict:
+        return {rid: {"status": r.status, "reason": r.reason,
+                      "attempts": r.attempts,
+                      "tokens": len(r.tokens)}
+                for rid, r in self._journal.items()}
+
+    def worker_states(self) -> dict:
+        return {w.label: {"role": w.role, "state": w.state(),
+                          "generation": w.generation,
+                          "restarts": w.restarts,
+                          "assigned": len(w.assigned)}
+                for w in self._workers.values()}
+
+    def stats(self) -> dict:
+        sts = [r.status for r in self._journal.values()]
+        return {
+            "requests": {s: sts.count(s)
+                         for s in (QUEUED, PREFILLING, PREFILLED,
+                                   DECODING, COMPLETED, FAILED)},
+            "workers": self.worker_states(),
+            "worker_restarts": sum(w.restarts
+                                   for w in self._workers.values()),
+            "handoff_seconds": self._m_handoff_lat.summary(),
+            "queue_wait_s": self._m_queue_wait.summary(),
+            "ttft_s": self._m_ttft.summary(),
+        }
+
+    def snapshot_workers(self, timeout_s: float = 10.0) -> dict:
+        """Request a telemetry/host-state snapshot from every UP
+        worker and block until they reply (or ``timeout_s``).
+        Returns ``{label: {"role", "metrics", "host_state",
+        "compiles"}}`` — the input ``telemetry.export.
+        merge_snapshots`` aggregates across processes."""
+        targets = [w for w in self._workers.values()
+                   if w.up and not w.retired]
+        for w in targets:
+            w.snapshot = None
+            self._send(w, {"type": "snapshot", "seq": 0})
+        deadline = time.monotonic() + timeout_s
+        while (any(w.snapshot is None for w in targets)
+               and time.monotonic() < deadline):
+            self._drain_events()
+            time.sleep(0.002)
+        return {w.label: {
+                    "role": w.role,
+                    "metrics": w.snapshot["metrics"],
+                    "host_state": w.snapshot["host_state"],
+                    "compiles": w.snapshot["compiles"]}
+                for w in targets if w.snapshot is not None}
+
+    def compile_counts(self) -> dict:
+        """Last known per-worker compile counts (hello, refreshed by
+        :meth:`snapshot_workers`) — the cluster gate's
+        ``{'step': 1, 'prefill': 1}`` pin reads this."""
+        out = {}
+        for w in self._workers.values():
+            if w.retired:
+                continue
+            if w.snapshot is not None:
+                out[w.label] = w.snapshot["compiles"]
+            elif w.compiles is not None:
+                out[w.label] = w.compiles
+        return out
+
+    # ---------------------------------------------------- lifecycle
+
+    def close(self):
+        """Shut workers down (kill past a short grace), stop the
+        accept loop, remove the scratch dir."""
+        if self._closing:
+            return
+        self._closing = True
+        for w in self._workers.values():
+            self._send(w, {"type": "shutdown"})
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 5.0
+        for w in self._workers.values():
+            if w.proc is None:
+                continue
+            while (w.proc.poll() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            if w.proc.poll() is None:
+                self._sigkill(w)
+                w.proc.wait(timeout=5.0)
+            if w.sock is not None:
+                try:
+                    w.sock.close()
+                except OSError:
+                    pass
+        if self._own_workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

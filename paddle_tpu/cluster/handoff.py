@@ -1,0 +1,90 @@
+"""KV handoff payload helpers: prefill worker -> decode worker.
+
+The payload itself is built by
+``PagedServingEngine.prefill_to_handoff`` (per-layer block pages in
+table order, per-block quantization scales when the pool is int8, the
+prompt, and the cursor length — see
+``ops/paged_attention.py::paged_export_blocks``) and consumed by
+``submit_handoff`` on the decode side.  This module adds the
+cluster-level envelope: PREFIX KEYS (block-aligned token-chunk
+digests — the radix registry's vocabulary, usable as a shared routing
+index without shipping token arrays to the router) and the byte/shape
+validation the controller runs before routing a payload it did not
+build.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["prefix_keys", "payload_nbytes", "validate_payload",
+           "attach_prefix_keys"]
+
+
+def prefix_keys(prompt, block_size: int):
+    """Cumulative digests of the prompt's block-aligned token chunks:
+    ``keys[i]`` identifies tokens ``0 .. (i+1)*block_size`` — the same
+    prefix granularity the radix registry shares at, so two prompts
+    with ``k`` equal leading keys share ``k`` cache blocks.  Only full
+    blocks get keys (a partial tail block is never shared)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    keys = []
+    h = hashlib.sha1()
+    for start in range(0, prompt.shape[0] - block_size + 1,
+                       block_size):
+        h.update(prompt[start:start + block_size].tobytes())
+        keys.append(h.hexdigest()[:16])
+    return tuple(keys)
+
+
+def attach_prefix_keys(payload: dict) -> dict:
+    """Stamp the routing keys onto an engine-built payload (in
+    place; returned for chaining)."""
+    payload["prefix_keys"] = list(
+        prefix_keys(payload["prompt"], int(payload["block_size"])))
+    return payload
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Raw tensor bytes in the payload (pages + scales + prompt) —
+    the ``cluster_handoff_bytes_total`` ruler.  Wire framing and
+    base64 overhead are excluded on purpose: this measures what a
+    zero-copy transport (device-to-device DMA on hardware) would
+    move."""
+    total = int(np.asarray(payload["prompt"]).nbytes)
+    for key in ("k_pages", "v_pages", "k_scales", "v_scales"):
+        for arr in payload.get(key, ()):
+            total += int(np.asarray(arr).nbytes)
+    return total
+
+
+def validate_payload(payload: dict) -> dict:
+    """Controller-side sanity check of a payload it is about to route:
+    required keys present, page stacks layer-consistent, and the
+    length covered by the shipped blocks.  Returns the payload.
+    Raises ``ValueError`` — the decode engine re-validates dtype and
+    block size against its own pool at import."""
+    for key in ("prompt", "length", "block_size", "kv_dtype",
+                "k_pages", "v_pages", "k_scales", "v_scales"):
+        if key not in payload:
+            raise ValueError(f"handoff payload missing {key!r}")
+    n = int(np.asarray(payload["prompt"]).reshape(-1).shape[0])
+    if int(payload["length"]) != n:
+        raise ValueError(
+            f"handoff payload length {payload['length']} != prompt "
+            f"tokens {n}")
+    bs = int(payload["block_size"])
+    k_pages = payload["k_pages"]
+    if len(k_pages) != len(payload["v_pages"]):
+        raise ValueError("handoff payload k_pages/v_pages layer "
+                         "count mismatch")
+    if not k_pages:
+        raise ValueError("handoff payload carries no layers")
+    nb = int(np.asarray(k_pages[0]).shape[0])
+    if nb * bs < n:
+        raise ValueError(
+            f"handoff payload ships {nb} blocks of {bs} — too few "
+            f"for {n} tokens")
+    return payload

@@ -1,0 +1,170 @@
+"""The CI cluster gate: ``python -m paddle_tpu.cluster.selfcheck``.
+
+One disaggregated serving run on the CPU backend — 1 prefill worker +
+1 decode worker as REAL OS processes — with a SIGKILL in the middle,
+asserting the properties the cluster exists to provide:
+
+1. **Disaggregated bit-identity** — greedy streams served through
+   prefill -> KV handoff -> decode across the process boundary are
+   byte-identical to a single in-process engine's.
+2. **Compile pinning** — after warmup + live traffic each worker,
+   either role, reports ``compiles == {'step': 1, 'prefill': 1}``
+   (modulo an unexercised ``share`` program when sharing is on):
+   serving across the cluster added NO programs.
+3. **Crash recovery** — a decode worker SIGKILLed mid-stream is
+   detected by heartbeat timeout, restarted with a bumped generation
+   tag, and its in-flight requests journal-replay to streams
+   bit-identical to the baseline; every request ends in EXACTLY one
+   terminal status.
+4. **Telemetry merge** — per-worker registry snapshots merge
+   (``telemetry.export.merge_snapshots``) into one schema-valid
+   snapshot, and the controller registry carries populated
+   ``cluster_*`` families (restart counter included).
+
+A ``heartbeat``-point fault (one dropped beat, injected controller-
+side) rides along so the process-scope injection path is exercised on
+every CI run, not only in the test suite.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def _check(ok, what):
+    status = "ok" if ok else "FAIL"
+    print(f"[cluster-selfcheck] {status}: {what}")
+    if not ok:
+        raise SystemExit(f"cluster selfcheck failed: {what}")
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import telemetry
+    from paddle_tpu.cluster import ClusterController
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry.export import (merge_snapshots,
+                                             validate_snapshot)
+    from paddle_tpu.testing.faults import (Fault, FaultInjector,
+                                           FaultSchedule)
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=48)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    kw = dict(num_slots=2, num_blocks=24, block_size=4,
+              prompt_buckets=(16,), decode_kernel=False, seed=0)
+    prompts = [np.arange(1, 7), np.arange(3, 12), np.arange(2, 5),
+               np.arange(5, 9), np.arange(1, 4)]
+    max_new = 8
+
+    # ---- baseline: one in-process engine, same config/params/seed
+    eng = PagedServingEngine(cfg, params, **kw)
+    base_rids = [eng.submit(p.astype(np.int32), max_new=max_new,
+                            temperature=0.0) for p in prompts]
+    base = eng.run()
+    _check(len(base) == len(prompts), "baseline engine served "
+           f"{len(prompts)} requests")
+
+    faults = FaultInjector(FaultSchedule([
+        # drop prefill0's 2nd heartbeat — exercises the controller's
+        # process-scope injection path; harmless under the timeout
+        Fault("heartbeat", 2, "raise", scope="prefill0"),
+    ]))
+    reg = telemetry.MetricsRegistry(name="cluster-selfcheck")
+    t0 = time.monotonic()
+    with ClusterController(cfg, params, prefill_workers=1,
+                           decode_workers=1, metrics=reg,
+                           hb_timeout_s=0.5, faults=faults,
+                           **kw) as ctl:
+        # ---- phase 1: clean disaggregated serve, bit-identity
+        rids = [ctl.submit(p.astype(np.int32), max_new=max_new)
+                for p in prompts]
+        res = ctl.run(timeout_s=180)
+        print(f"[cluster-selfcheck] phase 1 (spawn + serve) took "
+              f"{time.monotonic() - t0:.1f}s")
+        _check(all(np.array_equal(base[b], res[r])
+                   for b, r in zip(base_rids, rids)),
+               "disaggregated greedy streams bit-identical to the "
+               "in-process engine")
+        snaps = ctl.snapshot_workers()
+        _check(set(snaps) == {"prefill0", "decode0"},
+               "both workers answered the snapshot request")
+        _check(all(s["compiles"] == {"step": 1, "prefill": 1}
+                   for s in snaps.values()),
+               "per-worker compiles == {'step': 1, 'prefill': 1} "
+               "after live traffic")
+        merged = merge_snapshots(
+            {label: s["metrics"] for label, s in snaps.items()})
+        validate_snapshot(merged)
+        series = merged["metrics"]["serving_submitted_total"]["series"]
+        _check({s["labels"]["worker"] for s in series}
+               == {"prefill0", "decode0"},
+               "merged worker snapshots keep per-worker series "
+               "distinguishable")
+        _check(any(f["point"] == "heartbeat" for f in faults.fired()),
+               "process-scope heartbeat fault fired controller-side")
+
+        # ---- phase 2: SIGKILL decode0 mid-stream, replay identity
+        rids2 = [ctl.submit(p.astype(np.int32), max_new=max_new)
+                 for p in prompts]
+        deadline = time.monotonic() + 180
+        killed = False
+        while time.monotonic() < deadline:
+            ctl.pump()
+            live = [ctl._journal[r] for r in rids2]
+            if not killed and any(r.first_token_at is not None
+                                  for r in live):
+                ctl.kill_worker("decode0")
+                killed = True
+            if all(r.status in ("completed", "failed") for r in live):
+                break
+            time.sleep(0.002)
+        _check(killed, "SIGKILL landed while a stream was live")
+        st = ctl.status()
+        _check(all(st[r]["status"] == "completed" for r in rids2),
+               "every request reached exactly one terminal status "
+               "(completed) after the kill")
+        res2 = ctl.results()
+        _check(all(np.array_equal(base[b], res2[r])
+                   for b, r in zip(base_rids, rids2)),
+               "journal-replayed streams bit-identical after the "
+               "restart")
+        ws = ctl.worker_states()
+        _check(ws["decode0"]["generation"] >= 1
+               and ws["decode0"]["restarts"] >= 1,
+               "decode0 restarted with a bumped generation tag")
+        snaps2 = ctl.snapshot_workers()
+        _check(snaps2["decode0"]["compiles"]
+               == {"step": 1, "prefill": 1},
+               "restarted decode0 re-pinned "
+               "compiles == {'step': 1, 'prefill': 1}")
+        ctl_snap = reg.snapshot()
+        validate_snapshot(ctl_snap)
+        fams = ctl_snap["metrics"]
+        _check(all(name in fams for name in
+                   ("cluster_workers", "cluster_worker_restarts_total",
+                    "cluster_handoff_bytes_total",
+                    "cluster_handoff_seconds",
+                    "cluster_queue_wait_seconds", "cluster_ttft_seconds",
+                    "cluster_requests_total")),
+               "controller registry carries the cluster_* families")
+        restarts = sum(s["value"] for s in
+                       fams["cluster_worker_restarts_total"]["series"])
+        _check(restarts >= 1,
+               "cluster_worker_restarts_total counted the takedown")
+    print("[cluster-selfcheck] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Length-prefixed JSON control channel for the serving cluster.
+
+One frame = a 4-byte big-endian length followed by a UTF-8 JSON body.
+Numpy arrays ride inside the JSON as tagged base64 blobs
+(``{"__nd__": [dtype, shape, b64]}``), so the SAME channel carries
+tiny control messages (heartbeats, submits) and multi-megabyte KV
+handoff payloads without a second transport — msgpack would shave the
+base64 third off the handoff bytes, but JSON keeps the protocol
+greppable from a socket dump and the handoff latency on the CPU test
+rig is dominated by the prefill itself.
+
+The codec round-trips dtype and shape EXACTLY (``decode(encode(x))``
+is ``np.ndarray`` bit-identical), which is what lets the int8 pool
+pages and their f32 scales cross the process boundary without
+perturbing the bit-identity contract.  Frames are bounded by
+``MAX_FRAME_BYTES`` so a corrupt length prefix fails loudly instead
+of allocating gigabytes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+
+import numpy as np
+
+__all__ = ["MAX_FRAME_BYTES", "encode_frame", "decode_body",
+           "send_msg", "recv_msg", "frame_nbytes"]
+
+MAX_FRAME_BYTES = 1 << 31          # loud failure beats a 4 GiB malloc
+
+_ND_TAG = "__nd__"
+
+
+def _dtype_token(dt: np.dtype) -> str:
+    # `.str` round-trips every builtin dtype with explicit endianness,
+    # but extension dtypes (ml_dtypes' bfloat16 — the mixed-precision
+    # KV pool) stringify as opaque void ('|V2') and refuse the cast
+    # back; their registered NAME is the round-trippable spelling
+    return dt.name if dt.kind == "V" else dt.str
+
+
+def _pack(obj):
+    if isinstance(obj, np.ndarray):
+        return {_ND_TAG: [_dtype_token(obj.dtype), list(obj.shape),
+                          base64.b64encode(
+                              np.ascontiguousarray(obj).tobytes())
+                          .decode("ascii")]}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_pack(v) for v in obj]
+    return obj
+
+
+def _resolve_dtype(token: str) -> np.dtype:
+    try:
+        return np.dtype(token)
+    except TypeError:
+        import ml_dtypes            # registers bfloat16 and friends
+        return np.dtype(getattr(ml_dtypes, token))
+
+
+def _unpack(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {_ND_TAG}:
+            dt, shape, b64 = obj[_ND_TAG]
+            return np.frombuffer(
+                base64.b64decode(b64.encode("ascii")),
+                dtype=_resolve_dtype(dt)).reshape(shape).copy()
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v) for v in obj]
+    return obj
+
+
+def encode_frame(msg: dict) -> bytes:
+    """One wire frame: length prefix + JSON body."""
+    body = json.dumps(_pack(msg), separators=(",", ":")).encode()
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame body {len(body)} bytes exceeds "
+                         f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
+    return struct.pack(">I", len(body)) + body
+
+
+def decode_body(body: bytes) -> dict:
+    return _unpack(json.loads(body.decode()))
+
+
+def frame_nbytes(msg: dict) -> int:
+    """Wire size of ``msg`` — the handoff-bytes metric's ruler."""
+    return len(encode_frame(msg))
+
+
+def send_msg(sock, msg: dict) -> int:
+    """Write one frame; returns its wire size.  ``sock`` is a blocking
+    socket — a concurrent reader thread is fine (sockets are
+    full-duplex) but writers must not interleave."""
+    frame = encode_frame(msg)
+    sock.sendall(frame)
+    return len(frame)
+
+
+def _recv_exact(sock, n: int):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if buf:
+                raise ConnectionError(
+                    f"peer closed mid-frame ({len(buf)}/{n} bytes)")
+            return None                # clean EOF at a frame boundary
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock):
+    """Read one frame; ``None`` on clean EOF at a frame boundary.
+    Raises ``ConnectionError`` on a mid-frame close and ``ValueError``
+    on a length prefix past ``MAX_FRAME_BYTES`` (corrupt stream)."""
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(f"frame length {n} exceeds MAX_FRAME_BYTES "
+                         f"({MAX_FRAME_BYTES}) — corrupt stream")
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise ConnectionError("peer closed between prefix and body")
+    return decode_body(body)

@@ -1,0 +1,251 @@
+"""Cluster worker: one OS process, one ``PagedServingEngine``.
+
+Spawned by the controller as ``python -m paddle_tpu.cluster.worker``
+so the platform bootstrap (``JAX_PLATFORMS`` / virtual CPU devices)
+happens BEFORE jax imports — the same recipe as the multi-process
+distributed tests.  The worker connects back to the controller's
+listener, identifies itself (``hello``), then runs a single-threaded
+serve loop: drain control messages, step the engine, stream token
+deltas, heartbeat on a fixed cadence.  A reader thread blocks on the
+socket and feeds an inbox queue so control messages and heartbeats
+keep flowing while the engine steps.
+
+Role specialization is a message-set difference, not an engine fork:
+
+* ``prefill`` workers serve ``prefill`` requests — run
+  ``prefill_to_handoff`` and reply with the KV payload (stamped with
+  the prefix routing keys);
+* ``decode`` workers serve ``submit`` (local prefill + decode) and
+  ``handoff_submit`` (imported KV + replayed final prompt token).
+
+At startup the worker serves one tiny LOCAL warmup request, which
+compiles both programs — so every worker, either role, reaches
+steady state at ``compiles == {'step': 1, 'prefill': 1}`` and the
+cluster CI gate can assert serving added none.
+
+Determinism contract: a worker's engine is built from (config, params
+file, seed) only — a restarted generation is a journal-replay twin,
+so requeued greedy streams are bit-identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import queue
+import sys
+import threading
+import time
+
+
+def _provision_cpu(n: int) -> None:
+    # must run BEFORE jax imports anywhere in this process — the same
+    # backend-registry reset recipe as tests/multiproc_worker.py
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu
+
+    paddle_tpu._honor_env_platform(force=True)
+
+
+def _reader(sock, inbox):
+    from paddle_tpu.cluster import wire
+    try:
+        while True:
+            msg = wire.recv_msg(sock)
+            if msg is None:
+                break
+            inbox.put(msg)
+    except (ConnectionError, OSError):
+        pass
+    inbox.put({"type": "_eof"})
+
+
+def _engine_kwargs(config: dict) -> dict:
+    kw = dict(config["engine"])
+    if kw.get("prompt_buckets") is not None:
+        kw["prompt_buckets"] = tuple(kw["prompt_buckets"])
+    return kw
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="paddle_tpu.cluster.worker")
+    ap.add_argument("--controller", required=True,
+                    help="host:port of the controller's listener")
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--role", required=True,
+                    choices=("prefill", "decode"))
+    ap.add_argument("--generation", type=int, default=0)
+    ap.add_argument("--params", required=True,
+                    help="pickled numpy param pytree")
+    ap.add_argument("--config", required=True,
+                    help="JSON: platform/devices/cfg/engine/seed")
+    ap.add_argument("--hb-interval", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    with open(args.config) as f:
+        config = json.load(f)
+    if config.get("platform", "cpu") == "cpu":
+        _provision_cpu(int(config.get("devices", 1)))
+
+    import numpy as np
+
+    from paddle_tpu import telemetry
+    from paddle_tpu.cluster import handoff, wire
+    from paddle_tpu.models.transformer import TransformerConfig
+    from paddle_tpu.serving import PagedServingEngine
+
+    if "policy" in config:
+        # restore the spawner's ambient numerics policy: an engine
+        # built under mixed_precision() must stay numerically
+        # identical across the process boundary
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.dtypes import Policy, set_policy
+        pol = config["policy"]
+        set_policy(Policy(param_dtype=jnp.dtype(pol["param"]),
+                          compute_dtype=jnp.dtype(pol["compute"]),
+                          output_dtype=jnp.dtype(pol["output"])))
+
+    cfg = TransformerConfig(**config["cfg"])
+    with open(args.params, "rb") as f:
+        params = pickle.load(f)
+
+    # per-worker registry: its snapshot ships over the snapshot reply
+    # and merges controller-side (telemetry/export.py merge_snapshots)
+    registry = telemetry.MetricsRegistry(name=f"worker.{args.worker_id}")
+    eng = PagedServingEngine(cfg, params, metrics=registry,
+                            seed=int(config.get("seed", 0)),
+                            **_engine_kwargs(config))
+
+    if config.get("warmup", True):
+        # compile both programs before taking traffic: one local
+        # 2-token request exercises prefill AND the decode step, so
+        # steady state is {'step': 1, 'prefill': 1} for BOTH roles and
+        # serving itself must add no compiles
+        eng.submit(np.asarray([1], np.int32), max_new=2,
+                   temperature=0.0)
+        eng.run()
+        eng.pop_results()
+
+    import socket as socket_mod
+    host, port = args.controller.rsplit(":", 1)
+    sock = socket_mod.create_connection((host, int(port)), timeout=30)
+    sock.settimeout(None)
+    wire.send_msg(sock, {
+        "type": "hello", "worker": args.worker_id, "role": args.role,
+        "generation": args.generation, "pid": os.getpid(),
+        "compiles": eng.compile_counts()})
+
+    inbox = queue.Queue()
+    threading.Thread(target=_reader, args=(sock, inbox),
+                     daemon=True).start()
+
+    ridmap = {}                    # engine rid -> controller rid
+    sent = {}                      # engine rid -> tokens streamed
+    last_hb = 0.0
+    draining = False
+    gen = args.generation
+
+    def post(msg):
+        msg["worker"] = args.worker_id
+        msg["generation"] = gen
+        wire.send_msg(sock, msg)
+
+    def maybe_heartbeat():
+        # called between inbox commands as well as once per loop: a
+        # burst of handoff imports (each one an eager compile in a
+        # fresh process) must not starve the supervisor's watchdog for
+        # the whole batch — the silence is bounded by ONE command
+        nonlocal last_hb
+        now = time.monotonic()
+        if now - last_hb >= args.hb_interval:
+            last_hb = now
+            post({"type": "heartbeat", "ts": time.time(),
+                  "queue_depth": len(eng._queue),
+                  "active": sum(r is not None for r in eng._slots)})
+
+    def stream_deltas():
+        # token-stream channel: ship each live request's NEW tokens as
+        # they land (controller-side TTFT is honest), and the final
+        # delta with done=True exactly once per engine rid
+        for r in eng._slots:
+            if r is None or r.rid not in ridmap:
+                continue
+            n_sent = sent.get(r.rid, 0)
+            if len(r.tokens) > n_sent:
+                post({"type": "tokens", "rid": ridmap[r.rid],
+                      "tokens": np.asarray(r.tokens[n_sent:],
+                                           np.int32),
+                      "done": False})
+                sent[r.rid] = len(r.tokens)
+        for erid, toks in eng.pop_results().items():
+            if erid not in ridmap:
+                continue
+            n_sent = sent.pop(erid, 0)
+            post({"type": "tokens", "rid": ridmap.pop(erid),
+                  "tokens": np.asarray(toks[n_sent:], np.int32),
+                  "done": True})
+
+    while True:
+        progressed = False
+        while True:
+            try:
+                msg = inbox.get_nowait()
+            except queue.Empty:
+                break
+            progressed = True
+            kind = msg.get("type")
+            if kind == "_eof" or kind == "shutdown":
+                return 0
+            try:
+                if kind == "submit":
+                    erid = eng.submit(msg["prompt"],
+                                      int(msg["max_new"]),
+                                      float(msg["temperature"]))
+                    ridmap[erid] = msg["rid"]
+                elif kind == "handoff_submit":
+                    erid = eng.submit_handoff(msg["payload"],
+                                              int(msg["max_new"]),
+                                              float(msg["temperature"]))
+                    ridmap[erid] = msg["rid"]
+                elif kind == "prefill":
+                    payload = eng.prefill_to_handoff(
+                        msg["prompt"], float(msg["temperature"]))
+                    handoff.attach_prefix_keys(payload)
+                    post({"type": "handoff", "rid": msg["rid"],
+                          "payload": payload})
+                elif kind == "snapshot":
+                    post({"type": "snapshot", "seq": msg.get("seq"),
+                          "role": args.role,
+                          "host_state": eng.host_state(),
+                          "compiles": eng.compile_counts(),
+                          "metrics": registry.snapshot()})
+                elif kind == "drain":
+                    draining = True
+            except Exception as exc:  # engine reject / bad payload
+                post({"type": "error", "rid": msg.get("rid"),
+                      "detail": f"{type(exc).__name__}: {exc}"})
+            maybe_heartbeat()
+        has_work = bool(eng._queue) or any(
+            r is not None for r in eng._slots)
+        if has_work:
+            eng.step()
+            progressed = True
+        stream_deltas()
+        maybe_heartbeat()
+        if draining and not has_work and not eng._queue:
+            post({"type": "drained"})
+            draining = False
+        if not progressed:
+            time.sleep(0.002)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

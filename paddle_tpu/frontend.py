@@ -72,6 +72,7 @@ from paddle_tpu.core.errors import enforce
 from paddle_tpu.serving import PagedServingEngine, QueueFull
 
 __all__ = ["ServingFrontend", "SubmitRejected",
+           "disaggregated_frontend",
            "QUEUED", "RUNNING", "COMPLETED", "SHED", "FAILED",
            "TERMINAL"]
 
@@ -966,3 +967,29 @@ class ServingFrontend:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# --------------------------------------------------- disaggregated entry
+
+
+def disaggregated_frontend(cfg, params, *, prefill_workers: int = 1,
+                           decode_workers: int = 1, **kw):
+    """The process-isolated counterpart of :class:`ServingFrontend`:
+    build a :class:`~paddle_tpu.cluster.ClusterController` whose
+    workers are OS PROCESSES (prefill workers computing KV blocks and
+    handing them to decode workers) instead of engine threads in this
+    interpreter.  Same supervision story — heartbeat watchdog, SIGKILL
+    takedown, generation-tagged backoff restart, journal-replay with
+    greedy streams bit-identical — carried across the process
+    boundary; see ``docs/design/serving.md`` (disaggregation section)
+    for when each shape wins.
+
+    ``kw`` passes through to the controller (engine geometry,
+    ``kv_dtype``/``prefix_cache``, heartbeat/backoff/retry tuning,
+    ``autoscaler=AutoscalePolicy(...)``, ``faults=``, ``metrics=``).
+    The import lives inside the call so in-process serving never pays
+    for the cluster machinery."""
+    from paddle_tpu.cluster import ClusterController
+    return ClusterController(cfg, params,
+                             prefill_workers=prefill_workers,
+                             decode_workers=decode_workers, **kw)

@@ -54,6 +54,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NEG_INF = -1e30      # finite mask value (see ops/attention.py NEG_INF)
 
@@ -344,6 +345,100 @@ def paged_rc_add(cache: PagedKVCache, delta) -> PagedKVCache:
     wrap a refcount negative and resurrect a freed block."""
     return cache._replace(refcounts=jnp.maximum(
         cache.refcounts + jnp.asarray(delta, jnp.int32), 0))
+
+
+def paged_export_blocks(cache: PagedKVCache, slot: int) -> dict:
+    """Host-side handoff EXPORT: copy ``slot``'s mapped K/V blocks out
+    of the pool as numpy arrays — the prefill half of disaggregated
+    serving (``paddle_tpu/cluster``): a prefill worker computes a
+    prompt's KV blocks, exports them here, and ships them to a decode
+    worker whose pool they :func:`paged_import_blocks` into.
+
+    Returns ``{"length", "block_size", "kv_dtype", "k_pages",
+    "v_pages", "k_scales", "v_scales"}`` where pages are per-layer
+    ``[n_blocks, block_size, h, hd]`` gathers in TABLE ORDER (block 0
+    of the result holds tokens 0..block_size-1) and scales are the
+    matching ``[n_blocks, h]`` f32 rows — empty tuples when
+    unquantized — so an int8 pool travels WITH its per-block
+    quantization state and dequantizes identically on the other side.
+    Pure read: the cache is untouched and the copies stay valid after
+    the slot retires."""
+    slot = int(slot)
+    used = int(np.asarray(cache.blocks_used)[slot])
+    ids = np.asarray(cache.block_tables)[slot, :used].astype(np.int32)
+    return {
+        "length": int(np.asarray(cache.lengths)[slot]),
+        "block_size": cache.block_size,
+        "kv_dtype": cache.kv_dtype.name,
+        "k_pages": tuple(np.asarray(p)[ids] for p in cache.k_pages),
+        "v_pages": tuple(np.asarray(p)[ids] for p in cache.v_pages),
+        "k_scales": tuple(np.asarray(s)[ids] for s in cache.k_scales),
+        "v_scales": tuple(np.asarray(s)[ids] for s in cache.v_scales),
+    }
+
+
+def paged_import_blocks(cache: PagedKVCache, blocks: dict):
+    """Host-side handoff IMPORT: write foreign block pages (a
+    :func:`paged_export_blocks` payload) into this pool's lowest-index
+    FREE blocks and return ``(cache, ids)``, ``ids`` the ``[n]`` int32
+    physical blocks written (``None`` when the pool lacks enough free
+    blocks — caller backpressure, cache unchanged).
+
+    The written blocks keep refcount 0: the caller must map them into
+    a slot IMMEDIATELY (:func:`paged_share` sets rc to 1 — the
+    handoff's ownership pin) before anything else touches the pool,
+    because a :func:`paged_reserve` in between could claim them — and,
+    on a quantized pool, zero the freshly written scales (reserve
+    resets scales at claim time).  Scales are written HERE, after
+    choosing the blocks but outside any claim, for exactly that
+    reason: the handoff order is write-then-share, never
+    reserve-then-write."""
+    if jnp.dtype(blocks["kv_dtype"]) != cache.kv_dtype:
+        raise ValueError(
+            f"handoff import: payload kv_dtype {blocks['kv_dtype']} != "
+            f"pool kv_dtype {cache.kv_dtype.name}")
+    if int(blocks["block_size"]) != cache.block_size:
+        raise ValueError(
+            f"handoff import: payload block_size {blocks['block_size']}"
+            f" != pool block_size {cache.block_size}")
+    if len(blocks["k_pages"]) != cache.num_layers:
+        raise ValueError(
+            f"handoff import: payload has {len(blocks['k_pages'])} "
+            f"layers, pool has {cache.num_layers}")
+    n = int(blocks["k_pages"][0].shape[0])
+    want_shape = (n, cache.block_size) + cache.k_pages[0].shape[2:]
+    for p in tuple(blocks["k_pages"]) + tuple(blocks["v_pages"]):
+        if tuple(p.shape) != want_shape:
+            raise ValueError(
+                f"handoff import: page shape {tuple(p.shape)} != "
+                f"expected {want_shape}")
+    free = np.flatnonzero(np.asarray(cache.free))
+    if free.shape[0] < n:
+        return cache, None
+    ids_np = free[:n].astype(np.int32)
+    ids = jnp.asarray(ids_np)
+    out = cache._replace(
+        k_pages=tuple(p.at[ids].set(jnp.asarray(src, p.dtype))
+                      for p, src in zip(cache.k_pages,
+                                        blocks["k_pages"])),
+        v_pages=tuple(p.at[ids].set(jnp.asarray(src, p.dtype))
+                      for p, src in zip(cache.v_pages,
+                                        blocks["v_pages"])))
+    if cache.quantized:
+        if len(blocks["k_scales"]) != cache.num_layers:
+            raise ValueError(
+                "handoff import: int8 payload carries no per-block "
+                "scales (exported from an unquantized pool?)")
+        out = out._replace(
+            k_scales=tuple(
+                s.at[ids].set(jnp.asarray(src, jnp.float32))
+                for s, src in zip(cache.k_scales,
+                                  blocks["k_scales"])),
+            v_scales=tuple(
+                s.at[ids].set(jnp.asarray(src, jnp.float32))
+                for s, src in zip(cache.v_scales,
+                                  blocks["v_scales"])))
+    return out, ids_np
 
 
 def paged_cow(cache: PagedKVCache, want):

@@ -404,9 +404,10 @@ def kv_parity_probe(cfg: TransformerConfig, params, prompts, *,
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "tokens",
                  "blocks_reserved", "submitted_at", "first_token_at",
-                 "prefix_hit_tokens", "prefix_nodes")
+                 "prefix_hit_tokens", "prefix_nodes", "handoff")
 
-    def __init__(self, rid, prompt, max_new, temperature, blocks):
+    def __init__(self, rid, prompt, max_new, temperature, blocks,
+                 handoff=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -417,6 +418,18 @@ class _Request:
         self.first_token_at = None        # set when prefill emits tok0
         self.prefix_hit_tokens = 0        # prompt tokens NOT prefilled
         self.prefix_nodes = ()            # registry nodes this rid shares
+        self.handoff = handoff            # imported-KV payload or None
+
+
+class _HandoffHit:
+    """Stand-in for a prefix-cache match on the handoff admission path
+    (:meth:`PagedServingEngine._admit`): the prompt's KV arrives as an
+    imported payload rather than from the registry, so there are no
+    matched nodes — registration still runs so LATER identical prompts
+    hit locally."""
+    nodes = ()
+    block_ids = ()
+    shared_len = 0
 
 
 class PagedServingEngine:
@@ -829,19 +842,23 @@ class PagedServingEngine:
         # params and the paged pool stay replicated (multi-chip pool
         # sharding is the ROADMAP item this gate de-risks).
         self._decode_slot_args = (2, 3, 4, 5)
+        # share/rc_add are tiny refcount/table host transforms used by
+        # BOTH prefix sharing and the disaggregated KV handoff import
+        # (paddle_tpu/cluster): always built, but only registered with
+        # the compile watcher under sharing — the historical
+        # compile-count contracts name 'share' only in sharing mode,
+        # and the handoff's share is the same sub-millisecond table op.
+        self._share = jax.jit(paged.paged_share, donate_argnums=(0,))
+        self._rc_add = jax.jit(paged.paged_rc_add, donate_argnums=(0,))
         if sharing:
-            # prefix-sharing host transforms: share/pin are tiny
-            # refcount/table updates.  Legacy mode additionally keeps
-            # the per-tail-width prefill program (one compile per TAIL
-            # pad width used); unified mode serves tails through the
-            # single ragged prefill program.
+            # prefix-sharing host transforms.  Legacy mode additionally
+            # keeps the per-tail-width prefill program (one compile per
+            # TAIL pad width used); unified mode serves tails through
+            # the single ragged prefill program.
             if not self._unified:
                 self._prefill_tail = jax.jit(prefill_tail_fn,
                                              donate_argnums=(1,))
                 watched["prefill_tail"] = self._prefill_tail
-            self._share = jax.jit(paged.paged_share, donate_argnums=(0,))
-            self._rc_add = jax.jit(paged.paged_rc_add,
-                                   donate_argnums=(0,))
             watched["share"] = self._share
         if spec is not None:
 
@@ -1117,6 +1134,17 @@ class PagedServingEngine:
                  "the most recent parity probe (kv_parity_probe / "
                  "note_kv_divergence) — NOT sampled by the engine loop; "
                  "0 until a probe reports")
+        self._m_handoff_export = m.counter(
+            "serving_handoff_exports_total",
+            help="prompts prefilled and exported as KV handoff "
+                 "payloads (prefill_to_handoff — the disaggregated "
+                 "prefill role's output)")
+        self._m_handoff_import = m.counter(
+            "serving_handoff_imports_total",
+            help="admissions that mapped an imported KV handoff "
+                 "payload instead of prefilling the prompt "
+                 "(submit_handoff — the disaggregated decode role's "
+                 "input)")
         if spec is not None:
             self._m_spec_drafted = m.counter(
                 "serving_spec_draft_tokens_total",
@@ -1218,6 +1246,120 @@ class PagedServingEngine:
                                 max_new=int(max_new))
         return rid
 
+    def prefill_to_handoff(self, prompt_ids,
+                           temperature: float = 0.0) -> dict:
+        """Prefill a prompt and EXPORT its KV blocks as a handoff
+        payload instead of decoding — the disaggregated PREFILL role
+        (``paddle_tpu/cluster``): a prefill worker calls this per
+        admitted prompt and ships the payload to a decode worker's
+        :meth:`submit_handoff`.
+
+        A free slot is borrowed for the call and freed before
+        returning, so this composes with live decode traffic on the
+        same engine.  The sampled first token is deliberately
+        DISCARDED: the decode side maps the blocks with the length
+        cursor one short and replays the final prompt token through
+        its own tail prefill, which regenerates the first token
+        bit-identically (the prefix-cache full-prompt-hit replay
+        contract) — no token or RNG state crosses the wire."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        enforce(n >= 1, "prefill_to_handoff: empty prompt")
+        enforce(any(n <= w for w in self.buckets),
+                "prefill_to_handoff: prompt length %d exceeds every "
+                "prefill bucket %s", n, self.buckets)
+        blocks = -(-n // self.bs)
+        enforce(self._reserved + self._pinned + blocks <= self.nb,
+                "prefill_to_handoff: %d blocks needed but only %d "
+                "unreserved in the pool", blocks,
+                self.nb - self._reserved - self._pinned)
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            enforce(False, "prefill_to_handoff: no free slot")
+        if self._faults is not None:
+            self._faults.fire("prefill")
+        width = (self._prefill_width if self._unified
+                 else min(w for w in self.buckets if n <= w))
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :n] = prompt
+        self.cache, _tok0, _done0, ok = self._prefill(
+            self.params, self.cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(n, jnp.int32),
+            float(temperature), self._split())
+        assert bool(ok), "paged pool exhausted despite handoff " \
+                         "accounting (engine bug)"
+        payload = paged.paged_export_blocks(self.cache, slot)
+        payload["prompt"] = prompt
+        self.cache = self._free(
+            self.cache, jnp.asarray(np.arange(self.S) == slot))
+        self._m_handoff_export.inc()
+        if self.tracer is not None:
+            self.tracer.instant("handoff_export", track="host",
+                                prompt_len=int(n),
+                                blocks=int(blocks))
+        return payload
+
+    def submit_handoff(self, payload: dict, max_new: int,
+                       temperature: float = 0.0) -> int:
+        """Queue a request whose prompt KV arrives as an imported
+        handoff payload (:meth:`prefill_to_handoff` on another engine)
+        — the disaggregated DECODE role.  Admission writes the
+        payload's pages (and, for int8 pools, their per-block scales)
+        into free pool blocks, maps them into the slot with
+        ``paged_share``-style refcount pinning, and replays only the
+        final prompt token, so the greedy stream is bit-identical to a
+        local :meth:`submit` of the same prompt.  Capacity and
+        queue-bound contracts match :meth:`submit`."""
+        enforce(self._unified or self.prefix_enabled,
+                "submit_handoff needs the tail-prefill program: build "
+                "the engine with unified_step=True (default) or "
+                "prefix_cache=True")
+        prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+        n = prompt.shape[0]
+        enforce(n >= 1, "submit_handoff: empty prompt")
+        enforce(int(payload["length"]) == n,
+                "submit_handoff: payload covers %s tokens but the "
+                "prompt is %d — partial handoffs are not a thing",
+                payload["length"], n)
+        enforce(jnp.dtype(payload["kv_dtype"]) == self.kv_dtype,
+                "submit_handoff: payload kv_dtype %s != pool %s",
+                payload["kv_dtype"], self.kv_dtype.name)
+        enforce(int(payload["block_size"]) == self.bs,
+                "submit_handoff: payload block_size %s != pool %d",
+                payload["block_size"], self.bs)
+        enforce(any(n <= w for w in self.buckets),
+                "submit_handoff: prompt length %d exceeds every "
+                "prefill bucket %s", n, self.buckets)
+        enforce(max_new >= 1 and n + max_new <= self.cap,
+                "submit_handoff: prompt %d + max_new %d exceeds "
+                "per-slot capacity %d", n, max_new, self.cap)
+        blocks = -(-(n + max_new) // self.bs)
+        worst = blocks + 1 if self.prefix_enabled else blocks
+        enforce(worst <= self.nb,
+                "submit_handoff: request worst case %d blocks exceeds "
+                "the pool (%d) — it could never be admitted", worst,
+                self.nb)
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            self._m_submit_rejects.inc(reason="queue_full")
+            if self.tracer is not None:
+                self.tracer.instant("submit_rejected", track="host",
+                                    reason="queue_full",
+                                    queued=len(self._queue))
+            raise QueueFull(len(self._queue), self.max_queue)
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, prompt, max_new, float(temperature),
+                       blocks, handoff=payload)
+        self._queue.append(req)
+        self._m_submitted.inc()
+        if self.tracer is not None:
+            self.tracer.instant("submit", track="host", rid=rid,
+                                ts=req.submitted_at, prompt_len=int(n),
+                                max_new=int(max_new), handoff=True)
+        return rid
+
     def _split(self):
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -1282,7 +1424,17 @@ class PagedServingEngine:
             hit = None
             need = req.blocks_reserved
             slack = 0
-            if self._prefix is not None:
+            if self._prefix is not None and req.handoff is not None:
+                # handoff admission skips the registry match (the
+                # prompt's KV arrives in the payload) but still
+                # REGISTERS after import, which can pin its tail block
+                # — the same COW-slack rule as a fresh admission
+                slack = 1
+                short = (self._reserved + self._pinned + need + slack
+                         - self.nb)
+                if short > 0:
+                    self._evict_prefix(short)
+            elif self._prefix is not None:
                 hit = self._prefix.match(req.prompt)
                 if hit.block_ids:
                     # matched blocks are resident already: reserve the
@@ -1333,7 +1485,10 @@ class PagedServingEngine:
                                     ts=t_admit, slot=slot)
                 self.tracer.complete("queue", req.submitted_at, t_admit,
                                      track=f"slot{slot}", rid=req.rid)
-            if hit is not None and hit.block_ids:
+            if req.handoff is not None:
+                tok0, done0, ok, width, ptoks = self._admit_handoff(
+                    req, slot)
+            elif hit is not None and hit.block_ids:
                 tok0, done0, ok, width, ptoks = self._admit_hit(
                     req, slot, hit)
             else:
@@ -1355,12 +1510,15 @@ class PagedServingEngine:
             assert bool(ok), "paged pool exhausted despite admission " \
                              "accounting (engine bug)"
             if self._prefix is not None:
-                if hit.block_ids:
+                if hit is None:           # handoff: no registry match
+                    hit = _HandoffHit()   # ran; register + pin below
+                elif hit.block_ids:
                     self._m_prefix_hits.inc()
                     self._m_prefix_tokens.inc(req.prefix_hit_tokens)
+                    self._m_prefix_hist.observe(float(hit.shared_len))
                 else:
                     self._m_prefix_misses.inc()
-                self._m_prefix_hist.observe(float(hit.shared_len))
+                    self._m_prefix_hist.observe(float(hit.shared_len))
                 self._register_prefix(req, slot, hit)
             self._reserved += req.blocks_reserved
             self._slots[slot] = req
@@ -1425,6 +1583,53 @@ class PagedServingEngine:
                                 rid=req.rid, shared_tokens=new_len,
                                 matched_tokens=hit.shared_len,
                                 blocks=nmap, prefill_tokens=tlen)
+        return tok0, done0, ok, width, tlen
+
+    def _admit_handoff(self, req, slot):
+        """Admission path for an imported-KV request
+        (:meth:`submit_handoff`): write the payload's pages into free
+        pool blocks (``paged_import_blocks`` — scales land with the
+        pages, before any claim could zero them), map them into
+        ``slot`` with the length cursor held ONE TOKEN SHORT
+        (``paged_share`` sets each imported block's refcount to 1 —
+        this slot owns them; retire frees them back to the pool), and
+        replay the final prompt token through the tail prefill — the
+        prefix-cache full-prompt-hit recipe, so the emitted first
+        token and every decode token after it are bit-identical to a
+        local prefill of the same prompt."""
+        n = int(req.prompt.shape[0])
+        cache, ids = paged.paged_import_blocks(self.cache, req.handoff)
+        assert ids is not None, \
+            "handoff import found no free blocks despite admission " \
+            "accounting (engine bug)"
+        new_len = n - 1
+        nmap = len(ids)
+        bid = np.zeros((self.maxb,), np.int32)
+        bid[:nmap] = ids
+        self.cache = self._share(
+            cache, jnp.asarray(slot, jnp.int32), jnp.asarray(bid),
+            jnp.asarray(nmap, jnp.int32),
+            jnp.asarray(new_len, jnp.int32))
+        tlen = 1
+        if self._unified:
+            width = self._prefill_width
+            tail_prog = self._prefill
+        else:
+            width = min(w for w in self._tail_buckets if tlen <= w)
+            tail_prog = self._prefill_tail
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :tlen] = req.prompt[new_len:]
+        self.cache, tok0, done0, ok = tail_prog(
+            self.params, self.cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(tlen, jnp.int32),
+            req.temperature, self._split())
+        req.prefix_hit_tokens = new_len
+        req.handoff = None                # pages are resident: drop the
+        self._m_handoff_import.inc()      # payload's host copy
+        if self.tracer is not None:
+            self.tracer.instant("handoff_import", track=f"slot{slot}",
+                                rid=req.rid, blocks=nmap,
+                                imported_tokens=new_len)
         return tok0, done0, ok, width, tlen
 
     def _register_prefix(self, req, slot, hit):

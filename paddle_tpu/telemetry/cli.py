@@ -81,17 +81,42 @@ def _grep_snapshot(snap: dict, rx) -> dict:
     return {**snap, "metrics": metrics}
 
 
+def _source_label(path: str) -> str:
+    import os
+    stem = os.path.basename(path)
+    return stem[:-len(".jsonl")] if stem.endswith(".jsonl") else stem
+
+
 def cmd_show(args) -> int:
     from paddle_tpu.telemetry.export import (console_summary,
+                                             merge_snapshots,
                                              prometheus_text)
-    rec = _load_record(args.path, args.index)
-    snap = _grep_snapshot(rec["snapshot"], _compile_grep(args.grep))
+    if len(args.path) == 1:
+        rec = _load_record(args.path[0], args.index)
+        snap = rec["snapshot"]
+        header = f"# {args.path[0]}[{args.index}] {_meta_line(rec)}"
+    else:
+        # multi-source: one record per file, merged with a worker=
+        # label derived from each filename stem — how per-worker
+        # cluster exports read as one table
+        labels = [_source_label(p) for p in args.path]
+        if len(set(labels)) != len(labels):
+            raise SystemExit(
+                f"duplicate source stems across {args.path} — rename "
+                "the files so each contributes a distinct label")
+        recs = [_load_record(p, args.index) for p in args.path]
+        snap = merge_snapshots(
+            list(zip(labels, (r["snapshot"] for r in recs))))
+        header = "\n".join(
+            f"# {p}[{args.index}] {_meta_line(r)}"
+            for p, r in zip(args.path, recs))
+    snap = _grep_snapshot(snap, _compile_grep(args.grep))
     if args.json:
         print(json.dumps(snap, indent=2, sort_keys=True))
     elif args.prom:
         sys.stdout.write(prometheus_text(snap))
     else:
-        print(f"# {args.path}[{args.index}] {_meta_line(rec)}")
+        print(header)
         print(console_summary(snap))
     return 0
 
@@ -264,7 +289,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("show", help="render one snapshot record")
-    p.add_argument("path", help="JSONL file written by append_jsonl")
+    p.add_argument("path", nargs="+",
+                   help="JSONL file(s) written by append_jsonl; "
+                        "several files merge into one snapshot with a "
+                        "worker= label per source (filename stem)")
     p.add_argument("--index", type=int, default=-1,
                    help="record index (default: last line)")
     p.add_argument("--prom", action="store_true",

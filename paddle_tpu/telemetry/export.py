@@ -35,8 +35,8 @@ from paddle_tpu.telemetry.metrics import (SCHEMA_VERSION, approx_quantile)
 
 __all__ = ["validate_snapshot", "append_jsonl", "read_jsonl",
            "prometheus_text", "console_summary", "emit_row",
-           "bench_row", "diff_snapshots", "append_trace_jsonl",
-           "run_meta"]
+           "bench_row", "diff_snapshots", "merge_snapshots",
+           "append_trace_jsonl", "run_meta"]
 
 
 # ------------------------------------------------------------- validation
@@ -317,6 +317,82 @@ def console_summary(snapshot: dict) -> str:
                 f"p95={_fmt(q[95])} p99={_fmt(q[99])} "
                 f"max={_fmt(s['max'])}")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- merge
+
+
+def merge_snapshots(snapshots, *, label: str = "worker",
+                    registry: str = "cluster") -> dict:
+    """Merge per-process registry snapshots into ONE valid snapshot by
+    LABEL AUGMENTATION: every series gains ``{label: source}``, so the
+    merged snapshot renders through every existing exporter (console,
+    Prometheus, JSONL) with the source visible and nothing summed away.
+    The cluster controller feeds this ``{worker_label: snapshot}``
+    from ``snapshot_workers()``; the CLI feeds it one snapshot per
+    ``telemetry show`` JSONL source.
+
+    ``snapshots`` is ``{source: snapshot}`` or ``[(source, snapshot),
+    ...]``.  Metrics appearing in several sources must agree on type
+    and (for histograms) bucket bounds — disagreement raises
+    ``ValueError`` naming the metric, same contract as
+    :func:`diff_snapshots`.  A series that already carries the merge
+    label with a DIFFERENT value (a re-merge of a merged snapshot
+    under a clashing source name) also fails loudly rather than
+    silently relabeling."""
+    items = list(snapshots.items()) if isinstance(snapshots, dict) \
+        else list(snapshots)
+    if not items:
+        raise ValueError("merge_snapshots: nothing to merge")
+    merged = {}
+    seen_sources = set()
+    for source, snap in items:
+        source = str(source)
+        if source in seen_sources:
+            raise ValueError(
+                f"merge_snapshots: duplicate source label {source!r}")
+        seen_sources.add(source)
+        validate_snapshot(snap)
+        for name, entry in snap["metrics"].items():
+            kind = entry["type"]
+            tgt = merged.get(name)
+            if tgt is None:
+                tgt = merged[name] = {"type": kind,
+                                      "help": entry["help"],
+                                      "series": []}
+                if kind == "histogram":
+                    tgt["bounds"] = list(entry["bounds"])
+            else:
+                if tgt["type"] != kind:
+                    raise ValueError(
+                        f"merge_snapshots: metric {name!r} is a "
+                        f"{tgt['type']} in one source but a {kind} in "
+                        f"{source!r} — these snapshots are not "
+                        "mergeable")
+                if kind == "histogram" \
+                        and tgt["bounds"] != list(entry["bounds"]):
+                    raise ValueError(
+                        f"merge_snapshots: histogram {name!r} bucket "
+                        f"bounds differ across sources "
+                        f"({tgt['bounds']} vs {entry['bounds']}) — "
+                        "fixed-bucket histograms only aggregate when "
+                        "the bounds match")
+                if not tgt["help"] and entry["help"]:
+                    tgt["help"] = entry["help"]
+            for s in entry["series"]:
+                labels = dict(s["labels"])
+                if labels.get(label, source) != source:
+                    raise ValueError(
+                        f"merge_snapshots: {name!r} series already "
+                        f"labeled {label}={labels[label]!r}, clashes "
+                        f"with source {source!r}")
+                labels[label] = source
+                row = dict(s)
+                row["labels"] = labels
+                tgt["series"].append(row)
+    return validate_snapshot({"schema_version": SCHEMA_VERSION,
+                              "registry": str(registry),
+                              "metrics": merged})
 
 
 # ----------------------------------------------------------------- diff

@@ -64,7 +64,18 @@ __all__ = ["POINTS", "ACTIONS", "Fault", "FaultError", "FaultSchedule",
 #: ``prefill``     before a prompt's prefill dispatch,
 #: ``decode_step`` before each jitted decode step,
 #: ``retire``      before a finished request's blocks are freed.
-POINTS = ("attach", "admit", "prefill", "decode_step", "retire")
+#:
+#: Process-scope points, fired by the CLUSTER CONTROLLER (scope = the
+#: worker label), both indexed per heartbeat RECEIVED from that worker
+#: — so ``at=`` counts its heartbeats, the only reproducible clock a
+#: real OS process exposes:
+#: ``proc_kill``   ``raise`` SIGKILLs the worker's actual process;
+#:                 detection still runs through the genuine
+#:                 heartbeat-timeout machinery,
+#: ``heartbeat``   ``raise`` drops the heartbeat, ``delay`` delivers
+#:                 it late (watchdog-margin chaos).
+POINTS = ("attach", "admit", "prefill", "decode_step", "retire",
+          "proc_kill", "heartbeat")
 
 #: What a fault does when it fires: ``raise`` throws :class:`FaultError`
 #: (a crash), ``delay`` sleeps ``delay_s`` (latency chaos — deadline

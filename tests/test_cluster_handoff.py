@@ -1,0 +1,466 @@
+"""KV handoff (ops export/import + engine handoff path), the cluster
+wire codec, the autoscaler policy, process-scope fault points, and the
+multi-process telemetry merge — everything in the disaggregation
+stack that tests IN-PROCESS (``tests/test_cluster.py`` covers the real
+OS-process cluster).
+
+The load-bearing pins:
+
+* the block export/import round-trip is BIT-EXACT for bf16 and int8
+  pools, per-block quantization scales included;
+* a handed-off request's greedy stream is byte-identical to a locally
+  prefilled one (the ``new_len = n - 1`` + replayed-final-token import
+  recipe), for every kv dtype x prefix-sharing combination;
+* refcount/pin accounting on the receiving engine is exact: imported
+  blocks are owned (rc 1) while live and the pool drains to empty
+  after retire;
+* ``merge_snapshots`` label-augments per-worker snapshots into ONE
+  schema-valid snapshot and refuses unmergeable inputs loudly;
+* the autoscaler is a pure function of its observation dict.
+"""
+
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry
+from paddle_tpu.cluster import wire
+from paddle_tpu.cluster.autoscaler import AutoscalePolicy
+from paddle_tpu.cluster.handoff import (attach_prefix_keys,
+                                        payload_nbytes, prefix_keys,
+                                        validate_payload)
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.serving import PagedServingEngine, QueueFull
+
+CFG = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                        num_layers=1, ffn_mult=2, max_len=48)
+ENGINE_KW = dict(num_slots=2, num_blocks=24, block_size=4,
+                 prompt_buckets=(16,), decode_kernel=False, seed=0)
+PROMPTS = [np.arange(1, 7), np.arange(3, 12), np.arange(2, 5),
+           np.arange(5, 9), np.arange(1, 4)]
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _engine(params, **over):
+    kw = {**ENGINE_KW, **over}
+    return PagedServingEngine(CFG, params, **kw)
+
+
+# ------------------------------------------------------ ops round-trip
+
+
+class TestOpsExportImport:
+
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
+    def test_export_import_round_trip_bit_exact(self, params, kv_dtype):
+        src = _engine(params, kv_dtype=kv_dtype)
+        prompt = np.arange(1, 11).astype(np.int32)
+        payload = src.prefill_to_handoff(prompt)
+        assert payload["length"] == prompt.shape[0]
+        assert payload["block_size"] == ENGINE_KW["block_size"]
+        n_blocks = -(-prompt.shape[0] // ENGINE_KW["block_size"])
+        assert payload["k_pages"][0].shape[0] == n_blocks
+        if kv_dtype == "int8":
+            assert payload["k_pages"][0].dtype == np.int8
+            assert payload["k_scales"][0].dtype == np.float32
+        else:
+            assert payload["k_scales"] == ()
+
+        dst = _engine(params, kv_dtype=kv_dtype)
+        cache, ids = paged.paged_import_blocks(dst.cache, payload)
+        assert ids is not None and ids.shape[0] == n_blocks
+        for layer, (kp, vp) in enumerate(zip(payload["k_pages"],
+                                             payload["v_pages"])):
+            np.testing.assert_array_equal(
+                np.asarray(cache.k_pages[layer])[ids], kp)
+            np.testing.assert_array_equal(
+                np.asarray(cache.v_pages[layer])[ids], vp)
+        if kv_dtype == "int8":
+            np.testing.assert_array_equal(
+                np.asarray(cache.k_scales[0])[ids],
+                payload["k_scales"][0])
+        # written blocks stay rc=0 until the caller shares them in
+        assert np.asarray(cache.refcounts).sum() == 0
+
+    def test_import_rejects_mismatched_pool(self, params):
+        src = _engine(params, kv_dtype="int8")
+        payload = src.prefill_to_handoff(np.arange(1, 7).astype(np.int32))
+        dst = _engine(params)             # unquantized pool
+        with pytest.raises(ValueError, match="kv_dtype"):
+            paged.paged_import_blocks(dst.cache, payload)
+        bad = dict(payload, block_size=8)
+        with pytest.raises(ValueError, match="block"):
+            paged.paged_import_blocks(
+                _engine(params, kv_dtype="int8").cache, bad)
+
+    def test_import_reports_pool_exhaustion(self, params):
+        src = _engine(params)
+        payload = src.prefill_to_handoff(np.arange(1, 11).astype(np.int32))
+        dst = _engine(params, num_blocks=2)   # too small for 3 blocks
+        cache, ids = paged.paged_import_blocks(dst.cache, payload)
+        assert ids is None
+        assert cache is dst.cache
+
+
+# -------------------------------------------------------------- codec
+
+
+class TestWireCodec:
+
+    def test_ndarray_round_trip_bit_exact(self):
+        msg = {"type": "handoff", "payload": {
+            "k_pages": [np.arange(24, dtype=np.int8).reshape(2, 3, 4),
+                        np.linspace(0, 1, 6).astype(np.float32)
+                        .reshape(2, 3, 1)],
+            "k_scales": [np.asarray([[1.5, 2.25]], np.float32)],
+            "prompt": np.arange(5, dtype=np.int32),
+            "length": 5}}
+        out = wire.decode_body(wire.encode_frame(msg)[4:])
+        assert out["payload"]["length"] == 5
+        for a, b in zip(msg["payload"]["k_pages"],
+                        out["payload"]["k_pages"]):
+            assert b.dtype == a.dtype and b.shape == a.shape
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(msg["payload"]["k_scales"][0],
+                                      out["payload"]["k_scales"][0])
+
+    def test_extension_dtype_round_trip_bit_exact(self):
+        # ml_dtypes' bfloat16 (the mixed-precision KV pool dtype)
+        # stringifies as opaque void via dtype.str — the codec must
+        # ship its NAME so a bf16 handoff crosses the wire bit-exactly
+        import ml_dtypes
+        a = (np.arange(12, dtype=np.float32) / 7).astype(
+            ml_dtypes.bfloat16).reshape(3, 4)
+        out = wire.decode_body(wire.encode_frame({"x": a})[4:])["x"]
+        assert out.dtype == a.dtype and out.shape == a.shape
+        np.testing.assert_array_equal(out.view(np.uint8),
+                                      a.view(np.uint8))
+
+    def test_socket_round_trip_and_eof(self):
+        a, b = socket.socketpair()
+        try:
+            wire.send_msg(a, {"seq": 1,
+                              "x": np.asarray([3, 4], np.int32)})
+            wire.send_msg(a, {"seq": 2})
+            got = wire.recv_msg(b)
+            assert got["seq"] == 1
+            np.testing.assert_array_equal(got["x"], [3, 4])
+            assert wire.recv_msg(b)["seq"] == 2
+            a.close()
+            assert wire.recv_msg(b) is None    # clean EOF
+        finally:
+            b.close()
+
+    def test_mid_frame_close_raises(self):
+        a, b = socket.socketpair()
+        try:
+            frame = wire.encode_frame({"big": "x" * 64})
+            a.sendall(frame[:10])
+            a.close()
+            with pytest.raises(ConnectionError):
+                wire.recv_msg(b)
+        finally:
+            b.close()
+
+    def test_oversized_prefix_raises(self):
+        import struct
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", wire.MAX_FRAME_BYTES + 1))
+            with pytest.raises(ValueError, match="MAX_FRAME_BYTES"):
+                wire.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# --------------------------------------------------- payload envelope
+
+
+class TestHandoffEnvelope:
+
+    def test_prefix_keys_block_granular_and_shared(self):
+        bs = 4
+        k1 = prefix_keys(np.arange(0, 10), bs)      # 2 full blocks
+        k2 = prefix_keys(np.arange(0, 12), bs)      # 3 full blocks
+        assert len(k1) == 2 and len(k2) == 3
+        assert k1 == k2[:2]                          # shared prefix
+        assert prefix_keys(np.arange(0, 3), bs) == ()
+        k3 = prefix_keys(np.concatenate([np.arange(0, 4),
+                                         np.asarray([99] * 8)]), bs)
+        assert k3[0] == k1[0] and k3[1] != k1[1]
+
+    def test_attach_and_nbytes_and_validate(self, params):
+        src = _engine(params, kv_dtype="int8")
+        prompt = np.arange(1, 11).astype(np.int32)
+        payload = attach_prefix_keys(src.prefill_to_handoff(prompt))
+        assert payload["prefix_keys"] == list(
+            prefix_keys(prompt, ENGINE_KW["block_size"]))
+        expect = prompt.nbytes + sum(
+            np.asarray(a).nbytes for key in
+            ("k_pages", "v_pages", "k_scales", "v_scales")
+            for a in payload[key])
+        assert payload_nbytes(payload) == expect
+        assert validate_payload(payload) is payload
+        for missing in ("prompt", "k_pages", "kv_dtype"):
+            bad = {k: v for k, v in payload.items() if k != missing}
+            with pytest.raises(ValueError, match=missing):
+                validate_payload(bad)
+        with pytest.raises(ValueError, match="length"):
+            validate_payload(dict(payload, length=3))
+        with pytest.raises(ValueError, match="too few"):
+            validate_payload(dict(
+                payload, prompt=np.arange(64, dtype=np.int32),
+                length=64))
+
+
+# ------------------------------------------------- engine handoff path
+
+
+class TestEngineHandoff:
+
+    @pytest.mark.parametrize("kv_dtype,prefix",
+                             [(None, False), ("int8", False),
+                              (None, True), ("int8", True)])
+    def test_handoff_streams_bit_identical(self, params, kv_dtype,
+                                           prefix):
+        base_eng = _engine(params, kv_dtype=kv_dtype,
+                           prefix_cache=prefix)
+        rids = [base_eng.submit(p.astype(np.int32), max_new=MAX_NEW,
+                                temperature=0.0) for p in PROMPTS]
+        base = base_eng.run()
+
+        pre = _engine(params, kv_dtype=kv_dtype, prefix_cache=prefix)
+        dec = _engine(params, kv_dtype=kv_dtype, prefix_cache=prefix)
+        hrids = []
+        for p in PROMPTS:
+            payload = pre.prefill_to_handoff(p.astype(np.int32))
+            hrids.append(dec.submit_handoff(payload, max_new=MAX_NEW))
+        got = dec.run()
+        for b, h in zip(rids, hrids):
+            np.testing.assert_array_equal(base[b], got[h])
+        # handoff admission must not grow the compile set
+        compiles = dec.compile_counts()
+        assert compiles["step"] == 1 and compiles["prefill"] == 1
+        assert compiles.get("share", 0) == 0
+
+    def test_refcounts_owned_while_live_and_drain_after(self, params):
+        pre = _engine(params)
+        dec = _engine(params)
+        prompt = np.arange(1, 11).astype(np.int32)   # 3 blocks of 4
+        payload = pre.prefill_to_handoff(prompt)
+        dec.submit_handoff(payload, max_new=MAX_NEW)
+        dec.step()                        # admission + first step
+        rc = np.asarray(dec.cache.refcounts)
+        used = int(np.asarray(dec.cache.blocks_used)[0])
+        assert used >= 3                  # imported blocks are mapped
+        # every mapped block owned exactly once, nothing pinned twice
+        table = np.asarray(dec.cache.block_tables)[0, :used]
+        np.testing.assert_array_equal(rc[table], 1)
+        assert rc.sum() == used
+        dec.run()
+        assert np.asarray(dec.cache.refcounts).sum() == 0
+        # the exporting engine freed its prefill slot immediately
+        assert np.asarray(pre.cache.refcounts).sum() == 0
+
+    def test_submit_handoff_validation_and_backpressure(self, params):
+        pre = _engine(params, kv_dtype="int8")
+        payload = pre.prefill_to_handoff(np.arange(1, 7).astype(np.int32))
+        with pytest.raises(Exception, match="kv_dtype"):
+            _engine(params).submit_handoff(payload, max_new=4)
+        dec = _engine(params, kv_dtype="int8", max_queue=1)
+        dec.submit(np.asarray([1, 2], np.int32), max_new=4)
+        with pytest.raises(QueueFull):
+            dec.submit_handoff(payload, max_new=4)
+
+    def test_handoff_counters_observe(self, params):
+        reg = telemetry.MetricsRegistry(name="handoff-test")
+        pre = _engine(params, metrics=reg)
+        dec = _engine(params,
+                      metrics=telemetry.MetricsRegistry(name="d"))
+        payload = pre.prefill_to_handoff(np.arange(1, 7).astype(np.int32))
+        dec.submit_handoff(payload, max_new=4)
+        dec.run()
+        exp = reg.snapshot()["metrics"][
+            "serving_handoff_exports_total"]["series"]
+        assert exp and exp[0]["value"] == 1
+        imp = dec.metrics.snapshot()["metrics"][
+            "serving_handoff_imports_total"]["series"]
+        assert imp and imp[0]["value"] == 1
+
+
+# -------------------------------------------------------- autoscaler
+
+
+def _obs(queue_depth, wait_p50, workers):
+    return {"queue_depth": queue_depth,
+            "queue_wait_p50_s": wait_p50, "ttft_p95_s": None,
+            "workers": workers}
+
+
+def _w(label, active=0, idle_s=0.0, up=True):
+    return {"label": label, "up": up, "active": active,
+            "idle_s": idle_s}
+
+
+class TestAutoscalePolicy:
+
+    def test_grows_under_queue_pressure_to_max(self):
+        pol = AutoscalePolicy(max_workers={"decode": 2},
+                              grow_queue_wait_s=0.1, cooldown_s=0.0)
+        obs = _obs(4, 0.5, {"prefill": [_w("prefill0")],
+                            "decode": [_w("decode0", active=2)]})
+        acts = pol.decide(10.0, obs)
+        assert ("grow", "decode", None) in acts
+        obs["workers"]["decode"].append(_w("decode1", active=2))
+        obs["workers"]["prefill"].append(_w("prefill1"))
+        assert pol.decide(11.0, obs) == []    # both roles at max
+
+    def test_retires_longest_idle_above_min(self):
+        pol = AutoscalePolicy(retire_idle_s=1.0, cooldown_s=0.0)
+        obs = _obs(0, None, {
+            "prefill": [_w("prefill0", idle_s=9.0)],
+            "decode": [_w("decode0", idle_s=5.0),
+                       _w("decode1", idle_s=7.0)]})
+        acts = pol.decide(10.0, obs)
+        # prefill at min stays; decode sheds its longest-idle worker
+        assert acts == [("retire", "decode", "decode1")]
+
+    def test_never_retires_active_or_pressured(self):
+        pol = AutoscalePolicy(retire_idle_s=1.0, cooldown_s=0.0)
+        obs = _obs(0, None, {"prefill": [_w("prefill0")],
+                             "decode": [_w("decode0", idle_s=9.0),
+                                        _w("decode1", active=1,
+                                           idle_s=9.0)]})
+        assert pol.decide(10.0, obs) == [("retire", "decode",
+                                          "decode0")]
+        obs = _obs(3, 0.0, {"prefill": [_w("prefill0")],
+                            "decode": [_w("decode0", idle_s=9.0),
+                                       _w("decode1", idle_s=9.0)]})
+        assert pol.decide(20.0, obs) == []   # queued work: no retire
+
+    def test_cooldown_damps_flapping(self):
+        pol = AutoscalePolicy(grow_queue_wait_s=0.1, cooldown_s=5.0)
+        obs = _obs(4, 1.0, {"prefill": [_w("prefill0")],
+                            "decode": [_w("decode0", active=2)]})
+        assert pol.decide(10.0, obs)
+        assert pol.decide(12.0, obs) == []     # cooling
+        assert pol.decide(16.0, obs)           # cooldown expired
+
+    def test_min_above_max_rejected(self):
+        with pytest.raises(ValueError, match="min_workers"):
+            AutoscalePolicy(min_workers={"decode": 5},
+                            max_workers={"decode": 2})
+
+
+# -------------------------------------------- process-scope fault points
+
+
+class TestProcessFaultPoints:
+
+    def test_points_registered(self):
+        from paddle_tpu.testing.faults import POINTS, Fault
+        assert "proc_kill" in POINTS and "heartbeat" in POINTS
+        Fault("proc_kill", 3, "raise", scope="decode0")
+        Fault("heartbeat", 2, "delay", delay_s=0.01)
+
+    def test_seeded_schedules_cover_process_points(self):
+        from paddle_tpu.testing.faults import FaultSchedule
+        sched = FaultSchedule.seeded(
+            7, n_faults=4, points=("proc_kill", "heartbeat"),
+            scopes=("decode0", "prefill0"),
+            actions=("raise", "delay"))
+        assert len(sched) >= 1
+        assert all(f.point in ("proc_kill", "heartbeat")
+                   for f in sched)
+        replay = FaultSchedule.seeded(
+            7, n_faults=4, points=("proc_kill", "heartbeat"),
+            scopes=("decode0", "prefill0"),
+            actions=("raise", "delay"))
+        assert repr(replay) == repr(sched)
+
+    def test_fire_counts_per_worker_scope(self):
+        from paddle_tpu.testing.faults import (Fault, FaultError,
+                                               FaultInjector,
+                                               FaultSchedule)
+        inj = FaultInjector(FaultSchedule(
+            [Fault("proc_kill", 2, "raise", scope="decode0")]))
+        inj.fire("proc_kill", scope="decode0")
+        inj.fire("proc_kill", scope="prefill0")   # other scope: no-op
+        with pytest.raises(FaultError):
+            inj.fire("proc_kill", scope="decode0")
+        assert inj.counts()[("decode0", "proc_kill")] == 2
+
+
+# ------------------------------------------------------ telemetry merge
+
+
+def _mini_registry(name, n):
+    reg = telemetry.MetricsRegistry(name=name)
+    reg.counter("reqs_total", help="h").inc(n, kind="x")
+    reg.histogram("lat_seconds", help="h").observe(0.01 * n)
+    reg.gauge("depth", help="h").set(float(n))
+    return reg
+
+
+class TestMergeSnapshots:
+
+    def test_label_augmented_merge_validates(self):
+        from paddle_tpu.telemetry.export import (merge_snapshots,
+                                                 validate_snapshot)
+        merged = merge_snapshots({
+            "decode0": _mini_registry("w0", 1).snapshot(),
+            "decode1": _mini_registry("w1", 3).snapshot()})
+        validate_snapshot(merged)
+        series = merged["metrics"]["reqs_total"]["series"]
+        by_worker = {s["labels"]["worker"]: s["value"] for s in series}
+        assert by_worker == {"decode0": 1.0, "decode1": 3.0}
+        assert all(s["labels"]["kind"] == "x" for s in series)
+        hist = merged["metrics"]["lat_seconds"]["series"]
+        assert {s["labels"]["worker"] for s in hist} \
+            == {"decode0", "decode1"}
+
+    def test_unmergeable_inputs_fail_loudly(self):
+        from paddle_tpu.telemetry.export import merge_snapshots
+        a = _mini_registry("a", 1).snapshot()
+        with pytest.raises(ValueError, match="duplicate source"):
+            merge_snapshots([("w", a), ("w", a)])
+        b = telemetry.MetricsRegistry(name="b")
+        b.gauge("reqs_total", help="h").set(1.0)
+        with pytest.raises(ValueError, match="not mergeable"):
+            merge_snapshots([("w0", a), ("w1", b.snapshot())])
+        c = telemetry.MetricsRegistry(name="c")
+        c.histogram("lat_seconds", help="h",
+                    buckets=(1.0, 2.0)).observe(0.5)
+        with pytest.raises(ValueError, match="bounds"):
+            merge_snapshots([("w0", a), ("w1", c.snapshot())])
+        with pytest.raises(ValueError, match="nothing to merge"):
+            merge_snapshots({})
+
+    def test_cli_show_merges_multiple_sources(self, tmp_path, capsys):
+        from paddle_tpu.telemetry.cli import main
+        from paddle_tpu.telemetry.export import append_jsonl
+        p0 = str(tmp_path / "decode0.jsonl")
+        p1 = str(tmp_path / "decode1.jsonl")
+        append_jsonl(p0, _mini_registry("w0", 2).snapshot(), ts=1.0)
+        append_jsonl(p1, _mini_registry("w1", 5).snapshot(), ts=1.0)
+        assert main(["show", p0, p1]) == 0
+        out = capsys.readouterr().out
+        assert "worker=decode0" in out and "worker=decode1" in out
+        assert main(["show", p0, p1, "--prom"]) == 0
+        out = capsys.readouterr().out
+        assert 'worker="decode0"' in out and 'worker="decode1"' in out
+        with pytest.raises(SystemExit, match="duplicate source"):
+            main(["show", p0, p0])
