@@ -73,6 +73,112 @@ def _kv_dtype_extras(args, cfg, params):
         kv_max_logit_divergence=round(div, 5))
 
 
+def _mesh_extras(args, cfg):
+    """Row keys for ``--mesh N``: the per-chip capacity story.
+
+    ``kv_pool_bytes=`` is a PER-CHIP budget, so the win is denominated
+    in blocks-per-chip: the same byte budget holds N× the blocks when
+    each chip carries only ``num_heads/N`` of every block
+    (``paged_pool_bytes(shards=N)``).  Rides next to whatever mode the
+    row times, and stacks with ``--kv-dtype int8`` (per-chip bytes
+    divide the already-quantized block)."""
+    if not args.mesh:
+        return {}
+    import jax.numpy as jnp
+    from paddle_tpu.core.dtypes import get_policy
+    from paddle_tpu.ops import paged_attention as paged
+
+    kvdt = args.kv_dtype_resolved or get_policy().compute_dtype
+    kw = dict(num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+              head_dim=cfg.dim // cfg.num_heads,
+              block_size=args.block_size, kv_dtype=kvdt)
+    bb1 = paged.paged_pool_bytes(1, **kw)
+    bbN = paged.paged_pool_bytes(1, shards=args.mesh, **kw)
+    per_req = -(-(args.prompt + args.steps) // args.block_size)
+    pool = args.pool_blocks or \
+        args.batch * -(-cfg.max_len // args.block_size)
+    budget = pool * bb1            # the 1-device pool as per-chip budget
+    return dict(
+        mesh_devices=args.mesh,
+        kv_block_bytes_per_chip=bbN,
+        capacity_requests_1dev=(budget // bb1) // per_req,
+        capacity_requests_per_chip_budget=(budget // bbN) // per_req)
+
+
+def _bench_mesh(args, cfg, params, jax):
+    """``--mesh N`` (no mode flag): head-sharded engine benchmark.
+
+    Serves one greedy burst twice IN THE SAME PROCESS — through a
+    single-device engine and through the same engine with its KV block
+    pools sharded over an N-device ``mp`` mesh (``mesh=N``) — asserts
+    the streams bit-identical (sharding is a layout, not a numeric),
+    and reports ms/token + TTFT p50/p95 next to the 1-device
+    baseline's, plus the per-chip capacity keys from
+    :func:`_mesh_extras`.  On CPU run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine
+
+    plen, steps, bs = args.prompt, args.steps, args.block_size
+    slots = min(args.batch, 8)
+    per_req = -(-(plen + steps) // bs)
+    pool = args.pool_blocks or slots * per_req + 4
+    kern = {"auto": None, "on": True, "off": False}[args.paged_kernel]
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, args.vocab, plen).astype(np.int32)
+               for _ in range(args.batch)]
+
+    def drive(mesh):
+        reg = telemetry.MetricsRegistry(f"mesh_{mesh or 1}dev")
+        eng = PagedServingEngine(
+            cfg, params, num_slots=slots, num_blocks=pool,
+            block_size=bs, prompt_buckets=(plen,), decode_kernel=kern,
+            kv_dtype=args.kv_dtype_resolved, metrics=reg, seed=0,
+            mesh=mesh)
+        eng.submit(prompts[0][:8], max_new=2)
+        eng.run()                    # warm: compile prefill + step
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new=steps) for p in prompts]
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        ttft = reg.get("serving_ttft_seconds").summary()
+        return (eng, {r: list(map(int, out[r])) for r in rids},
+                wall, ttft)
+
+    _, base_out, base_wall, base_ttft = drive(None)
+    eng, out, wall, ttft = drive(args.mesh)
+    assert out == base_out, \
+        "greedy head-sharded streams diverged from single-device"
+    gen = max(sum(len(v) for v in out.values()), 1)
+    rep = eng.hbm_report()
+
+    def _ms(v):
+        return round(v * 1e3, 3) if v is not None else None
+
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
+               f"prompt{plen} mesh{args.mesh}",
+        value=round(wall * 1e3 / gen, 3),
+        unit="ms",                          # sharded ms per token
+        backend=jax.default_backend(),
+        decoder="engine",
+        compiles=eng.compile_counts(),      # {'step': 1, 'prefill': 1}
+        paged_kernel=bool(eng.decode_kernel),
+        block_size=bs,
+        pool_blocks=pool,
+        pool_mib_per_chip=round(rep["pool_bytes_per_shard"] / 2**20, 2),
+        pool_mib_total=round(rep["pool_bytes_total"] / 2**20, 2),
+        ttft_ms_p50=_ms(ttft["p50"]),
+        ttft_ms_p95=_ms(ttft["p95"]),
+        baseline_ttft_ms_p50=_ms(base_ttft["p50"]),
+        baseline_ttft_ms_p95=_ms(base_ttft["p95"]),
+        baseline_ms_per_token=round(base_wall * 1e3 / gen, 3),
+        streams_match=True,                 # asserted above
+        tokens_per_s=round(gen / wall, 1),
+        **_mesh_extras(args, cfg),
+        **_kv_dtype_extras(args, cfg, params))
+
+
 def _bench_shared_prefix(args, cfg, params, jax):
     """``--shared-prefix N``: engine-level prefix-cache benchmark.
 
@@ -99,7 +205,8 @@ def _bench_shared_prefix(args, cfg, params, jax):
         prompt_buckets=(plen + sfx,), prefix_cache=True,
         decode_kernel={"auto": None, "on": True,
                        "off": False}[args.paged_kernel],
-        kv_dtype=args.kv_dtype_resolved, tracer=tracer, seed=0)
+        kv_dtype=args.kv_dtype_resolved, tracer=tracer, seed=0,
+        mesh=args.mesh or None)
 
     def burst(prefix, count, max_new):
         return [eng.submit(np.concatenate(
@@ -155,6 +262,7 @@ def _bench_shared_prefix(args, cfg, params, jax):
         prefill_hit_ms=round(
             med([pfill[r][0] for r in hits]) * 1e3, 3),
         tokens_per_s=round(gen / wall, 1),
+        **_mesh_extras(args, cfg),
         **_kv_dtype_extras(args, cfg, params))
 
 
@@ -190,7 +298,8 @@ def _bench_spec(args, cfg, params, jax):
             cfg, params, num_slots=slots, num_blocks=pool,
             block_size=bs, prompt_buckets=(plen,),
             decode_kernel=kern, spec=spec,
-            kv_dtype=args.kv_dtype_resolved, seed=0)
+            kv_dtype=args.kv_dtype_resolved, seed=0,
+            mesh=args.mesh or None)
         for p in prompts[:2]:     # warm-up: compile every program
             eng.submit(p, max_new=4)
         eng.run()
@@ -236,6 +345,7 @@ def _bench_spec(args, cfg, params, jax):
         baseline_ms_per_token=round(base_wall * 1e3 / base_gen, 3),
         streams_match=ident,
         tokens_per_s=round(gen / wall, 1),
+        **_mesh_extras(args, cfg),
         **_kv_dtype_extras(args, cfg, params))
 
 
@@ -287,7 +397,8 @@ def _bench_mixed_batch(args, cfg, params, jax):
             cfg, params, num_slots=slots, num_blocks=pool,
             block_size=bs, prompt_buckets=(short, plen),
             decode_kernel=kern, spec=spec, unified_step=unified,
-            kv_dtype=args.kv_dtype_resolved, metrics=reg, seed=0)
+            kv_dtype=args.kv_dtype_resolved, metrics=reg, seed=0,
+            mesh=args.mesh or None)
         # warm-up: one short + one long admission compiles every
         # program both modes will touch, so the measured burst is
         # compile-free in each
@@ -371,6 +482,7 @@ def _bench_mixed_batch(args, cfg, params, jax):
         ragged_dispatches=disp_u,
         streams_match=ident,
         tokens_per_s=round(gen / wall_u, 1),
+        **_mesh_extras(args, cfg),
         **_kv_dtype_extras(args, cfg, params))
 
 
@@ -655,6 +767,21 @@ def main():
                          "+ decode_stall_ms for both, plus the "
                          "ragged-kernel dispatch counts; requires "
                          "--paged")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="shard the paged KV block pools over an "
+                         "N-device mp mesh (serving.py mesh= knob: "
+                         "pools split on the KV-head axis, bookkeeping "
+                         "replicated, one all-gather combine per "
+                         "layer).  Alone it is its own row — sharded "
+                         "ms/token + TTFT next to a 1-device baseline "
+                         "from the same process (greedy streams "
+                         "asserted bit-identical) plus the per-chip "
+                         "capacity keys; composes with --kv-dtype/"
+                         "--spec/--shared-prefix/--mixed-batch, whose "
+                         "rows gain mesh_devices + per-chip capacity.  "
+                         "On CPU run under XLA_FLAGS=--xla_force_host_"
+                         "platform_device_count=N; requires --paged "
+                         "and num_heads divisible by N")
     ap.add_argument("--draft-layers", type=int, default=1, metavar="N",
                     help="layers kept by the truncated-layer draft "
                          "(with --spec); N == --layers is the "
@@ -753,6 +880,16 @@ def main():
                  "--shared-prefix/--spec/--mixed-batch")
     if args.prefill_workers < 1 or args.decode_workers < 1:
         ap.error("--prefill-workers/--decode-workers must be >= 1")
+    if args.mesh:
+        if args.mesh < 2:
+            ap.error("--mesh needs N >= 2 devices (1 is the baseline "
+                     "every mesh row already carries)")
+        if not args.paged:
+            ap.error("--mesh requires --paged (the head-sharded pools "
+                     "live in the paged KV cache)")
+        if args.frontend or args.disagg:
+            ap.error("--mesh does not compose with --frontend/--disagg "
+                     "yet (their engines live in other processes)")
 
     import paddle_tpu  # noqa: F401  (env platform contract)
     from paddle_tpu.utils.attach import attach_probe_with_retry
@@ -848,6 +985,15 @@ def main():
             return
         if args.shared_prefix:
             row = _bench_shared_prefix(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
+            return
+        if args.mesh:
+            row = _bench_mesh(args, cfg, params, jax)
             from paddle_tpu import telemetry
             if args.telemetry_out:
                 telemetry.append_jsonl(

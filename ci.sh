@@ -45,9 +45,16 @@ echo "== tpu-lint: jaxpr + SPMD self-check over registered entrypoints =="
 # TWICE — XLA gather form and the kernel-selected -kernel twins
 # (Pallas interpret mode; kernel bodies are opaque to the jaxpr rules,
 # and the decode-loop attention gathers must be gone, zero new
-# suppressions).  The -kernel shard recipes stay replicated-under-mesh:
-# the slot-shared-pool rationale is unchanged and GSPMD cannot
-# partition a pallas_call.  Three gates in one invocation:
+# suppressions).  The paged STEP entrypoints (serve-step, -kernel,
+# engine-step-ragged, -int8) lint under REAL head-sharded ("mp", 2)
+# recipes — pools split on the KV-head axis, bookkeeping replicated —
+# and their decode_collectives contract is exact-set both ways: any
+# collective beyond the declared attention-output all-gather errors,
+# AND an elided all-gather errors (the sharding stopped being
+# exercised).  The -kernel twins shard the same way: under explicit
+# shard_map each device runs its own pallas_call on its local head
+# slice, so GSPMD is never asked to partition the kernel.  Three
+# gates in one invocation:
 #   --budgets      per-shard peak-HBM estimate vs analysis/budgets.json
 #   --warn-ratchet post-suppression warn count can only go DOWN
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
@@ -74,8 +81,15 @@ echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead
 # fast path byte-for-byte the direct engine), and re-lints the
 # instrumented entrypoints incl. the health-instrumented train step
 # and the fault-injection engine twin — host-callback-in-loop must
-# report zero findings.
-JAX_PLATFORMS=cpu python -m paddle_tpu.telemetry.selfcheck
+# report zero findings.  XLA_FLAGS forces a 2-device CPU platform so
+# the mesh smoke runs for real (a burst through a head-sharded engine:
+# greedy streams bit-identical to single-device, 0 kernel fallbacks,
+# step HLO carrying exactly the per-layer all-gather combine and no
+# other collective, pool gauge == hbm_report per-shard x shards);
+# without >=2 devices that check self-reports SKIPPED — the flag here
+# guarantees it runs for real in CI.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python -m paddle_tpu.telemetry.selfcheck
 
 echo "== cluster gate: disaggregated prefill/decode over real processes =="
 # Spawns 1 prefill + 1 decode worker as real OS processes on the CPU

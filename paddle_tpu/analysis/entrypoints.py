@@ -24,13 +24,20 @@ Entrypoints that ship with a mesh layout also carry a
 :class:`~paddle_tpu.analysis.shard_rules.ShardRecipe` — then
 ``--self-check`` additionally lowers them under a real >=2-device CPU
 mesh and runs the SPMD rule family (shard_rules.py), and ``--memory``
-reports per-shard bytes under that mesh.  The shipped recipes are
-DATA-PARALLEL on purpose: batch/slot-major args shard on ``dp``
+reports per-shard bytes under that mesh.  The trainer/dense-serve
+recipes are DATA-PARALLEL: batch/slot-major args shard on ``dp``
 (declared by the serving builders via ``_lint_batch_args`` /
-``_decode_slot_args``), params replicate.  A tensor-parallel recipe
-would put a per-layer all-reduce inside the decode while body — the
-exact program shape ``collective-in-decode`` exists to reject.
-Recipe-less entrypoints lint single-device exactly as before.
+``_decode_slot_args``), params replicate — a naive tensor-parallel
+recipe would put a per-layer all-reduce inside the decode while body,
+the exact shape ``collective-in-decode`` exists to reject.  The
+mesh-native paged step entrypoints (``paged-serve-step*``,
+``paged-engine-step-*``) instead carry HEAD-SHARDED recipes matching
+serving.py's ``mesh=`` knob: the KV block pools split on the head
+axis, bookkeeping replicates, and ``decode_collectives`` contracts
+the decode body to exactly the attention-output all-gather — the rule
+fails on any extra collective AND on the declared combine going
+missing.  Recipe-less entrypoints lint single-device exactly as
+before.
 """
 
 from __future__ import annotations
@@ -107,6 +114,34 @@ def _dp_recipe(n_args: int, sharded_args, note: str):
     return ShardRecipe(axes=(("dp", 2),), arg_specs=specs, note=note)
 
 
+def _paged_mp_recipe(n_args: int, cache_args, note: str):
+    """Two-device HEAD-SHARDED ShardRecipe for the mesh-native paged
+    step (serving.py ``mesh=``): the listed cache args carry the
+    ``paged_cache_shardings`` layout (pools on the head axis, scales
+    following, bookkeeping replicated), everything else replicates,
+    and the decode body is contracted to EXACTLY the attention-output
+    all-gather — collective-in-decode now fails on an extra collective
+    AND on the combine going missing."""
+    from paddle_tpu.analysis.shard_rules import ShardRecipe
+    from paddle_tpu.parallel.sharding import paged_cache_shardings
+
+    def cache_spec(arg, mesh):
+        return paged_cache_shardings(arg, mesh, "mp")
+
+    specs = tuple(cache_spec if i in tuple(cache_args) else None
+                  for i in range(n_args))
+    return ShardRecipe(axes=(("mp", 2),), arg_specs=specs, note=note,
+                       decode_collectives=("all-gather",))
+
+
+def _mesh_or_none(n: int = 2):
+    """Serving ``mesh=`` knob for the sharded entrypoints: ``n`` when
+    the process has the devices, else None so the factory still builds
+    (shard_check then reports the device shortfall instead of the
+    factory crashing the whole self-check)."""
+    return n if len(jax.devices()) >= n else None
+
+
 @register_entrypoint("trainer-train-step")
 def _trainer_train_step() -> LintTarget:
     tr = _tiny_trainer()
@@ -178,23 +213,27 @@ def _dense_serve_step() -> LintTarget:
 @register_entrypoint("paged-serve-step")
 def _paged_serve_step() -> LintTarget:
     from paddle_tpu.serving import paged_serve_builder
-    serve = paged_serve_builder(_tiny_cfg(), block_size=8)
+    # The paged loop cannot dp-shard its batch (the block pool is
+    # SLOT-SHARED, [nb, bs, h, hd] with no batch dim — row-sharded
+    # append/reserve scatters would all-gather the pool every
+    # iteration; shard-check proved 11 collective-in-decode errors
+    # under a dp recipe).  It shards on the HEAD axis instead: the
+    # builder's mesh= knob runs append/attend per head-shard under
+    # shard_map, every input replicates, the in-jit pool is pinned to
+    # the head-sharded layout, and the ONLY collective in the while
+    # body is the per-layer attention-output all-gather the recipe
+    # declares.
+    serve = paged_serve_builder(_tiny_cfg(), block_size=8,
+                                mesh=_mesh_or_none())
     prompts = jnp.zeros((2, 4), jnp.int32)
-    # The paged loop cannot dp-shard its batch yet: the block pool is
-    # SLOT-SHARED ([nb, bs, h, hd], no batch dim), so row-sharded
-    # append/reserve scatters force an all-gather of the pool every
-    # iteration — shard-check proves it (11 collective-in-decode
-    # errors under a dp recipe).  Until the ROADMAP multi-chip pool
-    # item (per-shard pool accounting) lands, the honest contract is
-    # replicated-under-mesh: the gate still compiles the SPMD program
-    # and proves no collective sneaks into the loop.
     return LintTarget(
         "paged-serve-step", serve._jit,
         (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
          0.0, None, None, None, None, None),
-        recipe=_dp_recipe(9, (), "replicated under the mesh — see "
-                          "factory comment; dp blocked on the "
-                          "multi-chip pool ROADMAP item"))
+        recipe=_paged_mp_recipe(9, (), "head-sharded pool built "
+                                "in-jit (inputs replicate); decode "
+                                "body carries exactly the attention-"
+                                "output all-gather"))
 
 
 @register_entrypoint("paged-engine-decode")
@@ -277,27 +316,30 @@ def _paged_engine_decode_faults() -> LintTarget:
 # traced jaxpr carries the pallas_call eqn either way, which is what
 # the gate is for: the kernel body must stay opaque to the XLA-HBM
 # rules and the attention gathers must be GONE from the decode loop,
-# with zero new suppressions).  Both recipes are replicated-under-mesh:
-# the paged-serve rationale above still holds unchanged, and
-# additionally GSPMD cannot partition a pallas_call — the same reason
-# the Trainer traces under fusion_disabled() when sharding rules are
-# active — so a sharded kernel recipe is the multi-chip pool item's
-# problem, not this gate's.
+# with zero new suppressions).  The serve twin shards like
+# paged-serve-step: GSPMD cannot AUTO-partition a pallas_call, but the
+# mesh path never asks it to — under the explicit shard_map each
+# device runs its own pallas_call over its local head slice, so the
+# kernel recipe flips to head-sharded with it.  The legacy engine
+# decode twin below stays replicated (the legacy multi-program mode
+# has no mesh knob; the unified step twins carry the sharded recipe).
 
 
 @register_entrypoint("paged-serve-step-kernel")
 def _paged_serve_step_kernel() -> LintTarget:
     from paddle_tpu.serving import paged_serve_builder
     serve = paged_serve_builder(_tiny_cfg(), block_size=8,
-                                decode_kernel=True)
+                                decode_kernel=True,
+                                mesh=_mesh_or_none())
     prompts = jnp.zeros((2, 4), jnp.int32)
     return LintTarget(
         "paged-serve-step-kernel", serve._jit,
         (_tiny_lm_params(), prompts, jnp.asarray(6, jnp.int32),
          0.0, None, None, None, None, None),
-        recipe=_dp_recipe(9, (), "replicated under the mesh — "
-                          "paged-serve-step rationale, plus GSPMD "
-                          "cannot partition a pallas_call"))
+        recipe=_paged_mp_recipe(9, (), "head-sharded like "
+                                "paged-serve-step; each device runs "
+                                "its own pallas_call on local heads "
+                                "inside shard_map"))
 
 
 @register_entrypoint("paged-engine-decode-kernel")
@@ -360,17 +402,18 @@ def _paged_engine_step_ragged() -> LintTarget:
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
                              prompt_buckets=(8,),
-                             spec=SpecConfig(k=2, draft_layers=1))
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             mesh=_mesh_or_none())
     S, W = eng.S, eng.step_width
     return LintTarget(
         "paged-engine-step-ragged", eng._step,
         (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
          jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
          jnp.zeros((S,), bool), jax.random.key(0)),
-        recipe=_dp_recipe(7, eng._decode_slot_args,
-                          "dp over slot-major step inputs (toks/qlens/"
-                          "temps/done); pool + block tables replicated "
-                          "exactly as the legacy decode twin"))
+        recipe=_paged_mp_recipe(
+            7, (1,), "head-sharded KV pool (paged_cache_shardings on "
+            "the cache arg); params + slot vectors replicate; exactly "
+            "the attention-output all-gather in the step"))
 
 
 @register_entrypoint("paged-engine-step-int8")
@@ -390,14 +433,15 @@ def _paged_engine_step_int8() -> LintTarget:
     eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
                              num_slots=2, num_blocks=8, block_size=8,
                              prompt_buckets=(8,), kv_dtype="int8",
-                             spec=SpecConfig(k=2, draft_layers=1))
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             mesh=_mesh_or_none())
     S, W = eng.S, eng.step_width
     return LintTarget(
         "paged-engine-step-int8", eng._step,
         (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
          jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
          jnp.zeros((S,), bool), jax.random.key(0)),
-        recipe=_dp_recipe(7, eng._decode_slot_args,
-                          "dp over slot-major step inputs; pool, scale "
-                          "tables and block tables replicated exactly "
-                          "as the ragged twin"))
+        recipe=_paged_mp_recipe(
+            7, (1,), "head-sharded int8 pool + per-block scales "
+            "(scales follow their pages' head split); same single "
+            "all-gather contract as the bf16 ragged twin"))

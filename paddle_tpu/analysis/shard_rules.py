@@ -78,10 +78,21 @@ class ShardRecipe:
     * a callable ``(arg, mesh) -> sharding pytree`` for per-leaf
       layouts (e.g. :func:`paddle_tpu.parallel.sharding.
       shardings_like` with a rule table).
+
+    ``decode_collectives``: the collective kinds the decode body is
+    CONTRACTED to carry (``()`` = none allowed, the default).  With
+    kinds declared, collective-in-decode flips from "no collectives"
+    to an exact-set assertion BOTH ways: a kind outside the list is
+    the usual hot-path error, and a declared kind MISSING from the
+    compiled program is also an error — the intended combine got
+    elided, so the sharding is not doing what the recipe claims
+    (e.g. the head-sharded paged step's attention-output all-gather).
+    ``-start`` async forms count as their base kind.
     """
     axes: Tuple[Tuple[str, int], ...]
     arg_specs: Tuple[Any, ...] = ()
     note: str = ""
+    decode_collectives: Tuple[str, ...] = ()
 
     @property
     def num_devices(self) -> int:
@@ -282,7 +293,21 @@ class CollectiveInDecodeRule(ShardRule):
     rule_id = "collective-in-decode"
     severity = "error"
     doc = ("GSPMD collective (all-gather/all-reduce/all-to-all/...) "
-           "inside a while/scan decode body")
+           "inside a while/scan decode body; with "
+           "recipe.decode_collectives declared, an exact-set check — "
+           "extra kinds AND missing declared kinds both fail")
+
+    @staticmethod
+    def _base_kind(op: str) -> str:
+        return op[:-len("-start")] if op.endswith("-start") else op
+
+    @staticmethod
+    def _line_meta(line: str):
+        meta = _META_RE.search(line)
+        op_name = meta.group(1) if meta else ""
+        file = meta.group(2) if meta and meta.group(2) else None
+        lineno = int(meta.group(3)) if meta and meta.group(3) else None
+        return op_name, file, lineno
 
     def run(self, sa, ctx):
         if not sa.hlo:
@@ -294,16 +319,56 @@ class CollectiveInDecodeRule(ShardRule):
                 if _hlo_opcode(line) == "while":
                     loop_comps |= _transitive(
                         comps, _called_computations(line))
+        allowed = {self._base_kind(k)
+                   for k in sa.recipe.decode_collectives}
+        if allowed:
+            # declared-combine mode: the compiled step program is
+            # CONTRACTED to carry exactly these kinds.  Scan the WHOLE
+            # module, not just while bodies: the engine's fixed-shape
+            # step fn has no decode while (the host loop drives it),
+            # and incidental whiles (sort/RNG utilities) must not
+            # shrink the region the exact-set check covers.
+            scan = set(comps)
+            found: Dict[str, Tuple[str, str]] = {}
+            for name in sorted(scan):
+                for line in comps.get(name, ()):
+                    op = _hlo_opcode(line)
+                    if op in COLLECTIVE_OPS:
+                        found.setdefault(self._base_kind(op),
+                                         (name, line))
+            for base in sorted(set(found) - allowed):
+                name, line = found[base]
+                op_name, file, lineno = self._line_meta(line)
+                ctx.report(
+                    self, f"{sa.target.name}/spmd/{name}",
+                    f"{base} in the decode step "
+                    f"({op_name or 'no op_name'}) is outside the "
+                    f"recipe's declared set {sorted(allowed)} — an "
+                    "undeclared per-step collective on the serving "
+                    "hot path",
+                    file=file, line=lineno,
+                    suggestion="reshard so only the declared combine "
+                    "crosses the mesh, or (if this collective is "
+                    "genuinely the contract) add it to the recipe's "
+                    "decode_collectives")
+            for base in sorted(allowed - set(found)):
+                ctx.report(
+                    self, f"{sa.target.name}/spmd",
+                    f"declared decode collective {base!r} is MISSING "
+                    "from the compiled program — the intended combine "
+                    "was elided, so the sharded layout is not being "
+                    "exercised (a replicated input or an unconsumed "
+                    "output usually hides it)",
+                    suggestion="check the recipe's arg_specs actually "
+                    "shard the pool and that the combined value is "
+                    "consumed downstream")
+            return
         for name in sorted(loop_comps):
             for line in comps.get(name, ()):
                 op = _hlo_opcode(line)
                 if op not in COLLECTIVE_OPS:
                     continue
-                meta = _META_RE.search(line)
-                op_name = meta.group(1) if meta else ""
-                file = meta.group(2) if meta and meta.group(2) else None
-                lineno = (int(meta.group(3))
-                          if meta and meta.group(3) else None)
+                op_name, file, lineno = self._line_meta(line)
                 ctx.report(
                     self, f"{sa.target.name}/spmd/{name}",
                     f"{op} inside the decode loop "

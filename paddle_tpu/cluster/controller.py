@@ -143,6 +143,7 @@ class ClusterController:
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
                  decode_kernel=None, prefix_cache: bool = False,
                  kv_dtype=None, kv_pool_bytes: Optional[int] = None,
+                 mesh: Optional[int] = None, mesh_axis: str = "mp",
                  engine_max_queue: Optional[int] = None, seed: int = 0,
                  hb_interval_s: float = 0.05,
                  hb_timeout_s: float = 1.0,
@@ -156,6 +157,12 @@ class ClusterController:
             raise ValueError("cluster needs at least one decode worker")
         if prefill_workers < 0:
             raise ValueError("prefill_workers must be >= 0")
+        if mesh is not None and (not isinstance(mesh, int) or mesh < 1):
+            # the config crosses a process boundary as JSON, so only
+            # the device-count form of the serving mesh= knob ships;
+            # workers provision >= mesh devices before building engines
+            raise ValueError("cluster mesh= must be a device count "
+                             f"(int >= 1), got {mesh!r}")
         self.cfg = cfg
         self.hb_interval_s = float(hb_interval_s)
         self.hb_timeout_s = float(hb_timeout_s)
@@ -183,6 +190,7 @@ class ClusterController:
             prompt_buckets=list(prompt_buckets), eos_id=eos_id,
             decode_kernel=decode_kernel, prefix_cache=prefix_cache,
             kv_dtype=kv_dtype, kv_pool_bytes=kv_pool_bytes,
+            mesh=mesh, mesh_axis=mesh_axis,
             max_queue=engine_max_queue)
         # the numerics policy is ambient process state
         # (core/dtypes.py) — a caller constructing the cluster under

@@ -92,7 +92,10 @@ def main(argv=None) -> int:
     with open(args.config) as f:
         config = json.load(f)
     if config.get("platform", "cpu") == "cpu":
-        _provision_cpu(int(config.get("devices", 1)))
+        # a sharded engine (engine.mesh = device count) needs that many
+        # virtual devices in THIS process, whatever the devices field says
+        mesh = (config.get("engine") or {}).get("mesh") or 0
+        _provision_cpu(max(int(config.get("devices", 1)), int(mesh)))
 
     import numpy as np
 

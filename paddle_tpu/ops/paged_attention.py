@@ -55,6 +55,9 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -1e30      # finite mask value (see ops/attention.py NEG_INF)
 
@@ -590,6 +593,66 @@ def merge_views(cache: PagedKVCache, views) -> PagedKVCache:
     return out
 
 
+# --- mesh sharding (multi-chip serving) ------------------------------
+#
+# The pools shard along the KV-HEAD axis of a parallel/mesh.py mesh:
+# k_pages/v_pages [nb, bs, h, hd] -> P(None, None, axis, None), the
+# int8 scales [nb, h] -> P(None, axis); block tables, lengths,
+# refcounts, and every other bookkeeping leaf stay REPLICATED, so the
+# allocator (reserve/free/share/cow/rollback/rc_add) partitions
+# collective-free — its math never crosses the head axis.  Attention is
+# head-local, so each chip appends into and attends over only its own
+# head shard (shard_map below) and the per-head arithmetic is
+# bit-identical to the single-device program; the ONE collective in a
+# decode step is the all-gather that replicates the attention output
+# for the (replicated) w_o matmul and everything downstream — logits,
+# sampling, and therefore streams are byte-identical to one device.
+#
+# The scope is threaded exactly like decode_kernel_scope: the serving
+# engine / serve builder enters paged_mesh_scope inside its traced body
+# so paged_append / paged_decode_attention / paged_chunked_attention
+# see the mesh at trace time; library callers without a scope get the
+# single-device forms unchanged.  The Pallas kernel composes: under
+# shard_map each device runs its own pallas_call over the local head
+# shard (the old "GSPMD cannot partition a pallas_call" restriction
+# applied only to auto-sharding, not manual shard_map).
+
+_paged_mesh = threading.local()
+
+
+@contextlib.contextmanager
+def paged_mesh_scope(mesh, axis: str = "mp"):
+    """Pin head-axis pool sharding under this context: every
+    paged_append / paged_decode_attention / paged_chunked_attention
+    call inside runs under ``shard_map`` over ``mesh``'s ``axis``.
+    ``mesh=None`` is a no-op scope (single-device forms).  Scopes nest;
+    the previous value restores on exit."""
+    prev = getattr(_paged_mesh, "value", None)
+    _paged_mesh.value = None if mesh is None else (mesh, axis)
+    try:
+        yield
+    finally:
+        _paged_mesh.value = prev
+
+
+def active_paged_mesh():
+    """The ``(mesh, axis)`` pinned by the innermost
+    :func:`paged_mesh_scope`, or ``None`` outside any scope."""
+    return getattr(_paged_mesh, "value", None)
+
+
+def _mesh_shard_count(mesh, axis) -> int:
+    return int(mesh.shape[axis])
+
+
+def _check_heads(num_heads: int, mesh, axis) -> None:
+    n = _mesh_shard_count(mesh, axis)
+    if num_heads % n != 0:
+        raise ValueError(
+            f"paged mesh sharding needs num_heads ({num_heads}) "
+            f"divisible by mesh axis {axis!r} size ({n})")
+
+
 def _quantized_append(pages: jax.Array, scales: jax.Array,
                       new: jax.Array, phys: jax.Array):
     """Quantize-on-append for one pool tensor (K or V of one layer).
@@ -653,7 +716,55 @@ def paged_append(view: PagedLayerView, k_new: jax.Array,
     (and, on quantized pools, scales) updated — every write path
     (decode append, chunked tail prefill, speculative verify windows)
     funnels through here, so quantize-on-append covers them all.
+
+    Under :func:`paged_mesh_scope` the write runs per head shard: each
+    device slices its local heads out of the (replicated) fresh K/V
+    and scatters into its local pool shard — no communication, the
+    routing indices are computed from replicated tables/lengths on
+    every device identically.
     """
+    ctx = active_paged_mesh()
+    if ctx is None:
+        return _paged_append_local(view, k_new, v_new)
+    mesh, ax = ctx
+    _check_heads(k_new.shape[2], mesh, ax)
+    pspec = P(None, None, ax, None)
+    rep = P()
+    make = type(view)
+    if view.k_scales is not None:
+        def body(kp, vp, ks, vs, table, lens, valid, kn, vn):
+            out = _paged_append_local(
+                make(kp, vp, table, lens, valid, ks, vs), kn, vn)
+            return out.k_pages, out.v_pages, out.k_scales, out.v_scales
+        kp, vp, ks, vs = shard_map(
+            body, mesh=mesh,
+            in_specs=(pspec, pspec, P(None, ax), P(None, ax),
+                      rep, rep, rep, pspec, pspec),
+            out_specs=(pspec, pspec, P(None, ax), P(None, ax)),
+            check_rep=False)(
+                view.k_pages, view.v_pages, view.k_scales,
+                view.v_scales, view.block_table, view.lengths,
+                view.append_valid, k_new, v_new)
+        return view._replace(k_pages=kp, v_pages=vp,
+                             k_scales=ks, v_scales=vs)
+
+    def body(kp, vp, table, lens, valid, kn, vn):
+        out = _paged_append_local(make(kp, vp, table, lens, valid),
+                                  kn, vn)
+        return out.k_pages, out.v_pages
+    kp, vp = shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, pspec, rep, rep, rep, pspec, pspec),
+        out_specs=(pspec, pspec), check_rep=False)(
+            view.k_pages, view.v_pages, view.block_table,
+            view.lengths, view.append_valid, k_new, v_new)
+    return view._replace(k_pages=kp, v_pages=vp)
+
+
+def _paged_append_local(view: PagedLayerView, k_new: jax.Array,
+                        v_new: jax.Array):
+    """Single-shard :func:`paged_append` body (also the per-device
+    program under the mesh scope's ``shard_map``)."""
     nb, bs = view.k_pages.shape[0], view.k_pages.shape[1]
     maxb = view.block_table.shape[1]
     b, t = k_new.shape[0], k_new.shape[1]
@@ -875,6 +986,53 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                                       == jnp.int8), (
         "int8 pools need k_scales/v_scales and float pools must not "
         "pass them — a raw int8 gather would attend garbage")
+    ctx = active_paged_mesh()
+    if ctx is not None:
+        return _mesh_attention(_paged_decode_attention_body, ctx, q,
+                               k_pages, v_pages, block_table, lengths,
+                               scale, k_scales, v_scales)
+    return _paged_decode_attention_body(q, k_pages, v_pages,
+                                        block_table, lengths, scale,
+                                        k_scales, v_scales)
+
+
+def _mesh_attention(body, ctx, q, k_pages, v_pages, block_table,
+                    lengths, scale, k_scales, v_scales):
+    """Run an attention body per head shard under ``shard_map`` and
+    replicate the result — the ONE collective (an all-gather over the
+    head axis of the ``[b, t, h, hd]`` output) in a sharded decode
+    step.  Attention is head-local, so the per-shard math is the
+    single-device math over a head subset: outputs are bit-identical.
+    The replicated query slices locally into head shards (no
+    communication); tables/lengths stay replicated."""
+    mesh, ax = ctx
+    _check_heads(q.shape[2], mesh, ax)
+    pspec = P(None, None, ax, None)
+    rep = P()
+    quant = k_scales is not None
+    # placeholder scale leaves keep one in_specs shape across the
+    # quantized / unquantized forms
+    ks_arg = k_scales if quant else lengths
+    vs_arg = v_scales if quant else lengths
+    sspec = P(None, ax) if quant else rep
+
+    def wrapped(q, kp, vp, table, lens, ks, vs):
+        return body(q, kp, vp, table, lens, scale,
+                    ks if quant else None, vs if quant else None)
+
+    out = shard_map(
+        wrapped, mesh=mesh,
+        in_specs=(pspec, pspec, pspec, rep, rep, sspec, sspec),
+        out_specs=pspec, check_rep=False)(
+            q, k_pages, v_pages, block_table, lengths, ks_arg, vs_arg)
+    return jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, P()))
+
+
+def _paged_decode_attention_body(q, k_pages, v_pages, block_table,
+                                 lengths, scale, k_scales, v_scales):
+    """Single-shard dispatch body of :func:`paged_decode_attention`
+    (also the per-device program under the mesh scope)."""
     if q.shape[1] == 1 and _use_kernel(q, k_pages, scale):
         from paddle_tpu.ops.pallas_paged_attention import (
             paged_decode_attention_kernel)
@@ -980,13 +1138,29 @@ def paged_chunked_attention(q: jax.Array, k_pages: jax.Array,
     kernel-selected call past the budget surfaces the typed
     ``ragged_unsupported_shape`` reason and takes the gather form.
     """
-    b, tq, h, hd = q.shape
-    nb, bs = k_pages.shape[0], k_pages.shape[1]
-    maxb = block_table.shape[1]
     assert (k_scales is not None) == (jnp.dtype(k_pages.dtype)
                                       == jnp.int8), (
         "int8 pools need k_scales/v_scales and float pools must not "
         "pass them — a raw int8 gather would attend garbage")
+    ctx = active_paged_mesh()
+    if ctx is not None:
+        # append_valid only marks pad lanes (don't-care outputs) — the
+        # masking math runs off lengths, so the shard body omits it
+        return _mesh_attention(_paged_chunked_attention_body, ctx, q,
+                               k_pages, v_pages, block_table, lengths,
+                               scale, k_scales, v_scales)
+    return _paged_chunked_attention_body(q, k_pages, v_pages,
+                                         block_table, lengths, scale,
+                                         k_scales, v_scales)
+
+
+def _paged_chunked_attention_body(q, k_pages, v_pages, block_table,
+                                  lengths, scale, k_scales, v_scales):
+    """Single-shard dispatch body of :func:`paged_chunked_attention`
+    (also the per-device program under the mesh scope)."""
+    b, tq, h, hd = q.shape
+    nb, bs = k_pages.shape[0], k_pages.shape[1]
+    maxb = block_table.shape[1]
     if _use_kernel(q, k_pages, scale):
         from paddle_tpu.ops.pallas_paged_attention import (
             paged_ragged_attention_kernel)
@@ -1041,18 +1215,29 @@ def dense_hbm_bytes(max_len: int, *, num_layers: int, num_heads: int,
 
 def paged_pool_bytes(num_blocks: int, *, num_layers: int,
                      num_heads: int, head_dim: int, block_size: int,
-                     kv_dtype=jnp.float32) -> int:
-    """TOTAL allocated pool bytes for a cache of ``num_blocks`` —
+                     kv_dtype=jnp.float32, shards: int = 1) -> int:
+    """Allocated pool bytes for a cache of ``num_blocks`` —
     K+V pools across layers plus, for quantized pools, the
     per-block-per-head f32 scale tensors.  This is the honest
     bytes-per-block the serving engine's admission capacity divides
     by (``PagedServingEngine(kv_pool_bytes=...)``): an int8 pool pays
     ``2 * layers * heads * 4`` scale bytes per block on top of its
     1-byte elements, so the capacity gain is computed from real
-    footprint, not the element-width ratio."""
+    footprint, not the element-width ratio.
+
+    ``shards > 1`` returns PER-SHARD bytes under head-axis mesh
+    sharding (each chip holds ``num_heads // shards`` heads of every
+    block — values and scales both divide), which is what a per-chip
+    HBM budget (``kv_pool_bytes=``) must divide by: at a fixed
+    per-chip budget, N chips hold N× the blocks."""
+    if num_heads % shards:
+        raise ValueError(
+            f"paged_pool_bytes: num_heads ({num_heads}) not divisible "
+            f"by shards ({shards})")
+    h_local = num_heads // shards
     dt = jnp.dtype(kv_dtype)
-    per_block = (2 * num_layers * block_size * num_heads * head_dim
+    per_block = (2 * num_layers * block_size * h_local * head_dim
                  * dt.itemsize)
     if dt == jnp.int8:
-        per_block += 2 * num_layers * num_heads * 4     # f32 scales
+        per_block += 2 * num_layers * h_local * 4       # f32 scales
     return num_blocks * per_block

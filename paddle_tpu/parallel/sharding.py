@@ -67,6 +67,36 @@ def shardings_like(params, mesh: Mesh, rules: Optional[Rules]):
     return unflatten_names(out)
 
 
+def paged_cache_shardings(cache, mesh: Mesh, axis: str = "mp"):
+    """NamedSharding pytree for a ``PagedKVCache`` under head-axis mesh
+    sharding — the multi-chip serving layout (``docs/design/serving.md``
+    "multi-chip serving"): K/V block pools shard on their head axis
+    (``[nb, bs, h, hd]`` → ``P(None, None, axis)``), the int8
+    per-block-per-head scales follow (``[nb, h]`` → ``P(None, axis)``),
+    and every bookkeeping leaf — block tables, lengths, blocks_used,
+    refcounts — stays REPLICATED so the allocator partitions
+    collective-free.  Duck-typed over the cache's NamedTuple fields so
+    this module never imports ``ops.paged_attention``.
+
+    Used by ``PagedServingEngine`` for initial cache placement/
+    donation pinning and by the sharded ``paged-engine-step-*`` lint
+    recipes as a callable arg_spec."""
+    # no trailing None: jit keys programs on the spec VERBATIM, and
+    # compiled outputs come back as P(None, None, axis) — a trailing
+    # None here would force a spurious recompile on the first
+    # post-step prefill
+    pool = NamedSharding(mesh, P(None, None, axis))
+    scale = NamedSharding(mesh, P(None, axis))
+    rep = NamedSharding(mesh, P())
+    return type(cache)(
+        k_pages=tuple(pool for _ in cache.k_pages),
+        v_pages=tuple(pool for _ in cache.v_pages),
+        block_tables=rep, lengths=rep, blocks_used=rep,
+        refcounts=rep,
+        k_scales=tuple(scale for _ in cache.k_scales),
+        v_scales=tuple(scale for _ in cache.v_scales))
+
+
 def lstm_tp_rules(axis: str = "mp") -> Rules:
     """Tensor-parallel layout for the LSTM stack: gate projections shard on
     the 4h output dim, embeddings on vocab rows, the readout on classes.

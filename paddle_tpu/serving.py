@@ -60,6 +60,8 @@ from paddle_tpu.models.transformer import (TransformerConfig,
 from paddle_tpu.ops import paged_attention as paged
 from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
                                             paged_hbm_bytes)
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.sharding import paged_cache_shardings
 from paddle_tpu.prefix_cache import PrefixCache
 from paddle_tpu import speculative as spec_mod
 from paddle_tpu.speculative import SpecConfig, TruncatedDraft
@@ -98,12 +100,37 @@ def _paged_model(cfg: TransformerConfig, attn_fn):
                 ids, caches=views, position=0, pos_ids=pos_ids))
 
 
+def _resolve_mesh(mesh, mesh_axis: str):
+    """Normalize the serving ``mesh=`` knob: ``None`` means no
+    sharding, an int ``n`` builds a 1-D ``(mesh_axis,)`` mesh over the
+    first ``n`` local devices, and a ``jax.sharding.Mesh`` passes
+    through (it must carry ``mesh_axis``)."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, (int, np.integer)):
+        n = int(mesh)
+        enforce(n >= 1, "serving mesh=%s: need at least one device", n)
+        enforce(n <= len(jax.devices()),
+                "serving mesh=%s devices requested, only %s present",
+                n, len(jax.devices()))
+        mesh = make_mesh((n,), (mesh_axis,), jax.devices()[:n])
+    enforce(mesh_axis in mesh.shape,
+            "serving mesh is missing axis %r (mesh axes: %s)",
+            mesh_axis, tuple(mesh.shape))
+    return mesh
+
+
+def _mesh_shards(mesh, mesh_axis: str) -> int:
+    return 1 if mesh is None else int(mesh.shape[mesh_axis])
+
+
 def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
                         block_size: int = 16,
                         max_blocks_per_slot: Optional[int] = None,
                         num_blocks: Optional[int] = None,
                         decode_kernel=None, draft=None,
-                        kv_dtype=None):
+                        kv_dtype=None, mesh=None,
+                        mesh_axis: str = "mp"):
     """Serving-shaped PAGED decode: ``lm_serve_builder``'s contract
     (traced ``steps``, one compiled program per prompt bucket, eos
     early exit, PAD past each row's end) over the block-pool cache.
@@ -152,6 +179,16 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     reuse the paged program machinery.  The FULL speculative pipeline
     (draft + batched verify + rollback) is the engine's
     ``spec=SpecConfig(...)`` knob.
+
+    ``mesh`` shards the K/V block pools along their head axis over a
+    ``mesh_axis`` mesh axis (an int ``n`` builds the 1-D mesh; a
+    ``jax.sharding.Mesh`` is used as-is).  Params and every
+    bookkeeping leaf (block tables, lengths, refcounts) stay
+    REPLICATED; attention and append run per-head-shard under
+    ``shard_map``, and the ONLY collective in the decode body is the
+    all-gather that recombines the attention output — so sharded
+    greedy streams are BIT-IDENTICAL to the single-device program
+    (``docs/design/serving.md`` "multi-chip serving").
     """
     dslice = None
     if draft is not None:
@@ -181,18 +218,29 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     # bit-identity — tests/test_quantized_kv.py pins it)
     kv_dt = jnp.dtype(kv_dtype if kv_dtype is not None
                       else get_policy().compute_dtype)
+    mesh = _resolve_mesh(mesh, mesh_axis)
+    shards = _mesh_shards(mesh, mesh_axis)
+    enforce(cfg.num_heads % shards == 0,
+            "paged_serve_builder: num_heads %s not divisible by mesh "
+            "axis %r size %s", cfg.num_heads, mesh_axis, shards)
+    # the kernel runs PER SHARD inside shard_map, on the local head
+    # slice — resolve viability against what each device actually sees
     use_kernel = paged.resolve_decode_kernel(
-        decode_kernel, block_size=bs, num_heads=cfg.num_heads,
+        decode_kernel, block_size=bs,
+        num_heads=cfg.num_heads // shards,
         head_dim=hd, kv_dtype=kv_dt)
 
     @functools.partial(jax.jit, static_argnums=(5, 6, 7))
     def _pserve(params, prompt_ids, steps, temperature=0.0, rng=None,
                 eos_id=None, top_k=None, top_p=None, prompt_lens=None):
-        # The scope pins decode-attention dispatch AT TRACE TIME —
-        # prefill calls (t>1 queries) take the XLA form regardless;
-        # the per-step t=1 attention inside the while_loop body takes
-        # the kernel iff use_kernel resolved True at build.
-        with paged.decode_kernel_scope(use_kernel):
+        # The scopes pin dispatch AT TRACE TIME — prefill calls (t>1
+        # queries) take the XLA form regardless; the per-step t=1
+        # attention inside the while_loop body takes the kernel iff
+        # use_kernel resolved True at build.  The mesh scope reroutes
+        # every paged append/attend through its head-sharded shard_map
+        # form (a no-op when mesh is None).
+        with paged.decode_kernel_scope(use_kernel), \
+                paged.paged_mesh_scope(mesh, mesh_axis):
             return _pserve_impl(params, prompt_ids, steps, temperature,
                                 rng, eos_id, top_k, top_p, prompt_lens)
 
@@ -210,6 +258,12 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
         nb = num_blocks if num_blocks else b * maxb
         cache = paged.paged_init(cfg.num_layers, b, maxb, nb, bs,
                                  cfg.num_heads, hd, kv_dt)
+        if mesh is not None:
+            # pin the pool layout once, up front: the while_loop carry
+            # then holds the head-sharded placement stable instead of
+            # letting GSPMD re-derive (and possibly gather) it per step
+            cache = jax.lax.with_sharding_constraint(
+                cache, paged_cache_shardings(cache, mesh, mesh_axis))
         rng_key = jax.random.key(0) if rng is None else rng
         temp = jnp.asarray(temperature, jnp.float32)
         steps = jnp.clip(jnp.asarray(steps, jnp.int32), 1, max_new)
@@ -320,6 +374,8 @@ def paged_serve_builder(cfg: TransformerConfig, attn_fn=None,
     serve.decode_kernel = use_kernel   # resolved choice, for bench rows
     serve.kv_dtype = kv_dt             # resolved pool dtype, ditto
     serve.draft_cfg = cfg if draft is not None else None
+    serve.mesh = mesh                  # resolved Mesh (None = 1 device)
+    serve.mesh_axis = mesh_axis
     return serve
 
 
@@ -554,38 +610,59 @@ class PagedServingEngine:
                  max_queue: Optional[int] = None, faults=None,
                  spec: Optional[SpecConfig] = None, draft=None,
                  unified_step: bool = True, kv_dtype=None,
-                 kv_pool_bytes: Optional[int] = None):
+                 kv_pool_bytes: Optional[int] = None, mesh=None,
+                 mesh_axis: str = "mp"):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
         self.bs = block_size
         hd = cfg.dim // cfg.num_heads
+        # Mesh sharding: the K/V block pools (and int8 scales) shard
+        # along their HEAD axis over `mesh_axis`; params + every
+        # bookkeeping leaf stay replicated, so the allocator and the
+        # whole host admission loop run unchanged and the only
+        # collective in the decode body is the attention-output
+        # all-gather (ops/paged_attention.py paged_mesh_scope).
+        mesh = _resolve_mesh(mesh, mesh_axis)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        shards = _mesh_shards(mesh, mesh_axis)
+        self.shards = shards
+        enforce(cfg.num_heads % shards == 0,
+                "engine mesh: num_heads %s not divisible by mesh axis "
+                "%r size %s", cfg.num_heads, mesh_axis, shards)
         # KV-pool dtype: None inherits the numerics policy's compute
         # dtype (the pre-quantization behavior, byte-identical pytree);
         # "int8" stores quantized block pools + per-block-per-head f32
         # scales (ops/paged_attention.py — the capacity knob).
         self.kv_dtype = jnp.dtype(kv_dtype if kv_dtype is not None
                                   else get_policy().compute_dtype)
-        #: real HBM bytes ONE pool block costs across all layers (K+V
-        #: pages plus, when quantized, their scale rows) — the unit the
-        #: admission ledger and kv_pool_bytes sizing are denominated in
+        #: real PER-SHARD HBM bytes ONE pool block costs across all
+        #: layers (K+V pages plus, when quantized, their scale rows) —
+        #: each chip holds num_heads/shards of every block, so this is
+        #: the unit the admission ledger and the PER-CHIP kv_pool_bytes
+        #: budget are denominated in (single device: shards=1, total)
         self.block_bytes = paged.paged_pool_bytes(
             1, num_layers=cfg.num_layers, num_heads=cfg.num_heads,
-            head_dim=hd, block_size=block_size, kv_dtype=self.kv_dtype)
+            head_dim=hd, block_size=block_size, kv_dtype=self.kv_dtype,
+            shards=shards)
         enforce((num_blocks is None) != (kv_pool_bytes is None),
                 "engine pool sizing: pass exactly one of num_blocks "
-                "(block count) or kv_pool_bytes (byte budget; blocks = "
-                "budget // block_bytes), got num_blocks=%s "
-                "kv_pool_bytes=%s", num_blocks, kv_pool_bytes)
+                "(block count) or kv_pool_bytes (PER-CHIP byte budget; "
+                "blocks = budget // per-shard block_bytes), got "
+                "num_blocks=%s kv_pool_bytes=%s", num_blocks,
+                kv_pool_bytes)
         if num_blocks is None:
-            # byte-budget sizing: the SAME budget admits more blocks
-            # (so more resident requests) under a narrower kv_dtype —
-            # the int8 capacity win, derived from real bytes-per-block
+            # byte-budget sizing: the SAME per-chip budget admits more
+            # blocks (so more resident requests) under a narrower
+            # kv_dtype OR across more head shards — the int8 and
+            # multi-chip capacity wins, from real bytes-per-block
             num_blocks = int(kv_pool_bytes) // self.block_bytes
             enforce(num_blocks >= 1,
                     "kv_pool_bytes=%s cannot hold even one block "
-                    "(%s bytes at kv_dtype=%s)", kv_pool_bytes,
-                    self.block_bytes, self.kv_dtype.name)
+                    "(%s bytes/shard at kv_dtype=%s over %s shard(s))",
+                    kv_pool_bytes, self.block_bytes,
+                    self.kv_dtype.name, shards)
         self.nb = num_blocks
         self.maxb = (max_blocks_per_slot if max_blocks_per_slot
                      else -(-cfg.max_len // block_size))
@@ -607,13 +684,26 @@ class PagedServingEngine:
         # engine's lifetime (same tri-state knob as paged_serve_builder;
         # None = kernel on TPU, True forces it in interpret mode off-TPU
         # for the parity/CI path, False forces the XLA gather form).
+        # under the mesh the kernel runs PER SHARD inside shard_map, on
+        # the local head slice — resolve against what a device sees
         self.decode_kernel = paged.resolve_decode_kernel(
             decode_kernel, block_size=block_size,
-            num_heads=cfg.num_heads, head_dim=hd,
+            num_heads=cfg.num_heads // shards, head_dim=hd,
             kv_dtype=self.kv_dtype)
         use_kernel = self.decode_kernel
         sharing = bool(prefix_cache)
         self.prefix_enabled = sharing
+
+        def _pin(c):
+            # every traced fn returns its cache through this: the
+            # donated-in and returned-out pool layouts must agree (the
+            # step's output IS the next step's input), so pin the
+            # head-sharded placement on the way out rather than let
+            # GSPMD re-derive it per program
+            if mesh is None:
+                return c
+            return jax.lax.with_sharding_constraint(
+                c, paged_cache_shardings(c, mesh, mesh_axis))
 
         def decode_fn(params, cache, tok, active, temps, done, key):
             # the scopes pin decode-attention dispatch at trace time;
@@ -622,7 +712,8 @@ class PagedServingEngine:
             # feeding serving_kernel_fallback_total{reason=...}
             with paged.decode_kernel_scope(use_kernel), \
                     paged.kernel_fallback_scope(
-                        self._note_kernel_fallback):
+                        self._note_kernel_fallback), \
+                    paged.paged_mesh_scope(mesh, mesh_axis):
                 act = active.astype(jnp.int32)
                 if sharing:
                     # un-share each appending slot's cursor block
@@ -645,11 +736,12 @@ class PagedServingEngine:
                 nxt, done = pick(lg[:, -1], key, done)
                 if sharing:
                     ok = ok & cok
-                return cache, nxt, done, ok
+                return _pin(cache), nxt, done, ok
 
         def prefill_fn(params, cache, slot, prompt, plen, temp, key):
             # same scope for symmetry; t>1 queries take the XLA form
-            with paged.decode_kernel_scope(use_kernel):
+            with paged.decode_kernel_scope(use_kernel), \
+                    paged.paged_mesh_scope(mesh, mesh_axis):
                 want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
                 cache, ok = paged.paged_reserve(cache, want)
                 views = paged.layer_views(cache, slot[None], plen[None])
@@ -667,7 +759,7 @@ class PagedServingEngine:
                                         jnp.int32, eos_id, top_k, top_p)
                 tok0, done0 = pick(last[None], key,
                                    jnp.zeros((1,), bool))
-                return cache, tok0[0], done0[0], ok
+                return _pin(cache), tok0[0], done0[0], ok
 
         def prefill_tail_fn(params, cache, slot, tail, tlen, temp, key):
             # TAIL prefill after a prefix-cache hit: ``paged_share``
@@ -677,7 +769,8 @@ class PagedServingEngine:
             # the resident prefix plus the earlier tail tokens via the
             # chunked view.  COW first: a matched partial block is
             # shared mid-block and the tail appends into it.
-            with paged.decode_kernel_scope(use_kernel):
+            with paged.decode_kernel_scope(use_kernel), \
+                    paged.paged_mesh_scope(mesh, mesh_axis):
                 want = jnp.zeros((S,), jnp.int32).at[slot].set(tlen)
                 cache, cok = paged.paged_cow(cache, want)
                 cache, ok = paged.paged_reserve(cache, want)
@@ -698,7 +791,7 @@ class PagedServingEngine:
                                         jnp.int32, eos_id, top_k, top_p)
                 tok0, done0 = pick(last[None], key,
                                    jnp.zeros((1,), bool))
-                return cache, tok0[0], done0[0], ok & cok
+                return _pin(cache), tok0[0], done0[0], ok & cok
 
         # Speculation config resolves FIRST: the unified step's static
         # window width is k+1 with a draft attached (verify windows),
@@ -716,6 +809,11 @@ class PagedServingEngine:
                     "draft vocab %s != target vocab %s — the accept "
                     "rule compares distributions over one vocabulary",
                     draft.cfg.vocab_size, cfg.vocab_size)
+            enforce(draft.cfg.num_heads % shards == 0,
+                    "engine mesh: draft num_heads %s not divisible by "
+                    "mesh axis %r size %s (the draft pool shards the "
+                    "same way as the target's)", draft.cfg.num_heads,
+                    mesh_axis, shards)
             self.draft = draft
             self._draft_params = draft.params
             k = int(spec.k)
@@ -749,7 +847,8 @@ class PagedServingEngine:
                     paged.kernel_fallback_scope(
                         self._note_kernel_fallback), \
                     paged.kernel_dispatch_scope(
-                        self._note_kernel_dispatch):
+                        self._note_kernel_dispatch), \
+                    paged.paged_mesh_scope(mesh, mesh_axis):
                 if sharing:
                     # un-share each appending slot's cursor block
                     # before the write (cond-gated in-graph COW)
@@ -778,8 +877,8 @@ class PagedServingEngine:
                     probs = jax.nn.softmax(restrict(
                         (lf / tcol).reshape(S * W, V)),
                         axis=-1).reshape(S, W, V)
-                    return cache, nxt, done, greedy, probs, ok
-                return cache, nxt, done, greedy, ok
+                    return _pin(cache), nxt, done, greedy, probs, ok
+                return _pin(cache), nxt, done, greedy, ok
 
         def prefill_ragged_fn(params, cache, slot, toks, tlen, temp,
                               key):
@@ -794,7 +893,8 @@ class PagedServingEngine:
                     paged.kernel_fallback_scope(
                         self._note_kernel_fallback), \
                     paged.kernel_dispatch_scope(
-                        self._note_kernel_dispatch):
+                        self._note_kernel_dispatch), \
+                    paged.paged_mesh_scope(mesh, mesh_axis):
                 want = jnp.zeros((S,), jnp.int32).at[slot].set(tlen)
                 if sharing:
                     cache, cok = paged.paged_cow(cache, want)
@@ -818,7 +918,7 @@ class PagedServingEngine:
                                    jnp.zeros((1,), bool))
                 if sharing:
                     ok = ok & cok
-                return cache, tok0[0], done0[0], ok
+                return _pin(cache), tok0[0], done0[0], ok
 
         # The cache (pool + block tables) is DEAD the moment each step
         # returns its successor — donate it so XLA updates the pool
@@ -839,8 +939,9 @@ class PagedServingEngine:
         # shard-check contract: decode_fn/step_fn args 2..5 (tok[s],
         # active/qlens, temps, done) are slot-major [S]-leading
         # vectors — the lint mesh recipe shards them on the data axis;
-        # params and the paged pool stay replicated (multi-chip pool
-        # sharding is the ROADMAP item this gate de-risks).
+        # params stay replicated.  The paged pool's HEAD-axis sharding
+        # is the mesh= knob above; the sharded paged-engine-step-*
+        # recipes pin its layout via paged_cache_shardings instead.
         self._decode_slot_args = (2, 3, 4, 5)
         # share/rc_add are tiny refcount/table host transforms used by
         # BOTH prefix sharing and the disaggregated KV handoff import
@@ -887,7 +988,8 @@ class PagedServingEngine:
                 # chunked, and the observer records its typed fallback.
                 with paged.decode_kernel_scope(use_kernel), \
                         paged.kernel_fallback_scope(
-                            self._note_kernel_fallback):
+                            self._note_kernel_fallback), \
+                        paged.paged_mesh_scope(mesh, mesh_axis):
                     keys = jax.random.split(key, k)
                     dcache, ok = paged.paged_reserve(dcache, pend_len)
                     views = paged.chunked_layer_views(dcache, arange_s,
@@ -916,7 +1018,7 @@ class PagedServingEngine:
                         tok, q = _propose(lg[:, -1], temps, keys[i])
                         drafts.append(tok)
                         qs.append(q)
-                    return (dcache, jnp.stack(drafts, axis=1),
+                    return (_pin(dcache), jnp.stack(drafts, axis=1),
                             jnp.stack(qs, axis=1), ok)
 
             def verify_fn(params, cache, toks, valid, temps):
@@ -931,7 +1033,8 @@ class PagedServingEngine:
                 # its other readers can see.
                 with paged.decode_kernel_scope(use_kernel), \
                         paged.kernel_fallback_scope(
-                            self._note_kernel_fallback):
+                            self._note_kernel_fallback), \
+                        paged.paged_mesh_scope(mesh, mesh_axis):
                     if sharing:
                         cache, cok = paged.paged_cow(cache, valid)
                     cache, ok = paged.paged_reserve(cache, valid)
@@ -951,13 +1054,14 @@ class PagedServingEngine:
                         axis=-1).reshape(S, k + 1, V)
                     if sharing:
                         ok = ok & cok
-                    return cache, greedy, probs, ok
+                    return _pin(cache), greedy, probs, ok
 
             def draft_prefill_fn(dparams, dcache, slot, prompt, plen):
                 # the draft sees the FULL prompt even when the target's
                 # admission was a prefix-cache hit: the draft pool has
                 # no registry, and proposal quality is all this buys
-                with paged.decode_kernel_scope(use_kernel):
+                with paged.decode_kernel_scope(use_kernel), \
+                        paged.paged_mesh_scope(mesh, mesh_axis):
                     want = jnp.zeros((S,), jnp.int32).at[slot].set(plen)
                     dcache, ok = paged.paged_reserve(dcache, want)
                     views = paged.layer_views(dcache, slot[None],
@@ -968,7 +1072,7 @@ class PagedServingEngine:
                                                  prompt, views, pos_ids)
                     dcache = paged.paged_advance(
                         paged.merge_views(dcache, views), want)
-                    return dcache, ok
+                    return _pin(dcache), ok
 
             self._draft = jax.jit(draft_fn, donate_argnums=(1,))
             self._draft_prefill = jax.jit(draft_prefill_fn,
@@ -994,6 +1098,13 @@ class PagedServingEngine:
         self.cache = paged.paged_init(cfg.num_layers, S, self.maxb,
                                       self.nb, self.bs, cfg.num_heads,
                                       hd, self.kv_dtype)
+        if mesh is not None:
+            # place the fresh pool in its head-sharded layout up front
+            # so the first donated step starts from the steady-state
+            # placement (no resharding transfer on step one)
+            self.cache = jax.device_put(
+                self.cache,
+                paged_cache_shardings(self.cache, mesh, mesh_axis))
         self._key = jax.random.key(seed)
         # host mirrors: fixed-shape device carries + per-slot requests
         self._slots = [None] * S          # _Request or None
@@ -1026,6 +1137,10 @@ class PagedServingEngine:
                 self.bs, draft.cfg.num_heads,
                 draft.cfg.dim // draft.cfg.num_heads,
                 get_policy().compute_dtype)
+            if mesh is not None:
+                self.dcache = jax.device_put(
+                    self.dcache,
+                    paged_cache_shardings(self.dcache, mesh, mesh_axis))
             self._dlen = [None] * S       # draft cache length mirror
             self._dpend = [None] * S      # committed, not yet drafted
             self._spec_rng = np.random.default_rng(seed)
@@ -1123,11 +1238,13 @@ class PagedServingEngine:
         self._m_kv_pool_bytes = m.gauge(
             "serving_kv_pool_bytes",
             help="target KV block-pool footprint in HBM bytes (pages + "
-                 "quantization scales), by dtype= — set once at "
-                 "construction; the int8/bf16 ratio IS the capacity "
-                 "headline")
-        self._m_kv_pool_bytes.set(float(self.nb * self.block_bytes),
-                                  dtype=self.kv_dtype.name)
+                 "quantization scales), by dtype= and shards= — TOTAL "
+                 "across the mesh (per-chip = value / shards), set once"
+                 " at construction; the int8/bf16 ratio IS the capacity"
+                 " headline")
+        self._m_kv_pool_bytes.set(
+            float(self.nb * self.block_bytes * shards),
+            dtype=self.kv_dtype.name, shards=str(shards))
         self._m_kv_div = m.gauge(
             "serving_kv_max_logit_divergence",
             help="max |logit(quantized) - logit(reference)| observed by "
@@ -1602,6 +1719,13 @@ class PagedServingEngine:
         assert ids is not None, \
             "handoff import found no free blocks despite admission " \
             "accounting (engine bug)"
+        if self.mesh is not None:
+            # the eager host-side .at[].set page writes drop the pool's
+            # head-axis placement — restore it before the donated step
+            # sees a mixed-layout cache
+            cache = jax.device_put(
+                cache,
+                paged_cache_shardings(cache, self.mesh, self.mesh_axis))
         new_len = n - 1
         nmap = len(ids)
         bid = np.zeros((self.maxb,), np.int32)
@@ -2127,7 +2251,11 @@ class PagedServingEngine:
         return {
             "active_lengths": lens,
             "kv_dtype": self.kv_dtype.name,
+            # bytes one block costs ON EACH CHIP (each holds its
+            # num_heads/shards slice of every block); single device:
+            # shards == 1 and per-shard == total, the legacy meaning
             "block_bytes": self.block_bytes,
+            "shards": self.shards,
             "paged_bytes_per_request": paged_hbm_bytes(
                 lens, block_size=self.bs, num_layers=L, num_heads=h,
                 head_dim=hd, dtype_bytes=kv_bytes),
@@ -2136,12 +2264,19 @@ class PagedServingEngine:
                 head_dim=hd,
                 dtype_bytes=jnp.dtype(get_policy().compute_dtype)
                 .itemsize),
-            "pool_bytes_total": self.nb * self.block_bytes,
+            # per-shard vs mesh-total, stated separately so nothing
+            # conflates them once pools shard (the selfcheck pins the
+            # serving_kv_pool_bytes gauge == pool_bytes_total)
+            "pool_bytes_per_shard": self.nb * self.block_bytes,
+            "pool_bytes_total": (self.nb * self.block_bytes
+                                 * self.shards),
             "kv_scale_bytes": scale_bytes,
             # blocks the prefix registry holds resident past their
-            # donors (the HBM rent prefix sharing pays for its hits)
+            # donors (the HBM rent prefix sharing pays for its hits;
+            # total across the mesh, like pool_bytes_total)
             "prefix_pinned_blocks": self._pinned,
-            "prefix_pinned_bytes": self._pinned * self.block_bytes,
+            "prefix_pinned_bytes": (self._pinned * self.block_bytes
+                                    * self.shards),
         }
 
     def stats(self):

@@ -606,6 +606,102 @@ def _check_int8_smoke():
     return rate, ref_rate, int(ragged)
 
 
+def _check_mesh_smoke():
+    """Multi-chip serving smoke: a burst through a 2-way head-sharded
+    engine must keep the single-device contract — bit-identical greedy
+    streams, zero kernel fallbacks, one compiled step whose ONLY
+    collective is the per-layer attention-output all-gather, and a pool
+    gauge that reports total bytes with the ``shards`` label.
+
+    Returns ``None`` (and the caller prints a skip) when the process
+    has fewer than 2 devices — run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=2`` (ci.sh does).
+    """
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.device_count() < 2:
+        return None
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=2, ffn_mult=2, max_len=32)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0),
+                           jnp.zeros((1, 4), jnp.int32))
+
+    def drive(mesh, reg):
+        eng = PagedServingEngine(cfg, params, num_slots=2,
+                                 num_blocks=16, block_size=4,
+                                 prompt_buckets=(4, 16), metrics=reg,
+                                 decode_kernel=True, seed=0, mesh=mesh)
+        eng.submit(np.arange(1, 13, dtype=np.int32), max_new=6)
+        eng.submit(np.arange(2, 5, dtype=np.int32), max_new=6)
+        out = {rid: np.asarray(t).tolist()
+               for rid, t in eng.run().items()}
+        return eng, out
+
+    _, ref_out = drive(None, MetricsRegistry("selfcheck-mesh-ref"))
+    reg = MetricsRegistry("selfcheck-mesh")
+    eng, out = drive(2, reg)
+    if out != ref_out:
+        _fail("head-sharded greedy streams diverged from the "
+              f"single-device engine: {out} vs {ref_out}")
+
+    compiles = eng.compile_counts()
+    if compiles.get("step") != 1 or compiles.get("prefill", 0) > 2:
+        _fail("the compile-set pin broke under the 2-device mesh: "
+              f"{compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    fb = metrics.get("serving_kernel_fallback_total", {"series": []})
+    if sum(s["value"] for s in fb["series"]) != 0:
+        _fail("the sharded path silently regressed to the XLA gather "
+              "form: serving_kernel_fallback_total carries "
+              f"{[(s['labels'], s['value']) for s in fb['series']]}")
+    pool_g = metrics.get("serving_kv_pool_bytes", {"series": []})
+    by_shards = {s["labels"].get("shards"): s["value"]
+                 for s in pool_g["series"]}
+    rep = eng.hbm_report()
+    if by_shards.get("2") != float(rep["pool_bytes_total"]):
+        _fail(f"serving_kv_pool_bytes{{shards=2}} {by_shards} does not "
+              f"match hbm_report pool_bytes_total "
+              f"{rep['pool_bytes_total']}")
+    if rep["pool_bytes_per_shard"] * rep["shards"] \
+            != rep["pool_bytes_total"]:
+        _fail(f"hbm_report per-shard arithmetic broke: {rep}")
+
+    # the compiled step's ONLY collective is the attention-output
+    # combine — one all-gather per layer, nothing in the allocator
+    S = eng.S
+    hlo = eng._step.lower(
+        eng.params, eng.cache,
+        jnp.zeros((S, eng.step_width), jnp.int32),
+        jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+        jnp.zeros((S,), bool), jax.random.key(0)).compile().as_text()
+    kinds = set(re.findall(
+        r"\b(all-gather|all-reduce|reduce-scatter|all-to-all|"
+        r"collective-permute)(?:-start)?\(", hlo))
+    if kinds != {"all-gather"}:
+        _fail("the sharded step must carry exactly one collective kind "
+              f"(the all-gather combine), found {sorted(kinds)}")
+    n_combine = len(re.findall(r"\ball-gather(?:-start)?\(", hlo))
+    if n_combine != cfg.num_layers:
+        _fail(f"expected one combine per layer "
+              f"({cfg.num_layers}), found {n_combine}")
+    return rep["shards"], n_combine
+
+
 def _check_health():
     import jax.numpy as jnp
     import numpy as np
@@ -845,6 +941,17 @@ def main(argv=None) -> int:
           "gauge matches hbm_report with scale bytes counted, spec "
           f"accept rate {i_rate:.2f} within {INT8_ACCEPT_RATE_SLACK} "
           f"of the bf16 twin's {i_ref:.2f})")
+    mesh_res = _check_mesh_smoke()
+    if mesh_res is None:
+        print("selfcheck: mesh smoke SKIPPED (needs >=2 devices; run "
+              "under XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    else:
+        m_shards, m_combines = mesh_res
+        print(f"selfcheck: 2-device mesh smoke ok ({m_shards} shards, "
+              "greedy streams bit-identical to single-device, 0 kernel "
+              f"fallbacks, step HLO carries exactly {m_combines} "
+              "all-gather combine(s) and no other collective, pool "
+              "gauge matches hbm_report per-shard x shards)")
     hsnap, h_per_step = _check_health()
     print("selfcheck: training health smoke ok "
           f"({sum(1 for m in hsnap['metrics'] if m.startswith('train_health'))} "

@@ -1,0 +1,222 @@
+"""Multi-chip paged serving: head-sharded KV pools under a 2-device mesh
+(``serving.py`` ``mesh=`` knob + ``parallel/sharding.paged_cache_shardings``
++ the ``ops/paged_attention.py`` shard_map dispatch).
+
+The load-bearing pins:
+
+* SHARDING IS A LAYOUT, NOT A NUMERIC: greedy token streams from a
+  2-way head-sharded engine are BIT-IDENTICAL to the single-device
+  engine across every serving mode (XLA kernel on/off, bf16/int8
+  pools, speculative decoding, prefix sharing) — the per-shard
+  attention + replicated-combine schedule must reassociate nothing;
+* ONE program, ONE collective: the engine still compiles exactly
+  ``{'step': 1, 'prefill': 1}`` under the mesh, and the compiled step's
+  only collective kind is the per-layer attention-output all-gather
+  (the allocator/bookkeeping partitions collective-free);
+* per-shard accounting: ``paged_pool_bytes(shards=N)`` divides the
+  head-carrying bytes exactly, ``kv_pool_bytes=`` is a PER-CHIP budget
+  (same budget => N× blocks on N chips), and ``hbm_report()`` keeps
+  per-shard × shards == total;
+* the prefix-cache refcount ledger stays exact with sharded pools
+  (host-side ledger never sees the mesh).
+
+Runs on the 8-device virtual CPU platform from conftest.py.
+"""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.core.errors import EnforceError
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.serving import PagedServingEngine, paged_serve_builder
+from paddle_tpu.speculative import SpecConfig
+import paddle_tpu.nn as nn
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _engine(params, mesh, **kw):
+    kw.setdefault("num_blocks", 24)
+    kw.setdefault("prompt_buckets", (8,))
+    return PagedServingEngine(CFG, params, num_slots=2,
+                              block_size=4, seed=0, mesh=mesh, **kw)
+
+
+def _serve_burst(eng):
+    prompts = [np.array([1, 2, 3, 4], np.int32),
+               np.array([5, 6, 7], np.int32),
+               np.array([9, 10, 11, 12, 13], np.int32)]
+    for p in prompts:
+        eng.submit(p, max_new=6)
+    return {rid: np.asarray(toks).tolist()
+            for rid, toks in eng.run().items()}
+
+
+# ------------------------------------------------- stream bit-identity
+
+
+MODES = {
+    "plain": dict(),
+    "int8": dict(kv_dtype="int8"),
+    "kernel": dict(decode_kernel=True),
+    "prefix": dict(prefix_cache=True),
+    "spec": dict(spec=SpecConfig(k=2, draft_layers=1)),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_sharded_streams_bit_identical_to_single_device(params, mode):
+    ref = _serve_burst(_engine(params, mesh=None, **MODES[mode]))
+    got = _serve_burst(_engine(params, mesh=2, **MODES[mode]))
+    assert got == ref, (
+        f"{mode}: head-sharded greedy stream diverged from single-device")
+
+
+def test_builder_sharded_bit_identical(params):
+    prompts = jnp.asarray(np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int32))
+    lens = np.array([4, 3], np.int32)
+    one = paged_serve_builder(CFG, block_size=4)
+    two = paged_serve_builder(CFG, block_size=4, mesh=2)
+    assert two.mesh is not None and two.mesh.shape["mp"] == 2
+    a = np.asarray(one(params, prompts, 6, prompt_lens=lens))
+    b = np.asarray(two(params, prompts, 6, prompt_lens=lens))
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------- one program, one collective kind
+
+
+def test_compile_counts_pinned_under_mesh(params):
+    eng = _engine(params, mesh=2)
+    _serve_burst(eng)
+    assert eng.compile_counts() == {"step": 1, "prefill": 1}, (
+        "the mesh must not add programs: one ragged step + one "
+        "bucketed prefill serve the whole burst")
+
+
+def test_step_hlo_has_only_the_attention_combine(params):
+    eng = _engine(params, mesh=2)
+    S = eng.S
+    lowered = eng._step.lower(
+        eng.params, eng.cache, jnp.zeros((S, eng.step_width), jnp.int32),
+        jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+        jnp.zeros((S,), bool), jax.random.key(0))
+    hlo = lowered.compile().as_text()
+    kinds = set(re.findall(
+        r"\b(all-gather|all-reduce|reduce-scatter|all-to-all|"
+        r"collective-permute)(?:-start)?\(", hlo))
+    assert kinds == {"all-gather"}, (
+        f"decode step must carry EXACTLY the attention-output "
+        f"all-gather, found {sorted(kinds)}")
+    combines = len(re.findall(r"\ball-gather(?:-start)?\(", hlo))
+    assert combines == CFG.num_layers, (
+        f"expected one combine per layer, found {combines}")
+
+
+# --------------------------------------------- per-shard byte accounting
+
+
+def _pool_bytes(shards, kv_dtype="bfloat16"):
+    return paged.paged_pool_bytes(
+        6, num_layers=2, num_heads=4, head_dim=8, block_size=4,
+        kv_dtype=jnp.dtype(kv_dtype), shards=shards)
+
+
+def test_pool_bytes_divides_exactly_across_shards():
+    for dt in ("bfloat16", "int8"):
+        total = _pool_bytes(1, dt)
+        for n in (2, 4):
+            assert _pool_bytes(n, dt) * n == total, (
+                f"{dt}: per-shard bytes must tile the pool exactly")
+
+
+def test_pool_bytes_rejects_indivisible_heads():
+    with pytest.raises(ValueError, match="not divisible"):
+        paged.paged_pool_bytes(6, num_layers=2, num_heads=3, head_dim=8,
+                               block_size=4, shards=2)
+
+
+def test_kv_pool_bytes_is_a_per_chip_budget(params):
+    budget = _engine(params, mesh=None).block_bytes * 6
+    one = _engine(params, mesh=None, num_blocks=None, kv_pool_bytes=budget)
+    two = _engine(params, mesh=2, num_blocks=None, kv_pool_bytes=budget)
+    assert one.nb == 6
+    assert two.nb == 12, (
+        "the same per-chip byte budget must hold 2x the blocks on "
+        "2 chips — that is the multi-chip capacity win")
+    rep = two.hbm_report()
+    assert rep["shards"] == 2
+    assert rep["pool_bytes_per_shard"] * 2 == rep["pool_bytes_total"]
+    assert rep["pool_bytes_per_shard"] <= budget
+
+
+def test_engine_rejects_indivisible_heads(params):
+    # num_heads=4 cannot split over a 3-way head axis
+    with pytest.raises(EnforceError):
+        _engine(params, mesh=3)
+
+
+# --------------------------------------- refcount ledger under sharding
+
+
+def _registry_pins(eng):
+    pins = {}
+    stack = [eng._prefix._root]
+    while stack:
+        node = stack.pop()
+        for nd in list(node.children.values()) + list(node.tails.values()):
+            pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
+        stack.extend(node.children.values())
+    return pins
+
+
+def _assert_refcounts_exact(eng):
+    tables = np.asarray(eng.cache.block_tables)
+    used = np.asarray(eng.cache.blocks_used)
+    rc = np.asarray(eng.cache.refcounts)
+    expect = np.zeros_like(rc)
+    for s in range(eng.S):
+        for b in tables[s, :used[s]]:
+            assert b >= 0
+            expect[b] += 1
+    for b, n in _registry_pins(eng).items():
+        expect[b] += n
+    np.testing.assert_array_equal(rc, expect)
+    assert eng._reserved + eng._pinned <= eng.nb
+
+
+def test_refcounts_never_leak_with_sharded_pools(params):
+    rng = np.random.default_rng(0)
+    eng = _engine(params, mesh=2, prefix_cache=True, num_blocks=20,
+                  prompt_buckets=(16,))
+    prefix = np.arange(1, 11, dtype=np.int32)
+    for step in range(40):
+        roll = rng.random()
+        if roll < 0.35:
+            tail = rng.integers(0, CFG.vocab_size,
+                                size=int(rng.integers(0, 4)))
+            eng.submit(np.concatenate([prefix, tail]).astype(np.int32),
+                       max_new=int(rng.integers(1, 5)))
+        elif roll < 0.45 and eng._prefix.blocks:
+            eng.flush_prefix_cache()
+        else:
+            eng.step()
+        _assert_refcounts_exact(eng)
+    eng.run()
+    _assert_refcounts_exact(eng)
+    assert eng.occupancy()["blocks_in_use"] == eng._pinned
+    eng.flush_prefix_cache()
+    assert eng.occupancy()["blocks_in_use"] == 0 and eng._pinned == 0
