@@ -36,26 +36,36 @@ import paddle_tpu.framework
 print("import surface OK on", jax.default_backend())
 EOF
 
-echo "== tpu-lint: jaxpr + SPMD self-check over registered entrypoints =="
+echo "== tpu-lint: jaxpr + SPMD + kernel self-check over registered entrypoints =="
 # Traces the trainer/serve/eval programs on CPU and fails on any
 # error-severity finding (accum-dtype, host-callback-in-loop, and the
 # shard family: entrypoints with a ShardRecipe lower under a 2-device
 # CPU mesh and their compiled HLO is checked for collective-in-decode,
 # mesh-axis-mismatch, ...).  The paged serve/engine entrypoints lint
 # TWICE — XLA gather form and the kernel-selected -kernel twins
-# (Pallas interpret mode; kernel bodies are opaque to the jaxpr rules,
-# and the decode-loop attention gathers must be gone, zero new
-# suppressions).  The paged STEP entrypoints (serve-step, -kernel,
-# engine-step-ragged, -int8) lint under REAL head-sharded ("mp", 2)
-# recipes — pools split on the KV-head axis, bookkeeping replicated —
-# and their decode_collectives contract is exact-set both ways: any
-# collective beyond the declared attention-output all-gather errors,
-# AND an elided all-gather errors (the sharding stopped being
-# exercised).  The -kernel twins shard the same way: under explicit
-# shard_map each device runs its own pallas_call on its local head
-# slice, so GSPMD is never asked to partition the kernel.  Three
-# gates in one invocation:
+# (Pallas interpret mode; the decode-loop attention gathers must be
+# gone, zero new suppressions).  At every pallas_call the walker now
+# descends with the KERNEL-scoped family (analysis/kernel_rules.py):
+# vmem-budget re-derives the per-grid-step VMEM working set from the
+# traced BlockSpecs and errors on any drift from _paged_vmem_bytes or
+# the kernel_vmem_bytes pins in budgets.json; scratch-accum-dtype,
+# oob-index-map (the -1 tail-sentinel clamp proof), and
+# masking-completeness check the kernel body itself.  --self-check
+# also runs kernel_self_check(): a known-bad OOB mutant must produce
+# exactly one finding through the full lint() path, so a refactor
+# that silently stops descending fails here loudly.  The paged STEP
+# entrypoints (serve-step, -kernel, engine-step-ragged(-kernel),
+# -int8(-kernel)) lint under REAL head-sharded ("mp", 2) recipes —
+# pools split on the KV-head axis, bookkeeping replicated — and their
+# decode_collectives contract is exact-set both ways: any collective
+# beyond the declared attention-output all-gather errors, AND an
+# elided all-gather errors (the sharding stopped being exercised).
+# The -kernel twins shard the same way: under explicit shard_map each
+# device runs its own pallas_call on its local head slice, so GSPMD
+# is never asked to partition the kernel.  Three gates in one
+# invocation:
 #   --budgets      per-shard peak-HBM estimate vs analysis/budgets.json
+#                  (+ exact kernel_vmem_bytes pins for kernel twins)
 #   --warn-ratchet post-suppression warn count can only go DOWN
 JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \

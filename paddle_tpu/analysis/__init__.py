@@ -49,6 +49,15 @@ from paddle_tpu.analysis.shard_rules import (SHARD_RULES, ShardRecipe,
                                              shard_check)
 from paddle_tpu.analysis.memory import (MemoryReport, check_budgets,
                                         estimate_target, load_budgets)
+from paddle_tpu.analysis.kernel_rules import (KERNEL_RULES,
+                                              KernelAnalysis,
+                                              KernelRule,
+                                              active_kernel_rules,
+                                              analyze_pallas_call,
+                                              derive_kernel_vmem,
+                                              kernel_self_check,
+                                              max_kernel_vmem,
+                                              register_kernel_rule)
 from paddle_tpu.analysis.nans import nan_check
 
 __all__ = [
@@ -58,5 +67,8 @@ __all__ = [
     "self_check_targets", "SHARD_RULES", "ShardRecipe", "ShardRule",
     "active_shard_rules", "register_shard_rule", "shard_check",
     "MemoryReport", "check_budgets", "estimate_target", "load_budgets",
+    "KERNEL_RULES", "KernelAnalysis", "KernelRule",
+    "active_kernel_rules", "analyze_pallas_call", "derive_kernel_vmem",
+    "kernel_self_check", "max_kernel_vmem", "register_kernel_rule",
     "nan_check",
 ]

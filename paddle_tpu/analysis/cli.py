@@ -71,7 +71,16 @@ def _parse_shapes(spec: str):
 def _resolve_target(spec: str, shapes: Optional[str]):
     from paddle_tpu.analysis.core import LintTarget
     if ":" not in spec:
-        raise SystemExit(f"target {spec!r} must be module:attr")
+        # bare name: a registered entrypoint.  An unknown name is a
+        # HARD usage error — silently skipping a misspelled entrypoint
+        # would exit 0 with the gate never having run.
+        from paddle_tpu.analysis.entrypoints import ENTRYPOINTS
+        if spec in ENTRYPOINTS:
+            return ENTRYPOINTS[spec]()
+        print(f"tpu-lint: unknown entrypoint {spec!r} (and not a "
+              "module:attr target).  Registered entrypoints:\n  "
+              + "\n  ".join(sorted(ENTRYPOINTS)), file=sys.stderr)
+        raise SystemExit(2)
     mod_name, attr = spec.split(":", 1)
     try:
         mod = importlib.import_module(mod_name)
@@ -201,19 +210,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from paddle_tpu.analysis.rules import active_rules
     if args.list_rules:
+        from paddle_tpu.analysis.kernel_rules import active_kernel_rules
         from paddle_tpu.analysis.shard_rules import active_shard_rules
         for rule in active_rules():
             print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
         for rule in active_shard_rules():
             doc = (rule.__doc__ or "").strip().splitlines()[0]
             print(f"{rule.rule_id:<22} {rule.severity:<6} {doc}")
+        for rule in active_kernel_rules():
+            print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
         return 0
 
     from paddle_tpu.analysis.core import lint_target
     targets = []
+    all_findings = []
     if args.self_check:
         from paddle_tpu.analysis.entrypoints import self_check_targets
         targets.extend(self_check_targets())
+        # kernel-rule wiring smoke BEFORE any entrypoint traces: a
+        # registry break (rule unregistered, descent disconnected)
+        # must fail fast as an error finding, not silently lint
+        # kernels with half the family missing
+        from paddle_tpu.analysis.core import Finding
+        from paddle_tpu.analysis.kernel_rules import kernel_self_check
+        try:
+            msg = kernel_self_check()
+            if not args.json:
+                print(msg)
+        except Exception as e:
+            all_findings.append(Finding(
+                rule_id="kernel-rule-smoke", severity="error",
+                path="--self-check",
+                message=f"kernel-rule wiring smoke failed: {e}",
+                suggestion="analysis/kernel_rules.py registration or "
+                           "core.py pallas_call descent broke"))
     for spec in args.targets:
         targets.append(_resolve_target(spec, args.shapes))
     if not targets:
@@ -226,7 +256,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.nans:
         from paddle_tpu.analysis.nans import nan_check
-        all_findings = []
         for target in targets:
             findings = nan_check(target)
             all_findings.extend(findings)
@@ -240,7 +269,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _gate(all_findings, args.fail_on)
 
     from paddle_tpu.analysis.shard_rules import shard_check
-    all_findings = []
     for target in targets:
         findings = lint_target(target, disable=disable,
                                with_cost=args.cost)
@@ -264,11 +292,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             for rep in reports:
                 xla = (f"  (xla temp {rep.xla['temp_size_in_bytes']}B)"
                        if rep.xla else "")
+                kv = (f"  kernel-vmem {rep.kernel_vmem_bytes}B"
+                      if rep.kernel_vmem_bytes else "")
                 print(f"{rep.name:<22} mesh={rep.mesh:<12} "
                       f"peak/shard {rep.peak_bytes}B  "
                       f"args {rep.args_bytes}B  "
                       f"largest-transient "
-                      f"{rep.largest_transient_bytes}B{xla}")
+                      f"{rep.largest_transient_bytes}B{xla}{kv}")
         if args.budgets:
             budget_findings = check_budgets(reports,
                                             load_budgets(args.budgets))

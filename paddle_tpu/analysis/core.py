@@ -139,10 +139,14 @@ class LintContext:
     suppressions and rule disables at report time."""
 
     def __init__(self, disable: Sequence[str] = (),
-                 cost: Optional[Dict[str, float]] = None):
+                 cost: Optional[Dict[str, float]] = None,
+                 opaque_kernels: bool = False):
         self.findings: List[Finding] = []
         self.disable = set(disable)
         self.cost = cost          # whole-program cost_analysis(), if any
+        # escape hatch for third-party kernels: skip the kernel-rule
+        # descent into pallas_call bodies (lint(opaque_kernels=True))
+        self.opaque_kernels = opaque_kernels
 
     def report(self, rule, path: str, message: str, *, eqn=None,
                suggestion: str = "", file: Optional[str] = None,
@@ -217,10 +221,11 @@ def _walk(closed_jaxpr, rules, ctx: LintContext, state: WalkState):
             check = getattr(rule, "check_eqn", None)
             if check is not None:
                 check(eqn, eqn_state, ctx)
-        _descend(eqn, rules, ctx, eqn_state)
+        _descend(eqn, rules, ctx, eqn_state, jaxpr)
 
 
-def _descend(eqn, rules, ctx: LintContext, state: WalkState):
+def _descend(eqn, rules, ctx: LintContext, state: WalkState,
+             enclosing_jaxpr=None):
     """Recurse into an equation's sub-jaxprs with the right loop-depth
     and carry-taint seeding per control-flow primitive."""
     prim = eqn.primitive.name
@@ -269,12 +274,18 @@ def _descend(eqn, rules, ctx: LintContext, state: WalkState):
             t = _inner_taint(state, eqn.invars, inner.jaxpr.invars)
             _walk(inner, rules, ctx, state.at(prim, tainted=t))
     elif prim == "pallas_call":
-        # Kernel bodies are OPAQUE: the inner jaxpr runs under Mosaic's
-        # machine model (VMEM refs, explicit grid pipelining), where
-        # XLA-HBM rules like gather-in-decode are category errors — a
-        # kernel's ref indexing would false-fire them.  The memory
-        # estimator already treats pallas_call as a leaf for the same
-        # reason (memory.py _sub_jaxprs).
+        # Kernel bodies get their OWN rule family (kernel_rules.py):
+        # the inner jaxpr runs under Mosaic's machine model (VMEM refs,
+        # explicit grid pipelining), where XLA-HBM rules like
+        # gather-in-decode are category errors — a kernel's ref
+        # indexing would false-fire them — so the XLA rules still skip
+        # it, and the kernel-scoped family (vmem-budget,
+        # scratch-accum-dtype, oob-index-map, masking-completeness)
+        # checks the kernel contract instead.  ``opaque_kernels=True``
+        # restores the old skip for third-party kernels.
+        if not getattr(ctx, "opaque_kernels", False):
+            from paddle_tpu.analysis.kernel_rules import check_pallas_call
+            check_pallas_call(eqn, state, ctx, enclosing_jaxpr)
         return
     else:
         # generic fallback (remat/checkpoint, closed_call, ...): walk any
@@ -310,7 +321,8 @@ def _program_cost(lowered) -> Optional[Dict[str, float]]:
 
 def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
          *, name: str = "", rules=None, disable: Sequence[str] = (),
-         with_cost: bool = False) -> List[Finding]:
+         with_cost: bool = False,
+         opaque_kernels: bool = False) -> List[Finding]:
     """Trace ``fn(*args, **kwargs)`` and run the rule registry over the
     resulting jaxpr.  Returns findings sorted most-severe-first.
 
@@ -318,6 +330,9 @@ def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
     executes.  ``disable`` removes rules by id for this run;
     ``with_cost=True`` additionally compiles the program (CPU) and
     attaches whole-program flops/bytes to cost-aware findings.
+    ``opaque_kernels=True`` skips the kernel-rule descent into
+    ``pallas_call`` bodies (third-party kernels the kernel contract
+    does not apply to).
     """
     if rules is None:
         from paddle_tpu.analysis.rules import active_rules
@@ -333,7 +348,8 @@ def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
             lowered = None
     cost = _program_cost(lowered) if (with_cost and lowered) else None
 
-    ctx = LintContext(disable=disable, cost=cost)
+    ctx = LintContext(disable=disable, cost=cost,
+                      opaque_kernels=opaque_kernels)
     _walk(closed, rules, ctx, WalkState(path=name))
 
     # function-level rules (donation-audit) see the lowering, not eqns

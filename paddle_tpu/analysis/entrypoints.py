@@ -65,6 +65,13 @@ def register_entrypoint(name: str):
 
 def self_check_targets(names=None) -> List[LintTarget]:
     keys = sorted(ENTRYPOINTS) if names is None else list(names)
+    unknown = [k for k in keys if k not in ENTRYPOINTS]
+    if unknown:
+        # a misspelled entrypoint silently skipping would green-light a
+        # gate that never ran — fail loud with the valid names instead
+        raise KeyError(
+            f"unknown entrypoint(s) {unknown!r}; registered: "
+            f"{', '.join(sorted(ENTRYPOINTS))}")
     return [ENTRYPOINTS[k]() for k in keys]
 
 
@@ -314,9 +321,12 @@ def _paged_engine_decode_faults() -> LintTarget:
 # Kernel-selected twins: the same serve programs with decode_kernel
 # FORCED on (Pallas interpret mode on the CPU lint backend — the
 # traced jaxpr carries the pallas_call eqn either way, which is what
-# the gate is for: the kernel body must stay opaque to the XLA-HBM
-# rules and the attention gathers must be GONE from the decode loop,
-# with zero new suppressions).  The serve twin shards like
+# the gate is for: the attention gathers must be GONE from the decode
+# loop with zero new suppressions, the XLA-HBM rules still skip the
+# kernel body, and the KERNEL rule family (analysis/kernel_rules.py)
+# opens it — vmem-budget cross-checks the derived footprint against
+# _paged_vmem_bytes per dtype arm, scratch/oob/masking prove the
+# kernel contract from the trace).  The serve twin shards like
 # paged-serve-step: GSPMD cannot AUTO-partition a pallas_call, but the
 # mesh path never asks it to — under the explicit shard_map each
 # device runs its own pallas_call over its local head slice, so the
@@ -414,6 +424,60 @@ def _paged_engine_step_ragged() -> LintTarget:
             7, (1,), "head-sharded KV pool (paged_cache_shardings on "
             "the cache arg); params + slot vectors replicate; exactly "
             "the attention-output all-gather in the step"))
+
+
+@register_entrypoint("paged-engine-step-ragged-kernel")
+def _paged_engine_step_ragged_kernel() -> LintTarget:
+    # The unified ragged step with the Pallas kernel FORCED on and a
+    # bf16 KV pool: the arm that exercises _paged_vmem_bytes' 6 B/elt
+    # charge (Mosaic stages packed bf16 tiles through unpacked copies).
+    # The kernel rules open the pallas_call and re-derive the footprint
+    # from its BlockSpecs — estimator drift on THIS arm fails lint
+    # here, per entrypoint, exactly as the int8 twin below pins the
+    # 5 B/elt arm.  Same head-sharded recipe as the XLA ragged step:
+    # under explicit shard_map each device runs its own pallas_call on
+    # its local head slice.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,), kv_dtype="bfloat16",
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             mesh=_mesh_or_none(), decode_kernel=True)
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-ragged-kernel", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_paged_mp_recipe(
+            7, (1,), "head-sharded bf16 pool; each device runs its "
+            "own pallas_call on local heads inside shard_map; same "
+            "single all-gather contract as the XLA ragged twin"))
+
+
+@register_entrypoint("paged-engine-step-int8-kernel")
+def _paged_engine_step_int8_kernel() -> LintTarget:
+    # The quantized kernel twin: unified ragged step, Pallas kernel
+    # forced on, int8 pages + per-block scales.  Pins the estimator's
+    # 5 B/elt int8 arm (1 packed byte streamed + 4-byte f32 dequant
+    # staging) through the same derived-vs-estimator cross-check, and
+    # proves the in-kernel dequant keeps f32 accumulation
+    # (scratch-accum-dtype) and complete masking.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,), kv_dtype="int8",
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             mesh=_mesh_or_none(), decode_kernel=True)
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-int8-kernel", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_paged_mp_recipe(
+            7, (1,), "head-sharded int8 pool + scales, kernel forced; "
+            "same single all-gather contract as the int8 XLA twin"))
 
 
 @register_entrypoint("paged-engine-step-int8")

@@ -1,0 +1,839 @@
+"""Kernel-scoped lint rules: tpu-lint's descent into ``pallas_call``.
+
+Since PR 6 the jaxpr walker early-returned at ``pallas_call`` — the
+ragged paged-attention kernel, the single hottest program in the repo,
+was the one region the static analyzer could not see.  Its VMEM budget
+was guarded only by the hand-maintained ``_paged_vmem_bytes``
+estimator and interpret-mode tests.  This module is the descent: a
+traced ``pallas_call`` equation carries everything the kernel contract
+needs statically — the kernel body jaxpr, the grid, every BlockSpec's
+block shape and index-map jaxpr, the scalar-prefetch operand count,
+and the scratch avals — so the contract is PROVED from the trace
+instead of hand-mirrored.
+
+The family (all ``error`` severity — each one is a correctness or OOM
+trap, not an advisory):
+
+==========================  ==========================================
+rule                        fires when
+==========================  ==========================================
+vmem-budget                 the per-grid-step VMEM footprint DERIVED
+                            from block shapes + scratch avals exceeds
+                            the resident budget, or (for the repo's
+                            paged kernel) disagrees with
+                            ``_paged_vmem_bytes`` — estimator drift
+                            becomes a lint error, per entrypoint,
+                            including the int8 5 B/elt arm
+scratch-accum-dtype         an online-softmax / dot accumulator lives
+                            in bf16/f16 — VMEM scratch avals and
+                            in-kernel ``dot_general`` outputs must be
+                            f32 even when the pools are bf16/int8
+oob-index-map               a BlockSpec index map, evaluated in
+                            interval arithmetic over the grid bounds,
+                            can address past the operand's extent —
+                            or a TABLE-GATHERED map's scalar-prefetch
+                            operand has no clamp proof at the call
+                            site (the bug class the ``-1``
+                            tail-sentinel clip protects against)
+masking-completeness        a softmax ``exp`` consumes data loaded
+                            from a gathered page with no
+                            ``kpos < lengths[r]+j+1``-shaped predicate
+                            anywhere on its dataflow — the unmasked-
+                            garbage-lane silent-wrong-answer bug
+                            interpret tests miss at untested shapes
+==========================  ==========================================
+
+Each rule reports AT MOST ONE finding per ``pallas_call`` (violations
+are aggregated into the message): the units of review are kernels, not
+the dozens of taint paths a single dropped predicate poisons.
+
+What is PROVED vs. TESTED (docs/design/analysis.md has the worked
+examples): affine index maps are proved in-bounds or proved violating
+by interval arithmetic over the grid corners — an interval the
+arithmetic cannot bound stays QUIET (no false fires on exotic affine
+maps).  Gathered maps invert the burden: their index is runtime table
+data, so the rule DEMANDS a clamp proof on the operand's producer
+chain (descending ``jnp.clip``'s ``pjit`` wrapper to its ``max``/
+``min``/``clamp`` bounds) and errors when none exists.  Masking and
+scratch dtypes are taint/aval proofs over the kernel jaxpr.  Numeric
+parity with the XLA fallback remains the interpret-mode test suite's
+job — lint proves shape/dataflow contracts, not values.
+
+The XLA-HBM rule family (``rules.py``) still skips kernel bodies: a
+kernel's ref indexing would false-fire gather-in-decode, and the HBM
+liveness estimator keeps treating ``pallas_call`` as a leaf (kernel
+VMEM is Mosaic's ledger — surfaced separately as
+``MemoryReport.kernel_vmem_bytes`` and gated by ``budgets.json``'s
+``kernel_vmem_bytes`` keys).  ``lint(..., opaque_kernels=True)``
+restores the old skip for third-party kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+from jax._src import core as jcore
+
+__all__ = ["KernelRule", "KERNEL_RULES", "register_kernel_rule",
+           "active_kernel_rules", "KernelAnalysis", "analyze_pallas_call",
+           "check_pallas_call", "iter_pallas_calls", "derive_kernel_vmem",
+           "max_kernel_vmem", "kernel_self_check"]
+
+
+def _ppa():
+    """The paged-attention kernel module, looked up LIVE at check time:
+    the drift rule must see a monkeypatched ``_paged_vmem_bytes`` (the
+    poison-the-estimator test), so nothing from it is bound at import.
+    """
+    from paddle_tpu.ops import pallas_paged_attention
+    return pallas_paged_attention
+
+
+class KernelRule:
+    rule_id: str = ""
+    severity: str = "error"
+    doc: str = ""
+
+    def check_kernel(self, ka: "KernelAnalysis", state, ctx) -> None:
+        raise NotImplementedError
+
+
+KERNEL_RULES: Dict[str, type] = {}
+
+
+def register_kernel_rule(cls):
+    assert cls.rule_id and cls.rule_id not in KERNEL_RULES, cls
+    KERNEL_RULES[cls.rule_id] = cls
+    return cls
+
+
+def active_kernel_rules() -> List[KernelRule]:
+    return [cls() for cls in KERNEL_RULES.values()]
+
+
+# ------------------------------------------------------- interval arithmetic
+#
+# Intervals are (lo, hi) pairs of ints; None on a side means unbounded.
+# The arithmetic is deliberately conservative: anything it cannot bound
+# widens to unknown, and the rules only act on what IS bounded (affine
+# proofs) or on the gathered/unproven combination (clamp demands).
+
+_UNKNOWN: Tuple[Optional[int], Optional[int]] = (None, None)
+
+
+def _const_interval(val) -> Tuple[Optional[int], Optional[int]]:
+    try:
+        arr = np.asarray(val)
+        if arr.size == 0 or arr.dtype.kind not in "iub":
+            return _UNKNOWN
+        return (int(arr.min()), int(arr.max()))
+    except Exception:
+        return _UNKNOWN
+
+
+def _ivl_max(a, b):
+    los = [x for x in (a[0], b[0]) if x is not None]
+    lo = max(los) if los else None
+    hi = (None if a[1] is None or b[1] is None else max(a[1], b[1]))
+    return (lo, hi)
+
+
+def _ivl_min(a, b):
+    his = [x for x in (a[1], b[1]) if x is not None]
+    hi = min(his) if his else None
+    lo = (None if a[0] is None or b[0] is None else min(a[0], b[0]))
+    return (lo, hi)
+
+
+def _ivl_add(a, b):
+    return (None if a[0] is None or b[0] is None else a[0] + b[0],
+            None if a[1] is None or b[1] is None else a[1] + b[1])
+
+
+def _ivl_sub(a, b):
+    return (None if a[0] is None or b[1] is None else a[0] - b[1],
+            None if a[1] is None or b[0] is None else a[1] - b[0])
+
+
+def _ivl_mul(a, b):
+    if None in a or None in b:
+        return _UNKNOWN
+    corners = [a[i] * b[j] for i in (0, 1) for j in (0, 1)]
+    return (min(corners), max(corners))
+
+
+def _combine(prim: str, ivs) -> Tuple[Optional[int], Optional[int]]:
+    if prim == "add":
+        return _ivl_add(ivs[0], ivs[1])
+    if prim == "sub":
+        return _ivl_sub(ivs[0], ivs[1])
+    if prim == "mul":
+        return _ivl_mul(ivs[0], ivs[1])
+    if prim == "max":
+        return _ivl_max(ivs[0], ivs[1])
+    if prim == "min":
+        return _ivl_min(ivs[0], ivs[1])
+    if prim == "clamp":
+        # clamp(min, x, max): each declared bound caps its side even
+        # when x itself is unbounded — exactly the table-clip proof
+        mn, x, mx = ivs
+        return (mn[0] if mn[0] is not None else x[0],
+                mx[1] if mx[1] is not None else x[1])
+    if prim == "rem":
+        a, b = ivs
+        if (b[0] is not None and b[0] > 0 and b[1] is not None
+                and a[0] is not None and a[0] >= 0):
+            return (0, b[1] - 1)
+        return _UNKNOWN
+    return _UNKNOWN
+
+
+def _producers(jaxpr) -> Dict[int, Any]:
+    out: Dict[int, Any] = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            out[id(v)] = eqn
+    return out
+
+
+# value-preserving wrappers the producer walk looks through
+_PASSTHROUGH = ("convert_element_type", "copy", "reshape", "squeeze",
+                "broadcast_in_dim", "stop_gradient", "device_put")
+
+
+def _value_interval(var, producers: Dict[int, Any],
+                    env: Dict[int, Tuple], depth: int = 0):
+    """Best-effort integer interval of one value inside a jaxpr, walking
+    producer chains through ``pjit`` bodies (``jnp.clip`` traces as
+    ``pjit:clip`` around ``max``/``min``) up to a small depth."""
+    if isinstance(var, jcore.Literal):
+        return _const_interval(var.val)
+    if id(var) in env:
+        return env[id(var)]
+    if depth > 16:
+        return _UNKNOWN
+    eqn = producers.get(id(var))
+    if eqn is None:
+        return _UNKNOWN
+    prim = eqn.primitive.name
+    if prim in _PASSTHROUGH:
+        return _value_interval(eqn.invars[0], producers, env, depth + 1)
+    if prim == "pjit":
+        inner = eqn.params["jaxpr"].jaxpr
+        ienv = {id(iv): _value_interval(ov, producers, env, depth + 1)
+                for ov, iv in zip(eqn.invars, inner.invars)}
+        k = next((i for i, ov in enumerate(eqn.outvars) if ov is var),
+                 None)
+        if k is None or k >= len(inner.outvars):
+            return _UNKNOWN
+        return _value_interval(inner.outvars[k], _producers(inner),
+                               ienv, depth + 1)
+    if prim == "iota":
+        dim = eqn.params.get("dimension", 0)
+        shape = eqn.params.get("shape") or getattr(
+            eqn.outvars[0].aval, "shape", ())
+        try:
+            return (0, max(0, int(shape[dim]) - 1))
+        except Exception:
+            return _UNKNOWN
+    if prim in ("add", "sub", "mul", "max", "min", "clamp", "rem"):
+        ivs = [_value_interval(v, producers, env, depth + 1)
+               for v in eqn.invars]
+        return _combine(prim, ivs)
+    return _UNKNOWN
+
+
+# ----------------------------------------------------------- the analysis
+
+
+@dataclasses.dataclass
+class KernelAnalysis:
+    """Everything a kernel rule reads from one traced ``pallas_call``:
+    the kernel body jaxpr, the grid, the per-operand block mappings
+    (with index-map jaxprs), scratch avals, and which INPUTS are
+    table-GATHERED (their index map reads a scalar-prefetch ref) —
+    the distinction the VMEM charging, masking, and OOB proofs all
+    pivot on."""
+    eqn: Any                        # the pallas_call eqn
+    enclosing_jaxpr: Any            # jaxpr containing it (clamp proofs)
+    name: str                       # kernel fn name (name_and_src_info)
+    jaxpr: Any                      # kernel body Jaxpr
+    grid: Tuple[int, ...]
+    num_prefetch: int
+    num_inputs: int
+    num_outputs: int
+    in_block_mappings: Tuple
+    out_block_mappings: Tuple
+    scratch_avals: Tuple
+    gathered_inputs: FrozenSet[int]   # input indices fetched by table
+
+    def input_aval(self, i: int):
+        return self.eqn.invars[self.num_prefetch + i].aval
+
+    @property
+    def prefetch_ref_ids(self) -> FrozenSet[int]:
+        return frozenset(id(v)
+                         for v in self.jaxpr.invars[:self.num_prefetch])
+
+    @property
+    def gathered_ref_ids(self) -> FrozenSet[int]:
+        return frozenset(id(self.jaxpr.invars[self.num_prefetch + i])
+                         for i in self.gathered_inputs)
+
+
+def _index_map_reads_prefetch(imj, n_grid: int) -> bool:
+    prefetch_ids = {id(v) for v in imj.invars[n_grid:]}
+    return any(e.primitive.name == "get" and e.invars
+               and id(e.invars[0]) in prefetch_ids for e in imj.eqns)
+
+
+def analyze_pallas_call(eqn, enclosing_jaxpr) -> Optional[KernelAnalysis]:
+    """Pull the kernel contract out of a traced ``pallas_call``; None
+    when the metadata this jax version exposes does not match (the
+    rules then skip rather than crash the gate)."""
+    try:
+        params = eqn.params
+        gm = params["grid_mapping"]
+        body = params["jaxpr"]
+        body = getattr(body, "jaxpr", body)
+        grid = tuple(int(g) for g in gm.grid)
+        np_, ni, no = (int(gm.num_index_operands), int(gm.num_inputs),
+                       int(gm.num_outputs))
+        bms = tuple(gm.block_mappings)
+        in_bms, out_bms = bms[:ni], bms[ni:ni + no]
+        scratch = tuple(v.aval
+                        for v in body.invars[np_ + ni + no:])
+        gathered = frozenset(
+            i for i, bm in enumerate(in_bms)
+            if _index_map_reads_prefetch(bm.index_map_jaxpr.jaxpr,
+                                         len(grid)))
+        name = str(params.get("name_and_src_info", "")).split(" at ")[0]
+        return KernelAnalysis(
+            eqn=eqn, enclosing_jaxpr=enclosing_jaxpr, name=name or "?",
+            jaxpr=body, grid=grid, num_prefetch=np_, num_inputs=ni,
+            num_outputs=no, in_block_mappings=in_bms,
+            out_block_mappings=out_bms, scratch_avals=scratch,
+            gathered_inputs=gathered)
+    except Exception:
+        return None
+
+
+def check_pallas_call(eqn, state, ctx, enclosing_jaxpr,
+                      rules: Optional[List[KernelRule]] = None) -> None:
+    """Entry point from ``core._descend``: run the kernel family over
+    one traced ``pallas_call``."""
+    ka = analyze_pallas_call(eqn, enclosing_jaxpr)
+    if ka is None:
+        return
+    for rule in (active_kernel_rules() if rules is None else rules):
+        rule.check_kernel(ka, state, ctx)
+
+
+def iter_pallas_calls(jaxpr):
+    """Yield ``(pallas_call eqn, enclosing jaxpr)`` pairs from a jaxpr
+    tree, recursing through every jaxpr-valued equation param (pjit,
+    while/scan/cond, shard_map, remat, ...)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            yield eqn, jaxpr
+        for val in (eqn.params or {}).values():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                    yield from iter_pallas_calls(getattr(v, "jaxpr", v))
+
+
+# --------------------------------------------------------- VMEM derivation
+
+
+def _block_elems(block_shape) -> int:
+    n = 1
+    for d in block_shape:
+        n *= 1 if d is None else int(d)
+    return n
+
+
+def _per_elt_streamed(dtype) -> int:
+    """Bytes/element CHARGED for a double-buffered streamed block —
+    deliberately the same policy ``_paged_vmem_bytes`` documents (bf16
+    tiles stage through unpacked copies: 6; int8 streams 1 packed byte
+    plus a 4-byte f32 dequant staging copy: 5; else 4).  The policy is
+    duplicated here ON PURPOSE: deriving both sides from shared code
+    would make estimator drift undetectable — disagreement IS the
+    signal the vmem-budget rule exists for."""
+    dt = np.dtype(dtype)
+    if dt == np.dtype("bfloat16") if hasattr(np, "bfloat16") else False:
+        return 6   # pragma: no cover - numpy lacks bfloat16 natively
+    if str(dt) == "bfloat16" or dtype == "bfloat16":
+        return 6
+    if dt.itemsize == 1:
+        return 5
+    return 4
+
+
+def derive_kernel_vmem(ka: KernelAnalysis) -> int:
+    """Per-grid-step resident VMEM bytes derived from the traced kernel:
+    gathered inputs stream double-buffered at the dtype's charge rate,
+    non-gathered inputs and outputs stage double-buffered f32 (4 B),
+    scratch counts its aval bytes verbatim."""
+    total = 0
+    for i, bm in enumerate(ka.in_block_mappings):
+        elems = _block_elems(bm.block_shape)
+        if i in ka.gathered_inputs:
+            dtype = getattr(ka.input_aval(i), "dtype", np.float32)
+            total += 2 * elems * _per_elt_streamed(dtype)
+        else:
+            total += 2 * elems * 4
+    for bm in ka.out_block_mappings:
+        total += 2 * _block_elems(bm.block_shape) * 4
+    for aval in ka.scratch_avals:
+        shape = getattr(aval, "shape", ())
+        dtype = getattr(aval, "dtype", np.float32)
+        try:
+            itemsize = np.dtype(dtype).itemsize
+        except TypeError:
+            itemsize = 2 if str(dtype) == "bfloat16" else 4
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def max_kernel_vmem(jaxpr) -> int:
+    """Largest derived kernel footprint over every ``pallas_call`` in a
+    jaxpr tree (0 when there is none) — what ``memory.py`` surfaces as
+    ``MemoryReport.kernel_vmem_bytes`` and ``budgets.json`` gates."""
+    best = 0
+    for eqn, encl in iter_pallas_calls(jaxpr):
+        ka = analyze_pallas_call(eqn, encl)
+        if ka is not None:
+            best = max(best, derive_kernel_vmem(ka))
+    return best
+
+
+# ----------------------------------------------------------------- rules
+
+
+@register_kernel_rule
+class KernelVmemBudgetRule(KernelRule):
+    """The derived footprint must fit the resident budget, and — for the
+    repo's ragged paged-attention kernel — must EQUAL what
+    ``_paged_vmem_bytes`` predicts for the same (block_size, group,
+    head_dim, kv_dtype, max_q).  The hand estimator gates dispatch
+    (``paged_attention_supported``); if it drifts from the traced
+    kernel it silently mis-sizes the fallback envelope, so drift is an
+    error per entrypoint — bf16's 6 B/elt and int8's 5 B/elt arms
+    included."""
+
+    rule_id = "vmem-budget"
+    severity = "error"
+    doc = ("kernel VMEM footprint derived from BlockSpecs/scratch "
+           "exceeds the resident budget, or drifts from "
+           "_paged_vmem_bytes on the paged kernel")
+
+    def check_kernel(self, ka, state, ctx):
+        ppa = _ppa()
+        derived = derive_kernel_vmem(ka)
+        budget = int(ppa._PAGED_RESIDENT_BUDGET)
+        problems = []
+        if derived > budget:
+            problems.append(
+                f"derived per-grid-step VMEM {derived} B exceeds the "
+                f"resident budget {budget} B — Mosaic would OOM at "
+                "compile time on a real chip")
+        if (ka.name == ppa.PAGED_KERNEL_NAME and ka.gathered_inputs
+                and len(ka.in_block_mappings) >= 2):
+            gi = min(ka.gathered_inputs)
+            kv_bs = ka.in_block_mappings[gi].block_shape
+            qi = next((i for i in range(len(ka.in_block_mappings))
+                       if i not in ka.gathered_inputs), None)
+            if qi is not None and len(kv_bs) == 4:
+                q_bs = ka.in_block_mappings[qi].block_shape
+                bs, g, hd = int(kv_bs[1]), int(kv_bs[2]), int(kv_bs[3])
+                tq = int(q_bs[1])
+                kv_dtype = getattr(ka.input_aval(gi), "dtype",
+                                   np.float32)
+                est = int(ppa._paged_vmem_bytes(bs, g, hd, kv_dtype,
+                                                tq))
+                if est != derived:
+                    problems.append(
+                        f"estimator drift: _paged_vmem_bytes(block_size"
+                        f"={bs}, group={g}, head_dim={hd}, kv_dtype="
+                        f"{np.dtype(kv_dtype) if not isinstance(kv_dtype, str) else kv_dtype}, "
+                        f"max_q={tq}) says {est} B but the traced "
+                        f"kernel derives {derived} B — the dispatch "
+                        "envelope (paged_attention_supported) is "
+                        "sized by a number the kernel no longer "
+                        "matches")
+        if problems:
+            ctx.report(
+                self, f"{state.path}/pallas_call:{ka.name}",
+                "; ".join(problems), eqn=ka.eqn,
+                suggestion="re-anchor _paged_vmem_bytes to the kernel's "
+                           "actual blocks/scratch (they must agree "
+                           "exactly), or shrink the head group / block "
+                           "size until the working set fits")
+
+
+@register_kernel_rule
+class KernelScratchDtypeRule(KernelRule):
+    """The in-kernel twin of ``accum-dtype``: online-softmax state
+    (running max / sum / acc in VMEM scratch) and ``dot_general``
+    accumulators must be f32 even when the streamed pools are
+    bf16/int8 — a bf16 accumulator re-rounds every page merge and the
+    error grows with sequence length, the silent-precision-loss class
+    PR 1 fixed in the XLA form."""
+
+    rule_id = "scratch-accum-dtype"
+    severity = "error"
+    doc = ("bf16/f16 VMEM scratch accumulator or in-kernel dot "
+           "accumulating in a narrow float")
+
+    _NARROW = ("bfloat16", "float16")
+
+    def _dtype_name(self, dtype) -> str:
+        try:
+            return np.dtype(dtype).name
+        except TypeError:
+            return str(dtype)
+
+    def check_kernel(self, ka, state, ctx):
+        problems = []
+        for k, aval in enumerate(ka.scratch_avals):
+            dn = self._dtype_name(getattr(aval, "dtype", None))
+            if dn in self._NARROW:
+                shape = tuple(getattr(aval, "shape", ()))
+                problems.append(f"scratch ref #{k} ({dn}{shape}) "
+                                "accumulates across the grid in a "
+                                "narrow float")
+        for eqn in _flat_eqns(ka.jaxpr):
+            if eqn.primitive.name != "dot_general":
+                continue
+            dn = self._dtype_name(getattr(eqn.outvars[0].aval, "dtype",
+                                          None))
+            if dn in self._NARROW:
+                problems.append(
+                    f"in-kernel dot_general accumulates in {dn}")
+        if problems:
+            ctx.report(
+                self, f"{state.path}/pallas_call:{ka.name}",
+                "; ".join(problems), eqn=ka.eqn,
+                suggestion="keep softmax state and dot accumulators in "
+                           "f32 (pltpu.VMEM(..., jnp.float32), "
+                           "preferred_element_type=jnp.float32); "
+                           "downcast once, at the output write")
+
+
+@register_kernel_rule
+class KernelOobIndexMapRule(KernelRule):
+    """Evaluate every BlockSpec index map symbolically over the grid
+    bounds.  An AFFINE map is an error only when a corner PROVABLY
+    addresses past the operand ((hi+1) * block_size > extent, or a
+    negative block index); an interval the arithmetic cannot bound
+    stays quiet.  A table-GATHERED map inverts the burden: its index is
+    runtime data, so the scalar-prefetch operand feeding it must carry
+    a clamp proof on its producer chain (the ``jnp.clip(table, 0,
+    nb-1)`` every caller ships — the ``-1`` tail-sentinel class) whose
+    bounds fit the pool; no proof is an error."""
+
+    rule_id = "oob-index-map"
+    severity = "error"
+    doc = ("BlockSpec index map can address past the operand extent, "
+           "or a gathered map's table operand lacks a clamp proof")
+
+    def check_kernel(self, ka, state, ctx):
+        outer_prods = _producers(ka.enclosing_jaxpr)
+
+        def prefetch_bound(k: int):
+            if k >= len(ka.eqn.invars):
+                return _UNKNOWN
+            return _value_interval(ka.eqn.invars[k], outer_prods, {})
+
+        problems = []
+        all_bms = (list(enumerate(ka.in_block_mappings))
+                   + [(ka.num_inputs + j, bm)
+                      for j, bm in enumerate(ka.out_block_mappings)])
+        for oi, bm in all_bms:
+            imj = bm.index_map_jaxpr.jaxpr
+            extents = tuple(bm.array_shape_dtype.shape)
+            label = (f"input {oi}" if oi < ka.num_inputs
+                     else f"output {oi - ka.num_inputs}")
+            results = self._eval_map(imj, ka.grid, prefetch_bound)
+            for dim, ((lo, hi), gathered) in enumerate(results):
+                if dim >= len(extents):
+                    break
+                bs_d = bm.block_shape[dim]
+                span = 1 if bs_d is None else int(bs_d)
+                ext = int(extents[dim])
+                if lo is not None and hi is not None:
+                    if lo < 0 or (hi + 1) * span > ext:
+                        problems.append(
+                            f"{label} dim {dim}: block index in "
+                            f"[{lo}, {hi}] x block {span} can address "
+                            f"past extent {ext}")
+                elif gathered:
+                    problems.append(
+                        f"{label} dim {dim}: table-gathered block "
+                        "index has no clamp proof at the call site — "
+                        "a -1 (unmapped) or stale table entry would "
+                        "fetch out of the pool")
+        if problems:
+            ctx.report(
+                self, f"{state.path}/pallas_call:{ka.name}",
+                "; ".join(problems), eqn=ka.eqn,
+                suggestion="clip the block table at the call site "
+                           "(jnp.clip(table, 0, num_blocks - 1), as "
+                           "paged_ragged_attention_kernel does) and "
+                           "keep affine maps inside the operand "
+                           "extent at every grid corner")
+
+    @staticmethod
+    def _eval_map(imj, grid, prefetch_bound: Callable[[int], Tuple]):
+        """Evaluate an index-map jaxpr over grid-corner intervals;
+        returns per-output ``((lo, hi), gathered)``."""
+        vals: Dict[int, Tuple] = {}     # var id -> ((lo, hi), gathered)
+        ref_k: Dict[int, int] = {}      # var id of prefetch ref -> index
+        n_grid = len(grid)
+        for i, iv in enumerate(imj.invars):
+            if i < n_grid:
+                vals[id(iv)] = ((0, max(0, grid[i] - 1)), False)
+            else:
+                ref_k[id(iv)] = i - n_grid
+
+        def read(v):
+            if isinstance(v, jcore.Literal):
+                return (_const_interval(v.val), False)
+            return vals.get(id(v), (_UNKNOWN, False))
+
+        for eqn in imj.eqns:
+            prim = eqn.primitive.name
+            if (prim == "get" and eqn.invars
+                    and id(eqn.invars[0]) in ref_k):
+                out = (prefetch_bound(ref_k[id(eqn.invars[0])]), True)
+            else:
+                ins = [read(v) for v in eqn.invars]
+                gathered = any(g for _, g in ins)
+                if prim in _PASSTHROUGH:
+                    out = (ins[0][0] if ins else _UNKNOWN, gathered)
+                elif prim in ("add", "sub", "mul", "max", "min",
+                              "clamp", "rem"):
+                    out = (_combine(prim, [iv for iv, _ in ins]),
+                           gathered)
+                else:
+                    out = (_UNKNOWN, gathered)
+            for ov in eqn.outvars:
+                vals[id(ov)] = out
+        return [read(ov) for ov in imj.outvars]
+
+
+@register_kernel_rule
+class KernelMaskingRule(KernelRule):
+    """Every softmax ``exp`` that consumes gathered-page data must be
+    dominated by a length-bound predicate: the rule taints (a) values
+    loaded from table-gathered input refs (K/V page tiles), (b) values
+    derived from scalar-prefetch SMEM reads (the per-row ``lengths``),
+    and (c) outputs of comparisons whose operands derive from (b) —
+    the ``kpos < lengths[r]+j+1`` shape.  An ``exp`` whose input is
+    (a)-tainted but not (c)-tainted consumes unmasked garbage lanes —
+    positions past the row's bound, unwritten pages behind ``-1``
+    table entries — and the softmax silently weights them.  Taint
+    flows through VMEM scratch (``swap`` marks the ref), so one
+    dropped predicate poisons the whole online-softmax chain: the rule
+    aggregates to ONE finding per kernel."""
+
+    rule_id = "masking-completeness"
+    severity = "error"
+    doc = ("softmax exp consumes gathered-page data with no "
+           "length-bound predicate on its dataflow")
+
+    _CMP = ("lt", "le", "gt", "ge")
+
+    def check_kernel(self, ka, state, ctx):
+        if not ka.gathered_inputs:
+            return
+        tk: set = set()    # gathered-K/V taint
+        tm: set = set()    # mask-predicate taint
+        ts: set = set()    # scalar-prefetch-derived taint (lengths)
+        seed = {}
+        for vid in ka.gathered_ref_ids:
+            seed[vid] = {"gathered_ref"}
+        for vid in ka.prefetch_ref_ids:
+            seed.setdefault(vid, set()).add("smem_ref")
+        unmasked = self._walk(ka.jaxpr, tk, tm, ts, seed)
+        if unmasked:
+            ctx.report(
+                self, f"{state.path}/pallas_call:{ka.name}",
+                f"{unmasked} softmax exp(s) consume data loaded from "
+                "gathered pages with NO length-bound predicate "
+                "anywhere on their dataflow — garbage tail lanes and "
+                "unwritten pages get nonzero weight (the silent-"
+                "wrong-answer class interpret tests miss at untested "
+                "shapes)", eqn=ka.eqn,
+                suggestion="apply the per-query causal bound before "
+                           "the softmax: bias = where(kpos < "
+                           "lengths[r] + j + 1, 0, NEG_INF), added to "
+                           "the scores ahead of every exp")
+
+    def _walk(self, jaxpr, tk, tm, ts, refs: Dict[int, set]) -> int:
+        """Forward taint propagation over one (sub-)jaxpr; returns the
+        count of K-tainted-but-unmasked ``exp`` eqns.  ``refs`` maps
+        ref-var ids to their roles; ``swap`` writes taint INTO a ref,
+        ``get`` reads it back out."""
+        unmasked = 0
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            invars = [v for v in eqn.invars if isinstance(v, jcore.Var)]
+            k_in = any(id(v) in tk for v in invars)
+            m_in = any(id(v) in tm for v in invars)
+            s_in = any(id(v) in ts for v in invars)
+            if prim == "get" and eqn.invars:
+                roles = refs.get(id(eqn.invars[0]), ())
+                if "gathered_ref" in roles:
+                    k_in = True
+                if "smem_ref" in roles:
+                    s_in = True
+            if prim in self._CMP and s_in:
+                m_in = True
+            if prim == "swap" and eqn.invars:
+                # writing a tainted value into a ref taints the ref
+                # itself: later reads (next group iteration's m_prev/
+                # acc) inherit it
+                rid = id(eqn.invars[0])
+                if k_in:
+                    tk.add(rid)
+                if m_in:
+                    tm.add(rid)
+                if s_in:
+                    ts.add(rid)
+            if prim == "exp" and k_in and not m_in:
+                unmasked += 1
+            # recurse into sub-jaxprs (pl.when conds, where pjits)
+            # with taints mapped across the boundary both ways
+            unmasked += self._descend(eqn, tk, tm, ts, refs)
+            for ov in eqn.outvars:
+                if k_in:
+                    tk.add(id(ov))
+                if m_in:
+                    tm.add(id(ov))
+                if s_in:
+                    ts.add(id(ov))
+        return unmasked
+
+    def _descend(self, eqn, tk, tm, ts, refs) -> int:
+        inners = []
+        prim = eqn.primitive.name
+        params = eqn.params or {}
+        if prim == "cond":
+            inners = [(getattr(b, "jaxpr", b), list(eqn.invars[1:]))
+                      for b in params.get("branches", ())]
+        else:
+            for val in params.values():
+                vals = val if isinstance(val, (tuple, list)) else (val,)
+                for v in vals:
+                    if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                        inners.append((getattr(v, "jaxpr", v),
+                                       list(eqn.invars)))
+        total = 0
+        for inner, operands in inners:
+            imap = list(zip(operands, inner.invars))
+            for ov, iv in imap:
+                if not isinstance(ov, jcore.Var):
+                    continue
+                if id(ov) in tk:
+                    tk.add(id(iv))
+                if id(ov) in tm:
+                    tm.add(id(iv))
+                if id(ov) in ts:
+                    ts.add(id(iv))
+                if id(ov) in refs:
+                    refs[id(iv)] = refs[id(ov)]
+            total += self._walk(inner, tk, tm, ts, refs)
+            # ref mutations inside the branch surface to the caller
+            for ov, iv in imap:
+                if not isinstance(ov, jcore.Var):
+                    continue
+                if id(iv) in tk:
+                    tk.add(id(ov))
+                if id(iv) in tm:
+                    tm.add(id(ov))
+                if id(iv) in ts:
+                    ts.add(id(ov))
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                if isinstance(iv, jcore.Var):
+                    if id(iv) in tk:
+                        tk.add(id(ov))
+                    if id(iv) in tm:
+                        tm.add(id(ov))
+                    if id(iv) in ts:
+                        ts.add(id(ov))
+        return total
+
+
+def _flat_eqns(jaxpr):
+    """All equations of a jaxpr tree, sub-jaxprs inlined (order
+    preserved within each body)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in (eqn.params or {}).values():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                    yield from _flat_eqns(getattr(v, "jaxpr", v))
+
+
+# ------------------------------------------------------------- smoke check
+
+
+def kernel_self_check() -> str:
+    """Registry wiring smoke for ``--self-check``: the four kernel
+    rules must be registered, a deliberately-OOB mutant kernel must
+    produce exactly the oob finding through the full ``lint()`` path
+    (proving ``core._descend`` actually opens ``pallas_call``), and a
+    clean copy kernel must produce none.  Raises on any break — the
+    CLI converts that into an error finding so a wiring regression
+    fails the gate fast, before any entrypoint traces."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from paddle_tpu.analysis.core import lint
+
+    required = {"vmem-budget", "scratch-accum-dtype", "oob-index-map",
+                "masking-completeness"}
+    missing = required - set(KERNEL_RULES)
+    if missing:
+        raise RuntimeError(
+            f"kernel rule registry is missing {sorted(missing)} — "
+            "kernel_rules.py registration broke")
+
+    def _copy(index_map):
+        def fn(x):
+            return pl.pallas_call(
+                lambda x_ref, o_ref: o_ref.__setitem__(
+                    slice(None), x_ref[:]),
+                grid=(2,),
+                in_specs=[pl.BlockSpec((4,), index_map)],
+                out_specs=pl.BlockSpec((4,), lambda i: (i,)),
+                out_shape=jax.ShapeDtypeStruct((8,), jnp.float32),
+                interpret=True)(x)
+        return fn
+
+    x = jnp.zeros((8,), jnp.float32)
+    bad = lint(_copy(lambda i: (i + 1,)), (x,), name="kernel-smoke-bad")
+    oob = [f for f in bad if f.rule_id == "oob-index-map"]
+    if len(oob) != 1:
+        raise RuntimeError(
+            "kernel-rule smoke: the OOB mutant kernel produced "
+            f"{len(oob)} oob-index-map finding(s), expected exactly 1 "
+            "— core.py is no longer descending into pallas_call")
+    good = lint(_copy(lambda i: (i,)), (x,), name="kernel-smoke-good")
+    noisy = [f for f in good if f.rule_id in KERNEL_RULES]
+    if noisy:
+        raise RuntimeError(
+            "kernel-rule smoke: the clean copy kernel produced "
+            f"{[(f.rule_id, f.message) for f in noisy]}")
+    return (f"kernel-rule smoke OK ({len(KERNEL_RULES)} kernel rules "
+            "registered; oob mutant fires, clean kernel quiet)")

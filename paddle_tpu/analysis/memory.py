@@ -84,6 +84,12 @@ class MemoryReport:
     peak_bytes: int                 # liveness-scan peak, per shard
     largest_transient_bytes: int    # biggest single equation output
     xla: Optional[Dict[str, int]] = None   # memory_analysis(), if any
+    # largest per-grid-step Pallas kernel VMEM footprint in the traced
+    # program (kernel_rules.max_kernel_vmem; 0 = no pallas_call).
+    # Separate ledger from peak_bytes on purpose: kernel working sets
+    # live in VMEM under Mosaic's allocator, not HBM under XLA's — the
+    # liveness scan keeps treating pallas_call as a leaf.
+    kernel_vmem_bytes: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -242,11 +248,15 @@ def estimate_target(target: LintTarget, recipe=None, *,
             }
         except Exception:
             xla = None
+    from paddle_tpu.analysis.kernel_rules import max_kernel_vmem
+    kernel_vmem = max_kernel_vmem(closed.jaxpr)
+
     _ = flat_args   # (leaves kept for future per-arg breakdowns)
     return MemoryReport(name=target.name, mesh=mesh_desc, shards=shards,
                         args_bytes=args_bytes, out_bytes=out_bytes,
                         peak_bytes=peak,
-                        largest_transient_bytes=largest, xla=xla)
+                        largest_transient_bytes=largest, xla=xla,
+                        kernel_vmem_bytes=kernel_vmem)
 
 
 # -------------------------------------------------------------- budget gate
@@ -290,4 +300,36 @@ def check_budgets(reports: List[MemoryReport],
                         "serving slice before any measurement",
                 suggestion="shrink the footprint, or raise the "
                            "budget in the SAME pr with the reason"))
+
+        class _K:                  # kernel-VMEM twin of the gate above
+            rule_id, severity = "kernel-vmem-budget", "error"
+
+        if rep.kernel_vmem_bytes > 0:
+            kv_budget = entry.get("kernel_vmem_bytes")
+            if kv_budget is None:
+                out.append(Finding(
+                    rule_id=_K.rule_id, severity=_K.severity,
+                    path=rep.name,
+                    message=f"{rep.name!r} traces a pallas_call "
+                            f"(derived per-grid-step VMEM "
+                            f"{rep.kernel_vmem_bytes} bytes) but has "
+                            "no kernel_vmem_bytes budget — a kernel-"
+                            "bearing entrypoint must declare its VMEM "
+                            "working set, same policy as peak_bytes",
+                    suggestion="add \"kernel_vmem_bytes\": N to the "
+                               f"{rep.name!r} entry in budgets.json"))
+            elif rep.kernel_vmem_bytes > int(kv_budget):
+                out.append(Finding(
+                    rule_id=_K.rule_id, severity=_K.severity,
+                    path=rep.name,
+                    message=f"derived kernel VMEM "
+                            f"{rep.kernel_vmem_bytes} bytes exceeds "
+                            f"the checked-in {int(kv_budget)} — a "
+                            "working-set regression this size moves "
+                            "the supported-shape envelope "
+                            "(paged_attention_supported) on a real "
+                            "chip",
+                    suggestion="shrink the block/group working set, "
+                               "or raise the budget in the SAME pr "
+                               "with the reason"))
     return out

@@ -300,8 +300,10 @@ class GatherInDecodeRule(Rule):
             suggestion="fuse the gather into a kernel — the Pallas "
                        "paged decode kernel "
                        "(ops/pallas_paged_attention.py) is the worked "
-                       "example, and kernel bodies are opaque to this "
-                       "rule; otherwise hoist the indices, or suppress "
+                       "example; this XLA-HBM rule skips kernel "
+                       "bodies (the kernel-scoped family in "
+                       "kernel_rules.py checks them instead); "
+                       "otherwise hoist the indices, or suppress "
                        "if the per-step gather is the op's contract "
                        "(free-list alloc, KV append)")
 

@@ -34,6 +34,12 @@ replicated-large-param      warn   an input leaf >= threshold bytes
 reshard-churn               warn   the same value hit by chained or
                                    repeated sharding constraints
                                    between uses
+jit-cache-key               warn   a declared spec differs only
+                                   cosmetically (trailing None dims)
+                                   from its canonical form — jit keys
+                                   programs on the spec VERBATIM, so
+                                   the first round-trip through a
+                                   compiled output recompiles
 ==========================  =====  ==================================
 
 Nothing executes: the mesh is CPU devices (``ci.sh`` forces
@@ -552,6 +558,85 @@ class ReshardChurnRule(ShardRule):
                                f"{path}/{eqn.primitive.name}")
             for v in eqn.outvars:
                 producers[id(v)] = eqn
+
+
+@register_shard_rule
+class JitCacheKeyRule(ShardRule):
+    """Cosmetically-redundant PartitionSpecs poison the jit cache: jit
+    keys compiled programs on the argument sharding VERBATIM, and
+    compiled outputs come back with trailing ``None`` dims stripped
+    (``P(None, None, 'mp', None)`` returns as ``P(None, None, 'mp')``).
+    A declared spec carrying trailing ``None``s is therefore
+    semantically identical to — but cache-key-DIFFERENT from — the
+    sharding of the arrays that flow back in on the next call, which
+    forces a spurious recompile on the first post-step reuse (the
+    regression class ``paged_cache_shardings`` documents:
+    parallel/sharding.py's 'no trailing None' comment).  Flags both
+    declared ``in_shardings`` leaves and in-program
+    ``with_sharding_constraint`` specs."""
+
+    rule_id = "jit-cache-key"
+    severity = "warn"
+    doc = ("declared PartitionSpec differs only cosmetically (trailing "
+           "None dims) from its canonical form — spurious recompile "
+           "on the first compiled-output round-trip")
+
+    @staticmethod
+    def _trailing_nones(spec) -> int:
+        entries = tuple(spec or ())
+        n = 0
+        for e in reversed(entries):
+            if e is not None:
+                break
+            n += 1
+        return n
+
+    def _flag(self, ctx, path, spec, what, eqn=None):
+        entries = tuple(spec)
+        canon = entries[:len(entries) - self._trailing_nones(spec)]
+        ctx.report(
+            self, path,
+            f"{what} P{entries!r} carries trailing None dim(s) — "
+            f"canonical form is P{canon!r}; jit keys programs on the "
+            "spec verbatim and compiled outputs come back canonical, "
+            "so the first round-trip recompiles the whole step",
+            eqn=eqn,
+            suggestion="drop the trailing None dims (partial "
+            "PartitionSpecs mean 'replicated on the rest' already)")
+
+    def run(self, sa, ctx):
+        seen = set()
+        for label, _leaf, s in sa.leaf_specs:
+            spec = getattr(s, "spec", None)
+            if spec is None or not self._trailing_nones(spec):
+                continue
+            key = (tuple(spec),)
+            if key in seen:        # one finding per distinct bad spec
+                continue
+            seen.add(key)
+            self._flag(ctx, f"{sa.target.name}/{label}", spec,
+                       f"in_shardings for {label}")
+        if sa.closed is not None:
+            self._walk(sa.closed.jaxpr, sa, ctx, sa.target.name)
+
+    def _walk(self, jaxpr, sa, ctx, path):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "sharding_constraint":
+                spec = getattr(eqn.params.get("sharding"), "spec", None)
+                if spec is not None and self._trailing_nones(spec):
+                    self._flag(ctx, f"{path}/sharding_constraint", spec,
+                               "with_sharding_constraint spec",
+                               eqn=eqn)
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr",
+                        "cond_jaxpr", "body_jaxpr"):
+                inner = eqn.params.get(key) if eqn.params else None
+                if inner is not None:
+                    self._walk(getattr(inner, "jaxpr", inner), sa, ctx,
+                               f"{path}/{eqn.primitive.name}")
+            for inner in (eqn.params.get("branches") or ()
+                          if eqn.params else ()):
+                self._walk(getattr(inner, "jaxpr", inner), sa, ctx,
+                           f"{path}/{eqn.primitive.name}")
 
 
 # -------------------------------------------------------------- shard_check

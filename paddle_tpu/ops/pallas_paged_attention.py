@@ -64,7 +64,16 @@ except ImportError:  # pragma: no cover
 from paddle_tpu.ops.pallas_kernels import _on_tpu
 
 __all__ = ["paged_decode_attention_kernel",
-           "paged_ragged_attention_kernel", "paged_attention_supported"]
+           "paged_ragged_attention_kernel", "paged_attention_supported",
+           "PAGED_KERNEL_NAME", "PAGED_RESIDENT_BUDGET",
+           "paged_vmem_bytes"]
+
+# The kernel-body function name as it appears in a traced pallas_call's
+# ``name_and_src_info`` — how tpu-lint's kernel rules (analysis/
+# kernel_rules.py) recognize THIS kernel and cross-check the estimator
+# below against the footprint they derive from its BlockSpecs.  Keep in
+# sync with the def below (the vmem-budget drift rule keys on it).
+PAGED_KERNEL_NAME = "_ragged_kernel"
 
 NEG_INF = -1e30   # finite mask value — MUST match ops/paged_attention.py
 
@@ -111,6 +120,14 @@ def _paged_vmem_bytes(block_size: int, group: int, head_dim: int,
     scratch = (max_q * group * head_dim * 4    # acc
                + 2 * max_q * group * 4)        # (m, l)
     return streamed + qo + scratch
+
+
+# Public aliases for the walker/tooling surface (grid/spec metadata
+# consumers like analysis/kernel_rules.py and external budget probes).
+# The underscored names stay — they are the mutable module attributes
+# the drift tests monkeypatch — but new readers should bind these.
+PAGED_RESIDENT_BUDGET = _PAGED_RESIDENT_BUDGET
+paged_vmem_bytes = _paged_vmem_bytes
 
 
 def _head_group(num_heads: int, block_size: int, head_dim: int,

@@ -1,0 +1,395 @@
+"""Kernel-scoped lint rules (``paddle_tpu/analysis/kernel_rules.py``).
+
+The same twin-snippet discipline as test_tpu_lint.py, applied INSIDE
+``pallas_call``: each kernel rule gets a mutant kernel it must flag
+with exactly ONE typed finding and the shipped/fixed form it must stay
+quiet on.  The load-bearing positives are the bug classes the ISSUE
+names — estimator drift (a poisoned ``_paged_vmem_bytes`` must fail
+lint), an unclipped table-gathered index map (the ``-1`` tail-sentinel
+class), a bf16 online-softmax scratch, and a dropped length-bound
+predicate ahead of the softmax.  The shipped ragged kernel must
+produce ZERO kernel findings on all three pool-dtype arms, with the
+derived footprint exactly equal to the hand estimator per arm.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.analysis import (KERNEL_RULES, LintTarget,
+                                 check_budgets, estimate_target,
+                                 kernel_self_check, lint,
+                                 max_kernel_vmem)
+from paddle_tpu.analysis.kernel_rules import (analyze_pallas_call,
+                                              derive_kernel_vmem,
+                                              iter_pallas_calls)
+from paddle_tpu.ops import pallas_paged_attention as ppa
+
+KERNEL_RULE_IDS = ("vmem-budget", "scratch-accum-dtype",
+                   "oob-index-map", "masking-completeness")
+
+
+def _kernel_findings(findings):
+    return [f for f in findings if f.rule_id in KERNEL_RULE_IDS]
+
+
+def _by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+# ------------------------------------------------- shipped-kernel fixtures
+
+
+def _ragged_args(kv_dtype=jnp.float32, tq=2):
+    b, h, hd, nb, bs, maxb = 2, 2, 16, 8, 8, 3
+    q = jnp.zeros((b, tq, h, hd), jnp.float32)
+    k = jnp.zeros((nb, bs, h, hd), kv_dtype)
+    v = jnp.zeros((nb, bs, h, hd), kv_dtype)
+    table = jnp.zeros((b, maxb), jnp.int32)
+    lens = jnp.ones((b,), jnp.int32)
+    if jnp.dtype(kv_dtype) == jnp.int8:
+        scales = jnp.ones((nb, h), jnp.float32)
+        return (q, k, v, table, lens), dict(k_scales=scales,
+                                            v_scales=scales)
+    return (q, k, v, table, lens), {}
+
+
+def _lint_ragged(kv_dtype=jnp.float32, tq=2, **lint_kw):
+    args, kw = _ragged_args(kv_dtype, tq)
+    fn = functools.partial(ppa.paged_ragged_attention_kernel,
+                           interpret=True, **kw)
+    return lint(fn, args, name="ragged", **lint_kw)
+
+
+# ---------------------------------------------------------- mutant builder
+#
+# A minimal table-gathered kernel shaped like the real one: pool in,
+# block table + lengths on the scalar-prefetch path, one VMEM scratch.
+# Knobs select each mutant: clip on/off, mask predicate on/off, scratch
+# dtype.  The clean configuration must produce zero kernel findings —
+# the false-positive half of every rule's contract.
+
+NB, BS, HD, B, MAXB = 8, 4, 16, 2, 3
+
+
+def _gathered_call(kernel, table, lens, *, clip=True,
+                   scratch_dtype=jnp.float32):
+    kpool = jnp.zeros((NB, BS, HD), jnp.float32)
+    if clip:
+        table = jnp.clip(table, 0, NB - 1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, MAXB),
+        in_specs=[pl.BlockSpec((1, BS, HD),
+                  lambda r, j, tbl, ln: (tbl[r, j], 0, 0))],
+        out_specs=pl.BlockSpec((1, HD), lambda r, j, tbl, ln: (r, 0)),
+        scratch_shapes=[pltpu.VMEM((1, HD), scratch_dtype)])
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, HD), jnp.float32),
+        interpret=True)(table, lens, kpool)
+
+
+def _masked_kernel(tbl_ref, lens_ref, k_ref, o_ref, acc_ref):
+    r = pl.program_id(0)
+    x = k_ref[0]
+    kpos = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    bias = jnp.where(kpos < lens_ref[r], 0.0, -1e30)
+    o_ref[0] = jnp.sum(jnp.exp(x + bias), axis=0)
+
+
+def _unmasked_kernel(tbl_ref, lens_ref, k_ref, o_ref, acc_ref):
+    # MUTANT: the length-bound predicate is gone — garbage tail lanes
+    # and unwritten pages reach the softmax with nonzero weight
+    o_ref[0] = jnp.sum(jnp.exp(k_ref[0]), axis=0)
+
+
+def _table():
+    return jnp.zeros((B, MAXB), jnp.int32), jnp.ones((B,), jnp.int32)
+
+
+# ----------------------------------------------------------- registration
+
+
+def test_kernel_rules_registered_and_error_severity():
+    assert set(KERNEL_RULE_IDS) <= set(KERNEL_RULES)
+    for rid in KERNEL_RULE_IDS:
+        assert KERNEL_RULES[rid]().severity == "error"
+
+
+def test_kernel_self_check_smoke():
+    assert "OK" in kernel_self_check()
+
+
+# -------------------------------------------- shipped kernel: zero findings
+
+
+@pytest.mark.parametrize("kv_dtype", [jnp.float32, jnp.bfloat16,
+                                      jnp.int8],
+                         ids=["f32", "bf16", "int8"])
+def test_shipped_ragged_kernel_lints_clean(kv_dtype):
+    fs = _kernel_findings(_lint_ragged(kv_dtype))
+    assert fs == [], [(f.rule_id, f.message) for f in fs]
+
+
+@pytest.mark.parametrize("kv_dtype", [jnp.float32, jnp.bfloat16,
+                                      jnp.int8],
+                         ids=["f32", "bf16", "int8"])
+def test_derived_footprint_equals_estimator_per_arm(kv_dtype):
+    # the derivation from the traced BlockSpecs must EQUAL the hand
+    # estimator for the exact (block_size, group, head_dim, dtype,
+    # max_q) the kernel was built with — bf16's 6 B/elt and int8's
+    # 5 B/elt arms included — and fit the resident budget
+    args, kw = _ragged_args(kv_dtype, tq=2)
+    fn = functools.partial(ppa.paged_ragged_attention_kernel,
+                           interpret=True, **kw)
+    closed = jax.make_jaxpr(fn)(*args)
+    kas = [analyze_pallas_call(e, j)
+           for e, j in iter_pallas_calls(closed.jaxpr)]
+    assert len(kas) == 1 and kas[0] is not None
+    ka = kas[0]
+    assert ka.name == ppa.PAGED_KERNEL_NAME
+    derived = derive_kernel_vmem(ka)
+    gi = min(ka.gathered_inputs)
+    bs, g, hd = (int(d) for d in
+                 ka.in_block_mappings[gi].block_shape[1:4])
+    est = ppa._paged_vmem_bytes(bs, g, hd, kv_dtype, max_q=2)
+    assert derived == est
+    assert derived <= ppa._PAGED_RESIDENT_BUDGET
+    assert max_kernel_vmem(closed.jaxpr) == derived
+
+
+# ------------------------------------------------------- vmem-budget drift
+
+
+def test_poisoned_estimator_fails_lint(monkeypatch):
+    # perturb _paged_vmem_bytes by ONE double-buffered f32 page — the
+    # drift the rule exists for: the dispatch envelope and the traced
+    # kernel no longer agree
+    orig = ppa._paged_vmem_bytes
+
+    def poisoned(block_size, group, head_dim, kv_dtype, max_q=1):
+        return (orig(block_size, group, head_dim, kv_dtype, max_q)
+                + 2 * 2 * block_size * group * head_dim * 4)
+
+    monkeypatch.setattr(ppa, "_paged_vmem_bytes", poisoned)
+    fs = _by_rule(_lint_ragged(), "vmem-budget")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "drift" in fs[0].message
+
+
+def test_shrunk_budget_fails_lint(monkeypatch):
+    # the other arm of the rule: a working set over the resident
+    # budget is an error even when the estimator agrees with it.
+    # head_group pins the group explicitly — with the budget shrunk
+    # the builder's own _head_group gate would otherwise refuse to
+    # construct the kernel before lint ever saw it.
+    monkeypatch.setattr(ppa, "_PAGED_RESIDENT_BUDGET", 64)
+    args, kw = _ragged_args()
+    fn = functools.partial(ppa.paged_ragged_attention_kernel,
+                           interpret=True, head_group=2, **kw)
+    fs = _by_rule(lint(fn, args, name="ragged"), "vmem-budget")
+    assert len(fs) == 1
+    assert "exceeds the resident budget" in fs[0].message
+
+
+# ---------------------------------------------------------- oob-index-map
+
+
+def test_oob_fires_on_unclipped_gathered_table():
+    tbl, lens = _table()
+    fs = _by_rule(
+        lint(lambda t, l: _gathered_call(_masked_kernel, t, l,
+                                         clip=False), (tbl, lens)),
+        "oob-index-map")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "clamp proof" in fs[0].message
+
+
+def test_oob_quiet_on_clipped_table():
+    tbl, lens = _table()
+    fs = lint(lambda t, l: _gathered_call(_masked_kernel, t, l),
+              (tbl, lens))
+    assert not _by_rule(fs, "oob-index-map")
+
+
+def test_oob_fires_on_overreaching_affine_map():
+    def bad(x):
+        return pl.pallas_call(
+            lambda x_ref, o_ref: o_ref.__setitem__(slice(None),
+                                                   x_ref[:]),
+            grid=(2,),
+            in_specs=[pl.BlockSpec((4,), lambda i: (i + 1,))],
+            out_specs=pl.BlockSpec((4,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.float32),
+            interpret=True)(x)
+
+    fs = _by_rule(lint(bad, (jnp.zeros((8,), jnp.float32),)),
+                  "oob-index-map")
+    assert len(fs) == 1
+    assert "past extent 8" in fs[0].message
+
+
+def test_oob_quiet_on_in_bounds_affine_map():
+    def ok(x):
+        return pl.pallas_call(
+            lambda x_ref, o_ref: o_ref.__setitem__(slice(None),
+                                                   x_ref[:]),
+            grid=(2,),
+            in_specs=[pl.BlockSpec((4,), lambda i: (i,))],
+            out_specs=pl.BlockSpec((4,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.float32),
+            interpret=True)(x)
+
+    assert not _kernel_findings(
+        lint(ok, (jnp.zeros((8,), jnp.float32),)))
+
+
+# ------------------------------------------------------ scratch-accum-dtype
+
+
+def test_scratch_dtype_fires_on_bf16_scratch_mutant():
+    tbl, lens = _table()
+
+    def no_softmax(tbl_ref, lens_ref, k_ref, o_ref, acc_ref):
+        o_ref[0] = jnp.sum(k_ref[0], axis=0)
+
+    fs = _kernel_findings(
+        lint(lambda t, l: _gathered_call(no_softmax, t, l,
+                                         scratch_dtype=jnp.bfloat16),
+             (tbl, lens)))
+    # exactly ONE typed finding — the bf16 scratch, nothing else
+    assert [f.rule_id for f in fs] == ["scratch-accum-dtype"]
+    assert "bfloat16" in fs[0].message
+
+
+def test_scratch_dtype_quiet_on_f32_scratch():
+    tbl, lens = _table()
+    fs = lint(lambda t, l: _gathered_call(_masked_kernel, t, l),
+              (tbl, lens))
+    assert not _by_rule(fs, "scratch-accum-dtype")
+
+
+# ---------------------------------------------------- masking-completeness
+
+
+def test_masking_fires_on_dropped_predicate_mutant():
+    tbl, lens = _table()
+    fs = _kernel_findings(
+        lint(lambda t, l: _gathered_call(_unmasked_kernel, t, l),
+             (tbl, lens)))
+    assert [f.rule_id for f in fs] == ["masking-completeness"]
+    assert fs[0].severity == "error"
+
+
+def test_masking_quiet_with_length_bound_predicate():
+    tbl, lens = _table()
+    fs = lint(lambda t, l: _gathered_call(_masked_kernel, t, l),
+              (tbl, lens))
+    assert not _by_rule(fs, "masking-completeness")
+
+
+# --------------------------------------------- suppression + ratchet shape
+
+
+def test_disable_kwarg_suppresses_kernel_rule():
+    tbl, lens = _table()
+    fs = lint(lambda t, l: _gathered_call(_unmasked_kernel, t, l),
+              (tbl, lens), disable=("masking-completeness",))
+    assert not _kernel_findings(fs)
+
+
+def test_source_comment_suppresses_kernel_rule():
+    # findings anchor on the pallas_call invocation's user source line
+    # (probe: the `return pl.pallas_call(` statement), so the
+    # clang-tidy-style comment on the line above suppresses exactly
+    # like it does for XLA-rule findings
+    tbl, lens = _table()
+    kpool = jnp.zeros((NB, BS, HD), jnp.float32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, MAXB),
+        in_specs=[pl.BlockSpec((1, BS, HD),
+                  lambda r, j, t, l: (t[r, j], 0, 0))],
+        out_specs=pl.BlockSpec((1, HD), lambda r, j, t, l: (r, 0)),
+        scratch_shapes=[pltpu.VMEM((1, HD), jnp.float32)])
+
+    def bad(t, l):
+        # tpu-lint: disable=masking-completeness
+        return pl.pallas_call(
+            _unmasked_kernel, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, HD), jnp.float32),
+            interpret=True)(jnp.clip(t, 0, NB - 1), l, kpool)
+
+    assert not _kernel_findings(lint(bad, (tbl, lens)))
+
+
+def test_opaque_kernels_escape_hatch():
+    tbl, lens = _table()
+    fs = lint(lambda t, l: _gathered_call(_unmasked_kernel, t, l,
+                                          clip=False), (tbl, lens),
+              opaque_kernels=True)
+    assert not _kernel_findings(fs)
+
+
+def test_kernel_findings_are_errors_never_warns():
+    # the warn ratchet stays 0 by construction: every kernel finding
+    # is error severity, so mutants can never leak into the warn count
+    tbl, lens = _table()
+    fs = _kernel_findings(
+        lint(lambda t, l: _gathered_call(_unmasked_kernel, t, l,
+                                         clip=False,
+                                         scratch_dtype=jnp.bfloat16),
+             (tbl, lens)))
+    assert len(fs) == 3         # masking + oob + scratch, one each
+    assert all(f.severity == "error" for f in fs)
+
+
+# ------------------------------------------------- memory + budgets wiring
+
+
+def _kernel_target():
+    tbl, lens = _table()
+    return LintTarget(
+        "kernel-mem-probe",
+        lambda t, l: _gathered_call(_masked_kernel, t, l),
+        (tbl, lens))
+
+
+def test_memory_report_surfaces_kernel_vmem():
+    rep = estimate_target(_kernel_target(), with_xla=False)
+    # 2-buffered f32 pool block + 2-buffered f32 out + f32 scratch
+    expected = 2 * (BS * HD) * 4 + 2 * HD * 4 + HD * 4
+    assert rep.kernel_vmem_bytes == expected
+
+
+def test_check_budgets_gates_kernel_vmem():
+    rep = estimate_target(_kernel_target(), with_xla=False)
+    kv = rep.kernel_vmem_bytes
+
+    # missing kernel_vmem_bytes on a kernel-bearing report = error
+    fs = check_budgets([rep], {rep.name: {"peak_bytes": 10**9}})
+    assert [f.rule_id for f in fs] == ["kernel-vmem-budget"]
+    assert "no kernel_vmem_bytes budget" in fs[0].message
+
+    # exact pin = clean
+    assert not check_budgets(
+        [rep], {rep.name: {"peak_bytes": 10**9,
+                           "kernel_vmem_bytes": kv}})
+
+    # over the pin = error
+    fs = check_budgets(
+        [rep], {rep.name: {"peak_bytes": 10**9,
+                           "kernel_vmem_bytes": kv - 1}})
+    assert [f.rule_id for f in fs] == ["kernel-vmem-budget"]
+    assert "exceeds" in fs[0].message
+
+
+def test_kernel_free_report_needs_no_kernel_budget():
+    rep = estimate_target(
+        LintTarget("plain", lambda x: x + 1.0,
+                   (jnp.zeros((4,), jnp.float32),)), with_xla=False)
+    assert rep.kernel_vmem_bytes == 0
+    assert not check_budgets([rep], {"plain": {"peak_bytes": 10**9}})

@@ -153,6 +153,51 @@ def test_reshard_churn_quiet_on_single_constraint():
         "reshard-churn")
 
 
+# -------------------------------------------------------- jit-cache-key
+
+
+def test_jit_cache_key_fires_on_trailing_none_spec():
+    # the PR 14 regression class, statically: a KV-pool-shaped spec
+    # with a cosmetic trailing None differs from the canonical spec
+    # compiled outputs come back with, so jit's verbatim cache key
+    # recompiles the step on the first post-step round-trip
+    pool = jnp.zeros((4, 2, 2, 8), jnp.float32)
+    fs = _by_rule(
+        shard_check(_target(
+            lambda p: p + 1.0, (pool,),
+            ShardRecipe(axes=DP2,
+                        arg_specs=(P(None, None, "dp", None),)))),
+        "jit-cache-key")
+    assert fs and fs[0].severity == "warn"
+    assert "trailing None" in fs[0].message
+
+
+def test_jit_cache_key_quiet_on_canonical_spec():
+    # the paged_cache_shardings convention: no trailing None
+    pool = jnp.zeros((4, 2, 2, 8), jnp.float32)
+    assert not _by_rule(
+        shard_check(_target(
+            lambda p: p + 1.0, (pool,),
+            ShardRecipe(axes=DP2,
+                        arg_specs=(P(None, None, "dp"),)))),
+        "jit-cache-key")
+
+
+def test_jit_cache_key_fires_on_constraint_spec():
+    mesh = _mesh2()
+
+    def pinned(x):
+        return lax.with_sharding_constraint(
+            x + 1.0, NamedSharding(mesh, P("dp", None)))
+
+    x = jnp.zeros((8, 8), jnp.float32)
+    fs = _by_rule(
+        shard_check(_target(pinned, (x,),
+                            ShardRecipe(axes=DP2, arg_specs=(None,)))),
+        "jit-cache-key")
+    assert fs and "with_sharding_constraint" in fs[0].message
+
+
 # ---------------------------------------------------- recipe-less contract
 
 

@@ -312,6 +312,23 @@ def test_cli_no_targets_is_usage_error():
     assert lint_main([]) == 2
 
 
+def test_cli_unknown_entrypoint_is_hard_error(capsys):
+    # regression: misspelled entrypoint names used to be silently
+    # skipped, so `paddle_tpu lint paged-engine-step-raggd` exited 0
+    # and the CI gate guarded nothing
+    with pytest.raises(SystemExit) as e:
+        lint_main(["paged-engine-step-raggd"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "unknown entrypoint" in err
+    assert "paged-engine-step-ragged-kernel" in err   # lists valid names
+
+
+def test_cli_bare_entrypoint_name_resolves(capsys):
+    assert lint_main(["trainer-eval-step"]) == 0
+    capsys.readouterr()
+
+
 def _bad_dot_target():
     a = jax.ShapeDtypeStruct((8, 8), BF)
     return LintTarget("bad-dot", lambda x, y: jnp.dot(x, y), (a, a))
