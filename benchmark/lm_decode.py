@@ -266,6 +266,103 @@ def _bench_shared_prefix(args, cfg, params, jax):
         **_kv_dtype_extras(args, cfg, params))
 
 
+def _bench_prefix_tiers(args, cfg, params, jax):
+    """``--shared-prefix N --prefix-host-bytes B``: tiered prefix-cache
+    benchmark — the three admission regimes as SEPARATE rows.
+
+    N rounds, each behind a FRESH system prompt: (1) miss — full
+    prefill; (2) HBM hit — the registered blocks map by refcount
+    increment and the full-prompt replay prefills ONE token; (3)
+    restore hit — ``spill_prefix_cache()`` demotes the prefix to the
+    host store first, so the same match additionally pays the
+    host->device ``paged_import_blocks`` write before its one-token
+    prefill.  Runs the LEGACY per-width prefill engine
+    (``unified_step=False``): the unified program pads every prefill
+    to one ragged width, which would flatten the miss-vs-hit wall-time
+    the rows exist to show.  Reports TTFT p50/p95 per regime and pins
+    restore-hit p50 STRICTLY between HBM-hit and miss."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry.trace import Tracer
+
+    rounds, sfx, bs = args.shared_prefix, 8, args.block_size
+    plen = args.prompt
+    per_req = -(-(plen + sfx + 2) // bs)
+    pool = args.pool_blocks or 2 * per_req + 4
+    rs = np.random.RandomState(1)
+    tracer = Tracer(capacity=1 << 17, name="lm_decode_prefix_tiers")
+    eng = PagedServingEngine(
+        cfg, params, num_slots=1, num_blocks=pool, block_size=bs,
+        prompt_buckets=(plen + sfx,), prefix_cache=True,
+        prefix_host_bytes=args.prefix_host_bytes, unified_step=False,
+        decode_kernel={"auto": None, "on": True,
+                       "off": False}[args.paged_kernel],
+        kv_dtype=args.kv_dtype_resolved, tracer=tracer, seed=0,
+        mesh=args.mesh or None)
+
+    def one(prompt):
+        rid = eng.submit(prompt, max_new=2)
+        eng.run()
+        return rid
+
+    def round_trip(prompt):
+        """miss -> HBM hit -> spill -> restore hit; rids per regime."""
+        rid_miss = one(prompt)
+        rid_hbm = one(prompt)
+        eng.spill_prefix_cache()
+        rid_restore = one(prompt)
+        eng.flush_prefix_cache()
+        return rid_miss, rid_hbm, rid_restore
+
+    def prompt_for(round_idx):
+        del round_idx                    # fresh draw per call is enough
+        return np.concatenate(
+            [rs.randint(0, args.vocab, plen),
+             rs.randint(0, args.vocab, sfx)]).astype(np.int32)
+
+    # warm-up round: compiles the full-width prefill, the 1-token tail
+    # prefill, share, decode, and the restore import's refcount adds —
+    # every measured span after this is compile-free
+    round_trip(prompt_for(-1))
+    rids = {"miss": [], "hbm_hit": [], "restore_hit": []}
+    for r in range(rounds):
+        m, h, s = round_trip(prompt_for(r))
+        rids["miss"].append(m)
+        rids["hbm_hit"].append(h)
+        rids["restore_hit"].append(s)
+
+    ttft = {e["rid"]: e["args"]["ttft_s"] * 1e3
+            for e in tracer.events() if e["name"] == "first_token"}
+    restored = {e["rid"] for e in tracer.events()
+                if e["name"] == "prefix_restore"}
+    assert set(rids["restore_hit"]) <= restored, (
+        "every restore-hit round must actually promote spilled blocks")
+    assert not (set(rids["miss"]) | set(rids["hbm_hit"])) & restored
+    p = {regime: (float(np.percentile([ttft[r] for r in rr], 50)),
+                  float(np.percentile([ttft[r] for r in rr], 95)))
+         for regime, rr in rids.items()}
+    assert p["hbm_hit"][0] < p["restore_hit"][0] < p["miss"][0], (
+        "restore-hit TTFT must sit strictly between the HBM hit and "
+        f"the miss, got {p}")
+    st = eng.host_state()["prefix_cache"]
+    common = dict(
+        unit="ms", backend=jax.default_backend(), decoder="engine",
+        compiles=eng.compile_counts(), shared_prefix=rounds,
+        block_size=bs, pool_blocks=pool,
+        prefix_host_bytes=args.prefix_host_bytes,
+        paged_kernel=bool(eng.decode_kernel),
+        spills=int(st["spills"]), restores=int(st["restores"]),
+        **_mesh_extras(args, cfg), **_kv_dtype_extras(args, cfg, params))
+    name = (f"lm_decode d{args.dim} L{args.layers} prompt{plen} "
+            f"prefix-tiers{rounds}")
+    return [telemetry.bench_row(metric=f"{name} {regime}",
+                                value=round(p50, 3),
+                                ttft_p50_ms=round(p50, 3),
+                                ttft_p95_ms=round(p95, 3),
+                                regime=regime, **common)
+            for regime, (p50, p95) in p.items()]
+
+
 def _bench_spec(args, cfg, params, jax):
     """``--spec K``: speculative-decoding engine benchmark.
 
@@ -746,6 +843,17 @@ def main():
                          "the row reports miss vs hit TTFT/prefill "
                          "spans and prefix_hit_tokens instead of the "
                          "differential step time; requires --paged")
+    ap.add_argument("--prefix-host-bytes", type=int, default=0,
+                    metavar="N",
+                    help="with --shared-prefix: attach an N-byte host-"
+                         "RAM spill tier to the prefix cache and report "
+                         "the THREE admission regimes as separate rows "
+                         "— miss (full prefill), HBM hit (resident "
+                         "blocks map, one-token replay) and restore "
+                         "hit (spilled blocks re-import from host RAM "
+                         "first) — each with TTFT p50/p95; the restore "
+                         "row is asserted strictly between the other "
+                         "two")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding through the paged "
                          "serving ENGINE: a truncated-layer draft "
@@ -841,6 +949,9 @@ def main():
         ap.error("--ragged requires --decoder serve")
     if args.paged and args.decoder != "serve":
         ap.error("--paged requires --decoder serve")
+    if args.prefix_host_bytes and not args.shared_prefix:
+        ap.error("--prefix-host-bytes is the --shared-prefix bench's "
+                 "host-tier arm; pass both")
     if args.shared_prefix and not args.paged:
         ap.error("--shared-prefix requires --paged (the prefix cache "
                  "lives in the paged serving engine)")
@@ -984,13 +1095,17 @@ def main():
             telemetry.emit_row(row)
             return
         if args.shared_prefix:
-            row = _bench_shared_prefix(args, cfg, params, jax)
             from paddle_tpu import telemetry
+            if args.prefix_host_bytes:
+                rows = _bench_prefix_tiers(args, cfg, params, jax)
+            else:
+                rows = [_bench_shared_prefix(args, cfg, params, jax)]
             if args.telemetry_out:
                 telemetry.append_jsonl(
                     args.telemetry_out, telemetry.get_registry().snapshot(),
-                    meta=telemetry.run_meta(**row))
-            telemetry.emit_row(row)
+                    meta=telemetry.run_meta(**rows[0]))
+            for row in rows:
+                telemetry.emit_row(row)
             return
         if args.mesh:
             row = _bench_mesh(args, cfg, params, jax)

@@ -80,6 +80,13 @@ echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead
 # trace (JSONL + per-request waterfalls + Chrome trace-event export
 # structure), bounds the per-observation overhead (metric inc/observe
 # AND tracer event record under the same 50us ceiling), runs the
+# spill-tier smoke (forced pool pressure DEMOTES prefix blocks to the
+# host store instead of destroying them, a re-arrival RESTORES the
+# spilled prefix with its greedy stream bit-identical to sharing-off,
+# serving_prefix_spilled_bytes reconciles with the store, the
+# eviction counter's tier={hbm,host} split sums to the unlabeled
+# series, compiles=={'step':1} holds across spill/restore, and
+# flush_prefix_cache drains BOTH tiers), runs the
 # training-health smoke (Trainer(health=...) batch + scan at cadence:
 # schema-valid train_health_* snapshot, compiles=={step:1, scan:1}
 # with the in-graph statistics vector on, per-step host cost bounded

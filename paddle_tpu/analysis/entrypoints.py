@@ -426,6 +426,37 @@ def _paged_engine_step_ragged() -> LintTarget:
             "the attention-output all-gather in the step"))
 
 
+@register_entrypoint("paged-engine-step-spill")
+def _paged_engine_step_spill() -> LintTarget:
+    # The unified ragged step on an engine carrying the TIERED prefix
+    # cache (radix registry + host-RAM spill store).  The whole tier
+    # is host-side machinery — demotion serializes pages with eager
+    # numpy reads, restore writes them back with eager .at[].set
+    # imports BEFORE the step runs — so the traced step program must
+    # be byte-for-byte the plain ragged step: same peak, same rule
+    # set, no host callbacks smuggled in by the spill bookkeeping.
+    # budgets.json pins its peak to paged-engine-step-ragged's ceiling
+    # for exactly that reason.
+    from paddle_tpu.serving import PagedServingEngine, SpecConfig
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,),
+                             spec=SpecConfig(k=2, draft_layers=1),
+                             prefix_cache=True,
+                             prefix_host_bytes=1 << 20,
+                             mesh=_mesh_or_none())
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-spill", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0)),
+        recipe=_paged_mp_recipe(
+            7, (1,), "head-sharded KV pool (paged_cache_shardings on "
+            "the cache arg); params + slot vectors replicate; exactly "
+            "the attention-output all-gather in the step"))
+
+
 @register_entrypoint("paged-engine-step-ragged-kernel")
 def _paged_engine_step_ragged_kernel() -> LintTarget:
     # The unified ragged step with the Pallas kernel FORCED on and a

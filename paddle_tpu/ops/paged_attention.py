@@ -380,6 +380,65 @@ def paged_export_blocks(cache: PagedKVCache, slot: int) -> dict:
     }
 
 
+def paged_export_block(cache: PagedKVCache, block_id) -> dict:
+    """Single-block spill EXPORT: copy ONE physical block's K/V pages
+    (and, on a quantized pool, its per-block scale rows) out of the
+    pool as numpy arrays — the prefix cache's host-tier serializer
+    (:func:`paged_export_blocks`' per-block twin: the cluster wire
+    codec minus the TCP hop and minus the slot walk, since a spilled
+    registry node owns exactly one block).
+
+    Pages keep the leading block axis at length 1
+    (``[1, block_size, h, hd]`` per layer, scales ``[1, h]``), so
+    restoring N spilled blocks is a per-layer concatenate of their
+    payloads (:func:`paged_concat_block_payloads`) fed straight into
+    :func:`paged_import_blocks`.  Pure read; the copies stay valid
+    after the block is unpinned and reused."""
+    b = int(block_id)
+    return {
+        "block_size": cache.block_size,
+        "kv_dtype": cache.kv_dtype.name,
+        "k_pages": tuple(np.asarray(p[b])[None] for p in cache.k_pages),
+        "v_pages": tuple(np.asarray(p[b])[None] for p in cache.v_pages),
+        "k_scales": tuple(np.asarray(s[b])[None]
+                          for s in cache.k_scales),
+        "v_scales": tuple(np.asarray(s[b])[None]
+                          for s in cache.v_scales),
+    }
+
+
+def paged_concat_block_payloads(payloads) -> dict:
+    """Merge :func:`paged_export_block` payloads (logical block order)
+    into one :func:`paged_import_blocks`-shaped dict — how the prefix
+    cache's restore path turns N host-tier entries back into a single
+    import (one ``.at[ids].set`` write per layer, not N)."""
+    payloads = list(payloads)
+    if not payloads:
+        raise ValueError("paged_concat_block_payloads: empty payload "
+                         "list")
+    head = payloads[0]
+    for p in payloads[1:]:
+        if (p["kv_dtype"] != head["kv_dtype"]
+                or p["block_size"] != head["block_size"]):
+            raise ValueError(
+                "paged_concat_block_payloads: mixed payloads "
+                f"({p['kv_dtype']}/{p['block_size']} vs "
+                f"{head['kv_dtype']}/{head['block_size']})")
+    L = len(head["k_pages"])
+    cat = (lambda field, i:
+           np.concatenate([p[field][i] for p in payloads], axis=0))
+    return {
+        "block_size": head["block_size"],
+        "kv_dtype": head["kv_dtype"],
+        "k_pages": tuple(cat("k_pages", i) for i in range(L)),
+        "v_pages": tuple(cat("v_pages", i) for i in range(L)),
+        "k_scales": tuple(cat("k_scales", i)
+                          for i in range(len(head["k_scales"]))),
+        "v_scales": tuple(cat("v_scales", i)
+                          for i in range(len(head["v_scales"]))),
+    }
+
+
 def paged_import_blocks(cache: PagedKVCache, blocks: dict):
     """Host-side handoff IMPORT: write foreign block pages (a
     :func:`paged_export_blocks` payload) into this pool's lowest-index

@@ -38,26 +38,49 @@ This module is that bookkeeping — pure host Python, no jax:
   refcount would stay > 0).  A sharer-free leaf's block is pinned
   only by the registry, so its unpin is an immediate pool return.
 
-The serving engine (``serving.py``) drives match -> share -> tail
-prefill -> register; ``docs/design/serving.md`` has the full design.
+* **Spill tier.**  With a :class:`HostPrefixStore` attached, eviction
+  under pool pressure DEMOTES instead of destroys: a sharer-free
+  leaf's pages are serialized to pinned host RAM (the engine's
+  exporter callback — ``paged_export_block``, the cluster wire codec
+  minus the TCP hop) and the node stays in the tree marked
+  ``spilled`` with no device block.  A later radix hit on a spilled
+  node restores its pages into freshly reserved pool blocks
+  (``paged_import_blocks`` + ``device_put``) and PROMOTES the node
+  back to resident before the tail prefill — effective prefix-cache
+  capacity extends past HBM into the host-byte budget.  The store is
+  its own LRU: inserting past the budget destroys the oldest
+  sharer-free host entries (and their now-unreachable registry
+  nodes) for real.  Spill/promote cascade exactly like eviction —
+  leaf-first, so a spilled node never has resident descendants, and
+  every matched path is a resident prefix followed by a spilled
+  suffix the engine restores in one import.
+
+The serving engine (``serving.py``) drives match -> restore-or-share
+-> tail prefill -> register; ``docs/design/serving.md`` has the full
+design.
 """
 
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+from collections import OrderedDict
+from typing import (Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Set, Tuple)
 
-__all__ = ["PrefixCache", "PrefixHit"]
+__all__ = ["PrefixCache", "PrefixHit", "HostPrefixStore"]
 
 
 class _Node:
-    """One cached block: a full chunk (interior-capable) or a tail."""
+    """One cached block: a full chunk (interior-capable) or a tail.
+    ``spilled`` nodes hold no device block (``block_id == -1``); their
+    pages live in the host store under :meth:`prefix_keys`."""
 
     __slots__ = ("block_id", "parent", "children", "tails", "sharers",
-                 "last_used", "is_tail", "n_tokens")
+                 "last_used", "is_tail", "n_tokens", "key", "spilled")
 
     def __init__(self, block_id: int, parent: Optional["_Node"],
-                 n_tokens: int, is_tail: bool, tick: int):
+                 n_tokens: int, is_tail: bool, tick: int,
+                 key: Tuple[int, ...] = ()):
         self.block_id = int(block_id)
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
@@ -66,6 +89,92 @@ class _Node:
         self.last_used = tick
         self.is_tail = is_tail
         self.n_tokens = n_tokens              # tokens the block holds
+        self.key = tuple(key)                 # this node's edge tokens
+        self.spilled = False                  # pages in the host tier?
+
+    def prefix_keys(self) -> Tuple[Tuple[int, ...], bool]:
+        """The node's identity for the host store: the full root-to-
+        here token path plus the tail flag (a tail and a chunk can
+        cover the same tokens under one parent)."""
+        keys: List[Tuple[int, ...]] = []
+        nd: Optional[_Node] = self
+        while nd is not None and nd.parent is not None:
+            keys.append(nd.key)
+            nd = nd.parent
+        toks = tuple(t for k in reversed(keys) for t in k)
+        return (toks, self.is_tail)
+
+
+class HostPrefixStore:
+    """Byte-budgeted host-RAM tier for spilled prefix blocks.
+
+    A plain LRU ``OrderedDict`` of ``prefix_keys -> payload`` (the
+    :func:`~paddle_tpu.ops.paged_attention.paged_export_block` numpy
+    dict — pinned host buffers in the TPU-runtime sense: plain host
+    memory the device DMAs from on restore).  ``put`` drops
+    least-recently-stored entries to make room, skipping keys the
+    caller marks locked (a mid-admission match must not lose its own
+    payload to the demotions its admission forced), and rejects an
+    entry that cannot fit the budget at all — ``total_bytes`` never
+    exceeds ``max_bytes``.  Single-threaded, like the registry that
+    owns it."""
+
+    def __init__(self, max_bytes: int):
+        assert max_bytes >= 1
+        self.max_bytes = int(max_bytes)
+        self.total_bytes = 0
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    @staticmethod
+    def payload_bytes(payload: dict) -> int:
+        """Host bytes one payload pins (pages + quantization scales)."""
+        return int(sum(a.nbytes for field in ("k_pages", "v_pages",
+                                              "k_scales", "v_scales")
+                       for a in payload[field]))
+
+    def put(self, key, payload: dict,
+            locked: Optional[Callable[[tuple], bool]] = None
+            ) -> Tuple[bool, List[tuple]]:
+        """Insert ``payload`` under ``key``; returns ``(accepted,
+        dropped_keys)``.  Evicts LRU entries (oldest first, skipping
+        ``locked`` ones) until the budget fits; refuses (cache
+        untouched) when even dropping every unlocked entry would not
+        make room."""
+        nbytes = self.payload_bytes(payload)
+        if key in self._entries:
+            self.pop(key)
+        if nbytes > self.max_bytes:
+            return False, []
+        droppable = [k for k in self._entries
+                     if locked is None or not locked(k)]
+        need = self.total_bytes + nbytes - self.max_bytes
+        drops: List[tuple] = []
+        for k in droppable:
+            if need <= 0:
+                break
+            need -= self.payload_bytes(self._entries[k])
+            drops.append(k)
+        if need > 0:
+            return False, []              # locked entries hold the rest
+        for k in drops:
+            self.pop(k)
+        self._entries[key] = payload
+        self.total_bytes += nbytes
+        return True, drops
+
+    def pop(self, key) -> dict:
+        payload = self._entries.pop(key)
+        self.total_bytes -= self.payload_bytes(payload)
+        return payload
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def keys(self):
+        return list(self._entries.keys())
 
 
 class PrefixHit(NamedTuple):
@@ -85,17 +194,25 @@ class PrefixHit(NamedTuple):
 
 class PrefixCache:
     """Radix registry over block-size token chunks.  Single-threaded —
-    owned and driven by one engine's admission loop."""
+    owned and driven by one engine's admission loop.  An attached
+    ``host_store`` (:class:`HostPrefixStore`) turns eviction into
+    demotion: see the module docstring's spill-tier paragraph."""
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int,
+                 host_store: Optional[HostPrefixStore] = None):
         assert block_size >= 1
         self.bs = int(block_size)
         self._root = _Node(-1, None, 0, False, 0)
         self._tick = itertools.count(1)       # LRU clock (monotonic)
+        self.host_store = host_store
+        self._spilled_index: Dict[tuple, _Node] = {}
         self.hits = 0
         self.misses = 0
         self.hit_tokens = 0
-        self.evictions = 0
+        self.evictions = 0                    # resident blocks destroyed
+        self.spills = 0                       # resident -> host demotions
+        self.restores = 0                     # host -> resident promotions
+        self.host_evictions = 0               # host entries destroyed
 
     # ------------------------------------------------------------ match
 
@@ -164,9 +281,13 @@ class PrefixCache:
             key = tuple(toks[i:i + bs])
             child = node.children.get(key)
             if child is None:
-                child = _Node(block_ids[bi], node, bs, False, now)
+                child = _Node(block_ids[bi], node, bs, False, now, key)
                 node.children[key] = child
                 new.append(child)
+            assert not child.spilled, (
+                "insert walked a spilled node — the engine must "
+                "promote (restore) matched spilled nodes before "
+                "registering the admitted prompt")
             child.last_used = now
             node = child
             i += bs
@@ -175,27 +296,39 @@ class PrefixCache:
             key = tuple(toks[i:])
             tail = node.tails.get(key)
             if tail is None:
-                tail = _Node(block_ids[bi], node, len(key), True, now)
+                tail = _Node(block_ids[bi], node, len(key), True, now,
+                             key)
                 node.tails[key] = tail
                 new.append(tail)
+            assert not tail.spilled, (
+                "insert walked a spilled tail — promote before insert")
             tail.last_used = now
         return new
 
     # --------------------------------------------------------- eviction
 
     def evictable(self) -> List[_Node]:
-        """Current victims: sharer-free LEAVES (tails, and chunk nodes
-        with no children and no tails), LRU-first."""
+        """Current victims: sharer-free RESIDENT leaves (tails, and
+        chunk nodes with no resident children and no resident tails),
+        LRU-first.  Spilled descendants don't anchor a parent — the
+        cascade that lets a whole cold branch demote tier by tier —
+        but destroying such a parent takes its (unreachable) spilled
+        subtree with it (:meth:`evict`)."""
         out: List[_Node] = []
+
+        def resident_leaf(nd: _Node) -> bool:
+            return (not any(not c.spilled for c in nd.children.values())
+                    and not any(not t.spilled
+                                for t in nd.tails.values()))
 
         def walk(node: _Node):
             for child in node.children.values():
                 walk(child)
-                if (not child.children and not child.tails
+                if (not child.spilled and resident_leaf(child)
                         and not child.sharers):
                     out.append(child)
             for tail in node.tails.values():
-                if not tail.sharers:
+                if not tail.spilled and not tail.sharers:
                     out.append(tail)
 
         walk(self._root)
@@ -203,11 +336,13 @@ class PrefixCache:
         return out
 
     def evict(self, max_blocks: int) -> List[int]:
-        """Drop up to ``max_blocks`` registered blocks (LRU leaf-first,
-        cascading: a parent whose last child left becomes a leaf and
-        may evict in the same call).  Returns the freed block ids —
-        the ENGINE unpins them (``paged_rc_add`` -1); a sharer-free
-        leaf's block then returns to the pool immediately."""
+        """DESTROY up to ``max_blocks`` registered blocks (LRU
+        leaf-first, cascading: a parent whose last child left becomes
+        a leaf and may evict in the same call).  Returns the freed
+        block ids — the ENGINE unpins them (``paged_rc_add`` -1); a
+        sharer-free leaf's block then returns to the pool immediately.
+        A victim's spilled descendants (unreachable once their match
+        path is gone) drop from the host store with it."""
         freed: List[int] = []
         while len(freed) < max_blocks:
             victims = self.evictable()
@@ -216,10 +351,103 @@ class PrefixCache:
             for victim in victims:
                 if len(freed) >= max_blocks:
                     break
-                self._remove(victim)
+                self._destroy(victim)
                 freed.append(victim.block_id)
                 self.evictions += 1
         return freed
+
+    def demote(self, max_blocks: int,
+               exporter: Callable[[int], dict]) -> List[int]:
+        """SPILL up to ``max_blocks`` eviction victims into the host
+        store instead of destroying them: ``exporter(block_id)``
+        (engine-supplied — it owns the device) serializes each
+        victim's pages BEFORE the block is given back, the node stays
+        in the tree marked ``spilled``, and the returned block ids are
+        unpinned by the engine exactly as :meth:`evict`'s.  Cascades
+        like eviction (a parent whose children all spilled is the next
+        round's victim).  Store pressure falls through loudly: an
+        entry the budget cannot hold destroys its node instead, and
+        LRU host entries dropped to make room destroy theirs
+        (``host_evictions``)."""
+        assert self.host_store is not None, \
+            "demote without a host store (engine bug)"
+        locked = (lambda key: bool(self._spilled_index[key].sharers)
+                  if key in self._spilled_index else False)
+        freed: List[int] = []
+        while len(freed) < max_blocks:
+            victims = self.evictable()
+            if not victims:
+                break
+            for victim in victims:
+                if len(freed) >= max_blocks:
+                    break
+                payload = exporter(victim.block_id)
+                ok, dropped = self.host_store.put(
+                    victim.prefix_keys(), payload, locked=locked)
+                for key in dropped:
+                    nd = self._spilled_index.get(key)
+                    if nd is not None:      # a prior cascade may have
+                        self._destroy_spilled(nd)   # taken it already
+                if ok:
+                    freed.append(victim.block_id)
+                    victim.block_id = -1
+                    victim.spilled = True
+                    self._spilled_index[victim.prefix_keys()] = victim
+                    self.spills += 1
+                else:
+                    self._destroy(victim)
+                    freed.append(victim.block_id)
+                    self.evictions += 1
+        return freed
+
+    def promote(self, node: _Node, block_id: int) -> None:
+        """Mark a spilled node resident again under ``block_id`` — the
+        restore path's registry half.  The ENGINE already imported the
+        host payload into that block and re-pinned it (+1 refcount);
+        the caller pops the store entry itself (the payload is the
+        import's input)."""
+        assert node.spilled, "promote of a resident node (engine bug)"
+        self._spilled_index.pop(node.prefix_keys(), None)
+        node.spilled = False
+        node.block_id = int(block_id)
+        node.last_used = next(self._tick)
+        self.restores += 1
+
+    def drop_spilled(self) -> int:
+        """Destroy every sharer-free host-tier entry (flush's host
+        half); returns how many were dropped.  Bottom-up, so parents
+        whose children all dropped leave in the same call."""
+        dropped = 0
+        for key in list(self._spilled_index.keys()):
+            node = self._spilled_index.get(key)
+            if node is not None and not node.sharers:
+                dropped += self._destroy_spilled(node)
+        return dropped
+
+    def _destroy_spilled(self, node: _Node) -> int:
+        """Remove a spilled node AND its (all-spilled) subtree from
+        the tree and the host store; returns entries destroyed."""
+        n = 0
+        for child in (list(node.children.values())
+                      + list(node.tails.values())):
+            n += self._destroy_spilled(child)
+        key = node.prefix_keys()
+        self._spilled_index.pop(key, None)
+        if self.host_store is not None and key in self.host_store:
+            self.host_store.pop(key)
+        self._remove(node)
+        self.host_evictions += 1
+        return n + 1
+
+    def _destroy(self, node: _Node) -> None:
+        """Remove a RESIDENT node; its spilled descendants (orphaned
+        match paths) drop from the host store with it."""
+        for child in (list(node.children.values())
+                      + list(node.tails.values())):
+            assert child.spilled, "destroying a node with resident " \
+                                  "descendants (evictable() bug)"
+            self._destroy_spilled(child)
+        self._remove(node)
 
     def _remove(self, node: _Node) -> None:
         parent = node.parent
@@ -231,30 +459,46 @@ class PrefixCache:
 
     # ------------------------------------------------------------ stats
 
-    def _count(self) -> Tuple[int, int, int]:
-        chunks = tails = shared = 0
+    def _count(self) -> Tuple[int, int, int, int]:
+        """(resident chunks, resident tails, shared, spilled)."""
+        chunks = tails = shared = spilled = 0
         stack = [self._root]
         while stack:
             node = stack.pop()
-            chunks += len(node.children)
-            tails += len(node.tails)
+            for nd in node.children.values():
+                if nd.spilled:
+                    spilled += 1
+                else:
+                    chunks += 1
+            for nd in node.tails.values():
+                if nd.spilled:
+                    spilled += 1
+                else:
+                    tails += 1
             shared += sum(1 for nd in node.children.values()
                           if nd.sharers)
             shared += sum(1 for nd in node.tails.values() if nd.sharers)
             stack.extend(node.children.values())
-        return chunks, tails, shared
+        return chunks, tails, shared, spilled
 
     @property
     def blocks(self) -> int:
-        """Registered (pinned) blocks."""
-        chunks, tails, _ = self._count()
+        """Registered RESIDENT (pinned) blocks — spilled nodes hold
+        no device block."""
+        chunks, tails, _, _ = self._count()
         return chunks + tails
 
     def stats(self) -> dict:
-        chunks, tails, shared = self._count()
+        chunks, tails, shared, spilled = self._count()
         return {"chunk_nodes": chunks, "tail_nodes": tails,
                 "pinned_blocks": chunks + tails,
                 "shared_blocks": shared,
+                "spilled_nodes": spilled,
                 "hits": self.hits, "misses": self.misses,
                 "hit_tokens": self.hit_tokens,
-                "evictions": self.evictions}
+                "evictions": self.evictions,
+                "spills": self.spills,
+                "restores": self.restores,
+                "host_evictions": self.host_evictions,
+                "host_bytes": (self.host_store.total_bytes
+                               if self.host_store is not None else 0)}

@@ -62,7 +62,7 @@ from paddle_tpu.ops.paged_attention import (dense_hbm_bytes,
                                             paged_hbm_bytes)
 from paddle_tpu.parallel.mesh import make_mesh
 from paddle_tpu.parallel.sharding import paged_cache_shardings
-from paddle_tpu.prefix_cache import PrefixCache
+from paddle_tpu.prefix_cache import HostPrefixStore, PrefixCache
 from paddle_tpu import speculative as spec_mod
 from paddle_tpu.speculative import SpecConfig, TruncatedDraft
 from paddle_tpu import telemetry
@@ -611,7 +611,8 @@ class PagedServingEngine:
                  spec: Optional[SpecConfig] = None, draft=None,
                  unified_step: bool = True, kv_dtype=None,
                  kv_pool_bytes: Optional[int] = None, mesh=None,
-                 mesh_axis: str = "mp"):
+                 mesh_axis: str = "mp",
+                 prefix_host_bytes: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -693,6 +694,19 @@ class PagedServingEngine:
         use_kernel = self.decode_kernel
         sharing = bool(prefix_cache)
         self.prefix_enabled = sharing
+        # Host spill tier: a byte-budgeted pinned-host store under the
+        # radix registry.  Pool-pressure eviction then DEMOTES
+        # sharer-free prefix nodes (pages serialized host-side) instead
+        # of destroying them, and a radix hit on a spilled node
+        # restores its blocks before the tail prefill — effective
+        # prefix capacity extends past HBM into host RAM.
+        enforce(prefix_host_bytes is None or sharing,
+                "prefix_host_bytes requires prefix_cache=True")
+        enforce(prefix_host_bytes is None or int(prefix_host_bytes) >= 1,
+                "prefix_host_bytes must be >= 1, got %s",
+                prefix_host_bytes)
+        self._host_store = (HostPrefixStore(int(prefix_host_bytes))
+                            if sharing and prefix_host_bytes else None)
 
         def _pin(c):
             # every traced fn returns its cache through this: the
@@ -1116,7 +1130,9 @@ class PagedServingEngine:
         self._next_rid = 0
         self._reserved = 0                # worst-case blocks, admitted
         self._pinned = 0                  # registry-pinned pool blocks
-        self._prefix = PrefixCache(self.bs) if sharing else None
+        self._prefix = (PrefixCache(self.bs,
+                                    host_store=self._host_store)
+                        if sharing else None)
         # tail pad widths: a hit's unmatched tail can be one token
         # (the full-prompt-hit replay), so the tail buckets extend the
         # prompt buckets downward; one tail-prefill compile per width
@@ -1316,8 +1332,40 @@ class PagedServingEngine:
                      "one live request (host-side estimate)")
             self._m_prefix_evict = m.counter(
                 "serving_prefix_evictions_total",
-                help="registered blocks unpinned under pool pressure "
-                     "(LRU sharer-free leaves) or by flush")
+                help="registered blocks leaving their tier under pool "
+                     "pressure (LRU sharer-free leaves) or by flush; "
+                     "tier=hbm counts blocks leaving the device pool "
+                     "(demoted OR destroyed), tier=host counts host-"
+                     "store entries destroyed.  The unlabeled series "
+                     "is the historical name and sums both tiers.")
+            if self._host_store is not None:
+                self._m_prefix_spilled_bytes = m.gauge(
+                    "serving_prefix_spilled_bytes",
+                    help="host bytes the spill tier currently pins "
+                         "(pages + int8 scales of demoted prefix "
+                         "blocks; reconciles with the host store)")
+                self._m_prefix_spilled_blocks = m.gauge(
+                    "serving_prefix_spilled_blocks",
+                    help="registry nodes whose pages live only in the "
+                         "host tier (block_id freed back to the pool)")
+                self._m_prefix_spills = m.counter(
+                    "serving_prefix_spills_total",
+                    help="resident prefix blocks demoted to the host "
+                         "tier instead of destroyed")
+                self._m_prefix_restores = m.counter(
+                    "serving_prefix_restores_total",
+                    help="admissions that promoted >=1 spilled node "
+                         "back to the device pool before tail prefill")
+                self._m_prefix_restore_blocks = m.counter(
+                    "serving_prefix_restore_blocks_total",
+                    help="pool blocks re-imported from the host tier "
+                         "on restore hits")
+                self._m_prefix_restore_s = m.histogram(
+                    "serving_prefix_restore_seconds",
+                    help="wall time of one restore (host concat + "
+                         "paged_import_blocks + device_put + re-pin)",
+                    buckets=(.0005, .001, .0025, .005, .01, .025, .05,
+                             .1, .25, .5, 1.0))
 
     # ---------------------------------------------------------- host API
 
@@ -1554,9 +1602,15 @@ class PagedServingEngine:
             elif self._prefix is not None:
                 hit = self._prefix.match(req.prompt)
                 if hit.block_ids:
-                    # matched blocks are resident already: reserve the
-                    # tail plus ONE block of copy-on-write slack
-                    need = need - len(hit.block_ids) + 1
+                    # RESIDENT matched blocks are paid for already:
+                    # reserve the tail plus ONE block of copy-on-write
+                    # slack.  SPILLED matched blocks stay in `need` —
+                    # the restore re-imports each into a fresh pool
+                    # block, and _admit_hit transfers that reservation
+                    # to the registry pin once the block is resident.
+                    resident = sum(1 for nd in hit.nodes
+                                   if not nd.spilled)
+                    need = need - resident + 1
                 # registration may pin this request's own tail block
                 # past its reservation's reach — one more COW-slack
                 # block keeps the ledger an upper bound
@@ -1671,10 +1725,15 @@ class PagedServingEngine:
         the replayed write into a private block, never under the
         registered copy's other readers."""
         n = int(req.prompt.shape[0])
+        spilled = [nd for nd in hit.nodes if nd.spilled]
+        if spilled:
+            self._restore_spilled(req, slot, spilled)
         new_len = hit.shared_len if hit.shared_len < n else n - 1
         nmap = len(hit.block_ids)
         bid = np.zeros((self.maxb,), np.int32)
-        bid[:nmap] = hit.block_ids
+        # read ids off the NODES, not hit.block_ids — a restore just
+        # rewrote the spilled entries' block_id from -1 to fresh blocks
+        bid[:nmap] = [nd.block_id for nd in hit.nodes]
         self.cache = self._share(
             self.cache, jnp.asarray(slot, jnp.int32), jnp.asarray(bid),
             jnp.asarray(nmap, jnp.int32),
@@ -1701,6 +1760,49 @@ class PagedServingEngine:
                                 matched_tokens=hit.shared_len,
                                 blocks=nmap, prefill_tokens=tlen)
         return tok0, done0, ok, width, tlen
+
+    def _restore_spilled(self, req, slot, spilled):
+        """Promote a hit's spilled suffix back to the device pool
+        before the share: pop each node's host payload (logical
+        order), write them into free blocks in ONE
+        ``paged_import_blocks`` call, re-shard under a mesh, PIN the
+        imported blocks (+1 refcount — write-then-pin-then-share, so a
+        concurrent claim can never zero a just-restored page), then
+        promote the registry nodes onto their new block ids.  The
+        admission ledger covered these blocks inside the request's
+        reservation; pinning transfers them to ``_pinned`` exactly as
+        :meth:`_register_prefix` transfers fresh registrations."""
+        t_r0 = time.perf_counter()
+        payloads = [self._host_store.pop(nd.prefix_keys())
+                    for nd in spilled]
+        blocks = paged.paged_concat_block_payloads(payloads)
+        cache, ids = paged.paged_import_blocks(self.cache, blocks)
+        assert ids is not None, \
+            "restore found no free blocks despite admission " \
+            "accounting (engine bug)"
+        if self.mesh is not None:
+            # eager host-side page writes drop the pool's head-axis
+            # placement — restore it before the donated step sees a
+            # mixed-layout cache (the handoff-import rule)
+            cache = jax.device_put(
+                cache,
+                paged_cache_shardings(cache, self.mesh, self.mesh_axis))
+        delta = np.zeros((self.nb,), np.int32)
+        for b in ids:
+            delta[b] += 1
+        self.cache = self._rc_add(cache, jnp.asarray(delta))
+        for nd, b in zip(spilled, ids):
+            self._prefix.promote(nd, int(b))
+        self._pinned += len(spilled)
+        req.blocks_reserved -= len(spilled)
+        nbytes = sum(HostPrefixStore.payload_bytes(p) for p in payloads)
+        self._m_prefix_restores.inc()
+        self._m_prefix_restore_blocks.inc(len(spilled))
+        self._m_prefix_restore_s.observe(time.perf_counter() - t_r0)
+        if self.tracer is not None:
+            self.tracer.instant("prefix_restore", track=f"slot{slot}",
+                                rid=req.rid, blocks=len(spilled),
+                                bytes=nbytes)
 
     def _admit_handoff(self, req, slot):
         """Admission path for an imported-KV request
@@ -1778,32 +1880,86 @@ class PagedServingEngine:
             tail_new = any(nd.is_tail for nd in new_nodes)
             req.blocks_reserved += (1 if tail_new else 0) - len(new_nodes)
 
-    def _evict_prefix(self, n_blocks: int) -> int:
+    def _export_block(self, block_id: int) -> dict:
+        """Registry demotion exporter: one block's pages (+ int8
+        scales) as a host payload — the engine owns the device, the
+        registry only decides WHICH block spills."""
+        return paged.paged_export_block(self.cache, block_id)
+
+    def _evict_prefix(self, n_blocks: int, spill: bool = True) -> int:
         """Unpin up to ``n_blocks`` LRU sharer-free registry leaves.
         The pin is the only refcount such a block still holds, so the
-        decrement returns it to the pool immediately."""
-        freed = self._prefix.evict(n_blocks)
+        decrement returns it to the pool immediately.  With a host
+        store attached (and ``spill`` true) victims DEMOTE — pages
+        serialized host-side before the unpin — instead of being
+        destroyed; either way the freed blocks leave the device pool,
+        so the ledger math is identical."""
+        pre_host = self._prefix.host_evictions
+        if spill and self._host_store is not None:
+            pre_spills = self._prefix.spills
+            freed = self._prefix.demote(n_blocks, self._export_block)
+            n_spilled = self._prefix.spills - pre_spills
+        else:
+            freed = self._prefix.evict(n_blocks)
+            n_spilled = 0
         if freed:
             delta = np.zeros((self.nb,), np.int32)
             for b in freed:
                 delta[b] -= 1
             self.cache = self._rc_add(self.cache, jnp.asarray(delta))
             self._pinned -= len(freed)
+            # unlabeled series = historical name, sums both tiers
             self._m_prefix_evict.inc(len(freed))
+            self._m_prefix_evict.inc(len(freed), tier="hbm")
             if self.tracer is not None:
                 self.tracer.instant("prefix_evict", track="host",
-                                    blocks=len(freed))
+                                    blocks=len(freed),
+                                    spilled=n_spilled)
+        if n_spilled:
+            self._m_prefix_spills.inc(n_spilled)
+            if self.tracer is not None:
+                self.tracer.instant("prefix_spill", track="host",
+                                    blocks=n_spilled,
+                                    host_bytes=self._host_store
+                                    .total_bytes)
+        n_host = self._prefix.host_evictions - pre_host
+        if n_host:
+            # host-budget LRU drops and orphaned spilled subtrees
+            self._m_prefix_evict.inc(n_host)
+            self._m_prefix_evict.inc(n_host, tier="host")
         return len(freed)
+
+    def spill_prefix_cache(self, max_blocks: Optional[int] = None) -> int:
+        """Demote up to ``max_blocks`` (default: every evictable)
+        sharer-free registry leaves into the host tier, returning
+        their device blocks to the pool; returns how many blocks were
+        unpinned.  The cold-start / pressure-relief knob: the spilled
+        prefixes keep answering radix matches and restore on their
+        next hit."""
+        enforce(self._prefix is not None,
+                "spill_prefix_cache: engine built without prefix_cache")
+        enforce(self._host_store is not None,
+                "spill_prefix_cache: engine built without "
+                "prefix_host_bytes")
+        return self._evict_prefix(
+            self.nb if max_blocks is None else int(max_blocks),
+            spill=True)
 
     def flush_prefix_cache(self) -> int:
         """Evict every evictable registry entry (sharer-free leaves,
         cascading through emptied parents) and return their blocks to
-        the pool; returns how many blocks were unpinned.  Entries
-        still mapped by live requests survive — flush again after they
-        retire for a full clear."""
+        the pool; returns how many blocks were unpinned.  Drains BOTH
+        tiers: host-store entries are destroyed (never demoted-to) on
+        the way out.  Entries still mapped by live requests survive —
+        flush again after they retire for a full clear."""
         enforce(self._prefix is not None,
                 "flush_prefix_cache: engine built without prefix_cache")
-        return self._evict_prefix(self.nb)
+        if self._host_store is not None:
+            dropped = self._prefix.drop_spilled()
+            if dropped:
+                self._m_prefix_evict.inc(dropped)
+                self._m_prefix_evict.inc(dropped, tier="host")
+        return self._evict_prefix(self.nb, spill=False)
 
     def _retire(self, slot: int, reason: str = "max_new"):
         if self._faults is not None:
@@ -1866,6 +2022,10 @@ class PagedServingEngine:
             st = self._prefix.stats()
             self._m_prefix_pinned.set(st["pinned_blocks"])
             self._m_prefix_shared.set(st["shared_blocks"])
+            if self._host_store is not None:
+                self._m_prefix_spilled_bytes.set(
+                    self._host_store.total_bytes)
+                self._m_prefix_spilled_blocks.set(st["spilled_nodes"])
 
     def step(self):
         """One decode step over every active slot, then retire/admit.
@@ -2165,6 +2325,11 @@ class PagedServingEngine:
             "prefix_pinned_blocks": self._pinned,
             "prefix_cache": (None if self._prefix is None
                              else self._prefix.stats()),
+            "prefix_host_tier": (None if self._host_store is None else {
+                "budget_bytes": self._host_store.max_bytes,
+                "bytes": self._host_store.total_bytes,
+                "entries": len(self._host_store),
+            }),
             # the pool ledger in one place: everything the watchdog and
             # the frontend's router read, with no private attributes
             "ledger": {
@@ -2277,6 +2442,13 @@ class PagedServingEngine:
             "prefix_pinned_blocks": self._pinned,
             "prefix_pinned_bytes": (self._pinned * self.block_bytes
                                     * self.shards),
+            # the host tier those pins demote into under pressure —
+            # HOST bytes, deliberately outside every HBM total above
+            "prefix_host_bytes": (0 if self._host_store is None
+                                  else self._host_store.total_bytes),
+            "prefix_host_budget_bytes": (
+                0 if self._host_store is None
+                else self._host_store.max_bytes),
         }
 
     def stats(self):

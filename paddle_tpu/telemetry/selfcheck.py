@@ -33,6 +33,18 @@ Ten checks, each a hard failure (non-zero exit) when violated:
    (copy-on-write rides the same traced unified step), and
    ``hbm_report()`` must reconcile — pinned prefix blocks are the only
    pool residue after the run and a flush returns the pool to empty.
+5b. **Spill-tier smoke** — the shared-prefix engine with a host-RAM
+   spill store (``prefix_host_bytes``) under FORCED pool pressure:
+   admission must DEMOTE sharer-free prefix blocks to the host tier
+   (nonzero spills, zero destroys), a re-arrival of the demoted
+   prefix must RESTORE it (nonzero restores) with its greedy stream
+   bit-identical to a sharing-off engine, the
+   ``serving_prefix_spilled_bytes`` gauge must reconcile with the
+   store's byte total, the eviction counter's ``tier={hbm,host}``
+   split must sum to the unlabeled series, the
+   ``compiles == {'step': 1}`` contract must hold across
+   spill/restore (imports are eager host writes, never a program),
+   and ``flush_prefix_cache`` must drain BOTH tiers to empty.
 6. **Speculative smoke** — the same tiny engine with
    ``spec=SpecConfig(...)`` (and the prefix cache on) serves greedy
    requests next to a spec-off twin: the streams must be
@@ -125,6 +137,7 @@ INSTRUMENTED_ENTRYPOINTS = (
     "paged-engine-decode-spec",
     "paged-engine-step-int8",
     "paged-engine-step-ragged",
+    "paged-engine-step-spill",
     "paged-serve-step",
     "trainer-train-step",
     "trainer-train-step-health",
@@ -356,6 +369,92 @@ def _check_prefix_smoke():
     toks = sum(s["value"] for s in
                metrics["serving_prefix_hit_tokens_total"]["series"])
     return int(hits), int(toks)
+
+
+def _check_prefix_spill_smoke():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               TransformerLM)
+    from paddle_tpu.serving import PagedServingEngine
+    from paddle_tpu.telemetry import MetricsRegistry, validate_snapshot
+
+    cfg = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                            num_layers=1, ffn_mult=2, max_len=16)
+    model = nn.transform(lambda ids: TransformerLM(cfg, name="lm")(ids))
+    params, _ = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+    reg = MetricsRegistry("selfcheck-spill")
+    # one slot + a pool sized so the third admission MUST relieve
+    # pressure (4 pinned + 3-block worst case + 1 COW slack > 7):
+    # with the host store attached that pressure demotes
+    eng = PagedServingEngine(cfg, params, num_slots=1, num_blocks=7,
+                             block_size=4, prompt_buckets=(8,),
+                             metrics=reg, prefix_cache=True,
+                             prefix_host_bytes=1 << 18)
+    p1 = np.arange(1, 8, dtype=np.int32)           # 7 tokens: 2 blocks
+    p2 = (p1 + 9) % 30 + 1
+    p3 = (p1 + 17) % 30 + 1
+    eng.submit(p1, max_new=4)
+    ref_stream = eng.run().popitem()[1]
+    eng.submit(p2, max_new=4)
+    eng.run()
+    eng.submit(p3, max_new=4)
+    eng.run()
+    st = eng.host_state()["prefix_cache"]
+    if st["spills"] <= 0:
+        _fail(f"forced pool pressure did not demote: {st}")
+    if st["evictions"] != 0:
+        _fail("pressure DESTROYED prefix blocks despite the host "
+              f"tier having room: {st}")
+    # the demoted p1 prefix re-arrives: must restore, bit-identically
+    eng.submit(p1, max_new=4)
+    restored_stream = eng.run().popitem()[1]
+    st = eng.host_state()["prefix_cache"]
+    if st["restores"] <= 0:
+        _fail(f"re-arrival of a spilled prefix did not restore: {st}")
+    solo = PagedServingEngine(cfg, params, num_slots=1, num_blocks=7,
+                              block_size=4, prompt_buckets=(8,))
+    solo.submit(p1, max_new=4)
+    if not np.array_equal(restored_stream, solo.run().popitem()[1]) or \
+            not np.array_equal(restored_stream, ref_stream):
+        _fail("restored stream is not bit-identical to the sharing-off "
+              "engine's")
+    compiles = eng.compile_counts()
+    if compiles.get("step") != 1:
+        _fail("the compiles == {'step': 1} contract broke across "
+              f"spill/restore: {compiles}")
+
+    snap = reg.snapshot()
+    validate_snapshot(snap)
+    metrics = snap["metrics"]
+    gauge = sum(s["value"] for s in
+                metrics["serving_prefix_spilled_bytes"]["series"])
+    if gauge != eng._host_store.total_bytes:
+        _fail(f"serving_prefix_spilled_bytes gauge {gauge} does not "
+              f"reconcile with the host store "
+              f"({eng._host_store.total_bytes} bytes)")
+    ev = {tuple(sorted(s["labels"].items())): s["value"] for s in
+          metrics["serving_prefix_evictions_total"]["series"]}
+    total = ev.get((), 0)
+    split = ev.get((("tier", "hbm"),), 0) + ev.get((("tier", "host"),), 0)
+    if total != split or ev.get((("tier", "hbm"),), 0) <= 0:
+        _fail("eviction tier labels must sum to the unlabeled series "
+              f"with a nonzero hbm share: {ev}")
+
+    n_spills, n_restores = int(st["spills"]), int(st["restores"])
+    eng.flush_prefix_cache()
+    st = eng.host_state()["prefix_cache"]
+    if (eng.occupancy()["blocks_in_use"] != 0 or st["spilled_nodes"]
+            or len(eng._host_store) or eng._host_store.total_bytes):
+        _fail("flush_prefix_cache left a tier non-empty: "
+              f"occ={eng.occupancy()} registry={st} "
+              f"store={len(eng._host_store)}/"
+              f"{eng._host_store.total_bytes}B")
+    return n_spills, n_restores
 
 
 def _check_spec_smoke():
@@ -925,6 +1024,11 @@ def main(argv=None) -> int:
     print(f"selfcheck: shared-prefix smoke ok ({p_hits} hit(s), "
           f"{p_toks} shared tokens, compiles==1 with sharing on, "
           "pool reconciles + flush empties)")
+    sp_spills, sp_restores = _check_prefix_spill_smoke()
+    print(f"selfcheck: spill-tier smoke ok ({sp_spills} demotion(s) "
+          f"under forced pressure, {sp_restores} restore(s) "
+          "bit-identical, spilled-bytes gauge reconciles, tier labels "
+          "sum, flush drains both tiers)")
     s_accepted, s_compiles = _check_spec_smoke()
     print(f"selfcheck: speculative smoke ok ({s_accepted} accepted "
           "draft tokens, greedy byte-identical, compiles bounded "

@@ -1,0 +1,454 @@
+"""Tiered prefix cache: host-RAM spill/restore behind the radix tree
+(``prefix_cache.py`` HostPrefixStore + demote/promote,
+``ops/paged_attention.py`` block export, ``serving.py`` restore path).
+
+The load-bearing pins:
+
+* TOKEN IDENTITY ACROSS THE TIER: a prompt served after its prefix
+  was demoted to host RAM and restored is bit-identical to the same
+  prompt on a sharing-off engine — {bf16, int8} x {XLA, kernel} — and
+  the ``compiles == {'step': 1, 'prefill': 1}`` pin survives the
+  restore (imports are eager host writes, never a new program).
+* REFCOUNTS NEVER LEAK ACROSS TIERS: the resident-pin invariant holds
+  through randomized submit/step/spill/flush interleavings, and the
+  host store reconciles with the registry's spilled-node set at every
+  host-visible point.
+* BYTES SURVIVE THE ROUND TRIP: int8 pages AND their per-block scale
+  rows come back bit-exact after spill + restore.
+* The eviction counter's ``tier={hbm,host}`` split sums to the
+  historical unlabeled series.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.prefix_cache import HostPrefixStore, PrefixCache
+from paddle_tpu.serving import PagedServingEngine
+from paddle_tpu import telemetry
+import paddle_tpu.nn as nn
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _engine(params, *, sharing=True, host_bytes=1 << 20, num_blocks=24,
+            num_slots=2, seed=0, decode_kernel=None, kv_dtype=None,
+            metrics=None, tracer=None):
+    return PagedServingEngine(
+        CFG, params, num_slots=num_slots, num_blocks=num_blocks,
+        block_size=4, prompt_buckets=(16,), prefix_cache=sharing,
+        prefix_host_bytes=host_bytes if sharing else None,
+        kv_dtype=kv_dtype, seed=seed, decode_kernel=decode_kernel,
+        metrics=metrics if metrics is not None
+        else telemetry.MetricsRegistry(), tracer=tracer)
+
+
+PREFIX = (np.arange(1, 11) % 50 + 1).astype(np.int32)   # 10 tokens
+
+
+# ------------------------------------------------- host store (unit)
+
+
+def _payload(nbytes):
+    assert nbytes % 2 == 0
+    half = np.zeros((nbytes // 2,), np.uint8)
+    return {"block_size": 4, "kv_dtype": "float32",
+            "k_pages": (half,), "v_pages": (half.copy(),),
+            "k_scales": (), "v_scales": ()}
+
+
+def test_host_store_lru_drops_oldest_first():
+    st = HostPrefixStore(max_bytes=100)
+    for i in range(3):                           # 30 bytes each
+        ok, drops = st.put(("k", i), _payload(30))
+        assert ok and drops == []
+    assert st.total_bytes == 90 and len(st) == 3
+    ok, drops = st.put(("k", 3), _payload(40))   # needs 30 freed
+    assert ok and drops == [("k", 0)], "oldest entry drops first"
+    assert st.total_bytes == 100 and ("k", 0) not in st
+
+
+def test_host_store_rejects_oversized_and_respects_locks():
+    st = HostPrefixStore(max_bytes=50)
+    ok, drops = st.put("big", _payload(60))
+    assert not ok and drops == [] and len(st) == 0
+    assert st.put("a", _payload(30))[0]
+    assert st.put("b", _payload(20))[0]
+    # both entries locked: the new entry cannot claim their bytes
+    ok, drops = st.put("c", _payload(30), locked=lambda k: True)
+    assert not ok and drops == []
+    assert st.total_bytes == 50 and "a" in st and "b" in st
+    # only "a" locked: "b" is droppable, making room
+    ok, drops = st.put("c", _payload(20), locked=lambda k: k == "a")
+    assert ok and drops == ["b"]
+    assert "a" in st and "c" in st and st.total_bytes == 50
+
+
+def test_host_store_put_replaces_existing_key_bytes():
+    st = HostPrefixStore(max_bytes=50)
+    assert st.put("a", _payload(40))[0]
+    assert st.put("a", _payload(20))[0], "re-put must reclaim old bytes"
+    assert st.total_bytes == 20 and len(st) == 1
+
+
+# ---------------------------------------------- radix demote/promote
+
+
+def test_registry_demote_marks_spilled_and_match_still_walks():
+    pc = PrefixCache(block_size=4, host_store=HostPrefixStore(1 << 16))
+    pc.insert(list(range(10)), [5, 6, 7])        # 2 chunks + tail
+    freed = pc.demote(10, lambda bid: _payload(16))
+    # leaf-first cascade: tail 7, then chunk 6, then chunk 5
+    assert freed == [7, 6, 5]
+    assert pc.blocks == 0 and pc.stats()["spilled_nodes"] == 3
+    assert pc.stats()["spills"] == 3 and len(pc.host_store) == 3
+    hit = pc.match(list(range(10)) + [99])
+    assert hit.shared_len == 10, "spilled nodes must keep matching"
+    assert all(nd.spilled for nd in hit.nodes)
+    assert hit.block_ids == [-1, -1, -1]
+    for nd, bid in zip(hit.nodes, (11, 12, 13)):
+        pc.host_store.pop(nd.prefix_keys())
+        pc.promote(nd, bid)
+    assert pc.blocks == 3 and pc.stats()["restores"] == 3
+    assert len(pc.host_store) == 0
+    assert pc.match(list(range(10))).block_ids == [11, 12, 13]
+
+
+def test_registry_demote_sharer_guard_and_budget_fallthrough():
+    pc = PrefixCache(block_size=4, host_store=HostPrefixStore(40))
+    (a,) = pc.insert(list(range(4)), [1])
+    a.sharers.add(0)
+    assert pc.demote(10, lambda bid: _payload(16)) == []
+    a.sharers.discard(0)
+    # budget holds 2 of these payloads; a third demotion drops the LRU
+    pc.insert(list(range(4)) + [9], [1, 2])      # tail under a
+    pc.insert(list(range(4)) + [8], [1, 3])      # second tail
+    freed = pc.demote(10, lambda bid: _payload(16))
+    assert sorted(freed) == [1, 2, 3]
+    assert pc.stats()["spills"] + pc.stats()["host_evictions"] >= 3
+    assert pc.host_store.total_bytes <= 40
+    # an entry that can never fit destroys its node instead
+    pc2 = PrefixCache(block_size=4, host_store=HostPrefixStore(8))
+    pc2.insert(list(range(4)), [4])
+    assert pc2.demote(10, lambda bid: _payload(16)) == [4]
+    assert pc2.stats()["spilled_nodes"] == 0
+    assert pc2.stats()["evictions"] == 1 and len(pc2.host_store) == 0
+
+
+def test_registry_evict_destroys_orphaned_spilled_descendants():
+    pc = PrefixCache(block_size=4, host_store=HostPrefixStore(1 << 16))
+    pc.insert(list(range(10)), [5, 6, 7])
+    # demote only the deepest entries; chunk 5 stays resident
+    freed = pc.demote(2, lambda bid: _payload(16))
+    assert freed == [7, 6] and pc.blocks == 1
+    # destroying the resident parent takes the spilled subtree with it
+    assert pc.evict(10) == [5]
+    assert pc.stats()["spilled_nodes"] == 0 and len(pc.host_store) == 0
+    assert pc.stats()["host_evictions"] == 2
+    assert pc.match(list(range(10))).shared_len == 0
+
+
+def test_registry_drop_spilled_clears_host_tier_only():
+    pc = PrefixCache(block_size=4, host_store=HostPrefixStore(1 << 16))
+    pc.insert(list(range(10)), [5, 6, 7])
+    pc.demote(2, lambda bid: _payload(16))       # 7, 6 spill
+    assert pc.drop_spilled() == 2
+    assert len(pc.host_store) == 0 and pc.host_store.total_bytes == 0
+    assert pc.blocks == 1, "resident nodes survive the host drop"
+
+
+# --------------------------------------- spill-aware leak invariant
+
+
+def _resident_pins(eng):
+    """block id -> registry pin count, RESIDENT nodes only (a spilled
+    node holds no device block, so no pin)."""
+    pins = {}
+    stack = [eng._prefix._root]
+    while stack:
+        node = stack.pop()
+        for nd in (list(node.children.values())
+                   + list(node.tails.values())):
+            if not nd.spilled:
+                pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
+        stack.extend(node.children.values())
+    return pins
+
+
+def _assert_tiers_reconcile(eng):
+    """Refcounts == slot mappings + resident pins; the host store's
+    byte total and key set mirror the registry's spilled nodes."""
+    tables = np.asarray(eng.cache.block_tables)
+    used = np.asarray(eng.cache.blocks_used)
+    rc = np.asarray(eng.cache.refcounts)
+    expect = np.zeros_like(rc)
+    for s in range(eng.S):
+        for b in tables[s, :used[s]]:
+            assert b >= 0
+            expect[b] += 1
+    for b, n in _resident_pins(eng).items():
+        assert b >= 0, "a resident node must hold a physical block"
+        expect[b] += n
+    np.testing.assert_array_equal(rc, expect)
+    assert sum(_resident_pins(eng).values()) == eng._pinned
+    assert eng._reserved + eng._pinned <= eng.nb
+    spilled = eng._prefix._spilled_index
+    assert set(spilled.keys()) == set(eng._host_store.keys())
+    assert all(nd.spilled and nd.block_id == -1
+               for nd in spilled.values())
+    assert eng._prefix.stats()["spilled_nodes"] == len(eng._host_store)
+    assert eng._host_store.total_bytes == sum(
+        HostPrefixStore.payload_bytes(eng._host_store._entries[k])
+        for k in eng._host_store.keys())
+    assert eng._host_store.total_bytes <= eng._host_store.max_bytes
+
+
+# ------------------------------------------------- token identity
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("decode_kernel", [None, True])
+def test_token_identity_across_spill_restore(params, kv_dtype,
+                                             decode_kernel):
+    eng = _engine(params, kv_dtype=kv_dtype, decode_kernel=decode_kernel)
+    prompt = np.concatenate([PREFIX, [3, 4]]).astype(np.int32)
+    r0 = eng.submit(prompt, max_new=4)
+    out0 = eng.run()[r0]
+    n = eng.spill_prefix_cache()
+    assert n > 0 and eng._pinned == 0
+    assert eng.occupancy()["blocks_in_use"] == 0
+    r1 = eng.submit(prompt, max_new=4)
+    out1 = eng.run()[r1]
+    st = eng._prefix.stats()
+    assert st["restores"] == n and st["spilled_nodes"] == 0
+    np.testing.assert_array_equal(out0, out1)
+    ref = _engine(params, sharing=False, kv_dtype=kv_dtype,
+                  decode_kernel=decode_kernel)
+    rr = ref.submit(prompt, max_new=4)
+    np.testing.assert_array_equal(out0, ref.run()[rr])
+    counts = eng.compile_counts()
+    assert counts["step"] == 1 and counts["prefill"] == 1, counts
+    # flush drains BOTH tiers
+    eng.spill_prefix_cache()
+    assert len(eng._host_store) > 0
+    eng.flush_prefix_cache()
+    assert len(eng._host_store) == 0
+    assert eng._host_store.total_bytes == 0
+    assert eng._prefix.stats()["spilled_nodes"] == 0
+    assert eng.occupancy()["blocks_in_use"] == 0 and eng._pinned == 0
+
+
+def test_restore_prefills_tail_only(params):
+    tracer = telemetry.Tracer(name="t")
+    eng = _engine(params, tracer=tracer)
+    prompt = np.concatenate([PREFIX, [3, 4]]).astype(np.int32)
+    eng.submit(prompt, max_new=2)
+    eng.run()
+    eng.spill_prefix_cache()
+    eng.submit(prompt, max_new=2)
+    eng.run()
+    restores = [e for e in tracer.events()
+                if e["name"] == "prefix_restore"]
+    assert len(restores) == 1 and restores[0]["args"]["blocks"] == 3
+    assert restores[0]["args"]["bytes"] > 0
+    prefills = [e for e in tracer.events() if e["name"] == "prefill"]
+    assert prefills[0]["args"]["prefill_tokens"] == len(prompt)
+    assert prefills[-1]["args"]["prefill_tokens"] == 1, (
+        "a restored full-prompt hit replays exactly the final token")
+
+
+# ---------------------------------------------- pressure + metrics
+
+
+def test_pool_pressure_demotes_and_labels_tiers(params):
+    reg = telemetry.MetricsRegistry()
+    # pool sized so the third prompt's admission must relieve pressure
+    eng = _engine(params, num_blocks=8, num_slots=1, metrics=reg)
+    p1 = PREFIX
+    p2 = ((PREFIX + 13) % 50 + 1).astype(np.int32)
+    eng.submit(p1, max_new=2)
+    eng.run()
+    eng.submit(p2, max_new=2)
+    eng.run()
+    assert eng._pinned > 0
+    p3 = ((PREFIX + 29) % 50 + 1).astype(np.int32)
+    eng.submit(p3, max_new=6)
+    out = eng.run()
+    assert len(out) == 1
+    st = eng._prefix.stats()
+    assert st["spills"] > 0, (
+        "pool pressure must demote, not destroy, with a host tier")
+    assert st["evictions"] == 0
+    _assert_tiers_reconcile(eng)
+    # p1's prefix went to host under pressure; its re-arrival restores
+    eng.submit(p1, max_new=2)
+    eng.run()
+    assert eng._prefix.stats()["restores"] > 0
+    _assert_tiers_reconcile(eng)
+    # the tier split sums to the historical unlabeled series
+    series = reg.snapshot()["metrics"][
+        "serving_prefix_evictions_total"]["series"]
+    by_tier = {tuple(sorted(s["labels"].items())): s["value"]
+               for s in series}
+    unlabeled = by_tier.get((), 0)
+    hbm = by_tier.get((("tier", "hbm"),), 0)
+    host = by_tier.get((("tier", "host"),), 0)
+    assert unlabeled == hbm + host and hbm > 0
+    # gauges reconcile with the store after a step sampled them
+    snap = reg.snapshot()["metrics"]
+    assert (snap["serving_prefix_spilled_bytes"]["series"][0]["value"]
+            == eng._host_store.total_bytes)
+
+
+def test_spilled_bytes_gauge_and_flush_host_label(params):
+    reg = telemetry.MetricsRegistry()
+    eng = _engine(params, metrics=reg)
+    eng.submit(PREFIX, max_new=2)
+    eng.run()
+    eng.spill_prefix_cache()
+    eng.submit(np.array([7, 7, 7], np.int32), max_new=2)
+    eng.run()                                    # a step samples gauges
+    snap = reg.snapshot()["metrics"]
+    assert (snap["serving_prefix_spilled_bytes"]["series"][0]["value"]
+            == eng._host_store.total_bytes > 0)
+    assert (snap["serving_prefix_spilled_blocks"]["series"][0]["value"]
+            == len(eng._host_store))
+    before = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["serving_prefix_evictions_total"]["series"]}
+    eng.flush_prefix_cache()                     # drains the host tier
+    after = {tuple(sorted(s["labels"].items())): s["value"]
+             for s in reg.snapshot()["metrics"]
+             ["serving_prefix_evictions_total"]["series"]}
+    host_key = (("tier", "host"),)
+    assert after[host_key] > before.get(host_key, 0)
+    assert after[()] == (after[host_key]
+                         + after.get((("tier", "hbm"),), 0))
+
+
+# ------------------------------------------------ randomized leak
+
+
+def test_spill_refcounts_never_leak_randomized(params):
+    rng = np.random.default_rng(7)
+    # a host budget that fits only ~4 block payloads (one bf16 block =
+    # 2048 bytes here) forces live store LRU churn alongside restores
+    eng = _engine(params, num_blocks=20, num_slots=2, host_bytes=9000)
+    prefixes = [PREFIX, (PREFIX + 7) % 50 + 1,
+                (PREFIX + 23) % 50 + 1]
+    pending = 0
+    for step in range(70):
+        roll = rng.random()
+        if roll < 0.3 and pending < 6:
+            base = prefixes[int(rng.integers(len(prefixes)))]
+            tail = rng.integers(0, CFG.vocab_size,
+                                size=int(rng.integers(0, 4)))
+            prompt = np.concatenate([base, tail]).astype(np.int32)
+            eng.submit(prompt, max_new=int(rng.integers(1, 6)))
+            pending += 1
+        elif roll < 0.42 and eng._prefix.blocks:
+            eng.spill_prefix_cache(int(rng.integers(1, 6)))
+        elif roll < 0.5:
+            eng.flush_prefix_cache()
+        else:
+            progressed = eng.step()
+            if not progressed and not eng._queue:
+                pending = 0
+        _assert_tiers_reconcile(eng)
+    eng.run()
+    _assert_tiers_reconcile(eng)
+    assert eng.occupancy()["blocks_in_use"] == eng._pinned
+    st = eng._prefix.stats()
+    assert st["spills"] > 0 and st["restores"] > 0, (
+        "the interleaving must actually exercise the tier "
+        f"(spills={st['spills']} restores={st['restores']})")
+    eng.flush_prefix_cache()
+    assert eng.occupancy()["blocks_in_use"] == 0
+    assert eng._pinned == 0 and eng._prefix.blocks == 0
+    assert len(eng._host_store) == 0
+
+
+# ------------------------------------------------- int8 round trip
+
+
+def test_int8_pages_and_scales_bit_exact_through_host_store(params):
+    eng = _engine(params, kv_dtype="int8")
+    prompt = np.concatenate([PREFIX, [3, 4]]).astype(np.int32)
+    eng.submit(prompt, max_new=2)
+    eng.run()
+    assert eng.cache.quantized
+    # snapshot every registered block's pages + scale rows by node
+    nodes = [nd for _, nd in _walk_nodes(eng._prefix)]
+    before = {}
+    for nd in nodes:
+        b = nd.block_id
+        before[nd.prefix_keys()] = (
+            [np.asarray(p[b]) for p in eng.cache.k_pages],
+            [np.asarray(p[b]) for p in eng.cache.v_pages],
+            [np.asarray(s[b]) for s in eng.cache.k_scales],
+            [np.asarray(s[b]) for s in eng.cache.v_scales])
+    n = eng.spill_prefix_cache()
+    assert n == len(nodes) > 0
+    eng.submit(prompt, max_new=2)
+    eng.run()
+    assert eng._prefix.stats()["restores"] == n
+    for key, nd in _walk_nodes(eng._prefix):
+        kp, vp, ks, vs = before[key]
+        b = nd.block_id
+        for i in range(len(kp)):
+            np.testing.assert_array_equal(
+                np.asarray(eng.cache.k_pages[i][b]), kp[i])
+            np.testing.assert_array_equal(
+                np.asarray(eng.cache.v_pages[i][b]), vp[i])
+            np.testing.assert_array_equal(
+                np.asarray(eng.cache.k_scales[i][b]), ks[i],
+                err_msg="int8 K scales must survive the round trip")
+            np.testing.assert_array_equal(
+                np.asarray(eng.cache.v_scales[i][b]), vs[i],
+                err_msg="int8 V scales must survive the round trip")
+
+
+def _walk_nodes(pc):
+    out = []
+    stack = [pc._root]
+    while stack:
+        node = stack.pop()
+        for nd in (list(node.children.values())
+                   + list(node.tails.values())):
+            out.append((nd.prefix_keys(), nd))
+        stack.extend(node.children.values())
+    return out
+
+
+# -------------------------------------------------- engine surface
+
+
+def test_prefix_host_bytes_requires_prefix_cache(params):
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError):
+        PagedServingEngine(CFG, params, num_slots=1, num_blocks=8,
+                           prefix_host_bytes=1 << 20)
+
+
+def test_spill_api_requires_host_store(params):
+    from paddle_tpu.core.errors import EnforceError
+    eng = _engine(params, host_bytes=None)
+    assert eng._host_store is None
+    with pytest.raises(EnforceError):
+        eng.spill_prefix_cache()
+    # without a store, eviction destroys as before (no spilled state)
+    eng.submit(PREFIX, max_new=2)
+    eng.run()
+    eng.flush_prefix_cache()
+    assert eng._prefix.stats()["spills"] == 0
+    assert eng._prefix.stats()["evictions"] > 0
