@@ -71,6 +71,16 @@ JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --self-check --memory \
     --budgets paddle_tpu/analysis/budgets.json \
     --warn-ratchet paddle_tpu/analysis/warn_baseline.json
 
+echo "== host-lint: thread-safety + lock discipline over the serving host layer =="
+# Pure-AST pass (no tracing) over the registered host modules:
+# unguarded-shared-write / lock-order-cycle / blocking-under-lock /
+# leaked-lock.  The shipped baseline is ZERO post-suppression findings
+# — the shared warn ratchet makes any new unguarded write a hard CI
+# failure, and the --self-check invocation above already proved the
+# deadlock-cycle and unguarded-write mutants fire exactly once.
+JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --host \
+    --warn-ratchet paddle_tpu/analysis/warn_baseline.json
+
 echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead + chaos + re-lint =="
 # Drives a real instrumented paged-serving run with the request-level
 # tracer ON and the Pallas decode kernel SELECTED (interpret mode on

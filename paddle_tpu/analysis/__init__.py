@@ -59,6 +59,14 @@ from paddle_tpu.analysis.kernel_rules import (KERNEL_RULES,
                                               max_kernel_vmem,
                                               register_kernel_rule)
 from paddle_tpu.analysis.nans import nan_check
+from paddle_tpu.analysis.host_rules import (HOST_MODULES, HOST_RULES,
+                                            HostRule, active_host_rules,
+                                            analyze_host_module,
+                                            host_check,
+                                            host_check_sources,
+                                            host_self_check,
+                                            register_host_rule,
+                                            resolve_host_modules)
 
 __all__ = [
     "Finding", "LintTarget", "lint", "lint_target", "SEVERITIES",
@@ -71,4 +79,7 @@ __all__ = [
     "active_kernel_rules", "analyze_pallas_call", "derive_kernel_vmem",
     "kernel_self_check", "max_kernel_vmem", "register_kernel_rule",
     "nan_check",
+    "HOST_MODULES", "HOST_RULES", "HostRule", "active_host_rules",
+    "analyze_host_module", "host_check", "host_check_sources",
+    "host_self_check", "register_host_rule", "resolve_host_modules",
 ]

@@ -193,6 +193,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="RUN each target under checkify float "
                              "checks and localize the first non-finite "
                              "op (debug helper; executes the program)")
+    parser.add_argument("--host", action="store_true",
+                        help="run the host-concurrency family (thread "
+                             "model + lock discipline, AST-level) over "
+                             "the registered serving host modules; "
+                             "positional args filter the module list")
     args = parser.parse_args(argv)
 
     # the analyzer must NEVER touch (or hang on) an attached chip: all
@@ -210,20 +215,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from paddle_tpu.analysis.rules import active_rules
     if args.list_rules:
+        # grouped by family so the four registries stop interleaving
+        from paddle_tpu.analysis.host_rules import active_host_rules
         from paddle_tpu.analysis.kernel_rules import active_kernel_rules
         from paddle_tpu.analysis.shard_rules import active_shard_rules
+        print("jaxpr rules:")
         for rule in active_rules():
-            print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+            print(f"  {rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+        print("shard rules:")
         for rule in active_shard_rules():
             doc = (rule.__doc__ or "").strip().splitlines()[0]
-            print(f"{rule.rule_id:<22} {rule.severity:<6} {doc}")
+            print(f"  {rule.rule_id:<22} {rule.severity:<6} {doc}")
+        print("kernel rules:")
         for rule in active_kernel_rules():
-            print(f"{rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+            print(f"  {rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+        print("host rules:")
+        for rule in active_host_rules():
+            print(f"  {rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
         return 0
 
     from paddle_tpu.analysis.core import lint_target
     targets = []
     all_findings = []
+    disable = tuple(filter(None, args.disable.split(",")))
+    host_mods = []
+    if args.host:
+        # AST-level family: no tracing, positional args filter the
+        # registered module list instead of naming entrypoints
+        from paddle_tpu.analysis.host_rules import (host_check,
+                                                    resolve_host_modules)
+        host_mods = resolve_host_modules(args.targets or None)
+        findings = host_check(host_mods, disable=disable)
+        all_findings.extend(findings)
+        if not args.json:
+            errs = sum(f.severity == "error" for f in findings)
+            warns = sum(f.severity == "warn" for f in findings)
+            print(f"== host: {len(host_mods)} module(s), "
+                  f"{errs} error(s), {warns} warning(s)")
+            _render_table(findings)
     if args.self_check:
         from paddle_tpu.analysis.entrypoints import self_check_targets
         targets.extend(self_check_targets())
@@ -244,15 +273,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 message=f"kernel-rule wiring smoke failed: {e}",
                 suggestion="analysis/kernel_rules.py registration or "
                            "core.py pallas_call descent broke"))
-    for spec in args.targets:
-        targets.append(_resolve_target(spec, args.shapes))
-    if not targets:
+        # host-rule wiring smoke, same contract: the deadlock-cycle
+        # and unguarded-write mutants must each fire exactly once
+        # through the full host_check path, clean twins quiet
+        from paddle_tpu.analysis.host_rules import host_self_check
+        try:
+            msg = host_self_check()
+            if not args.json:
+                print(msg)
+        except Exception as e:
+            all_findings.append(Finding(
+                rule_id="host-rule-smoke", severity="error",
+                path="--self-check",
+                message=f"host-rule wiring smoke failed: {e}",
+                suggestion="analysis/host_rules.py registration or "
+                           "thread-model construction broke"))
+    if not args.host:
+        for spec in args.targets:
+            targets.append(_resolve_target(spec, args.shapes))
+    if not targets and not args.host:
         parser.print_usage(sys.stderr)
-        print("tpu-lint: nothing to lint (pass targets or --self-check)",
-              file=sys.stderr)
+        print("tpu-lint: nothing to lint (pass targets, --self-check "
+              "or --host)", file=sys.stderr)
         return 2
-
-    disable = tuple(filter(None, args.disable.split(",")))
 
     if args.nans:
         from paddle_tpu.analysis.nans import nan_check
@@ -339,8 +382,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         else:
             print(json.dumps(payload, indent=2))
     else:
-        n = len(targets)
-        print(f"tpu-lint: {n} entrypoint(s), "
+        scanned = []
+        if targets:
+            scanned.append(f"{len(targets)} entrypoint(s)")
+        if host_mods:
+            scanned.append(f"{len(host_mods)} host module(s)")
+        print(f"tpu-lint: {' + '.join(scanned) or '0 targets'}, "
               f"{len(all_findings)} finding(s) — "
               f"{'FAIL' if rc else 'OK'} at --fail-on={args.fail_on}")
     return rc

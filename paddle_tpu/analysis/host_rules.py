@@ -1,0 +1,1121 @@
+"""tpu-lint HOST rule family: thread-safety and lock discipline for
+the serving host layer, proved from the AST instead of a trace.
+
+Every other tpu-lint family works on a *traced* artifact — jaxprs
+(``rules.py``), compiled HLO (``shard_rules.py``), Pallas kernel
+bodies (``kernel_rules.py``).  The host-side concurrency layer that
+drives those programs in production (frontend worker threads, the
+cluster controller's accept/reader threads, tracer ring buffers, the
+prefix ledgers) never crosses a trace boundary, so until now it was
+guarded only by seeded chaos schedules — probabilistic coverage for a
+deterministic failure class.  This module closes that gap with an
+AST-level pass that builds, per module:
+
+* a **thread model** — thread roots from ``threading.Thread(target=
+  ...)`` / ``threading.Timer`` spawn sites, plus the public API
+  surface as the implicit "caller" root (every public method can run
+  on whatever thread the embedder calls from), with intra-class
+  ``self.method()`` call edges assigning each method to the roots
+  that can reach it;
+* the set of **shared mutable attributes** — instance fields (and
+  ``global``-declared module state) accessed from >= 2 distinct
+  thread roots with at least one write;
+* a **lock-scope map** — ``with self._lock:`` regions (any context
+  manager whose name ends in a ``lock`` token, or an attribute
+  initialised from ``threading.Lock/RLock/Condition/Semaphore``),
+  plus the repo's ``_locked``-suffix convention: a method named
+  ``*_locked`` is taken to run with its class's ``self._lock`` held
+  (frontend.py's existing discipline, now machine-checked).
+
+The rule registry then checks:
+
+* ``unguarded-shared-write`` — a shared field written outside every
+  lock scope that guards its other accesses.  Declare intent with a
+  ``# guarded-by: <lock>`` comment on (or above) the write, or
+  suppress with the usual ``# tpu-lint: disable=`` + rationale.
+* ``lock-order-cycle`` — the cross-module lock-acquisition graph
+  (syntactic ``with`` nesting + call edges resolved through
+  ``self.attr = ClassName(...)`` component types) must be acyclic:
+  static deadlock detection.
+* ``blocking-under-lock`` — ``time.sleep`` / ``Event.wait`` / socket
+  ``recv``/``accept``/``connect`` / ``Thread.join`` / subprocess
+  waits / ``.block_until_ready()`` inside a lock scope — the
+  hung-step-watchdog failure class caught before it fires.
+* ``leaked-lock`` — a bare ``.acquire()`` with no ``with`` block and
+  no ``.release()`` in a dominating ``finally``.
+
+Proved vs tested (the honest caveats, mirrored in
+``docs/design/analysis.md``): the model is name-based, not
+points-to — two attributes spelled ``self._lock`` on different
+classes are different locks (sound for cycles: merging would only
+ADD edges); fields on objects other than ``self`` (e.g. the
+frontend's ``seat.*``) escape the per-class model; callbacks invoked
+through registries run on whichever root calls them and are folded
+into "caller"; ``queue.Queue`` hand-off (``.put``/``.get``) is
+deliberately not a "write" — it IS the sanctioned lock-free channel
+(the cluster's documented contract).  The chaos schedules keep
+covering what the AST cannot see; this family makes the lock
+discipline itself a per-commit contract.
+
+``host_self_check()`` is the wiring smoke ``--self-check`` rides: a
+two-lock deadlock mutant and an unguarded-shared-write mutant must
+each produce exactly one finding through the full ``host_check``
+path, and their clean twins must stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.core import Finding, LintContext, severity_rank
+
+__all__ = [
+    "HOST_MODULES", "HOST_RULES", "HostRule", "ModuleModel",
+    "active_host_rules", "analyze_host_module", "host_check",
+    "host_check_sources", "host_self_check", "register_host_rule",
+    "resolve_host_modules",
+]
+
+#: The registered host-layer module set ``lint --host`` covers: every
+#: module that owns threads, locks, or cross-thread state on the
+#: serving path.  Pure-policy modules (autoscaler) ride along cheaply
+#: and prove they STAY lock-free.
+HOST_MODULES = (
+    "paddle_tpu.serving",
+    "paddle_tpu.frontend",
+    "paddle_tpu.prefix_cache",
+    "paddle_tpu.cluster.controller",
+    "paddle_tpu.cluster.worker",
+    "paddle_tpu.cluster.autoscaler",
+    "paddle_tpu.cluster.handoff",
+    "paddle_tpu.cluster.wire",
+    "paddle_tpu.cluster.selfcheck",
+    "paddle_tpu.telemetry.metrics",
+    "paddle_tpu.telemetry.trace",
+)
+
+# A name segment is lock-like when "lock" appears as a whole token
+# ("_lock", "active_lock", "rlock") — NOT as a substring ("block",
+# "num_blocks" must never classify as locks).
+_LOCK_NAME_RE = re.compile(r"(?:^|_)r?lock(?:$|_|s$)", re.IGNORECASE)
+
+#: ``threading`` constructors whose product is a lock for scope/graph
+#: purposes even when the attribute name says nothing.
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+#: Method calls that mutate their receiver in place — a write to the
+#: field holding the receiver.  ``queue.Queue.put/get`` are absent on
+#: purpose: the queue IS the sanctioned lock-free cross-thread channel.
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop",
+             "popleft", "popitem", "remove", "clear", "add", "discard",
+             "update", "setdefault", "sort", "reverse"}
+
+#: Attribute calls that block the calling thread.  ``.join`` is only
+#: blocking when the receiver isn't a string constant and the call has
+#: no positional args (``Thread.join(timeout=...)`` vs ``sep.join(
+#: parts)``); ``.get`` is excluded (dict.get) — documented caveat.
+_BLOCKING_METHODS = {"sleep", "wait", "join", "accept", "connect",
+                     "recv", "recv_into", "recvfrom", "communicate",
+                     "check_call", "check_output",
+                     "block_until_ready", "recv_msg"}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([\w.\-]+)")
+
+_CALLER_ROOT = "caller"
+
+
+def _is_lock_name(segment: str) -> bool:
+    return bool(_LOCK_NAME_RE.search(segment))
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """``self._lock`` -> "self._lock"; None for non-name chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+# ------------------------------------------------------------------ model
+
+
+@dataclasses.dataclass
+class Access:
+    """One read or write of a tracked field at a source line."""
+    attr: str
+    kind: str                       # "read" | "write"
+    line: int
+    locks: FrozenSet[str]           # lock ids held at the site
+    guarded_by: Optional[str]       # "# guarded-by: X" annotation
+
+
+@dataclasses.dataclass
+class CallSite:
+    """A call the lock-graph may need to resolve."""
+    kind: str                       # "self" | "attr" | "name"
+    target: Tuple                   # ("m",) | (attr, "m") | ("fn",)
+    line: int
+    locks: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: str
+    line: int
+    held: FrozenSet[str]            # locks already held when acquired
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    what: str
+    line: int
+    locks: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class FnInfo:
+    name: str
+    qualname: str
+    line: int
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    acquisitions: List[Acquisition] = dataclasses.field(
+        default_factory=list)
+    blocking: List[BlockingCall] = dataclasses.field(
+        default_factory=list)
+    bare_acquires: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+    finally_releases: Set[str] = dataclasses.field(default_factory=set)
+    with_releases: Set[str] = dataclasses.field(default_factory=set)
+    implicit_locks: FrozenSet[str] = frozenset()
+
+
+@dataclasses.dataclass
+class ClassModel:
+    name: str
+    module: str
+    methods: Dict[str, FnInfo] = dataclasses.field(default_factory=dict)
+    spawn_targets: Set[str] = dataclasses.field(default_factory=set)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    call_edges: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+    method_roots: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.module}:{self.name}.{attr}"
+
+
+@dataclasses.dataclass
+class ModuleModel:
+    name: str                       # dotted module name
+    file: str
+    lines: List[str]
+    classes: Dict[str, ClassModel] = dataclasses.field(
+        default_factory=dict)
+    functions: Dict[str, FnInfo] = dataclasses.field(
+        default_factory=dict)
+    spawn_targets: Set[str] = dataclasses.field(default_factory=set)
+    global_mutables: Set[str] = dataclasses.field(default_factory=set)
+    fn_roots: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def short(self) -> str:
+        return self.name.rpartition(".")[2]
+
+
+class _FnWalker:
+    """One pass over a function body tracking the held-lock set through
+    ``with`` nesting, collecting accesses / calls / acquisitions."""
+
+    def __init__(self, model: ModuleModel, cls: Optional[ClassModel],
+                 fn: ast.FunctionDef, qualname: str,
+                 global_names: Set[str]):
+        self.model = model
+        self.cls = cls
+        self.qualname = qualname
+        implicit: FrozenSet[str] = frozenset()
+        # the repo's convention: a *_locked method runs under its
+        # class's self._lock (frontend.py discipline, machine-checked)
+        if cls is not None and fn.name.endswith("_locked"):
+            implicit = frozenset({cls.lock_id("_lock")})
+        self.info = FnInfo(name=fn.name, qualname=qualname,
+                           line=fn.lineno, implicit_locks=implicit)
+        self.fn_globals: Set[str] = set()
+        self.fn_locals: Set[str] = {a.arg for a in fn.args.args}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                self.fn_globals.update(node.names)
+        self._walk_body(fn.body, implicit, in_finally=False)
+
+    # -------------------------------------------------- lock classification
+
+    def _lock_of(self, expr: ast.expr) -> Optional[str]:
+        """Canonical lock id for a with-item / acquire receiver, or
+        None when the expression isn't lock-like."""
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        named = _is_lock_name(parts[-1])
+        ctor = False
+        if (self.cls is not None and len(parts) == 2
+                and parts[0] == "self"):
+            ctor = parts[1] in getattr(self.cls, "_lock_ctor_attrs",
+                                       set())
+            if named or ctor:
+                return self.cls.lock_id(parts[1])
+            return None
+        if not named:
+            return None
+        if parts[0] == "self":       # self.a.b_lock — qualify by class
+            cls = self.cls.name if self.cls is not None else "?"
+            return f"{self.model.name}:{cls}.{'.'.join(parts[1:])}"
+        return f"{self.model.name}:{dotted}"
+
+    # ------------------------------------------------------- statement walk
+
+    def _walk_body(self, body, held: FrozenSet[str],
+                   in_finally: bool) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held, in_finally)
+
+    def _walk_stmt(self, stmt, held: FrozenSet[str],
+                   in_finally: bool) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is None:
+                    self._walk_expr(item.context_expr, held)
+                else:
+                    self.info.acquisitions.append(Acquisition(
+                        lock=lock, line=stmt.lineno,
+                        held=frozenset(inner)))
+                    self.info.with_releases.add(lock)
+                    inner.add(lock)
+            self._walk_body(stmt.body, frozenset(inner), in_finally)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, held, in_finally)
+            for h in stmt.handlers:
+                self._walk_body(h.body, held, in_finally)
+            self._walk_body(stmt.orelse, held, in_finally)
+            self._walk_body(stmt.finalbody, held, in_finally=True)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._walk_expr(stmt.test, held)
+            self._walk_body(stmt.body, held, in_finally)
+            self._walk_body(stmt.orelse, held, in_finally)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._walk_expr(stmt.iter, held)
+            self._record_store_target(stmt.target)
+            self._walk_body(stmt.body, held, in_finally)
+            self._walk_body(stmt.orelse, held, in_finally)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: body runs later, possibly without the lock —
+            # but conservatively attribute its accesses to this scope
+            self.fn_locals.add(stmt.name)
+            self._walk_body(stmt.body, held, in_finally)
+        elif isinstance(stmt, ast.ClassDef):
+            pass
+        else:
+            self._walk_leaf(stmt, held, in_finally)
+
+    def _record_store_target(self, target) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self.fn_locals.add(n.id)
+
+    # ------------------------------------------------------ leaf statements
+
+    def _walk_leaf(self, stmt, held: FrozenSet[str],
+                   in_finally: bool) -> None:
+        # explicit write targets first (assign / augassign / del)
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for t in targets:
+            self._record_write_target(t, stmt.lineno, held)
+        self._walk_expr(stmt, held, in_finally=in_finally)
+
+    def _record_write_target(self, target, line: int,
+                             held: FrozenSet[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write_target(elt, line, held)
+            return
+        node = target
+        while isinstance(node, (ast.Subscript, ast.Starred)):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and self.cls is not None):
+            self._access(node.attr, "write", line, held)
+        elif isinstance(node, ast.Name):
+            if node.id in self.fn_globals:
+                self._global_access(node.id, "write", line, held)
+            else:
+                self.fn_locals.add(node.id)
+
+    def _access(self, attr: str, kind: str, line: int,
+                held: FrozenSet[str]) -> None:
+        self.info.accesses.append(Access(
+            attr=attr, kind=kind, line=line,
+            locks=held | self.info.implicit_locks,
+            guarded_by=self._annotation(line)))
+
+    def _global_access(self, name: str, kind: str, line: int,
+                       held: FrozenSet[str]) -> None:
+        self.info.accesses.append(Access(
+            attr=f"global:{name}", kind=kind, line=line,
+            locks=held | self.info.implicit_locks,
+            guarded_by=self._annotation(line)))
+
+    def _annotation(self, line: int) -> Optional[str]:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.model.lines):
+                m = _GUARDED_BY_RE.search(self.model.lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    # ------------------------------------------------------ expression walk
+
+    def _walk_expr(self, node, held: FrozenSet[str],
+                   in_finally: bool = False) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, held, in_finally)
+            elif isinstance(sub, ast.Attribute):
+                if (isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"
+                        and isinstance(sub.ctx, ast.Load)
+                        and self.cls is not None):
+                    self._access(sub.attr, "read", sub.lineno, held)
+            elif isinstance(sub, ast.Name):
+                if (isinstance(sub.ctx, ast.Load)
+                        and sub.id in self.model.global_mutables
+                        and sub.id not in self.fn_locals):
+                    self._global_access(sub.id, "read", sub.lineno,
+                                        held)
+            elif isinstance(sub, (ast.Lambda,)):
+                pass  # body visited by the same ast.walk, same held set
+
+    def _record_call(self, call: ast.Call, held: FrozenSet[str],
+                     in_finally: bool) -> None:
+        func = call.func
+        self._record_spawn(call)
+        if isinstance(func, ast.Attribute):
+            meth, recv = func.attr, func.value
+            # in-place mutator -> a write to the receiver field
+            if meth in _MUTATORS:
+                if (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        and self.cls is not None):
+                    self._access(recv.attr, "write", call.lineno, held)
+                elif (isinstance(recv, ast.Name)
+                      and recv.id in self.model.global_mutables
+                      and recv.id not in self.fn_locals):
+                    self._global_access(recv.id, "write", call.lineno,
+                                        held)
+            # lock protocol
+            lock = self._lock_of(recv)
+            if lock is not None and meth == "acquire":
+                self.info.bare_acquires.append((lock, call.lineno))
+            if lock is not None and meth == "release":
+                if in_finally:
+                    self.info.finally_releases.add(lock)
+            # blocking while holding a lock
+            if meth in _BLOCKING_METHODS and held:
+                if not self._join_exempt(meth, recv, call):
+                    what = _dotted(func) or f"?.{meth}"
+                    self.info.blocking.append(BlockingCall(
+                        what=what, line=call.lineno, locks=held))
+            # call-graph edges the lock-cycle rule resolves
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.info.calls.append(CallSite(
+                    kind="self", target=(meth,), line=call.lineno,
+                    locks=held | self.info.implicit_locks))
+            elif (isinstance(recv, ast.Attribute)
+                  and isinstance(recv.value, ast.Name)
+                  and recv.value.id == "self"):
+                self.info.calls.append(CallSite(
+                    kind="attr", target=(recv.attr, meth),
+                    line=call.lineno,
+                    locks=held | self.info.implicit_locks))
+        elif isinstance(func, ast.Name):
+            if func.id == "sleep" and held:
+                self.info.blocking.append(BlockingCall(
+                    what="sleep", line=call.lineno, locks=held))
+            self.info.calls.append(CallSite(
+                kind="name", target=(func.id,), line=call.lineno,
+                locks=held | self.info.implicit_locks))
+
+    @staticmethod
+    def _join_exempt(meth: str, recv, call: ast.Call) -> bool:
+        """``sep.join(parts)`` is string formatting, not blocking:
+        exempt ``.join`` with a constant-string receiver or any
+        positional argument (``Thread.join`` takes only timeout=)."""
+        if meth != "join":
+            return False
+        if isinstance(recv, ast.Constant) and isinstance(recv.value,
+                                                        str):
+            return True
+        return bool(call.args)
+
+    def _record_spawn(self, call: ast.Call) -> None:
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name not in ("Thread", "Timer"):
+            return
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if name == "Timer" and target is None and len(call.args) >= 2:
+            target = call.args[1]
+        if target is None:
+            return
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self" and self.cls is not None):
+            self.cls.spawn_targets.add(target.attr)
+        elif isinstance(target, ast.Name):
+            self.model.spawn_targets.add(target.id)
+
+
+# --------------------------------------------------------------- analysis
+
+
+def _collect_attr_types(cls_model: ClassModel,
+                        init: ast.FunctionDef) -> None:
+    """``self.x = Cls(...)`` (directly or through one local alias) in
+    __init__ types the component attribute for cross-class call
+    resolution in the lock graph."""
+    local_types: Dict[str, str] = {}
+
+    def ctor_name(value) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = _dotted(value.func)
+        if d is None:
+            return None
+        leaf = d.rpartition(".")[2]
+        return leaf if leaf[:1].isupper() else None
+
+    for stmt in ast.walk(init):
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        cname = ctor_name(stmt.value)
+        if isinstance(tgt, ast.Name) and cname:
+            local_types[tgt.id] = cname
+        elif (isinstance(tgt, ast.Attribute)
+              and isinstance(tgt.value, ast.Name)
+              and tgt.value.id == "self"):
+            if cname:
+                cls_model.attr_types[tgt.attr] = cname
+            elif (isinstance(stmt.value, ast.Name)
+                  and stmt.value.id in local_types):
+                cls_model.attr_types[tgt.attr] = \
+                    local_types[stmt.value.id]
+            if (ctor_name(stmt.value) in _LOCK_CTORS
+                    or (isinstance(stmt.value, ast.Call)
+                        and _dotted(stmt.value.func) is not None
+                        and _dotted(stmt.value.func).rpartition(".")[2]
+                        in _LOCK_CTORS)):
+                getattr(cls_model, "_lock_ctor_attrs").add(tgt.attr)
+
+
+def _compute_roots(methods: Dict[str, FnInfo],
+                   spawn_targets: Set[str],
+                   public: Set[str]) -> Dict[str, Set[str]]:
+    """Assign each method/function the set of thread roots that can
+    reach it through self-/name-call edges."""
+    edges: Dict[str, Set[str]] = {}
+    for name, info in methods.items():
+        edges[name] = {c.target[0] for c in info.calls
+                       if c.kind in ("self", "name")
+                       and c.target[0] in methods
+                       # same-key self vs name calls resolved by caller
+                       }
+    roots: Dict[str, Set[str]] = {name: set() for name in methods}
+
+    def flood(root: str, entries: Set[str]) -> None:
+        stack = [e for e in entries if e in methods]
+        seen: Set[str] = set()
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            roots[m].add(root)
+            stack.extend(edges.get(m, ()))
+
+    flood(_CALLER_ROOT, public)
+    for t in sorted(spawn_targets):
+        flood(f"thread:{t}", {t})
+    # a method no root reaches (registered callback, getattr dispatch)
+    # is folded into the caller root — it runs on whoever invokes it
+    for name, r in roots.items():
+        if not r:
+            r.add(_CALLER_ROOT)
+    return roots
+
+
+def analyze_host_module(path: Optional[str] = None,
+                        source: Optional[str] = None,
+                        name: Optional[str] = None) -> ModuleModel:
+    """Parse one module into its thread/lock model.  ``path`` reads a
+    file; ``source`` lints a string (tests, self-check mutants)."""
+    if source is None:
+        assert path is not None, "need path or source"
+        with open(path) as f:
+            source = f.read()
+    file = path or f"<{name or 'host-lint'}>"
+    mod_name = name or (os.path.splitext(os.path.basename(file))[0]
+                        if path else "mutant")
+    tree = ast.parse(source, filename=file)
+    model = ModuleModel(name=mod_name, file=file,
+                        lines=source.splitlines())
+
+    # pass 0: global-declared mutable module state
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            model.global_mutables.update(node.names)
+
+    # pass 1: build class/function models (two sweeps so spawn sites
+    # and lock-ctor attrs discovered mid-walk inform root computation)
+    classes = [n for n in tree.body if isinstance(n, ast.ClassDef)]
+    functions = [n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+    for cnode in classes:
+        cm = ClassModel(name=cnode.name, module=model.short)
+        object.__setattr__(cm, "_lock_ctor_attrs", set())
+        model.classes[cnode.name] = cm
+        init = next((m for m in cnode.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is not None:
+            _collect_attr_types(cm, init)
+        for m in cnode.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = _FnWalker(model, cm, m,
+                              f"{model.short}.{cnode.name}.{m.name}",
+                              model.global_mutables)
+                cm.methods[m.name] = w.info
+        public = {n for n in cm.methods
+                  if not n.startswith("_") or n == "__init__"
+                  or (n.startswith("__") and n.endswith("__"))}
+        cm.method_roots = _compute_roots(cm.methods, cm.spawn_targets,
+                                         public)
+    for fnode in functions:
+        w = _FnWalker(model, None, fnode,
+                      f"{model.short}.{fnode.name}",
+                      model.global_mutables)
+        model.functions[fnode.name] = w.info
+    pub_fns = {n for n in model.functions if not n.startswith("_")}
+    model.fn_roots = _compute_roots(model.functions,
+                                    model.spawn_targets, pub_fns)
+    return model
+
+
+# -------------------------------------------------------------- registry
+
+
+class HostRule:
+    """Base host-concurrency rule.  ``check_module`` runs per module;
+    ``check_program`` once over the whole analyzed set (cross-module
+    properties like the lock graph)."""
+
+    rule_id = "abstract-host-rule"
+    severity = "warn"
+    doc = ""
+
+    def check_module(self, model: ModuleModel,
+                     ctx: LintContext) -> None:
+        pass
+
+    def check_program(self, models: Sequence[ModuleModel],
+                      ctx: LintContext) -> None:
+        pass
+
+
+HOST_RULES: Dict[str, type] = {}
+
+
+def register_host_rule(cls):
+    HOST_RULES[cls.rule_id] = cls
+    return cls
+
+
+def active_host_rules() -> List[HostRule]:
+    return [cls() for cls in HOST_RULES.values()]
+
+
+# ----------------------------------------------------------------- rules
+
+
+def _field_groups(model: ModuleModel):
+    """Yield (scope_name, roots_of_fn, accesses_by_field) for every
+    class plus the module-function pseudo-scope."""
+    for cname, cm in sorted(model.classes.items()):
+        yield (f"{model.short}.{cname}", cm.method_roots, cm.methods,
+               False)
+    yield (model.short, model.fn_roots, model.functions, True)
+
+
+@register_host_rule
+class UnguardedSharedWrite(HostRule):
+    rule_id = "unguarded-shared-write"
+    severity = "warn"
+    doc = ("field accessed from >=2 thread roots, written outside "
+           "every lock scope that guards its other accesses")
+
+    def check_module(self, model: ModuleModel,
+                     ctx: LintContext) -> None:
+        for scope, roots, fns, is_module in _field_groups(model):
+            per_field: Dict[str, List[Tuple[str, Access]]] = {}
+            for fname, info in fns.items():
+                if not is_module and fname in ("__init__", "__del__"):
+                    continue   # construction happens-before publish
+                for acc in info.accesses:
+                    if is_module != acc.attr.startswith("global:"):
+                        continue
+                    per_field.setdefault(acc.attr, []).append(
+                        (fname, acc))
+            for field, sites in sorted(per_field.items()):
+                a_roots: Set[str] = set()
+                writers = []
+                for fname, acc in sites:
+                    a_roots |= roots.get(fname, {_CALLER_ROOT})
+                    if acc.kind == "write":
+                        writers.append((fname, acc))
+                # module-level globals: each public fn is its own root
+                # (module functions have no owning thread)
+                if is_module:
+                    a_roots = {f"{_CALLER_ROOT}:{f}" if r ==
+                               _CALLER_ROOT else r
+                               for f, _ in sites
+                               for r in roots.get(f, {_CALLER_ROOT})}
+                if len(a_roots) < 2 or not writers:
+                    continue
+                guards: Set[str] = set()
+                for _, acc in sites:
+                    guards |= acc.locks
+                    if acc.guarded_by:
+                        guards.add(acc.guarded_by)
+                reported_never = False
+                for fname, acc in writers:
+                    if acc.locks or acc.guarded_by:
+                        continue
+                    pretty = field.replace("global:", "")
+                    if guards:
+                        locks = ", ".join(sorted(guards))
+                        ctx.report(
+                            self, f"{scope}.{fname}",
+                            f"write to shared field {pretty!r} holds "
+                            f"no lock, but its other accesses are "
+                            f"guarded by {locks}",
+                            suggestion="take the guarding lock, or "
+                                       "declare intent with "
+                                       "'# guarded-by: <lock>'",
+                            file=model.file, line=acc.line)
+                    elif not reported_never:
+                        reported_never = True
+                        r = ", ".join(sorted(a_roots))
+                        ctx.report(
+                            self, f"{scope}.{fname}",
+                            f"shared field {pretty!r} (accessed from "
+                            f"{r}) is written with no lock held "
+                            f"anywhere",
+                            suggestion="guard every access with one "
+                                       "lock, or suppress with a "
+                                       "rationale if the race is "
+                                       "benign by design",
+                            file=model.file, line=acc.line)
+
+
+@register_host_rule
+class LockOrderCycle(HostRule):
+    rule_id = "lock-order-cycle"
+    severity = "error"
+    doc = ("cross-module lock-acquisition graph has a cycle — "
+           "static deadlock")
+
+    def check_program(self, models: Sequence[ModuleModel],
+                      ctx: LintContext) -> None:
+        class_index: Dict[str, Tuple[ModuleModel, ClassModel]] = {}
+        for m in models:
+            for cname, cm in m.classes.items():
+                class_index.setdefault(cname, (m, cm))
+        acquired_memo: Dict[int, FrozenSet[str]] = {}
+
+        def resolve(model, cls, call: CallSite):
+            if call.kind == "self" and cls is not None:
+                return model, cls, cls.methods.get(call.target[0])
+            if call.kind == "attr" and cls is not None:
+                tname = cls.attr_types.get(call.target[0])
+                if tname and tname in class_index:
+                    tm, tc = class_index[tname]
+                    return tm, tc, tc.methods.get(call.target[1])
+            if call.kind == "name":
+                return model, None, model.functions.get(
+                    call.target[0])
+            return model, cls, None
+
+        def acquired(model, cls, info: Optional[FnInfo],
+                     stack: Set[int]) -> FrozenSet[str]:
+            if info is None:
+                return frozenset()
+            key = id(info)
+            if key in acquired_memo:
+                return acquired_memo[key]
+            if key in stack:
+                return frozenset()
+            stack.add(key)
+            locks = {a.lock for a in info.acquisitions}
+            locks |= info.implicit_locks
+            for call in info.calls:
+                tm, tc, ti = resolve(model, cls, call)
+                if ti is not None and ti is not info:
+                    locks |= acquired(tm, tc, ti, stack)
+            stack.discard(key)
+            acquired_memo[key] = frozenset(locks)
+            return acquired_memo[key]
+
+        edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+        def edge(a: str, b: str, file: str, line: int) -> None:
+            if a != b:
+                edges.setdefault(a, {}).setdefault(b, (file, line))
+
+        for m in models:
+            scopes = [(m, cm, info) for cm in m.classes.values()
+                      for info in cm.methods.values()]
+            scopes += [(m, None, info)
+                       for info in m.functions.values()]
+            for model, cls, info in scopes:
+                for acq in info.acquisitions:
+                    for h in acq.held | info.implicit_locks:
+                        edge(h, acq.lock, model.file, acq.line)
+                for call in info.calls:
+                    if not call.locks:
+                        continue
+                    tm, tc, ti = resolve(model, cls, call)
+                    if ti is None or ti is info:
+                        continue
+                    for l in acquired(tm, tc, ti, set()):
+                        for h in call.locks:
+                            edge(h, l, model.file, call.line)
+
+        # Tarjan SCC over the lock graph; any SCC of >=2 locks is a
+        # potential deadlock (self-edges skipped: RLock re-entry)
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strong(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in edges.get(v, {}):
+                if w not in index:
+                    strong(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+        nodes = set(edges)
+        for tos in edges.values():
+            nodes.update(tos)
+        for v in sorted(nodes):
+            if v not in index:
+                strong(v)
+
+        for comp in sorted(sccs):
+            anchor = None
+            for a in comp:
+                for b, loc in sorted(edges.get(a, {}).items()):
+                    if b in comp:
+                        anchor = loc
+                        break
+                if anchor:
+                    break
+            file, line = anchor if anchor else (models[0].file, 1)
+            ctx.report(
+                self, "lock-graph",
+                "lock-acquisition cycle: "
+                + " <-> ".join(comp)
+                + " — two threads taking these in opposite order "
+                  "deadlock",
+                suggestion="impose one global acquisition order "
+                           "(document it where the locks are made)",
+                file=file, line=line)
+
+
+@register_host_rule
+class BlockingUnderLock(HostRule):
+    rule_id = "blocking-under-lock"
+    severity = "error"
+    doc = ("sleep/wait/join/socket-recv/subprocess/"
+           "block_until_ready called while holding a lock")
+
+    def check_module(self, model: ModuleModel,
+                     ctx: LintContext) -> None:
+        scopes = [(cm, info) for cm in model.classes.values()
+                  for info in cm.methods.values()]
+        scopes += [(None, info) for info in model.functions.values()]
+        for _, info in scopes:
+            for b in info.blocking:
+                locks = ", ".join(sorted(b.locks))
+                ctx.report(
+                    self, info.qualname,
+                    f"blocking call {b.what}() while holding "
+                    f"{locks} — every other thread needing the lock "
+                    f"stalls for the full wait",
+                    suggestion="move the wait outside the lock "
+                               "(collect under the lock, block "
+                               "after), or bound it and suppress "
+                               "with a rationale",
+                    file=model.file, line=b.line)
+
+
+@register_host_rule
+class LeakedLock(HostRule):
+    rule_id = "leaked-lock"
+    severity = "error"
+    doc = ("bare .acquire() without a 'with' block or a .release() "
+           "in a dominating finally")
+
+    def check_module(self, model: ModuleModel,
+                     ctx: LintContext) -> None:
+        scopes = [info for cm in model.classes.values()
+                  for info in cm.methods.values()]
+        scopes += list(model.functions.values())
+        for info in scopes:
+            for lock, line in info.bare_acquires:
+                if lock in info.finally_releases:
+                    continue
+                ctx.report(
+                    self, info.qualname,
+                    f"{lock} is acquire()d with no release() in a "
+                    f"finally — any exception on the path leaks the "
+                    f"lock and wedges every other thread",
+                    suggestion="use 'with <lock>:' (or try/finally "
+                               "release)",
+                    file=model.file, line=line)
+
+
+# ------------------------------------------------------------ entrypoints
+
+
+def resolve_host_modules(
+        filters: Optional[Sequence[str]] = None
+) -> List[Tuple[str, str]]:
+    """(dotted-name, file-path) for the registered host modules,
+    optionally restricted by substring filters (CLI positionals)."""
+    import importlib.util
+    out = []
+    for dotted in HOST_MODULES:
+        if filters and not any(f in dotted or dotted.endswith(f)
+                               for f in filters):
+            continue
+        spec = importlib.util.find_spec(dotted)
+        if spec is None or spec.origin is None:
+            raise RuntimeError(
+                f"host-lint: registered module {dotted} not found")
+        out.append((dotted, spec.origin))
+    if filters and not out:
+        # HARD usage error, same contract as a misspelled entrypoint
+        # name: a typo'd CI filter must not silently guard nothing
+        print(f"host-lint: no registered host module matches "
+              f"{list(filters)}; registered: "
+              + ", ".join(HOST_MODULES), file=sys.stderr)
+        raise SystemExit(2)
+    return out
+
+
+def _run_rules(models: List[ModuleModel],
+               disable: Sequence[str]) -> List[Finding]:
+    ctx = LintContext(disable=disable)
+    for rule in active_host_rules():
+        for model in models:
+            rule.check_module(model, ctx)
+        rule.check_program(models, ctx)
+    ctx.findings.sort(key=lambda f: (-severity_rank(f.severity),
+                                     f.file or "", f.line or 0,
+                                     f.rule_id))
+    return ctx.findings
+
+
+def host_check(modules: Optional[Sequence[Tuple[str, str]]] = None,
+               disable: Sequence[str] = ()) -> List[Finding]:
+    """Lint the registered host modules (or an explicit
+    (name, path) list).  The whole set is analyzed together so the
+    lock graph sees cross-module acquisition edges."""
+    if modules is None:
+        modules = resolve_host_modules()
+    models = [analyze_host_module(path=path, name=name)
+              for name, path in modules]
+    return _run_rules(models, disable)
+
+
+def host_check_sources(sources: Sequence[Tuple[str, str]],
+                       disable: Sequence[str] = (),
+                       files: Optional[Sequence[str]] = None
+                       ) -> List[Finding]:
+    """Lint (name, source) pairs — the same full path ``host_check``
+    takes, for tests and the self-check mutants.  ``files`` optionally
+    names on-disk twins so ``# tpu-lint: disable=`` resolution works."""
+    models = []
+    for i, (name, src) in enumerate(sources):
+        path = files[i] if files else None
+        models.append(analyze_host_module(path=path, source=src,
+                                          name=name))
+    return _run_rules(models, disable)
+
+
+# ------------------------------------------------------------- self-check
+
+_DEADLOCK_MUTANT = """
+import threading
+
+class Exchange:
+    def __init__(self):
+        self._book_lock = threading.Lock()
+        self._fill_lock = threading.Lock()
+
+    def place(self):
+        with self._book_lock:
+            with self._fill_lock:
+                return 1
+
+    def settle(self):
+        with self._fill_lock:
+            with self._book_lock:
+                return 2
+"""
+
+_DEADLOCK_CLEAN = """
+import threading
+
+class Exchange:
+    def __init__(self):
+        self._book_lock = threading.Lock()
+        self._fill_lock = threading.Lock()
+
+    def place(self):
+        with self._book_lock:
+            with self._fill_lock:
+                return 1
+
+    def settle(self):
+        with self._book_lock:
+            with self._fill_lock:
+                return 2
+"""
+
+_UNGUARDED_MUTANT = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._thread = threading.Thread(target=self._worker)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            self._depth += 1
+
+    def poll(self):
+        with self._lock:
+            return self._depth
+"""
+
+_UNGUARDED_CLEAN = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._thread = threading.Thread(target=self._worker)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self._depth += 1
+
+    def poll(self):
+        with self._lock:
+            return self._depth
+"""
+
+
+def host_self_check() -> str:
+    """Wiring smoke for the host family, run by ``--self-check``:
+    a deadlock-cycle mutant and an unguarded-shared-write mutant must
+    each fire EXACTLY once through the full ``host_check`` path, and
+    their clean twins must stay quiet — so a refactor that silently
+    stops building the thread model (or unregisters a rule) fails CI
+    loudly instead of linting nothing."""
+    required = {"unguarded-shared-write", "lock-order-cycle",
+                "blocking-under-lock", "leaked-lock"}
+    missing = required - set(HOST_RULES)
+    if missing:
+        raise RuntimeError(
+            f"host-rule registry lost {sorted(missing)}")
+    cases = [
+        ("lock-order-cycle", _DEADLOCK_MUTANT, _DEADLOCK_CLEAN),
+        ("unguarded-shared-write", _UNGUARDED_MUTANT,
+         _UNGUARDED_CLEAN),
+    ]
+    for rule_id, mutant, clean in cases:
+        got = host_check_sources([("mutant", mutant)])
+        hits = [f for f in got if f.rule_id == rule_id]
+        if len(hits) != 1 or len(got) != 1:
+            raise RuntimeError(
+                f"host self-check: {rule_id} mutant produced "
+                f"{[f.rule_id for f in got]}, expected exactly one "
+                f"{rule_id} finding")
+        quiet = host_check_sources([("clean", clean)])
+        if quiet:
+            raise RuntimeError(
+                f"host self-check: {rule_id} clean twin produced "
+                f"{[f.rule_id for f in quiet]}, expected none")
+    return ("host-rule self-check OK: deadlock-cycle and "
+            "unguarded-write mutants each fired exactly once, "
+            "clean twins quiet")

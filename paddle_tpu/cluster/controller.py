@@ -58,6 +58,7 @@ import numpy as np
 
 from paddle_tpu import telemetry
 from paddle_tpu.cluster import handoff, wire
+from paddle_tpu.utils.threads import watch_thread
 
 __all__ = ["ClusterController", "TERMINAL"]
 
@@ -250,6 +251,12 @@ class ClusterController:
             "cluster_scale_events_total",
             help="autoscaler actions applied, by action=grow|retire "
                  "and role=")
+        self._m_thread_crashes = m.counter(
+            "cluster_thread_crashes_total",
+            help="uncaught exceptions that escaped an accept/reader "
+                 "thread (threading.excepthook backstop) — a dead "
+                 "reader looks like a silent worker until heartbeat "
+                 "timeout; this makes the cause visible immediately")
 
         self._workers = {}
         self._next_index = {role: 0 for role in _ROLES}
@@ -262,6 +269,7 @@ class ClusterController:
         self._port = self._listener.getsockname()[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True)
+        watch_thread(self._accept_thread, self._thread_crash_backstop)
         self._accept_thread.start()
         for _ in range(prefill_workers):
             self._grow("prefill", scaled=False)
@@ -319,6 +327,16 @@ class ClusterController:
         self._sigkill(self._workers[label])
 
     # ----------------------------------------------------------- threads
+
+    def _thread_crash_backstop(self, args):
+        """threading.excepthook backstop (utils/threads.py): an
+        uncaught exception escaping the accept loop or a reader (a
+        malformed hello raising past the narrow except, teardown
+        races) is counted instead of dying stderr-only — the pump
+        keeps its single-threaded contract, so this only observes."""
+        err = f"{args.exc_type.__name__}: {args.exc_value}"
+        self._m_thread_crashes.inc(
+            thread=getattr(args.thread, "name", "?"), error=err[:80])
 
     def _accept_loop(self):
         while not self._closing:
@@ -388,9 +406,11 @@ class ClusterController:
                 w.last_beat = time.monotonic()
                 w.idle_since = w.last_beat
                 w.compiles = msg.get("compiles")
-                threading.Thread(target=self._reader,
-                                 args=(conn, label, gen),
-                                 daemon=True).start()
+                t = threading.Thread(target=self._reader,
+                                     args=(conn, label, gen),
+                                     daemon=True)
+                watch_thread(t, self._thread_crash_backstop)
+                t.start()
             elif kind == "heartbeat":
                 self._on_heartbeat(w)
             elif kind == "tokens":
@@ -771,7 +791,9 @@ class ClusterController:
         accept loop, remove the scratch dir."""
         if self._closing:
             return
-        self._closing = True
+        # lock-free stop flag by design: a single bool store is atomic
+        # under the GIL and the accept thread only ever polls it
+        self._closing = True  # tpu-lint: disable=unguarded-shared-write
         for w in self._workers.values():
             self._send(w, {"type": "shutdown"})
         try:

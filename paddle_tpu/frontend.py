@@ -70,6 +70,7 @@ import numpy as np
 from paddle_tpu import telemetry
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.serving import PagedServingEngine, QueueFull
+from paddle_tpu.utils.threads import watch_thread
 
 __all__ = ["ServingFrontend", "SubmitRejected",
            "disaggregated_frontend",
@@ -332,6 +333,11 @@ class ServingFrontend:
             "frontend_deadline_miss_total",
             help="requests that COMPLETED after their deadline (shed "
                  "requests count under frontend_shed_total instead)")
+        self._m_thread_crashes = m.counter(
+            "frontend_thread_crashes_total",
+            help="uncaught exceptions that escaped a worker thread "
+                 "entirely (past its own crash parking) — each fires "
+                 "the armed flight recorder via threading.excepthook")
         self._m_queue_g = m.gauge(
             "frontend_queue_depth", help="frontend-queued requests")
         self._m_live_g = m.gauge(
@@ -652,7 +658,29 @@ class ServingFrontend:
         seat.thread = threading.Thread(
             target=self._worker, args=(seat, seat.generation, eng),
             name=f"ptpu-frontend-{seat.label}", daemon=True)
+        # backstop for an exception that escapes the worker's own
+        # crash parking (a raise inside the handler, teardown races):
+        # count it and fire the armed flight recorder instead of the
+        # default stderr-only death leaving the seat silently unpumped
+        watch_thread(seat.thread, self._thread_crash_backstop)
         seat.thread.start()
+
+    def _thread_crash_backstop(self, args):
+        """Runs on the dying thread via threading.excepthook; bounded
+        work only — the hook dispatcher guarantees the original
+        traceback still prints after this."""
+        name = getattr(args.thread, "name", "?")
+        self._m_thread_crashes.inc(thread=name)
+        if self.tracer is not None:
+            err = f"{args.exc_type.__name__}: {args.exc_value}"
+            self.tracer.instant("thread_crash", track="frontend",
+                                thread=name, error=err)
+            if self.tracer.flight_path is not None:
+                with self._lock:
+                    snap = self._snapshot_locked()
+                self.tracer.dump_flight(
+                    reason=f"uncaught exception on {name}: {err}",
+                    state={"frontend": snap})
 
     def _backoff(self, restarts: int) -> float:
         return min(self.restart_backoff_s * (2.0 ** max(0,

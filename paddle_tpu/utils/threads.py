@@ -1,0 +1,72 @@
+"""Per-thread crash backstop over the process-wide ``threading.excepthook``.
+
+A daemon worker that dies to an exception its own try/except never saw
+(a raise inside the handler itself, interpreter-teardown races, a
+poisoned lock) otherwise prints to stderr and vanishes — the frontend
+keeps routing to a seat nobody is pumping.  ``threading.excepthook``
+is the only hook Python offers and it is process-global, so this
+module owns ONE chained installation: components register a handler
+per thread object (``watch_thread``), the hook dispatches to the
+owner's handler, then always falls through to whatever hook was
+installed before (default: the stderr traceback — the crash stays
+visible, it just stops being *silent*).
+
+Handlers run on the dying thread, in exception context: they must not
+raise (the dispatcher swallows, so a broken handler cannot eat the
+traceback) and should do bounded work — bump a counter, fire the
+flight recorder — not resurrection.  Entries are weak: a collected
+Thread object drops its handler with it.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable
+
+__all__ = ["watch_thread", "watched_threads"]
+
+_state_lock = threading.Lock()
+_handlers: "weakref.WeakKeyDictionary[threading.Thread, Callable]" = \
+    weakref.WeakKeyDictionary()
+_prev_hook = None
+_installed = False
+
+
+def _hook(args):
+    handler = None
+    try:
+        with _state_lock:
+            if args.thread is not None:
+                handler = _handlers.get(args.thread)
+    except Exception:
+        handler = None
+    if handler is not None:
+        try:
+            handler(args)
+        except Exception:
+            pass          # never shadow the original traceback
+    prev = _prev_hook if _prev_hook is not None \
+        else threading.__excepthook__
+    prev(args)
+
+
+def watch_thread(thread: threading.Thread,
+                 on_crash: Callable) -> None:
+    """Arm ``on_crash(args)`` for an uncaught exception escaping
+    ``thread`` (``args`` is ``threading.ExceptHookArgs``).  Installs
+    the chained process hook on first use; re-registering a thread
+    replaces its handler."""
+    global _installed, _prev_hook
+    with _state_lock:
+        if not _installed:
+            _prev_hook = threading.excepthook
+            threading.excepthook = _hook
+            _installed = True
+        _handlers[thread] = on_crash
+
+
+def watched_threads():
+    """Live registered threads (tests / introspection)."""
+    with _state_lock:
+        return list(_handlers.keys())
