@@ -67,6 +67,15 @@ from paddle_tpu.analysis.host_rules import (HOST_MODULES, HOST_RULES,
                                             host_self_check,
                                             register_host_rule,
                                             resolve_host_modules)
+from paddle_tpu.analysis.pool_rules import (POOL_CLIENT_MODULES,
+                                            POOL_RULES, PoolRule,
+                                            active_pool_rules,
+                                            analyze_pool_module,
+                                            pool_check,
+                                            pool_check_sources,
+                                            pool_self_check,
+                                            register_pool_rule,
+                                            resolve_pool_modules)
 
 __all__ = [
     "Finding", "LintTarget", "lint", "lint_target", "SEVERITIES",
@@ -82,4 +91,8 @@ __all__ = [
     "HOST_MODULES", "HOST_RULES", "HostRule", "active_host_rules",
     "analyze_host_module", "host_check", "host_check_sources",
     "host_self_check", "register_host_rule", "resolve_host_modules",
+    "POOL_CLIENT_MODULES", "POOL_RULES", "PoolRule",
+    "active_pool_rules", "analyze_pool_module", "pool_check",
+    "pool_check_sources", "pool_self_check", "register_pool_rule",
+    "resolve_pool_modules",
 ]

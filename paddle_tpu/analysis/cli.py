@@ -138,11 +138,17 @@ def _render_table(findings, out=None) -> None:
             print(f"{pad}   program cost: {cost}", file=out)
 
 
+def _live(findings):
+    """Findings that count for gates/ratchets/summaries: a source-
+    suppressed finding kept for the --json artifact never fails a run."""
+    return [f for f in findings if not f.suppressed]
+
+
 def _gate(findings, fail_on: str) -> int:
     from paddle_tpu.analysis.core import severity_rank
     bar = severity_rank(fail_on)
     return 1 if any(severity_rank(f.severity) >= bar
-                    for f in findings) else 0
+                    for f in _live(findings)) else 0
 
 
 # ------------------------------------------------------------------- main
@@ -198,6 +204,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "model + lock discipline, AST-level) over "
                              "the registered serving host modules; "
                              "positional args filter the module list")
+    parser.add_argument("--pool", action="store_true",
+                        help="run the pool-ownership family (paged-"
+                             "block acquire/release/pin discipline, "
+                             "AST-level) over the registered pool-"
+                             "client modules; positional args filter "
+                             "the module list")
     args = parser.parse_args(argv)
 
     # the analyzer must NEVER touch (or hang on) an attached chip: all
@@ -232,25 +244,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("host rules:")
         for rule in active_host_rules():
             print(f"  {rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
+        print("pool rules:")
+        from paddle_tpu.analysis.pool_rules import active_pool_rules
+        for rule in active_pool_rules():
+            print(f"  {rule.rule_id:<22} {rule.severity:<6} {rule.doc}")
         return 0
 
     from paddle_tpu.analysis.core import lint_target
     targets = []
     all_findings = []
     disable = tuple(filter(None, args.disable.split(",")))
+    # --json is the CI artifact: it keeps source-suppressed findings,
+    # flagged ``"suppressed": true``, so consumers see what was
+    # silenced; gates/ratchets/summaries filter them out (_live).
+    keep_suppressed = args.json
     host_mods = []
+    pool_mods = []
     if args.host:
         # AST-level family: no tracing, positional args filter the
         # registered module list instead of naming entrypoints
         from paddle_tpu.analysis.host_rules import (host_check,
                                                     resolve_host_modules)
         host_mods = resolve_host_modules(args.targets or None)
-        findings = host_check(host_mods, disable=disable)
+        findings = host_check(host_mods, disable=disable,
+                              keep_suppressed=keep_suppressed)
         all_findings.extend(findings)
         if not args.json:
             errs = sum(f.severity == "error" for f in findings)
             warns = sum(f.severity == "warn" for f in findings)
             print(f"== host: {len(host_mods)} module(s), "
+                  f"{errs} error(s), {warns} warning(s)")
+            _render_table(findings)
+    if args.pool:
+        # same contract as --host for the pool-ownership family
+        from paddle_tpu.analysis.pool_rules import (pool_check,
+                                                    resolve_pool_modules)
+        pool_mods = resolve_pool_modules(args.targets or None)
+        findings = pool_check(pool_mods, disable=disable,
+                              keep_suppressed=keep_suppressed)
+        all_findings.extend(findings)
+        if not args.json:
+            errs = sum(f.severity == "error" for f in findings)
+            warns = sum(f.severity == "warn" for f in findings)
+            print(f"== pool: {len(pool_mods)} module(s), "
                   f"{errs} error(s), {warns} warning(s)")
             _render_table(findings)
     if args.self_check:
@@ -288,13 +324,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 message=f"host-rule wiring smoke failed: {e}",
                 suggestion="analysis/host_rules.py registration or "
                            "thread-model construction broke"))
-    if not args.host:
+        # pool-rule wiring smoke, same contract: the refcount-leak and
+        # share-before-pin mutants must each fire exactly once through
+        # the full pool_check path, clean twins quiet
+        from paddle_tpu.analysis.pool_rules import pool_self_check
+        try:
+            msg = pool_self_check()
+            if not args.json:
+                print(msg)
+        except Exception as e:
+            all_findings.append(Finding(
+                rule_id="pool-rule-smoke", severity="error",
+                path="--self-check",
+                message=f"pool-rule wiring smoke failed: {e}",
+                suggestion="analysis/pool_rules.py registration or "
+                           "ownership-model construction broke"))
+    if not (args.host or args.pool):
         for spec in args.targets:
             targets.append(_resolve_target(spec, args.shapes))
-    if not targets and not args.host:
+    if not targets and not (args.host or args.pool):
         parser.print_usage(sys.stderr)
-        print("tpu-lint: nothing to lint (pass targets, --self-check "
-              "or --host)", file=sys.stderr)
+        print("tpu-lint: nothing to lint (pass targets, --self-check, "
+              "--host or --pool)", file=sys.stderr)
         return 2
 
     if args.nans:
@@ -314,8 +365,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from paddle_tpu.analysis.shard_rules import shard_check
     for target in targets:
         findings = lint_target(target, disable=disable,
-                               with_cost=args.cost)
-        findings.extend(shard_check(target, disable=disable))
+                               with_cost=args.cost,
+                               keep_suppressed=keep_suppressed)
+        findings.extend(shard_check(target, disable=disable,
+                                    keep_suppressed=keep_suppressed))
         all_findings.extend(findings)
         if not args.json:
             errs = sum(f.severity == "error" for f in findings)
@@ -350,7 +403,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 _render_table(budget_findings) if budget_findings else \
                     print(f"memory budgets OK ({args.budgets})")
 
-    warns = sum(f.severity == "warn" for f in all_findings)
+    warns = sum(f.severity == "warn" for f in _live(all_findings))
     if args.write_warn_baseline:
         with open(args.write_warn_baseline, "w") as f:
             json.dump({"warn_count": warns}, f, indent=2)
@@ -387,6 +440,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             scanned.append(f"{len(targets)} entrypoint(s)")
         if host_mods:
             scanned.append(f"{len(host_mods)} host module(s)")
+        if pool_mods:
+            scanned.append(f"{len(pool_mods)} pool module(s)")
         print(f"tpu-lint: {' + '.join(scanned) or '0 targets'}, "
               f"{len(all_findings)} finding(s) — "
               f"{'FAIL' if rc else 'OK'} at --fail-on={args.fail_on}")

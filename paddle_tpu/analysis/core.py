@@ -63,6 +63,11 @@ class Finding:
     file: Optional[str] = None
     line: Optional[int] = None
     cost: Optional[Dict[str, float]] = None   # program-level, if computed
+    family: str = "jaxpr"     # jaxpr | shard | kernel | host | pool
+    # Suppressed findings are dropped at report time unless the run
+    # asks to keep them (``--json`` artifacts show what WAS silenced);
+    # gates/ratchets/summaries must filter on this flag.
+    suppressed: bool = False
 
     def location(self) -> str:
         if self.file is None:
@@ -140,13 +145,17 @@ class LintContext:
 
     def __init__(self, disable: Sequence[str] = (),
                  cost: Optional[Dict[str, float]] = None,
-                 opaque_kernels: bool = False):
+                 opaque_kernels: bool = False,
+                 keep_suppressed: bool = False):
         self.findings: List[Finding] = []
         self.disable = set(disable)
         self.cost = cost          # whole-program cost_analysis(), if any
         # escape hatch for third-party kernels: skip the kernel-rule
         # descent into pallas_call bodies (lint(opaque_kernels=True))
         self.opaque_kernels = opaque_kernels
+        # keep source-suppressed findings, flagged, instead of dropping
+        # them (the ``--json`` artifact records what was silenced)
+        self.keep_suppressed = keep_suppressed
 
     def report(self, rule, path: str, message: str, *, eqn=None,
                suggestion: str = "", file: Optional[str] = None,
@@ -155,12 +164,15 @@ class LintContext:
             return
         if eqn is not None and file is None:
             file, line = _user_frame(eqn)
-        if _suppressed(file, line, rule.rule_id):
+        suppressed = _suppressed(file, line, rule.rule_id)
+        if suppressed and not self.keep_suppressed:
             return
         self.findings.append(Finding(
             rule_id=rule.rule_id, severity=rule.severity, path=path,
             message=message, suggestion=suggestion, file=file, line=line,
-            cost=self.cost if attach_cost else None))
+            cost=self.cost if attach_cost else None,
+            family=getattr(rule, "family", "jaxpr"),
+            suppressed=suppressed))
 
 
 # ------------------------------------------------------------------- walker
@@ -321,8 +333,8 @@ def _program_cost(lowered) -> Optional[Dict[str, float]]:
 
 def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
          *, name: str = "", rules=None, disable: Sequence[str] = (),
-         with_cost: bool = False,
-         opaque_kernels: bool = False) -> List[Finding]:
+         with_cost: bool = False, opaque_kernels: bool = False,
+         keep_suppressed: bool = False) -> List[Finding]:
     """Trace ``fn(*args, **kwargs)`` and run the rule registry over the
     resulting jaxpr.  Returns findings sorted most-severe-first.
 
@@ -349,7 +361,8 @@ def lint(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
     cost = _program_cost(lowered) if (with_cost and lowered) else None
 
     ctx = LintContext(disable=disable, cost=cost,
-                      opaque_kernels=opaque_kernels)
+                      opaque_kernels=opaque_kernels,
+                      keep_suppressed=keep_suppressed)
     _walk(closed, rules, ctx, WalkState(path=name))
 
     # function-level rules (donation-audit) see the lowering, not eqns

@@ -645,6 +645,7 @@ class HostRule:
 
     rule_id = "abstract-host-rule"
     severity = "warn"
+    family = "host"
     doc = ""
 
     def check_module(self, model: ModuleModel,
@@ -966,8 +967,9 @@ def resolve_host_modules(
 
 
 def _run_rules(models: List[ModuleModel],
-               disable: Sequence[str]) -> List[Finding]:
-    ctx = LintContext(disable=disable)
+               disable: Sequence[str],
+               keep_suppressed: bool = False) -> List[Finding]:
+    ctx = LintContext(disable=disable, keep_suppressed=keep_suppressed)
     for rule in active_host_rules():
         for model in models:
             rule.check_module(model, ctx)
@@ -979,7 +981,8 @@ def _run_rules(models: List[ModuleModel],
 
 
 def host_check(modules: Optional[Sequence[Tuple[str, str]]] = None,
-               disable: Sequence[str] = ()) -> List[Finding]:
+               disable: Sequence[str] = (),
+               keep_suppressed: bool = False) -> List[Finding]:
     """Lint the registered host modules (or an explicit
     (name, path) list).  The whole set is analyzed together so the
     lock graph sees cross-module acquisition edges."""
@@ -987,7 +990,7 @@ def host_check(modules: Optional[Sequence[Tuple[str, str]]] = None,
         modules = resolve_host_modules()
     models = [analyze_host_module(path=path, name=name)
               for name, path in modules]
-    return _run_rules(models, disable)
+    return _run_rules(models, disable, keep_suppressed)
 
 
 def host_check_sources(sources: Sequence[Tuple[str, str]],

@@ -94,6 +94,7 @@ def _ppa():
 class KernelRule:
     rule_id: str = ""
     severity: str = "error"
+    family: str = "kernel"
     doc: str = ""
 
     def check_kernel(self, ka: "KernelAnalysis", state, ctx) -> None:

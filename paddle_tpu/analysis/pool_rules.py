@@ -1,0 +1,932 @@
+"""tpu-lint POOL rule family: ownership & refcount discipline for the
+paged KV block pool, proved from the AST of the pool's client modules.
+
+The paged block pool (``ops/paged_attention.py``) is refcounted: a
+block is free at rc 0, exclusively owned at rc 1, shared/pinned above
+that.  Every serving feature since the paged engine landed — prefix
+sharing, speculative rollback, the cluster handoff, the host-RAM spill
+tier — is one more *owner* of the same pool, each with a documented
+acquire/release contract that was, until this family, defended only by
+randomized runtime property tests.  This module makes the contract a
+per-commit static check, the exact move ``host_rules.py`` made for the
+lock discipline: build a model from the AST, run rules over it, anchor
+findings to real source lines.
+
+Per registered client module (:data:`POOL_CLIENT_MODULES`) the
+analysis builds an **ownership model** from the ``paged_*`` API
+surface:
+
+* every call site of a pool op — ``paged_reserve`` / ``paged_free`` /
+  ``paged_share`` / ``paged_rc_add`` / ``paged_cow`` /
+  ``paged_rollback`` / ``paged_append`` / ``paged_export_block(s)`` /
+  ``paged_import_blocks`` — classified as ACQUIRE (reserve, import),
+  RELEASE (free, rollback), SHARE, PIN (rc_add), EXPORT, or USE
+  (append, cow, advance), with jitted engine aliases resolved through
+  ``self.X = jax.jit(paged.paged_Y, ...)`` assignments (the serving
+  engine calls ``self._free``, never ``paged_free`` directly);
+* per function (class methods, module functions, AND nested defs —
+  the traced step programs are closures, each its own ownership
+  scope): the ordered op-event list, the binding each ACQUIRE's
+  result lands in, and how that binding escapes (returned, stored to
+  an attribute, passed whole to another call — ownership transfer);
+* per class: op-effect summaries threaded through intra-class
+  ``self.method()`` call edges, the same flood the host family uses
+  for thread roots — a ledger enforce living in a helper the writer
+  calls still counts.
+
+The rule registry then checks:
+
+* ``unbalanced-acquire`` — an ACQUIRE whose result binding never
+  escapes: not released, not returned, not stored, not handed to
+  another op.  The claimed blocks' refcounts were committed on device
+  and the handle dropped on the floor — the refcount-leak class the
+  randomized properties hunt at runtime.  An explicit ``raise``
+  between the acquire and the first escape is the exception-edge form
+  of the same leak and reports too.
+* ``share-before-pin`` — on an import path (restore/handoff), a
+  ``paged_share`` that runs before the ``paged_rc_add`` pin.  The
+  write-then-pin-then-share ordering exists because a concurrent
+  claim can zero a just-restored page the instant it is shared but
+  not yet pinned; PR 16 documents it, this rule enforces it.
+* ``cow-slack-bypass`` — an admission-side increase of the
+  ``_reserved`` / ``_pinned`` ledger with neither a capacity check
+  against the pool bound (``nb``) nor a balancing transfer on another
+  ledger field in reach (own function or a self-callee).  Growth
+  without enforce is how a pool overcommits past the COW slack.
+* ``append-after-free`` — a name passed to ``paged_free`` /
+  ``paged_rollback`` flowing into a later ``paged_append`` /
+  ``paged_share`` in the same function: the freed/rolled-back slot id
+  is stale; appending through it writes into blocks the allocator may
+  already have handed to someone else.
+* ``export-mutation`` — a pool mutation (reserve / share / cow /
+  import / append / advance) after a ``paged_export_block(s)`` in the
+  same function.  Exports copy, so the pages are safe — but the
+  payload's block ids and length describe a pool state that no longer
+  exists when it reaches the wire: the stale-payload class.
+  Releasing the exported slot (``paged_free`` — the handoff epilogue)
+  is the sanctioned order and stays quiet.
+
+Proved vs tested (honest caveats, mirrored in
+``docs/design/analysis.md``): the model is name-based, not points-to
+— escape analysis tracks the binding a result lands in, so rebinding
+through a container index or threading state through an object the
+walker cannot see escapes conservatively (no finding); dataflow in
+``append-after-free`` is same-name, same-function; the ordering rules
+compare source positions, not path-sensitive dominance, so an
+acquire/share inside one branch and its release/pin in another can
+evade or over-report (none of the shipped clients are shaped that
+way).  The runtime twin — :func:`~paddle_tpu.ops.paged_attention.
+paged_reconcile` — keeps covering what the AST cannot see: it checks
+the *materialized* pool (refcounts == table references + registry
+pins, free set consistent) on live engines, and the consolidated
+property helpers (``tests/helpers_pool.py``) drive both sides against
+the same seeded leak.
+
+``pool_self_check()`` is the wiring smoke ``--self-check`` rides: a
+refcount-leak mutant and a share-before-pin ordering mutant must each
+produce exactly one finding through the full ``pool_check`` path, and
+their clean twins must stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.core import Finding, LintContext, severity_rank
+
+__all__ = [
+    "POOL_CLIENT_MODULES", "POOL_RULES", "PoolRule", "PoolModuleModel",
+    "active_pool_rules", "analyze_pool_module", "pool_check",
+    "pool_check_sources", "pool_self_check", "register_pool_rule",
+    "resolve_pool_modules",
+]
+
+#: The registered pool-client module set ``lint --pool`` covers: every
+#: module that acquires, releases, shares, or ships paged blocks.
+#: Modules with no direct pool calls today (speculative's host policy,
+#: the cluster roles that drive engines through their public API) ride
+#: along cheaply and prove they STAY free of raw pool access.
+POOL_CLIENT_MODULES = (
+    "paddle_tpu.serving",
+    "paddle_tpu.prefix_cache",
+    "paddle_tpu.speculative",
+    "paddle_tpu.cluster.worker",
+    "paddle_tpu.cluster.controller",
+)
+
+#: op name -> ownership kind.  Anything else spelled ``paged_*``
+#: (init, advance, concat, the attention entrypoints) is tracked as a
+#: neutral USE so the event stream stays complete.
+_ACQUIRE_OPS = {"paged_reserve", "paged_import_blocks"}
+_RELEASE_OPS = {"paged_free", "paged_rollback"}
+_SHARE_OPS = {"paged_share"}
+_PIN_OPS = {"paged_rc_add"}
+_EXPORT_OPS = {"paged_export_block", "paged_export_blocks"}
+#: mutations that invalidate an already-exported payload's block-id /
+#: length description of the pool.  free/rollback are absent BY
+#: CONTRACT: export-then-release is the handoff epilogue (the payload
+#: is a copy; releasing the donor slot is the point of exporting).
+_EXPORT_MUTATORS = {"paged_reserve", "paged_share", "paged_cow",
+                    "paged_import_blocks", "paged_append",
+                    "paged_advance"}
+#: ops a freed/rolled-back id must never flow into
+_STALE_USE_OPS = {"paged_append", "paged_share"}
+
+#: host-side admission-ledger fields (serving.py): ``_reserved`` +
+#: ``_pinned`` must stay <= the pool bound; ``blocks_reserved`` is the
+#: per-request share of ``_reserved`` that transfers ledger weight.
+_LEDGER_FIELDS = {"_reserved", "_pinned", "blocks_reserved"}
+#: attribute/name leaves that count as the pool-capacity bound in a
+#: comparison (``self.nb``, a local ``nb``)
+_CAPACITY_NAMES = {"nb"}
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+# ------------------------------------------------------------------ model
+
+
+@dataclasses.dataclass
+class OpEvent:
+    """One pool-op call site inside a function."""
+    op: str                         # canonical paged_* name
+    line: int
+    result: Optional[str]           # name the (first) result binds to
+    args: Tuple[Optional[str], ...]  # positional args: bare name or
+    #                                  None placeholder, cache first
+    via: str                        # "direct" | "alias:<attr>"
+
+
+@dataclasses.dataclass
+class Escape:
+    """A use that transfers ownership of a binding out of the local
+    frame: returned, stored to an attribute/subscript, or passed whole
+    as a call argument."""
+    name: str
+    line: int
+    how: str                        # "return" | "store" | "callarg"
+
+
+@dataclasses.dataclass
+class LedgerWrite:
+    field: str
+    line: int
+    grows: bool                     # += (True) vs -= (False)
+
+
+@dataclasses.dataclass
+class PoolFnInfo:
+    name: str
+    qualname: str
+    line: int
+    events: List[OpEvent] = dataclasses.field(default_factory=list)
+    escapes: List[Escape] = dataclasses.field(default_factory=list)
+    raises: List[int] = dataclasses.field(default_factory=list)
+    ledger_writes: List[LedgerWrite] = dataclasses.field(
+        default_factory=list)
+    capacity_checks: List[int] = dataclasses.field(default_factory=list)
+    self_calls: Set[str] = dataclasses.field(default_factory=set)
+
+    def ops(self) -> Set[str]:
+        return {e.op for e in self.events}
+
+
+@dataclasses.dataclass
+class PoolClassModel:
+    name: str
+    module: str
+    methods: Dict[str, PoolFnInfo] = dataclasses.field(
+        default_factory=dict)
+    #: self.attr -> canonical paged_* op (``self._free = jax.jit(
+    #: paged.paged_free, ...)`` and friends)
+    op_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: transitive op-effect summary per method (self-call closure)
+    effects: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class PoolModuleModel:
+    name: str
+    file: str
+    lines: List[str]
+    classes: Dict[str, PoolClassModel] = dataclasses.field(
+        default_factory=dict)
+    functions: Dict[str, PoolFnInfo] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def short(self) -> str:
+        return self.name.rpartition(".")[2]
+
+    def all_fns(self):
+        for cm in self.classes.values():
+            for info in cm.methods.values():
+                yield cm, info
+        for info in self.functions.values():
+            yield None, info
+
+
+def _collect_op_aliases(cm: PoolClassModel, cnode: ast.ClassDef) -> None:
+    """``self.X = jax.jit(paged.paged_Y, ...)`` (or a bare
+    ``paged.paged_Y``) anywhere in the class body aliases attribute X
+    to pool op Y — the serving engine's jitted-wrapper convention."""
+    def paged_leaf(value) -> Optional[str]:
+        d = _dotted(value)
+        if d is not None:
+            leaf = d.rpartition(".")[2]
+            return leaf if leaf.startswith("paged_") else None
+        if isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if d is not None and d.rpartition(".")[2] == "jit" \
+                    and value.args:
+                return paged_leaf(value.args[0])
+        return None
+
+    for stmt in ast.walk(cnode):
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            op = paged_leaf(stmt.value)
+            if op is not None:
+                cm.op_aliases[tgt.attr] = op
+
+
+class _PoolFnWalker:
+    """One pass over a function body collecting the ownership events.
+    Nested defs are NOT descended here — each gets its own walker (a
+    traced step program is its own ownership scope)."""
+
+    def __init__(self, model: PoolModuleModel,
+                 cls: Optional[PoolClassModel], fn, qualname: str):
+        self.model = model
+        self.cls = cls
+        self.info = PoolFnInfo(name=fn.name, qualname=qualname,
+                               line=fn.lineno)
+        for stmt in fn.body:
+            self._walk_stmt(stmt)
+
+    # --------------------------------------------------- classification
+
+    def _op_of_call(self, call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(canonical op, via) for a pool-op call, else None."""
+        d = _dotted(call.func)
+        if d is None:
+            return None
+        leaf = d.rpartition(".")[2]
+        if leaf.startswith("paged_"):
+            return leaf, "direct"
+        parts = d.split(".")
+        if (self.cls is not None and len(parts) == 2
+                and parts[0] == "self"
+                and parts[1] in self.cls.op_aliases):
+            return self.cls.op_aliases[parts[1]], f"alias:{parts[1]}"
+        return None
+
+    @staticmethod
+    def _bare_args(call: ast.Call) -> Tuple[Optional[str], ...]:
+        # position-preserving: args[0] is always the cache argument,
+        # whether spelled ``cache`` (None-free) or ``self.cache``
+        # (placeholder) — the stale-id rule keys on positions past it
+        return tuple(a.id if isinstance(a, ast.Name) else None
+                     for a in call.args)
+
+    def _record_op(self, call: ast.Call,
+                   result: Optional[str]) -> bool:
+        got = self._op_of_call(call)
+        if got is None:
+            return False
+        op, via = got
+        self.info.events.append(OpEvent(
+            op=op, line=call.lineno, result=result,
+            args=self._bare_args(call), via=via))
+        return True
+
+    # -------------------------------------------------------- statements
+
+    def _walk_stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                      # own scope, walked separately
+        if isinstance(stmt, ast.Assign):
+            self._handle_assign(stmt.targets, stmt.value, stmt.lineno)
+        elif isinstance(stmt, ast.AugAssign):
+            self._handle_aug(stmt)
+            self._scan_expr(stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._handle_assign([stmt.target], stmt.value,
+                                    stmt.lineno)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                for name in self._names_returned(stmt.value):
+                    self.info.escapes.append(Escape(
+                        name=name, line=stmt.lineno, how="return"))
+                self._scan_expr(stmt.value)
+        elif isinstance(stmt, ast.Raise):
+            self.info.raises.append(stmt.lineno)
+            if stmt.exc is not None:
+                self._scan_expr(stmt.exc)
+        elif isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, as_statement=True)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._note_capacity(stmt.test)
+            self._scan_expr(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._walk_stmt(s)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter)
+            for s in stmt.body + stmt.orelse:
+                self._walk_stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            for s in stmt.body:
+                self._walk_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody):
+                self._walk_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._walk_stmt(s)
+        elif isinstance(stmt, (ast.Assert,)):
+            self._note_capacity(stmt.test)
+            self._scan_expr(stmt.test)
+        elif isinstance(stmt, ast.Delete):
+            pass
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child)
+
+    def _handle_assign(self, targets, value, line: int) -> None:
+        # op call on the RHS: bind the (first) result name
+        result = None
+        if len(targets) == 1:
+            tgt = targets[0]
+            if isinstance(tgt, ast.Name):
+                result = tgt.id
+            elif (isinstance(tgt, ast.Tuple) and tgt.elts
+                  and isinstance(tgt.elts[0], ast.Name)):
+                # ``cache, ok = paged_reserve(...)`` — ownership rides
+                # element 0 of every pool-op result tuple
+                result = tgt.elts[0].id
+        if isinstance(value, ast.Call) and self._record_op(value,
+                                                           result):
+            for a in value.args:
+                self._scan_expr(a)
+        else:
+            self._scan_expr(value)
+        # attribute / subscript stores transfer ownership out of the
+        # local frame (``self.cache = cache``)
+        for tgt in targets:
+            if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                if isinstance(value, ast.Name):
+                    self.info.escapes.append(Escape(
+                        name=value.id, line=line, how="store"))
+                elif isinstance(value, ast.Call):
+                    # ``self.cache = self._rc_add(cache, ...)`` — the
+                    # call-arg escape below already covers ``cache``;
+                    # nothing extra to record for the store itself
+                    pass
+
+    def _handle_aug(self, stmt: ast.AugAssign) -> None:
+        tgt = stmt.target
+        if isinstance(tgt, ast.Attribute) and tgt.attr in _LEDGER_FIELDS:
+            self.info.ledger_writes.append(LedgerWrite(
+                field=tgt.attr, line=stmt.lineno,
+                grows=isinstance(stmt.op, ast.Add)))
+
+    @staticmethod
+    def _names_returned(value) -> List[str]:
+        if isinstance(value, ast.Name):
+            return [value.id]
+        if isinstance(value, ast.Tuple):
+            return [e.id for e in value.elts
+                    if isinstance(e, ast.Name)]
+        return []
+
+    def _note_capacity(self, test) -> None:
+        for sub in ast.walk(test):
+            if not isinstance(sub, ast.Compare):
+                continue
+            leaves = set()
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Attribute):
+                    leaves.add(n.attr)
+                elif isinstance(n, ast.Name):
+                    leaves.add(n.id)
+            if leaves & _CAPACITY_NAMES and leaves & _LEDGER_FIELDS:
+                self.info.capacity_checks.append(sub.lineno)
+
+    # ------------------------------------------------------- expressions
+
+    def _scan_expr(self, node, as_statement: bool = False) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            self._record_op(sub, None)
+            # every bare-name argument passed WHOLE to any call is an
+            # ownership transfer (merge_views(cache, ...), device_put,
+            # self._rc_add(cache, delta), enforce helpers, ...)
+            for a in sub.args:
+                if isinstance(a, ast.Name):
+                    self.info.escapes.append(Escape(
+                        name=a.id, line=sub.lineno, how="callarg"))
+            for kw in sub.keywords:
+                if isinstance(kw.value, ast.Name):
+                    self.info.escapes.append(Escape(
+                        name=kw.value.id, line=sub.lineno,
+                        how="callarg"))
+            # intra-class edges for the effect closure
+            d = _dotted(sub.func)
+            if d is not None:
+                parts = d.split(".")
+                if len(parts) == 2 and parts[0] == "self":
+                    self.info.self_calls.add(parts[1])
+            # ``enforce(cond, ...)`` carries the capacity check as an
+            # argument expression, not a statement test
+            if sub.args:
+                self._note_capacity(sub.args[0])
+
+
+def _compute_effects(cm: PoolClassModel) -> None:
+    """Transitive op-effect sets through self-call edges — the pool
+    twin of the host family's thread-root flood: a release/enforce
+    living in a helper still counts for its callers."""
+    for name in cm.methods:
+        seen: Set[str] = set()
+        ops: Set[str] = set()
+        stack = [name]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in cm.methods:
+                continue
+            seen.add(m)
+            info = cm.methods[m]
+            ops |= info.ops()
+            stack.extend(info.self_calls)
+        cm.effects[name] = ops
+
+
+def _reaches_ledger_relief(cm: Optional[PoolClassModel],
+                           info: PoolFnInfo, field: str,
+                           line: int) -> bool:
+    """True when the growing ledger write at ``line`` is covered by a
+    capacity check or a balancing transfer in the function itself or
+    any self-callee (transitively)."""
+    seen: Set[str] = set()
+    stack = [info]
+    while stack:
+        fn = stack.pop()
+        if fn.qualname in seen:
+            continue
+        seen.add(fn.qualname)
+        if fn.capacity_checks:
+            return True
+        for w in fn.ledger_writes:
+            if w.field != field or w.line != line:
+                # any OTHER ledger write is a transfer: weight moved
+                # between _reserved / _pinned / blocks_reserved, the
+                # sum the capacity check already admitted
+                return True
+        if cm is not None:
+            for callee in fn.self_calls:
+                if callee in cm.methods:
+                    stack.append(cm.methods[callee])
+    return False
+
+
+def analyze_pool_module(path: Optional[str] = None,
+                        source: Optional[str] = None,
+                        name: Optional[str] = None) -> PoolModuleModel:
+    """Parse one module into its pool-ownership model.  ``path`` reads
+    a file; ``source`` lints a string (tests, self-check mutants)."""
+    if source is None:
+        assert path is not None, "need path or source"
+        with open(path) as f:
+            source = f.read()
+    file = path or f"<{name or 'pool-lint'}>"
+    mod_name = name or (os.path.splitext(os.path.basename(file))[0]
+                        if path else "mutant")
+    tree = ast.parse(source, filename=file)
+    model = PoolModuleModel(name=mod_name, file=file,
+                            lines=source.splitlines())
+
+    def collect_fns(body, cls: Optional[PoolClassModel], prefix: str,
+                    key_prefix: str,
+                    sink: Dict[str, PoolFnInfo]) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}"
+                # sink keys are CLASS-RELATIVE (``admit``, nested defs
+                # ``admit.step``) so the self-call edges — which carry
+                # bare method names — resolve against them
+                key = f"{key_prefix}{node.name}"
+                w = _PoolFnWalker(model, cls, node, qual)
+                sink[key] = w.info
+                # nested defs (traced step programs) are their own
+                # ownership scopes, keyed by dotted name
+                collect_fns(node.body, cls, qual, f"{key}.", sink)
+
+    for cnode in tree.body:
+        if isinstance(cnode, ast.ClassDef):
+            cm = PoolClassModel(name=cnode.name, module=model.short)
+            model.classes[cnode.name] = cm
+            _collect_op_aliases(cm, cnode)
+            collect_fns(cnode.body, cm,
+                        f"{model.short}.{cnode.name}", "", cm.methods)
+            _compute_effects(cm)
+    collect_fns([n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))],
+                None, model.short, "", model.functions)
+    return model
+
+
+# -------------------------------------------------------------- registry
+
+
+class PoolRule:
+    """Base pool-ownership rule.  ``check_module`` runs per module;
+    ``check_program`` once over the whole analyzed set."""
+
+    rule_id = "abstract-pool-rule"
+    severity = "warn"
+    family = "pool"
+    doc = ""
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        pass
+
+    def check_program(self, models: Sequence[PoolModuleModel],
+                      ctx: LintContext) -> None:
+        pass
+
+
+POOL_RULES: Dict[str, type] = {}
+
+
+def register_pool_rule(cls):
+    POOL_RULES[cls.rule_id] = cls
+    return cls
+
+
+def active_pool_rules() -> List[PoolRule]:
+    return [cls() for cls in POOL_RULES.values()]
+
+
+# ----------------------------------------------------------------- rules
+
+
+@register_pool_rule
+class UnbalancedAcquire(PoolRule):
+    rule_id = "unbalanced-acquire"
+    severity = "error"
+    doc = ("reserved/imported blocks whose result binding is never "
+           "released, returned, stored, or handed on — a refcount "
+           "leak")
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        for _, info in model.all_fns():
+            for ev in info.events:
+                if ev.op not in _ACQUIRE_OPS or ev.result is None:
+                    continue
+                later_escapes = [e for e in info.escapes
+                                 if e.name == ev.result
+                                 and e.line >= ev.line]
+                if not later_escapes:
+                    ctx.report(
+                        self, info.qualname,
+                        f"{ev.op} result {ev.result!r} is dropped: "
+                        f"the claimed blocks' refcounts were "
+                        f"committed but no release, store, return, "
+                        f"or transfer ever sees them again",
+                        suggestion="release with paged_free/"
+                                   "paged_rollback, commit the new "
+                                   "cache (self.cache = ...), or "
+                                   "return it to the caller",
+                        file=model.file, line=ev.line)
+                    continue
+                first_escape = min(e.line for e in later_escapes)
+                bad_raise = [ln for ln in info.raises
+                             if ev.line < ln < first_escape]
+                if bad_raise:
+                    ctx.report(
+                        self, info.qualname,
+                        f"explicit raise between the {ev.op} at line "
+                        f"{ev.line} and the first escape of "
+                        f"{ev.result!r} at line {first_escape} leaks "
+                        f"the claimed blocks on the exception edge",
+                        suggestion="release in a try/finally, or "
+                                   "raise before acquiring",
+                        file=model.file, line=bad_raise[0])
+
+
+@register_pool_rule
+class ShareBeforePin(PoolRule):
+    rule_id = "share-before-pin"
+    severity = "error"
+    doc = ("on an import (restore/handoff) path, paged_share runs "
+           "before the paged_rc_add pin — violates write-then-pin-"
+           "then-share")
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        for _, info in model.all_fns():
+            imports = [e for e in info.events
+                       if e.op == "paged_import_blocks"]
+            if not imports:
+                continue
+            imp_line = min(e.line for e in imports)
+            shares = [e for e in info.events if e.op in _SHARE_OPS
+                      and e.line > imp_line]
+            pins = [e for e in info.events if e.op in _PIN_OPS
+                    and e.line > imp_line]
+            if not shares or not pins:
+                # share-only (handoff admission: share IS the pin) and
+                # pin-only (restore: promote, no share here) paths are
+                # both sanctioned shapes
+                continue
+            first_share = min(e.line for e in shares)
+            first_pin = min(e.line for e in pins)
+            if first_share < first_pin:
+                ctx.report(
+                    self, info.qualname,
+                    f"imported blocks are shared (line {first_share}) "
+                    f"before they are pinned (line {first_pin}) — a "
+                    f"concurrent claim between the two can zero a "
+                    f"just-restored page",
+                    suggestion="pin first: paged_rc_add(+1) on the "
+                               "imported ids, then paged_share "
+                               "(write-then-pin-then-share)",
+                    file=model.file, line=first_share)
+
+
+@register_pool_rule
+class CowSlackBypass(PoolRule):
+    rule_id = "cow-slack-bypass"
+    severity = "error"
+    doc = ("admission-ledger growth (_reserved/_pinned +=) with no "
+           "capacity check against the pool bound and no balancing "
+           "ledger transfer in reach")
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        for cm, info in model.all_fns():
+            if info.name == "__init__":
+                continue                # construction seeds the ledger
+            for w in info.ledger_writes:
+                if not w.grows or w.field == "blocks_reserved":
+                    # blocks_reserved is per-request weight already
+                    # admitted under the capacity check; only the
+                    # class-wide _reserved/_pinned sums gate admission
+                    continue
+                if _reaches_ledger_relief(cm, info, w.field, w.line):
+                    continue
+                ctx.report(
+                    self, info.qualname,
+                    f"{w.field} grows with neither a capacity check "
+                    f"against the pool bound nor a balancing ledger "
+                    f"transfer in this function or its self-callees "
+                    f"— admission can overcommit past the COW slack",
+                    suggestion="enforce _reserved + _pinned (+ need "
+                               "+ slack) <= nb before growing, or "
+                               "transfer weight from another ledger "
+                               "field",
+                    file=model.file, line=w.line)
+
+
+@register_pool_rule
+class AppendAfterFree(PoolRule):
+    rule_id = "append-after-free"
+    severity = "error"
+    doc = ("a name passed to paged_free/paged_rollback flows into a "
+           "later paged_append/paged_share — stale slot id")
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        for _, info in model.all_fns():
+            freed: Dict[str, int] = {}
+            for ev in sorted(info.events, key=lambda e: e.line):
+                if ev.op in _RELEASE_OPS:
+                    # args[0] is the cache; the rest name what was
+                    # released (slot mask, rollback lengths)
+                    for name in ev.args[1:]:
+                        if name is not None:
+                            freed.setdefault(name, ev.line)
+                elif ev.op in _STALE_USE_OPS:
+                    for name in ev.args[1:]:
+                        if name in freed and freed[name] < ev.line:
+                            ctx.report(
+                                self, info.qualname,
+                                f"{name!r} was passed to a release "
+                                f"op at line {freed[name]} and flows "
+                                f"into {ev.op} here — the blocks it "
+                                f"names may already belong to "
+                                f"another owner",
+                                suggestion="re-derive the slot/block "
+                                           "ids after the release, "
+                                           "or reorder the release "
+                                           "after the use",
+                                file=model.file, line=ev.line)
+
+
+@register_pool_rule
+class ExportMutation(PoolRule):
+    rule_id = "export-mutation"
+    severity = "error"
+    doc = ("pool mutated (reserve/share/cow/import/append/advance) "
+           "after a paged_export in the same function — stale "
+           "payload")
+
+    def check_module(self, model: PoolModuleModel,
+                     ctx: LintContext) -> None:
+        for _, info in model.all_fns():
+            exports = [e for e in info.events if e.op in _EXPORT_OPS]
+            if not exports:
+                continue
+            first_export = min(e.line for e in exports)
+            for ev in info.events:
+                if ev.op in _EXPORT_MUTATORS \
+                        and ev.line > first_export:
+                    ctx.report(
+                        self, info.qualname,
+                        f"{ev.op} mutates the pool after the export "
+                        f"at line {first_export} — the payload's "
+                        f"block ids and length describe a pool state "
+                        f"that no longer exists when it is sent",
+                        suggestion="send (or fully pack) the payload "
+                                   "before mutating, or export after "
+                                   "the mutation; releasing the "
+                                   "exported slot (paged_free) is "
+                                   "the sanctioned epilogue and does "
+                                   "not trip this rule",
+                        file=model.file, line=ev.line)
+
+
+# ------------------------------------------------------------ entrypoints
+
+
+def resolve_pool_modules(
+        filters: Optional[Sequence[str]] = None
+) -> List[Tuple[str, str]]:
+    """(dotted-name, file-path) for the registered pool-client
+    modules, optionally restricted by substring filters (CLI
+    positionals).  Same hard exit-2 contract as ``--host``."""
+    import importlib.util
+    out = []
+    for dotted in POOL_CLIENT_MODULES:
+        if filters and not any(f in dotted or dotted.endswith(f)
+                               for f in filters):
+            continue
+        spec = importlib.util.find_spec(dotted)
+        if spec is None or spec.origin is None:
+            raise RuntimeError(
+                f"pool-lint: registered module {dotted} not found")
+        out.append((dotted, spec.origin))
+    if filters and not out:
+        # HARD usage error: a typo'd CI filter must not silently
+        # guard nothing
+        print(f"pool-lint: no registered pool-client module matches "
+              f"{list(filters)}; registered: "
+              + ", ".join(POOL_CLIENT_MODULES), file=sys.stderr)
+        raise SystemExit(2)
+    return out
+
+
+def _run_rules(models: List[PoolModuleModel],
+               disable: Sequence[str],
+               keep_suppressed: bool = False) -> List[Finding]:
+    ctx = LintContext(disable=disable, keep_suppressed=keep_suppressed)
+    for rule in active_pool_rules():
+        for model in models:
+            rule.check_module(model, ctx)
+        rule.check_program(models, ctx)
+    ctx.findings.sort(key=lambda f: (f.suppressed,
+                                     -severity_rank(f.severity),
+                                     f.file or "", f.line or 0,
+                                     f.rule_id))
+    return ctx.findings
+
+
+def pool_check(modules: Optional[Sequence[Tuple[str, str]]] = None,
+               disable: Sequence[str] = (),
+               keep_suppressed: bool = False) -> List[Finding]:
+    """Lint the registered pool-client modules (or an explicit
+    (name, path) list)."""
+    if modules is None:
+        modules = resolve_pool_modules()
+    models = [analyze_pool_module(path=path, name=name)
+              for name, path in modules]
+    return _run_rules(models, disable, keep_suppressed)
+
+
+def pool_check_sources(sources: Sequence[Tuple[str, str]],
+                       disable: Sequence[str] = (),
+                       files: Optional[Sequence[str]] = None
+                       ) -> List[Finding]:
+    """Lint (name, source) pairs — the same full path ``pool_check``
+    takes, for tests and the self-check mutants."""
+    models = []
+    for i, (name, src) in enumerate(sources):
+        path = files[i] if files else None
+        models.append(analyze_pool_module(path=path, source=src,
+                                          name=name))
+    return _run_rules(models, ())
+
+
+# ------------------------------------------------------------- self-check
+
+_LEAK_MUTANT = """
+from paddle_tpu.ops import paged_attention as paged
+
+def admit(cache, want):
+    grown, ok = paged.paged_reserve(cache, want)
+    if not bool(ok):
+        return cache
+    return cache._replace(refcounts=grown.refcounts)
+"""
+
+_LEAK_CLEAN = """
+from paddle_tpu.ops import paged_attention as paged
+
+def admit(cache, want):
+    grown, ok = paged.paged_reserve(cache, want)
+    if not bool(ok):
+        return cache
+    return grown
+"""
+
+_ORDERING_MUTANT = """
+from paddle_tpu.ops import paged_attention as paged
+
+def restore(cache, payload, slot, bid, nmap, new_len, delta):
+    cache, ids = paged.paged_import_blocks(cache, payload)
+    cache = paged.paged_share(cache, slot, bid, nmap, new_len)
+    cache = paged.paged_rc_add(cache, delta)
+    return cache
+"""
+
+_ORDERING_CLEAN = """
+from paddle_tpu.ops import paged_attention as paged
+
+def restore(cache, payload, slot, bid, nmap, new_len, delta):
+    cache, ids = paged.paged_import_blocks(cache, payload)
+    cache = paged.paged_rc_add(cache, delta)
+    cache = paged.paged_share(cache, slot, bid, nmap, new_len)
+    return cache
+"""
+
+
+def pool_self_check() -> str:
+    """Wiring smoke for the pool family, run by ``--self-check``: a
+    refcount-leak mutant and a share-before-pin ordering mutant must
+    each fire EXACTLY once through the full ``pool_check`` path, and
+    their clean twins must stay quiet — so a refactor that silently
+    stops building the ownership model (or unregisters a rule) fails
+    CI loudly instead of linting nothing."""
+    required = {"unbalanced-acquire", "share-before-pin",
+                "cow-slack-bypass", "append-after-free",
+                "export-mutation"}
+    missing = required - set(POOL_RULES)
+    if missing:
+        raise RuntimeError(
+            f"pool-rule registry lost {sorted(missing)}")
+    cases = [
+        ("unbalanced-acquire", _LEAK_MUTANT, _LEAK_CLEAN),
+        ("share-before-pin", _ORDERING_MUTANT, _ORDERING_CLEAN),
+    ]
+    for rule_id, mutant, clean in cases:
+        got = pool_check_sources([("mutant", mutant)])
+        hits = [f for f in got if f.rule_id == rule_id]
+        if len(hits) != 1 or len(got) != 1:
+            raise RuntimeError(
+                f"pool self-check: {rule_id} mutant produced "
+                f"{[f.rule_id for f in got]}, expected exactly one "
+                f"{rule_id} finding")
+        quiet = pool_check_sources([("clean", clean)])
+        if quiet:
+            raise RuntimeError(
+                f"pool self-check: {rule_id} clean twin produced "
+                f"{[f.rule_id for f in quiet]}, expected none")
+    return ("pool-rule self-check OK: refcount-leak and "
+            "share-before-pin mutants each fired exactly once, "
+            "clean twins quiet")

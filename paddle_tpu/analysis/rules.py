@@ -34,6 +34,7 @@ _NARROW_FLOATS = ("bfloat16", "float16")
 class Rule:
     rule_id: str = ""
     severity: str = "warn"
+    family: str = "jaxpr"
     doc: str = ""
 
 

@@ -267,6 +267,7 @@ def _transitive(comps: Dict[str, List[str]], roots: Sequence[str]):
 class ShardRule:
     rule_id: str = ""
     severity: str = "warn"
+    family: str = "shard"
     doc: str = ""
 
     def run(self, sa: ShardAnalysis, ctx: LintContext) -> None:
@@ -667,7 +668,8 @@ def _static_axis_findings(recipe: ShardRecipe, target_name: str,
 
 def shard_check(target: LintTarget, recipe: Optional[ShardRecipe] = None,
                 rules: Optional[Sequence[ShardRule]] = None,
-                disable: Sequence[str] = ()) -> List[Finding]:
+                disable: Sequence[str] = (),
+                keep_suppressed: bool = False) -> List[Finding]:
     """Lower ``target`` under its mesh recipe and run the SPMD rule
     family.  Returns findings sorted most-severe-first; a recipe-less
     target returns ``[]`` (it lints single-device via :func:`lint`).
@@ -676,7 +678,7 @@ def shard_check(target: LintTarget, recipe: Optional[ShardRecipe] = None,
     if recipe is None:
         return []
     rules = list(rules) if rules is not None else active_shard_rules()
-    ctx = LintContext(disable=disable)
+    ctx = LintContext(disable=disable, keep_suppressed=keep_suppressed)
 
     mesh = build_mesh(recipe)
     if mesh is None:

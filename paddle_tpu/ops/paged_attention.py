@@ -613,6 +613,101 @@ def paged_rollback(cache: PagedKVCache, new_lengths) -> PagedKVCache:
         blocks_used=keep)
 
 
+def paged_reconcile(cache: PagedKVCache, pins=None,
+                    strict_scales: bool = False) -> list:
+    """Runtime reconciliation oracle: check the pool's materialized
+    invariants and return a list of human-readable problem strings
+    (empty == consistent), each naming the offending block or slot.
+
+    This is the runtime twin of the STATIC pool-ownership family
+    (``analysis/pool_rules.py``): the AST rules prove the clients'
+    acquire/release/pin ordering per commit; this oracle proves the
+    pool a live engine actually materialized still balances.  It is a
+    host-side numpy read (device sync!), so the engine exposes it
+    opt-in via ``host_state(reconcile=True)`` — never on the crash-dump
+    path, which must stay sync-free.
+
+    Invariants checked:
+
+    * every mapped table entry (column < ``blocks_used``) is a physical
+      block id in ``[0, num_blocks)``, and every entry at or past
+      ``blocks_used`` is ``-1`` (the unmapped sentinel);
+    * per block: ``refcount == table references + host pins`` —
+      ``pins`` is the host registry's pin count per block (e.g.
+      ``PrefixCache.pin_counts``); omitted, it defaults to zero, which
+      is exact for engines without a prefix registry;
+    * free-set consistency: an rc-0 block mapped by any table is a
+      dangling reference (flagged specially — the reader can claim it
+      out from under the slot);
+    * per slot: ``lengths <= blocks_used * block_size`` (the cursor
+      never points past the mapped blocks);
+    * ``strict_scales=True`` only: quantized scale rows of rc-0 blocks
+      must be zero.  NOT a live-engine invariant — ``paged_reserve``
+      zeroes scales at CLAIM time, never at free time, so a running
+      pool legitimately carries stale scales on freed blocks; strict
+      mode is for fresh pools and corruption tests.
+    """
+    nb = cache.num_blocks
+    bs = cache.block_size
+    rc = np.asarray(cache.refcounts)
+    tables = np.asarray(cache.block_tables)
+    used = np.asarray(cache.blocks_used)
+    lengths = np.asarray(cache.lengths)
+    problems: list = []
+
+    cols = np.arange(tables.shape[1])[None, :]
+    mapped = cols < used[:, None]
+    # table shape: mapped entries physical, unmapped entries -1
+    bad_phys = mapped & ((tables < 0) | (tables >= nb))
+    for s, c in zip(*np.nonzero(bad_phys)):
+        problems.append(
+            f"slot {s}: mapped table column {c} holds {tables[s, c]}, "
+            f"not a physical block id in [0, {nb})")
+    bad_unmapped = (~mapped) & (tables != -1)
+    for s, c in zip(*np.nonzero(bad_unmapped)):
+        problems.append(
+            f"slot {s}: column {c} past blocks_used={used[s]} holds "
+            f"{tables[s, c]}, expected -1")
+
+    # refcounts == table references + host pins, per block
+    valid = mapped & (tables >= 0) & (tables < nb)
+    refs = np.bincount(tables[valid].ravel(), minlength=nb)[:nb]
+    pin = np.zeros(nb, np.int64)
+    if pins is not None:
+        for b, n in (pins.items() if hasattr(pins, "items")
+                     else enumerate(np.asarray(pins))):
+            if 0 <= int(b) < nb:
+                pin[int(b)] += int(n)
+    for b in np.nonzero(rc != refs + pin)[0]:
+        if rc[b] == 0 and refs[b] > 0:
+            problems.append(
+                f"block {b}: free (refcount 0) but mapped by "
+                f"{refs[b]} table reference(s) — dangling row, a "
+                f"claim can reuse it under the reader")
+        else:
+            problems.append(
+                f"block {b}: refcount {rc[b]} but {refs[b]} table "
+                f"reference(s) + {pin[b]} pin(s)")
+
+    for s in np.nonzero(lengths > used * bs)[0]:
+        problems.append(
+            f"slot {s}: length {lengths[s]} exceeds blocks_used="
+            f"{used[s]} * block_size={bs}")
+
+    if strict_scales and cache.quantized:
+        free_blocks = rc == 0
+        for name, scales in (("k_scales", cache.k_scales),
+                             ("v_scales", cache.v_scales)):
+            for layer, sc in enumerate(scales):
+                sc = np.asarray(sc)
+                dirty = free_blocks & (np.abs(sc).sum(axis=-1) != 0)
+                for b in np.nonzero(dirty)[0]:
+                    problems.append(
+                        f"block {b}: free but layer {layer} "
+                        f"{name} row is non-zero")
+    return problems
+
+
 def layer_views(cache: PagedKVCache, slot_ids, append_valid):
     """Per-layer :class:`PagedLayerView` list for a model call over
     batch rows ``slot_ids`` [b] appending ``append_valid`` [b] tokens."""

@@ -481,6 +481,26 @@ class PrefixCache:
             stack.extend(node.children.values())
         return chunks, tails, shared, spilled
 
+    def pin_counts(self, num_blocks: int) -> Dict[int, int]:
+        """Registry pin count per physical block id: how many of the
+        pool's refcounts this registry holds (one per RESIDENT node —
+        spilled nodes hold no device block and pin nothing).  This is
+        the ``pins`` argument :func:`paddle_tpu.ops.paged_attention.
+        paged_reconcile` needs to balance refcounts against table
+        references on an engine with prefix sharing."""
+        pins: Dict[int, int] = {}
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            for nd in list(node.children.values()) \
+                    + list(node.tails.values()):
+                if not nd.spilled:
+                    assert 0 <= nd.block_id < num_blocks, \
+                        (nd.block_id, num_blocks)
+                    pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
+            stack.extend(node.children.values())
+        return pins
+
     @property
     def blocks(self) -> int:
         """Registered RESIDENT (pinned) blocks — spilled nodes hold

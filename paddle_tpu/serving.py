@@ -2302,10 +2302,32 @@ class PagedServingEngine:
 
     # --------------------------------------------------- flight recorder
 
-    def host_state(self) -> dict:
+    def host_state(self, reconcile: bool = False) -> dict:
         """JSON-safe engine host state for the flight recorder.  HOST
         accounting only — no device sync (:meth:`occupancy` would block
-        on a device that may be the thing that just wedged)."""
+        on a device that may be the thing that just wedged).
+
+        ``reconcile=True`` additionally runs the pool's runtime
+        reconciliation oracle (:func:`paddle_tpu.ops.paged_attention.
+        paged_reconcile`) over the main pool — balanced against the
+        prefix registry's pins — and the draft pool, under a
+        ``"pool_reconcile"`` key.  That READS DEVICE ARRAYS (a sync),
+        so it is opt-in and must never be requested from the crash-dump
+        path; the telemetry selfcheck and the pool property tests are
+        the intended callers."""
+        state = self._host_state_base()
+        if reconcile:
+            pins = (None if self._prefix is None
+                    else self._prefix.pin_counts(self.nb))
+            problems = paged.paged_reconcile(self.cache, pins=pins)
+            if self.spec is not None:
+                problems += [f"draft: {p}" for p in
+                             paged.paged_reconcile(self.dcache)]
+            state["pool_reconcile"] = {"ok": not problems,
+                                       "problems": problems}
+        return state
+
+    def _host_state_base(self) -> dict:
         return {
             "slots": [None if r is None else {
                 "rid": r.rid,

@@ -161,6 +161,20 @@ def _fail(msg: str) -> None:
     raise SystemExit(f"telemetry selfcheck FAILED: {msg}")
 
 
+def _reconcile_or_fail(eng, where: str) -> None:
+    """Run the pool's runtime reconciliation oracle on a live engine:
+    refcounts must equal table references + registry pins, the free
+    set must be consistent, no cursor past its mapped blocks — for
+    the main AND (when speculating) the draft pool.  The static pool
+    family (``analysis/pool_rules.py``) proves the clients' ordering
+    per commit; this proves the pool each smoke check actually
+    materialized balances."""
+    rec = eng.host_state(reconcile=True)["pool_reconcile"]
+    if not rec["ok"]:
+        _fail(f"{where}: paged_reconcile found inconsistencies: "
+              + "; ".join(rec["problems"]))
+
+
 def _check_serving_smoke():
     import jax.numpy as jnp
     import numpy as np
@@ -223,6 +237,7 @@ def _check_serving_smoke():
     if stats["tokens_per_s"] <= 0:
         _fail(f"stats tokens_per_s must be positive when driven via "
               f"run(): {stats['tokens_per_s']}")
+    _reconcile_or_fail(eng, "serving smoke")
     return snap, tracer.snapshot(), n_req
 
 
@@ -361,6 +376,7 @@ def _check_prefix_smoke():
     if report["prefix_pinned_bytes"] <= 0:
         _fail("hbm_report prefix_pinned_bytes not positive with blocks "
               "pinned")
+    _reconcile_or_fail(eng, "prefix smoke (pins registered)")
     eng.flush_prefix_cache()
     if eng.occupancy()["blocks_in_use"] != 0:
         _fail(f"flush left blocks resident: {eng.occupancy()}")
@@ -446,6 +462,7 @@ def _check_prefix_spill_smoke():
               f"with a nonzero hbm share: {ev}")
 
     n_spills, n_restores = int(st["spills"]), int(st["restores"])
+    _reconcile_or_fail(eng, "prefix-spill smoke (mixed tiers)")
     eng.flush_prefix_cache()
     st = eng.host_state()["prefix_cache"]
     if (eng.occupancy()["blocks_in_use"] != 0 or st["spilled_nodes"]
@@ -532,6 +549,7 @@ def _check_spec_smoke():
               "mapped after every request retired")
     if int(np.asarray(eng.dcache.refcounts).max()) != 0:
         _fail("draft pool refcounts corrupted after the run")
+    _reconcile_or_fail(eng, "spec smoke (main + draft pools)")
     eng.flush_prefix_cache()
     if eng.occupancy()["blocks_in_use"] != 0:
         _fail(f"flush left blocks resident: {eng.occupancy()}")
@@ -597,6 +615,7 @@ def _check_unified_smoke():
         _fail("the unified path silently regressed to the XLA gather "
               "form: serving_kernel_fallback_total carries "
               f"{[(s['labels'], s['value']) for s in fb['series']]}")
+    _reconcile_or_fail(eng, "unified smoke")
     return int(ragged), compiles
 
 
@@ -702,6 +721,7 @@ def _check_int8_smoke():
     if rep["pool_bytes_total"] >= bf16_total:
         _fail(f"int8 pool bytes {rep['pool_bytes_total']} not below "
               f"the bf16 pool's {bf16_total} at equal capacity")
+    _reconcile_or_fail(eng, "int8 smoke (quantized pools)")
     return rate, ref_rate, int(ragged)
 
 
@@ -798,6 +818,7 @@ def _check_mesh_smoke():
     if n_combine != cfg.num_layers:
         _fail(f"expected one combine per layer "
               f"({cfg.num_layers}), found {n_combine}")
+    _reconcile_or_fail(eng, "mesh smoke (sharded pools)")
     return rep["shards"], n_combine
 
 

@@ -1,0 +1,74 @@
+"""Shared paged-pool refcount-property helpers.
+
+Before the pool family landed, four test files each carried a private
+radix-walk + host-mirror reconciler (``test_prefix_cache``,
+``test_speculative``, ``test_prefix_spill``, ``test_sharded_serving``)
+— four slightly different spellings of one invariant.  They now all
+drive the SAME runtime oracle the engine and the telemetry selfcheck
+use, :func:`paddle_tpu.ops.paged_attention.paged_reconcile`:
+refcounts == block-table references + registry pins, free set
+consistent, no cursor past its mapped blocks.
+
+``leaky_admit`` is the SEEDED LEAK MUTANT the acceptance contract
+pins: the same bug must be caught by the static pool rule
+(``unbalanced-acquire`` on its source) AND by the runtime oracle (run
+it on a real pool, ``paged_reconcile`` names the leaked block) — the
+two halves of the family watching one defect from both sides.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import paged_attention as paged
+
+
+def registry_pins(eng):
+    """Block id -> prefix-registry pin count for an engine (resident
+    nodes only — a spilled node holds no device block)."""
+    return eng._prefix.pin_counts(eng.nb)
+
+
+def assert_refcounts_exact(eng):
+    """Device refcounts == slot mappings + registry pins, everywhere,
+    via ``paged_reconcile``; plus the host-side ledger invariants
+    (registry pin total mirrors ``_pinned``, ledger within the pool)."""
+    pins = registry_pins(eng) if eng._prefix is not None else None
+    problems = paged.paged_reconcile(eng.cache, pins=pins)
+    assert not problems, "\n".join(problems)
+    if pins is not None:
+        assert sum(pins.values()) == eng._pinned, (
+            f"registry pins {sum(pins.values())} != engine _pinned "
+            f"{eng._pinned}")
+    assert eng._reserved + eng._pinned <= eng.nb, (
+        "ledger must stay within the pool")
+
+
+def assert_tiers_reconcile(eng):
+    """Spill-aware superset of :func:`assert_refcounts_exact`: the
+    device pool balances AND the host store's key set / byte totals
+    mirror the registry's spilled nodes."""
+    from paddle_tpu.prefix_cache import HostPrefixStore
+
+    assert_refcounts_exact(eng)
+    spilled = eng._prefix._spilled_index
+    assert set(spilled.keys()) == set(eng._host_store.keys())
+    assert all(nd.spilled and nd.block_id == -1
+               for nd in spilled.values())
+    assert eng._prefix.stats()["spilled_nodes"] == len(eng._host_store)
+    assert eng._host_store.total_bytes == sum(
+        HostPrefixStore.payload_bytes(eng._host_store._entries[k])
+        for k in eng._host_store.keys())
+    assert eng._host_store.total_bytes <= eng._host_store.max_bytes
+
+
+def leaky_admit(cache, want):
+    """SEEDED LEAK MUTANT — do not fix.  Claims blocks via
+    ``paged_reserve`` but commits only the refcount plane of the
+    result, dropping the table/length updates: refcounts rise with no
+    table reference to account for them.  The static rule sees the
+    dropped ``grown`` binding (``unbalanced-acquire``); the runtime
+    oracle sees the unbalanced pool (``paged_reconcile`` names the
+    leaked block)."""
+    grown, ok = paged.paged_reserve(cache, jnp.asarray(want))
+    del ok
+    return cache._replace(refcounts=grown.refcounts)

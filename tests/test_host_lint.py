@@ -442,9 +442,14 @@ def test_cli_list_rules_groups_by_family(capsys):
     shard = out.index("shard rules:")
     kernel = out.index("kernel rules:")
     host = out.index("host rules:")
-    assert jaxpr < shard < kernel < host
+    pool = out.index("pool rules:")
+    assert jaxpr < shard < kernel < host < pool
     for rule_id in HOST_RULE_IDS:
-        assert out.index(rule_id) > host
+        assert host < out.index(rule_id) < pool
+    for rule_id in ("unbalanced-acquire", "share-before-pin",
+                    "cow-slack-bypass", "append-after-free",
+                    "export-mutation"):
+        assert out.index(rule_id) > pool
 
 
 # ------------------------------------------ threading.excepthook backstop

@@ -1,0 +1,375 @@
+"""Pool-ownership lint family (``paddle_tpu/analysis/pool_rules.py``).
+
+The twin-snippet discipline of the other lint-family test files,
+applied to the paged-pool ownership pass: each rule gets a mutant it
+must flag with exactly ONE typed finding and a clean twin it must stay
+quiet on — a dropped ``paged_reserve`` result vs the returned form, a
+share-before-pin restore vs write-then-pin-then-share, ledger growth
+without the capacity enforce vs the enforced/transferred forms, a
+freed slot mask flowing into a later share, a pool mutation behind an
+export vs the sanctioned export-then-free epilogue.  Plus: the jitted
+engine-alias resolution (``self._free = jax.jit(paged.paged_free)``),
+the intra-class effect threading (enforce in a self-callee counts),
+``# tpu-lint: disable=`` suppression, the shipped POOL_CLIENT_MODULES
+zero baseline, the registry/CLI smoke, the grouped ``--list-rules``
+order, and the ``--json`` artifact shape (rule, family, file:line,
+severity, suppressed-or-not).
+"""
+
+import json
+
+import pytest
+
+from paddle_tpu.analysis import (POOL_CLIENT_MODULES, POOL_RULES,
+                                 pool_check, pool_check_sources,
+                                 pool_self_check)
+from paddle_tpu.analysis.cli import main as lint_main
+
+POOL_RULE_IDS = ("unbalanced-acquire", "share-before-pin",
+                 "cow-slack-bypass", "append-after-free",
+                 "export-mutation")
+
+
+def _lint(src, name="mutant"):
+    return pool_check_sources([(name, src)])
+
+
+def _only(findings, rule_id):
+    assert [f.rule_id for f in findings] == [rule_id], (
+        [(f.rule_id, f.message) for f in findings])
+    return findings[0]
+
+
+# ------------------------------------------------- unbalanced-acquire
+
+
+LEAK = """
+from paddle_tpu.ops import paged_attention as paged
+
+def admit(cache, want):
+    grown, ok = paged.paged_reserve(cache, want)
+    if not bool(ok):
+        return cache
+    return cache._replace(refcounts=grown.refcounts)
+"""
+
+LEAK_CLEAN = """
+from paddle_tpu.ops import paged_attention as paged
+
+def admit(cache, want):
+    grown, ok = paged.paged_reserve(cache, want)
+    if not bool(ok):
+        return cache
+    return grown
+"""
+
+
+def test_unbalanced_acquire_fires_on_dropped_result():
+    f = _only(_lint(LEAK), "unbalanced-acquire")
+    assert f.severity == "error"
+    assert "grown" in f.message
+    assert f.line == 5          # the paged_reserve line
+
+
+def test_unbalanced_acquire_quiet_on_returned_result():
+    assert _lint(LEAK_CLEAN) == []
+
+
+def test_unbalanced_acquire_quiet_on_committed_result():
+    # the engine idiom: the acquired cache is stored to self.cache
+    # (store escape) or handed on to another op (call-arg escape)
+    src = """
+from paddle_tpu.ops import paged_attention as paged
+
+class Eng:
+    def admit(self, want):
+        cache, ok = paged.paged_reserve(self.cache, want)
+        self.cache = cache
+"""
+    assert _lint(src) == []
+
+
+def test_unbalanced_acquire_fires_on_exception_edge():
+    # an explicit raise between the acquire and its first escape
+    # leaks the claimed blocks on that edge
+    src = """
+from paddle_tpu.ops import paged_attention as paged
+
+class Eng:
+    def admit(self, want, bad):
+        cache, ok = paged.paged_reserve(self.cache, want)
+        if bad:
+            raise ValueError(bad)
+        self.cache = cache
+"""
+    f = _only(_lint(src), "unbalanced-acquire")
+    assert "exception edge" in f.message
+
+
+def test_unbalanced_acquire_quiet_on_raise_before_acquire():
+    src = """
+from paddle_tpu.ops import paged_attention as paged
+
+class Eng:
+    def admit(self, want, bad):
+        if bad:
+            raise ValueError(bad)
+        cache, ok = paged.paged_reserve(self.cache, want)
+        self.cache = cache
+"""
+    assert _lint(src) == []
+
+
+# --------------------------------------------------- share-before-pin
+
+
+def test_share_before_pin_twins():
+    from paddle_tpu.analysis.pool_rules import (_ORDERING_CLEAN,
+                                                _ORDERING_MUTANT)
+    f = _only(_lint(_ORDERING_MUTANT), "share-before-pin")
+    assert f.severity == "error"
+    assert _lint(_ORDERING_CLEAN) == []
+
+
+def test_share_before_pin_quiet_on_sanctioned_shapes():
+    # handoff admission: import -> share with NO rc_add (the share IS
+    # the pin); restore promotion: import -> rc_add with no share here
+    handoff = """
+from paddle_tpu.ops import paged_attention as paged
+
+def admit(cache, payload, slot, bid, nmap, new_len):
+    cache, ids = paged.paged_import_blocks(cache, payload)
+    return paged.paged_share(cache, slot, bid, nmap, new_len)
+"""
+    restore = """
+from paddle_tpu.ops import paged_attention as paged
+
+def promote(cache, payload, delta):
+    cache, ids = paged.paged_import_blocks(cache, payload)
+    return paged.paged_rc_add(cache, delta)
+"""
+    assert _lint(handoff) == []
+    assert _lint(restore) == []
+
+
+# --------------------------------------------------- cow-slack-bypass
+
+
+def test_cow_slack_bypass_fires_without_enforce():
+    src = """
+class Eng:
+    def admit(self, req):
+        self._reserved += req.need
+"""
+    f = _only(_lint(src), "cow-slack-bypass")
+    assert f.severity == "error"
+
+
+def test_cow_slack_bypass_quiet_with_capacity_check():
+    src = """
+class Eng:
+    def admit(self, req, need, slack):
+        if self._reserved + self._pinned + need + slack > self.nb:
+            return False
+        self._reserved += need
+        return True
+"""
+    assert _lint(src) == []
+
+
+def test_cow_slack_bypass_quiet_on_ledger_transfer():
+    # reservation -> pin transfer: weight moves between ledger fields
+    # the capacity check already admitted (serving's restore path)
+    src = """
+class Eng:
+    def promote(self, req, n):
+        self._pinned += n
+        req.blocks_reserved -= n
+"""
+    assert _lint(src) == []
+
+
+def test_cow_slack_bypass_threads_through_self_calls():
+    # the enforce living in a helper the writer calls still counts —
+    # the intra-class effect threading the host family pioneered
+    src = """
+class Eng:
+    def _enforce(self, need):
+        assert self._reserved + self._pinned + need <= self.nb
+
+    def admit(self, need):
+        self._enforce(need)
+        self._reserved += need
+"""
+    assert _lint(src) == []
+
+
+# -------------------------------------------------- append-after-free
+
+
+def test_append_after_free_twins():
+    mutant = """
+from paddle_tpu.ops import paged_attention as paged
+
+def f(cache, mask, slot, nmap, new_len):
+    cache = paged.paged_free(cache, mask)
+    return paged.paged_share(cache, slot, mask, nmap, new_len)
+"""
+    clean = """
+from paddle_tpu.ops import paged_attention as paged
+
+def f(cache, mask, slot, nmap, new_len):
+    cache = paged.paged_share(cache, slot, mask, nmap, new_len)
+    return paged.paged_free(cache, mask)
+"""
+    f = _only(_lint(mutant), "append-after-free")
+    assert "mask" in f.message
+    assert _lint(clean) == []
+
+
+def test_append_after_free_sees_through_engine_aliases():
+    # the serving engine never calls paged_free directly — it calls
+    # self._free, a jax.jit(paged.paged_free, donate_argnums=(0,))
+    # wrapper bound in __init__; the model must resolve the alias
+    src = """
+import jax
+from paddle_tpu.ops import paged_attention as paged
+
+class Eng:
+    def __init__(self):
+        self._free = jax.jit(paged.paged_free, donate_argnums=(0,))
+        self._share = jax.jit(paged.paged_share)
+
+    def retire(self, mask, slot, nmap, new_len):
+        self.cache = self._free(self.cache, mask)
+        self.cache = self._share(self.cache, slot, mask, nmap, new_len)
+"""
+    _only(_lint(src), "append-after-free")
+
+
+# --------------------------------------------------- export-mutation
+
+
+def test_export_mutation_twins():
+    mutant = """
+from paddle_tpu.ops import paged_attention as paged
+
+def handoff(cache, slot, want):
+    payload = paged.paged_export_blocks(cache, slot)
+    cache, ok = paged.paged_reserve(cache, want)
+    return cache, payload
+"""
+    # export-then-FREE is the sanctioned handoff epilogue: the payload
+    # is a copy, releasing the donor slot is the point of exporting
+    clean = """
+from paddle_tpu.ops import paged_attention as paged
+
+def handoff(cache, slot, mask):
+    payload = paged.paged_export_blocks(cache, slot)
+    cache = paged.paged_free(cache, mask)
+    return cache, payload
+"""
+    f = _only(_lint(mutant), "export-mutation")
+    assert "paged_reserve" in f.message
+    assert _lint(clean) == []
+
+
+# --------------------------------------------------------- suppression
+
+
+def test_disable_comment_suppresses_at_site(tmp_path):
+    src = LEAK.replace(
+        "grown, ok = paged.paged_reserve(cache, want)",
+        "grown, ok = paged.paged_reserve(cache, want)"
+        "  # tpu-lint: disable=unbalanced-acquire")
+    p = tmp_path / "suppressed_mutant.py"
+    p.write_text(src)
+    assert pool_check([("suppressed_mutant", str(p))]) == []
+    # the --json artifact keeps it, flagged
+    kept = pool_check([("suppressed_mutant", str(p))],
+                      keep_suppressed=True)
+    assert [(f.rule_id, f.suppressed) for f in kept] == [
+        ("unbalanced-acquire", True)]
+
+
+# ------------------------------------------- shipped modules + registry
+
+
+def test_registry_carries_all_five_rules():
+    assert set(POOL_RULE_IDS) <= set(POOL_RULES)
+
+
+def test_pool_self_check_passes():
+    assert "OK" in pool_self_check()
+
+
+def test_shipped_pool_modules_lint_clean():
+    # acceptance contract: the registered pool clients carry a ZERO
+    # post-suppression baseline — any new finding is a regression
+    findings = pool_check()
+    assert findings == [], [(f.rule_id, f.location()) for f in findings]
+    assert len(POOL_CLIENT_MODULES) == 5
+
+
+def test_model_is_not_trivially_empty():
+    # zero findings must mean "clean", not "saw nothing": the serving
+    # model must carry real pool-op events and the jitted aliases
+    from paddle_tpu.analysis.pool_rules import (analyze_pool_module,
+                                                resolve_pool_modules)
+    mods = dict(resolve_pool_modules(["serving"]))
+    model = analyze_pool_module(path=mods["paddle_tpu.serving"],
+                                name="paddle_tpu.serving")
+    events = [e for _, info in model.all_fns() for e in info.events]
+    assert len(events) >= 30
+    aliases = {a for cm in model.classes.values()
+               for a in cm.op_aliases.values()}
+    assert {"paged_free", "paged_share", "paged_rc_add",
+            "paged_rollback"} <= aliases
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cli_pool_arm_runs_clean():
+    assert lint_main(["--pool"]) == 0
+
+
+def test_cli_pool_filter_and_unknown_filter():
+    assert lint_main(["--pool", "serving"]) == 0
+    # typo'd filter is a HARD usage error (exit 2), matching --host:
+    # it must not silently guard nothing
+    with pytest.raises(SystemExit) as e:
+        lint_main(["--pool", "no-such-module"])
+    assert e.value.code == 2
+
+
+def test_cli_json_pool_arm_emits_bare_list(capsys):
+    assert lint_main(["--pool", "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out) == []
+
+
+def test_json_artifact_golden(tmp_path):
+    # the machine-readable artifact: one live finding, one suppressed,
+    # every documented key present with the right values
+    src = (LEAK
+           + "\n\ndef admit2(cache, want):\n"
+             "    grown, ok = paged.paged_reserve(cache, want)"
+             "  # tpu-lint: disable=unbalanced-acquire\n"
+             "    return cache\n")
+    p = tmp_path / "golden_mutant.py"
+    p.write_text(src)
+    findings = pool_check([("golden_mutant", str(p))],
+                          keep_suppressed=True)
+    dicts = [f.to_dict() for f in findings]
+    assert len(dicts) == 2
+    for d in dicts:
+        assert {"rule_id", "severity", "path", "message", "suggestion",
+                "file", "line", "cost", "family",
+                "suppressed"} <= set(d)
+        assert d["rule_id"] == "unbalanced-acquire"
+        assert d["family"] == "pool"
+        assert d["severity"] == "error"
+        assert d["file"] == str(p) and isinstance(d["line"], int)
+    # live findings sort before suppressed ones
+    assert [d["suppressed"] for d in dicts] == [False, True]

@@ -1,0 +1,167 @@
+"""Runtime pool reconciliation oracle
+(``ops/paged_attention.py::paged_reconcile``).
+
+The load-bearing pins:
+
+* CLEAN POOLS PASS: a fresh pool, a pool mid-schedule, and a drained
+  serving engine (``host_state(reconcile=True)``) reconcile with zero
+  problems across {bf16, int8} x {mesh off, 2-way} — the oracle must
+  not false-fire on any shipped configuration;
+* CORRUPTION IS NAMED: three seeded corruptions — a refcount
+  off-by-one, a dangling table row (a mapped block whose refcount says
+  free), a non-zeroed scale on a free block (strict mode) — each fail
+  with a message naming the exact block id;
+* BOTH HALVES CATCH THE SEEDED LEAK: ``helpers_pool.leaky_admit`` is
+  flagged statically by ``unbalanced-acquire`` on its source AND at
+  runtime by ``paged_reconcile`` on the pool it corrupts — the
+  acceptance contract tying the static family to its runtime twin;
+* the ``host_state`` default stays sync-free: no ``pool_reconcile``
+  key unless explicitly requested.
+"""
+
+import inspect
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from helpers_pool import leaky_admit
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import paged_attention as paged
+from paddle_tpu.serving import PagedServingEngine
+
+CFG = TransformerConfig(vocab_size=61, dim=32, num_heads=4,
+                        num_layers=2, ffn_mult=2, max_len=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def _pool(dtype=jnp.float32, nb=8):
+    return paged.paged_init(1, 2, 4, nb, 4, 1, 4, dtype=dtype)
+
+
+# ------------------------------------------------------- clean pools
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, "int8"])
+def test_fresh_pool_reconciles(dtype):
+    cache = _pool(dtype)
+    assert paged.paged_reconcile(cache) == []
+    assert paged.paged_reconcile(cache, strict_scales=True) == []
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, "int8"])
+def test_mid_schedule_pool_reconciles(dtype):
+    cache = _pool(dtype)
+    cache, ok = paged.paged_reserve(cache, jnp.asarray([6, 3]))
+    assert bool(ok)
+    cache = paged.paged_advance(cache, jnp.asarray([6, 3]))
+    # pin a mapped block (the prefix-registry move), then retire slot 1
+    b = int(np.asarray(cache.block_tables)[0, 0])
+    pins = np.zeros(8, np.int32)
+    pins[b] = 1
+    cache = paged.paged_rc_add(cache, jnp.asarray(pins))
+    cache = paged.paged_free(cache, jnp.asarray([False, True]))
+    assert paged.paged_reconcile(cache, pins=pins) == []
+    # the same pool WITHOUT the pin accounting must not balance
+    assert paged.paged_reconcile(cache) != []
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("mesh", [None, 2])
+def test_live_engines_reconcile(params, kv_dtype, mesh):
+    # {bf16, int8} x {mesh off, 2-way}: every combination must keep a
+    # balanced pool mid-flight AND after draining, including with the
+    # prefix registry pinning blocks
+    eng = PagedServingEngine(CFG, params, num_slots=2, num_blocks=24,
+                             block_size=4, prompt_buckets=(8, 16),
+                             seed=0,
+                             mesh=mesh, kv_dtype=kv_dtype,
+                             prefix_cache=True)
+    prefix = np.arange(1, 9, dtype=np.int32)
+    eng.submit(prefix, max_new=4)
+    eng.submit(np.concatenate([prefix, [11, 12]]).astype(np.int32),
+               max_new=4)
+    for _ in range(3):
+        eng.step()
+        rec = eng.host_state(reconcile=True)["pool_reconcile"]
+        assert rec["ok"], rec["problems"]
+    eng.run()
+    rec = eng.host_state(reconcile=True)["pool_reconcile"]
+    assert rec["ok"], rec["problems"]
+    assert "pool_reconcile" not in eng.host_state(), (
+        "the default host_state must stay sync-free (crash-dump path)")
+
+
+# ------------------------------------------------- seeded corruptions
+
+
+def test_rc_off_by_one_names_the_block():
+    cache = _pool()
+    cache, _ = paged.paged_reserve(cache, jnp.asarray([4, 0]))
+    b = int(np.asarray(cache.block_tables)[0, 0])
+    bad = cache._replace(refcounts=cache.refcounts.at[b].add(1))
+    problems = paged.paged_reconcile(bad)
+    assert len(problems) == 1 and f"block {b}" in problems[0], problems
+    assert "refcount 2" in problems[0]
+
+
+def test_dangling_table_row_names_the_block():
+    cache = _pool()
+    cache, _ = paged.paged_reserve(cache, jnp.asarray([4, 0]))
+    b = int(np.asarray(cache.block_tables)[0, 0])
+    bad = cache._replace(refcounts=cache.refcounts.at[b].set(0))
+    problems = paged.paged_reconcile(bad)
+    assert len(problems) == 1 and f"block {b}" in problems[0], problems
+    assert "dangling" in problems[0]
+
+
+def test_nonzero_freed_scale_names_the_block():
+    cache = _pool("int8")
+    b = 3
+    dirty = cache.k_scales[0].at[b, 0].set(0.5)
+    bad = cache._replace(k_scales=(dirty,))
+    # default mode tolerates it — a live pool legitimately carries
+    # stale scales on freed blocks (reserve zeroes at CLAIM time)
+    assert paged.paged_reconcile(bad) == []
+    problems = paged.paged_reconcile(bad, strict_scales=True)
+    assert len(problems) == 1 and f"block {b}" in problems[0], problems
+    assert "k_scales" in problems[0]
+
+
+def test_cursor_past_mapped_blocks_names_the_slot():
+    cache = _pool()
+    cache, _ = paged.paged_reserve(cache, jnp.asarray([4, 0]))
+    bad = cache._replace(lengths=cache.lengths.at[0].set(99))
+    problems = paged.paged_reconcile(bad)
+    assert any("slot 0" in p and "99" in p for p in problems), problems
+
+
+# ------------------------------- the seeded leak, caught from both sides
+
+
+def test_leaky_admit_caught_statically():
+    from paddle_tpu.analysis import pool_check_sources
+    src = inspect.getsource(leaky_admit)
+    findings = pool_check_sources([("helpers_pool", src)])
+    assert [f.rule_id for f in findings] == ["unbalanced-acquire"], (
+        [(f.rule_id, f.message) for f in findings])
+
+
+def test_leaky_admit_caught_at_runtime():
+    cache = _pool()
+    leaked = leaky_admit(cache, [4, 0])
+    problems = paged.paged_reconcile(leaked)
+    assert problems, "the leaked claim must unbalance the pool"
+    assert all("refcount" in p for p in problems)
+    # the honest twin of the mutant commits the whole result: balanced
+    grown, ok = paged.paged_reserve(cache, jnp.asarray([4, 0]))
+    assert bool(ok)
+    assert paged.paged_reconcile(grown) == []

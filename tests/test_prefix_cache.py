@@ -252,36 +252,11 @@ def test_full_prompt_hit_replays_one_token(params):
 
 
 # --------------------------------------------- refcount-leak invariant
+# (the reconciler lives in helpers_pool, shared by the four pool
+# property suites and built on paged_reconcile — the same oracle the
+# engine's host_state(reconcile=True) runs)
 
-
-def _registry_pins(eng):
-    """Walk the radix tree: block id -> pin count (always 1/node)."""
-    pins = {}
-    stack = [eng._prefix._root]
-    while stack:
-        node = stack.pop()
-        for nd in list(node.children.values()) + list(node.tails.values()):
-            pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
-        stack.extend(node.children.values())
-    return pins
-
-
-def _assert_refcounts_exact(eng):
-    """Device refcounts == slot mappings + registry pins, everywhere."""
-    tables = np.asarray(eng.cache.block_tables)
-    used = np.asarray(eng.cache.blocks_used)
-    rc = np.asarray(eng.cache.refcounts)
-    expect = np.zeros_like(rc)
-    for s in range(eng.S):
-        for b in tables[s, :used[s]]:
-            assert b >= 0, "mapped prefix of a row must be physical"
-            expect[b] += 1
-    for b, n in _registry_pins(eng).items():
-        expect[b] += n
-    np.testing.assert_array_equal(rc, expect)
-    assert sum(_registry_pins(eng).values()) == eng._pinned
-    assert eng._reserved + eng._pinned <= eng.nb, (
-        "ledger must stay within the pool")
+from helpers_pool import assert_refcounts_exact as _assert_refcounts_exact
 
 
 def test_refcounts_never_leak_randomized(params):

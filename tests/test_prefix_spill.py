@@ -169,49 +169,11 @@ def test_registry_drop_spilled_clears_host_tier_only():
 
 
 # --------------------------------------- spill-aware leak invariant
+# (shared reconciler: helpers_pool builds it on paged_reconcile, with
+# pin_counts skipping spilled nodes — a spilled node holds no device
+# block, so no pin)
 
-
-def _resident_pins(eng):
-    """block id -> registry pin count, RESIDENT nodes only (a spilled
-    node holds no device block, so no pin)."""
-    pins = {}
-    stack = [eng._prefix._root]
-    while stack:
-        node = stack.pop()
-        for nd in (list(node.children.values())
-                   + list(node.tails.values())):
-            if not nd.spilled:
-                pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
-        stack.extend(node.children.values())
-    return pins
-
-
-def _assert_tiers_reconcile(eng):
-    """Refcounts == slot mappings + resident pins; the host store's
-    byte total and key set mirror the registry's spilled nodes."""
-    tables = np.asarray(eng.cache.block_tables)
-    used = np.asarray(eng.cache.blocks_used)
-    rc = np.asarray(eng.cache.refcounts)
-    expect = np.zeros_like(rc)
-    for s in range(eng.S):
-        for b in tables[s, :used[s]]:
-            assert b >= 0
-            expect[b] += 1
-    for b, n in _resident_pins(eng).items():
-        assert b >= 0, "a resident node must hold a physical block"
-        expect[b] += n
-    np.testing.assert_array_equal(rc, expect)
-    assert sum(_resident_pins(eng).values()) == eng._pinned
-    assert eng._reserved + eng._pinned <= eng.nb
-    spilled = eng._prefix._spilled_index
-    assert set(spilled.keys()) == set(eng._host_store.keys())
-    assert all(nd.spilled and nd.block_id == -1
-               for nd in spilled.values())
-    assert eng._prefix.stats()["spilled_nodes"] == len(eng._host_store)
-    assert eng._host_store.total_bytes == sum(
-        HostPrefixStore.payload_bytes(eng._host_store._entries[k])
-        for k in eng._host_store.keys())
-    assert eng._host_store.total_bytes <= eng._host_store.max_bytes
+from helpers_pool import assert_tiers_reconcile as _assert_tiers_reconcile
 
 
 # ------------------------------------------------- token identity

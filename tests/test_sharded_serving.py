@@ -170,32 +170,10 @@ def test_engine_rejects_indivisible_heads(params):
 
 
 # --------------------------------------- refcount ledger under sharding
+# (shared reconciler — the host-side ledger never sees the mesh, so
+# the single-device oracle applies unchanged to sharded pools)
 
-
-def _registry_pins(eng):
-    pins = {}
-    stack = [eng._prefix._root]
-    while stack:
-        node = stack.pop()
-        for nd in list(node.children.values()) + list(node.tails.values()):
-            pins[nd.block_id] = pins.get(nd.block_id, 0) + 1
-        stack.extend(node.children.values())
-    return pins
-
-
-def _assert_refcounts_exact(eng):
-    tables = np.asarray(eng.cache.block_tables)
-    used = np.asarray(eng.cache.blocks_used)
-    rc = np.asarray(eng.cache.refcounts)
-    expect = np.zeros_like(rc)
-    for s in range(eng.S):
-        for b in tables[s, :used[s]]:
-            assert b >= 0
-            expect[b] += 1
-    for b, n in _registry_pins(eng).items():
-        expect[b] += n
-    np.testing.assert_array_equal(rc, expect)
-    assert eng._reserved + eng._pinned <= eng.nb
+from helpers_pool import assert_refcounts_exact as _assert_refcounts_exact
 
 
 def test_refcounts_never_leak_with_sharded_pools(params):
