@@ -179,6 +179,130 @@ def _bench_mesh(args, cfg, params, jax):
         **_kv_dtype_extras(args, cfg, params))
 
 
+def _bench_adapters(args, cfg, params, jax):
+    """``--adapters N --adapter-rank R``: multi-tenant LoRA rows.
+
+    Serves the same greedy burst three ways in one process: through an
+    adapter-FREE engine (the baseline), then twice through one adapter
+    engine — first with every adapter COLD (each distinct adapter's
+    first admission is a miss: artifact read + pool-slot factor
+    writes), then again with every adapter RESIDENT (pure gathered-
+    delta hits).  Half the burst's rows carry no adapter; those rows
+    are asserted bit-identical to the baseline engine's streams (the
+    id=-1 select contract), and the adapter engine must hold
+    ``compiles == {'step': 1, 'prefill': 1}`` across both bursts with
+    N distinct adapters resident in one batch — loading is a buffer
+    rewrite, never a recompile.  The miss-vs-hit split reports the
+    load-latency histogram (the miss side's cost) next to both bursts'
+    ms/token.  Composes with ``--kv-dtype`` / ``--mesh``."""
+    from paddle_tpu import telemetry
+    from paddle_tpu.serving import PagedServingEngine
+
+    plen, steps, bs = args.prompt, args.steps, args.block_size
+    slots = min(args.batch, 8)
+    per_req = -(-(plen + steps) // bs)
+    pool = args.pool_blocks or slots * per_req + 4
+    kern = {"auto": None, "on": True, "off": False}[args.paged_kernel]
+    rank = args.adapter_rank
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, args.vocab, plen).astype(np.int32)
+               for _ in range(args.batch)]
+    # every other row decodes through an adapter, round-robin over N
+    names, _j = [], 0
+    for _i in range(args.batch):
+        if _i % 2 == 0:
+            names.append(None)
+        else:
+            names.append(f"ad{_j % args.adapters}")
+            _j += 1
+
+    def artifact(tenant, name):
+        r = np.random.RandomState(7 + int(name[2:]))
+        return {"a": (r.randn(cfg.num_layers, cfg.dim, rank)
+                      .astype(np.float32) * 0.05),
+                "b": (r.randn(cfg.num_layers, rank, cfg.dim)
+                      .astype(np.float32) * 0.05),
+                "scale": 1.0, "meta": {}}
+
+    def build(adapters):
+        reg = telemetry.MetricsRegistry(
+            "lora" if adapters else "lora_base")
+        eng = PagedServingEngine(
+            cfg, params, num_slots=slots, num_blocks=pool,
+            block_size=bs, prompt_buckets=(plen,), decode_kernel=kern,
+            kv_dtype=args.kv_dtype_resolved, metrics=reg, seed=0,
+            mesh=args.mesh or None, adapters=adapters,
+            adapter_rank=rank,
+            adapter_source=artifact if adapters else None)
+        eng.submit(prompts[0][:8], max_new=2)
+        eng.run()                    # warm: compile prefill + step
+        return eng, reg
+
+    def burst(eng, with_adapters):
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new=steps,
+                           adapter=nm if with_adapters else None,
+                           tenant=None if nm is None else "bench")
+                for p, nm in zip(prompts, names)]
+        out = eng.run()
+        wall = time.perf_counter() - t0
+        return [list(map(int, out[r])) for r in rids], wall
+
+    base_eng, _ = build(None)
+    base_out, base_wall = burst(base_eng, False)
+    eng, reg = build(args.adapters)
+    miss_out, miss_wall = burst(eng, True)   # every adapter cold
+    hit_out, hit_wall = burst(eng, True)     # every adapter resident
+    assert eng.compile_counts() == {"step": 1, "prefill": 1}, \
+        f"adapter engine recompiled: {eng.compile_counts()}"
+    for outs in (miss_out, hit_out):
+        for i, toks in enumerate(outs):
+            if names[i] is None:
+                assert toks == base_out[i], \
+                    "adapter-free row diverged from the base engine"
+    assert miss_out == hit_out, \
+        "resident-hit burst diverged from the miss burst"
+    misses = int(reg.get("serving_adapter_misses_total").value(
+        tenant="bench"))
+    hits = int(reg.get("serving_adapter_hits_total").value(
+        tenant="bench"))
+    load = reg.get("serving_adapter_load_seconds").summary()
+    ttft = reg.get("serving_ttft_seconds").summary()
+    gen = max(sum(len(v) for v in hit_out), 1)
+
+    def _ms(v):
+        return round(v * 1e3, 3) if v is not None else None
+
+    return telemetry.bench_row(
+        metric=f"lm_decode d{args.dim} L{args.layers} b{args.batch} "
+               f"prompt{plen} adapters{args.adapters} r{rank}"
+               + (f" mesh{args.mesh}" if args.mesh else ""),
+        value=round(hit_wall * 1e3 / gen, 3),
+        unit="ms",                    # resident-hit ms per token
+        backend=jax.default_backend(),
+        decoder="engine",
+        compiles=eng.compile_counts(),      # {'step': 1, 'prefill': 1}
+        paged_kernel=bool(eng.decode_kernel),
+        block_size=bs,
+        pool_blocks=pool,
+        adapters=args.adapters,
+        adapter_rank=rank,
+        adapter_pool_mib=round(
+            eng.hbm_report()["adapter_pool_bytes"] / 2**20, 3),
+        adapter_hits=hits,
+        adapter_misses=misses,
+        adapter_load_ms_p50=_ms(load["p50"]),
+        adapter_load_ms_p95=_ms(load["p95"]),
+        miss_burst_ms_per_token=round(miss_wall * 1e3 / gen, 3),
+        baseline_ms_per_token=round(base_wall * 1e3 / gen, 3),
+        ttft_ms_p50=_ms(ttft["p50"]),
+        ttft_ms_p95=_ms(ttft["p95"]),
+        streams_match=True,                 # asserted above
+        tokens_per_s=round(gen / hit_wall, 1),
+        **(_mesh_extras(args, cfg) if args.mesh else {}),
+        **_kv_dtype_extras(args, cfg, params))
+
+
 def _bench_shared_prefix(args, cfg, params, jax):
     """``--shared-prefix N``: engine-level prefix-cache benchmark.
 
@@ -890,6 +1014,20 @@ def main():
                          "On CPU run under XLA_FLAGS=--xla_force_host_"
                          "platform_device_count=N; requires --paged "
                          "and num_heads divisible by N")
+    ap.add_argument("--adapters", type=int, default=0, metavar="N",
+                    help="multi-tenant LoRA row: serve the burst with "
+                         "every other request routed through one of N "
+                         "pooled adapters (serving.py adapters= knob) "
+                         "— cold-miss and resident-hit bursts next to "
+                         "an adapter-free baseline from the same "
+                         "process, one compile asserted across N "
+                         "distinct residents, adapter-free rows "
+                         "asserted bit-identical to the baseline; "
+                         "composes with --kv-dtype/--mesh; requires "
+                         "--paged")
+    ap.add_argument("--adapter-rank", type=int, default=8, metavar="R",
+                    help="LoRA rank of the pooled A/B factors (with "
+                         "--adapters)")
     ap.add_argument("--draft-layers", type=int, default=1, metavar="N",
                     help="layers kept by the truncated-layer draft "
                          "(with --spec); N == --layers is the "
@@ -991,6 +1129,18 @@ def main():
                  "--shared-prefix/--spec/--mixed-batch")
     if args.prefill_workers < 1 or args.decode_workers < 1:
         ap.error("--prefill-workers/--decode-workers must be >= 1")
+    if args.adapters:
+        if not args.paged:
+            ap.error("--adapters requires --paged (the LoRA pool lives "
+                     "in the paged serving engine)")
+        if args.adapters < 1:
+            ap.error("--adapters must be >= 1")
+        if args.adapter_rank < 1:
+            ap.error("--adapter-rank must be >= 1")
+        if (args.frontend or args.disagg or args.spec
+                or args.shared_prefix or args.mixed_batch):
+            ap.error("--adapters is its own row; drop --frontend/"
+                     "--disagg/--spec/--shared-prefix/--mixed-batch")
     if args.mesh:
         if args.mesh < 2:
             ap.error("--mesh needs N >= 2 devices (1 is the baseline "
@@ -1106,6 +1256,15 @@ def main():
                     meta=telemetry.run_meta(**rows[0]))
             for row in rows:
                 telemetry.emit_row(row)
+            return
+        if args.adapters:
+            row = _bench_adapters(args, cfg, params, jax)
+            from paddle_tpu import telemetry
+            if args.telemetry_out:
+                telemetry.append_jsonl(
+                    args.telemetry_out, telemetry.get_registry().snapshot(),
+                    meta=telemetry.run_meta(**row))
+            telemetry.emit_row(row)
             return
         if args.mesh:
             row = _bench_mesh(args, cfg, params, jax)

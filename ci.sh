@@ -121,7 +121,15 @@ echo "== telemetry gate: instrumented smoke + schema + trace + health + overhead
 # failed engine construction, overload: exactly-once terminal status,
 # retried greedy streams bit-identical to the fault-free run,
 # compiles=={'decode':1} per engine, and the fault-free single-engine
-# fast path byte-for-byte the direct engine), and re-lints the
+# fast path byte-for-byte the direct engine), runs the multi-tenant
+# adapter smoke (a mixed-tenant burst with 3 distinct LoRA adapters
+# resident in ONE batch: compiles=={'step':1,'prefill':1} — loading
+# adapters rewrites pool buffers, never recompiles — the adapter-free
+# row byte-identical to a direct pool-less engine, a 4th adapter into
+# the full pool evicting the LRU sharer-free resident with nonzero
+# serving_adapter_evictions_total, per-tenant token metering
+# populated, and the adapter pool's device refcounts reconciling with
+# the host registry after the drain), and re-lints the
 # instrumented entrypoints incl. the health-instrumented train step
 # and the fault-injection engine twin — host-callback-in-loop must
 # report zero findings.  XLA_FLAGS forces a 2-device CPU platform so

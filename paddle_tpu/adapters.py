@@ -1,0 +1,393 @@
+"""Multi-tenant LoRA adapter hosting: pool, registry, checkpoint.
+
+The device half (``ops/adapters.py``) is a paged pool of per-layer
+LoRA factor stacks the unified step gathers from by per-slot adapter
+id.  This module is the host half — pure bookkeeping in the shape the
+prefix radix cache established:
+
+* :class:`AdapterPool` owns the device :class:`~paddle_tpu.ops.adapters.
+  AdapterPoolState` and walks it through the KV pool's ownership ops —
+  ``paged_adapter_reserve`` on load (ACQUIRE), ``paged_adapter_rc_add``
+  while any engine slot references the adapter (PIN), and
+  ``paged_adapter_free`` on evict (RELEASE) — so pool-lint's five
+  ownership rules check this allocator through the same op sets that
+  guard the KV block pool.
+* :class:`AdapterRegistry` maps ``(tenant, adapter)`` keys to pool
+  slots with load/unload/pin and LRU eviction of SHARER-FREE entries
+  (pins == 0) under pressure; a fully pinned pool raises the typed
+  :class:`AdapterPoolFull` instead of evicting live weights.  Its
+  :meth:`AdapterRegistry.reconcile` feeds the registry-derived
+  expected refcounts to the ``paged_adapter_reconcile`` runtime
+  oracle — the adapter twin of ``host_state(reconcile=True)``.
+* :func:`save_adapter` / :func:`load_adapter` are the serialized
+  artifact format (flat-key ``.npz`` + JSON meta, tmp-then-rename —
+  ``training/checkpoint.py``'s discipline): the shape a trained-draft
+  style finetune job hands to serving.
+
+The serving engine (``serving.py``) drives resolve -> load-on-miss ->
+pin -> decode -> unpin; ``frontend.py`` routes requests by adapter
+with per-tenant SLO classes.  ``docs/design/serving.md`` has the full
+design.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.ops import adapters as aops
+
+__all__ = ["AdapterPool", "AdapterPoolFull", "AdapterRegistry",
+           "load_adapter", "save_adapter"]
+
+
+class AdapterPoolFull(RuntimeError):
+    """Every adapter pool slot is pinned by an active request — nothing
+    is evictable, so a new adapter cannot load until a request retires.
+    Carries ``(pool_slots, pinned)``."""
+
+    def __init__(self, pool_slots: int, pinned: int):
+        super().__init__(
+            f"adapter pool full: all {pool_slots} slots resident, "
+            f"{pinned} pinned, none evictable")
+        self.pool_slots = pool_slots
+        self.pinned = pinned
+
+
+class AdapterPool:
+    """Device adapter-pool owner: P fixed slots of per-layer LoRA A/B
+    stacks plus the refcount vector, mutated only through the
+    ``paged_adapter_*`` ownership ops.  Host mirror ``_rc`` shadows the
+    device refcounts write-for-write so free-slot search never syncs;
+    the reconcile oracle is what proves the mirror honest."""
+
+    def __init__(self, num_layers: int, pool_slots: int, dim: int,
+                 rank: int):
+        if pool_slots < 1:
+            raise ValueError(f"pool_slots must be >= 1, got {pool_slots}")
+        if rank < 0:
+            raise ValueError(f"adapter rank must be >= 0, got {rank}")
+        self.num_layers = int(num_layers)
+        self.pool_slots = int(pool_slots)
+        self.dim = int(dim)
+        self.rank = int(rank)
+        self.state = aops.paged_adapter_init(num_layers, pool_slots,
+                                             dim, rank)
+        self._rc = np.zeros((pool_slots,), np.int64)
+
+    # ------------------------------------------------------- ownership
+
+    def reserve(self) -> int:
+        """Claim the lowest free slot (refcount 0 -> 1, factors
+        zeroed).  Returns -1 when no slot is free — the caller (the
+        registry) decides between eviction and :class:`AdapterPoolFull`."""
+        free = np.nonzero(self._rc == 0)[0]
+        if free.size == 0:
+            return -1
+        slot = int(free[0])
+        st, ok = aops.paged_adapter_reserve(self.state, slot)
+        self.state = st
+        if not bool(ok):
+            raise AssertionError(
+                f"adapter slot {slot}: host mirror said free but device "
+                "refcount was live (mirror drift — run reconcile)")
+        self._rc[slot] = 1
+        return slot
+
+    def load(self, slot: int, a_stack, b_stack, scale: float) -> None:
+        """Write one adapter's factors into claimed ``slot`` (shapes
+        validated against the pool's static layout)."""
+        if len(a_stack) != self.num_layers or len(b_stack) != self.num_layers:
+            raise ValueError(
+                f"adapter has {len(a_stack)}/{len(b_stack)} A/B layers; "
+                f"pool is built for {self.num_layers}")
+        for i, (al, bl) in enumerate(zip(a_stack, b_stack)):
+            if tuple(np.shape(al)) != (self.dim, self.rank):
+                raise ValueError(
+                    f"layer {i} A shape {tuple(np.shape(al))} != pool "
+                    f"({self.dim}, {self.rank})")
+            if tuple(np.shape(bl)) != (self.rank, self.dim):
+                raise ValueError(
+                    f"layer {i} B shape {tuple(np.shape(bl))} != pool "
+                    f"({self.rank}, {self.dim})")
+        self.state = aops.paged_adapter_load(self.state, slot, a_stack,
+                                             b_stack, scale)
+
+    def pin(self, slot: int) -> None:
+        """+1 refcount: an engine slot is decoding with this adapter."""
+        st = aops.paged_adapter_rc_add(self.state, slot, 1)
+        self.state = st
+        self._rc[slot] += 1
+
+    def unpin(self, slot: int) -> None:
+        """-1 refcount at request retire."""
+        if self._rc[slot] <= 1:
+            raise AssertionError(
+                f"adapter slot {slot}: unpin below residency "
+                f"(rc mirror {int(self._rc[slot])})")
+        st = aops.paged_adapter_rc_add(self.state, slot, -1)
+        self.state = st
+        self._rc[slot] -= 1
+
+    def free(self, slot: int) -> None:
+        """Release a SHARER-FREE slot (refcount exactly 1) back to the
+        pool — the evict path."""
+        if self._rc[slot] != 1:
+            raise AssertionError(
+                f"adapter slot {slot}: free with rc mirror "
+                f"{int(self._rc[slot])} (must be exactly 1 — resident, "
+                "no pins)")
+        st = aops.paged_adapter_free(self.state, slot)
+        self.state = st
+        self._rc[slot] = 0
+
+    # ------------------------------------------------------- step feed
+
+    def device_args(self, slot_ids) -> tuple:
+        """The step's adapter argument: ``(a_stacks, b_stacks, scales,
+        ids)`` — one gatherable pytree, static shapes, so swapping
+        adapters never changes the traced signature."""
+        ids = jnp_int32(slot_ids)
+        return (self.state.a, self.state.b, self.state.scales, ids)
+
+    # ------------------------------------------------------- accounting
+
+    def refcounts(self) -> np.ndarray:
+        """Host mirror of per-slot refcounts (no device sync)."""
+        return self._rc.copy()
+
+    def free_slots(self) -> int:
+        return int(np.count_nonzero(self._rc == 0))
+
+    def pool_bytes(self) -> int:
+        return aops.paged_adapter_pool_bytes(
+            self.num_layers, self.pool_slots, self.dim, self.rank)
+
+    def reconcile(self, expected_rc: Optional[Sequence[int]] = None
+                  ) -> List[str]:
+        """Device refcounts vs an expected vector (default: the host
+        mirror).  Empty list == consistent."""
+        exp = self._rc if expected_rc is None else expected_rc
+        return aops.paged_adapter_reconcile(self.state, exp)
+
+
+def jnp_int32(x):
+    """Late-bound jnp cast so importing this module never initializes
+    a backend (the registry/checkpoint half is jax-free)."""
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(x, np.int32))
+
+
+class AdapterRegistry:
+    """Host map ``(tenant, adapter) -> pool slot`` with the prefix
+    cache's residency discipline: resolve touches LRU, load-on-miss
+    reserves (evicting the oldest SHARER-FREE entry under pressure),
+    pin/unpin guard active decode rows, unload releases sharer-free
+    entries.  ``on_evict(tenant, name, slot)`` lets the engine count
+    and trace evictions without the registry importing telemetry."""
+
+    def __init__(self, pool: AdapterPool,
+                 on_evict: Optional[Callable[[str, str, int], None]] = None):
+        self._pool = pool
+        self._on_evict = on_evict
+        # insertion/touch order IS the LRU order (prefix-cache idiom)
+        self._by_key: "OrderedDict[Tuple[str, str], int]" = OrderedDict()
+        self._by_slot: Dict[int, Tuple[str, str]] = {}
+        self._pin_count: Dict[int, int] = {}
+        self._loads = 0
+        self._evictions = 0
+
+    # ------------------------------------------------------- residency
+
+    def resolve(self, name: str, tenant: str = "default") -> Optional[int]:
+        """Resident slot for ``(tenant, name)`` or None (a miss);
+        touches LRU recency on hit."""
+        key = (str(tenant), str(name))
+        slot = self._by_key.get(key)
+        if slot is not None:
+            self._by_key.move_to_end(key)
+        return slot
+
+    def load(self, name: str, artifact, tenant: str = "default") -> int:
+        """Make ``(tenant, name)`` resident and return its slot.
+        ``artifact`` is a :func:`save_adapter` path or an in-memory
+        dict ``{"a": [...], "b": [...], "scale": float}``.  Hit: LRU
+        touch, no device writes.  Miss: reserve (evicting the LRU
+        sharer-free entry if the pool is full) and write the factors;
+        raises :class:`AdapterPoolFull` when every slot is pinned."""
+        key = (str(tenant), str(name))
+        slot = self._by_key.get(key)
+        if slot is not None:
+            self._by_key.move_to_end(key)
+            return slot
+        if isinstance(artifact, (str, os.PathLike)):
+            artifact = load_adapter(artifact)
+        slot = self._pool.reserve()
+        if slot < 0:
+            self._evict_lru()
+            slot = self._pool.reserve()
+            if slot < 0:  # pragma: no cover - _evict_lru raised already
+                raise AdapterPoolFull(self._pool.pool_slots,
+                                      sum(self._pin_count.values()))
+        self._pool.load(slot, artifact["a"], artifact["b"],
+                        float(artifact.get("scale", 1.0)))
+        self._by_key[key] = slot
+        self._by_slot[slot] = key
+        self._pin_count[slot] = 0
+        self._loads += 1
+        return slot
+
+    def _evict_lru(self) -> None:
+        """Free the least-recently-used SHARER-FREE entry; raise
+        :class:`AdapterPoolFull` when every resident adapter is pinned."""
+        for key, slot in self._by_key.items():  # oldest first
+            if self._pin_count.get(slot, 0) == 0:
+                tenant, name = key
+                self._pool.free(slot)
+                del self._by_key[key]
+                del self._by_slot[slot]
+                del self._pin_count[slot]
+                self._evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(tenant, name, slot)
+                return
+        raise AdapterPoolFull(self._pool.pool_slots,
+                              sum(self._pin_count.values()))
+
+    def unload(self, name: str, tenant: str = "default") -> bool:
+        """Explicitly release a SHARER-FREE entry.  False when absent;
+        raises when pinned (unloading live weights is always a bug)."""
+        key = (str(tenant), str(name))
+        slot = self._by_key.get(key)
+        if slot is None:
+            return False
+        if self._pin_count.get(slot, 0) > 0:
+            raise AssertionError(
+                f"adapter {key} slot {slot} has "
+                f"{self._pin_count[slot]} pinned rows; retire them "
+                "before unload")
+        self._pool.free(slot)
+        del self._by_key[key]
+        del self._by_slot[slot]
+        del self._pin_count[slot]
+        return True
+
+    # ------------------------------------------------------- pinning
+
+    def pin(self, slot: int) -> None:
+        if slot not in self._by_slot:
+            raise KeyError(f"adapter slot {slot} is not resident")
+        self._pool.pin(slot)
+        self._pin_count[slot] += 1
+
+    def unpin(self, slot: int) -> None:
+        if self._pin_count.get(slot, 0) <= 0:
+            raise AssertionError(
+                f"adapter slot {slot}: unpin without matching pin")
+        self._pool.unpin(slot)
+        self._pin_count[slot] -= 1
+
+    # ------------------------------------------------------- accounting
+
+    def resident(self) -> List[Tuple[str, str, int, int]]:
+        """``(tenant, name, slot, pins)`` rows, LRU-oldest first."""
+        return [(t, n, s, self._pin_count.get(s, 0))
+                for (t, n), s in self._by_key.items()]
+
+    def tenant_of(self, slot: int) -> Optional[str]:
+        key = self._by_slot.get(slot)
+        return key[0] if key is not None else None
+
+    def rc_expected(self) -> np.ndarray:
+        """The registry-derived refcount vector the device pool must
+        match: 0 for free slots, ``1 + pins`` for resident ones."""
+        exp = np.zeros((self._pool.pool_slots,), np.int64)
+        for slot in self._by_slot:
+            exp[slot] = 1 + self._pin_count.get(slot, 0)
+        return exp
+
+    def reconcile(self) -> List[str]:
+        """Run the adapter-pool runtime oracle against the registry's
+        OWN residency+pin view (not the pool's mirror — an honest
+        cross-check needs independent books)."""
+        return self._pool.reconcile(self.rc_expected())
+
+    def stats(self) -> dict:
+        return {
+            "resident": len(self._by_key),
+            "pool_slots": self._pool.pool_slots,
+            "pinned_rows": sum(self._pin_count.values()),
+            "loads": self._loads,
+            "evictions": self._evictions,
+        }
+
+
+# ------------------------------------------------------------ artifact
+
+_META_KEY = "meta_json"
+
+
+def save_adapter(path: str, a_stack, b_stack, scale: float = 1.0,
+                 meta: Optional[dict] = None) -> str:
+    """Serialize one LoRA adapter to ``path`` (must end ``.npz``):
+    per-layer factors under flat keys ``a/{i}`` / ``b/{i}`` (float32),
+    the scalar ``scale``, and a JSON metadata blob — the checkpoint
+    module's flat-key + tmp-then-rename discipline, sized for the
+    artifact a finetune/trained-draft job emits.  Round-trips exactly
+    through :func:`load_adapter`."""
+    if not str(path).endswith(".npz"):
+        raise ValueError(f"adapter artifact must end in .npz: {path!r}")
+    if len(a_stack) != len(b_stack):
+        raise ValueError(
+            f"A has {len(a_stack)} layers, B has {len(b_stack)}")
+    flat = {}
+    for i, (al, bl) in enumerate(zip(a_stack, b_stack)):
+        flat[f"a/{i}"] = np.asarray(al, np.float32)
+        flat[f"b/{i}"] = np.asarray(bl, np.float32)
+    flat["scale"] = np.float32(scale)
+    info = dict(meta or {})
+    info.setdefault("format", "paddle_tpu.lora.v1")
+    info["num_layers"] = len(a_stack)
+    if len(a_stack):
+        info["dim"] = int(np.shape(a_stack[0])[0])
+        info["rank"] = int(np.shape(a_stack[0])[1])
+    flat[_META_KEY] = np.frombuffer(
+        json.dumps(info, sort_keys=True).encode(), np.uint8)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    # atomic-ish write: temp file then rename (checkpoint.py pattern);
+    # suffix must end in .npz or np.savez silently writes to <tmp>.npz
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)
+    np.savez(tmp, **flat)
+    os.replace(tmp, path)
+    return path
+
+
+def load_adapter(path: str) -> dict:
+    """Read a :func:`save_adapter` artifact back into
+    ``{"a": [per-layer f32], "b": [...], "scale": float,
+    "meta": dict}`` — byte-exact factors (f32 in, f32 out)."""
+    with np.load(path) as z:
+        layers = sorted(int(k.split("/", 1)[1]) for k in z.files
+                        if k.startswith("a/"))
+        if layers != list(range(len(layers))):
+            raise ValueError(
+                f"adapter artifact {path!r} has non-contiguous layer "
+                f"keys: {layers}")
+        a = [np.asarray(z[f"a/{i}"], np.float32) for i in layers]
+        b = [np.asarray(z[f"b/{i}"], np.float32) for i in layers]
+        for i in layers:
+            if f"b/{i}" not in z.files:
+                raise ValueError(
+                    f"adapter artifact {path!r} missing b/{i}")
+        meta = {}
+        if _META_KEY in z.files:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+        return {"a": a, "b": b, "scale": float(z["scale"]),
+                "meta": meta}

@@ -47,6 +47,7 @@ from typing import Callable, Dict, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.analysis.core import LintTarget
 
@@ -424,6 +425,51 @@ def _paged_engine_step_ragged() -> LintTarget:
             7, (1,), "head-sharded KV pool (paged_cache_shardings on "
             "the cache arg); params + slot vectors replicate; exactly "
             "the attention-output all-gather in the step"))
+
+
+@register_entrypoint("paged-engine-step-lora")
+def _paged_engine_step_lora() -> LintTarget:
+    # The unified ragged step with the multi-tenant LoRA adapter pool
+    # GATHERED in: each row takes its per-slot adapter id, the step
+    # gathers that slot's A/B factors from the pooled f32 stacks and
+    # applies ``h + scale * (x @ A) @ B`` per layer.  Linting it pins
+    # the subsystem's two compiled-side contracts: the pool rides as a
+    # jit ARGUMENT (static shapes — loading/evicting adapters never
+    # recompiles, and the adapter stacks head-shard-compatibly
+    # replicate under the mp=2 recipe), and the delta path keeps f32
+    # accumulation (factors stored f32, both einsums accumulate f32,
+    # ONE cast back to the activation dtype) with id=-1 rows handed
+    # the base activations through a select.  Three distinct adapters
+    # are loaded so the gather is exercised over a mixed pool, exactly
+    # the N>=3-residents acceptance shape.
+    from paddle_tpu.serving import PagedServingEngine
+    eng = PagedServingEngine(_tiny_cfg(), _tiny_lm_params(),
+                             num_slots=2, num_blocks=8, block_size=8,
+                             prompt_buckets=(8,),
+                             adapters=3, adapter_rank=4,
+                             mesh=_mesh_or_none())
+    cfg = _tiny_cfg()
+    for i in range(3):
+        eng.load_adapter(
+            f"lint-{i}",
+            {"a": np.full((cfg.num_layers, cfg.dim, 4), 0.01 * (i + 1),
+                          np.float32),
+             "b": np.full((cfg.num_layers, 4, cfg.dim), 0.01 * (i + 1),
+                          np.float32),
+             "scale": 1.0, "meta": {}},
+            tenant=f"t{i}")
+    S, W = eng.S, eng.step_width
+    return LintTarget(
+        "paged-engine-step-lora", eng._step,
+        (eng.params, eng.cache, jnp.zeros((S, W), jnp.int32),
+         jnp.ones((S,), jnp.int32), jnp.zeros((S,), jnp.float32),
+         jnp.zeros((S,), bool), jax.random.key(0),
+         eng.adapter_step_args()),
+        recipe=_paged_mp_recipe(
+            8, (1,), "head-sharded KV pool (paged_cache_shardings on "
+            "the cache arg); params, slot vectors AND the gathered "
+            "adapter stacks replicate; exactly the attention-output "
+            "all-gather in the step"))
 
 
 @register_entrypoint("paged-engine-step-spill")

@@ -114,17 +114,23 @@ POOL_CLIENT_MODULES = (
     "paddle_tpu.serving",
     "paddle_tpu.prefix_cache",
     "paddle_tpu.speculative",
+    "paddle_tpu.adapters",
     "paddle_tpu.cluster.worker",
     "paddle_tpu.cluster.controller",
 )
 
 #: op name -> ownership kind.  Anything else spelled ``paged_*``
 #: (init, advance, concat, the attention entrypoints) is tracked as a
-#: neutral USE so the event stream stays complete.
-_ACQUIRE_OPS = {"paged_reserve", "paged_import_blocks"}
-_RELEASE_OPS = {"paged_free", "paged_rollback"}
+#: neutral USE so the event stream stays complete.  The LoRA adapter
+#: pool (``ops/adapters.py``) spells its slot ownership through the
+#: same verbs — ``paged_adapter_reserve`` / ``paged_adapter_free`` /
+#: ``paged_adapter_rc_add`` — so its clients lint under the identical
+#: acquire/release/pin discipline as the KV block pool's.
+_ACQUIRE_OPS = {"paged_reserve", "paged_import_blocks",
+                "paged_adapter_reserve"}
+_RELEASE_OPS = {"paged_free", "paged_rollback", "paged_adapter_free"}
 _SHARE_OPS = {"paged_share"}
-_PIN_OPS = {"paged_rc_add"}
+_PIN_OPS = {"paged_rc_add", "paged_adapter_rc_add"}
 _EXPORT_OPS = {"paged_export_block", "paged_export_blocks"}
 #: mutations that invalidate an already-exported payload's block-id /
 #: length description of the pool.  free/rollback are absent BY

@@ -145,6 +145,7 @@ class ClusterController:
                  decode_kernel=None, prefix_cache: bool = False,
                  kv_dtype=None, kv_pool_bytes: Optional[int] = None,
                  mesh: Optional[int] = None, mesh_axis: str = "mp",
+                 adapters: Optional[int] = None, adapter_rank: int = 8,
                  engine_max_queue: Optional[int] = None, seed: int = 0,
                  hb_interval_s: float = 0.05,
                  hb_timeout_s: float = 1.0,
@@ -192,6 +193,11 @@ class ClusterController:
             decode_kernel=decode_kernel, prefix_cache=prefix_cache,
             kv_dtype=kv_dtype, kv_pool_bytes=kv_pool_bytes,
             mesh=mesh, mesh_axis=mesh_axis,
+            # adapter pool size/rank are plain ints so they cross the
+            # process boundary as JSON; an adapter_source callable
+            # cannot — workers serve pre-loaded or submit-time
+            # adapter_id=-1 traffic only
+            adapters=adapters, adapter_rank=adapter_rank,
             max_queue=engine_max_queue)
         # the numerics policy is ambient process state
         # (core/dtypes.py) — a caller constructing the cluster under

@@ -115,16 +115,21 @@ class _FrontendRequest:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "priority",
                  "deadline_s", "deadline_at", "submitted_at", "status",
                  "reason", "tokens", "attempts", "engine", "assigned_at",
-                 "finished_at", "deadline_missed")
+                 "finished_at", "deadline_missed", "tenant", "adapter")
 
     def __init__(self, rid, prompt, max_new, temperature, priority,
-                 deadline_s):
+                 deadline_s, tenant=None, adapter=None):
         self.rid = rid
         self.prompt = prompt              # np.int32 copy: THE journal
         self.max_new = int(max_new)
         self.temperature = float(temperature)
         self.priority = int(priority)
         self.deadline_s = deadline_s
+        # tenant/adapter routing rides the journal: a replay after an
+        # engine restart re-submits with the SAME adapter, so the
+        # replacement stream is still the original's bit-identical twin
+        self.tenant = tenant
+        self.adapter = adapter
         self.submitted_at = time.perf_counter()
         self.deadline_at = (None if deadline_s is None
                             else self.submitted_at + float(deadline_s))
@@ -143,7 +148,8 @@ class _FrontendRequest:
                 "reason": self.reason, "attempts": self.attempts,
                 "priority": self.priority, "engine": self.engine,
                 "deadline_s": self.deadline_s,
-                "deadline_missed": self.deadline_missed}
+                "deadline_missed": self.deadline_missed,
+                "tenant": self.tenant, "adapter": self.adapter}
 
 
 # Seat states.  A seat is the supervisor's stable handle on "engine
@@ -157,7 +163,7 @@ class _Seat:
                  "thread", "inbox", "assigned", "wake", "crash",
                  "step_started_at", "last_beat", "restarts",
                  "restart_at", "registry", "avg_service_s",
-                 "avg_tokens", "warmed")
+                 "avg_tokens", "warmed", "adapters_seen")
 
     def __init__(self, index: int, registry):
         self.index = index
@@ -183,19 +189,34 @@ class _Seat:
         # recompiles: new jit objects) — the watchdog widens its hang
         # bound until this flips
         self.warmed = False
+        # adapter names this seat's engine has loaded (router affinity:
+        # a request for a seen adapter prefers this seat — resident-hit
+        # over a host-load miss).  Advisory only; the engine's own
+        # registry LRU may have evicted it, in which case the engine
+        # just re-loads (a miss, not an error).
+        self.adapters_seen: set = set()
 
 
 class ServingFrontend:
     """Supervise ``num_engines`` paged serving engines as ONE service.
 
     Construction mirrors :class:`~paddle_tpu.serving.PagedServingEngine`
-    (``num_slots`` .. ``prefix_cache`` and ``spec`` — a
+    (``num_slots`` .. ``prefix_cache``, ``spec`` — a
     :class:`~paddle_tpu.serving.SpecConfig` turns on speculative
-    decoding — are forwarded to every seat's engine, each built with
-    the SAME ``seed`` so a replacement engine is the journal-replay
-    twin of the one it replaces; deadline/admission prediction then
-    reads each seat's live tokens-per-step rate, see
-    :meth:`_service_estimate_locked`).  Frontend-level knobs:
+    decoding — and ``adapters``/``adapter_rank``/``adapter_source``
+    for the multi-tenant LoRA pool are forwarded to every seat's
+    engine, each built with the SAME ``seed`` so a replacement engine
+    is the journal-replay twin of the one it replaces;
+    deadline/admission prediction then reads each seat's live
+    tokens-per-step rate, see :meth:`_service_estimate_locked`).
+    Frontend-level knobs:
+
+    ``tenant_slo``
+        Per-tenant SLO classes, ``{tenant: {"priority": int,
+        "deadline_s": float}}``: submit() defaults for requests that
+        name the tenant but pass neither knob explicitly (explicit
+        values always win).  Tenants not in the map behave exactly as
+        before — priority 1, no deadline.
 
     ``max_queue``
         Bound on frontend-queued requests (``None`` = unbounded).  At
@@ -241,7 +262,10 @@ class ServingFrontend:
                  prompt_buckets=(64,), eos_id: Optional[int] = None,
                  top_k=None, top_p=None, attn_fn=None, seed: int = 0,
                  decode_kernel=None, prefix_cache: bool = False,
-                 spec=None, engine_max_queue: Optional[int] = None,
+                 spec=None, adapters: Optional[int] = None,
+                 adapter_rank: int = 8, adapter_source=None,
+                 tenant_slo=None,
+                 engine_max_queue: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  hang_timeout_s: float = 10.0,
                  first_step_grace_s: float = 120.0,
@@ -287,7 +311,15 @@ class ServingFrontend:
             prompt_buckets=prompt_buckets, eos_id=eos_id, top_k=top_k,
             top_p=top_p, attn_fn=attn_fn, seed=seed,
             decode_kernel=decode_kernel, prefix_cache=prefix_cache,
-            spec=spec, max_queue=engine_max_queue)
+            spec=spec, adapters=adapters, adapter_rank=adapter_rank,
+            adapter_source=adapter_source, max_queue=engine_max_queue)
+        self._adapters_on = adapters is not None
+        # per-tenant SLO classes: {tenant: {"priority": int,
+        # "deadline_s": float}} defaults applied at submit when the
+        # caller passes neither explicitly; unknown tenants fall back
+        # to priority 1 / no deadline, same as before
+        self._tenant_slo = {k: dict(v)
+                            for k, v in (tenant_slo or {}).items()}
 
         self._lock = threading.RLock()
         self._requests: Dict[int, _FrontendRequest] = {}   # the journal
@@ -362,8 +394,10 @@ class ServingFrontend:
     # ------------------------------------------------------------ submit
 
     def submit(self, prompt_ids, max_new: int, temperature: float = 0.0,
-               *, priority: int = 1,
-               deadline_s: Optional[float] = None) -> int:
+               *, priority: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> int:
         """Journal one request; returns its frontend rid.
 
         ``priority`` — larger is MORE important; it orders dispatch and
@@ -374,9 +408,27 @@ class ServingFrontend:
         passes.  Once dispatched to an engine a request runs to
         completion — a late finish counts a deadline miss, not a shed.
 
+        ``tenant`` names the request's SLO class: when ``priority`` /
+        ``deadline_s`` are not passed explicitly, the tenant's defaults
+        from the constructor's ``tenant_slo`` map apply (explicit
+        always wins; unknown tenants get priority 1, no deadline).
+        ``adapter`` routes the request through that LoRA adapter on
+        the engine (requires ``adapters=`` at construction); routing
+        prefers a seat that has already loaded it.  Both ride the
+        journal, so replay after an engine restart preserves them.
+
         Raises :class:`SubmitRejected` (``reason`` in
         :data:`REJECT_REASONS`) instead of queuing work it already
         knows it will drop."""
+        enforce(adapter is None or self._adapters_on,
+                "submit(adapter=%r) on a frontend built without an "
+                "adapter pool — pass adapters= at construction",
+                adapter)
+        slo = self._tenant_slo.get(tenant, {})
+        if priority is None:
+            priority = slo.get("priority", 1)
+        if deadline_s is None:
+            deadline_s = slo.get("deadline_s")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1).copy()
         n = int(prompt.shape[0])
         reason = self._size_reject(n, max_new)
@@ -422,17 +474,24 @@ class ServingFrontend:
             rid = self._next_rid
             self._next_rid += 1
             req = _FrontendRequest(rid, prompt, max_new, temperature,
-                                   priority, deadline_s)
+                                   priority, deadline_s,
+                                   tenant=tenant, adapter=adapter)
             self._requests[rid] = req
             self._queue.append(rid)
             self._m_submitted.inc()
             if est is not None:
                 self._m_predicted.observe(est)
             if self.tracer is not None:
+                extra = {}
+                if tenant is not None:
+                    extra["tenant"] = tenant
+                if adapter is not None:
+                    extra["adapter"] = adapter
                 self.tracer.instant(
                     "submit", track="frontend", rid=rid,
                     prompt_len=n, max_new=int(max_new),
-                    priority=int(priority), deadline_s=deadline_s)
+                    priority=int(priority), deadline_s=deadline_s,
+                    **extra)
             return rid
 
     def _size_reject(self, n: int, max_new: int) -> Optional[str]:
@@ -518,9 +577,15 @@ class ServingFrontend:
         return (self._predicted_wait_locked(best)
                 + self._service_estimate_locked(best, max_new))
 
-    def _route_locked(self) -> Optional[_Seat]:
+    def _route_locked(self, adapter=None) -> Optional[_Seat]:
         """Least predicted wait, ties to fewest assigned then lowest
-        index — deterministic for a deterministic submit sequence."""
+        index — deterministic for a deterministic submit sequence.
+        A request carrying an ``adapter`` prefers a seat whose engine
+        has already loaded it (``adapters_seen``) — a resident-hit
+        gather instead of a host-load miss — but only as the LEADING
+        tie-break: a cold seat with a shorter predicted wait within the
+        same affinity class still wins, and with no affine seat live
+        the request routes like any other."""
         best, key = None, None
         for seat in self._seats:
             if seat.state != _UP:
@@ -529,8 +594,10 @@ class ServingFrontend:
             if cap is not None \
                     and len(seat.assigned) >= self.num_slots + cap:
                 continue                  # would just bounce QueueFull
-            k = (self._predicted_wait_locked(seat), len(seat.assigned),
-                 seat.index)
+            affine = (0 if adapter is not None
+                      and adapter in seat.adapters_seen else 1)
+            k = (affine, self._predicted_wait_locked(seat),
+                 len(seat.assigned), seat.index)
             if key is None or k < key:
                 best, key = seat, k
         return best
@@ -559,7 +626,9 @@ class ServingFrontend:
                 for req in work:
                     try:
                         erid = eng.submit(req.prompt, req.max_new,
-                                          req.temperature)
+                                          req.temperature,
+                                          adapter=req.adapter,
+                                          tenant=req.tenant)
                     except QueueFull:
                         # backpressure, not failure: bounce it back to
                         # the frontend queue for another seat
@@ -725,6 +794,10 @@ class ServingFrontend:
         seat.crash = None
         seat.step_started_at = None
         seat.inbox.clear()
+        # the replacement engine starts with an EMPTY adapter registry
+        # — stale affinity would route misses at it as if they were
+        # hits, so the hint resets with the engine
+        seat.adapters_seen.clear()
         seat.restarts += 1
         seat.restart_at = (time.perf_counter()
                            + self._backoff(seat.restarts))
@@ -848,7 +921,7 @@ class ServingFrontend:
             woken = set()
             for rid in self._queue:
                 req = self._requests[rid]
-                seat = self._route_locked()
+                seat = self._route_locked(adapter=req.adapter)
                 if seat is None:
                     remaining.append(rid)
                     continue
@@ -856,6 +929,8 @@ class ServingFrontend:
                 req.engine = seat.index
                 req.assigned_at = now
                 seat.assigned.add(rid)
+                if req.adapter is not None:
+                    seat.adapters_seen.add(req.adapter)
                 seat.inbox.append(req)
                 woken.add(seat.index)
             self._queue = remaining

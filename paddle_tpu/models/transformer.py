@@ -151,7 +151,7 @@ class TransformerLM(Module):
         self.attn_fn = attn_fn
 
     def forward(self, ids, mask=None, caches=None, position=None,
-                pos_ids=None, cache_valid=None):
+                pos_ids=None, cache_valid=None, adapters=None):
         """``caches`` (per-layer ``(k, v)`` pairs) + ``position`` run
         the incremental-decoding form: keys/values write into the
         caches at ``position`` and ``(logits, new_caches)`` returns —
@@ -171,7 +171,17 @@ class TransformerLM(Module):
         block-pool cache form (`paddle_tpu/serving.py`).  Pass
         ``pos_ids`` (the per-slot write cursors) and any ``position``;
         the paged branch ignores ``position`` and appends at each
-        view's own lengths."""
+        view's own lengths.
+
+        ``adapters`` (decode only): the pooled-LoRA step argument
+        ``(a_stacks, b_stacks, scales, ids)`` from
+        :meth:`paddle_tpu.adapters.AdapterPool.device_args` — after
+        every block, each row's low-rank delta is gathered by its
+        pool-slot id and applied to the residual stream in f32
+        (``ops/adapters.py:adapter_delta``); ``ids == -1`` rows pass
+        through the ``where`` select bit-identical to
+        ``adapters=None``.  A pytree argument with static shapes, so
+        loading/evicting adapters never retraces."""
         cfg = self.cfg
         policy = get_policy()
         b, t = ids.shape
@@ -217,8 +227,14 @@ class TransformerLM(Module):
             block = TransformerBlock(cfg, layer_idx=i, attn_fn=attn_fn,
                                      name=f"block_{i}")
             if caches is not None:
+                x_in = x
                 x, c = block(x, mask, cache=caches[i], position=position,
                              cache_valid=cache_valid)
+                if adapters is not None:
+                    from paddle_tpu.ops.adapters import adapter_delta
+                    ad_a, ad_b, ad_scales, ad_ids = adapters
+                    x = adapter_delta(x, x_in, ad_a[i], ad_b[i],
+                                      ad_scales, ad_ids)
                 new_caches.append(c)
             elif cfg.remat and cfg.remat != "attn":
                 x = nn.remat(block, x, mask)

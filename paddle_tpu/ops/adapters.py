@@ -1,0 +1,192 @@
+"""Pooled LoRA adapter buffers: the device half of multi-tenant serving.
+
+One serving engine hosts MANY fine-tuned variants by keeping every
+resident adapter's low-rank deltas in per-layer POOLED buffers — A
+stacked ``[P, dim, r]`` and B stacked ``[P, r, dim]`` per layer, plus a
+per-slot scale vector — and letting the unified step GATHER each row's
+A/B by its per-slot adapter id.  The pool is a jit ARGUMENT with static
+shapes (``P`` pool slots, rank ``r`` fixed at engine build), so loading,
+evicting, or swapping adapters rewrites buffer contents host-side and
+never recompiles the step: ``compiles == {'step': 1, 'prefill': 1}``
+holds with any number of distinct adapters resident in one batch.
+
+The pool carries the KV block pool's ownership discipline in miniature
+(reserve on load / rc-pin while referenced / free on evict), spelled as
+``paged_adapter_*`` ops so the pool-lint family
+(``analysis/pool_rules.py``) classifies them through the same
+ACQUIRE/RELEASE/PIN sets it checks ``paged_reserve``/``paged_free``/
+``paged_rc_add`` with, and :func:`paged_adapter_reconcile` is the
+runtime oracle twin (``paged_reconcile`` for adapter slots): device
+refcounts must equal the host registry's residency + pins, named per
+slot.  The host-side pool/registry/checkpoint machinery lives in
+``paddle_tpu/adapters.py``; serving integration in ``serving.py``.
+
+Numerics contract (the ``paged-engine-step-lora`` lint twin pins it):
+A/B/scales are stored f32 and :func:`adapter_delta` accumulates the
+low-rank update in f32 — ``h + scale * (x @ A) @ B`` runs entirely in
+f32 and casts back to ``h.dtype`` once — and rows with ``adapter_id ==
+-1`` take ``h`` through a SELECT, verbatim, so adapter-free rows are
+bit-identical to an adapter-free engine.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "AdapterPoolState", "adapter_delta", "paged_adapter_init",
+    "paged_adapter_free", "paged_adapter_load", "paged_adapter_pool_bytes",
+    "paged_adapter_rc_add", "paged_adapter_reconcile",
+    "paged_adapter_reserve",
+]
+
+
+class AdapterPoolState(NamedTuple):
+    """Device-resident adapter pool (a pytree of fixed-shape arrays).
+
+    ``a`` / ``b``: per-layer tuples of pooled LoRA factors, f32
+    ``[P, dim, rank]`` / ``[P, rank, dim]``.  ``scales``: f32 ``[P]``
+    per-adapter scaling (``alpha / rank`` baked in by the loader).
+    ``refcounts``: int32 ``[P]`` — 0 free, 1 resident, 1+n while n
+    engine slots are pinned to the adapter (the eviction guard)."""
+
+    a: tuple
+    b: tuple
+    scales: jnp.ndarray
+    refcounts: jnp.ndarray
+
+    @property
+    def pool_slots(self) -> int:
+        return int(self.scales.shape[0])
+
+    @property
+    def rank(self) -> int:
+        return int(self.a[0].shape[-1])
+
+
+def paged_adapter_init(num_layers: int, pool_slots: int, dim: int,
+                       rank: int) -> AdapterPoolState:
+    """A zeroed adapter pool: every slot free, every factor 0."""
+    P = int(pool_slots)
+    a = tuple(jnp.zeros((P, dim, rank), jnp.float32)
+              for _ in range(num_layers))
+    b = tuple(jnp.zeros((P, rank, dim), jnp.float32)
+              for _ in range(num_layers))
+    return AdapterPoolState(a=a, b=b,
+                            scales=jnp.zeros((P,), jnp.float32),
+                            refcounts=jnp.zeros((P,), jnp.int32))
+
+
+def paged_adapter_pool_bytes(num_layers: int, pool_slots: int, dim: int,
+                             rank: int) -> int:
+    """HBM bytes the pool costs (f32 A+B stacks + scales + refcounts)."""
+    per_slot = num_layers * 2 * dim * rank * 4
+    return pool_slots * (per_slot + 4) + pool_slots * 4
+
+
+def paged_adapter_reserve(state: AdapterPoolState, slot):
+    """Claim pool slot ``slot`` for a fresh adapter (the ACQUIRE op):
+    refcount 0 -> 1 and the slot's factors/scale zeroed — a recycled
+    slot can never leak its previous tenant's weights.  Returns
+    ``(state, ok)``; ``ok`` is False when the slot was not free (the
+    host allocator picked a live slot — a bug, not pressure)."""
+    slot = jnp.asarray(slot, jnp.int32)
+    ok = state.refcounts[slot] == 0
+    a = tuple(al.at[slot].set(0.0) for al in state.a)
+    b = tuple(bl.at[slot].set(0.0) for bl in state.b)
+    return state._replace(
+        a=a, b=b,
+        scales=state.scales.at[slot].set(0.0),
+        refcounts=state.refcounts.at[slot].set(1)), ok
+
+
+def paged_adapter_load(state: AdapterPoolState, slot, a_stack, b_stack,
+                       scale) -> AdapterPoolState:
+    """Write one adapter's factors into a CLAIMED slot (refcount
+    untouched — reserve owns the claim, load owns the bytes).  The
+    factors are cast to the pool's f32 storage; the write is an eager
+    host-side ``.at[].set`` per layer, exactly how the spill tier
+    imports pages."""
+    slot = jnp.asarray(slot, jnp.int32)
+    a = tuple(al.at[slot].set(jnp.asarray(x, jnp.float32))
+              for al, x in zip(state.a, a_stack))
+    b = tuple(bl.at[slot].set(jnp.asarray(x, jnp.float32))
+              for bl, x in zip(state.b, b_stack))
+    return state._replace(
+        a=a, b=b,
+        scales=state.scales.at[slot].set(
+            jnp.asarray(scale, jnp.float32)))
+
+
+def paged_adapter_rc_add(state: AdapterPoolState, slot,
+                         delta) -> AdapterPoolState:
+    """Pin/unpin a resident adapter (the PIN op): ``+1`` while an
+    engine slot decodes with it, ``-1`` at retire.  A pinned adapter
+    (refcount > 1) is never evictable."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return state._replace(
+        refcounts=state.refcounts.at[slot].add(
+            jnp.asarray(delta, jnp.int32)))
+
+
+def paged_adapter_free(state: AdapterPoolState, slot) -> AdapterPoolState:
+    """Release a slot back to the pool (the RELEASE op): refcount to 0.
+    Factors stay until the next reserve zeroes them (claim-time
+    zeroing, the KV pool's scale discipline)."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return state._replace(refcounts=state.refcounts.at[slot].set(0))
+
+
+def adapter_delta(h, x_in, a, b, scales, ids):
+    """The gathered batched low-rank update, one layer:
+    ``h + scale * (x_in @ A_id) @ B_id`` in f32, SELECTED per row.
+
+    ``h`` / ``x_in``: ``[B, T, dim]`` block output / block input (the
+    parallel-adapter form on the residual stream).  ``a`` / ``b``: the
+    layer's pooled stacks ``[P, dim, r]`` / ``[P, r, dim]``; ``ids``:
+    int32 ``[B]`` pool-slot ids, ``-1`` = no adapter.  The id is
+    CLIPPED for the gather (the -1 sentinel reads slot 0's bytes, whose
+    values are discarded) and the final ``where`` hands ``-1`` rows
+    ``h`` verbatim — bit-identical to never running the adapter path.
+    Everything between the casts is f32: gathering f32 factors, both
+    einsums accumulate f32, and the sum casts back to ``h.dtype``
+    exactly once (the accum-dtype contract the lora lint twin pins)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    idx = jnp.clip(ids, 0, a.shape[0] - 1)
+    ga = jnp.take(a, idx, axis=0)                 # [B, dim, r] f32
+    gb = jnp.take(b, idx, axis=0)                 # [B, r, dim] f32
+    gs = jnp.take(scales, idx, axis=0)            # [B] f32
+    xf = x_in.astype(jnp.float32)
+    low = jnp.einsum("btd,bdr->btr", xf, ga)
+    delta = jnp.einsum("btr,brd->btd", low, gb)
+    out = (h.astype(jnp.float32)
+           + gs[:, None, None] * delta).astype(h.dtype)
+    return jnp.where((ids >= 0)[:, None, None], out, h)
+
+
+def paged_adapter_reconcile(state: AdapterPoolState,
+                            expected_rc: Sequence[int]) -> list:
+    """Runtime reconciliation oracle (the ``paged_reconcile`` twin for
+    the adapter pool): device refcounts must equal the host registry's
+    view — ``expected_rc[p]`` is 0 for a free slot, ``1 + pins`` for a
+    resident one.  Returns human-readable problem strings naming the
+    exact slot (empty == consistent).  Host-side numpy read (device
+    sync), so callers expose it opt-in exactly like the KV oracle."""
+    rc = np.asarray(state.refcounts)
+    exp = np.asarray(expected_rc, np.int64)
+    problems: list = []
+    if exp.shape != rc.shape:
+        return [f"adapter pool: expected-rc vector shape {exp.shape} "
+                f"!= pool slots {rc.shape}"]
+    for p in np.nonzero(rc != exp)[0]:
+        problems.append(
+            f"adapter slot {int(p)}: device refcount {int(rc[p])} != "
+            f"registry residency+pins {int(exp[p])}")
+    for p in np.nonzero(rc < 0)[0]:
+        problems.append(
+            f"adapter slot {int(p)}: negative refcount {int(rc[p])} "
+            "(over-released)")
+    return problems

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.adapters import AdapterPoolFull
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.core.dtypes import get_policy
 from paddle_tpu.models.transformer import (TransformerConfig,
@@ -95,9 +96,10 @@ def _paged_model(cfg: TransformerConfig, attn_fn):
         from paddle_tpu.ops.attention import flash_attention_fn
         attn_fn = flash_attention_fn
     return nn.transform(
-        lambda ids, views, pos_ids:
+        lambda ids, views, pos_ids, adapters=None:
             TransformerLM(cfg, attn_fn=attn_fn, name="lm")(
-                ids, caches=views, position=0, pos_ids=pos_ids))
+                ids, caches=views, position=0, pos_ids=pos_ids,
+                adapters=adapters))
 
 
 def _resolve_mesh(mesh, mesh_axis: str):
@@ -460,10 +462,11 @@ def kv_parity_probe(cfg: TransformerConfig, params, prompts, *,
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "tokens",
                  "blocks_reserved", "submitted_at", "first_token_at",
-                 "prefix_hit_tokens", "prefix_nodes", "handoff")
+                 "prefix_hit_tokens", "prefix_nodes", "handoff",
+                 "adapter", "tenant", "adapter_slot")
 
     def __init__(self, rid, prompt, max_new, temperature, blocks,
-                 handoff=None):
+                 handoff=None, adapter=None, tenant=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -475,6 +478,9 @@ class _Request:
         self.prefix_hit_tokens = 0        # prompt tokens NOT prefilled
         self.prefix_nodes = ()            # registry nodes this rid shares
         self.handoff = handoff            # imported-KV payload or None
+        self.adapter = adapter            # adapter name or None (base)
+        self.tenant = tenant              # tenant id or None (default)
+        self.adapter_slot = -1            # resolved pool slot at admit
 
 
 class _HandoffHit:
@@ -612,7 +618,9 @@ class PagedServingEngine:
                  unified_step: bool = True, kv_dtype=None,
                  kv_pool_bytes: Optional[int] = None, mesh=None,
                  mesh_axis: str = "mp",
-                 prefix_host_bytes: Optional[int] = None):
+                 prefix_host_bytes: Optional[int] = None,
+                 adapters: Optional[int] = None,
+                 adapter_rank: int = 8, adapter_source=None):
         self.cfg = cfg
         self.params = params
         self.S = num_slots
@@ -707,6 +715,49 @@ class PagedServingEngine:
                 prefix_host_bytes)
         self._host_store = (HostPrefixStore(int(prefix_host_bytes))
                             if sharing and prefix_host_bytes else None)
+        # Multi-tenant LoRA: ``adapters=P`` attaches a P-slot pooled
+        # adapter buffer (paddle_tpu/adapters.py) whose per-layer A/B
+        # stacks ride the unified step as ONE extra pytree argument —
+        # static shapes, so loading/evicting adapters never retraces
+        # and ``compiles == {'step': 1, 'prefill': 1}`` holds with any
+        # number of distinct adapters resident in a batch.  Rows with
+        # no adapter (slot id -1) pass the delta's where-select
+        # verbatim: bit-identical to an adapter-free engine.
+        # ``adapter_source(tenant, name)`` supplies a save_adapter path
+        # or factor dict on a registry miss (the load-from-host path
+        # the miss-latency histogram times).
+        enforce(adapters is None or int(adapters) >= 1,
+                "adapters must be None (off) or >= 1 pool slots, "
+                "got %s", adapters)
+        enforce(adapters is None or int(adapter_rank) >= 0,
+                "adapter_rank must be >= 0, got %s", adapter_rank)
+        enforce(adapter_source is None or adapters is not None,
+                "adapter_source requires adapters=N")
+        enforce(adapters is None or bool(unified_step),
+                "adapters need the unified step (the gathered-delta "
+                "path is only traced there): unified_step=True")
+        # A cached prefix's KV at layers >= 1 embeds the deltas of
+        # whatever adapter computed it — sharing those blocks with a
+        # request running a DIFFERENT adapter would replay the wrong
+        # tenant's activations, so the two features are mutually
+        # exclusive until the registry keys by adapter.
+        enforce(adapters is None or not sharing,
+                "adapters + prefix_cache: cached prefix KV embeds the "
+                "computing adapter's deltas and cannot be shared "
+                "across adapters — build with prefix_cache=False")
+        self._apool = None
+        self._adapters = None
+        self._adapter_source = adapter_source
+        self.adapter_rank = int(adapter_rank) if adapters else None
+        if adapters is not None:
+            from paddle_tpu.adapters import AdapterPool, AdapterRegistry
+            self._apool = AdapterPool(cfg.num_layers, int(adapters),
+                                      cfg.dim, int(adapter_rank))
+            self._adapters = AdapterRegistry(
+                self._apool, on_evict=self._note_adapter_evict)
+            #: per-engine-slot adapter pool-slot ids (-1 = no adapter)
+            #: — the host mirror the step's gather ids are built from
+            self._adapter_slots = np.full((S,), -1, np.int32)
 
         def _pin(c):
             # every traced fn returns its cache through this: the
@@ -843,7 +894,8 @@ class PagedServingEngine:
         #: prefill compiles in unified mode)
         self._prefill_width = max(self.buckets)
 
-        def step_fn(params, cache, toks, qlens, temps, done, key):
+        def step_fn(params, cache, toks, qlens, temps, done, key,
+                    ad=None):
             # THE unified ragged step: every live slot appends and
             # scores ``qlens[s]`` fresh tokens (0 = idle this call)
             # through ONE compiled program — a plain-decode row is a
@@ -856,6 +908,11 @@ class PagedServingEngine:
             # attached — the restricted/tempered per-column target
             # distributions rejection sampling consumes.  Idle and pad
             # lanes compute don't-care values the host never reads.
+            # ``ad`` (adapter engines only): the pooled-LoRA argument
+            # ``(a_stacks, b_stacks, scales, ids[S])`` — each row's
+            # low-rank delta gathers by its pool-slot id inside the
+            # model (f32 accum, id=-1 rows select through verbatim);
+            # ``None`` traces the byte-identical adapter-free program.
             W = self.step_width
             with paged.decode_kernel_scope(use_kernel), \
                     paged.kernel_fallback_scope(
@@ -873,7 +930,7 @@ class PagedServingEngine:
                 pos_ids = (cache.lengths[:, None]
                            + jnp.arange(W)[None, :])
                 (lg, views), _ = model.apply(params, {}, None, toks,
-                                             views, pos_ids)
+                                             views, pos_ids, ad)
                 cache = paged.paged_advance(
                     paged.merge_views(cache, views), qlens)
                 lf = lg.astype(jnp.float32)               # [S, W, V]
@@ -895,7 +952,7 @@ class PagedServingEngine:
                 return _pin(cache), nxt, done, greedy, ok
 
         def prefill_ragged_fn(params, cache, slot, toks, tlen, temp,
-                              key):
+                              key, ad=None):
             # ONE ragged prefill program for fresh prompts AND
             # prefix-hit tails: append ``tlen`` tokens to ``slot`` at
             # its current committed base (0 for a fresh slot,
@@ -918,8 +975,13 @@ class PagedServingEngine:
                                                   tlen[None])
                 w = toks.shape[1]
                 pos_ids = (off + jnp.arange(w))[None, :]
+                if ad is not None:
+                    # prefill runs ONE slot: gather that row's id from
+                    # the [S] vector in-graph so the program stays
+                    # slot-agnostic (one compile for every slot)
+                    ad = (ad[0], ad[1], ad[2], ad[3][slot][None])
                 (lg, views), _ = model.apply(params, {}, None, toks,
-                                             views, pos_ids)
+                                             views, pos_ids, ad)
                 cache = paged.paged_advance(
                     paged.merge_views(cache, views), want)
                 last = jax.lax.dynamic_index_in_dim(lg[0], tlen - 1,
@@ -1278,6 +1340,46 @@ class PagedServingEngine:
                  "payload instead of prefilling the prompt "
                  "(submit_handoff — the disaggregated decode role's "
                  "input)")
+        if self._apool is not None:
+            self._m_adapter_resident = m.gauge(
+                "serving_adapter_resident",
+                help="adapters resident in the pooled A/B buffers, "
+                     "sampled per step (pool capacity: the adapters= "
+                     "knob; evictions keep this <= capacity)")
+            self._m_adapter_evictions = m.counter(
+                "serving_adapter_evictions_total",
+                help="LRU sharer-free adapters evicted from the pool "
+                     "under load pressure, by tenant= (a pinned "
+                     "adapter — any active row decoding with it — is "
+                     "never a victim)")
+            self._m_adapter_loads = m.counter(
+                "serving_adapter_loads_total",
+                help="adapter factor loads written into pool slots, by"
+                     " tenant= (warm load_adapter() calls plus "
+                     "admission misses)")
+            self._m_adapter_hits = m.counter(
+                "serving_adapter_hits_total",
+                help="admissions whose adapter was already resident, "
+                     "by tenant= (no host->device factor traffic)")
+            self._m_adapter_misses = m.counter(
+                "serving_adapter_misses_total",
+                help="admissions that loaded their adapter from "
+                     "adapter_source, by tenant= — each observes "
+                     "serving_adapter_load_seconds")
+            self._m_adapter_load_s = m.histogram(
+                "serving_adapter_load_seconds",
+                help="wall time to make a missing adapter resident "
+                     "(artifact read + factor device writes) — the "
+                     "miss-vs-hit latency split's miss side; resident "
+                     "hits never observe here",
+                buckets=(.0005, .001, .0025, .005, .01, .025, .05,
+                         .1, .25, .5, 1.0))
+            self._m_adapter_tokens = m.counter(
+                "serving_adapter_tokens_total",
+                help="generated tokens retired per tenant= (adapter "
+                     "and base requests both count; base rows without "
+                     "a tenant land on tenant=\"default\") — the "
+                     "per-tenant usage-metering feed")
         if spec is not None:
             self._m_spec_drafted = m.counter(
                 "serving_spec_draft_tokens_total",
@@ -1370,10 +1472,21 @@ class PagedServingEngine:
     # ---------------------------------------------------------- host API
 
     def submit(self, prompt_ids, max_new: int,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0, *, adapter=None,
+               tenant=None) -> int:
         """Queue one request; returns its id.  ``prompt_ids``: 1-D int
         sequence.  Capacity contract is loud: the prompt must fit a
-        bucket and ``prompt + max_new`` the per-slot capacity."""
+        bucket and ``prompt + max_new`` the per-slot capacity.
+
+        ``adapter=``/``tenant=`` (adapter engines): decode this
+        request under ``(tenant, adapter)``'s pooled LoRA delta —
+        resolved (loading through ``adapter_source`` on a miss) and
+        pinned at admission, unpinned at retire.  ``adapter=None``
+        rides the slot-id -1 fast path: bit-identical to an engine
+        built without adapters."""
+        enforce(adapter is None or self._apool is not None,
+                "submit: adapter=%r but the engine was built without "
+                "an adapter pool (pass adapters=N)", adapter)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         n = prompt.shape[0]
         enforce(n >= 1, "submit: empty prompt")
@@ -1402,13 +1515,19 @@ class PagedServingEngine:
             raise QueueFull(len(self._queue), self.max_queue)
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, prompt, max_new, float(temperature), blocks)
+        req = _Request(rid, prompt, max_new, float(temperature), blocks,
+                       adapter=adapter, tenant=tenant)
         self._queue.append(req)
         self._m_submitted.inc()
         if self.tracer is not None:
+            extra = {}
+            if adapter is not None:
+                extra["adapter"] = str(adapter)
+            if tenant is not None:
+                extra["tenant"] = str(tenant)
             self.tracer.instant("submit", track="host", rid=rid,
                                 ts=req.submitted_at, prompt_len=int(n),
-                                max_new=int(max_new))
+                                max_new=int(max_new), **extra)
         return rid
 
     def prefill_to_handoff(self, prompt_ids,
@@ -1451,7 +1570,7 @@ class PagedServingEngine:
         self.cache, _tok0, _done0, ok = self._prefill(
             self.params, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(padded), jnp.asarray(n, jnp.int32),
-            float(temperature), self._split())
+            float(temperature), self._split(), *self._ad_extra())
         assert bool(ok), "paged pool exhausted despite handoff " \
                          "accounting (engine bug)"
         payload = paged.paged_export_blocks(self.cache, slot)
@@ -1554,6 +1673,97 @@ class PagedServingEngine:
         the operator's most recent probe found."""
         self._m_kv_div.set(float(value), dtype=self.kv_dtype.name)
 
+    # ------------------------------------------------------- adapters
+
+    def _note_adapter_evict(self, tenant: str, name: str, slot: int):
+        """Registry eviction observer: an LRU sharer-free adapter left
+        the pool under load pressure.  Host-side, after the eager
+        ``paged_adapter_free`` returned — never inside a traced step."""
+        self._m_adapter_evictions.inc(tenant=tenant)
+        if self.tracer is not None:
+            self.tracer.instant("adapter_evict", track="host",
+                                tenant=tenant, adapter=name,
+                                pool_slot=int(slot))
+
+    def load_adapter(self, name: str, artifact,
+                     tenant: str = "default") -> int:
+        """Make adapter ``(tenant, name)`` resident ahead of traffic
+        (the warm path — admission misses route through
+        ``adapter_source`` instead).  ``artifact``: a
+        :func:`paddle_tpu.adapters.save_adapter` path or an in-memory
+        ``{"a": [...], "b": [...], "scale": float}``.  Returns the
+        pool slot; raises ``AdapterPoolFull`` when every slot is
+        pinned by active requests."""
+        enforce(self._apool is not None,
+                "load_adapter: engine built without adapters "
+                "(pass adapters=N)")
+        t0 = time.perf_counter()
+        slot = self._adapters.load(name, artifact, tenant=tenant)
+        self._m_adapter_loads.inc(tenant=str(tenant))
+        self._m_adapter_load_s.observe(time.perf_counter() - t0)
+        if self.tracer is not None:
+            self.tracer.instant("adapter_load", track="host",
+                                tenant=str(tenant), adapter=str(name),
+                                pool_slot=int(slot))
+        return slot
+
+    def unload_adapter(self, name: str, tenant: str = "default") -> bool:
+        """Explicitly release a sharer-free resident adapter."""
+        enforce(self._apool is not None,
+                "unload_adapter: engine built without adapters")
+        return self._adapters.unload(name, tenant=tenant)
+
+    def adapter_step_args(self):
+        """The unified step's adapter argument for the CURRENT slot
+        map: ``(a_stacks, b_stacks, scales, ids[S])`` — what the
+        decode/prefill dispatches (and the ``paged-engine-step-lora``
+        lint entrypoint) pass as the step's last parameter."""
+        enforce(self._apool is not None,
+                "adapter_step_args: engine built without adapters")
+        return self._apool.device_args(self._adapter_slots)
+
+    def _ad_extra(self) -> tuple:
+        """``(adapter_arg,)`` for adapter engines, ``()`` otherwise —
+        splatted onto every unified step/prefill dispatch so the
+        non-adapter call sites stay byte-identical."""
+        if self._apool is None:
+            return ()
+        return (self._apool.device_args(self._adapter_slots),)
+
+    def _acquire_adapter(self, req) -> int:
+        """Admission-side adapter residency: resolve ``(tenant,
+        adapter)`` to a pool slot — loading through ``adapter_source``
+        on a miss (the timed load-from-host path) — and PIN it for the
+        request's lifetime.  Raises ``AdapterPoolFull`` when the pool
+        is resident-full and fully pinned (the caller rejects the
+        admission like pool pressure, without dequeuing)."""
+        tenant = req.tenant if req.tenant is not None else "default"
+        t0 = time.perf_counter()
+        slot = self._adapters.resolve(req.adapter, tenant=tenant)
+        if slot is None:
+            enforce(self._adapter_source is not None,
+                    "adapter %r (tenant %r) is not resident and the "
+                    "engine has no adapter_source to load it from — "
+                    "load_adapter() it first or attach a source",
+                    req.adapter, tenant)
+            artifact = self._adapter_source(tenant, req.adapter)
+            slot = self._adapters.load(req.adapter, artifact,
+                                       tenant=tenant)
+            dt = time.perf_counter() - t0
+            self._m_adapter_misses.inc(tenant=tenant)
+            self._m_adapter_loads.inc(tenant=tenant)
+            self._m_adapter_load_s.observe(dt)
+            if self.tracer is not None:
+                self.tracer.instant("adapter_load", track="host",
+                                    tenant=tenant,
+                                    adapter=str(req.adapter),
+                                    pool_slot=int(slot), rid=req.rid,
+                                    load_s=dt)
+        else:
+            self._m_adapter_hits.inc(tenant=tenant)
+        self._adapters.pin(slot)
+        return slot
+
     def _admit(self):
         """Prefill queued requests into free slots while the pool's
         worst-case accounting allows — called before every decode step,
@@ -1633,6 +1843,26 @@ class PagedServingEngine:
                                         rid=req.rid,
                                         queued=len(self._queue))
                 return                    # pool cannot take it yet
+            ad_slot = -1
+            if self._apool is not None and req.adapter is not None:
+                try:
+                    ad_slot = self._acquire_adapter(req)
+                except AdapterPoolFull:
+                    # every adapter slot is pinned by an active
+                    # request: block admission (request stays queued)
+                    # exactly like KV-pool pressure — a retire will
+                    # unpin and the next _admit proceeds
+                    if hit is not None:
+                        for nd in hit.nodes:
+                            nd.sharers.discard(req.rid)
+                    self._m_rejects.inc(reason="adapter_pool")
+                    if self.tracer is not None:
+                        self.tracer.instant("admission_blocked",
+                                            track="host",
+                                            reason="adapter_pool",
+                                            rid=req.rid,
+                                            queued=len(self._queue))
+                    return
             if self._faults is not None:
                 try:
                     # fires once per request actually reaching its
@@ -1644,9 +1874,17 @@ class PagedServingEngine:
                     if hit is not None:
                         for nd in hit.nodes:
                             nd.sharers.discard(req.rid)
+                    if ad_slot >= 0:
+                        # the pin was the only state moved so far
+                        self._adapters.unpin(ad_slot)
                     raise
             self._queue.popleft()
             req.blocks_reserved = need
+            if self._apool is not None:
+                # slot-map mirror BEFORE the prefill dispatch: the
+                # prompt's own logits must run under its adapter
+                req.adapter_slot = ad_slot
+                self._adapter_slots[slot] = ad_slot
             t_admit = time.perf_counter()
             self._m_queue_wait.observe(t_admit - req.submitted_at)
             if self.tracer is not None:
@@ -1676,7 +1914,7 @@ class PagedServingEngine:
                     self.params, self.cache,
                     jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
                     jnp.asarray(req.prompt.shape[0], jnp.int32),
-                    req.temperature, self._split())
+                    req.temperature, self._split(), *self._ad_extra())
                 ptoks = int(req.prompt.shape[0])
             assert bool(ok), "paged pool exhausted despite admission " \
                              "accounting (engine bug)"
@@ -1752,7 +1990,7 @@ class PagedServingEngine:
         self.cache, tok0, done0, ok = tail_prog(
             self.params, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(padded), jnp.asarray(tlen, jnp.int32),
-            req.temperature, self._split())
+            req.temperature, self._split(), *self._ad_extra())
         req.prefix_hit_tokens = new_len
         if self.tracer is not None:
             self.tracer.instant("prefix_hit", track=f"slot{slot}",
@@ -1848,7 +2086,7 @@ class PagedServingEngine:
         self.cache, tok0, done0, ok = tail_prog(
             self.params, self.cache, jnp.asarray(slot, jnp.int32),
             jnp.asarray(padded), jnp.asarray(tlen, jnp.int32),
-            req.temperature, self._split())
+            req.temperature, self._split(), *self._ad_extra())
         req.prefix_hit_tokens = new_len
         req.handoff = None                # pages are resident: drop the
         self._m_handoff_import.inc()      # payload's host copy
@@ -1985,6 +2223,15 @@ class PagedServingEngine:
         self.cache = self._free(
             self.cache, jnp.asarray(np.arange(self.S) == slot))
         self._reserved -= req.blocks_reserved
+        if self._apool is not None:
+            # unpin BEFORE clearing the slot map: a queued adapter
+            # blocked on adapter_pool pressure can admit this _admit
+            if req.adapter_slot >= 0:
+                self._adapters.unpin(req.adapter_slot)
+            self._adapter_slots[slot] = -1
+            self._m_adapter_tokens.inc(
+                n, tenant=str(req.tenant if req.tenant is not None
+                              else "default"))
         if self._prefix is not None:
             # the registry pins keep this request's registered blocks
             # resident; only the live-sharer marks (eviction guards)
@@ -2018,6 +2265,9 @@ class PagedServingEngine:
         self._m_slots_g.set(len(active))
         for fn, n in self._compile_watch.counts().items():
             self._m_compiles.set(n, fn=fn)
+        if self._apool is not None:
+            self._m_adapter_resident.set(
+                self._adapters.stats()["resident"])
         if self._prefix is not None:
             st = self._prefix.stats()
             self._m_prefix_pinned.set(st["pinned_blocks"])
@@ -2083,7 +2333,7 @@ class PagedServingEngine:
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.asarray(active.astype(np.int32)),
                 jnp.asarray(self._temps), jnp.asarray(self._done),
-                self._split())
+                self._split(), *self._ad_extra())
             if self.spec is not None:
                 self.cache, nxt, done, _greedy, _probs, ok = out
             else:
@@ -2181,7 +2431,7 @@ class PagedServingEngine:
             self.cache, _nxt, _done, greedy, probs, vok = self._step(
                 self.params, self.cache, jnp.asarray(toks),
                 jnp.asarray(valid), temps, jnp.asarray(self._done),
-                self._split())
+                self._split(), *self._ad_extra())
         else:
             self.cache, greedy, probs, vok = self._verify(
                 self.params, self.cache, jnp.asarray(toks),
@@ -2323,6 +2573,11 @@ class PagedServingEngine:
             if self.spec is not None:
                 problems += [f"draft: {p}" for p in
                              paged.paged_reconcile(self.dcache)]
+            if self._apool is not None:
+                # the adapter pool's oracle twin rides the same key so
+                # one reconcile gate covers every refcounted pool
+                problems += [f"adapter: {p}" for p in
+                             self._adapters.reconcile()]
             state["pool_reconcile"] = {"ok": not problems,
                                        "problems": problems}
         return state
@@ -2366,6 +2621,11 @@ class PagedServingEngine:
             # and how long it took — None before the first step
             "last_step_wall": self._last_step_wall,
             "last_step_seconds": self._last_step_seconds,
+            "adapters": (None if self._apool is None else {
+                **self._adapters.stats(),
+                "rank": self.adapter_rank,
+                "slot_map": [int(x) for x in self._adapter_slots],
+            }),
             "pool_blocks": self.nb,
             "block_size": self.bs,
             "num_slots": self.S,
@@ -2471,6 +2731,11 @@ class PagedServingEngine:
             "prefix_host_budget_bytes": (
                 0 if self._host_store is None
                 else self._host_store.max_bytes),
+            # the pooled LoRA buffers' rent: f32 A/B stacks for every
+            # pool slot, resident for the engine's lifetime (replicated
+            # across the mesh, so per-chip == total)
+            "adapter_pool_bytes": (0 if self._apool is None
+                                   else self._apool.pool_bytes()),
         }
 
     def stats(self):
@@ -2494,6 +2759,8 @@ class PagedServingEngine:
                 "compiles": self.compile_counts(),
                 "occupancy": self.occupancy(),
                 "spec": spec_stats,
+                "adapters": (None if self._apool is None
+                             else self._adapters.stats()),
                 "latency": {
                     "queue_wait_s": self._m_queue_wait.summary(),
                     "ttft_s": self._m_ttft.summary(),

@@ -31,7 +31,9 @@ def registry_pins(eng):
 def assert_refcounts_exact(eng):
     """Device refcounts == slot mappings + registry pins, everywhere,
     via ``paged_reconcile``; plus the host-side ledger invariants
-    (registry pin total mirrors ``_pinned``, ledger within the pool)."""
+    (registry pin total mirrors ``_pinned``, ledger within the pool).
+    On an engine with a LoRA adapter pool the adapter oracle runs too
+    — ONE helper covers both pools, zero-baseline, no suppressions."""
     pins = registry_pins(eng) if eng._prefix is not None else None
     problems = paged.paged_reconcile(eng.cache, pins=pins)
     assert not problems, "\n".join(problems)
@@ -41,6 +43,25 @@ def assert_refcounts_exact(eng):
             f"{eng._pinned}")
     assert eng._reserved + eng._pinned <= eng.nb, (
         "ledger must stay within the pool")
+    if getattr(eng, "_apool", None) is not None:
+        assert_adapter_refcounts_exact(eng)
+
+
+def assert_adapter_refcounts_exact(eng):
+    """Adapter-pool twin of :func:`assert_refcounts_exact`: device
+    slot refcounts == the host registry's residency + pins
+    (``paged_adapter_reconcile`` through the registry's expected-rc
+    vector), host rc mirror consistent, no engine slot mapped to a
+    free adapter slot."""
+    problems = eng._adapters.reconcile()
+    assert not problems, "\n".join(problems)
+    rc = eng._apool.refcounts()
+    exp = eng._adapters.rc_expected()
+    assert np.array_equal(rc, exp), f"device rc {rc} != registry {exp}"
+    for s, ad in enumerate(eng._adapter_slots):
+        assert ad < 0 or rc[ad] >= 1, (
+            f"engine slot {s} maps adapter slot {int(ad)} with "
+            f"refcount {int(rc[ad])} (use-after-free)")
 
 
 def assert_tiers_reconcile(eng):

@@ -1,0 +1,455 @@
+"""Multi-tenant LoRA serving: the paged adapter pool + gathered deltas.
+
+The load-bearing pins:
+
+* ONE program, many tenants: ``compiles == {'step': 1, 'prefill': 1}``
+  with 3+ DISTINCT adapters resident in one batch — the pool is a jit
+  argument with static shapes, so loading/evicting adapters rewrites
+  buffer contents and never recompiles;
+* the id=-1 select contract: rows without an adapter are BIT-IDENTICAL
+  to an adapter-free engine (the delta path hands them ``h`` through a
+  ``where``, verbatim);
+* the zero/identity contracts: rank-0 and zero-init-B adapters produce
+  greedy streams identical to the base model across
+  {bf16, int8} x {kernel on/off} x {mesh off, 2} — the f32-accum
+  gathered delta adds exactly nothing when the factors say nothing;
+* batched isolation: two distinct adapters in one batch produce each
+  adapter's SOLO stream exactly (no cross-row factor bleed through the
+  gather);
+* pool discipline: the KV block pool's reserve/rc-pin/LRU-evict rules
+  on adapter slots, verified by the same two-sided stack — pool-lint
+  statically (``paddle_tpu.adapters`` is a registered client) and
+  ``paged_adapter_reconcile`` at runtime (helpers_pool drives it);
+* the checkpoint format round-trips byte-exactly (the trained-draft
+  artifact shape: flat-key npz, tmp-then-rename).
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry
+from paddle_tpu.adapters import (AdapterPool, AdapterPoolFull,
+                                 AdapterRegistry, load_adapter,
+                                 save_adapter)
+from paddle_tpu.core.errors import EnforceError
+from paddle_tpu.frontend import ServingFrontend
+from paddle_tpu.models.transformer import TransformerConfig, TransformerLM
+from paddle_tpu.ops import adapters as aops
+from paddle_tpu.serving import PagedServingEngine
+from paddle_tpu.testing.faults import Fault, FaultInjector, FaultSchedule
+
+from helpers_pool import (assert_adapter_refcounts_exact,
+                          assert_refcounts_exact)
+
+CFG = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                        num_layers=2, ffn_mult=2, max_len=24)
+
+ENGINE_KW = dict(num_slots=4, num_blocks=24, block_size=4,
+                 prompt_buckets=(8,), seed=0)
+
+PROMPT = np.arange(1, 8, dtype=np.int32)
+MAX_NEW = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = nn.transform(lambda ids: TransformerLM(CFG, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+# 1-layer twin for the 8-cell identity matrix: the per-cell cost is
+# jit compiles, and identity is a per-layer property — the 2-layer
+# stacking coverage rides the mixed-batch/eviction tests above.
+CFG1 = TransformerConfig(vocab_size=31, dim=16, num_heads=2,
+                         num_layers=1, ffn_mult=2, max_len=24)
+
+
+@pytest.fixture(scope="module")
+def params1():
+    model = nn.transform(lambda ids: TransformerLM(CFG1, name="lm")(ids))
+    p, _ = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    return p
+
+
+def mk_artifact(seed, rank=2, zero_b=False, mag=0.5, cfg=CFG):
+    r = np.random.RandomState(seed)
+    a = r.randn(cfg.num_layers, cfg.dim, rank).astype(np.float32) * mag
+    b = (np.zeros((cfg.num_layers, rank, cfg.dim), np.float32)
+         if zero_b else
+         r.randn(cfg.num_layers, rank, cfg.dim).astype(np.float32) * mag)
+    return {"a": a, "b": b, "scale": 1.0, "meta": {}}
+
+
+def source_of(arts):
+    def source(tenant, name):
+        return arts[name]
+    return source
+
+
+def greedy(eng, prompt=PROMPT, max_new=MAX_NEW, **kw):
+    rid = eng.submit(prompt, max_new, **kw)
+    return list(map(int, eng.run()[rid]))
+
+
+# ------------------------------------------------------------ ops units
+
+
+def test_adapter_delta_id_minus1_is_verbatim():
+    r = np.random.RandomState(0)
+    h = jnp.asarray(r.randn(2, 3, CFG.dim), jnp.bfloat16)
+    x = jnp.asarray(r.randn(2, 3, CFG.dim), jnp.bfloat16)
+    a = jnp.asarray(r.randn(4, CFG.dim, 2), jnp.float32)
+    b = jnp.asarray(r.randn(4, 2, CFG.dim), jnp.float32)
+    s = jnp.ones((4,), jnp.float32)
+    out = aops.adapter_delta(h, x, a, b, s, jnp.asarray([-1, 1]))
+    # row 0 (no adapter) is h VERBATIM — bitwise, not just close
+    assert np.array_equal(
+        np.asarray(out[0]).view(np.uint16),
+        np.asarray(h[0]).view(np.uint16))
+    # row 1 actually moved
+    assert not np.array_equal(np.asarray(out[1]), np.asarray(h[1]))
+
+
+def test_adapter_delta_f32_accum_matches_reference():
+    r = np.random.RandomState(1)
+    h = jnp.asarray(r.randn(1, 2, CFG.dim), jnp.bfloat16)
+    x = jnp.asarray(r.randn(1, 2, CFG.dim), jnp.bfloat16)
+    a = jnp.asarray(r.randn(2, CFG.dim, 3), jnp.float32)
+    b = jnp.asarray(r.randn(2, 3, CFG.dim), jnp.float32)
+    s = jnp.asarray([0.5, 2.0], jnp.float32)
+    out = aops.adapter_delta(h, x, a, b, s, jnp.asarray([1]))
+    assert out.dtype == h.dtype
+    xf = np.asarray(x, np.float32)
+    ref = (np.asarray(h, np.float32)
+           + 2.0 * (xf @ np.asarray(a[1])) @ np.asarray(b[1]))
+    assert np.array_equal(np.asarray(out),
+                          np.asarray(ref.astype(jnp.bfloat16)))
+
+
+def test_pool_reserve_load_pin_free_cycle():
+    pool = AdapterPool(CFG.num_layers, 2, CFG.dim, 2)
+    art = mk_artifact(0)
+    s0 = pool.reserve()
+    assert s0 == 0 and pool.refcounts().tolist() == [1, 0]
+    pool.load(s0, art["a"], art["b"], art["scale"])
+    pool.pin(s0)
+    assert pool.refcounts().tolist() == [2, 0]
+    pool.unpin(s0)
+    pool.free(s0)
+    assert pool.refcounts().tolist() == [0, 0]
+    assert pool.free_slots() == 2
+    # a full pool reserves -1, not an exception (the registry turns
+    # that into eviction-or-AdapterPoolFull policy)
+    assert pool.reserve() == 0 and pool.reserve() == 1
+    assert pool.reserve() == -1
+    assert not pool.reconcile([1, 1])
+
+
+def test_reserve_zeroes_recycled_slot():
+    pool = AdapterPool(CFG.num_layers, 1, CFG.dim, 2)
+    art = mk_artifact(3)
+    s = pool.reserve()
+    pool.load(s, art["a"], art["b"], 2.0)
+    pool.free(s)
+    s = pool.reserve()              # recycled: previous tenant's bytes
+    assert float(jnp.abs(pool.state.a[0][s]).max()) == 0.0
+    assert float(jnp.abs(pool.state.b[0][s]).max()) == 0.0
+    assert float(pool.state.scales[s]) == 0.0
+
+
+def test_reconcile_names_corrupted_slot():
+    pool = AdapterPool(CFG.num_layers, 3, CFG.dim, 2)
+    reg = AdapterRegistry(pool)
+    reg.load("x", mk_artifact(0), tenant="t0")
+    # corrupt the device plane behind the registry's back
+    pool.state = pool.state._replace(
+        refcounts=pool.state.refcounts.at[2].set(7))
+    problems = reg.reconcile()
+    assert problems and any("slot 2" in p for p in problems)
+
+
+def test_registry_lru_eviction_and_pins():
+    evicted = []
+    pool = AdapterPool(CFG.num_layers, 2, CFG.dim, 2)
+    reg = AdapterRegistry(
+        pool, on_evict=lambda t, n, s: evicted.append((t, n, s)))
+    sa = reg.load("a", mk_artifact(0), tenant="t0")
+    sb = reg.load("b", mk_artifact(1), tenant="t0")
+    assert reg.resolve("a", tenant="t0") == sa  # touch: b is now LRU
+    sc = reg.load("c", mk_artifact(2), tenant="t1")
+    assert evicted == [("t0", "b", sb)] and sc == sb
+    assert reg.resolve("b", tenant="t0") is None
+    # pinned adapters are never victims: pin both residents, then a
+    # fourth adapter finds no sharer-free slot
+    reg.pin(sa)
+    reg.pin(sc)
+    with pytest.raises(AdapterPoolFull):
+        reg.load("d", mk_artifact(3), tenant="t1")
+    reg.unpin(sa)
+    sd = reg.load("d", mk_artifact(3), tenant="t1")
+    assert sd == sa and evicted[-1] == ("t0", "a", sa)
+    assert reg.stats()["evictions"] == 2
+    assert not reg.reconcile()
+
+
+def test_unload_pinned_raises():
+    pool = AdapterPool(CFG.num_layers, 2, CFG.dim, 2)
+    reg = AdapterRegistry(pool)
+    s = reg.load("a", mk_artifact(0), tenant="t0")
+    reg.pin(s)
+    with pytest.raises(AssertionError):
+        reg.unload("a", tenant="t0")
+    reg.unpin(s)
+    reg.unload("a", tenant="t0")
+    assert pool.free_slots() == 2 and not reg.reconcile()
+
+
+# ----------------------------------------------------------- checkpoint
+
+
+def test_checkpoint_roundtrip_byte_exact(tmp_path):
+    art = mk_artifact(5, rank=3)
+    path = str(tmp_path / "ad.npz")
+    save_adapter(path, art["a"], art["b"], scale=1.5,
+                 meta={"tenant": "t0", "tag": "v1"})
+    back = load_adapter(path)
+    assert np.array_equal(back["a"], art["a"])
+    assert all(l.dtype == np.float32 for l in back["a"])
+    assert np.array_equal(back["b"], art["b"])
+    assert back["scale"] == 1.5
+    assert back["meta"]["tenant"] == "t0"
+    assert back["meta"]["format"] == "paddle_tpu.lora.v1"
+    assert back["meta"]["num_layers"] == CFG.num_layers
+    assert back["meta"]["rank"] == 3
+    # tmp-then-rename: no partial-write turds next to the artifact
+    assert os.listdir(tmp_path) == ["ad.npz"]
+    with pytest.raises(ValueError):
+        save_adapter(str(tmp_path / "ad.pkl"), art["a"], art["b"])
+
+
+def test_registry_loads_checkpoint_path(tmp_path):
+    art = mk_artifact(6)
+    path = str(tmp_path / "ad.npz")
+    save_adapter(path, art["a"], art["b"], scale=art["scale"])
+    pool = AdapterPool(CFG.num_layers, 1, CFG.dim, 2)
+    reg = AdapterRegistry(pool)
+    s = reg.load("a", path, tenant="t0")
+    assert np.array_equal(np.asarray(pool.state.a[0][s]), art["a"][0])
+    assert not reg.reconcile()
+
+
+# ------------------------------------------------------ engine: identity
+
+
+@pytest.mark.parametrize("mesh", [None, 2])
+@pytest.mark.parametrize("kernel", [False, True])
+@pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                         ids=["bf16", "int8"])
+def test_zero_adapters_are_identity(params1, kv_dtype, kernel, mesh):
+    """Rank-0 and zero-init-B adapters stream exactly like the base
+    model — across the KV dtype, kernel, and mesh axes the delta path
+    must compose with.  The base reference is the id=-1 row of the
+    SAME batch: that row's bit-identity to a pool-less engine is
+    pinned by the mixed-batch test and the selfcheck gate, so the
+    chain is exact without building a third engine per cell."""
+    kw = dict(ENGINE_KW, kv_dtype=kv_dtype, decode_kernel=kernel,
+              mesh=mesh)
+    cases = [("zb", dict(adapter_rank=2),
+              mk_artifact(7, zero_b=True, cfg=CFG1)),
+             ("r0", dict(adapter_rank=0), mk_artifact(8, rank=0,
+                                                      cfg=CFG1))]
+    for name, rank_kw, art in cases:
+        eng = PagedServingEngine(CFG1, params1, adapters=2,
+                                 adapter_source=source_of({name: art}),
+                                 **rank_kw, **kw)
+        r_base = eng.submit(PROMPT, MAX_NEW)
+        r_ad = eng.submit(PROMPT, MAX_NEW, adapter=name, tenant="t0")
+        out = eng.run()
+        assert list(map(int, out[r_ad])) == list(map(int, out[r_base]))
+        assert eng.compile_counts() == {"step": 1, "prefill": 1}
+        assert_refcounts_exact(eng)
+
+
+# --------------------------------------------------- engine: mixed batch
+
+
+def test_mixed_batch_three_adapters_one_compile(params):
+    arts = {f"ad{i}": mk_artifact(10 + i) for i in range(3)}
+    src = source_of(arts)
+    base = greedy(PagedServingEngine(CFG, params, **ENGINE_KW))
+
+    reg = telemetry.MetricsRegistry("adapters-mixed")
+    eng = PagedServingEngine(CFG, params, adapters=3, adapter_rank=2,
+                             adapter_source=src, metrics=reg,
+                             **ENGINE_KW)
+    # each adapter's SOLO stream first (alone in the batch), then the
+    # mixed batch through the SAME engine — the one-compile pin at the
+    # end covers all four runs
+    solo = {name: greedy(eng, adapter=name, tenant=f"t{i}")
+            for i, name in enumerate(arts)}
+    assert len({tuple(s) for s in solo.values()} | {tuple(base)}) == 4
+
+    rid_base = eng.submit(PROMPT, MAX_NEW)
+    rids = {name: eng.submit(PROMPT, MAX_NEW, adapter=name,
+                             tenant=f"t{i}")
+            for i, name in enumerate(arts)}
+    out = eng.run()
+    # ONE compiled step + ONE prefill with 3 distinct adapters resident
+    assert eng.compile_counts() == {"step": 1, "prefill": 1}
+    # the adapter-free row is bit-identical to the adapter-free engine
+    assert list(map(int, out[rid_base])) == base
+    # every adapter row reproduces its solo stream exactly
+    for name, rid in rids.items():
+        assert list(map(int, out[rid])) == solo[name], name
+    # per-tenant token metering (solo run + mixed row each) + the
+    # base row under the default tenant + pool books balance
+    for i in range(3):
+        assert reg.counter("serving_adapter_tokens_total").value(
+            tenant=f"t{i}") == 2 * MAX_NEW
+    assert reg.counter("serving_adapter_tokens_total").value(
+        tenant="default") == MAX_NEW
+    # solo runs were the misses; the mixed batch hit the residents
+    assert reg.counter("serving_adapter_misses_total").value(
+        tenant="t0") == 1
+    assert reg.counter("serving_adapter_hits_total").value(
+        tenant="t0") == 1
+    assert_refcounts_exact(eng)
+    st = eng.host_state(reconcile=True)
+    assert st["pool_reconcile"]["ok"]
+    assert st["adapters"]["resident"] == 3
+    assert st["adapters"]["pinned_rows"] == 0
+
+
+def test_eviction_reload_and_admission_pressure(params):
+    arts = {f"ad{i}": mk_artifact(20 + i) for i in range(3)}
+    reg = telemetry.MetricsRegistry("adapters-evict")
+    eng = PagedServingEngine(CFG, params, adapters=2, adapter_rank=2,
+                             adapter_source=source_of(arts),
+                             metrics=reg, **ENGINE_KW)
+    solo = {n: greedy(eng, adapter=n, tenant="t") for n in arts}
+    # 3 distinct adapters through a 2-slot pool: the third admission
+    # evicted the LRU resident; re-serving ad0 is a MISS that reloads
+    assert reg.counter("serving_adapter_evictions_total").value(
+        tenant="t") >= 1
+    before = reg.counter("serving_adapter_misses_total").value(
+        tenant="t")
+    assert greedy(eng, adapter="ad0", tenant="t") == solo["ad0"]
+    assert reg.counter("serving_adapter_misses_total").value(
+        tenant="t") == before + 1
+    assert sum(s["count"] for s in reg.snapshot()["metrics"]
+               ["serving_adapter_load_seconds"]["series"]) == before + 1
+
+    # all pool slots pinned by ACTIVE rows: a third tenant's admission
+    # BLOCKS (reject reason adapter_pool) until a retire unpins — then
+    # everything drains with the compile set still pinned
+    rids = [eng.submit(PROMPT, MAX_NEW, adapter=f"ad{i}", tenant="t")
+            for i in range(3)]
+    out = eng.run()
+    assert reg.counter("serving_admission_rejects_total").value(
+        reason="adapter_pool") >= 1
+    for i, rid in enumerate(rids):
+        assert list(map(int, out[rid])) == solo[f"ad{i}"]
+    assert eng.compile_counts() == {"step": 1, "prefill": 1}
+    assert_adapter_refcounts_exact(eng)
+
+
+def test_warm_load_and_unload_api(params):
+    eng = PagedServingEngine(CFG, params, adapters=2, adapter_rank=2,
+                             **ENGINE_KW)
+    eng.load_adapter("a", mk_artifact(30), tenant="t0")
+    s = greedy(eng, adapter="a", tenant="t0")
+    assert s != greedy(eng)
+    eng.unload_adapter("a", tenant="t0")
+    assert eng.host_state()["adapters"]["resident"] == 0
+    # no adapter_source: a miss has nowhere to load from
+    with pytest.raises(EnforceError):
+        greedy(eng, adapter="a", tenant="t0")
+
+
+def test_adapter_knob_validation(params):
+    with pytest.raises(EnforceError):
+        PagedServingEngine(CFG, params, adapters=0, **ENGINE_KW)
+    with pytest.raises(EnforceError):
+        PagedServingEngine(CFG, params, adapter_source=lambda t, n: None,
+                           **ENGINE_KW)
+    with pytest.raises(EnforceError):
+        PagedServingEngine(CFG, params, adapters=2, prefix_cache=True,
+                           **ENGINE_KW)
+    with pytest.raises(EnforceError):
+        PagedServingEngine(CFG, params, adapters=2, unified_step=False,
+                           **ENGINE_KW)
+    eng = PagedServingEngine(CFG, params, **ENGINE_KW)
+    with pytest.raises(EnforceError):
+        eng.submit(PROMPT, MAX_NEW, adapter="x")
+
+
+# ------------------------------------------------------------- frontend
+
+
+FE_KW = dict(num_slots=2, num_blocks=24, block_size=4,
+             prompt_buckets=(8,), decode_kernel=False, seed=0)
+
+
+def test_frontend_tenant_slo_and_adapter_routing(params):
+    arts = {"x": mk_artifact(40), "y": mk_artifact(41)}
+    with ServingFrontend(
+            CFG, params, num_engines=2, adapters=2, adapter_rank=2,
+            adapter_source=source_of(arts),
+            tenant_slo={"gold": {"priority": 5, "deadline_s": 60.0},
+                        "free": {"priority": 1}},
+            **FE_KW) as fe:
+        r_base = fe.submit(PROMPT, MAX_NEW)
+        r_gold = fe.submit(PROMPT, MAX_NEW, tenant="gold", adapter="x")
+        r_expl = fe.submit(PROMPT, MAX_NEW, tenant="gold", adapter="x",
+                           priority=9)
+        r_free = fe.submit(PROMPT, MAX_NEW, tenant="free", adapter="y")
+        out = fe.run(timeout_s=300)
+    # tenant SLO defaults apply; explicit values win; journal keeps
+    # tenant + adapter on the record
+    assert out[r_base]["priority"] == 1 and out[r_base]["tenant"] is None
+    assert out[r_gold]["priority"] == 5
+    assert out[r_gold]["deadline_s"] == 60.0
+    assert out[r_expl]["priority"] == 9
+    assert out[r_gold]["tenant"] == "gold"
+    assert out[r_gold]["adapter"] == "x"
+    assert out[r_free]["priority"] == 1
+    # same adapter => same stream; distinct adapters differ
+    assert np.array_equal(out[r_gold]["tokens"], out[r_expl]["tokens"])
+    assert not np.array_equal(out[r_gold]["tokens"],
+                              out[r_free]["tokens"])
+    with ServingFrontend(CFG, params, num_engines=1, **FE_KW) as fe:
+        with pytest.raises(EnforceError):
+            fe.submit(PROMPT, MAX_NEW, adapter="x")
+
+
+def test_frontend_replay_preserves_tenant_routing(params):
+    """An engine crash mid-decode journal-replays the request WITH its
+    tenant/adapter — the replacement stream is the fault-free adapter
+    stream, not a base-model stream (exactly-once unchanged)."""
+    arts = {"x": mk_artifact(42)}
+    ref_kw = dict(FE_KW, adapters=2, adapter_rank=2,
+                  adapter_source=source_of(arts))
+    with ServingFrontend(CFG, params, num_engines=1, **ref_kw) as fe:
+        r = fe.submit(PROMPT, MAX_NEW, tenant="t0", adapter="x")
+        want = fe.run(timeout_s=300)[r]["tokens"]
+
+    inj = FaultInjector(FaultSchedule([
+        Fault("decode_step", 2, "raise", scope="engine0")]))
+    with ServingFrontend(CFG, params, num_engines=1, faults=inj,
+                         restart_backoff_s=0.01,
+                         restart_backoff_cap_s=0.05,
+                         **ref_kw) as fe:
+        r = fe.submit(PROMPT, MAX_NEW, tenant="t0", adapter="x")
+        out = fe.run(timeout_s=300)
+        st = fe.stats()
+    assert [f["action"] for f in inj.fired()] == ["raise"]
+    assert st["engine_restarts"] == 1
+    assert out[r]["status"] == "completed" and out[r]["attempts"] == 1
+    assert out[r]["tenant"] == "t0" and out[r]["adapter"] == "x"
+    assert np.array_equal(out[r]["tokens"], want)

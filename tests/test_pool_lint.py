@@ -308,7 +308,8 @@ def test_shipped_pool_modules_lint_clean():
     # post-suppression baseline — any new finding is a regression
     findings = pool_check()
     assert findings == [], [(f.rule_id, f.location()) for f in findings]
-    assert len(POOL_CLIENT_MODULES) == 5
+    assert len(POOL_CLIENT_MODULES) == 6
+    assert "paddle_tpu.adapters" in POOL_CLIENT_MODULES
 
 
 def test_model_is_not_trivially_empty():
