@@ -867,6 +867,23 @@ def _bench_disagg(args, cfg, params, jax):
         stats = ctl.stats()
         compiles = {label: s["compiles"] for label, s
                     in ctl.snapshot_workers().items()}
+        # merged-trace handoff breakdown: export / wire / import as
+        # separate legs (handoff_ms above is only their prefill+wire
+        # sum as the controller saw it) — the ROADMAP v5e campaign's
+        # missing measurement.  With no prefill workers there are no
+        # handoff spans and the keys report None.
+        merged = ctl.merged_trace()
+        breakdown = telemetry.handoff_breakdown(merged["events"])
+    from paddle_tpu.telemetry.trace import _quantile
+
+    def _leg(key):
+        vals = sorted(r[key] for r in breakdown
+                      if r[key] is not None)
+        return (_quantile(vals, 0.50), _quantile(vals, 0.95))
+
+    exp_p50, exp_p95 = _leg("export_s")
+    wire_p50, wire_p95 = _leg("wire_s")
+    imp_p50, imp_p95 = _leg("import_s")
     snap = reg.snapshot()
     handoff_bytes = sum(
         s["value"] for s in
@@ -899,6 +916,12 @@ def _bench_disagg(args, cfg, params, jax):
         spawn_s=round(spawn_s, 2),
         handoff_ms_p50=_ms(handoff["p50"]),
         handoff_ms_p95=_ms(handoff["p95"]),
+        handoff_export_ms_p50=_ms(exp_p50),
+        handoff_export_ms_p95=_ms(exp_p95),
+        handoff_wire_ms_p50=_ms(wire_p50),
+        handoff_wire_ms_p95=_ms(wire_p95),
+        handoff_import_ms_p50=_ms(imp_p50),
+        handoff_import_ms_p95=_ms(imp_p95),
         handoff_kib_per_request=round(handoff_bytes / 1024 / reqs, 1),
         ttft_ms_p50=_ms(ttft["p50"]),
         ttft_ms_p95=_ms(ttft["p95"]),

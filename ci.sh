@@ -149,7 +149,12 @@ echo "== cluster gate: disaggregated prefill/decode over real processes =="
 # single in-process engine (clean AND after journal-replay), per-worker
 # compiles == {'step': 1, 'prefill': 1}, exactly-once terminal status,
 # generation-tagged restart, merged per-worker telemetry snapshots, and
-# populated cluster_* metric families.
+# populated cluster_* metric families.  Also gates the distributed
+# trace (one request's prefill/wire/decode spans merge into ONE
+# Chrome-valid trace, causally ordered after clock correction) and the
+# live HTTP endpoint (a real /metrics scrape is bit-identical to
+# rendering the registry snapshot directly; /healthz, /traces/recent
+# and /state serve valid JSON).
 JAX_PLATFORMS=cpu python -m paddle_tpu.cluster.selfcheck
 
 echo "== native libs =="

@@ -97,6 +97,7 @@ HOST_MODULES = (
     "paddle_tpu.cluster.selfcheck",
     "paddle_tpu.telemetry.metrics",
     "paddle_tpu.telemetry.trace",
+    "paddle_tpu.telemetry.httpd",
 )
 
 # A name segment is lock-like when "lock" appears as a whole token

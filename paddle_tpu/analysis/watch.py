@@ -21,13 +21,27 @@ asserting a REGION adds no compiles over already-warm functions)::
 
 Counts come from ``jit``'s own compile-cache size — exact, backend-
 independent, zero overhead on the measured path.
+
+A watcher can also REPORT, not just assert: :meth:`bind_metrics`
+registers a ``compile_seconds{program=}`` histogram and :meth:`poll`
+(called by the serving engine once per step / prefill) turns compile-
+count growth into observations plus a ``recompile`` trace instant
+naming the program on any compile after its first — so a broken
+``compiles == {'step': 1}`` pin is attributable from the trace
+timeline, not only countable after the fact.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
-__all__ = ["CompileWatcher"]
+__all__ = ["CompileWatcher", "COMPILE_SECONDS_BUCKETS"]
+
+#: XLA compiles run milliseconds (tiny test graphs) to minutes (full
+#: models) — log-spaced wide, like DEFAULT_LATENCY_BUCKETS but shifted
+#: up three decades.
+COMPILE_SECONDS_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                           10.0, 30.0, 60.0, 120.0)
 
 
 def _cache_size(fn) -> int:
@@ -51,6 +65,8 @@ class CompileWatcher:
     def __init__(self, **fns: Callable):
         self._fns: Dict[str, Callable] = {}
         self._base: Dict[str, int] = {}
+        self._hist = None
+        self._polled: Dict[str, int] = {}
         for name, fn in fns.items():
             self.watch(name, fn)
 
@@ -65,6 +81,7 @@ class CompileWatcher:
     def __enter__(self) -> "CompileWatcher":
         for name, fn in self._fns.items():
             self._base[name] = _cache_size(fn)
+        self._polled = {}
         return self
 
     def __exit__(self, *exc) -> None:
@@ -74,6 +91,50 @@ class CompileWatcher:
         """Compiles since baseline, per watched function."""
         return {name: _cache_size(fn) - self._base[name]
                 for name, fn in self._fns.items()}
+
+    # -------------------------------------------------------- reporting
+
+    def bind_metrics(self, registry) -> "CompileWatcher":
+        """Register the ``compile_seconds{program=}`` histogram on
+        ``registry`` and route future :meth:`poll` observations into
+        it.  Idempotent per registry (re-binding just re-resolves the
+        family, same as any ``registry.histogram`` call)."""
+        self._hist = registry.histogram(
+            "compile_seconds",
+            help="wall time of host calls that triggered an XLA "
+                 "compile, by program= (upper bound: the call's full "
+                 "duration, compile included)",
+            buckets=COMPILE_SECONDS_BUCKETS)
+        return self
+
+    def poll(self, seconds_hint: Optional[float] = None,
+             tracer=None) -> Dict[str, int]:
+        """Detect compile-count growth since the last poll and report
+        it; returns :meth:`counts`.  Call this right after the host
+        call that may have compiled (the engine does, once per step
+        and per prefill) — cost is one ``_cache_size`` read per
+        watched function, same as :meth:`counts`.
+
+        ``seconds_hint`` is the duration of the polled call; it is
+        observed into ``compile_seconds`` once per program that grew —
+        an UPPER BOUND on compile time (the call did other work too),
+        which is exactly the operator question ("how long did the step
+        that recompiled stall").  ``tracer`` gets a ``recompile``
+        instant naming the program whenever its total count exceeds 1
+        — the first compile per program is the contract, everything
+        after is the bug the trace should show."""
+        counts = self.counts()
+        for name, n in counts.items():
+            prev = self._polled.get(name, 0)
+            if n <= prev:
+                continue
+            if self._hist is not None and seconds_hint is not None:
+                self._hist.observe(float(seconds_hint), program=name)
+            if tracer is not None and n > 1:
+                tracer.instant("recompile", track="host", program=name,
+                               compiles=int(n), new=int(n - prev))
+        self._polled = counts
+        return counts
 
     def total(self) -> int:
         return sum(self.counts().values())

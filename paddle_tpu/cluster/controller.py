@@ -100,7 +100,8 @@ class _Worker:
     __slots__ = ("label", "role", "index", "generation", "proc",
                  "sock", "up", "retired", "last_beat", "restarts",
                  "restart_at", "assigned", "idle_since", "compiles",
-                 "snapshot", "spawned_at")
+                 "snapshot", "spawned_at", "trace_events", "pings",
+                 "last_ping", "clock_offset", "clock_disp", "clock_at")
 
     def __init__(self, label, role, index):
         self.label = label
@@ -119,6 +120,17 @@ class _Worker:
         self.compiles = None
         self.snapshot = None
         self.spawned_at = None
+        # distributed tracing: streamed trace events (ts already
+        # rebased to the WORKER's wall clock at receipt, so a
+        # generation bump cannot mix old events with new anchors)
+        self.trace_events = deque(maxlen=65536)
+        # clock alignment: outstanding ping send-stamps and the
+        # best (min-RTT) offset estimate with its dispersion bound
+        self.pings = {}
+        self.last_ping = None
+        self.clock_offset = None
+        self.clock_disp = None
+        self.clock_at = None
 
     def state(self) -> str:
         if self.retired:
@@ -152,6 +164,7 @@ class ClusterController:
                  restart_backoff_s: float = 0.05,
                  restart_backoff_cap_s: float = 2.0,
                  max_retries: int = 3, autoscaler=None, metrics=None,
+                 tracer=None, http_port: Optional[int] = None,
                  faults=None, platform: str = "cpu",
                  devices_per_worker: int = 1, warmup: bool = True,
                  workdir: Optional[str] = None):
@@ -263,6 +276,44 @@ class ClusterController:
                  "thread (threading.excepthook backstop) — a dead "
                  "reader looks like a silent worker until heartbeat "
                  "timeout; this makes the cause visible immediately")
+        self._m_clock_offset = m.gauge(
+            "cluster_clock_offset_s",
+            help="estimated worker wall clock minus controller wall "
+                 "clock, by worker= — the min-RTT sample of the "
+                 "heartbeat ping round-trips; merge_traces applies "
+                 "these to put all processes on one timeline")
+        self._m_clock_disp = m.gauge(
+            "cluster_clock_dispersion_s",
+            help="error bound of cluster_clock_offset_s (half the "
+                 "round-trip of its sample), by worker= — spans "
+                 "closer together than this may be misordered in the "
+                 "merged trace")
+        self._m_worker_queue = m.gauge(
+            "cluster_worker_queue_depth",
+            help="engine submit-queue depth from the worker's last "
+                 "heartbeat, by worker= — the autoscaler's per-worker "
+                 "load input, now scrapeable")
+        self._m_worker_active = m.gauge(
+            "cluster_worker_active_slots",
+            help="slots holding a live request, from the worker's "
+                 "last heartbeat, by worker=")
+        self._m_worker_blocks = m.gauge(
+            "cluster_worker_blocks_in_use",
+            help="host-side estimate of KV pool blocks holding live "
+                 "tokens, from the worker's last heartbeat, by "
+                 "worker=")
+        self._m_worker_occup = m.gauge(
+            "cluster_worker_occupancy_fraction",
+            help="blocks_in_use / pool size from the worker's last "
+                 "heartbeat, by worker= — the cross-process twin of "
+                 "serving_pool_occupancy_fraction")
+        # the controller's own tracer: submit/dispatch/handoff events
+        # on the reference clock (offset 0 in merged_trace).  Always
+        # on — the ring bound caps the cost, and a cluster trace with
+        # the controller's half missing cannot explain queue time.
+        self.tracer = (tracer if tracer is not None
+                       else telemetry.Tracer(name="controller"))
+        self._ping_seq = 0
 
         self._workers = {}
         self._next_index = {role: 0 for role in _ROLES}
@@ -281,6 +332,23 @@ class ClusterController:
             self._grow("prefill", scaled=False)
         for _ in range(decode_workers):
             self._grow("decode", scaled=False)
+        # live scrape surface (telemetry/httpd.py).  /metrics reads
+        # the thread-safe registry directly; the other routes read
+        # _http_cache, a dict REPLACED (never mutated) by the pump
+        # thread — handler threads see either the old or the new
+        # reference, both complete.
+        self._httpd = None
+        self._http_cache = {"healthz": (False, {"detail": "starting"}),
+                            "traces": {}, "state": {}}
+        self._http_refreshed = None
+        if http_port is not None:
+            from paddle_tpu.telemetry.httpd import TelemetryHTTPD
+            self._httpd = TelemetryHTTPD(
+                port=int(http_port),
+                metrics_fn=self.metrics.snapshot,
+                healthz_fn=lambda: self._http_cache["healthz"],
+                traces_fn=lambda: self._http_cache["traces"],
+                state_fn=lambda: self._http_cache["state"])
 
     # ------------------------------------------------------------ spawn
 
@@ -385,7 +453,7 @@ class ClusterController:
 
     def pump(self):
         """One supervision pass: drain events, watchdog, restarts,
-        autoscale, dispatch, gauges."""
+        autoscale, dispatch, gauges, clock pings, scrape cache."""
         self._drain_events()
         now = time.monotonic()
         self._watchdog(now)
@@ -393,6 +461,8 @@ class ClusterController:
         self._autoscale(now)
         self._dispatch(now)
         self._sample_gauges()
+        self._clock_pings(now)
+        self._refresh_http_cache(now)
 
     def _drain_events(self):
         while True:
@@ -418,7 +488,11 @@ class ClusterController:
                 watch_thread(t, self._thread_crash_backstop)
                 t.start()
             elif kind == "heartbeat":
-                self._on_heartbeat(w)
+                self._on_heartbeat(w, msg)
+            elif kind == "pong":
+                self._on_pong(w, msg)
+            elif kind == "trace":
+                self._on_trace(w, msg)
             elif kind == "tokens":
                 self._on_tokens(w, msg)
             elif kind == "handoff":
@@ -432,7 +506,7 @@ class ClusterController:
                     self._requeue(rid, f"worker_error: "
                                        f"{msg.get('detail')}")
 
-    def _on_heartbeat(self, w: "_Worker"):
+    def _on_heartbeat(self, w: "_Worker", msg: dict):
         if self._faults is not None:
             from paddle_tpu.testing.faults import FaultError
             try:
@@ -450,6 +524,82 @@ class ClusterController:
                 return
         w.last_beat = time.monotonic()
         self._m_heartbeats.inc(worker=w.label)
+        # occupancy payload -> cluster_worker_* gauges: the
+        # autoscaler's per-worker load inputs, now scrapeable
+        self._m_worker_queue.set(float(msg.get("queue_depth", 0)),
+                                 worker=w.label)
+        self._m_worker_active.set(float(msg.get("active", 0)),
+                                  worker=w.label)
+        if "blocks_in_use" in msg:
+            self._m_worker_blocks.set(float(msg["blocks_in_use"]),
+                                      worker=w.label)
+            pool = float(msg.get("pool_blocks") or 0)
+            if pool > 0:
+                self._m_worker_occup.set(
+                    float(msg["blocks_in_use"]) / pool,
+                    worker=w.label)
+
+    def _on_pong(self, w: "_Worker", msg: dict):
+        """One NTP-style sample: the worker's wall clock at ping
+        receipt vs the midpoint of our send/receive stamps.  Keep the
+        MIN-RTT sample (its dispersion — half the round trip — bounds
+        the offset error tightest), but age it out after 30s so a
+        drifting clock cannot pin a stale estimate forever."""
+        t_rx = time.time()
+        t_tx = w.pings.pop(msg.get("seq"), None)
+        if t_tx is None:
+            return                        # stale generation or dropped
+        rtt = t_rx - t_tx
+        if rtt < 0:                       # wall clock stepped mid-ping
+            return
+        disp = 0.5 * rtt
+        now = time.monotonic()
+        stale = (w.clock_at is not None and now - w.clock_at > 30.0)
+        if w.clock_disp is None or disp <= w.clock_disp or stale:
+            w.clock_offset = float(msg["t_worker"]) \
+                - 0.5 * (t_tx + t_rx)
+            w.clock_disp = disp
+            w.clock_at = now
+            self._m_clock_offset.set(w.clock_offset, worker=w.label)
+            self._m_clock_disp.set(w.clock_disp, worker=w.label)
+
+    def _on_trace(self, w: "_Worker", msg: dict):
+        """Buffer a worker's streamed trace events.  Each event's
+        monotonic ts is rebased HERE to the worker's wall clock using
+        the anchors shipped alongside — so events from a dead
+        generation stay correct when the restarted twin ships new
+        anchors, and merged_trace only needs the per-worker offset."""
+        try:
+            base = float(msg["wall_t0"]) - float(msg["perf_t0"])
+        except (KeyError, TypeError, ValueError):
+            return                        # malformed — drop the batch
+        for e in msg.get("events") or ():
+            if isinstance(e, dict) and isinstance(
+                    e.get("ts"), (int, float)):
+                e["ts"] = base + e["ts"]
+                w.trace_events.append(e)
+
+    def _clock_pings(self, now: float):
+        """Send one clock-alignment ping per heartbeat interval to
+        every up worker (piggybacking the heartbeat CADENCE, not the
+        frames: pings flow controller->worker, heartbeats the other
+        way).  Stamps ride the journaled pings dict; _on_pong turns
+        the echo into an offset sample."""
+        for w in self._workers.values():
+            if not w.up or w.retired or w.sock is None:
+                continue
+            if w.last_ping is not None \
+                    and now - w.last_ping < self.hb_interval_s:
+                continue
+            w.last_ping = now
+            self._ping_seq += 1
+            seq = self._ping_seq
+            t_tx = time.time()
+            if self._send(w, {"type": "ping", "seq": seq,
+                              "t_tx": t_tx}):
+                w.pings[seq] = t_tx
+                while len(w.pings) > 16:  # unanswered backlog cap
+                    w.pings.pop(next(iter(w.pings)))
 
     def _on_tokens(self, w: "_Worker", msg: dict):
         rid = int(msg["rid"])
@@ -477,6 +627,9 @@ class ClusterController:
         if req.prefill_sent_at is not None:
             self._m_handoff_lat.observe(
                 time.monotonic() - req.prefill_sent_at)
+        self.tracer.instant("handoff_recv", track="host", rid=rid,
+                            worker=w.label,
+                            bytes=handoff.payload_nbytes(payload))
         req.payload = payload
         req.status = PREFILLED
         req.worker = None
@@ -509,6 +662,11 @@ class ClusterController:
         w.up = False
         w.generation += 1
         w.restarts += 1
+        # outstanding pings can never be answered by the new
+        # generation; the offset estimate survives (same machine, same
+        # wall clock) until fresh pongs refine it
+        w.pings.clear()
+        w.last_ping = None
         self._m_restarts.inc(cause=cause, worker=w.label)
         for rid in sorted(w.assigned):
             self._requeue(rid, cause)
@@ -614,42 +772,54 @@ class ClusterController:
                     w = self._pick("prefill")
                     if w is None:
                         continue
-                    if self._send(w, {
+                    if self._send(w, wire.attach_trace({
                             "type": "prefill", "rid": rid,
                             "prompt": req.prompt,
-                            "temperature": req.temperature}):
+                            "temperature": req.temperature},
+                            rid, parent="dispatch")):
                         req.status = PREFILLING
                         req.worker = w.label
                         req.prefill_sent_at = now
                         w.assigned.add(rid)
+                        self.tracer.instant(
+                            "dispatch", track="host", rid=rid,
+                            worker=w.label, kind="prefill")
                 else:
                     w = self._pick("decode")
                     if w is None:
                         continue
-                    if self._send(w, {
+                    if self._send(w, wire.attach_trace({
                             "type": "submit", "rid": rid,
                             "prompt": req.prompt,
                             "max_new": req.max_new,
-                            "temperature": req.temperature}):
+                            "temperature": req.temperature},
+                            rid, parent="dispatch")):
                         req.status = DECODING
                         req.worker = w.label
                         self._m_queue_wait.observe(
                             now - req.submitted_at)
                         w.assigned.add(rid)
+                        self.tracer.instant(
+                            "dispatch", track="host", rid=rid,
+                            worker=w.label, kind="submit")
             elif req.status == PREFILLED:
                 w = self._pick("decode")
                 if w is None:
                     continue
-                if self._send(w, {
+                if self._send(w, wire.attach_trace({
                         "type": "handoff_submit", "rid": rid,
                         "payload": req.payload,
                         "max_new": req.max_new,
-                        "temperature": req.temperature}):
+                        "temperature": req.temperature},
+                        rid, parent="handoff_recv")):
                     req.payload = None    # shipped; replay re-prefills
                     req.status = DECODING
                     req.worker = w.label
                     self._m_queue_wait.observe(now - req.submitted_at)
                     w.assigned.add(rid)
+                    self.tracer.instant(
+                        "dispatch", track="host", rid=rid,
+                        worker=w.label, kind="handoff_submit")
 
     def _finalize(self, rid: int, status: str, reason=None):
         req = self._journal[rid]
@@ -686,6 +856,9 @@ class ClusterController:
                                              int(max_new),
                                              float(temperature))
         self._order.append(rid)
+        self.tracer.instant("submit", track="host", rid=rid,
+                            prompt_len=int(prompt.shape[0]),
+                            max_new=int(max_new))
         return rid
 
     def run(self, timeout_s: Optional[float] = None,
@@ -776,6 +949,80 @@ class ClusterController:
                     "compiles": w.snapshot["compiles"]}
                 for w in targets if w.snapshot is not None}
 
+    def merged_trace(self, *, refresh: bool = True,
+                     timeout_s: float = 10.0,
+                     synthesize_wire: bool = True) -> dict:
+        """ONE causally-ordered trace for the whole cluster: the
+        controller's own events plus every worker's streamed events,
+        merged by ``telemetry.merge_traces`` under the heartbeat-
+        estimated clock offsets — submit -> dispatch -> prefill ->
+        handoff export/wire/import -> decode -> retire as one
+        waterfall, one named process per worker in the Chrome render.
+
+        ``refresh=True`` runs a :meth:`snapshot_workers` round trip
+        first: workers flush their trace rings before replying and
+        frames are FIFO per socket, so everything recorded before the
+        call is merged.  ``refresh=False`` merges only what already
+        streamed in (what the /traces/recent cache uses — it cannot
+        block the pump on a round trip)."""
+        if refresh:
+            self.snapshot_workers(timeout_s=timeout_s)
+            self._drain_events()
+        traces = {"controller": self.tracer.snapshot()}
+        offsets = {"controller": 0.0}
+        for w in self._workers.values():
+            if not w.trace_events:
+                continue
+            # events were rebased to the worker's WALL clock at
+            # receipt (_on_trace), so the synthetic anchors are zero
+            # and only the offset places them on the reference clock
+            traces[w.label] = {
+                "schema_version": telemetry.TRACE_SCHEMA_VERSION,
+                "name": w.label,
+                "capacity": w.trace_events.maxlen,
+                "dropped": 0, "wall_t0": 0.0, "perf_t0": 0.0,
+                "events": list(w.trace_events)}
+            offsets[w.label] = (w.clock_offset
+                                if w.clock_offset is not None else 0.0)
+        return telemetry.merge_traces(traces, offsets=offsets,
+                                      synthesize_wire=synthesize_wire)
+
+    @property
+    def http_url(self) -> Optional[str]:
+        """Base URL of the live telemetry endpoint, or None when the
+        controller was built without ``http_port=``."""
+        return None if self._httpd is None else self._httpd.url
+
+    def _refresh_http_cache(self, now: float):
+        """Rebuild the /healthz, /traces/recent, and /state payloads
+        (throttled to ~2 Hz).  Handler threads read the PREVIOUS dict
+        until the swap — a single reference store, atomic under the
+        GIL, same discipline as ``_closing``."""
+        if self._httpd is None:
+            return
+        if self._http_refreshed is not None \
+                and now - self._http_refreshed < 0.5:
+            return
+        self._http_refreshed = now
+        states = self.worker_states()
+        ok = all(v["state"] in ("up", "retired")
+                 for v in states.values())
+        try:
+            summary = telemetry.waterfall_summary(
+                self.merged_trace(refresh=False)["events"])
+        except Exception as e:            # never let a malformed
+            summary = {"error": str(e)}   # trace break liveness
+        cache = {"healthz": (ok, {"workers": states}),
+                 "traces": summary,
+                 "state": {"requests": {
+                     s: sum(1 for r in self._journal.values()
+                            if r.status == s)
+                     for s in (QUEUED, PREFILLING, PREFILLED,
+                               DECODING, COMPLETED, FAILED)},
+                     "workers": states,
+                     "compiles": self.compile_counts()}}
+        self._http_cache = cache  # tpu-lint: disable=unguarded-shared-write
+
     def compile_counts(self) -> dict:
         """Last known per-worker compile counts (hello, refreshed by
         :meth:`snapshot_workers`) — the cluster gate's
@@ -800,6 +1047,8 @@ class ClusterController:
         # lock-free stop flag by design: a single bool store is atomic
         # under the GIL and the accept thread only ever polls it
         self._closing = True  # tpu-lint: disable=unguarded-shared-write
+        if self._httpd is not None:
+            self._httpd.close()
         for w in self._workers.values():
             self._send(w, {"type": "shutdown"})
         try:

@@ -20,7 +20,7 @@ import hashlib
 import numpy as np
 
 __all__ = ["prefix_keys", "payload_nbytes", "validate_payload",
-           "attach_prefix_keys"]
+           "attach_prefix_keys", "attach_trace_context"]
 
 
 def prefix_keys(prompt, block_size: int):
@@ -44,6 +44,19 @@ def attach_prefix_keys(payload: dict) -> dict:
     place; returned for chaining)."""
     payload["prefix_keys"] = list(
         prefix_keys(payload["prompt"], int(payload["block_size"])))
+    return payload
+
+
+def attach_trace_context(payload: dict, ctx) -> dict:
+    """Stamp the wire trace context (``wire.trace_of``'s dict, or
+    ``None`` for no-op) onto an engine-built payload, so the KV blocks
+    stay attributable to their cluster request as the payload crosses
+    prefill worker -> controller -> decode worker.  In place; returned
+    for chaining.  ``validate_payload`` tolerates the extra key —
+    older payload dumps simply lack it."""
+    if ctx is not None:
+        payload["trace"] = {"trace_id": int(ctx["trace_id"]),
+                            "parent": str(ctx.get("parent", "prefill"))}
     return payload
 
 

@@ -20,6 +20,15 @@ asserting the properties the cluster exists to provide:
    (``telemetry.export.merge_snapshots``) into one schema-valid
    snapshot, and the controller registry carries populated
    ``cluster_*`` families (restart counter included).
+5. **Merged distributed trace** — one disaggregated request's
+   submit -> dispatch -> prefill -> handoff export/wire/import ->
+   decode spans land in ONE Chrome-valid trace
+   (``ClusterController.merged_trace``), causally ordered on the
+   clock-corrected timeline, with one named process per worker.
+6. **Live /metrics endpoint** — the controller's embedded HTTP
+   server (``http_port=0``) serves a scrape bit-identical to
+   rendering the registry snapshot directly, plus ``/healthz``,
+   ``/traces/recent`` and ``/state``.
 
 A ``heartbeat``-point fault (one dropped beat, injected controller-
 side) rides along so the process-scope injection path is exercised on
@@ -28,6 +37,7 @@ every CI run, not only in the test suite.
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 
@@ -85,7 +95,7 @@ def main(argv=None) -> int:
     with ClusterController(cfg, params, prefill_workers=1,
                            decode_workers=1, metrics=reg,
                            hb_timeout_s=0.5, faults=faults,
-                           **kw) as ctl:
+                           http_port=0, **kw) as ctl:
         # ---- phase 1: clean disaggregated serve, bit-identity
         rids = [ctl.submit(p.astype(np.int32), max_new=max_new)
                 for p in prompts]
@@ -113,6 +123,64 @@ def main(argv=None) -> int:
                "distinguishable")
         _check(any(f["point"] == "heartbeat" for f in faults.fired()),
                "process-scope heartbeat fault fired controller-side")
+
+        # ---- merged distributed trace: ONE Chrome-valid trace holds
+        # a request's prefill (prefill0), wire transit (synthesized),
+        # and decode (decode0) spans, causally ordered after the
+        # per-worker clock correction
+        mtrace = ctl.merged_trace()
+        telemetry.validate_chrome_trace(telemetry.chrome_trace(mtrace))
+        procs = {e.get("proc") for e in mtrace["events"]}
+        _check({"controller", "prefill0", "decode0"} <= procs,
+               "merged trace carries one named process per worker "
+               "plus the controller")
+        rid0 = rids[0]
+        # keyed by (name, proc): the decode worker's tail-replay of
+        # the final prompt token is ALSO a "prefill" span — the chain
+        # wants the real one, on prefill0
+        want = [("submit", "controller"), ("prefill", "prefill0"),
+                ("handoff_export", "prefill0"),
+                ("handoff_wire", "cluster"),
+                ("handoff_import", "decode0"), ("decode", "decode0")]
+        ev = {(e["name"], e.get("proc")): e for e in mtrace["events"]
+              if e["rid"] == rid0}
+        _check(all(k in ev for k in want),
+               "request 0's full disaggregated span chain is present "
+               f"(missing {[k for k in want if k not in ev]})")
+        eps = 5e-3  # same-host clocks; ping offsets are sub-ms
+        chain = [ev[k] for k in want]
+        _check(all(a["ts"] + (a["dur"] or 0.0) <= b["ts"] + eps
+                   for a, b in zip(chain, chain[1:])),
+               "submit -> prefill -> export -> wire -> import -> "
+               "decode causally ordered on the corrected timeline")
+        _check(ev[("handoff_wire", "cluster")]["dur"] >= 0.0,
+               "synthesized wire span has non-negative duration")
+
+        # ---- live endpoint: a real HTTP scrape of /metrics is
+        # bit-identical to rendering the registry snapshot directly
+        # (nothing pumps the registry between the two reads)
+        import urllib.request
+        base_url = ctl.http_url
+        _check(base_url is not None, "controller bound an HTTP port")
+        with urllib.request.urlopen(base_url + "/metrics",
+                                    timeout=10) as r:
+            scraped = r.read().decode("utf-8")
+            ctype = r.headers["Content-Type"]
+        _check(scraped == telemetry.prometheus_text(reg.snapshot()),
+               "/metrics scrape bit-identical to rendering the "
+               "registry snapshot directly")
+        _check(ctype.startswith("text/plain"),
+               "/metrics served with the Prometheus text content type")
+        with urllib.request.urlopen(base_url + "/healthz",
+                                    timeout=10) as r:
+            hz_code, hz = r.status, json.loads(r.read())
+        _check(hz_code == 200 and hz["ok"] is True,
+               "/healthz reports ok with both workers up")
+        for route in ("/traces/recent", "/state"):
+            with urllib.request.urlopen(base_url + route,
+                                        timeout=10) as r:
+                json.loads(r.read())
+        _check(True, "/traces/recent and /state serve valid JSON")
 
         # ---- phase 2: SIGKILL decode0 mid-stream, replay identity
         rids2 = [ctl.submit(p.astype(np.int32), max_new=max_new)

@@ -25,12 +25,41 @@ import struct
 
 import numpy as np
 
-__all__ = ["MAX_FRAME_BYTES", "encode_frame", "decode_body",
-           "send_msg", "recv_msg", "frame_nbytes"]
+__all__ = ["MAX_FRAME_BYTES", "TRACE_KEY", "encode_frame",
+           "decode_body", "send_msg", "recv_msg", "frame_nbytes",
+           "attach_trace", "trace_of"]
 
 MAX_FRAME_BYTES = 1 << 31          # loud failure beats a 4 GiB malloc
 
 _ND_TAG = "__nd__"
+
+#: Envelope key carrying the distributed-tracing context.  It rides
+#: every dispatch frame as plain JSON next to the command fields, so
+#: the protocol stays greppable and older peers that ignore the key
+#: keep working.
+TRACE_KEY = "trace"
+
+
+def attach_trace(msg: dict, trace_id: int, parent: str = "") -> dict:
+    """Stamp the trace context on an outbound control message: the
+    cluster-wide request id (the controller's ``rid`` — one id, one
+    waterfall) plus the parent span name, so a worker's tracer can
+    attribute its local events to the cluster request that caused
+    them.  Returns ``msg`` for call-site chaining."""
+    ctx = {"trace_id": int(trace_id)}
+    if parent:
+        ctx["parent"] = str(parent)
+    msg[TRACE_KEY] = ctx
+    return msg
+
+
+def trace_of(msg: dict):
+    """The trace context of a received message, or ``None`` — tolerant
+    of peers (or replayed frame dumps) that never attached one."""
+    ctx = msg.get(TRACE_KEY)
+    if not isinstance(ctx, dict) or "trace_id" not in ctx:
+        return None
+    return ctx
 
 
 def _dtype_token(dt: np.dtype) -> str:

@@ -61,6 +61,13 @@ def _reader(sock, inbox):
             msg = wire.recv_msg(sock)
             if msg is None:
                 break
+            if msg.get("type") == "ping":
+                # clock-alignment pings are timestamped at RECEIPT, on
+                # this thread — inbox dwell (the engine may be mid-step
+                # for milliseconds) must not skew the offset estimate,
+                # only inflate the round trip the controller already
+                # measures
+                msg["rx_perf"] = time.perf_counter()
             inbox.put(msg)
     except (ConnectionError, OSError):
         pass
@@ -137,6 +144,14 @@ def main(argv=None) -> int:
         eng.run()
         eng.pop_results()
 
+    # per-worker tracer, armed AFTER warmup so the local warmup
+    # request never pollutes the cluster waterfall; its buffered
+    # events stream to the controller (rids remapped to controller
+    # ids) and merge there under the clock offset the heartbeat
+    # pings estimate
+    tracer = telemetry.Tracer(name=f"worker.{args.worker_id}")
+    eng.tracer = tracer
+
     import socket as socket_mod
     host, port = args.controller.rsplit(":", 1)
     sock = socket_mod.create_connection((host, int(port)), timeout=30)
@@ -161,6 +176,24 @@ def main(argv=None) -> int:
         msg["generation"] = gen
         wire.send_msg(sock, msg)
 
+    def flush_trace():
+        # ship the tracer's buffered events to the controller with
+        # engine rids rewritten to CONTROLLER rids (ridmap still holds
+        # every live mapping — callers flush BEFORE popping one), so
+        # the merged cluster trace folds both workers' spans of a
+        # request under one id.  Engine and tracer are driven only by
+        # this thread, so events()+clear() is not a torn read.
+        evs = tracer.events()
+        if not evs:
+            return
+        tracer.clear()
+        for e in evs:
+            if e["rid"] is not None:
+                e["rid"] = ridmap.get(e["rid"], e["rid"])
+        post({"type": "trace", "events": evs,
+              "wall_t0": tracer.wall_t0, "perf_t0": tracer.perf_t0,
+              "dropped": tracer.dropped})
+
     def maybe_heartbeat():
         # called between inbox commands as well as once per loop: a
         # burst of handoff imports (each one an eager compile in a
@@ -170,9 +203,19 @@ def main(argv=None) -> int:
         now = time.monotonic()
         if now - last_hb >= args.hb_interval:
             last_hb = now
+            live = [r for r in eng._slots if r is not None]
             post({"type": "heartbeat", "ts": time.time(),
                   "queue_depth": len(eng._queue),
-                  "active": sum(r is not None for r in eng._slots)})
+                  "active": len(live),
+                  # occupancy payload for the cluster_worker_* gauges:
+                  # the same request-level block estimate the engine's
+                  # own serving_pool_blocks_in_use gauge samples
+                  "slots_free": eng.S - len(live),
+                  "blocks_in_use": sum(
+                      -(-(r.prompt.shape[0] + len(r.tokens)) // eng.bs)
+                      for r in live),
+                  "pool_blocks": eng.nb})
+            flush_trace()
 
     def stream_deltas():
         # token-stream channel: ship each live request's NEW tokens as
@@ -188,7 +231,12 @@ def main(argv=None) -> int:
                                            np.int32),
                       "done": False})
                 sent[r.rid] = len(r.tokens)
-        for erid, toks in eng.pop_results().items():
+        results = eng.pop_results()
+        if results:
+            # the retire events are already in the ring: flush while
+            # ridmap still maps them, THEN drop the mappings
+            flush_trace()
+        for erid, toks in results.items():
             if erid not in ridmap:
                 continue
             n_sent = sent.pop(erid, 0)
@@ -219,12 +267,38 @@ def main(argv=None) -> int:
                                               float(msg["temperature"]))
                     ridmap[erid] = msg["rid"]
                 elif kind == "prefill":
+                    # prefill_to_handoff borrows a slot and frees it —
+                    # this engine never owns the request, so the trace
+                    # context's cluster rid tags the events directly
+                    # (no ridmap entry; the id needs no remap at flush)
+                    ctx = wire.trace_of(msg)
                     payload = eng.prefill_to_handoff(
-                        msg["prompt"], float(msg["temperature"]))
+                        msg["prompt"], float(msg["temperature"]),
+                        rid=(int(ctx["trace_id"]) if ctx
+                             else msg["rid"]))
                     handoff.attach_prefix_keys(payload)
+                    handoff.attach_trace_context(payload, ctx)
                     post({"type": "handoff", "rid": msg["rid"],
                           "payload": payload})
+                elif kind == "ping":
+                    # clock alignment: echo the controller's send
+                    # stamp and report this process's wall clock AT
+                    # RECEIPT (reader-thread perf stamp mapped through
+                    # the tracer's anchors) — the reply may be late,
+                    # that only widens the RTT the controller already
+                    # halves into the dispersion bound
+                    rx = float(msg.get("rx_perf",
+                                       time.perf_counter()))
+                    post({"type": "pong", "seq": msg.get("seq"),
+                          "t_tx": msg.get("t_tx"),
+                          "t_worker": tracer.wall_t0
+                          + (rx - tracer.perf_t0)})
                 elif kind == "snapshot":
+                    # flush first: by the time the snapshot reply
+                    # lands, every trace event recorded so far is
+                    # already controller-side (frames are FIFO per
+                    # socket) — merged_trace(refresh=True) rides this
+                    flush_trace()
                     post({"type": "snapshot", "seq": msg.get("seq"),
                           "role": args.role,
                           "host_state": eng.host_state(),

@@ -275,6 +275,7 @@ class ServingFrontend:
                  metrics=None, tracer=None,
                  flight_recorder: Optional[str] = None,
                  flight_window_s: float = 30.0,
+                 http_port: Optional[int] = None,
                  faults=None):
         enforce(num_engines >= 1, "frontend needs at least one engine, "
                 "got num_engines=%s", num_engines)
@@ -390,6 +391,20 @@ class ServingFrontend:
             for i in range(self.num_engines)]
         for seat in self._seats:
             self._seat_start(seat)
+        # live scrape surface (telemetry/httpd.py): /metrics merges
+        # the frontend registry with every seat's engine registry
+        # under seat= labels; /healthz flips to 503 whenever any seat
+        # is down (crash-parked or restarting).  Handler threads call
+        # only locked/thread-safe methods — see each _http_* callback.
+        self._httpd = None
+        if http_port is not None:
+            from paddle_tpu.telemetry.httpd import TelemetryHTTPD
+            self._httpd = TelemetryHTTPD(
+                port=int(http_port),
+                metrics_fn=self._http_metrics,
+                healthz_fn=self._http_healthz,
+                traces_fn=self._http_traces,
+                state_fn=self._http_state)
 
     # ------------------------------------------------------------ submit
 
@@ -1026,6 +1041,56 @@ class ServingFrontend:
         return [None if e is None else e.compile_counts()
                 for e in engines]
 
+    # ------------------------------------------------ live endpoint
+
+    @property
+    def http_url(self) -> Optional[str]:
+        """Base URL of the live telemetry endpoint, or None when the
+        frontend was built without ``http_port=``."""
+        return None if self._httpd is None else self._httpd.url
+
+    def _http_metrics(self) -> dict:
+        """/metrics source: the frontend registry merged with every
+        seat's engine registry under ``seat=`` labels
+        (``merge_snapshots`` — frontend_* and serving_* families are
+        disjoint, so nothing clashes).  Registries are thread-safe and
+        the seat list is fixed at construction, so handler threads
+        need no frontend lock here."""
+        from paddle_tpu.telemetry.export import merge_snapshots
+        pairs = [("frontend", self.metrics.snapshot())]
+        pairs += [(s.label, s.registry.snapshot())
+                  for s in self._seats]
+        return merge_snapshots(pairs, label="seat",
+                               registry="frontend")
+
+    def _http_healthz(self):
+        """/healthz source: 200 only when EVERY seat is up — a single
+        crash-parked or restarting seat flips the probe to 503, which
+        is exactly when a balancer should stop routing here."""
+        with self._lock:
+            states = {s.label: s.state for s in self._seats}
+        live = sum(1 for v in states.values() if v == _UP)
+        return live == len(states), {"engines_live": live,
+                                     "engines": len(states),
+                                     "seats": states}
+
+    def _http_traces(self) -> dict:
+        """/traces/recent source: the waterfall summary of the
+        frontend tracer's ring (empty summary when tracing is off).
+        ``Tracer.events()`` copies under the tracer's own lock."""
+        if self.tracer is None:
+            return {"requests": 0, "tracing": False}
+        return telemetry.waterfall_summary(self.tracer.events())
+
+    def _http_state(self) -> dict:
+        """/state source: service rollup + per-seat supervision view.
+        Engine ``host_state()`` is deliberately NOT walked here — a
+        scrape must not race the owning worker thread's step; per-seat
+        occupancy already rides /metrics via the seat registries."""
+        with self._lock:
+            snap = self._snapshot_locked()
+        return {"stats": self.stats(), "supervision": snap}
+
     def _snapshot_locked(self) -> dict:
         return {
             "queue_depth": len(self._queue),
@@ -1048,6 +1113,9 @@ class ServingFrontend:
         """Stop every worker thread and take the seats down.  Queued
         and running requests stay journaled (non-terminal) — close is
         shutdown, not resolution."""
+        if self._httpd is not None:
+            self._httpd.close()
+            self._httpd = None
         with self._lock:
             self._stopping = True
             for seat in self._seats:

@@ -1299,6 +1299,11 @@ class PagedServingEngine:
             "serving_compiles",
             help="compiles since engine construction per jitted fn "
                  "(CompileWatcher), sampled per step; decode must stay 1")
+        # compile_seconds{program=} rides the watcher itself: poll()
+        # (per step / per prefill) turns count growth into histogram
+        # observations and, past each program's first compile, a
+        # "recompile" trace instant naming the program
+        self._compile_watch.bind_metrics(m)
         self._m_kernel_fallback = m.counter(
             "serving_kernel_fallback_total",
             help="kernel-selected attention calls that traced the XLA "
@@ -1531,7 +1536,8 @@ class PagedServingEngine:
         return rid
 
     def prefill_to_handoff(self, prompt_ids,
-                           temperature: float = 0.0) -> dict:
+                           temperature: float = 0.0, *,
+                           rid: Optional[int] = None) -> dict:
         """Prefill a prompt and EXPORT its KV blocks as a handoff
         payload instead of decoding — the disaggregated PREFILL role
         (``paddle_tpu/cluster``): a prefill worker calls this per
@@ -1545,7 +1551,14 @@ class PagedServingEngine:
         cursor one short and replays the final prompt token through
         its own tail prefill, which regenerates the first token
         bit-identically (the prefix-cache full-prompt-hit replay
-        contract) — no token or RNG state crosses the wire."""
+        contract) — no token or RNG state crosses the wire.
+
+        ``rid`` tags the trace events only (this engine never owns the
+        request): the cluster worker passes the controller's request
+        id from the wire trace context, so the prefill and export
+        spans land on the same cross-process waterfall as the decode
+        side's."""
+        t0 = time.perf_counter()
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         n = prompt.shape[0]
         enforce(n >= 1, "prefill_to_handoff: empty prompt")
@@ -1573,15 +1586,24 @@ class PagedServingEngine:
             float(temperature), self._split(), *self._ad_extra())
         assert bool(ok), "paged pool exhausted despite handoff " \
                          "accounting (engine bug)"
+        t_sync = time.perf_counter()   # bool(ok) synced the prefill
         payload = paged.paged_export_blocks(self.cache, slot)
         payload["prompt"] = prompt
         self.cache = self._free(
             self.cache, jnp.asarray(np.arange(self.S) == slot))
         self._m_handoff_export.inc()
         if self.tracer is not None:
-            self.tracer.instant("handoff_export", track="host",
-                                prompt_len=int(n),
-                                blocks=int(blocks))
+            # complete spans (not instants) so the merged cluster
+            # trace can place the wire leg between export end and the
+            # decode side's import start
+            self.tracer.complete("prefill", t0, t_sync, track="host",
+                                 rid=rid, prompt_len=int(n),
+                                 handoff=True)
+            self.tracer.complete("handoff_export", t_sync, track="host",
+                                 rid=rid, prompt_len=int(n),
+                                 blocks=int(blocks))
+        self._compile_watch.poll(time.perf_counter() - t0,
+                                 tracer=self.tracer)
         return payload
 
     def submit_handoff(self, payload: dict, max_new: int,
@@ -2054,6 +2076,7 @@ class PagedServingEngine:
         prefix-cache full-prompt-hit recipe, so the emitted first
         token and every decode token after it are bit-identical to a
         local prefill of the same prompt."""
+        t0 = time.perf_counter()
         n = int(req.prompt.shape[0])
         cache, ids = paged.paged_import_blocks(self.cache, req.handoff)
         assert ids is not None, \
@@ -2091,9 +2114,11 @@ class PagedServingEngine:
         req.handoff = None                # pages are resident: drop the
         self._m_handoff_import.inc()      # payload's host copy
         if self.tracer is not None:
-            self.tracer.instant("handoff_import", track=f"slot{slot}",
-                                rid=req.rid, blocks=nmap,
-                                imported_tokens=new_len)
+            # a complete span (was an instant): the merged cluster
+            # trace ends the synthesized wire leg where this starts
+            self.tracer.complete("handoff_import", t0,
+                                 track=f"slot{slot}", rid=req.rid,
+                                 blocks=nmap, imported_tokens=new_len)
         return tok0, done0, ok, width, tlen
 
     def _register_prefix(self, req, slot, hit):
@@ -2317,6 +2342,10 @@ class PagedServingEngine:
         dt = time.perf_counter() - t0
         self._run_seconds += dt           # the decode paths synced: real
         self._m_step.observe(dt)
+        # compile_seconds + "recompile" trace instants: any program
+        # that compiled during this step gets the step's duration as
+        # its (upper-bound) compile-time observation
+        self._compile_watch.poll(dt, tracer=self.tracer)
         self._last_step_wall = time.time()
         self._last_step_seconds = dt
         return True

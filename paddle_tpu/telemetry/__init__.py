@@ -64,15 +64,18 @@ from paddle_tpu.telemetry.spans import (SPAN_METRIC, current_span, span,
 from paddle_tpu.telemetry.export import (append_jsonl,
                                          append_trace_jsonl, bench_row,
                                          console_summary, diff_snapshots,
-                                         emit_row, prometheus_text,
+                                         emit_row, merge_snapshots,
+                                         merge_traces, prometheus_text,
                                          read_jsonl, run_meta,
                                          validate_snapshot)
 from paddle_tpu.telemetry.trace import (TRACE_SCHEMA_VERSION, Tracer,
                                         chrome_trace, get_tracer,
+                                        handoff_breakdown,
                                         request_waterfalls, set_tracer,
                                         validate_chrome_trace,
                                         validate_trace,
                                         waterfall_summary)
+from paddle_tpu.telemetry.httpd import TelemetryHTTPD
 from paddle_tpu.telemetry.health import (Anomaly, HealthConfig,
                                          HealthMonitor, HealthSpec,
                                          build_spec, health_vector,
@@ -91,10 +94,12 @@ __all__ = [
     "span", "current_span", "trace", "start", "stop", "SPAN_METRIC",
     "append_jsonl", "read_jsonl", "prometheus_text", "console_summary",
     "validate_snapshot", "diff_snapshots", "emit_row", "bench_row",
+    "merge_snapshots", "merge_traces",
     "append_trace_jsonl", "run_meta",
     "Tracer", "TRACE_SCHEMA_VERSION", "chrome_trace", "get_tracer",
     "set_tracer", "validate_trace", "validate_chrome_trace",
-    "request_waterfalls", "waterfall_summary",
+    "request_waterfalls", "waterfall_summary", "handoff_breakdown",
+    "TelemetryHTTPD",
     "Anomaly", "HealthConfig", "HealthMonitor", "HealthSpec",
     "build_spec", "health_vector", "render_health", "unpack",
 ]

@@ -36,7 +36,7 @@ from paddle_tpu.telemetry.metrics import (SCHEMA_VERSION, approx_quantile)
 __all__ = ["validate_snapshot", "append_jsonl", "read_jsonl",
            "prometheus_text", "console_summary", "emit_row",
            "bench_row", "diff_snapshots", "merge_snapshots",
-           "append_trace_jsonl", "run_meta"]
+           "merge_traces", "append_trace_jsonl", "run_meta"]
 
 
 # ------------------------------------------------------------- validation
@@ -393,6 +393,99 @@ def merge_snapshots(snapshots, *, label: str = "worker",
     return validate_snapshot({"schema_version": SCHEMA_VERSION,
                               "registry": str(registry),
                               "metrics": merged})
+
+
+def merge_traces(traces, *, offsets=None, registry: str = "cluster",
+                 synthesize_wire: bool = True) -> dict:
+    """Merge per-process tracer snapshots into ONE valid trace snapshot
+    on a common wall-clock timeline — the trace sibling of
+    :func:`merge_snapshots`, and the function that turns a
+    disaggregated request's three partial traces (controller, prefill
+    worker, decode worker) into a single causally-ordered waterfall.
+
+    ``traces`` is ``{source: Tracer.snapshot()}`` or ``[(source,
+    snapshot), ...]``; every snapshot must carry the ``wall_t0`` /
+    ``perf_t0`` anchors (present since the tracer existed).  Each
+    event's monotonic ``ts`` converts to absolute wall seconds via its
+    source's anchors, minus that source's entry in ``offsets`` —
+    ``{source: seconds}``, the source's wall clock minus the reference
+    clock as estimated by the controller's heartbeat round-trips
+    (``cluster_clock_offset_s``).  Sources absent from ``offsets`` get
+    0.0 (trusted clock).  Each merged event gains ``{"proc": source}``,
+    which :func:`trace.chrome_trace` renders as one named process per
+    source.  Duplicate source names raise ``ValueError``, same contract
+    as :func:`merge_snapshots`.
+
+    ``synthesize_wire=True`` adds one ``handoff_wire`` complete span
+    per request that has both a ``handoff_export`` and a
+    ``handoff_import`` span: from export end to import start on the
+    corrected timeline.  That leg is invisible to any single process —
+    it covers the frame send, controller dwell, and the decode-side
+    queue wait.  When clock-correction error exceeds the true gap the
+    raw (negative) gap is preserved in ``args["raw_gap_s"]`` and the
+    span duration clamps to 0 so the merged trace stays Chrome-valid."""
+    from paddle_tpu.telemetry.trace import (TRACE_SCHEMA_VERSION,
+                                            validate_trace)
+    items = list(traces.items()) if isinstance(traces, dict) \
+        else list(traces)
+    if not items:
+        raise ValueError("merge_traces: nothing to merge")
+    offsets = dict(offsets or {})
+    events: List[dict] = []
+    sources = {}
+    dropped = 0
+    capacity = 0
+    for source, trace in items:
+        source = str(source)
+        if source in sources:
+            raise ValueError(
+                f"merge_traces: duplicate source label {source!r}")
+        validate_trace(trace)
+        for key in ("wall_t0", "perf_t0"):
+            if not isinstance(trace.get(key), (int, float)):
+                raise ValueError(
+                    f"merge_traces: source {source!r} lacks the "
+                    f"{key!r} wall-clock anchor — cannot place its "
+                    "events on a shared timeline")
+        off = float(offsets.get(source, 0.0))
+        base = trace["wall_t0"] - trace["perf_t0"] - off
+        for e in trace["events"]:
+            ev = dict(e, args=dict(e["args"]))
+            ev["ts"] = base + e["ts"]
+            ev["proc"] = source
+            events.append(ev)
+        dropped += int(trace["dropped"])
+        capacity += int(trace["capacity"])
+        sources[source] = {"offset_s": off, "events":
+                           len(trace["events"]),
+                           "dropped": int(trace["dropped"])}
+    if synthesize_wire:
+        export_end, import_start = {}, {}
+        for e in events:
+            rid = e.get("rid")
+            if rid is None or e["ph"] != "X":
+                continue
+            if e["name"] == "handoff_export":
+                export_end[rid] = e["ts"] + e["dur"]
+            elif e["name"] == "handoff_import":
+                import_start[rid] = e["ts"]
+        for rid in sorted(set(export_end) & set(import_start)):
+            gap = import_start[rid] - export_end[rid]
+            events.append({"ts": export_end[rid],
+                           "dur": max(0.0, gap),
+                           "name": "handoff_wire", "ph": "X",
+                           "track": "wire", "rid": int(rid),
+                           "args": {"raw_gap_s": gap},
+                           "proc": str(registry)})
+    events.sort(key=lambda e: e["ts"])
+    t0 = events[0]["ts"] if events else 0.0
+    return validate_trace({"schema_version": TRACE_SCHEMA_VERSION,
+                           "name": str(registry),
+                           "capacity": max(capacity, 1),
+                           "dropped": dropped,
+                           "wall_t0": t0, "perf_t0": t0,
+                           "sources": sources,
+                           "events": events})
 
 
 # ----------------------------------------------------------------- diff

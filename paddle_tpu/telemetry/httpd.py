@@ -1,0 +1,154 @@
+"""Live telemetry endpoint: a stdlib ``http.server`` scrape surface.
+
+Until now every exporter wrote files — metrics reached Prometheus only
+as JSONL snapshots copied out of the run directory, and "what is the
+engine doing RIGHT NOW" meant attaching a debugger.  This module puts
+the existing renderers behind a port, nothing more: the handler calls
+a caller-provided function per route and renders its return value with
+the exact same code paths the offline exporters use.  Four routes:
+
+* ``GET /metrics``  — ``prometheus_text(metrics_fn())``: the classic
+  exposition format, scrapeable by a real Prometheus.  Bit-identical
+  to rendering the registry snapshot directly (the CI httpd smoke
+  asserts this), because the handler performs NO transformation.
+* ``GET /healthz``  — ``healthz_fn() -> (ok, detail_dict)``: HTTP 200
+  with JSON when ok, 503 when not (a seat down, a worker restarting) —
+  the load-balancer probe.
+* ``GET /traces/recent`` — ``traces_fn() -> dict``: the waterfall
+  summary JSON (``trace.waterfall_summary``) of recent requests.
+* ``GET /state``    — ``state_fn() -> dict``: engine ``host_state()``
+  / cluster worker states, JSON.
+
+Threading contract (what keeps this module host-lint clean): the
+server thread and its per-request handler threads own NO shared
+mutable state in this module — every handler round reads via the
+injected callbacks, which are themselves thread-safe
+(``MetricsRegistry.snapshot()`` takes the registry lock; the frontend
+and controller hand in either locked methods or an atomically-swapped
+cached dict refreshed by their pump loop).  A callback that raises
+becomes an HTTP 500 carrying the error text: a broken scrape must
+never kill the serving process, and a scrape must never block the
+engine.  Endpoints without a configured callback return 404, so a
+metrics-only deployment exposes nothing else.
+
+Wiring: ``ServingFrontend(http_port=...)`` and
+``ClusterController(http_port=...)`` construct one of these (port 0
+picks a free port, see ``.port``/``.url``) and close it on shutdown.
+Design notes: ``docs/design/telemetry.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+__all__ = ["TelemetryHTTPD"]
+
+
+class TelemetryHTTPD:
+    """A daemon-threaded HTTP server exposing telemetry callbacks.
+
+    ``metrics_fn`` returns a registry snapshot dict (rendered as
+    Prometheus text); ``healthz_fn`` returns ``(ok, detail_dict)``;
+    ``traces_fn`` and ``state_fn`` return JSON-safe dicts.  Any of them
+    may be None — the route 404s.  The server binds immediately and
+    serves until :meth:`close`.
+    """
+
+    def __init__(self, *, port: int = 0, host: str = "127.0.0.1",
+                 metrics_fn: Optional[Callable[[], dict]] = None,
+                 healthz_fn: Optional[
+                     Callable[[], Tuple[bool, dict]]] = None,
+                 traces_fn: Optional[Callable[[], dict]] = None,
+                 state_fn: Optional[Callable[[], dict]] = None):
+        self.metrics_fn = metrics_fn
+        self.healthz_fn = healthz_fn
+        self.traces_fn = traces_fn
+        self.state_fn = state_fn
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer((host, int(port)), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="telemetry-httpd", daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """The bound port (useful with ``port=0``)."""
+        return int(self._server.server_address[1])
+
+    @property
+    def url(self) -> str:
+        """Base URL, e.g. ``http://127.0.0.1:9100``."""
+        host = self._server.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def close(self) -> None:
+        """Stop serving and join the server thread (idempotent)."""
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def _make_handler(httpd: TelemetryHTTPD):
+    """Build the request-handler class closed over ``httpd``.
+
+    ``BaseHTTPRequestHandler`` instantiates per request on the server's
+    handler threads; the closure keeps all routing state immutable."""
+
+    class _Handler(BaseHTTPRequestHandler):
+        # scrapes arrive every few seconds forever — stdout logging
+        # per request would drown the serving process's own output
+        def log_message(self, fmt, *args):  # noqa: ARG002
+            pass
+
+        def _send(self, status: int, body: bytes,
+                  content_type: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload, sort_keys=True).encode("utf-8")
+            self._send(status, body, "application/json; charset=utf-8")
+
+        def do_GET(self):  # noqa: N802 — http.server API name
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics" and httpd.metrics_fn is not None:
+                    from paddle_tpu.telemetry.export import \
+                        prometheus_text
+                    body = prometheus_text(httpd.metrics_fn())
+                    self._send(200, body.encode("utf-8"),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif path == "/healthz" \
+                        and httpd.healthz_fn is not None:
+                    ok, detail = httpd.healthz_fn()
+                    self._send_json(200 if ok else 503,
+                                    {"ok": bool(ok), **detail})
+                elif path == "/traces/recent" \
+                        and httpd.traces_fn is not None:
+                    self._send_json(200, httpd.traces_fn())
+                elif path == "/state" and httpd.state_fn is not None:
+                    self._send_json(200, httpd.state_fn())
+                else:
+                    self._send_json(404, {"error": "not found",
+                                          "path": path})
+            except Exception as e:  # a broken scrape must stay a
+                # scrape problem — never propagate into the server
+                try:
+                    self._send_json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+                except Exception:
+                    pass
+
+    return _Handler

@@ -56,7 +56,8 @@ from typing import Dict, Iterator, List, Optional, Sequence
 
 __all__ = ["Tracer", "TRACE_SCHEMA_VERSION", "chrome_trace",
            "validate_chrome_trace", "validate_trace", "set_tracer",
-           "get_tracer", "request_waterfalls", "waterfall_summary"]
+           "get_tracer", "request_waterfalls", "waterfall_summary",
+           "handoff_breakdown"]
 
 #: Bump when the event dict layout changes; validate_trace and the CI
 #: trace round-trip pin it.
@@ -313,6 +314,10 @@ def validate_trace(trace: dict) -> dict:
             _fail(f"{where}: rid must be int or None, got {rid!r}")
         if not isinstance(e.get("args"), dict):
             _fail(f"{where}: args must be a dict")
+        proc = e.get("proc")
+        if proc is not None and not isinstance(proc, str):
+            _fail(f"{where}: proc must be a string or absent, "
+                  f"got {proc!r}")
     return trace
 
 
@@ -337,27 +342,47 @@ def _track_order(tracks: Sequence[str]) -> List[str]:
 def chrome_trace(trace: dict, *, process_name: str = "paddle_tpu") -> dict:
     """Render a :meth:`Tracer.snapshot` as Chrome trace-event JSON.
 
-    Loads directly in Perfetto / ``chrome://tracing``: one process, one
-    named thread per track (``host`` on top, then ``slot0..slotN``),
-    spans as complete events, points as instants, ``rid`` and extras in
-    ``args``.  Timestamps convert to microseconds relative to the
-    earliest event (the format's unit)."""
+    Loads directly in Perfetto / ``chrome://tracing``: one named thread
+    per track (``host`` on top, then ``slot0..slotN``), spans as
+    complete events, points as instants, ``rid`` and extras in ``args``.
+    Timestamps convert to microseconds relative to the earliest event
+    (the format's unit).
+
+    A single-process snapshot renders as one process (pid 0).  A merged
+    cluster trace (``export.merge_traces``) tags each event with a
+    ``proc`` source label; those render as one NAMED PROCESS per source
+    — controller and every worker side by side on one timeline — with
+    the track threads numbered per process."""
     validate_trace(trace)
     events = trace["events"]
-    tracks = _track_order([e["track"] for e in events]) or ["host"]
-    tids = {t: i for i, t in enumerate(tracks)}
+    procs: List[Optional[str]] = []
+    for e in events:
+        p = e.get("proc")
+        if p not in procs:
+            procs.append(p)
+    if not procs:
+        procs = [None]
+    pids = {p: i for i, p in enumerate(procs)}
     t0 = min((e["ts"] for e in events), default=0.0)
-    out = [{"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
-            "args": {"name": f"{process_name}:{trace['name']}"}}]
-    for t, tid in tids.items():
-        out.append({"ph": "M", "name": "thread_name", "pid": 0,
-                    "tid": tid, "args": {"name": t}})
+    out = []
+    tids: Dict[tuple, int] = {}
+    for p, pid in pids.items():
+        pname = f"{process_name}:{trace['name']}" if p is None else str(p)
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": pname}})
+        tracks = _track_order([e["track"] for e in events
+                               if e.get("proc") == p]) or ["host"]
+        for i, t in enumerate(tracks):
+            tids[(p, t)] = i
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": i, "args": {"name": t}})
     for e in events:
         args = dict(e["args"])
         if e["rid"] is not None:
             args["rid"] = e["rid"]
-        ce = {"name": e["name"], "ph": e["ph"], "pid": 0,
-              "tid": tids[e["track"]],
+        p = e.get("proc")
+        ce = {"name": e["name"], "ph": e["ph"], "pid": pids[p],
+              "tid": tids[(p, e["track"])],
               "ts": (e["ts"] - t0) * 1e6, "args": args}
         if e["ph"] == "X":
             ce["dur"] = e["dur"] * 1e6
@@ -389,7 +414,7 @@ def validate_chrome_trace(doc: dict) -> dict:
                 fail(f"{where}: missing {key!r}")
         if e["ph"] == "M":
             if e["name"] == "thread_name":
-                named_threads.add(e["tid"])
+                named_threads.add((e["pid"], e["tid"]))
             continue
         if e["ph"] not in _PHASES:
             fail(f"{where}: unexpected phase {e['ph']!r}")
@@ -398,9 +423,10 @@ def validate_chrome_trace(doc: dict) -> dict:
         if e["ph"] == "X" and (not isinstance(e.get("dur"), (int, float))
                                or e["dur"] < 0):
             fail(f"{where}: complete event needs dur >= 0 (µs)")
-        if e["tid"] not in named_threads:
-            fail(f"{where}: tid {e['tid']} has no thread_name metadata "
-                 "— the track would render unlabeled")
+        if (e["pid"], e["tid"]) not in named_threads:
+            fail(f"{where}: pid {e['pid']} tid {e['tid']} has no "
+                 "thread_name metadata — the track would render "
+                 "unlabeled")
     return doc
 
 
@@ -485,3 +511,27 @@ def waterfall_summary(events: List[dict], slowest: int = 5) -> dict:
             "decode_s": digest("decode_s"),
             "total_s": digest("total_s"),
             "slowest": ranked[:max(0, int(slowest))]}
+
+
+def handoff_breakdown(events: List[dict]) -> List[dict]:
+    """Fold a MERGED cluster trace (``export.merge_traces``) into one
+    record per disaggregated request: how long the prefix KV spent in
+    export (prefill worker packs pages to host), on the wire (frame +
+    controller dwell + decode-side queue wait), and in import (decode
+    worker maps pages back in).  These are the three legs the ROADMAP's
+    v5e campaign wants separated — ``cluster_handoff_seconds`` only has
+    their sum.  Requests with no handoff spans are omitted."""
+    reqs: Dict[int, dict] = {}
+    for e in events:
+        rid = e.get("rid")
+        if rid is None or e.get("ph") != "X":
+            continue
+        key = {"handoff_export": "export_s", "handoff_wire": "wire_s",
+               "handoff_import": "import_s"}.get(e["name"])
+        if key is None:
+            continue
+        r = reqs.setdefault(int(rid), {
+            "rid": int(rid), "export_s": None, "wire_s": None,
+            "import_s": None})
+        r[key] = e["dur"]
+    return sorted(reqs.values(), key=lambda r: r["rid"])

@@ -1,0 +1,245 @@
+"""Distributed-trace merging (``telemetry.export.merge_traces``) and
+its renderers: cross-process clock correction, wire-span synthesis,
+multi-process Chrome export, and the per-request handoff breakdown.
+
+Load-bearing pins:
+
+* ``merge_traces`` places every source's monotonic timestamps on ONE
+  wall timeline via the snapshot anchors minus the per-source clock
+  offset — with correct offsets a disaggregated request's export ->
+  wire -> import chain comes out causally ordered even under seconds
+  of injected skew;
+* the synthesized ``handoff_wire`` span never goes negative — when the
+  correction error exceeds the true gap, the duration clamps to 0 and
+  the raw (negative) gap is preserved in ``args["raw_gap_s"]``;
+* the merged trace renders as one NAMED PROCESS per source in the
+  Chrome export and stays ``validate_chrome_trace``-valid;
+* ``handoff_breakdown`` folds the merged trace into per-request
+  export/wire/import legs (the three numbers ``cluster_handoff_seconds``
+  only has the sum of).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.telemetry import (chrome_trace, handoff_breakdown,
+                                  merge_traces, validate_chrome_trace)
+
+
+def _ev(name, ts, dur=None, *, track="host", rid=None, **args):
+    ph = "X" if dur is not None else "i"
+    return {"ts": float(ts),
+            "dur": None if dur is None else float(dur),
+            "name": str(name), "ph": ph, "track": track,
+            "rid": rid, "args": dict(args)}
+
+
+def _snap(name, events, *, wall_t0=0.0, perf_t0=0.0):
+    return {"schema_version": telemetry.TRACE_SCHEMA_VERSION,
+            "name": name, "capacity": 1024, "dropped": 0,
+            "wall_t0": float(wall_t0), "perf_t0": float(perf_t0),
+            "events": events}
+
+
+# ------------------------------------------------------- rebase + proc
+
+
+def test_merge_rebases_to_wall_and_tags_proc():
+    # source anchors: wall 50 at perf 10 -> event at perf 12 is wall 52
+    a = _snap("a", [_ev("x", 12.0, 0.5)], wall_t0=50.0, perf_t0=10.0)
+    b = _snap("b", [_ev("y", 3.0)], wall_t0=200.0, perf_t0=0.0)
+    merged = merge_traces({"a": a, "b": b})
+    by = {e["name"]: e for e in merged["events"]}
+    assert by["x"]["ts"] == pytest.approx(52.0)
+    assert by["x"]["proc"] == "a"
+    assert by["y"]["ts"] == pytest.approx(203.0)
+    assert by["y"]["proc"] == "b"
+    assert merged["sources"]["a"]["events"] == 1
+    assert merged["sources"]["b"]["offset_s"] == 0.0
+    # merged events come out globally time-sorted
+    ts = [e["ts"] for e in merged["events"]]
+    assert ts == sorted(ts)
+
+
+def test_offset_semantics_source_wall_minus_reference():
+    # a's wall clock runs 2s AHEAD of the reference: offset +2.0
+    # subtracts, landing its events back on the reference timeline
+    a = _snap("a", [_ev("x", 1.0, 0.1)], wall_t0=102.0, perf_t0=0.0)
+    merged = merge_traces({"a": a}, offsets={"a": 2.0})
+    assert merged["events"][0]["ts"] == pytest.approx(101.0)
+    assert merged["sources"]["a"]["offset_s"] == 2.0
+
+
+def test_duplicate_source_raises():
+    a = _snap("a", [])
+    with pytest.raises(ValueError, match="duplicate source"):
+        merge_traces([("a", a), ("a", a)])
+
+
+def test_missing_anchor_raises():
+    bad = _snap("a", [])
+    del bad["wall_t0"]
+    with pytest.raises(ValueError, match="wall_t0"):
+        merge_traces({"a": bad})
+
+
+def test_empty_merge_raises():
+    with pytest.raises(ValueError, match="nothing to merge"):
+        merge_traces({})
+
+
+# -------------------------------------------- skew-corrected causality
+
+
+def _skewed_cluster(offsets, *, rid=7, t_export=1.0, d_export=0.2,
+                    gap=0.05, d_import=0.03):
+    """Build controller/prefill/decode snapshots for ONE disaggregated
+    request on a TRUE timeline, with each process's wall clock skewed
+    by ``offsets[source]`` (local wall = true wall + offset).  Perf
+    clocks tick true seconds; wall_t0 carries the skew."""
+    base = 100.0
+    t_import = t_export + d_export + gap
+
+    def snap(src, events):
+        return _snap(src, events, wall_t0=base + offsets[src],
+                     perf_t0=0.0)
+
+    traces = {
+        "controller": snap("controller",
+                           [_ev("submit", 0.5, rid=rid)]),
+        "prefill0": snap("prefill0",
+                         [_ev("prefill", 0.7, t_export - 0.7, rid=rid),
+                          _ev("handoff_export", t_export, d_export,
+                              rid=rid)]),
+        "decode0": snap("decode0",
+                        [_ev("handoff_import", t_import, d_import,
+                             track="slot0", rid=rid),
+                         _ev("decode", t_import + d_import, 0.4,
+                             track="slot0", rid=rid)]),
+    }
+    return traces
+
+
+def test_clock_skew_corrected_chain_is_causal():
+    offsets = {"controller": 0.0, "prefill0": 0.9, "decode0": -0.6}
+    merged = merge_traces(_skewed_cluster(offsets), offsets=offsets)
+    ev = {e["name"]: e for e in merged["events"]}
+    chain = [ev[n] for n in ("submit", "prefill", "handoff_export",
+                             "handoff_wire", "handoff_import",
+                             "decode")]
+    for a, b in zip(chain, chain[1:]):
+        assert a["ts"] + (a["dur"] or 0.0) <= b["ts"] + 1e-9, \
+            f"{a['name']} must end before {b['name']} starts"
+    wire = ev["handoff_wire"]
+    assert wire["dur"] == pytest.approx(0.05)
+    assert wire["args"]["raw_gap_s"] == pytest.approx(0.05)
+    assert wire["proc"] == "cluster"
+    assert wire["track"] == "wire"
+
+
+def test_uncorrected_skew_misorders_and_wire_clamps():
+    # same 1.5s of relative skew, NO offsets passed: the apparent
+    # import start lands before the apparent export end, the wire span
+    # clamps to 0, and the negative raw gap survives in args — the
+    # exact failure the clock alignment exists to prevent
+    offsets = {"controller": 0.0, "prefill0": 0.9, "decode0": -0.6}
+    merged = merge_traces(_skewed_cluster(offsets))
+    ev = {e["name"]: e for e in merged["events"]}
+    exp_end = ev["handoff_export"]["ts"] + ev["handoff_export"]["dur"]
+    assert ev["handoff_import"]["ts"] < exp_end  # visibly misordered
+    wire = ev["handoff_wire"]
+    assert wire["dur"] == 0.0
+    assert wire["args"]["raw_gap_s"] == pytest.approx(0.05 - 1.5)
+
+
+def test_randomized_skew_monotonicity():
+    rng = np.random.default_rng(20)
+    for _ in range(10):
+        offs = {"controller": 0.0,
+                "prefill0": float(rng.uniform(-2, 2)),
+                "decode0": float(rng.uniform(-2, 2))}
+        gap = float(rng.uniform(0.001, 0.5))
+        merged = merge_traces(
+            _skewed_cluster(offs, gap=gap), offsets=offs)
+        ts = [e["ts"] for e in merged["events"]]
+        assert ts == sorted(ts)
+        ev = {e["name"]: e for e in merged["events"]}
+        assert ev["handoff_wire"]["dur"] == pytest.approx(gap)
+        chain = [ev[n] for n in ("submit", "prefill", "handoff_export",
+                                 "handoff_wire", "handoff_import",
+                                 "decode")]
+        for a, b in zip(chain, chain[1:]):
+            assert a["ts"] + (a["dur"] or 0.0) <= b["ts"] + 1e-9
+
+
+def test_wire_synthesis_opt_out():
+    offsets = {"controller": 0.0, "prefill0": 0.0, "decode0": 0.0}
+    merged = merge_traces(_skewed_cluster(offsets), offsets=offsets,
+                          synthesize_wire=False)
+    assert not any(e["name"] == "handoff_wire"
+                   for e in merged["events"])
+
+
+# ------------------------------------------------------- chrome export
+
+
+def test_chrome_trace_renders_one_named_process_per_source():
+    offsets = {"controller": 0.0, "prefill0": 0.3, "decode0": -0.3}
+    merged = merge_traces(_skewed_cluster(offsets), offsets=offsets)
+    doc = validate_chrome_trace(chrome_trace(merged))
+    pnames = {m["args"]["name"]: m["pid"]
+              for m in doc["traceEvents"]
+              if m.get("ph") == "M" and m["name"] == "process_name"}
+    assert set(pnames) == {"controller", "prefill0", "decode0",
+                           "cluster"}
+    assert len(set(pnames.values())) == 4  # distinct pids
+    # thread ids are numbered PER PROCESS: both workers own a tid 0
+    tn = {(m["pid"], m["tid"]): m["args"]["name"]
+          for m in doc["traceEvents"]
+          if m.get("ph") == "M" and m["name"] == "thread_name"}
+    assert tn[(pnames["prefill0"], 0)] == "host"
+    assert tn[(pnames["decode0"], 0)] == "slot0"
+    # every event lands in its source's pid
+    ev_pids = {e["pid"] for e in doc["traceEvents"]
+               if e.get("ph") in ("X", "i")}
+    assert ev_pids == set(pnames.values())
+
+
+def test_single_process_snapshot_still_renders():
+    snap = _snap("solo", [_ev("x", 1.0, 0.1)])
+    doc = validate_chrome_trace(chrome_trace(snap))
+    pnames = [m["args"]["name"] for m in doc["traceEvents"]
+              if m.get("ph") == "M" and m["name"] == "process_name"]
+    assert pnames == ["paddle_tpu:solo"]
+
+
+# -------------------------------------------------- handoff breakdown
+
+
+def test_handoff_breakdown_folds_legs_per_request():
+    offsets = {"controller": 0.0, "prefill0": 1.2, "decode0": -0.7}
+    merged = merge_traces(_skewed_cluster(offsets, rid=3, d_export=0.2,
+                                          gap=0.08, d_import=0.03),
+                          offsets=offsets)
+    rows = handoff_breakdown(merged["events"])
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["rid"] == 3
+    assert row["export_s"] == pytest.approx(0.2)
+    assert row["wire_s"] == pytest.approx(0.08)
+    assert row["import_s"] == pytest.approx(0.03)
+
+
+def test_handoff_breakdown_partial_and_ordering():
+    events = [_ev("handoff_export", 1.0, 0.2, rid=9),
+              _ev("handoff_export", 0.1, 0.1, rid=2),
+              _ev("handoff_import", 0.3, 0.05, rid=2),
+              _ev("decode", 2.0, 0.5, rid=9),      # not a handoff leg
+              _ev("handoff_export", 5.0, rid=4)]   # instant: ignored
+    rows = handoff_breakdown(events)
+    assert [r["rid"] for r in rows] == [2, 9]      # rid-sorted
+    assert rows[0]["import_s"] == pytest.approx(0.05)
+    assert rows[0]["wire_s"] is None               # never synthesized
+    assert rows[1] == {"rid": 9, "export_s": pytest.approx(0.2),
+                       "wire_s": None, "import_s": None}
